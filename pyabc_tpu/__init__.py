@@ -1,0 +1,22 @@
+"""pyabc_tpu — TPU-native ABC-SMC likelihood-free inference.
+
+Same capabilities as the reference (chrhck/pyABC, a fork of icb-dcm/pyabc),
+re-designed TPU-first: the propose→simulate→distance→accept→weight loop runs
+as batched, jit-compiled XLA generations over a device-resident particle
+population instead of pickled per-particle closures over worker processes.
+"""
+from .core import (
+    RV,
+    Distribution,
+    LowerBoundDecorator,
+    Parameter,
+    ParameterSpace,
+    Particle,
+    Population,
+    RVBase,
+    RVDecorator,
+    ScipyRV,
+    SumStatSpec,
+)
+
+__version__ = "0.1.0"

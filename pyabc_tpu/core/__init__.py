@@ -1,0 +1,30 @@
+from .parameters import Parameter, ParameterSpace
+from .population import Particle, Population
+from .random import generation_key, root_key, round_key
+from .random_variables import (
+    RV,
+    Distribution,
+    LowerBoundDecorator,
+    RVBase,
+    RVDecorator,
+    ScipyRV,
+)
+from .sumstat_spec import SumStatSpec
+from .weighted_statistics import (
+    effective_sample_size,
+    resample,
+    weighted_mean,
+    weighted_median,
+    weighted_quantile,
+    weighted_std,
+    weighted_var,
+)
+
+__all__ = [
+    "Parameter", "ParameterSpace", "Particle", "Population",
+    "RV", "Distribution", "RVBase", "RVDecorator", "LowerBoundDecorator",
+    "ScipyRV", "SumStatSpec",
+    "root_key", "generation_key", "round_key",
+    "weighted_quantile", "weighted_median", "weighted_mean", "weighted_std",
+    "weighted_var", "effective_sample_size", "resample",
+]

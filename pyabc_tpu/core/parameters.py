@@ -1,0 +1,92 @@
+"""Parameter containers.
+
+Reference parity: ``pyabc/parameters.py::Parameter`` (a dict of floats with
+attribute access). TPU-first shift (SURVEY.md §7.1): the device-side
+representation is a dense ``(n, d)`` array; ``ParameterSpace`` is the
+name<->column registry that keeps the dict-like facade at the API boundary.
+"""
+from __future__ import annotations
+
+from typing import Iterable, Mapping
+
+import numpy as np
+
+
+class Parameter(dict):
+    """A single parameter vector as a dict of floats.
+
+    Mirrors ``pyabc/parameters.py::Parameter``: plain mapping semantics plus
+    attribute access and a ``copy()`` that preserves the type.
+    """
+
+    def __getattr__(self, name: str):
+        try:
+            return self[name]
+        except KeyError as e:  # pragma: no cover - defensive
+            raise AttributeError(name) from e
+
+    def __setattr__(self, name: str, value):
+        self[name] = value
+
+    def copy(self) -> "Parameter":
+        return Parameter(self)
+
+
+class ParameterSpace:
+    """Registry mapping parameter names to columns of a dense theta array.
+
+    The device-side population stores parameters as ``theta: f32[n, dim]``;
+    this class is the single source of truth for the column order, so the
+    user-facing dict API (``Parameter``) and storage layer stay name-based
+    while all device math stays dense.
+    """
+
+    def __init__(self, names: Iterable[str]):
+        self.names: tuple[str, ...] = tuple(names)
+        if len(set(self.names)) != len(self.names):
+            raise ValueError(f"duplicate parameter names: {self.names}")
+        self._index = {n: i for i, n in enumerate(self.names)}
+
+    @property
+    def dim(self) -> int:
+        return len(self.names)
+
+    def index(self, name: str) -> int:
+        return self._index[name]
+
+    def to_array(self, par: Mapping[str, float]) -> np.ndarray:
+        """Dict -> (dim,) float array in registry column order."""
+        return np.asarray([float(par[n]) for n in self.names], dtype=np.float64)
+
+    def to_dict(self, arr) -> Parameter:
+        """(dim,) array -> Parameter dict."""
+        arr = np.asarray(arr)
+        return Parameter({n: float(arr[i]) for i, n in enumerate(self.names)})
+
+    def batch_to_arrays(self, pars: Iterable[Mapping[str, float]]) -> np.ndarray:
+        return np.stack([self.to_array(p) for p in pars], axis=0)
+
+    def pad_to(self, arr: np.ndarray, d_max: int) -> np.ndarray:
+        """Pad the trailing parameter axis with zeros up to ``d_max``.
+
+        Multi-model populations with heterogeneous parameter dimensions store
+        theta padded to the max dim; inactive columns carry zeros and are
+        masked out of all transition / pdf math.
+        """
+        arr = np.asarray(arr)
+        if arr.shape[-1] == d_max:
+            return arr
+        pad = [(0, 0)] * (arr.ndim - 1) + [(0, d_max - arr.shape[-1])]
+        return np.pad(arr, pad)
+
+    def __len__(self) -> int:
+        return self.dim
+
+    def __repr__(self) -> str:
+        return f"ParameterSpace({list(self.names)})"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ParameterSpace) and other.names == self.names
+
+    def __hash__(self) -> int:
+        return hash(self.names)

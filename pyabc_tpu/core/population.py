@@ -1,0 +1,206 @@
+"""Particle and Population containers.
+
+Reference parity: ``pyabc/population.py::{Particle, Population}``. The host
+`Population` keeps the reference's API (per-model weight normalization,
+``get_model_probabilities``, ``get_distribution``, ``get_weighted_distances``,
+``get_for_keys``) but is backed by dense struct-of-arrays storage — the same
+arrays the device generation kernel produced — instead of a list of Python
+objects. `Particle` views are materialized lazily for API compatibility.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Mapping, Sequence
+
+import numpy as np
+import pandas as pd
+
+from .parameters import Parameter, ParameterSpace
+from .sumstat_spec import SumStatSpec
+
+
+@dataclass
+class Particle:
+    """A single weighted particle (mirrors pyabc Particle).
+
+    ``preliminary`` marks look-ahead particles whose weight still awaits
+    correction (reference: redis look-ahead mode, SURVEY.md §2.3).
+    """
+
+    m: int
+    parameter: Parameter
+    weight: float
+    sum_stat: dict
+    distance: float
+    accepted: bool = True
+    preliminary: bool = False
+
+
+class Population:
+    """A weighted generation of particles, stored struct-of-arrays.
+
+    Total weight over all models is normalized to 1; model probability
+    p(m) = sum of weights of model-m particles; within-model distribution
+    weights are w / p(m) (reference semantics).
+    """
+
+    def __init__(
+        self,
+        *,
+        ms: np.ndarray,
+        thetas: np.ndarray,
+        weights: np.ndarray,
+        distances: np.ndarray,
+        sumstats: np.ndarray,
+        spaces: Sequence[ParameterSpace],
+        sumstat_spec: SumStatSpec,
+        model_names: Sequence[str] | None = None,
+        proposal_ids: np.ndarray | None = None,
+    ):
+        n = len(ms)
+        assert thetas.shape[0] == n and weights.shape[0] == n
+        assert distances.shape[0] == n and sumstats.shape[0] == n
+        self.ms = np.asarray(ms, dtype=np.int32)
+        self.thetas = np.asarray(thetas, dtype=np.float64)
+        w = np.asarray(weights, dtype=np.float64)
+        total = w.sum()
+        if not np.isfinite(total) or total <= 0:
+            raise ValueError(f"population total weight invalid: {total}")
+        self.weights = w / total
+        self.distances = np.asarray(distances, dtype=np.float64)
+        self.sumstats = np.asarray(sumstats, dtype=np.float64)
+        self.spaces = list(spaces)
+        self.sumstat_spec = sumstat_spec
+        self.model_names = (
+            list(model_names)
+            if model_names is not None
+            else [f"m{m}" for m in range(len(self.spaces))]
+        )
+        #: provenance slot ids from the sampler (deterministic trim order)
+        self.proposal_ids = (
+            np.asarray(proposal_ids) if proposal_ids is not None else None
+        )
+
+    # ------------------------------------------------------------------ sizes
+    def __len__(self) -> int:
+        return len(self.ms)
+
+    @property
+    def n_models(self) -> int:
+        return len(self.spaces)
+
+    # ------------------------------------------------------- reference API
+    @classmethod
+    def from_particles(
+        cls,
+        particles: Sequence[Particle],
+        spaces: Sequence[ParameterSpace],
+        sumstat_spec: SumStatSpec,
+        model_names: Sequence[str] | None = None,
+    ) -> "Population":
+        d_max = max(s.dim for s in spaces)
+        ms = np.asarray([p.m for p in particles], dtype=np.int32)
+        thetas = np.stack(
+            [
+                spaces[p.m].pad_to(spaces[p.m].to_array(p.parameter), d_max)
+                for p in particles
+            ]
+        )
+        weights = np.asarray([p.weight for p in particles])
+        distances = np.asarray([p.distance for p in particles])
+        sumstats = np.stack(
+            [np.asarray(sumstat_spec.flatten(p.sum_stat)) for p in particles]
+        )
+        return cls(
+            ms=ms, thetas=thetas, weights=weights, distances=distances,
+            sumstats=sumstats, spaces=spaces, sumstat_spec=sumstat_spec,
+            model_names=model_names,
+        )
+
+    def particles(self) -> list[Particle]:
+        """Materialize the list-of-Particle view (reference representation)."""
+        out = []
+        for i in range(len(self)):
+            m = int(self.ms[i])
+            space = self.spaces[m]
+            out.append(
+                Particle(
+                    m=m,
+                    parameter=space.to_dict(self.thetas[i, : space.dim]),
+                    weight=float(self.weights[i]),
+                    sum_stat=self.sumstat_spec.unflatten(self.sumstats[i]),
+                    distance=float(self.distances[i]),
+                    accepted=True,
+                )
+            )
+        return out
+
+    def get_model_probabilities(self) -> pd.DataFrame:
+        """DataFrame with column 'p' indexed by model index (reference API)."""
+        probs = self.model_probabilities_array()
+        alive = np.flatnonzero(probs > 0)
+        return pd.DataFrame({"p": probs[alive]}, index=pd.Index(alive, name="m"))
+
+    def model_probabilities_array(self) -> np.ndarray:
+        probs = np.zeros(self.n_models)
+        np.add.at(probs, self.ms, self.weights)
+        return probs
+
+    def get_alive_models(self) -> list[int]:
+        return [int(m) for m in np.unique(self.ms)]
+
+    def nr_of_models_alive(self) -> int:
+        return len(np.unique(self.ms))
+
+    def get_distribution(self, m: int = 0) -> tuple[pd.DataFrame, np.ndarray]:
+        """(parameters DataFrame, within-model normalized weights) for model m."""
+        mask = self.ms == m
+        if not mask.any():
+            raise KeyError(f"no particles for model {m}")
+        space = self.spaces[m]
+        df = pd.DataFrame(
+            self.thetas[mask][:, : space.dim], columns=list(space.names)
+        )
+        w = self.weights[mask]
+        return df, w / w.sum()
+
+    def get_weighted_distances(self) -> pd.DataFrame:
+        """DataFrame ['distance', 'w'] with overall-normalized weights."""
+        return pd.DataFrame({"distance": self.distances, "w": self.weights})
+
+    def get_weighted_sum_stats(self) -> tuple[np.ndarray, np.ndarray]:
+        """(weights, flat sumstat matrix) — reference returns (w, list-of-dicts)."""
+        return self.weights, self.sumstats
+
+    def get_accepted_sum_stats(self) -> list[dict]:
+        return [self.sumstat_spec.unflatten(s) for s in self.sumstats]
+
+    def get_for_keys(self, keys) -> dict:
+        """Subset view by keys: weight / distance / parameter / sum_stat."""
+        out = {}
+        for k in keys:
+            if k == "weight":
+                out[k] = self.weights
+            elif k == "distance":
+                out[k] = self.distances
+            elif k == "parameter":
+                out[k] = self.thetas
+            elif k == "sum_stat":
+                out[k] = self.sumstats
+            else:
+                raise KeyError(k)
+        return out
+
+    def update_weights(self, new_weights: np.ndarray) -> None:
+        """Replace weights (look-ahead correction path) and renormalize."""
+        w = np.asarray(new_weights, dtype=np.float64)
+        total = w.sum()
+        if not np.isfinite(total) or total <= 0:
+            raise ValueError(f"population total weight invalid: {total}")
+        self.weights = w / total
+
+    def __repr__(self):
+        return (
+            f"Population(n={len(self)}, models={self.get_alive_models()}, "
+            f"d_max={self.thetas.shape[1]})"
+        )

@@ -1,0 +1,23 @@
+"""PRNG key discipline.
+
+The reference reseeds numpy per worker process (``pyabc/sampler/multicore.py``);
+the TPU-native design derives every random draw from a single root key via
+fold_in over (generation, round, lane) so runs are reproducible regardless of
+batch sizes, device counts, or refill round counts.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def root_key(seed: int = 0):
+    return jax.random.key(seed)
+
+
+def generation_key(key, t: int):
+    """Key for generation t (t = -1 is the calibration generation)."""
+    return jax.random.fold_in(key, t + 1)
+
+
+def round_key(gen_key, round_idx: int):
+    return jax.random.fold_in(gen_key, round_idx)

@@ -1,0 +1,65 @@
+"""Summary-statistic flattening registry.
+
+Simulators return a pytree-dict ``{name: array}`` (the analog of the
+reference's sum-stat dicts, ``pyabc/model.py::Model.summary_statistics``).
+Device math wants one dense vector per particle, so ``SumStatSpec`` records
+shapes/offsets once and provides traceable flatten/unflatten. Per-flat-entry
+labels (``"name"`` or ``"name[i]"``) give `AdaptivePNormDistance` its
+per-statistic weight registry, mirroring the reference's dict-keyed weights.
+"""
+from __future__ import annotations
+
+from typing import Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class SumStatSpec:
+    def __init__(self, example: Mapping[str, np.ndarray | jnp.ndarray | float]):
+        self.names: tuple[str, ...] = tuple(sorted(example.keys()))
+        self.shapes: dict[str, tuple[int, ...]] = {}
+        self.sizes: dict[str, int] = {}
+        self.offsets: dict[str, int] = {}
+        off = 0
+        for n in self.names:
+            shp = tuple(np.shape(example[n]))
+            size = int(np.prod(shp)) if shp else 1
+            self.shapes[n] = shp
+            self.sizes[n] = size
+            self.offsets[n] = off
+            off += size
+        self.total_size = off
+
+    def flatten(self, stats: Mapping) -> jnp.ndarray:
+        """dict of arrays -> (total_size,) f32 vector. Traceable."""
+        parts = [jnp.ravel(jnp.asarray(stats[n], jnp.float32)) for n in self.names]
+        return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+    def unflatten(self, vec) -> dict[str, np.ndarray]:
+        vec = np.asarray(vec)
+        out = {}
+        for n in self.names:
+            off, size, shp = self.offsets[n], self.sizes[n], self.shapes[n]
+            out[n] = vec[..., off : off + size].reshape(vec.shape[:-1] + shp)
+        return out
+
+    def labels(self) -> list[str]:
+        """One label per flat entry: 'name' for scalars, 'name[i]' else."""
+        out = []
+        for n in self.names:
+            if self.sizes[n] == 1 and self.shapes[n] == ():
+                out.append(n)
+            else:
+                out.extend(f"{n}[{i}]" for i in range(self.sizes[n]))
+        return out
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, SumStatSpec)
+            and self.names == other.names
+            and self.shapes == other.shapes
+        )
+
+    def __repr__(self):
+        return f"SumStatSpec({dict(self.shapes)})"

@@ -1,0 +1,93 @@
+"""Weighted statistics over particle populations (host, numpy float64).
+
+Reference parity: ``pyabc/weighted_statistics.py`` — weighted_quantile,
+weighted_median, weighted_mean, weighted_std, effective_sample_size, resample.
+Device-side (jnp) versions live in ``pyabc_tpu.ops.stats``; these host versions
+run once per generation on gathered arrays where float64 is free.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def weighted_quantile(points, weights=None, alpha: float = 0.5) -> float:
+    """The alpha-quantile of weighted ``points``.
+
+    Matches the reference semantics (``pyabc/weighted_statistics.py::
+    weighted_quantile``): sort points, take the first point whose cumulative
+    normalized weight reaches ``alpha`` (a step-function / lower quantile,
+    no interpolation).
+    """
+    points = np.asarray(points, dtype=np.float64)
+    if weights is None:
+        weights = np.ones_like(points)
+    weights = np.asarray(weights, dtype=np.float64)
+    if points.shape != weights.shape:
+        raise ValueError("points and weights must have identical shape")
+    order = np.argsort(points, kind="stable")
+    points = points[order]
+    cum = np.cumsum(weights[order])
+    total = cum[-1]
+    if not np.isfinite(total) or total <= 0:
+        raise ValueError("weights must sum to a positive finite value")
+    idx = int(np.searchsorted(cum / total, alpha))
+    idx = min(idx, len(points) - 1)
+    return float(points[idx])
+
+
+def weighted_median(points, weights=None) -> float:
+    return weighted_quantile(points, weights, alpha=0.5)
+
+
+def weighted_mean(points, weights=None) -> float:
+    points = np.asarray(points, dtype=np.float64)
+    if weights is None:
+        return float(points.mean())
+    weights = np.asarray(weights, dtype=np.float64)
+    return float(np.sum(points * weights) / np.sum(weights))
+
+
+def weighted_var(points, weights=None) -> float:
+    points = np.asarray(points, dtype=np.float64)
+    mu = weighted_mean(points, weights)
+    if weights is None:
+        return float(np.mean((points - mu) ** 2))
+    weights = np.asarray(weights, dtype=np.float64)
+    return float(np.sum(weights * (points - mu) ** 2) / np.sum(weights))
+
+
+def weighted_std(points, weights=None) -> float:
+    return float(np.sqrt(weighted_var(points, weights)))
+
+
+def effective_sample_size(weights) -> float:
+    """ESS = (sum w)^2 / sum w^2 (reference: effective_sample_size)."""
+    w = np.asarray(weights, dtype=np.float64)
+    s = w.sum()
+    return float(s * s / np.sum(w * w))
+
+
+def resample(points, weights, n: int, rng=None) -> np.ndarray:
+    """Draw n points iid from the weighted empirical distribution."""
+    rng = np.random.default_rng(rng)
+    points = np.asarray(points)
+    w = np.asarray(weights, dtype=np.float64)
+    w = w / w.sum()
+    idx = rng.choice(len(points), size=n, p=w)
+    return points[idx]
+
+
+def resample_deterministic(points, weights, n: int) -> np.ndarray:
+    """Systematic (low-variance) resampling — deterministic given weights.
+
+    Used where the reference resamples for bootstrap-CV estimation; the
+    systematic variant reduces estimator noise for the adaptive population
+    size machinery.
+    """
+    points = np.asarray(points)
+    w = np.asarray(weights, dtype=np.float64)
+    w = w / w.sum()
+    positions = (np.arange(n) + 0.5) / n
+    idx = np.searchsorted(np.cumsum(w), positions)
+    idx = np.clip(idx, 0, len(points) - 1)
+    return points[idx]

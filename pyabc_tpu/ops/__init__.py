@@ -1,0 +1,3 @@
+from . import stats
+
+__all__ = ["stats"]

@@ -1,0 +1,64 @@
+"""Device-side (jnp, traceable) weighted statistics.
+
+These are the in-kernel counterparts of ``pyabc_tpu.core.weighted_statistics``
+for use inside jitted generation steps and shard_map'd collectives — e.g.
+normalizing importance weights with a psum, or computing a weighted quantile
+of distances without leaving the device.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def weighted_quantile(points, weights, alpha):
+    """Step-function weighted quantile (matches host semantics).
+
+    Fully traceable: sort + cumsum + searchsorted. ``alpha`` may be a scalar
+    or vector of quantile levels.
+    """
+    order = jnp.argsort(points)
+    p = points[order]
+    cum = jnp.cumsum(weights[order])
+    cdf = cum / cum[-1]
+    idx = jnp.clip(jnp.searchsorted(cdf, alpha), 0, p.shape[0] - 1)
+    return p[idx]
+
+
+def weighted_mean(points, weights, axis=None):
+    return jnp.sum(points * weights, axis=axis) / jnp.sum(weights, axis=axis)
+
+
+def weighted_var(points, weights, axis=None):
+    mu = weighted_mean(points, weights, axis=axis)
+    if axis is not None:
+        mu = jnp.expand_dims(mu, axis)
+    return weighted_mean((points - mu) ** 2, weights, axis=axis)
+
+
+def weighted_std(points, weights, axis=None):
+    return jnp.sqrt(weighted_var(points, weights, axis=axis))
+
+
+def effective_sample_size(weights):
+    s = jnp.sum(weights)
+    return s * s / jnp.sum(weights * weights)
+
+
+def normalize_log_weights(log_w, mask=None):
+    """exp-normalize masked log-weights to sum to 1 (stable).
+
+    An entirely-masked (or all -inf) input returns all zeros instead of NaN,
+    so an empty-acceptance round surfaces as zero mass, not NaN poisoning.
+    """
+    if mask is not None:
+        log_w = jnp.where(mask, log_w, -jnp.inf)
+    m = jnp.max(log_w)
+    safe_m = jnp.where(jnp.isfinite(m), m, 0.0)
+    w = jnp.exp(log_w - safe_m)
+    total = jnp.sum(w)
+    return jnp.where(total > 0, w / jnp.where(total > 0, total, 1.0), 0.0)
+
+
+def logsumexp_weighted(log_terms, axis=-1):
+    return jax.scipy.special.logsumexp(log_terms, axis=axis)

@@ -1,0 +1,22 @@
+"""Test configuration: force a virtual 8-device CPU platform BEFORE jax import.
+
+Mirrors the reference's test strategy (SURVEY.md §4): multi-node is tested as
+multi-process-on-localhost there; here multi-chip is tested as a virtual 8-device
+CPU mesh via --xla_force_host_platform_device_count.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,214 @@
+"""Unit tests for core primitives (reference analog: test_weighted_statistics,
+parts of test_random_variables / test_population)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pyabc_tpu.core import (
+    RV,
+    Distribution,
+    LowerBoundDecorator,
+    Parameter,
+    ParameterSpace,
+    Particle,
+    Population,
+    SumStatSpec,
+    effective_sample_size,
+    weighted_mean,
+    weighted_median,
+    weighted_quantile,
+    weighted_std,
+)
+from pyabc_tpu.ops import stats as ops_stats
+
+
+class TestWeightedStatistics:
+    def test_quantile_uniform_weights(self):
+        pts = np.arange(10.0)
+        assert weighted_quantile(pts, alpha=0.5) == pytest.approx(4.0)
+
+    def test_quantile_respects_weights(self):
+        pts = np.array([0.0, 1.0])
+        w = np.array([0.1, 0.9])
+        assert weighted_quantile(pts, w, alpha=0.5) == 1.0
+        w = np.array([0.9, 0.1])
+        assert weighted_quantile(pts, w, alpha=0.5) == 0.0
+
+    def test_median_mean_std(self):
+        pts = np.array([1.0, 2.0, 3.0, 4.0])
+        w = np.array([1.0, 1.0, 1.0, 1.0])
+        assert weighted_median(pts, w) == pytest.approx(2.0)
+        assert weighted_mean(pts, w) == pytest.approx(2.5)
+        assert weighted_std(pts, w) == pytest.approx(np.std(pts))
+
+    def test_ess(self):
+        assert effective_sample_size(np.ones(100)) == pytest.approx(100.0)
+        w = np.zeros(100)
+        w[0] = 1.0
+        assert effective_sample_size(w) == pytest.approx(1.0)
+
+    def test_device_quantile_matches_host(self):
+        rng = np.random.default_rng(0)
+        pts = rng.normal(size=257)
+        w = rng.uniform(0.1, 1.0, size=257)
+        for alpha in [0.1, 0.5, 0.9]:
+            host = weighted_quantile(pts, w, alpha)
+            dev = float(
+                ops_stats.weighted_quantile(
+                    jnp.asarray(pts), jnp.asarray(w), alpha
+                )
+            )
+            assert host == pytest.approx(dev, rel=1e-5)
+
+
+class TestRV:
+    @pytest.mark.parametrize(
+        "rv,scipy_name,scipy_args",
+        [
+            (RV("uniform", 1.0, 3.0), "uniform", (1.0, 3.0)),
+            (RV("norm", 2.0, 0.5), "norm", (2.0, 0.5)),
+            (RV("expon", 0.0, 2.0), "expon", (0.0, 2.0)),
+            (RV("gamma", 3.0, 0.0, 2.0), "gamma", (3.0, 0.0, 2.0)),
+            (RV("beta", 2.0, 5.0), "beta", (2.0, 5.0)),
+            (RV("laplace", 0.0, 1.5), "laplace", (0.0, 1.5)),
+            (RV("lognorm", 0.5, 0.0, 2.0), "lognorm", (0.5, 0.0, 2.0)),
+        ],
+    )
+    def test_logpdf_matches_scipy(self, rv, scipy_name, scipy_args):
+        import scipy.stats as st
+
+        frozen = getattr(st, scipy_name)(*scipy_args)
+        xs = np.asarray(frozen.rvs(size=50, random_state=1), dtype=np.float64)
+        ours = np.asarray(jax.vmap(rv.logpdf)(jnp.asarray(xs, jnp.float32)))
+        theirs = frozen.logpdf(xs)
+        np.testing.assert_allclose(ours, theirs, rtol=1e-3, atol=1e-3)
+
+    def test_sampling_moments(self):
+        key = jax.random.key(0)
+        x = np.asarray(RV("norm", 2.0, 0.5).rvs(key, (20000,)))
+        assert x.mean() == pytest.approx(2.0, abs=0.02)
+        assert x.std() == pytest.approx(0.5, abs=0.02)
+
+    def test_uniform_support(self):
+        rv = RV("uniform", 1.0, 3.0)
+        assert float(rv.logpdf(0.5)) == -np.inf
+        assert float(rv.logpdf(2.0)) == pytest.approx(-np.log(3.0), rel=1e-3)
+        assert float(rv.logpdf(4.5)) == -np.inf
+
+    def test_discrete_randint(self):
+        rv = RV("randint", 0, 4)
+        assert rv.discrete
+        x = np.asarray(rv.rvs(jax.random.key(1), (1000,)))
+        assert set(np.unique(x)) <= {0, 1, 2, 3}
+        assert float(rv.logpdf(2)) == pytest.approx(-np.log(4.0), rel=1e-3)
+        assert float(rv.logpdf(7)) == -np.inf
+
+    def test_poisson_binom_pmfs(self):
+        import scipy.stats as st
+
+        pois = RV("poisson", 3.5)
+        xs = np.arange(10)
+        np.testing.assert_allclose(
+            np.asarray(jax.vmap(pois.logpdf)(jnp.asarray(xs))),
+            st.poisson(3.5).logpmf(xs), rtol=1e-4, atol=1e-4,
+        )
+        binom = RV("binom", 10, 0.3)
+        np.testing.assert_allclose(
+            np.asarray(jax.vmap(binom.logpdf)(jnp.asarray(xs))),
+            st.binom(10, 0.3).logpmf(xs), rtol=1e-3, atol=1e-3,
+        )
+
+    def test_lower_bound_decorator(self):
+        rv = LowerBoundDecorator(RV("norm", 0.0, 1.0), 0.0)
+        x = np.asarray(rv.rvs(jax.random.key(0), (1000,)))
+        assert (x > 0).all()
+        assert float(rv.logpdf(-1.0)) == -np.inf
+        assert np.isfinite(float(rv.logpdf(1.0)))
+
+
+class TestDistribution:
+    def test_rvs_and_pdf(self):
+        dist = Distribution(a=RV("uniform", 0.0, 1.0), b=RV("norm", 0.0, 2.0))
+        par = dist.rvs(jax.random.key(0))
+        assert isinstance(par, Parameter)
+        assert set(par) == {"a", "b"}
+        import scipy.stats as st
+
+        expected = st.uniform(0, 1).pdf(par["a"]) * st.norm(0, 2).pdf(par["b"])
+        assert dist.pdf(par) == pytest.approx(expected, rel=1e-4)
+
+    def test_dense_roundtrip(self):
+        dist = Distribution(x=RV("norm", 1.0, 1.0), y=RV("uniform", -1.0, 2.0))
+        theta = dist.rvs_array(jax.random.key(3))
+        assert theta.shape == (2,)
+        lp = dist.logpdf_array(theta)
+        assert np.isfinite(float(lp))
+        # padded theta reads only the first dim columns
+        padded = jnp.concatenate([theta, jnp.zeros(3)])
+        assert float(dist.logpdf_array(padded)) == pytest.approx(float(lp))
+
+    def test_batched_logpdf(self):
+        dist = Distribution(x=RV("norm", 0.0, 1.0))
+        thetas = jnp.linspace(-2, 2, 11)[:, None]
+        lps = dist.logpdf_array(thetas)
+        assert lps.shape == (11,)
+
+
+class TestPopulation:
+    def _make(self):
+        spaces = [ParameterSpace(["a", "b"]), ParameterSpace(["c"])]
+        spec = SumStatSpec({"s": np.zeros(3)})
+        particles = [
+            Particle(0, Parameter(a=1.0, b=2.0), 0.3, {"s": np.ones(3)}, 0.5),
+            Particle(0, Parameter(a=2.0, b=3.0), 0.3, {"s": np.ones(3)}, 0.2),
+            Particle(1, Parameter(c=5.0), 0.4, {"s": np.zeros(3)}, 0.1),
+        ]
+        return Population.from_particles(particles, spaces, spec)
+
+    def test_normalization_and_model_probs(self):
+        pop = self._make()
+        assert pop.weights.sum() == pytest.approx(1.0)
+        probs = pop.get_model_probabilities()
+        assert probs.loc[0, "p"] == pytest.approx(0.6)
+        assert probs.loc[1, "p"] == pytest.approx(0.4)
+        assert pop.get_alive_models() == [0, 1]
+
+    def test_get_distribution(self):
+        pop = self._make()
+        df, w = pop.get_distribution(0)
+        assert list(df.columns) == ["a", "b"]
+        assert len(df) == 2
+        assert w.sum() == pytest.approx(1.0)
+        df1, w1 = pop.get_distribution(1)
+        assert list(df1.columns) == ["c"]
+        assert w1.sum() == pytest.approx(1.0)
+
+    def test_weighted_distances(self):
+        pop = self._make()
+        wd = pop.get_weighted_distances()
+        assert set(wd.columns) == {"distance", "w"}
+        assert wd["w"].sum() == pytest.approx(1.0)
+
+    def test_particle_roundtrip(self):
+        pop = self._make()
+        parts = pop.particles()
+        assert parts[0].parameter == Parameter(a=1.0, b=2.0)
+        assert parts[2].parameter == Parameter(c=5.0)
+        assert parts[2].m == 1
+
+
+class TestSumStatSpec:
+    def test_flatten_roundtrip(self):
+        spec = SumStatSpec({"a": np.zeros((2, 2)), "b": 0.0, "c": np.zeros(3)})
+        assert spec.total_size == 8
+        stats = {"a": np.arange(4.0).reshape(2, 2), "b": 7.0, "c": np.ones(3)}
+        flat = np.asarray(spec.flatten(stats))
+        back = spec.unflatten(flat)
+        np.testing.assert_allclose(back["a"], stats["a"])
+        assert back["b"] == pytest.approx(7.0)
+        np.testing.assert_allclose(back["c"], stats["c"])
+
+    def test_labels(self):
+        spec = SumStatSpec({"x": 0.0, "y": np.zeros(2)})
+        assert spec.labels() == ["x", "y[0]", "y[1]"]
