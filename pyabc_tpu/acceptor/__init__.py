@@ -1,0 +1,14 @@
+from .acceptor import (
+    Acceptor,
+    AcceptorResult,
+    SimpleFunctionAcceptor,
+    StochasticAcceptor,
+    UniformAcceptor,
+)
+from .pdf_norm import ScaledPDFNorm, pdf_norm_from_kernel, pdf_norm_max_found
+
+__all__ = [
+    "Acceptor", "AcceptorResult", "UniformAcceptor", "SimpleFunctionAcceptor",
+    "StochasticAcceptor", "pdf_norm_from_kernel", "pdf_norm_max_found",
+    "ScaledPDFNorm",
+]

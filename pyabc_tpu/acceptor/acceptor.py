@@ -1,0 +1,289 @@
+"""Acceptors — decide particle acceptance given distance and epsilon.
+
+Reference parity: ``pyabc/acceptor/acceptor.py::{AcceptorResult, Acceptor,
+UniformAcceptor, SimpleFunctionAcceptor, StochasticAcceptor}``.
+
+`StochasticAcceptor` implements noisy ABC: with a stochastic kernel distance
+returning log density v = log p(x_0 | x), accept with probability
+exp((v - pdf_norm)/T); over-unity densities (v > pdf_norm) are accepted with
+an importance weight exp((v - pdf_norm)/T) > 1 (exact correction, reference
+semantics). The device form keeps everything in log space inside the kernel.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..distance.kernel import SCALE_LIN, SCALE_LOG, StochasticKernel
+from .pdf_norm import pdf_norm_max_found
+
+
+class AcceptorResult:
+    """(distance, accept, weight) triple (pyabc AcceptorResult)."""
+
+    def __init__(self, distance: float, accept: bool, weight: float = 1.0):
+        self.distance = distance
+        self.accept = accept
+        self.weight = weight
+
+    def __iter__(self):
+        yield self.distance
+        yield self.accept
+        yield self.weight
+
+    def __repr__(self):
+        return (f"AcceptorResult(distance={self.distance}, "
+                f"accept={self.accept}, weight={self.weight})")
+
+
+class Acceptor:
+    """Abstract acceptor (pyabc Acceptor)."""
+
+    def initialize(self, t: int, get_weighted_distances: Callable | None = None,
+                   distance_function=None, x_0=None) -> None:
+        pass
+
+    def update(self, t: int, get_weighted_distances: Callable | None = None,
+               prev_temp: float | None = None,
+               acceptance_rate: float | None = None) -> None:
+        pass
+
+    def __call__(self, distance_function, eps, x, x_0, t, par) -> AcceptorResult:
+        raise NotImplementedError
+
+    def requires_calibration(self) -> bool:
+        return False
+
+    def is_adaptive(self) -> bool:
+        return False
+
+    def get_epsilon_config(self, t: int) -> dict:
+        """Info for the epsilon schedule (used by Temperature)."""
+        return {}
+
+    def get_config(self) -> dict:
+        return {"name": type(self).__name__}
+
+    def __repr__(self):
+        return f"{type(self).__name__}()"
+
+    # ------------------------------------------------------------- device
+    def is_device_compatible(self) -> bool:
+        return False
+
+    def device_params(self, t: int | None = None):
+        return ()
+
+    def device_fn(self, distance_device_fn):
+        """Traceable ``fn(key, x, x0, eps, dist_params, acc_params) ->
+        (distance, accept_bool, log_acc_weight)``."""
+        raise NotImplementedError
+
+
+class UniformAcceptor(Acceptor):
+    """Accept iff distance <= epsilon (pyabc UniformAcceptor).
+
+    ``use_complete_history``: accept only if the distance also satisfies all
+    previous epsilon thresholds (relevant when the distance function changed
+    between generations).
+    """
+
+    def __init__(self, use_complete_history: bool = False):
+        self.use_complete_history = bool(use_complete_history)
+        self._eps_history: dict[int, float] = {}
+        self._distance_changed_ts: set[int] = set()
+
+    def note_epsilon(self, t: int, eps_value: float,
+                     distance_changed: bool) -> None:
+        """Orchestrator hook: record the threshold used at generation t."""
+        self._eps_history[t] = float(eps_value)
+        if distance_changed:
+            self._distance_changed_ts.add(t)
+
+    def __call__(self, distance_function, eps, x, x_0, t, par) -> AcceptorResult:
+        d = distance_function(x, x_0, t, par)
+        accept = d <= eps(t)
+        if accept and self.use_complete_history:
+            # only thresholds since the last distance change are comparable
+            for s, e in self._eps_history.items():
+                if s < t and s not in self._distance_changed_ts and d > e:
+                    accept = False
+                    break
+        return AcceptorResult(distance=d, accept=bool(accept))
+
+    def is_device_compatible(self) -> bool:
+        return True
+
+    def device_params(self, t=None):
+        if not self.use_complete_history:
+            return ()
+        # min over applicable historical thresholds, as a single scalar
+        vals = [e for s, e in self._eps_history.items()
+                if t is None or s < t]
+        return jnp.asarray(min(vals) if vals else np.inf, jnp.float32)
+
+    def device_fn(self, distance_device_fn):
+        use_hist = self.use_complete_history
+
+        def fn(key, x, x0, eps, dist_params, acc_params):
+            d = distance_device_fn(x, x0, dist_params)
+            accept = d <= eps
+            if use_hist:
+                accept = accept & (d <= acc_params)
+            return d, accept, jnp.zeros(())  # log weight 0 => weight 1
+
+        return fn
+
+
+class SimpleFunctionAcceptor(Acceptor):
+    """Adapter for a plain callable (pyabc SimpleFunctionAcceptor)."""
+
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def __call__(self, distance_function, eps, x, x_0, t, par) -> AcceptorResult:
+        out = self.fn(distance_function, eps, x, x_0, t, par)
+        if isinstance(out, AcceptorResult):
+            return out
+        if isinstance(out, tuple):
+            return AcceptorResult(*out)
+        raise TypeError(f"acceptor function returned {out!r}")
+
+    @staticmethod
+    def assert_acceptor(maybe_acceptor) -> "Acceptor":
+        if isinstance(maybe_acceptor, Acceptor):
+            return maybe_acceptor
+        if callable(maybe_acceptor):
+            return SimpleFunctionAcceptor(maybe_acceptor)
+        raise TypeError(f"cannot coerce {maybe_acceptor!r} into an Acceptor")
+
+
+class StochasticAcceptor(Acceptor):
+    """Exact-likelihood stochastic acceptor (pyabc StochasticAcceptor).
+
+    Requires the distance to be a `StochasticKernel` and the epsilon schedule
+    to be a `Temperature`. At temperature T, a particle with kernel value v
+    (log scale) is accepted with probability min(1, exp((v - pdf_norm)/T));
+    if exp((v - pdf_norm)/T) > 1 the particle is accepted with that value as
+    importance weight.
+    """
+
+    def __init__(self, pdf_norm_method: Callable = pdf_norm_max_found,
+                 apply_importance_weighting: bool = True,
+                 log_file: str | None = None):
+        self.pdf_norm_method = pdf_norm_method
+        self.apply_importance_weighting = bool(apply_importance_weighting)
+        self.log_file = log_file
+        #: per-generation normalization constants (log scale)
+        self.pdf_norms: dict[int, float] = {}
+        self._kernel: StochasticKernel | None = None
+        self._max_found: float = -np.inf
+
+    def requires_calibration(self) -> bool:
+        return True
+
+    def is_adaptive(self) -> bool:
+        return True
+
+    def initialize(self, t, get_weighted_distances=None, distance_function=None,
+                   x_0=None):
+        if not isinstance(distance_function, StochasticKernel):
+            raise TypeError(
+                "StochasticAcceptor requires a StochasticKernel distance"
+            )
+        self._kernel = distance_function
+        self._update_norm(t, get_weighted_distances)
+
+    def update(self, t, get_weighted_distances=None, prev_temp=None,
+               acceptance_rate=None):
+        self._update_norm(t, get_weighted_distances)
+
+    def _update_norm(self, t, get_weighted_distances):
+        kernel_value = None
+        if get_weighted_distances is not None:
+            df = get_weighted_distances()
+            vals = np.asarray(df["distance"], np.float64)
+            if self._kernel.ret_scale == SCALE_LIN:
+                vals = np.log(np.maximum(vals, 1e-300))
+            if len(vals):
+                self._max_found = max(self._max_found, float(np.max(vals)))
+                kernel_value = vals
+        pdf_max = self._kernel.pdf_max if self._kernel else None
+        if pdf_max is not None and self._kernel.ret_scale == SCALE_LIN:
+            pdf_max = np.log(max(pdf_max, 1e-300))
+        norm = self.pdf_norm_method(
+            kernel_val=kernel_value,
+            pdf_max=pdf_max,
+            max_found=self._max_found,
+            prev_pdf_norm=(
+                max(self.pdf_norms.values()) if self.pdf_norms else None
+            ),
+        )
+        self.pdf_norms[t] = float(norm)
+        if self.log_file:
+            import json
+
+            try:
+                with open(self.log_file) as fh:
+                    log = json.load(fh)
+            except (OSError, ValueError):
+                log = {}
+            log[str(t)] = self.pdf_norms[t]
+            with open(self.log_file, "w") as fh:
+                json.dump(log, fh, indent=1)
+
+    def get_epsilon_config(self, t: int) -> dict:
+        return {
+            "pdf_norm": self.pdf_norms.get(t),
+            "kernel_scale": self._kernel.ret_scale if self._kernel else SCALE_LOG,
+        }
+
+    def __call__(self, distance_function, eps, x, x_0, t, par) -> AcceptorResult:
+        v = distance_function(x, x_0, t, par)
+        logv = (
+            float(np.log(max(v, 1e-300)))
+            if distance_function.ret_scale == SCALE_LIN
+            else float(v)
+        )
+        pdf_norm = self.pdf_norms[t]
+        temp = eps(t)
+        log_ratio = (logv - pdf_norm) / temp
+        if log_ratio >= 0:
+            accept = True
+            weight = float(np.exp(log_ratio)) if self.apply_importance_weighting else 1.0
+        else:
+            accept = bool(np.random.uniform() < np.exp(log_ratio))
+            weight = 1.0
+        return AcceptorResult(distance=v, accept=accept, weight=weight)
+
+    # ------------------------------------------------------------- device
+    def is_device_compatible(self) -> bool:
+        return self._kernel is not None and self._kernel.is_device_compatible()
+
+    def device_params(self, t=None):
+        return jnp.asarray(self.pdf_norms[t], jnp.float32)
+
+    def device_fn(self, distance_device_fn):
+        lin = self._kernel is not None and self._kernel.ret_scale == SCALE_LIN
+        apply_iw = self.apply_importance_weighting
+
+        def fn(key, x, x0, temp, dist_params, pdf_norm):
+            import jax
+
+            v = distance_device_fn(x, x0, dist_params)
+            logv = jnp.log(jnp.maximum(v, 1e-30)) if lin else v
+            log_ratio = (logv - pdf_norm) / temp
+            u = jax.random.uniform(key)
+            accept = jnp.log(u) < log_ratio
+            log_w = jnp.where(
+                (log_ratio > 0) & apply_iw, log_ratio, 0.0
+            )
+            return v, accept, log_w
+
+        return fn
+
+    def get_config(self):
+        return {"name": type(self).__name__,
+                "pdf_norm_method": getattr(self.pdf_norm_method, "__name__", "?")}

@@ -28,6 +28,8 @@ class RVBase(ABC):
 
     #: True if the variable takes integer values only
     discrete: bool = False
+    #: True if rvs/logpdf are jnp-traceable (device path eligible)
+    traceable: bool = True
 
     @abstractmethod
     def rvs(self, key, shape=()):
@@ -316,6 +318,7 @@ class RVDecorator(RVBase):
     def __init__(self, component: RVBase):
         self.component = component
         self.discrete = component.discrete
+        self.traceable = component.traceable
 
     def rvs(self, key, shape=()):
         return self.component.rvs(key, shape)
@@ -363,6 +366,8 @@ class ScipyRV(RVBase):
     traceable: using it in a prior forces the (slow) host proposal path.
     """
 
+    traceable = False
+
     def __init__(self, frozen):
         self.frozen = frozen
         self.discrete = not hasattr(frozen, "pdf")
@@ -402,6 +407,10 @@ class Distribution:
     @property
     def dim(self) -> int:
         return self.space.dim
+
+    @property
+    def traceable(self) -> bool:
+        return all(rv.traceable for rv in self.rv_map.values())
 
     def get_parameter_names(self) -> list[str]:
         return list(self.space.names)

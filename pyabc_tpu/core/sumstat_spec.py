@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from typing import Mapping
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -42,6 +43,15 @@ class SumStatSpec:
         for n in self.names:
             off, size, shp = self.offsets[n], self.sizes[n], self.shapes[n]
             out[n] = vec[..., off : off + size].reshape(vec.shape[:-1] + shp)
+        return out
+
+    def unflatten_traceable(self, vec) -> dict:
+        """Traceable dict view of a flat vector (jnp, keeps gradients/trace)."""
+        out = {}
+        for n in self.names:
+            off, size, shp = self.offsets[n], self.sizes[n], self.shapes[n]
+            sl = jax.lax.dynamic_slice_in_dim(vec, off, size, axis=-1)
+            out[n] = sl.reshape(vec.shape[:-1] + shp)
         return out
 
     def labels(self) -> list[str]:

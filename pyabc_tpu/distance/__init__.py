@@ -1,0 +1,45 @@
+from .aggregate import (
+    AdaptiveAggregatedDistance,
+    AggregatedDistance,
+    DistanceWithMeasureList,
+    MinMaxDistance,
+    PCADistance,
+    PercentileDistance,
+    RangeEstimatorDistance,
+    ZScoreDistance,
+)
+from .base import (
+    AcceptAllDistance,
+    Distance,
+    IdentityFakeDistance,
+    NoDistance,
+    SimpleFunctionDistance,
+    to_distance,
+)
+from .kernel import (
+    SCALE_LIN,
+    SCALE_LOG,
+    BinomialKernel,
+    FunctionKernel,
+    IndependentLaplaceKernel,
+    IndependentNormalKernel,
+    NegativeBinomialKernel,
+    NormalKernel,
+    PoissonKernel,
+    StochasticKernel,
+)
+from .pnorm import AdaptivePNormDistance, PNormDistance
+from . import scale
+
+__all__ = [
+    "Distance", "NoDistance", "IdentityFakeDistance", "AcceptAllDistance",
+    "SimpleFunctionDistance", "to_distance",
+    "PNormDistance", "AdaptivePNormDistance",
+    "AggregatedDistance", "AdaptiveAggregatedDistance",
+    "DistanceWithMeasureList", "ZScoreDistance", "PCADistance",
+    "MinMaxDistance", "PercentileDistance", "RangeEstimatorDistance",
+    "StochasticKernel", "NormalKernel", "IndependentNormalKernel",
+    "IndependentLaplaceKernel", "BinomialKernel", "PoissonKernel",
+    "NegativeBinomialKernel", "FunctionKernel", "SCALE_LIN", "SCALE_LOG",
+    "scale",
+]

@@ -1,0 +1,266 @@
+"""Aggregated / compound distances.
+
+Reference parity: ``pyabc/distance/distance.py::{AggregatedDistance,
+AdaptiveAggregatedDistance, DistanceWithMeasureList, ZScoreDistance,
+PCADistance, MinMaxDistance, PercentileDistance, RangeEstimatorDistance}``.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.sumstat_spec import SumStatSpec
+from .base import Distance, to_distance
+from .pnorm import _as_flat
+
+
+class AggregatedDistance(Distance):
+    """Weighted sum of sub-distances (pyabc AggregatedDistance).
+
+    d(x, x0) = sum_k factor_k * w_k * d_k(x, x0); weights may vary per
+    generation (dict {t: vector}).
+    """
+
+    def __init__(self, distances: Sequence, weights=None, factors=None):
+        self.distances = [to_distance(d) for d in distances]
+        if weights is None:
+            self.weights = {-1: np.ones(len(self.distances))}
+        elif isinstance(weights, dict):
+            self.weights = {
+                int(t): np.asarray(w, np.float64) for t, w in weights.items()
+            }
+        else:
+            self.weights = {-1: np.asarray(weights, np.float64)}
+        self.factors = (
+            np.ones(len(self.distances))
+            if factors is None
+            else np.asarray(factors, np.float64)
+        )
+
+    def initialize(self, t, get_all_sum_stats=None, x_0=None):
+        for d in self.distances:
+            d.initialize(t, get_all_sum_stats, x_0)
+
+    def configure_sampler(self, sampler):
+        for d in self.distances:
+            d.configure_sampler(sampler)
+
+    def update(self, t, get_all_sum_stats=None) -> bool:
+        return any([d.update(t, get_all_sum_stats) for d in self.distances])
+
+    def _weights_for(self, t):
+        if t is not None:
+            past = [s for s in self.weights if 0 <= s <= t]
+            if past:
+                return self.weights[max(past)]
+        return self.weights.get(-1, np.ones(len(self.distances)))
+
+    def __call__(self, x, x_0, t=None, par=None) -> float:
+        vals = np.asarray([d(x, x_0, t, par) for d in self.distances])
+        return float(np.sum(self._weights_for(t) * self.factors * vals))
+
+    def is_device_compatible(self) -> bool:
+        return all(d.is_device_compatible() for d in self.distances)
+
+    def device_params(self, t=None):
+        return (
+            jnp.asarray(self._weights_for(t) * self.factors, jnp.float32),
+            tuple(d.device_params(t) for d in self.distances),
+        )
+
+    def device_fn(self, spec: SumStatSpec):
+        fns = [d.device_fn(spec) for d in self.distances]
+
+        def fn(x, x0, params):
+            w, subparams = params
+            vals = jnp.stack(
+                [f(x, x0, p) for f, p in zip(fns, subparams)]
+            )
+            return jnp.sum(w * vals)
+
+        return fn
+
+
+class AdaptiveAggregatedDistance(AggregatedDistance):
+    """Aggregated distance that rescales sub-distances each generation so all
+    contribute comparably (pyabc AdaptiveAggregatedDistance). The scale of a
+    sub-distance is estimated over all recorded simulations by evaluating it
+    against the observation."""
+
+    def __init__(self, distances: Sequence,
+                 scale_function: Callable | None = None,
+                 adaptive: bool = True, log_file: str | None = None):
+        super().__init__(distances)
+        self.scale_function = scale_function or _span_of_values
+        self.adaptive = adaptive
+        self.log_file = log_file
+        self._x_0 = None
+
+    def requires_calibration(self) -> bool:
+        return True
+
+    def configure_sampler(self, sampler):
+        super().configure_sampler(sampler)
+        if self.adaptive:
+            sampler.sample_factory.record_rejected = True
+
+    def initialize(self, t, get_all_sum_stats=None, x_0=None):
+        super().initialize(t, get_all_sum_stats, x_0)
+        self._x_0 = x_0
+        if get_all_sum_stats is not None:
+            self._fit(t, get_all_sum_stats)
+
+    def update(self, t, get_all_sum_stats=None) -> bool:
+        changed = super().update(t, get_all_sum_stats)
+        if not self.adaptive or get_all_sum_stats is None:
+            return changed
+        self._fit(t, get_all_sum_stats)
+        return True
+
+    def _fit(self, t, get_all_sum_stats):
+        samples = get_all_sum_stats()
+        # per-sub-distance value of each recorded simulation vs observation
+        vals = np.asarray(
+            [
+                [d(self._unflatten(s), self._x_0, t) for d in self.distances]
+                for s in np.asarray(samples)
+            ]
+        )  # (n, K)
+        scales = np.asarray(
+            [self.scale_function(vals[:, k]) for k in range(vals.shape[1])]
+        )
+        w = np.zeros_like(scales)
+        pos = scales > 0
+        w[pos] = 1.0 / scales[pos]
+        self.weights[int(t)] = w
+
+    def _unflatten(self, flat):
+        for d in self.distances:
+            spec = getattr(d, "spec", None)
+            if spec is not None:
+                return spec.unflatten(np.asarray(flat))
+        return np.asarray(flat)
+
+
+def _span_of_values(values: np.ndarray) -> float:
+    return float(np.max(values) - np.min(values))
+
+
+class DistanceWithMeasureList(Distance):
+    """Base for distances restricted to a list of sum-stat labels, calibrated
+    from an initial prior sample (pyabc DistanceWithMeasureList)."""
+
+    def __init__(self, measures_to_use: Sequence[str] | str = "all",
+                 sumstat_spec: SumStatSpec | None = None):
+        self.measures_to_use = measures_to_use
+        self.spec = sumstat_spec
+        self._cols: np.ndarray | None = None
+
+    def requires_calibration(self) -> bool:
+        return True
+
+    def initialize(self, t, get_all_sum_stats=None, x_0=None):
+        if self.spec is None and hasattr(x_0, "keys"):
+            self.spec = SumStatSpec(x_0)
+        labels = self.spec.labels() if self.spec else None
+        if self.measures_to_use == "all" or labels is None:
+            size = self.spec.total_size if self.spec else None
+            self._cols = None if size is None else np.arange(size)
+        else:
+            cols = []
+            for m in self.measures_to_use:
+                if self.spec and m in self.spec.names:
+                    off = self.spec.offsets[m]
+                    cols.extend(range(off, off + self.spec.sizes[m]))
+                elif labels and m in labels:
+                    cols.append(labels.index(m))
+                else:
+                    raise KeyError(f"unknown measure {m!r}")
+            self._cols = np.asarray(cols)
+        if get_all_sum_stats is not None:
+            samples = np.asarray(get_all_sum_stats(), np.float64)
+            if self._cols is None:
+                self._cols = np.arange(samples.shape[1])
+            self._fit(samples[:, self._cols])
+
+    def _select(self, x) -> np.ndarray:
+        flat = _as_flat(x, self.spec)
+        return flat if self._cols is None else flat[self._cols]
+
+    def _fit(self, samples: np.ndarray) -> None:
+        pass
+
+
+class ZScoreDistance(DistanceWithMeasureList):
+    """Relative deviation to the observation: sum |(x-x0)/x0| (pyabc ZScoreDistance)."""
+
+    def __call__(self, x, x_0, t=None, par=None) -> float:
+        xs, x0s = self._select(x), self._select(x_0)
+        denom = np.where(x0s != 0, np.abs(x0s), 1.0)
+        return float(np.sum(np.abs((xs - x0s) / denom)))
+
+
+class PCADistance(DistanceWithMeasureList):
+    """Euclidean distance in the PCA-whitened sum-stat space (pyabc PCADistance)."""
+
+    def __init__(self, measures_to_use="all", sumstat_spec=None):
+        super().__init__(measures_to_use, sumstat_spec)
+        self._trafo: np.ndarray | None = None
+        self._mean: np.ndarray | None = None
+
+    def _fit(self, samples: np.ndarray) -> None:
+        self._mean = samples.mean(axis=0)
+        cov = np.cov(samples, rowvar=False)
+        cov = np.atleast_2d(cov)
+        vals, vecs = np.linalg.eigh(cov)
+        vals = np.maximum(vals, 1e-12)
+        # whitening transform: v -> diag(1/sqrt(vals)) @ vecs.T @ v
+        self._trafo = (vecs / np.sqrt(vals)).T
+
+    def __call__(self, x, x_0, t=None, par=None) -> float:
+        if self._trafo is None:
+            raise RuntimeError("PCADistance not initialized")
+        diff = self._select(x) - self._select(x_0)
+        return float(np.linalg.norm(self._trafo @ diff))
+
+
+class RangeEstimatorDistance(DistanceWithMeasureList):
+    """L1 distance normalized per-statistic by an estimated range
+    (pyabc RangeEstimatorDistance); subclasses define the range."""
+
+    @staticmethod
+    def lower(samples: np.ndarray) -> np.ndarray:
+        return np.min(samples, axis=0)
+
+    @staticmethod
+    def upper(samples: np.ndarray) -> np.ndarray:
+        return np.max(samples, axis=0)
+
+    def _fit(self, samples: np.ndarray) -> None:
+        lo, hi = self.lower(samples), self.upper(samples)
+        rng = hi - lo
+        self._inv_range = np.where(rng > 0, 1.0 / np.where(rng > 0, rng, 1.0), 0.0)
+
+    def __call__(self, x, x_0, t=None, par=None) -> float:
+        diff = np.abs(self._select(x) - self._select(x_0))
+        return float(np.sum(diff * self._inv_range))
+
+
+class MinMaxDistance(RangeEstimatorDistance):
+    """Range = [min, max] of the calibration sample (pyabc MinMaxDistance)."""
+
+
+class PercentileDistance(RangeEstimatorDistance):
+    """Range = inner percentile interval (pyabc PercentileDistance)."""
+
+    PERCENTILE = 1  # as in the reference
+
+    @classmethod
+    def lower(cls, samples):
+        return np.percentile(samples, cls.PERCENTILE, axis=0)
+
+    @classmethod
+    def upper(cls, samples):
+        return np.percentile(samples, 100 - cls.PERCENTILE, axis=0)

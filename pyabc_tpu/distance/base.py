@@ -1,0 +1,145 @@
+"""Distance base classes.
+
+Reference parity: ``pyabc/distance/base.py::{Distance, NoDistance,
+IdentityFakeDistance, AcceptAllDistance, SimpleFunctionDistance}``.
+
+TPU-first contract (SURVEY.md §7.1): besides the reference's host API
+(``__call__(x, x_0, t, par)`` on sum-stat dicts), every distance that can run
+on-device exposes
+
+- ``device_params(t) -> pytree of jnp arrays`` — the per-generation state
+  (e.g. adaptive weights), passed as *arguments* into the jitted generation
+  kernel so weight updates never trigger recompilation;
+- ``device_fn(spec) -> fn(x_flat, x0_flat, params) -> scalar`` — a traceable
+  distance over flat sum-stat vectors, vmapped by the kernel.
+
+Host ``__call__`` remains the semantic source of truth and is what unit tests
+check against closed forms.
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Callable
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.sumstat_spec import SumStatSpec
+
+
+class Distance(ABC):
+    """Abstract distance (pyabc Distance).
+
+    Lifecycle driven by the ABCSMC orchestrator: ``initialize`` before gen 0,
+    ``configure_sampler`` once, ``update`` after every generation (returns
+    True if the distance changed).
+    """
+
+    def initialize(self, t: int, get_all_sum_stats: Callable | None = None,
+                   x_0=None) -> None:
+        pass
+
+    def configure_sampler(self, sampler) -> None:
+        """Request sampler capabilities (e.g. record rejected sum stats)."""
+
+    def update(self, t: int, get_all_sum_stats: Callable | None = None) -> bool:
+        return False
+
+    @abstractmethod
+    def __call__(self, x, x_0, t: int | None = None, par=None) -> float:
+        """Distance between sum-stat dicts x and x_0."""
+
+    # ---------------------------------------------------------------- device
+    def is_device_compatible(self) -> bool:
+        return False
+
+    def device_params(self, t: int | None = None):
+        """Per-generation parameter pytree passed into the kernel."""
+        return ()
+
+    def device_fn(self, spec: SumStatSpec):
+        """Traceable ``fn(x_flat, x0_flat, params) -> scalar distance``."""
+        raise NotImplementedError(f"{type(self).__name__} has no device form")
+
+    def requires_calibration(self) -> bool:
+        """True if initialize() needs a prior calibration sample."""
+        return False
+
+    def get_config(self) -> dict:
+        return {"name": type(self).__name__}
+
+    def __repr__(self):
+        return f"{type(self).__name__}()"
+
+
+class NoDistance(Distance):
+    """Placeholder that must never be evaluated (pyabc NoDistance)."""
+
+    def __call__(self, x, x_0, t=None, par=None) -> float:
+        raise RuntimeError("NoDistance must not be called")
+
+
+class IdentityFakeDistance(Distance):
+    """Passes the simulation through as 'distance' (pyabc IdentityFakeDistance).
+
+    Used when the model itself computes and returns a distance.
+    """
+
+    def __call__(self, x, x_0, t=None, par=None):
+        return x
+
+
+class AcceptAllDistance(Distance):
+    """Always distance -1 < any epsilon (pyabc AcceptAllDistance)."""
+
+    def __call__(self, x, x_0, t=None, par=None) -> float:
+        return -1.0
+
+    def is_device_compatible(self) -> bool:
+        return True
+
+    def device_fn(self, spec):
+        def fn(x, x0, params):
+            return jnp.asarray(-1.0, jnp.float32)
+        return fn
+
+
+class SimpleFunctionDistance(Distance):
+    """Adapter for a plain callable ``f(x, x_0) -> float``
+    (pyabc SimpleFunctionDistance / to_distance)."""
+
+    def __init__(self, fn: Callable, traceable: bool = False):
+        self.fn = fn
+        self.traceable = traceable
+
+    def __call__(self, x, x_0, t=None, par=None) -> float:
+        return self.fn(x, x_0)
+
+    def is_device_compatible(self) -> bool:
+        return self.traceable
+
+    def device_fn(self, spec):
+        if not self.traceable:
+            raise NotImplementedError(
+                "wrap with to_distance(fn, traceable=True) for device use"
+            )
+        f = self.fn
+
+        def fn(x, x0, params):
+            return f(spec.unflatten_traceable(x), spec.unflatten_traceable(x0))
+
+        return fn
+
+    def __repr__(self):
+        return f"SimpleFunctionDistance({getattr(self.fn, '__name__', self.fn)!r})"
+
+
+def to_distance(maybe_distance, traceable: bool = False) -> Distance | None:
+    """Coerce None/callable/Distance into a Distance (pyabc to_distance)."""
+    if maybe_distance is None:
+        return None
+    if isinstance(maybe_distance, Distance):
+        return maybe_distance
+    if callable(maybe_distance):
+        return SimpleFunctionDistance(maybe_distance, traceable=traceable)
+    raise TypeError(f"cannot coerce {maybe_distance!r} into a Distance")

@@ -1,0 +1,360 @@
+"""Stochastic kernels — noise-model "distances" for noisy/stochastic ABC.
+
+Reference parity: ``pyabc/distance/kernel.py::{StochasticKernel, NormalKernel,
+IndependentNormalKernel, IndependentLaplaceKernel, BinomialKernel,
+PoissonKernel, NegativeBinomialKernel}`` with ``ret_scale`` in
+{SCALE_LIN, SCALE_LOG}.
+
+A kernel returns the (log-)density of the observation x_0 under a noise model
+centered at the simulation x. `StochasticAcceptor` consumes these, accepting
+with probability proportional to density^(1/T). All kernels here default to
+SCALE_LOG (the numerically sane choice); device forms are traceable jnp.
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.sumstat_spec import SumStatSpec
+from .base import Distance
+from .pnorm import _as_flat
+
+SCALE_LIN = "SCALE_LIN"
+SCALE_LOG = "SCALE_LOG"
+
+_LOG_2PI = math.log(2.0 * math.pi)
+
+
+class StochasticKernel(Distance):
+    """Base stochastic kernel (pyabc StochasticKernel).
+
+    ``ret_scale`` declares whether __call__ returns the density (SCALE_LIN)
+    or its log (SCALE_LOG). ``pdf_max`` is the (log-)maximum of the density
+    over x for acceptance normalization — computed at initialize if the
+    subclass can.
+    """
+
+    def __init__(self, ret_scale: str = SCALE_LOG,
+                 keys: Sequence[str] | None = None,
+                 pdf_max: float | None = None,
+                 sumstat_spec: SumStatSpec | None = None):
+        if ret_scale not in (SCALE_LIN, SCALE_LOG):
+            raise ValueError(f"ret_scale must be SCALE_LIN/SCALE_LOG: {ret_scale}")
+        self.ret_scale = ret_scale
+        self.keys = keys
+        self.pdf_max = pdf_max
+        self.spec = sumstat_spec
+
+    def initialize(self, t, get_all_sum_stats=None, x_0=None):
+        if self.spec is None and hasattr(x_0, "keys"):
+            if self.keys is not None:
+                x_0 = {k: x_0[k] for k in self.keys}
+            self.spec = SumStatSpec(x_0)
+
+    def _flat(self, x) -> np.ndarray:
+        if self.keys is not None and hasattr(x, "keys"):
+            x = {k: x[k] for k in self.keys}
+        return _as_flat(x, self.spec)
+
+    def is_device_compatible(self) -> bool:
+        return True
+
+
+class NormalKernel(StochasticKernel):
+    """Multivariate normal noise with full covariance (pyabc NormalKernel)."""
+
+    def __init__(self, cov=None, ret_scale: str = SCALE_LOG, keys=None,
+                 sumstat_spec=None):
+        super().__init__(ret_scale, keys, None, sumstat_spec)
+        self._cov_arg = cov
+        self._prec = None
+        self._logdet = None
+        self._dim = None
+
+    def initialize(self, t, get_all_sum_stats=None, x_0=None):
+        super().initialize(t, get_all_sum_stats, x_0)
+        dim = self.spec.total_size if self.spec else np.size(
+            self._flat(x_0)
+        )
+        cov = self._cov_arg if self._cov_arg is not None else np.eye(dim)
+        cov = np.atleast_2d(np.asarray(cov, np.float64))
+        self._dim = cov.shape[0]
+        self._prec = np.linalg.inv(cov)
+        sign, logdet = np.linalg.slogdet(cov)
+        if sign <= 0:
+            raise ValueError("kernel covariance must be positive definite")
+        self._logdet = logdet
+        # log max over x: the mode value
+        self.pdf_max = -0.5 * (self._dim * _LOG_2PI + self._logdet)
+        if self.ret_scale == SCALE_LIN:
+            self.pdf_max = math.exp(self.pdf_max)
+
+    def __call__(self, x, x_0, t=None, par=None) -> float:
+        diff = self._flat(x) - self._flat(x_0)
+        logp = -0.5 * (
+            self._dim * _LOG_2PI + self._logdet + diff @ self._prec @ diff
+        )
+        return float(np.exp(logp)) if self.ret_scale == SCALE_LIN else float(logp)
+
+    def device_params(self, t=None):
+        return (jnp.asarray(self._prec, jnp.float32),
+                jnp.asarray(self._logdet, jnp.float32))
+
+    def device_fn(self, spec):
+        dim = self._dim
+        lin = self.ret_scale == SCALE_LIN
+
+        def fn(x, x0, params):
+            prec, logdet = params
+            diff = x - x0
+            logp = -0.5 * (dim * _LOG_2PI + logdet + diff @ prec @ diff)
+            return jnp.exp(logp) if lin else logp
+
+        return fn
+
+
+class IndependentNormalKernel(StochasticKernel):
+    """Independent normal noise per statistic (pyabc IndependentNormalKernel).
+
+    ``var`` may be a scalar, a vector, or a callable ``var(par) -> vector``
+    (parameterized noise — e.g. an inferred noise parameter).
+    """
+
+    def __init__(self, var=None, keys=None, sumstat_spec=None):
+        super().__init__(SCALE_LOG, keys, None, sumstat_spec)
+        self.var = var
+
+    def is_device_compatible(self) -> bool:
+        # parameterized noise (callable var) has no generic device form
+        return not callable(self.var)
+
+    def initialize(self, t, get_all_sum_stats=None, x_0=None):
+        super().initialize(t, get_all_sum_stats, x_0)
+        dim = self.spec.total_size if self.spec else np.size(self._flat(x_0))
+        if self.var is None:
+            self.var = np.ones(dim)
+        if not callable(self.var):
+            var = np.broadcast_to(np.asarray(self.var, np.float64), (dim,))
+            self.pdf_max = float(-0.5 * np.sum(_LOG_2PI + np.log(var)))
+
+    def _var_for(self, par):
+        if callable(self.var):
+            return np.ravel(np.asarray(self.var(par), np.float64))
+        return np.ravel(np.asarray(self.var, np.float64))
+
+    def __call__(self, x, x_0, t=None, par=None) -> float:
+        diff = self._flat(x) - self._flat(x_0)
+        var = np.broadcast_to(self._var_for(par), diff.shape)
+        return float(-0.5 * np.sum(_LOG_2PI + np.log(var) + diff * diff / var))
+
+    def device_params(self, t=None):
+        if callable(self.var):
+            return ()
+        return jnp.asarray(np.ravel(np.asarray(self.var, np.float64)), jnp.float32)
+
+    def device_fn(self, spec):
+        var_callable = callable(self.var)
+
+        def fn(x, x0, params):
+            diff = x - x0
+            var = jnp.broadcast_to(params, diff.shape)
+            return -0.5 * jnp.sum(_LOG_2PI + jnp.log(var) + diff * diff / var)
+
+        if var_callable:
+            raise NotImplementedError(
+                "callable var: use device_fn_par (parameterized noise)"
+            )
+        return fn
+
+    def device_fn_par(self, spec):
+        """Parameterized-noise device form: fn(x, x0, var_vector)."""
+        def fn(x, x0, var):
+            diff = x - x0
+            var = jnp.broadcast_to(var, diff.shape)
+            return -0.5 * jnp.sum(_LOG_2PI + jnp.log(var) + diff * diff / var)
+        return fn
+
+
+class IndependentLaplaceKernel(StochasticKernel):
+    """Independent Laplace noise per statistic (pyabc IndependentLaplaceKernel)."""
+
+    def __init__(self, scale=None, keys=None, sumstat_spec=None):
+        super().__init__(SCALE_LOG, keys, None, sumstat_spec)
+        self.scale = scale
+
+    def is_device_compatible(self) -> bool:
+        return not callable(self.scale)
+
+    def initialize(self, t, get_all_sum_stats=None, x_0=None):
+        super().initialize(t, get_all_sum_stats, x_0)
+        dim = self.spec.total_size if self.spec else np.size(self._flat(x_0))
+        if self.scale is None:
+            self.scale = np.ones(dim)
+        if not callable(self.scale):
+            b = np.broadcast_to(np.asarray(self.scale, np.float64), (dim,))
+            self.pdf_max = float(-np.sum(np.log(2.0 * b)))
+
+    def __call__(self, x, x_0, t=None, par=None) -> float:
+        diff = self._flat(x) - self._flat(x_0)
+        b = self.scale(par) if callable(self.scale) else self.scale
+        b = np.broadcast_to(np.ravel(np.asarray(b, np.float64)), diff.shape)
+        return float(-np.sum(np.log(2.0 * b) + np.abs(diff) / b))
+
+    def device_params(self, t=None):
+        return jnp.asarray(np.ravel(np.asarray(self.scale, np.float64)),
+                           jnp.float32)
+
+    def device_fn(self, spec):
+        def fn(x, x0, params):
+            diff = x - x0
+            b = jnp.broadcast_to(params, diff.shape)
+            return -jnp.sum(jnp.log(2.0 * b) + jnp.abs(diff) / b)
+        return fn
+
+
+def _binom_logpmf(k, n, p):
+    return (
+        jax.scipy.special.gammaln(n + 1.0)
+        - jax.scipy.special.gammaln(k + 1.0)
+        - jax.scipy.special.gammaln(n - k + 1.0)
+        + jax.scipy.special.xlogy(k, p)
+        + jax.scipy.special.xlog1py(n - k, -p)
+    )
+
+
+class BinomialKernel(StochasticKernel):
+    """Binomial observation noise: x_0 ~ Binom(n=sim, p) (pyabc BinomialKernel)."""
+
+    def __init__(self, p: float, ret_scale: str = SCALE_LOG, keys=None,
+                 sumstat_spec=None):
+        if not 0 < p <= 1:
+            raise ValueError("p must be in (0, 1]")
+        super().__init__(ret_scale, keys, 0.0 if ret_scale == SCALE_LOG else 1.0,
+                         sumstat_spec)
+        self.p = float(p)
+
+    def __call__(self, x, x_0, t=None, par=None) -> float:
+        from scipy.stats import binom
+
+        n = np.maximum(np.round(self._flat(x)), 0.0)
+        k = np.round(self._flat(x_0))
+        logp = binom.logpmf(k, n, self.p)
+        total = float(np.sum(logp))
+        return math.exp(total) if self.ret_scale == SCALE_LIN else total
+
+    def device_params(self, t=None):
+        return jnp.asarray(self.p, jnp.float32)
+
+    def device_fn(self, spec):
+        lin = self.ret_scale == SCALE_LIN
+
+        def fn(x, x0, p):
+            n = jnp.maximum(jnp.round(x), 0.0)
+            k = jnp.round(x0)
+            logp = _binom_logpmf(k, n, p)
+            logp = jnp.where((k >= 0) & (k <= n), logp, -jnp.inf)
+            total = jnp.sum(logp)
+            return jnp.exp(total) if lin else total
+
+        return fn
+
+
+class PoissonKernel(StochasticKernel):
+    """Poisson observation noise: x_0 ~ Poisson(sim) (pyabc PoissonKernel)."""
+
+    def __init__(self, ret_scale: str = SCALE_LOG, keys=None, sumstat_spec=None):
+        super().__init__(ret_scale, keys, 0.0 if ret_scale == SCALE_LOG else 1.0,
+                         sumstat_spec)
+
+    def __call__(self, x, x_0, t=None, par=None) -> float:
+        lam = np.maximum(self._flat(x), 1e-12)
+        k = np.round(self._flat(x_0))
+        from scipy.special import gammaln
+
+        logp = k * np.log(lam) - lam - gammaln(k + 1.0)
+        logp = np.where(k >= 0, logp, -np.inf)
+        total = float(np.sum(logp))
+        return math.exp(total) if self.ret_scale == SCALE_LIN else total
+
+    def device_params(self, t=None):
+        return ()
+
+    def device_fn(self, spec):
+        lin = self.ret_scale == SCALE_LIN
+
+        def fn(x, x0, params):
+            lam = jnp.maximum(x, 1e-12)
+            k = jnp.round(x0)
+            logp = k * jnp.log(lam) - lam - jax.scipy.special.gammaln(k + 1.0)
+            logp = jnp.where(k >= 0, logp, -jnp.inf)
+            total = jnp.sum(logp)
+            return jnp.exp(total) if lin else total
+
+        return fn
+
+
+class NegativeBinomialKernel(StochasticKernel):
+    """Negative-binomial observation noise with dispersion p
+    (pyabc NegativeBinomialKernel): x_0 ~ NB(mean=sim, p)."""
+
+    def __init__(self, p: float, ret_scale: str = SCALE_LOG, keys=None,
+                 sumstat_spec=None):
+        super().__init__(ret_scale, keys, None, sumstat_spec)
+        self.p = float(p)
+
+    def __call__(self, x, x_0, t=None, par=None) -> float:
+        mean = np.maximum(self._flat(x), 1e-12)
+        k = np.round(self._flat(x_0))
+        # mean = n (1-p)/p  =>  n = mean p/(1-p)
+        n = mean * self.p / (1.0 - self.p)
+        from scipy.special import gammaln
+
+        logp = (
+            gammaln(k + n) - gammaln(n) - gammaln(k + 1.0)
+            + n * np.log(self.p) + k * np.log1p(-self.p)
+        )
+        logp = np.where(k >= 0, logp, -np.inf)
+        total = float(np.sum(logp))
+        return math.exp(total) if self.ret_scale == SCALE_LIN else total
+
+    def device_params(self, t=None):
+        return jnp.asarray(self.p, jnp.float32)
+
+    def device_fn(self, spec):
+        lin = self.ret_scale == SCALE_LIN
+
+        def fn(x, x0, p):
+            mean = jnp.maximum(x, 1e-12)
+            k = jnp.round(x0)
+            n = mean * p / (1.0 - p)
+            logp = (
+                jax.scipy.special.gammaln(k + n)
+                - jax.scipy.special.gammaln(n)
+                - jax.scipy.special.gammaln(k + 1.0)
+                + n * jnp.log(p)
+                + k * jnp.log1p(-p)
+            )
+            logp = jnp.where(k >= 0, logp, -jnp.inf)
+            total = jnp.sum(logp)
+            return jnp.exp(total) if lin else total
+
+        return fn
+
+
+class FunctionKernel(StochasticKernel):
+    """Adapter: arbitrary density function as a kernel (pyabc FunctionKernel)."""
+
+    def __init__(self, fn: Callable, ret_scale: str = SCALE_LOG,
+                 pdf_max=None):
+        super().__init__(ret_scale, None, pdf_max)
+        self.fn = fn
+
+    def __call__(self, x, x_0, t=None, par=None):
+        return self.fn(x, x_0, t, par)
+
+    def is_device_compatible(self) -> bool:
+        return False

@@ -1,0 +1,237 @@
+"""Weighted p-norm distances, fixed and adaptive.
+
+Reference parity: ``pyabc/distance/distance.py::{PNormDistance,
+AdaptivePNormDistance}`` and the scale functions of
+``pyabc/distance/scale.py``.
+
+Semantics (reference): d(x, x0) = (sum_i (w_i |x_i - x0_i|)^p)^(1/p);
+p = inf gives max_i w_i |x_i - x0_i|. The adaptive variant refits w_i each
+generation as 1/scale_i over ALL simulations of the previous generation
+(accepted AND rejected — ``configure_sampler`` sets
+``sampler.sample_factory.record_rejected = True``), optionally normalized to
+mean 1 so epsilon magnitudes stay comparable across generations.
+
+Device form: weights are a ``(S,)`` jnp vector passed as a kernel argument,
+so per-generation reweighting never recompiles the generation kernel.
+"""
+from __future__ import annotations
+
+import json
+from typing import Callable, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.sumstat_spec import SumStatSpec
+from .base import Distance
+from .scale import median_absolute_deviation
+
+
+def _as_flat(x, spec: SumStatSpec | None) -> np.ndarray:
+    """Dict-or-vector sum stats -> flat float64 vector (host path)."""
+    if isinstance(x, Mapping):
+        if spec is not None:
+            return np.asarray(spec.flatten(x), np.float64)
+        parts = [np.ravel(np.asarray(x[k], np.float64)) for k in sorted(x)]
+        return np.concatenate(parts) if len(parts) > 1 else parts[0]
+    return np.ravel(np.asarray(x, np.float64))
+
+
+class PNormDistance(Distance):
+    """Fixed-weight weighted p-norm (pyabc PNormDistance).
+
+    ``weights`` may be a dict ``{t: vector-or-dict}`` (per-generation), a flat
+    vector, or a dict keyed by sum-stat label; None means all-ones.
+    """
+
+    def __init__(self, p: float = 2.0, weights=None,
+                 factors=None, sumstat_spec: SumStatSpec | None = None):
+        if p < 1:
+            raise ValueError("p must be >= 1")
+        self.p = float(p)
+        self.spec = sumstat_spec
+        self._weights_arg = weights
+        self._factors_arg = factors
+        #: resolved per-generation weights {t: (S,) array}; -1 = default key
+        self.weights: dict[int, np.ndarray] = {}
+
+    # ----------------------------------------------------------- lifecycle
+    def initialize(self, t, get_all_sum_stats=None, x_0=None):
+        if self.spec is None and isinstance(x_0, Mapping):
+            self.spec = SumStatSpec(x_0)
+        self._resolve_initial_weights()
+
+    def _resolve_initial_weights(self):
+        w = self._weights_arg
+        if w is None:
+            return
+        if isinstance(w, Mapping) and all(
+            isinstance(k, (int, np.integer)) for k in w
+        ):
+            for t, wt in w.items():
+                self.weights[int(t)] = self._coerce_weight_vector(wt)
+        else:
+            self.weights[-1] = self._coerce_weight_vector(w)
+
+    def _coerce_weight_vector(self, w) -> np.ndarray:
+        if isinstance(w, Mapping):
+            if self.spec is None:
+                raise ValueError(
+                    "label-keyed weights require a SumStatSpec"
+                )
+            vec = np.ones(self.spec.total_size)
+            labels = self.spec.labels()
+            for k, v in w.items():
+                if k in labels:
+                    vec[labels.index(k)] = v
+                elif k in self.spec.names:
+                    off = self.spec.offsets[k]
+                    vec[off : off + self.spec.sizes[k]] = v
+                else:
+                    raise KeyError(f"unknown sum-stat label {k!r}")
+            return vec
+        return np.ravel(np.asarray(w, np.float64))
+
+    def weights_for(self, t: int | None) -> np.ndarray | None:
+        """The weight vector in effect at generation t (latest <= t, else default)."""
+        if not self.weights:
+            return None
+        if t is not None:
+            past = [s for s in self.weights if s >= 0 and s <= t]
+            if past:
+                return self.weights[max(past)]
+        return self.weights.get(-1)
+
+    # --------------------------------------------------------------- call
+    def __call__(self, x, x_0, t=None, par=None) -> float:
+        xf = _as_flat(x, self.spec)
+        x0f = _as_flat(x_0, self.spec)
+        w = self.weights_for(t)
+        if w is None:
+            w = np.ones_like(x0f)
+        f = self._factors_arg
+        if f is not None:
+            w = w * self._coerce_weight_vector(f)
+        diff = w * np.abs(xf - x0f)
+        if np.isinf(self.p):
+            return float(np.max(diff))
+        return float(np.sum(diff**self.p) ** (1.0 / self.p))
+
+    # ------------------------------------------------------------- device
+    def is_device_compatible(self) -> bool:
+        return True
+
+    def device_params(self, t=None):
+        if self.spec is None:
+            raise RuntimeError("distance not initialized (no SumStatSpec)")
+        w = self.weights_for(t)
+        if w is None:
+            w = np.ones(self.spec.total_size)
+        f = self._factors_arg
+        if f is not None:
+            w = w * self._coerce_weight_vector(f)
+        return jnp.asarray(w, jnp.float32)
+
+    def device_fn(self, spec: SumStatSpec):
+        p = self.p
+
+        def fn(x, x0, weights):
+            diff = weights * jnp.abs(x - x0)
+            if np.isinf(p):
+                return jnp.max(diff)
+            return jnp.sum(diff**p) ** (1.0 / p)
+
+        return fn
+
+    def get_config(self):
+        return {"name": type(self).__name__, "p": self.p}
+
+    def __repr__(self):
+        return f"{type(self).__name__}(p={self.p})"
+
+
+class AdaptivePNormDistance(PNormDistance):
+    """Self-reweighting p-norm (pyabc AdaptivePNormDistance).
+
+    Each generation, per-statistic weights are refit to 1/scale over all
+    recorded simulations (accepted + rejected) so every summary statistic
+    contributes comparably regardless of its magnitude.
+    """
+
+    def __init__(self, p: float = 2.0,
+                 scale_function: Callable = median_absolute_deviation,
+                 adaptive: bool = True,
+                 normalize_weights: bool = True,
+                 max_weight_ratio: float | None = None,
+                 scale_log_file: str | None = None,
+                 sumstat_spec: SumStatSpec | None = None):
+        super().__init__(p=p, weights=None, sumstat_spec=sumstat_spec)
+        self.scale_function = scale_function
+        self.adaptive = adaptive
+        self.normalize_weights = normalize_weights
+        self.max_weight_ratio = max_weight_ratio
+        self.scale_log_file = scale_log_file
+        self._x_0: np.ndarray | None = None
+
+    def requires_calibration(self) -> bool:
+        return True
+
+    def configure_sampler(self, sampler):
+        """Adaptive reweighting needs rejected simulations too (reference:
+        sampler.sample_factory.record_rejected = True)."""
+        if self.adaptive:
+            sampler.sample_factory.record_rejected = True
+
+    def initialize(self, t, get_all_sum_stats=None, x_0=None):
+        super().initialize(t, get_all_sum_stats, x_0)
+        self._x_0 = _as_flat(x_0, self.spec) if x_0 is not None else None
+        if get_all_sum_stats is not None:
+            self._fit(t, np.asarray(get_all_sum_stats(), np.float64))
+
+    def update(self, t, get_all_sum_stats=None) -> bool:
+        if not self.adaptive or get_all_sum_stats is None:
+            return False
+        self._fit(t, np.asarray(get_all_sum_stats(), np.float64))
+        return True
+
+    def _fit(self, t: int, samples: np.ndarray) -> None:
+        """weights[t] = 1/scale over the sample matrix (n, S)."""
+        try:
+            scale = self.scale_function(samples, self._x_0)
+        except TypeError:
+            scale = self.scale_function(samples)
+        scale = np.asarray(scale, np.float64)
+        w = np.zeros_like(scale)
+        pos = scale > 0
+        w[pos] = 1.0 / scale[pos]
+        if self.max_weight_ratio is not None and pos.any():
+            wmin = w[pos].min()
+            w = np.minimum(w, wmin * self.max_weight_ratio)
+        if self.normalize_weights and w.sum() > 0:
+            w = w * (w.size / w.sum())
+        self.weights[int(t)] = w
+        if self.scale_log_file:
+            labels = self.spec.labels() if self.spec else [
+                str(i) for i in range(w.size)
+            ]
+            try:
+                with open(self.scale_log_file) as fh:
+                    log = json.load(fh)
+            except (OSError, json.JSONDecodeError):
+                log = {}
+            log[str(t)] = dict(zip(labels, w.tolist()))
+            with open(self.scale_log_file, "w") as fh:
+                json.dump(log, fh, indent=1)
+
+    def get_config(self):
+        return {
+            "name": type(self).__name__,
+            "p": self.p,
+            "scale_function": self.scale_function.__name__,
+        }
+
+    def __repr__(self):
+        return (
+            f"AdaptivePNormDistance(p={self.p}, "
+            f"scale_function={self.scale_function.__name__})"
+        )

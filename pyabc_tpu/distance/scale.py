@@ -1,0 +1,99 @@
+"""Scale functions for adaptive distance re-weighting.
+
+Reference parity: ``pyabc/distance/scale.py`` — the pluggable per-statistic
+scale estimators used by ``AdaptivePNormDistance`` (weight = 1/scale).
+
+TPU-first shift: the reference computes these per sum-stat key over a list of
+dicts; here each function is vectorized over the statistic axis — input is the
+full matrix ``samples: (n_samples, S)`` of flattened sum stats plus the
+observed ``x_0: (S,)``, output is a ``(S,)`` scale vector. numpy float64 on
+host (runs once per generation).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def median_absolute_deviation(samples, x_0=None):
+    """MAD: median(|x - median(x)|) per statistic."""
+    med = np.median(samples, axis=0)
+    return np.median(np.abs(samples - med), axis=0)
+
+
+def mean_absolute_deviation(samples, x_0=None):
+    mean = np.mean(samples, axis=0)
+    return np.mean(np.abs(samples - mean), axis=0)
+
+
+def standard_deviation(samples, x_0=None):
+    return np.std(samples, axis=0)
+
+
+def span(samples, x_0=None):
+    return np.max(samples, axis=0) - np.min(samples, axis=0)
+
+
+def mean(samples, x_0=None):
+    return np.mean(samples, axis=0)
+
+
+def median(samples, x_0=None):
+    return np.median(samples, axis=0)
+
+
+def bias(samples, x_0):
+    """|mean(x) - x_0| — systematic deviation from the observation."""
+    return np.abs(np.mean(samples, axis=0) - x_0)
+
+
+def root_mean_square_deviation(samples, x_0):
+    """sqrt(bias^2 + std^2) — total deviation around the observation."""
+    b = bias(samples, x_0)
+    s = standard_deviation(samples)
+    return np.sqrt(b * b + s * s)
+
+
+def median_absolute_deviation_to_observation(samples, x_0):
+    return np.median(np.abs(samples - x_0), axis=0)
+
+
+def mean_absolute_deviation_to_observation(samples, x_0):
+    return np.mean(np.abs(samples - x_0), axis=0)
+
+
+def combined_median_absolute_deviation(samples, x_0):
+    """MAD + |median - x_0| (reference combined_median_absolute_deviation)."""
+    return median_absolute_deviation(samples) + np.abs(
+        np.median(samples, axis=0) - x_0
+    )
+
+
+def combined_mean_absolute_deviation(samples, x_0):
+    return mean_absolute_deviation(samples) + np.abs(
+        np.mean(samples, axis=0) - x_0
+    )
+
+
+def standard_deviation_to_observation(samples, x_0):
+    """sqrt(mean((x - x_0)^2)) around the observation."""
+    return np.sqrt(np.mean((samples - x_0) ** 2, axis=0))
+
+
+SCALE_FUNCTIONS = {
+    f.__name__: f
+    for f in [
+        median_absolute_deviation,
+        mean_absolute_deviation,
+        standard_deviation,
+        span,
+        mean,
+        median,
+        bias,
+        root_mean_square_deviation,
+        median_absolute_deviation_to_observation,
+        mean_absolute_deviation_to_observation,
+        combined_median_absolute_deviation,
+        combined_mean_absolute_deviation,
+        standard_deviation_to_observation,
+    ]
+}

@@ -1,0 +1,28 @@
+from .base import (
+    ConstantEpsilon,
+    Epsilon,
+    ListEpsilon,
+    MedianEpsilon,
+    NoEpsilon,
+    QuantileEpsilon,
+)
+from .temperature import (
+    AcceptanceRateScheme,
+    DalyScheme,
+    EssScheme,
+    ExpDecayFixedIterScheme,
+    ExpDecayFixedRatioScheme,
+    FrielPettittScheme,
+    PolynomialDecayFixedIterScheme,
+    Temperature,
+    TemperatureScheme,
+)
+
+__all__ = [
+    "Epsilon", "NoEpsilon", "ConstantEpsilon", "ListEpsilon",
+    "QuantileEpsilon", "MedianEpsilon",
+    "Temperature", "TemperatureScheme", "AcceptanceRateScheme",
+    "ExpDecayFixedIterScheme", "ExpDecayFixedRatioScheme",
+    "PolynomialDecayFixedIterScheme", "DalyScheme", "FrielPettittScheme",
+    "EssScheme",
+]

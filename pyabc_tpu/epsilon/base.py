@@ -1,0 +1,165 @@
+"""Epsilon (acceptance threshold) schedules.
+
+Reference parity: ``pyabc/epsilon/epsilon.py::{Epsilon, NoEpsilon,
+ConstantEpsilon, ListEpsilon, QuantileEpsilon, MedianEpsilon}``.
+
+`QuantileEpsilon` shrinks the threshold each generation to the alpha-quantile
+of the previous generation's *weighted* accepted distances (the reference's
+adaptive default). Host-side float64; the resulting scalar is passed as a
+kernel argument each generation (no recompile).
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Callable
+
+import numpy as np
+
+from ..core.weighted_statistics import weighted_quantile
+
+
+class Epsilon(ABC):
+    """Abstract epsilon schedule (pyabc Epsilon)."""
+
+    def initialize(self, t: int, get_weighted_distances: Callable | None = None,
+                   get_all_records: Callable | None = None,
+                   max_nr_populations: int | None = None,
+                   acceptor_config: dict | None = None) -> None:
+        pass
+
+    def configure_sampler(self, sampler) -> None:
+        pass
+
+    def update(self, t: int, get_weighted_distances: Callable | None = None,
+               get_all_records: Callable | None = None,
+               acceptance_rate: float | None = None,
+               acceptor_config: dict | None = None) -> None:
+        pass
+
+    @abstractmethod
+    def __call__(self, t: int) -> float:
+        """The threshold for generation t."""
+
+    def requires_calibration(self) -> bool:
+        return False
+
+    def get_config(self) -> dict:
+        return {"name": type(self).__name__}
+
+    def __repr__(self):
+        return f"{type(self).__name__}()"
+
+
+class NoEpsilon(Epsilon):
+    """No threshold (acceptance decided elsewhere; pyabc NoEpsilon)."""
+
+    def __call__(self, t: int) -> float:
+        return np.nan
+
+
+class ConstantEpsilon(Epsilon):
+    """Same threshold every generation (pyabc ConstantEpsilon)."""
+
+    def __init__(self, constant_epsilon_value: float):
+        self.constant_epsilon_value = float(constant_epsilon_value)
+
+    def __call__(self, t: int) -> float:
+        return self.constant_epsilon_value
+
+    def get_config(self):
+        return {"name": type(self).__name__,
+                "constant_epsilon_value": self.constant_epsilon_value}
+
+
+class ListEpsilon(Epsilon):
+    """Pre-specified threshold per generation (pyabc ListEpsilon)."""
+
+    def __init__(self, values):
+        self.epsilon_values = [float(v) for v in values]
+
+    def __call__(self, t: int) -> float:
+        return self.epsilon_values[t]
+
+    def get_config(self):
+        return {"name": type(self).__name__, "epsilon_values": self.epsilon_values}
+
+
+class QuantileEpsilon(Epsilon):
+    """alpha-quantile of the previous generation's weighted accepted distances
+    (pyabc QuantileEpsilon).
+
+    ``initial_epsilon`` may be a float or 'from_sample' (quantile of the
+    calibration sample — requires calibration). ``quantile_multiplier``
+    optionally scales the quantile (e.g. aggressive shrink < 1).
+    """
+
+    def __init__(self, initial_epsilon: float | str = "from_sample",
+                 alpha: float = 0.5, quantile_multiplier: float = 1.0,
+                 weighted: bool = True):
+        if not 0 < alpha <= 1:
+            raise ValueError("alpha must be in (0, 1]")
+        self.initial_epsilon = initial_epsilon
+        self.alpha = float(alpha)
+        self.quantile_multiplier = float(quantile_multiplier)
+        self.weighted = bool(weighted)
+        self._values: dict[int, float] = {}
+
+    def requires_calibration(self) -> bool:
+        return self.initial_epsilon == "from_sample"
+
+    def initialize(self, t, get_weighted_distances=None, get_all_records=None,
+                   max_nr_populations=None, acceptor_config=None):
+        if self.initial_epsilon == "from_sample":
+            if get_weighted_distances is None:
+                raise ValueError(
+                    "QuantileEpsilon('from_sample') needs calibration distances"
+                )
+            df = get_weighted_distances()
+            self._set(t, df)
+        else:
+            self._values[t] = float(self.initial_epsilon)
+
+    def update(self, t, get_weighted_distances=None, get_all_records=None,
+               acceptance_rate=None, acceptor_config=None):
+        if get_weighted_distances is None:
+            raise ValueError("QuantileEpsilon.update needs weighted distances")
+        self._set(t, get_weighted_distances())
+
+    def _set(self, t: int, df) -> None:
+        distances = np.asarray(df["distance"], np.float64)
+        weights = (
+            np.asarray(df["w"], np.float64)
+            if self.weighted and "w" in df
+            else np.ones_like(distances)
+        )
+        val = weighted_quantile(distances, weights, alpha=self.alpha)
+        self._values[t] = float(val * self.quantile_multiplier)
+
+    def __call__(self, t: int) -> float:
+        try:
+            return self._values[t]
+        except KeyError:
+            raise KeyError(
+                f"no epsilon value for generation {t} (have {sorted(self._values)})"
+            )
+
+    def get_config(self):
+        return {
+            "name": type(self).__name__,
+            "alpha": self.alpha,
+            "quantile_multiplier": self.quantile_multiplier,
+            "weighted": self.weighted,
+        }
+
+    def __repr__(self):
+        return f"{type(self).__name__}(alpha={self.alpha})"
+
+
+class MedianEpsilon(QuantileEpsilon):
+    """QuantileEpsilon at alpha=0.5 (pyabc MedianEpsilon; the default)."""
+
+    def __init__(self, initial_epsilon: float | str = "from_sample",
+                 quantile_multiplier: float = 1.0, weighted: bool = True):
+        super().__init__(initial_epsilon, alpha=0.5,
+                         quantile_multiplier=quantile_multiplier,
+                         weighted=weighted)

@@ -1,0 +1,349 @@
+"""Temperature schedules for stochastic (noisy) ABC.
+
+Reference parity: ``pyabc/epsilon/temperature.py::{Temperature,
+TemperatureBase, TemperatureScheme, AcceptanceRateScheme,
+ExpDecayFixedIterScheme, ExpDecayFixedRatioScheme,
+PolynomialDecayFixedIterScheme, DalyScheme, FrielPettittScheme, EssScheme}``.
+
+With a `StochasticAcceptor`, epsilon(t) is an (inverse) temperature T_t >= 1
+on the acceptance density: accept ~ exp((v - pdf_norm)/T). Temperature
+orchestrates one or more schemes, takes the *minimum* (most aggressive)
+proposal each generation, enforces monotone decay, and lands exactly at
+T = 1 (exact sampling) on the final generation when the horizon is known.
+
+All schemes receive the full per-generation context and return a proposed
+temperature. Weighted kernel values (log scale) come from the previous
+generation's records.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, Sequence
+
+import numpy as np
+
+from .base import Epsilon
+
+logger = logging.getLogger("ABC.Epsilon")
+
+
+class TemperatureScheme:
+    """Base: __call__(t, **ctx) -> proposed temperature."""
+
+    def __call__(self, t: int, *, get_weighted_distances=None,
+                 pdf_norm: float | None = None, kernel_scale: str = "SCALE_LOG",
+                 prev_temperature: float | None = None,
+                 acceptance_rate: float | None = None,
+                 max_nr_populations: int | None = None) -> float:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}()"
+
+
+class AcceptanceRateScheme(TemperatureScheme):
+    """Choose T so the *predicted* acceptance rate hits ``target_rate``
+    (reference AcceptanceRateScheme).
+
+    The prediction model: importance-weighted mean over last-generation
+    kernel values v_i of min(1, exp((v_i - pdf_norm)/T)); bisection on
+    log10(T).
+    """
+
+    def __init__(self, target_rate: float = 0.3, min_rate: float | None = None):
+        self.target_rate = float(target_rate)
+        self.min_rate = min_rate
+
+    def __call__(self, t, *, get_weighted_distances=None, pdf_norm=None,
+                 kernel_scale="SCALE_LOG", prev_temperature=None,
+                 acceptance_rate=None, max_nr_populations=None) -> float:
+        if get_weighted_distances is None or pdf_norm is None:
+            return np.inf
+        df = get_weighted_distances()
+        vals = np.asarray(df["distance"], np.float64)
+        if kernel_scale == "SCALE_LIN":
+            vals = np.log(np.maximum(vals, 1e-300))
+        w = np.asarray(df["w"], np.float64) if "w" in df else np.ones_like(vals)
+        w = w / w.sum()
+        diff = vals - pdf_norm  # <= 0 typically
+
+        def rate_at(temp: float) -> float:
+            return float(np.sum(w * np.minimum(1.0, np.exp(diff / temp))))
+
+        # T=1 already accepts often enough -> no tempering needed
+        if rate_at(1.0) >= self.target_rate:
+            return 1.0
+        lo, hi = 0.0, 12.0  # log10 T in [1, 1e12]
+        for _ in range(60):
+            mid = 0.5 * (lo + hi)
+            if rate_at(10.0**mid) >= self.target_rate:
+                hi = mid
+            else:
+                lo = mid
+        return float(10.0**hi)
+
+
+class ExpDecayFixedIterScheme(TemperatureScheme):
+    """Exponential decay to T=1 over a fixed horizon (reference
+    ExpDecayFixedIterScheme): log T linear in t, hitting 1 at the final
+    generation."""
+
+    def __call__(self, t, *, prev_temperature=None, max_nr_populations=None,
+                 **ctx) -> float:
+        if max_nr_populations is None:
+            raise ValueError(
+                "ExpDecayFixedIterScheme needs a fixed max_nr_populations"
+            )
+        if prev_temperature is None or not np.isfinite(prev_temperature):
+            return np.inf
+        t_to_go = max_nr_populations - t
+        if t_to_go <= 1:
+            return 1.0
+        # geometric interpolation from prev temp to 1 over remaining gens
+        return float(prev_temperature ** ((t_to_go - 1) / t_to_go))
+
+
+class ExpDecayFixedRatioScheme(TemperatureScheme):
+    """T_t = alpha * T_{t-1} (reference ExpDecayFixedRatioScheme)."""
+
+    def __init__(self, alpha: float = 0.5, min_rate: float = 1e-4,
+                 max_rate: float = 0.5):
+        self.alpha = float(alpha)
+        self.min_rate = min_rate
+        self.max_rate = max_rate
+
+    def __call__(self, t, *, prev_temperature=None, acceptance_rate=None,
+                 **ctx) -> float:
+        if prev_temperature is None or not np.isfinite(prev_temperature):
+            return np.inf
+        alpha = self.alpha
+        if acceptance_rate is not None:
+            # slow down when acceptance collapses, speed up when trivial
+            if acceptance_rate < self.min_rate:
+                alpha = np.sqrt(alpha)
+            elif acceptance_rate > self.max_rate:
+                alpha = alpha**2
+        return float(max(1.0, alpha * prev_temperature))
+
+
+class PolynomialDecayFixedIterScheme(TemperatureScheme):
+    """T decays polynomially to 1 over a fixed horizon (reference
+    PolynomialDecayFixedIterScheme)."""
+
+    def __init__(self, exponent: float = 3.0):
+        self.exponent = float(exponent)
+
+    def __call__(self, t, *, prev_temperature=None, max_nr_populations=None,
+                 **ctx) -> float:
+        if max_nr_populations is None:
+            raise ValueError(
+                "PolynomialDecayFixedIterScheme needs max_nr_populations"
+            )
+        if prev_temperature is None or not np.isfinite(prev_temperature):
+            return np.inf
+        t_to_go = max_nr_populations - t
+        if t_to_go <= 1:
+            return 1.0
+        frac = (t_to_go - 1) / t_to_go
+        return float(1.0 + (prev_temperature - 1.0) * frac**self.exponent)
+
+
+class DalyScheme(TemperatureScheme):
+    """Daly et al. 2017 adaptive tolerance contraction (reference DalyScheme):
+    keep an internal contraction state k; shrink it by ``alpha`` each
+    generation, but react to acceptance-rate collapse by re-expanding."""
+
+    def __init__(self, alpha: float = 0.5, min_rate: float = 1e-4):
+        self.alpha = float(alpha)
+        self.min_rate = float(min_rate)
+        self._k: dict[int, float] = {}
+
+    def __call__(self, t, *, prev_temperature=None, acceptance_rate=None,
+                 **ctx) -> float:
+        if prev_temperature is None or not np.isfinite(prev_temperature):
+            return np.inf
+        k_prev = self._k.get(t - 1, prev_temperature)
+        if acceptance_rate is not None and acceptance_rate < self.min_rate:
+            k = k_prev / self.alpha  # back off
+        else:
+            k = self.alpha * min(k_prev, prev_temperature)
+        self._k[t] = k
+        return float(max(1.0, prev_temperature - k))
+
+
+class FrielPettittScheme(TemperatureScheme):
+    """Power-posterior tempering ladder beta_t = ((t+1)/n)^2, T = 1/beta
+    (reference FrielPettittScheme)."""
+
+    def __call__(self, t, *, max_nr_populations=None, **ctx) -> float:
+        if max_nr_populations is None:
+            raise ValueError("FrielPettittScheme needs max_nr_populations")
+        beta = ((t + 1.0) / max_nr_populations) ** 2
+        return float(1.0 / max(beta, 1e-12))
+
+
+class EssScheme(TemperatureScheme):
+    """Choose T so the relative ESS of the tempering reweight factors hits
+    ``target_relative_ess`` (reference EssScheme)."""
+
+    def __init__(self, target_relative_ess: float = 0.8):
+        self.target_relative_ess = float(target_relative_ess)
+
+    def __call__(self, t, *, get_weighted_distances=None, pdf_norm=None,
+                 kernel_scale="SCALE_LOG", prev_temperature=None, **ctx
+                 ) -> float:
+        if get_weighted_distances is None:
+            return np.inf
+        df = get_weighted_distances()
+        vals = np.asarray(df["distance"], np.float64)
+        if kernel_scale == "SCALE_LIN":
+            vals = np.log(np.maximum(vals, 1e-300))
+        w = np.asarray(df["w"], np.float64) if "w" in df else np.ones_like(vals)
+        w = w / w.sum()
+        T_prev = (
+            prev_temperature
+            if prev_temperature is not None and np.isfinite(prev_temperature)
+            else None
+        )
+
+        def rel_ess(temp: float) -> float:
+            # reweight factor from T_prev (or prior) to temp
+            beta_new = 1.0 / temp
+            beta_old = 0.0 if T_prev is None else 1.0 / T_prev
+            lw = (beta_new - beta_old) * vals
+            lw = lw - lw.max()
+            ww = w * np.exp(lw)
+            s = ww.sum()
+            if s <= 0:
+                return 0.0
+            ww = ww / s
+            return float(1.0 / np.sum(ww**2) / len(ww))
+
+        target = self.target_relative_ess
+        if rel_ess(1.0) >= target:
+            return 1.0
+        lo, hi = 0.0, 12.0
+        for _ in range(60):
+            mid = 0.5 * (lo + hi)
+            if rel_ess(10.0**mid) >= target:
+                hi = mid
+            else:
+                lo = mid
+        return float(10.0**hi)
+
+
+class Temperature(Epsilon):
+    """Adaptive temperature schedule (reference Temperature).
+
+    ``schemes``: list of TemperatureScheme; the per-generation proposal is
+    aggregated with ``aggregate_fun`` (default min) and clipped to enforce
+    monotone decay and T >= 1. The final generation (known horizon) forces
+    T = 1. Defaults follow the reference: AcceptanceRateScheme +
+    ExpDecayFixedIterScheme.
+    """
+
+    def __init__(self, schemes: Sequence[TemperatureScheme] | None = None,
+                 aggregate_fun: Callable = min,
+                 initial_temperature: float | TemperatureScheme | None = None,
+                 enforce_less_equal_prev: bool = True,
+                 log_file: str | None = None):
+        self.schemes = list(schemes) if schemes is not None else None
+        self.aggregate_fun = aggregate_fun
+        self.initial_temperature = (
+            initial_temperature
+            if initial_temperature is not None
+            else AcceptanceRateScheme()
+        )
+        self.enforce_less_equal_prev = enforce_less_equal_prev
+        self.log_file = log_file
+        self.temperatures: dict[int, float] = {}
+        self._max_nr_populations: int | None = None
+
+    def requires_calibration(self) -> bool:
+        return True
+
+    def configure_sampler(self, sampler):
+        # acceptance-rate prediction wants all simulations, incl. rejected
+        sampler.sample_factory.record_rejected = True
+
+    def _effective_schemes(self) -> list[TemperatureScheme]:
+        if self.schemes is not None:
+            return self.schemes
+        schemes: list[TemperatureScheme] = [AcceptanceRateScheme()]
+        if self._max_nr_populations is not None:
+            schemes.append(ExpDecayFixedIterScheme())
+        else:
+            schemes.append(ExpDecayFixedRatioScheme())
+        return schemes
+
+    def initialize(self, t, get_weighted_distances=None, get_all_records=None,
+                   max_nr_populations=None, acceptor_config=None):
+        self._max_nr_populations = max_nr_populations
+        self._set(t, get_weighted_distances, acceptor_config,
+                  acceptance_rate=None)
+
+    def update(self, t, get_weighted_distances=None, get_all_records=None,
+               acceptance_rate=None, acceptor_config=None):
+        self._set(t, get_weighted_distances, acceptor_config, acceptance_rate)
+
+    def _set(self, t, get_weighted_distances, acceptor_config,
+             acceptance_rate):
+        acceptor_config = acceptor_config or {}
+        pdf_norm = acceptor_config.get("pdf_norm")
+        kernel_scale = acceptor_config.get("kernel_scale", "SCALE_LOG")
+        prev = self.temperatures.get(t - 1)
+        is_final = (
+            self._max_nr_populations is not None
+            and t >= self._max_nr_populations - 1
+        )
+        if is_final:
+            temp = 1.0
+        elif t == 0 or prev is None:
+            init = self.initial_temperature
+            if isinstance(init, (int, float)):
+                temp = float(init)
+            else:
+                temp = init(
+                    t, get_weighted_distances=get_weighted_distances,
+                    pdf_norm=pdf_norm, kernel_scale=kernel_scale,
+                    prev_temperature=None, acceptance_rate=acceptance_rate,
+                    max_nr_populations=self._max_nr_populations,
+                )
+            if not np.isfinite(temp):
+                temp = 1e4  # reference-style high fallback start
+        else:
+            proposals = []
+            for scheme in self._effective_schemes():
+                try:
+                    proposals.append(scheme(
+                        t, get_weighted_distances=get_weighted_distances,
+                        pdf_norm=pdf_norm, kernel_scale=kernel_scale,
+                        prev_temperature=prev,
+                        acceptance_rate=acceptance_rate,
+                        max_nr_populations=self._max_nr_populations,
+                    ))
+                except ValueError:
+                    continue
+            proposals = [p for p in proposals if np.isfinite(p)] or [prev]
+            temp = float(self.aggregate_fun(proposals))
+        if (self.enforce_less_equal_prev and prev is not None
+                and np.isfinite(prev)):
+            temp = min(temp, prev)
+        temp = max(temp, 1.0)
+        self.temperatures[t] = temp
+        logger.debug("temperature t=%d: %.4g", t, temp)
+        if self.log_file:
+            import json
+
+            with open(self.log_file, "w") as fh:
+                json.dump({str(k): v for k, v in self.temperatures.items()},
+                          fh, indent=1)
+
+    def __call__(self, t: int) -> float:
+        return self.temperatures[t]
+
+    def get_config(self):
+        return {"name": type(self).__name__}
+
+    def __repr__(self):
+        return f"Temperature(schemes={self.schemes})"

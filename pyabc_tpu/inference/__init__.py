@@ -1,0 +1,17 @@
+from .smc import ABCSMC, GenerationSpec
+from .util import (
+    DeviceContext,
+    create_prior_pdf,
+    create_simulate_function,
+    create_transition_pdf,
+    create_weight_function,
+    evaluate_proposal,
+    generate_valid_proposal,
+)
+
+__all__ = [
+    "ABCSMC", "GenerationSpec", "DeviceContext",
+    "create_simulate_function", "generate_valid_proposal",
+    "evaluate_proposal", "create_prior_pdf", "create_transition_pdf",
+    "create_weight_function",
+]

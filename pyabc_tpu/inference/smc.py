@@ -1,0 +1,587 @@
+"""ABCSMC — the inference engine (orchestrator).
+
+Reference parity: ``pyabc/smc.py::ABCSMC`` (pre-0.12) /
+``pyabc/inference/smc.py::ABCSMC`` (0.12+): full SMC loop with component
+lifecycle (initialize/update of distance, epsilon, acceptor, transitions,
+population strategy), calibration generation, stopping rules
+(minimum_epsilon, max_nr_populations, min_acceptance_rate,
+max_total_nr_simulations, max_walltime, stop_if_only_single_model_alive),
+db persistence every generation, and resume via ``load``.
+
+TPU-first: when every piece is traceable (JaxModel models, jax-native
+priors, device-compatible distance/acceptor/transitions), the per-generation
+work is dispatched to `BatchedSampler` as one fused XLA round kernel
+(`DeviceContext`); otherwise the reference's scalar closure path runs on the
+host. Both paths share this loop — adaptation stays central and host-side,
+exactly where the reference centralizes it (SURVEY.md §3.2, §7.1).
+"""
+from __future__ import annotations
+
+import datetime
+import json
+import logging
+import time
+from typing import Callable, Sequence
+
+import numpy as np
+
+from ..acceptor import Acceptor, SimpleFunctionAcceptor, StochasticAcceptor, UniformAcceptor
+from ..core.population import Population
+from ..core.random import generation_key, root_key
+from ..core.random_variables import Distribution
+from ..core.sumstat_spec import SumStatSpec
+from ..distance import Distance, PNormDistance, StochasticKernel, to_distance
+from ..epsilon import Epsilon, MedianEpsilon, NoEpsilon
+from ..model import JaxModel, Model, assert_models
+from ..populationstrategy import ConstantPopulationSize, PopulationStrategy
+from ..sampler.base import Sampler
+from ..sampler.batched import BatchedSampler
+from ..sampler.singlecore import SingleCoreSampler
+from ..storage.history import History
+from ..transition import (
+    ModelPerturbationKernel,
+    MultivariateNormalTransition,
+    NotEnoughParticles,
+    Transition,
+)
+from .util import DeviceContext, create_simulate_function
+
+logger = logging.getLogger("ABC")
+
+
+class GenerationSpec:
+    """The unit handed to samplers: scalar closure + device kernel context."""
+
+    def __init__(self, *, t, host_simulate_one=None, device=None, mode=None,
+                 dyn=None, gen_key=None):
+        self.t = t
+        self.host_simulate_one = host_simulate_one
+        self.device = device
+        self.mode = mode
+        self.dyn = dyn
+        self.gen_key = gen_key
+
+    def __call__(self):
+        return self.host_simulate_one()
+
+
+class ABCSMC:
+    """ABC-SMC with multi-model selection and adaptive components."""
+
+    def __init__(self, models, parameter_priors,
+                 distance_function: Distance | Callable | None = None,
+                 population_size: int | PopulationStrategy = 100,
+                 summary_statistics: Callable | None = None,
+                 model_prior=None,
+                 model_perturbation_kernel: ModelPerturbationKernel | None = None,
+                 transitions: Sequence[Transition] | Transition | None = None,
+                 eps: Epsilon | None = None,
+                 sampler: Sampler | None = None,
+                 acceptor: Acceptor | Callable | None = None,
+                 stop_if_only_single_model_alive: bool = False,
+                 max_nr_recorded_particles: float = np.inf,
+                 seed: int = 0,
+                 mesh=None):
+        self.models: list[Model] = assert_models(models)
+        if isinstance(parameter_priors, Distribution):
+            parameter_priors = [parameter_priors]
+        self.parameter_priors: list[Distribution] = list(parameter_priors)
+        if len(self.models) != len(self.parameter_priors):
+            raise ValueError("need one prior per model")
+        self.K = len(self.models)
+
+        self.distance_function = to_distance(
+            distance_function if distance_function is not None
+            else PNormDistance(p=2)
+        )
+        self.eps = eps if eps is not None else MedianEpsilon()
+        self.acceptor = SimpleFunctionAcceptor.assert_acceptor(
+            acceptor if acceptor is not None else UniformAcceptor()
+        )
+        # reference sanity pairing: stochastic acceptance needs a kernel
+        # distance and a temperature epsilon (reference ABCSMC sanity checks)
+        if isinstance(self.acceptor, StochasticAcceptor):
+            if not isinstance(self.distance_function, StochasticKernel):
+                raise ValueError(
+                    "StochasticAcceptor requires a StochasticKernel distance"
+                )
+            from ..epsilon import Temperature
+
+            if not isinstance(self.eps, Temperature):
+                raise ValueError(
+                    "StochasticAcceptor requires a Temperature epsilon "
+                    "(a distance-quantile epsilon would yield a negative "
+                    "'temperature' and invert acceptance)"
+                )
+        if isinstance(population_size, PopulationStrategy):
+            self.population_strategy = population_size
+        else:
+            self.population_strategy = ConstantPopulationSize(
+                int(population_size)
+            )
+        self.summary_statistics = summary_statistics
+        # model prior: probabilities over model indices (uniform default)
+        if model_prior is None:
+            self.model_prior_probs = np.full(self.K, 1.0 / self.K)
+        else:
+            self.model_prior_probs = np.asarray(model_prior, np.float64)
+            self.model_prior_probs /= self.model_prior_probs.sum()
+        self.model_perturbation_kernel = (
+            model_perturbation_kernel
+            if model_perturbation_kernel is not None
+            else ModelPerturbationKernel(self.K, probability_to_stay=0.7)
+        )
+        if transitions is None:
+            transitions = [MultivariateNormalTransition() for _ in range(self.K)]
+        if isinstance(transitions, Transition):
+            transitions = [transitions]
+        self.transitions: list[Transition] = list(transitions)
+        self.stop_if_only_single_model_alive = stop_if_only_single_model_alive
+        self.max_nr_recorded_particles = max_nr_recorded_particles
+        self.seed = seed
+        self.mesh = mesh
+        self._root_key = root_key(seed)
+
+        self._device_capable = self._check_device_capable()
+        if sampler is None:
+            sampler = (
+                BatchedSampler() if self._device_capable
+                else SingleCoreSampler()
+            )
+        self.sampler = sampler
+        self.sampler.sample_factory.max_nr_rejected = max_nr_recorded_particles
+
+        # run state
+        self.history: History | None = None
+        self.x_0: dict | None = None
+        self.spec: SumStatSpec | None = None
+        self._device_ctx: DeviceContext | None = None
+        self._model_probs: dict[int, float] = {}
+        self.minimum_epsilon = 0.0
+        self.max_nr_populations = np.inf
+        self.min_acceptance_rate = 0.0
+        self.max_total_nr_simulations = np.inf
+        self.max_walltime = None
+
+    # ------------------------------------------------------------- plumbing
+    def _check_device_capable(self) -> bool:
+        if not all(isinstance(m, JaxModel) for m in self.models):
+            return False
+        if not all(p.traceable for p in self.parameter_priors):
+            return False
+        if not self.distance_function.is_device_compatible():
+            return False
+        if not all(t.is_device_compatible() for t in self.transitions):
+            return False
+        # acceptor: uniform/stochastic have device forms; plain callables not
+        try:
+            compat = self.acceptor.is_device_compatible()
+        except Exception:
+            compat = False
+        # StochasticAcceptor only knows after initialize(); optimistic here
+        if isinstance(self.acceptor, StochasticAcceptor):
+            compat = self.distance_function.is_device_compatible()
+        return bool(compat)
+
+    @property
+    def model_names(self) -> list[str]:
+        return [m.name for m in self.models]
+
+    # ------------------------------------------------------------ lifecycle
+    def new(self, db: str, observed_sum_stat: dict | None = None, *,
+            gt_model: int | None = None, gt_par: dict | None = None,
+            meta_info: dict | None = None) -> History:
+        """Open a new run in ``db``; store observed data (reference .new)."""
+        observed = {
+            k: np.asarray(v) for k, v in (observed_sum_stat or {}).items()
+        }
+        self.x_0 = observed
+        self.spec = SumStatSpec(observed) if observed else None
+        self.history = History(db)
+        options = dict(meta_info or {})
+        options["parameter_names"] = {
+            m: list(p.space.names)
+            for m, p in enumerate(self.parameter_priors)
+        }
+        self.history.store_initial_data(
+            gt_model, options, observed, gt_par or {}, self.model_names,
+            json.dumps(self.distance_function.get_config()),
+            json.dumps(self.eps.get_config()),
+            json.dumps(self.population_strategy.get_config()),
+        )
+        return self.history
+
+    def load(self, db: str, abc_id: int, observed_sum_stat: dict | None = None
+             ) -> History:
+        """Resume a stored run (reference .load): continue at max_t + 1."""
+        self.history = History(db, abc_id)
+        observed = observed_sum_stat or self.history.get_observed_sum_stat()
+        self.x_0 = {k: np.asarray(v) for k, v in observed.items()}
+        self.spec = SumStatSpec(self.x_0)
+        return self.history
+
+    # ------------------------------------------------------------ internals
+    def _build_device_ctx(self) -> DeviceContext | None:
+        if not self._device_capable or self.spec is None:
+            return None
+        if self._device_ctx is None:
+            with np.errstate(divide="ignore"):
+                logits = np.log(self.model_prior_probs)
+            self._device_ctx = DeviceContext(
+                models=self.models,
+                parameter_priors=self.parameter_priors,
+                model_prior_logits=logits,
+                distance=self.distance_function,
+                acceptor=self.acceptor,
+                spec=self.spec,
+                x_0_flat=np.asarray(self.spec.flatten(self.x_0)),
+                transition_classes=[type(tr) for tr in self.transitions],
+                mesh=self.mesh,
+            )
+        return self._device_ctx
+
+    def _model_prior_rvs(self) -> int:
+        return int(np.random.choice(self.K, p=self.model_prior_probs))
+
+    def _model_prior_pmf(self, m: int) -> float:
+        return float(self.model_prior_probs[m])
+
+    def _generation_spec(self, t: int, *, calibration: bool = False
+                         ) -> GenerationSpec:
+        gen_key = generation_key(self._root_key, -1 if calibration else t)
+        device = self._build_device_ctx()
+        mode = dyn = None
+        if device is not None:
+            if calibration:
+                mode, dyn = "calibration", {}
+            else:
+                mode, dyn = device.build_dyn_args(
+                    t=t,
+                    eps_value=self.eps(t),
+                    model_probabilities=self._model_probs if t > 0 else None,
+                    transitions=self.transitions if t > 0 else None,
+                    model_perturbation_kernel=self.model_perturbation_kernel,
+                )
+        host = create_simulate_function(
+            0 if calibration else t,
+            model_probabilities=self._model_probs,
+            model_perturbation_kernel=self.model_perturbation_kernel,
+            transitions=self.transitions,
+            model_prior_rvs=self._model_prior_rvs,
+            model_prior_pmf=self._model_prior_pmf,
+            parameter_priors=self.parameter_priors,
+            models=self.models,
+            summary_statistics=self.summary_statistics,
+            x_0=self.x_0,
+            distance_function=self.distance_function,
+            eps=self.eps,
+            acceptor=self.acceptor,
+            evaluate=not calibration,
+        )
+        return GenerationSpec(
+            t=t, host_simulate_one=host, device=device, mode=mode, dyn=dyn,
+            gen_key=gen_key,
+        )
+
+    def _spaces(self):
+        return [p.space for p in self.parameter_priors]
+
+    def _sample_to_population(self, sample) -> Population:
+        """Normalize a Sample (device arrays or host particle list) to a
+        Population."""
+        if sample.ms is not None:
+            return Population(
+                ms=sample.ms, thetas=sample.thetas, weights=sample.weights,
+                distances=sample.distances, sumstats=sample.sumstats,
+                spaces=self._spaces(), sumstat_spec=self.spec,
+                model_names=self.model_names,
+                proposal_ids=sample.proposal_ids,
+            )
+        particles = sample.accepted_particles
+        pop = Population.from_particles(
+            particles, self._spaces(), self.spec, self.model_names
+        )
+        pop.proposal_ids = getattr(sample, "accepted_proposal_ids", None)
+        return pop
+
+    def _all_sumstats_provider(self, sample) -> Callable:
+        """() -> (n, S) matrix of all recorded sum stats for adaptive comps."""
+        def provider():
+            if sample.all_sumstats is not None:
+                return sample.all_sumstats
+            if getattr(sample, "host_all_records", None) is not None:
+                ss_dicts, _, _ = sample.host_all_records
+                return np.stack(
+                    [np.asarray(self.spec.flatten(s)) for s in ss_dicts]
+                )
+            if sample.sumstats is not None:
+                return sample.sumstats
+            return np.stack([
+                np.asarray(self.spec.flatten(p.sum_stat))
+                for p in sample.accepted_particles
+            ])
+        return provider
+
+    def _fit_transitions(self, pop: Population) -> None:
+        for m in pop.get_alive_models():
+            df, w = pop.get_distribution(m)
+            try:
+                self.transitions[m].fit(df, w)
+            except NotEnoughParticles:
+                logger.warning(
+                    "not enough particles to fit transition for model %d", m
+                )
+
+    def _recompute_distances(self, pop: Population, t: int) -> None:
+        """After a distance change, recompute accepted distances for the
+        epsilon update (reference semantics: history keeps the old values)."""
+        new_d = np.empty(len(pop))
+        x0 = self.x_0
+        for i in range(len(pop)):
+            stats = self.spec.unflatten(pop.sumstats[i])
+            new_d[i] = self.distance_function(stats, x0, t)
+        pop.distances = new_d
+
+    def _acceptor_config(self, t: int) -> dict:
+        return self.acceptor.get_epsilon_config(t)
+
+    # ------------------------------------------------------------------ run
+    def run(self, minimum_epsilon: float = 0.0,
+            max_nr_populations: float = np.inf,
+            min_acceptance_rate: float = 0.0,
+            max_total_nr_simulations: float = np.inf,
+            max_walltime: datetime.timedelta | float | None = None) -> History:
+        if self.history is None:
+            raise RuntimeError("call .new(db, observed) or .load(db, id) first")
+        self.minimum_epsilon = minimum_epsilon
+        start_walltime = time.time()
+        if isinstance(max_walltime, datetime.timedelta):
+            max_walltime = max_walltime.total_seconds()
+
+        t0 = self.history.max_t + 1
+        if t0 == 0:
+            self._initialize_components(max_nr_populations)
+        else:
+            self._restore_state(t0 - 1, max_nr_populations)
+
+        self.distance_function.configure_sampler(self.sampler)
+        self.eps.configure_sampler(self.sampler)
+
+        t = t0
+        sims_total = self.history.total_nr_simulations
+        distance_changed_at_t = False
+        while True:
+            current_eps = self.eps(t)
+            if hasattr(self.acceptor, "note_epsilon"):
+                # complete-history acceptance needs the threshold trail
+                self.acceptor.note_epsilon(t, current_eps,
+                                           distance_changed_at_t)
+
+            n_t = self.population_strategy(t)
+            max_eval = (
+                n_t / min_acceptance_rate
+                if min_acceptance_rate > 0 else np.inf
+            )
+            logger.info("t: %d, eps: %.8g", t, current_eps)
+            gen_spec = self._generation_spec(t)
+            sample = self.sampler.sample_until_n_accepted(
+                n_t, gen_spec, t, max_eval=max_eval
+            )
+            n_acc = sample.n_accepted if sample.ms is not None else len(
+                sample.accepted_particles
+            )
+            if n_acc < n_t:
+                logger.info(
+                    "stopping: only %d/%d accepted within budget", n_acc, n_t
+                )
+                break
+            pop = self._sample_to_population(sample)
+            nr_evals = self.sampler.nr_evaluations_
+            sims_total += nr_evals
+            acceptance_rate = n_t / nr_evals
+            self.history.append_population(
+                t, current_eps, pop, nr_evals, self.model_names
+            )
+            logger.info(
+                "acceptance rate: %.5f (%d evaluations)", acceptance_rate,
+                nr_evals,
+            )
+            self._model_probs = {
+                m: float(pop.model_probabilities_array()[m])
+                for m in pop.get_alive_models()
+            }
+
+            # central adaptation (reference §3.2 ADAPTATION block)
+            self._fit_transitions(pop)
+            all_ss = self._all_sumstats_provider(sample)
+            changed = self.distance_function.update(t + 1, all_ss)
+            distance_changed_at_t = bool(changed)
+            if changed:
+                self._recompute_distances(pop, t + 1)
+            get_wd = lambda: pop.get_weighted_distances()  # noqa: E731
+            self.acceptor.update(
+                t + 1, get_weighted_distances=get_wd,
+                prev_temp=current_eps, acceptance_rate=acceptance_rate,
+            )
+            try:
+                self.eps.update(
+                    t + 1, get_weighted_distances=get_wd,
+                    get_all_records=all_ss,
+                    acceptance_rate=acceptance_rate,
+                    acceptor_config=self._acceptor_config(t + 1),
+                )
+            except TypeError:
+                self.eps.update(t + 1, get_wd)
+            self.population_strategy.update(
+                [self.transitions[m] for m in pop.get_alive_models()],
+                np.asarray(
+                    [self._model_probs[m] for m in pop.get_alive_models()]
+                ),
+                t,
+            )
+
+            # stopping rules (reference §3.2)
+            if current_eps <= minimum_epsilon:
+                logger.info("stopping: eps=%.8g <= minimum_epsilon", current_eps)
+                break
+            if t + 1 >= max_nr_populations:
+                logger.info("stopping: max_nr_populations reached")
+                break
+            if acceptance_rate < min_acceptance_rate:
+                logger.info("stopping: acceptance rate below minimum")
+                break
+            if sims_total >= max_total_nr_simulations:
+                logger.info("stopping: max_total_nr_simulations reached")
+                break
+            if (max_walltime is not None
+                    and time.time() - start_walltime > max_walltime):
+                logger.info("stopping: max_walltime reached")
+                break
+            if (self.stop_if_only_single_model_alive
+                    and len(self._model_probs) == 1 and self.K > 1):
+                logger.info("stopping: single model alive")
+                break
+            t += 1
+        self.history.done()
+        return self.history
+
+    # -------------------------------------------------------- initialization
+    def _initialize_components(self, max_nr_populations) -> None:
+        """Calibration generation + initialize(t=0) of all components
+        (reference ABCSMC._initialize_dist_eps_acc)."""
+        needs_calibration = (
+            self.distance_function.requires_calibration()
+            or self.eps.requires_calibration()
+            or self.acceptor.requires_calibration()
+        )
+        calib_sample = None
+        calib_distances = None
+        if needs_calibration:
+            n_calib = (
+                self.population_strategy.nr_calibration_particles
+                or self.population_strategy(0)
+            )
+            gen_spec = self._generation_spec(0, calibration=True)
+            calib_sample = self.sampler.sample_until_n_accepted(
+                n_calib, gen_spec, -1, all_accepted=True
+            )
+            all_ss = self._all_sumstats_provider(calib_sample)
+            self.distance_function.initialize(0, all_ss, self.x_0)
+            # distances under the (possibly just-calibrated) distance
+            ss_mat = all_ss()
+            calib_distances = np.asarray([
+                self.distance_function(
+                    self.spec.unflatten(ss_mat[i]), self.x_0, 0
+                )
+                for i in range(ss_mat.shape[0])
+            ])
+        else:
+            self.distance_function.initialize(0, None, self.x_0)
+
+        import pandas as pd
+
+        def get_wd():
+            if calib_distances is None:
+                raise RuntimeError("epsilon needs a calibration sample")
+            return pd.DataFrame({
+                "distance": calib_distances,
+                "w": np.full(len(calib_distances), 1.0 / len(calib_distances)),
+            })
+
+        self.acceptor.initialize(
+            0,
+            get_weighted_distances=get_wd if calib_distances is not None else None,
+            distance_function=self.distance_function,
+            x_0=self.x_0,
+        )
+        try:
+            self.eps.initialize(
+                0,
+                get_weighted_distances=(
+                    get_wd if calib_distances is not None else None
+                ),
+                max_nr_populations=(
+                    int(max_nr_populations)
+                    if np.isfinite(max_nr_populations) else None
+                ),
+                acceptor_config=self._acceptor_config(0),
+            )
+        except TypeError:
+            self.eps.initialize(0, get_wd if calib_distances is not None else None)
+
+    def _restore_state(self, t_last: int,
+                       max_nr_populations: float = np.inf) -> None:
+        """Rebuild model probs + transitions from the stored last population
+        (reference resume caveat §5.4: adaptive internal state is
+        reconstructed, not serialized)."""
+        probs_df = self.history.get_model_probabilities(t_last)
+        self._model_probs = {
+            int(m): float(p) for m, p in probs_df["p"].items() if p > 0
+        }
+        # re-initialize distance/acceptor from the stored population's
+        # sum stats (adaptive internal state is reconstructed, not serialized)
+        _, stats = self.history.get_weighted_sum_stats(t_last)
+        self.distance_function.initialize(
+            t_last + 1, (lambda: stats), self.x_0
+        )
+        wd0 = self.history.get_weighted_distances(t_last)
+        try:
+            self.acceptor.initialize(
+                t_last + 1, get_weighted_distances=lambda: wd0,
+                distance_function=self.distance_function, x_0=self.x_0,
+            )
+        except TypeError:
+            pass
+        for m in self._model_probs:
+            df, w = self.history.get_distribution(m, t_last)
+            df = df[[c for c in df.columns if c != "pid"]]
+            try:
+                self.transitions[m].fit(df, w)
+            except NotEnoughParticles:
+                pass
+        # re-seed epsilon from the stored population's distances, RECOMPUTED
+        # under the just-re-initialized distance — stored values were computed
+        # with the previous weighting and would mis-scale the threshold
+        import pandas as pd
+
+        wd = self.history.get_weighted_distances(t_last)
+        ws, stats_mat = self.history.get_weighted_sum_stats(t_last)
+        new_d = np.asarray([
+            self.distance_function(
+                self.spec.unflatten(stats_mat[i]), self.x_0, t_last + 1
+            )
+            for i in range(stats_mat.shape[0])
+        ])
+        wd = pd.DataFrame({"distance": new_d, "w": ws / ws.sum()})
+        try:
+            self.eps.initialize(
+                t_last + 1,
+                get_weighted_distances=lambda: wd,
+                max_nr_populations=(
+                    int(max_nr_populations)
+                    if np.isfinite(max_nr_populations) else None
+                ),
+                acceptor_config=self._acceptor_config(t_last + 1),
+            )
+        except (TypeError, ValueError):
+            pass

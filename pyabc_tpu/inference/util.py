@@ -1,0 +1,504 @@
+"""Inference utilities: proposal, evaluation, weighting.
+
+Reference parity: ``pyabc/inference_util.py::{create_simulate_function,
+generate_valid_proposal, evaluate_proposal, create_prior_pdf,
+create_transition_pdf, create_weight_function}``.
+
+Two implementations of the same math live here:
+
+1. **Host path** (`create_simulate_function`): a faithful scalar closure,
+   exactly the reference's unit of distribution. It serves arbitrary Python
+   models (SimpleModel, ScipyRV priors) and doubles as the *oracle* that the
+   batched device kernel is property-tested against (SURVEY.md §7.3.5).
+
+2. **Device path** (`DeviceContext`): the TPU inversion — one jitted XLA
+   round kernel evaluates a whole batch of lanes: ancestor draw, model
+   perturbation, transition perturbation (with in-kernel redraws-until-
+   valid), simulation (`lax.switch` over models), distance, acceptance and
+   the FULL importance weight, all fused. Per-generation state (epsilon,
+   adaptive distance weights, fitted transitions, model probabilities) is
+   passed as padded array arguments, so a whole ABC run compiles O(few)
+   programs, not O(generations).
+
+Importance weight (the SMC core, §3.5):
+    w(theta, m) = model_prior(m) * prior_m(theta) * acc_weight
+                  / ( [sum_anc p_{t-1}(anc) MPK(m|anc)] * K_m(theta) )
+with K_m the transition density fitted on model-m particles of gen t-1.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.parameters import Parameter
+from ..core.population import Particle
+from ..core.random import round_key
+from ..core.sumstat_spec import SumStatSpec
+from ..model import JaxModel, Model
+
+
+# ===========================================================================
+# Host (scalar, reference-faithful) path
+# ===========================================================================
+
+def create_prior_pdf(model_prior_pmf, parameter_priors):
+    def prior_pdf(m, theta):
+        return model_prior_pmf(m) * parameter_priors[m].pdf(theta)
+
+    return prior_pdf
+
+
+def create_transition_pdf(transitions, model_probabilities,
+                          model_perturbation_kernel):
+    """Joint proposal density of (m, theta) (reference create_transition_pdf)."""
+
+    def transition_pdf(m, theta):
+        model_factor = sum(
+            p * model_perturbation_kernel.pmf(m, anc)
+            for anc, p in model_probabilities.items()
+        )
+        import pandas as pd
+
+        particle_factor = transitions[m].pdf(
+            pd.Series(dict(theta))
+        )
+        return model_factor * float(particle_factor)
+
+    return transition_pdf
+
+
+def generate_valid_proposal(t, model_probabilities, model_perturbation_kernel,
+                            transitions, model_prior_rvs, parameter_priors,
+                            nr_samples_per_parameter: int = 1,
+                            max_retries: int = 10000):
+    """Draw (m, theta) with positive prior mass (reference
+    generate_valid_proposal): ancestor model ~ p_{t-1}, perturb model, perturb
+    parameters, retry until prior > 0."""
+    if t == 0:
+        m = model_prior_rvs()
+        theta = parameter_priors[m].rvs(_np_key())
+        return m, theta
+    ms = np.asarray(list(model_probabilities.keys()))
+    ps = np.asarray(list(model_probabilities.values()), np.float64)
+    ps = ps / ps.sum()
+    for _ in range(max_retries):
+        m_anc = int(np.random.choice(ms, p=ps))
+        m = model_perturbation_kernel.rvs(m_anc)
+        if transitions[m].X is None:
+            continue  # never-fitted model cannot propose
+        theta_ser = transitions[m].rvs_single()
+        theta = Parameter(dict(theta_ser))
+        if parameter_priors[m].pdf(theta) > 0:
+            return m, theta
+    raise RuntimeError("could not generate a valid proposal")
+
+
+def _np_key():
+    return jax.random.key(np.random.randint(0, 2**31 - 1))
+
+
+def evaluate_proposal(m, theta, t, models, summary_statistics, distance_function,
+                      eps, acceptor, x_0):
+    """Simulate and accept-test one proposal (reference evaluate_proposal)."""
+    model_result = models[m].accept(
+        t, theta, summary_statistics, distance_function, eps, acceptor, x_0
+    )
+    return model_result
+
+
+def create_weight_function(prior_pdf, transition_pdf,
+                           nr_samples_per_parameter: int = 1):
+    """w = prior * acc_weight / proposal (reference create_weight_function)."""
+
+    def weight_function(m, theta, t, acceptance_weight: float):
+        if t == 0:
+            return float(acceptance_weight)
+        fraction = prior_pdf(m, theta) / transition_pdf(m, theta)
+        return float(acceptance_weight * fraction)
+
+    return weight_function
+
+
+def create_simulate_function(t, *, model_probabilities,
+                             model_perturbation_kernel, transitions,
+                             model_prior_rvs, model_prior_pmf,
+                             parameter_priors, models,
+                             summary_statistics, x_0, distance_function,
+                             eps, acceptor,
+                             evaluate: bool = True) -> Callable[[], Particle]:
+    """The reference's unit of distribution: a closure producing one Particle.
+
+    With ``evaluate=False`` the particle is returned all-accepted without the
+    accept test (calibration population, reference
+    ``only_simulate_data_for_proposal``).
+    """
+    prior_pdf = create_prior_pdf(model_prior_pmf, parameter_priors)
+    transition_pdf = (
+        create_transition_pdf(transitions, model_probabilities,
+                              model_perturbation_kernel)
+        if t > 0
+        else None
+    )
+
+    def weight_function(m, theta, acceptance_weight):
+        if t == 0 or transition_pdf is None:
+            return float(acceptance_weight)
+        return float(
+            acceptance_weight * prior_pdf(m, theta) / transition_pdf(m, theta)
+        )
+
+    def simulate_one() -> Particle:
+        m, theta = generate_valid_proposal(
+            t, model_probabilities, model_perturbation_kernel, transitions,
+            model_prior_rvs, parameter_priors,
+        )
+        if evaluate:
+            result = evaluate_proposal(
+                m, theta, t, models, summary_statistics, distance_function,
+                eps, acceptor, x_0,
+            )
+            accepted = bool(result.accepted)
+            weight = (
+                weight_function(m, theta, result.weight) if accepted else 0.0
+            )
+            return Particle(
+                m=m, parameter=theta, weight=weight,
+                sum_stat=result.sum_stat, distance=float(result.distance),
+                accepted=accepted,
+            )
+        res = models[m].summary_statistics(t, theta, summary_statistics)
+        d = distance_function(res.sum_stat, x_0, t, theta)
+        return Particle(
+            m=m, parameter=theta, weight=weight_function(m, theta, 1.0),
+            sum_stat=res.sum_stat, distance=float(d), accepted=True,
+        )
+
+    return simulate_one
+
+
+# ===========================================================================
+# Device (batched, jitted) path
+# ===========================================================================
+
+def _pow2_bucket(n: int, lo: int = 64) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+def pad_transition_params(params: dict, n_cap: int, d_max: int) -> dict:
+    """Zero-pad fitted transition params to static shapes.
+
+    Zero weights on padded ancestors mean they are never resampled and
+    contribute nothing to the mixture logpdf; zero-padded theta columns stay
+    exactly zero through chol @ noise, so padded dims never perturb.
+    """
+    out = {}
+    for k, v in params.items():
+        v = np.asarray(v)
+        if k == "thetas":
+            p = np.zeros((n_cap, d_max), v.dtype)
+            p[: v.shape[0], : v.shape[1]] = v
+        elif k == "weights":
+            p = np.zeros((n_cap,), v.dtype)
+            p[: v.shape[0]] = v
+        elif k in ("chol", "prec"):
+            p = np.zeros((d_max, d_max), v.dtype)
+            p[: v.shape[0], : v.shape[1]] = v
+        elif k in ("chols", "precs"):
+            p = np.zeros((n_cap, d_max, d_max), v.dtype)
+            p[: v.shape[0], : v.shape[1], : v.shape[2]] = v
+        elif k == "logdets":
+            # padded ancestors have weight 0; any finite logdet is inert
+            p = np.zeros((n_cap,), v.dtype)
+            p[: v.shape[0]] = v
+        elif k == "logdet":
+            p = v
+        else:
+            p = v
+        out[k] = jnp.asarray(p)
+    return out
+
+
+@dataclass
+class RoundResult:
+    """Host-side copy of one device round (B lanes)."""
+
+    ms: np.ndarray
+    thetas: np.ndarray
+    sumstats: np.ndarray
+    distances: np.ndarray
+    accepted: np.ndarray
+    valid: np.ndarray
+    log_weights: np.ndarray
+
+
+class DeviceContext:
+    """Builds & caches the jitted per-round generation kernels.
+
+    One instance lives for the whole ABC run; kernels are traced per
+    (batch_bucket, mode) where mode is 'prior' (generation 0 / calibration)
+    or 'transition' (later generations). All per-generation quantities are
+    array arguments.
+    """
+
+    N_REDRAWS = 4  # in-kernel proposal redraws against zero prior mass
+
+    def __init__(self, *, models: Sequence[JaxModel], parameter_priors,
+                 model_prior_logits, distance, acceptor, spec: SumStatSpec,
+                 x_0_flat, transition_classes=None, transition_cls=None,
+                 mesh=None):
+        self.models = list(models)
+        self.priors = list(parameter_priors)
+        self.K = len(self.models)
+        self.model_prior_logits = jnp.asarray(model_prior_logits, jnp.float32)
+        self.distance = distance
+        self.acceptor = acceptor
+        self.spec = spec
+        self.x0 = jnp.asarray(x_0_flat, jnp.float32)
+        if transition_classes is None:
+            if transition_cls is None:
+                raise ValueError("transition_classes required")
+            transition_classes = [transition_cls] * len(self.models)
+        #: per-model transition class: its static device_rvs/device_logpdf
+        #: are baked into that model's switch branch
+        self.transition_classes = list(transition_classes)
+        #: optional jax.sharding.Mesh with one axis: shard lanes over devices
+        #: (the ICI replacement for the reference's Redis counters/queues —
+        #: SURVEY.md §5.8; collectives are inserted by GSPMD)
+        self.mesh = mesh
+        self.d_max = max(m.space.dim for m in self.models)
+        self._kernels: dict = {}
+
+    # ------------------------------------------------------------------ build
+    def _lane_prior(self, key, dyn):
+        """One lane, generation 0: proposal from the prior."""
+        km, kt, ksim, kacc = jax.random.split(key, 4)
+        m = jax.random.categorical(km, self.model_prior_logits)
+        theta, ss = self._switch_sim_prior(m, kt, ksim)
+        d, accept, log_acc_w = self._accept_fn(
+            kacc, ss, dyn["eps"], dyn["dist_params"], dyn["acc_params"]
+        )
+        return dict(
+            m=m, theta=theta, sumstats=ss, distance=d,
+            accepted=accept, valid=jnp.asarray(True),
+            log_weight=log_acc_w,
+        )
+
+    def _lane_calibration(self, key, dyn):
+        """One lane, calibration: prior draw + simulate only (no accept test;
+        the distance may itself still need this sample to initialize)."""
+        km, kt, ksim = jax.random.split(key, 3)
+        m = jax.random.categorical(km, self.model_prior_logits)
+        theta, ss = self._switch_sim_prior(m, kt, ksim)
+        return dict(
+            m=m, theta=theta, sumstats=ss,
+            distance=jnp.zeros(()), accepted=jnp.asarray(True),
+            valid=jnp.asarray(True), log_weight=jnp.zeros(()),
+        )
+
+    def _switch_sim_prior(self, m, kt, ksim):
+        def make_branch(i):
+            model = self.models[i]
+            prior = self.priors[i]
+
+            def branch(kt, ksim):
+                theta = prior.rvs_array(kt)
+                ss = self.spec.flatten(model.sim(ksim, theta))
+                pad = self.d_max - theta.shape[0]
+                theta = jnp.pad(theta, (0, pad)) if pad else theta
+                return theta, ss
+
+            return branch
+
+        branches = [make_branch(i) for i in range(self.K)]
+        if self.K == 1:
+            return branches[0](kt, ksim)
+        return jax.lax.switch(m, branches, kt, ksim)
+
+    def _lane_transition(self, key, dyn):
+        """One lane, generation t>0: ancestor -> MPK -> transition -> sim."""
+        km1, km2, kt, ksim, kacc = jax.random.split(key, 5)
+        # ancestor model from previous-generation probabilities
+        m_anc = jax.random.categorical(km1, dyn["log_model_probs"])
+        # model perturbation via the (host-masked) transition matrix
+        m = jax.random.categorical(km2, jnp.log(dyn["mpk_matrix"][m_anc] + 1e-38))
+        theta, logpri, logq, ss, valid = self._switch_propose_sim(
+            m, kt, ksim, dyn
+        )
+        d, accept, log_acc_w = self._accept_fn(
+            kacc, ss, dyn["eps"], dyn["dist_params"], dyn["acc_params"]
+        )
+        accept = accept & valid
+        # log w = log model_prior + log prior - log model_factor - log K_m + acc
+        log_w = (
+            self.model_prior_logits[m]
+            + logpri
+            + log_acc_w
+            - dyn["log_model_factor"][m]
+            - logq
+        )
+        return dict(
+            m=m, theta=theta, sumstats=ss, distance=d, accepted=accept,
+            valid=valid, log_weight=jnp.where(valid, log_w, -jnp.inf),
+        )
+
+    def _switch_propose_sim(self, m, kt, ksim, dyn):
+        def make_branch(i):
+            model = self.models[i]
+            prior = self.priors[i]
+            dim = model.space.dim
+            trans_cls = self.transition_classes[i]
+
+            def branch(kt, ksim, trans_params_all):
+                params = trans_params_all[i]
+                # redraw-until-valid, fixed unroll
+                keys = jax.random.split(kt, DeviceContext.N_REDRAWS)
+                theta = trans_cls.device_rvs(keys[0], params)[: self.d_max]
+                logpri = prior.logpdf_array(theta[:dim])
+                for r in range(1, DeviceContext.N_REDRAWS):
+                    redraw = trans_cls.device_rvs(keys[r], params)[: self.d_max]
+                    re_logpri = prior.logpdf_array(redraw[:dim])
+                    take_new = ~jnp.isfinite(logpri)
+                    theta = jnp.where(take_new, redraw, theta)
+                    logpri = jnp.where(take_new, re_logpri, logpri)
+                valid = jnp.isfinite(logpri)
+                logq = trans_cls.device_logpdf(theta, params)
+                theta_m = theta[:dim]
+                ss = self.spec.flatten(model.sim(ksim, theta_m))
+                pad = self.d_max - dim
+                theta_out = jnp.pad(theta_m, (0, pad)) if pad else theta_m
+                return theta_out, logpri, logq, ss, valid
+
+            return branch
+
+        branches = [make_branch(i) for i in range(self.K)]
+        if self.K == 1:
+            return branches[0](kt, ksim, dyn["trans_params"])
+        return jax.lax.switch(m, branches, kt, ksim, dyn["trans_params"])
+
+    def _accept_fn(self, key, ss, eps, dist_params, acc_params):
+        acc_dev = self.acceptor.device_fn(self.distance.device_fn(self.spec))
+        return acc_dev(key, ss, self.x0, eps, dist_params, acc_params)
+
+    def round_kernel(self, B: int, mode: str):
+        """The jitted round function for batch size B ('prior'/'transition')."""
+        cache_key = (B, mode)
+        if cache_key in self._kernels:
+            return self._kernels[cache_key]
+
+        lane = {
+            "prior": self._lane_prior,
+            "transition": self._lane_transition,
+            "calibration": self._lane_calibration,
+        }[mode]
+
+        if self.mesh is None:
+            def round_fn(key, dyn):
+                keys = jax.random.split(key, B)
+                return jax.vmap(lambda k: lane(k, dyn))(keys)
+
+            fn = jax.jit(round_fn)
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            axis = self.mesh.axis_names[0]
+            lane_sharding = NamedSharding(self.mesh, P(axis))
+
+            def round_fn(key, dyn):
+                keys = jax.random.split(key, B)
+                keys = jax.lax.with_sharding_constraint(keys, lane_sharding)
+                out = jax.vmap(lambda k: lane(k, dyn))(keys)
+                return jax.tree.map(
+                    lambda x: jax.lax.with_sharding_constraint(
+                        x, lane_sharding
+                    ),
+                    out,
+                )
+
+            fn = jax.jit(round_fn)
+        self._kernels[cache_key] = fn
+        return fn
+
+    # ------------------------------------------------------------- dispatch
+    def run_round(self, key, B: int, mode: str, dyn: dict) -> RoundResult:
+        out = self.round_kernel(B, mode)(key, dyn)
+        out = jax.device_get(out)
+        return RoundResult(
+            ms=np.asarray(out["m"], np.int32),
+            thetas=np.asarray(out["theta"], np.float64),
+            sumstats=np.asarray(out["sumstats"], np.float64),
+            distances=np.asarray(out["distance"], np.float64),
+            accepted=np.asarray(out["accepted"], bool),
+            valid=np.asarray(out["valid"], bool),
+            log_weights=np.asarray(out["log_weight"], np.float64),
+        )
+
+    # ---------------------------------------------------- per-generation args
+    def build_dyn_args(self, *, t: int, eps_value: float,
+                       model_probabilities: dict | None = None,
+                       transitions: Sequence | None = None,
+                       model_perturbation_kernel=None) -> tuple[str, dict]:
+        """(mode, dynamic-args pytree) for generation t."""
+        dist_params = self.distance.device_params(t)
+        acc_params = self.acceptor.device_params(t)
+        dyn = {
+            "eps": jnp.asarray(eps_value, jnp.float32),
+            "dist_params": dist_params,
+            "acc_params": acc_params,
+        }
+        if t == 0 or transitions is None:
+            return "prior", dyn
+
+        probs = np.zeros(self.K)
+        for m, p in model_probabilities.items():
+            probs[int(m)] = p
+        fitted = np.asarray(
+            [tr.X is not None for tr in transitions], bool
+        )
+        matrix = np.asarray(
+            jax.device_get(model_perturbation_kernel.device_params()),
+            np.float64,
+        )
+        # never-fitted models cannot propose: mask & renormalize rows
+        matrix = matrix * fitted[None, :]
+        row_sums = matrix.sum(axis=1, keepdims=True)
+        matrix = np.where(row_sums > 0, matrix / np.where(row_sums > 0,
+                                                          row_sums, 1.0), 0.0)
+        # log model_factor[m] = log sum_anc p(anc) matrix[anc, m]
+        model_factor = probs @ matrix
+        with np.errstate(divide="ignore"):
+            log_model_factor = np.log(model_factor)
+            log_model_probs = np.log(probs)
+
+        n_cap = _pow2_bucket(
+            max(len(tr.X) for tr in transitions if tr.X is not None)
+        )
+        trans_params = []
+        for tr in transitions:
+            if tr.X is not None:
+                raw = jax.tree.map(np.asarray, tr.device_params())
+            else:
+                # placeholder params; masked out of the MPK matrix above
+                ref = next(x for x in transitions if x.X is not None)
+                raw = jax.tree.map(
+                    lambda v: np.zeros_like(np.asarray(v)),
+                    ref.device_params(),
+                )
+            trans_params.append(
+                pad_transition_params(raw, n_cap, self.d_max)
+            )
+
+        dyn.update(
+            log_model_probs=jnp.asarray(log_model_probs, jnp.float32),
+            mpk_matrix=jnp.asarray(matrix, jnp.float32),
+            log_model_factor=jnp.asarray(log_model_factor, jnp.float32),
+            trans_params=tuple(trans_params),
+        )
+        return "transition", dyn

@@ -1,0 +1,182 @@
+"""Model abstraction: wraps the user simulator.
+
+Reference parity: ``pyabc/model.py::{Model, SimpleModel, ModelResult,
+IntegratedModel}``. The reference splits a forward evaluation into
+``sample -> summary_statistics -> distance -> accept`` so subclasses can
+short-circuit; that split is preserved here. The TPU-first addition is
+`JaxModel`: a simulator expressed as a traceable function
+``sim(key, theta: f32[dim]) -> {name: array}`` which the batched generation
+kernel vmaps and jit-compiles over whole proposal rounds (SURVEY.md §7.1).
+Host-only simulators (arbitrary Python) remain supported through `Model` /
+`SimpleModel` and run on the host path of the sampler.
+"""
+from __future__ import annotations
+
+from typing import Callable, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from .core.parameters import Parameter, ParameterSpace
+from .core.sumstat_spec import SumStatSpec
+
+
+class ModelResult:
+    """Result of a (partial) model evaluation (pyabc ModelResult).
+
+    Carries whichever of sum_stat / distance / accepted have been computed.
+    """
+
+    def __init__(self, sum_stat=None, distance=None, accepted=None, weight=1.0):
+        self.sum_stat = sum_stat if sum_stat is not None else {}
+        self.distance = distance
+        self.accepted = accepted
+        self.weight = weight
+
+
+class Model:
+    """Base model: subclass and override ``sample`` (pyabc Model).
+
+    ``sample(par) -> raw data``; ``summary_statistics`` defaults to passing
+    the raw data through (the reference treats data dicts as sum stats
+    unless a sumstat calculator intervenes).
+    """
+
+    def __init__(self, name: str = "model"):
+        self.name = name
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.name!r})"
+
+    def sample(self, pars: Parameter):
+        raise NotImplementedError
+
+    def summary_statistics(self, t, pars, sum_stat_calculator) -> ModelResult:
+        raw = self.sample(pars)
+        stats = sum_stat_calculator(raw) if sum_stat_calculator else raw
+        return ModelResult(sum_stat=stats)
+
+    def distance(self, t, pars, sum_stat_calculator, distance_calculator,
+                 x_0) -> ModelResult:
+        result = self.summary_statistics(t, pars, sum_stat_calculator)
+        result.distance = distance_calculator(result.sum_stat, x_0)
+        return result
+
+    def accept(self, t, pars, sum_stat_calculator, distance_calculator, eps,
+               acceptor, x_0) -> ModelResult:
+        result = self.summary_statistics(t, pars, sum_stat_calculator)
+        acc_res = acceptor(
+            distance_function=distance_calculator, eps=eps,
+            x=result.sum_stat, x_0=x_0, t=t, par=pars,
+        )
+        result.distance = acc_res.distance
+        result.accepted = bool(acc_res.accept)
+        result.weight = float(acc_res.weight)
+        return result
+
+
+class SimpleModel(Model):
+    """Wrap a plain function ``f(par_dict) -> sum_stat_dict`` (pyabc SimpleModel)."""
+
+    def __init__(self, sample_function: Callable, name: str | None = None):
+        super().__init__(name or getattr(sample_function, "__name__", "model"))
+        self.sample_function = sample_function
+
+    def sample(self, pars: Parameter):
+        return self.sample_function(pars)
+
+    @staticmethod
+    def assert_model(model) -> "Model":
+        """Coerce a callable into a SimpleModel (pyabc SimpleModel.assert_model)."""
+        if isinstance(model, Model):
+            return model
+        if callable(model):
+            return SimpleModel(model)
+        raise TypeError(f"cannot coerce {model!r} into a Model")
+
+
+class IntegratedModel(Model):
+    """Model that integrates the accept step into the simulation
+    (pyabc IntegratedModel): ``integrated_simulate`` may early-reject a
+    too-distant trajectory without finishing it. On TPU the analog is a
+    simulator that returns an explicit reject flag; the batched kernel honors
+    it as ``accepted=False`` for the lane.
+    """
+
+    def integrated_simulate(self, pars, eps) -> ModelResult:
+        raise NotImplementedError
+
+    def accept(self, t, pars, sum_stat_calculator, distance_calculator, eps,
+               acceptor, x_0) -> ModelResult:
+        result = self.integrated_simulate(pars, eps(t))
+        if result.accepted is None:
+            return super().accept(
+                t, pars, sum_stat_calculator, distance_calculator, eps,
+                acceptor, x_0,
+            )
+        return result
+
+
+class JaxModel(Model):
+    """A TPU-native model: traceable batched simulator.
+
+    ``sim(key, theta: f32[dim]) -> {name: jnp array}`` must be jittable with
+    static shapes. The generation kernel calls ``vmap(sim)`` over a whole
+    proposal round and fuses simulate/distance/accept into one XLA program —
+    the TPU inversion of the reference's per-particle pickled closure
+    (SURVEY.md §7.1).
+
+    Parameters
+    ----------
+    sim: the traceable simulator.
+    space: parameter name->column registry (order of theta entries).
+    name: model display name.
+    """
+
+    def __init__(self, sim: Callable, space: ParameterSpace | list[str],
+                 name: str = "jax_model"):
+        super().__init__(name)
+        if not isinstance(space, ParameterSpace):
+            space = ParameterSpace(space)
+        self.sim = sim
+        self.space = space
+        self._sumstat_spec: SumStatSpec | None = None
+        self._jitted_sim = None
+
+    def sumstat_spec(self, key=None) -> SumStatSpec:
+        """Infer the flat sum-stat layout by one example evaluation."""
+        if self._sumstat_spec is None:
+            import jax
+
+            key = key if key is not None else jax.random.key(0)
+            theta = jnp.zeros((self.space.dim,), jnp.float32)
+            example = jax.eval_shape(self.sim, key, theta)
+            self._sumstat_spec = SumStatSpec(
+                {k: np.zeros(v.shape, np.float32) for k, v in example.items()}
+            )
+        return self._sumstat_spec
+
+    def sample(self, pars: Parameter):
+        """Host-path escape hatch: single evaluation with a fresh key."""
+        import jax
+
+        if self._jitted_sim is None:
+            self._jitted_sim = jax.jit(self.sim)
+        key = jax.random.key(np.random.randint(0, 2**31 - 1))
+        theta = jnp.asarray(self.space.to_array(pars), jnp.float32)
+        out = self._jitted_sim(key, theta)
+        return {k: np.asarray(v) for k, v in out.items()}
+
+    @staticmethod
+    def from_function(space, name="jax_model"):
+        """Decorator form: ``@JaxModel.from_function(["a","b"])``."""
+        def wrap(fn):
+            return JaxModel(fn, space, name=name)
+        return wrap
+
+
+def assert_models(models) -> list[Model]:
+    """Coerce a model or list of models/callables into a list of Models."""
+    if not isinstance(models, (list, tuple)):
+        models = [models]
+    return [SimpleModel.assert_model(m) for m in models]

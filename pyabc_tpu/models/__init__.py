@@ -1,0 +1,9 @@
+"""Benchmark model library — the 5 BASELINE.md configs as traceable JaxModels."""
+from . import gaussian, gillespie, lotka_volterra, model_selection, sir
+from .ode import rk4_at_times, rk4_integrate, rk45_integrate
+from .gillespie import tau_leap
+
+__all__ = [
+    "gaussian", "lotka_volterra", "gillespie", "sir", "model_selection",
+    "rk4_integrate", "rk4_at_times", "rk45_integrate", "tau_leap",
+]

@@ -1,0 +1,56 @@
+"""Conjugate Gaussian toy benchmark (config 1, BASELINE.md).
+
+The correctness anchor: 2-parameter Gaussian with known conjugate posterior
+(reference analog: pyABC's quickstart example & posterior-estimation tests).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.random_variables import RV, Distribution
+from ..model import JaxModel
+
+PRIOR_MU_SD = 1.0
+PRIOR_SD = (0.2, 1.5)  # uniform band for sigma
+NOISE_N = 10  # iid observations per simulation
+
+
+def make_gaussian_model(n: int = NOISE_N, name: str = "gaussian") -> JaxModel:
+    """theta = (mu, sigma); returns mean/std of n iid N(mu, sigma) draws."""
+
+    def sim(key, theta):
+        mu, sigma = theta[0], jnp.abs(theta[1])
+        x = mu + sigma * jax.random.normal(key, (n,))
+        return {"mean": jnp.mean(x), "std": jnp.std(x)}
+
+    return JaxModel(sim, ["mu", "sigma"], name=name)
+
+
+def default_prior() -> Distribution:
+    return Distribution(
+        mu=RV("norm", 0.0, PRIOR_MU_SD),
+        sigma=RV("uniform", PRIOR_SD[0], PRIOR_SD[1] - PRIOR_SD[0]),
+    )
+
+
+def make_mean_only_model(noise_sd: float = 0.5, name: str = "gauss1d"
+                         ) -> JaxModel:
+    """1-parameter version with exact conjugate posterior
+    (x | theta ~ N(theta, noise_sd^2), theta ~ N(0,1))."""
+
+    def sim(key, theta):
+        return {"x": theta[0] + noise_sd * jax.random.normal(key)}
+
+    return JaxModel(sim, ["theta"], name=name)
+
+
+def mean_only_prior() -> Distribution:
+    return Distribution(theta=RV("norm", 0.0, 1.0))
+
+
+def conjugate_posterior(x_obs: float, noise_sd: float = 0.5,
+                        prior_sd: float = 1.0) -> tuple[float, float]:
+    var = 1.0 / (1.0 / prior_sd**2 + 1.0 / noise_sd**2)
+    return var * x_obs / noise_sd**2, float(np.sqrt(var))

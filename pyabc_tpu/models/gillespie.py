@@ -1,0 +1,129 @@
+"""Stochastic chemical kinetics via tau-leaping (config 3, BASELINE.md).
+
+Reference analog: the pyABC Gillespie/chemical-reaction example notebooks.
+Exact SSA has a data-dependent event count, which XLA cannot trace
+(SURVEY.md §7.3.3); the framework therefore ships **tau-leaping** with a
+fixed leap count — Poisson firing numbers per reaction channel per leap,
+statically shaped, vmap/jit-able. For stiff regions a midpoint tau-leap
+variant is provided.
+
+Generic engine + two canonical systems: birth-death and the
+Lotka-Volterra reaction network (stochastic LV).
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.random_variables import RV, Distribution
+from ..model import JaxModel
+
+
+def tau_leap(key, x0, stoich: jnp.ndarray, propensity_fn: Callable,
+             t1: float, n_leaps: int, save_every: int = 1,
+             midpoint: bool = False):
+    """Tau-leaping simulation of a reaction network.
+
+    Parameters
+    ----------
+    x0: (n_species,) initial counts (float; kept >= 0).
+    stoich: (n_reactions, n_species) stoichiometry matrix.
+    propensity_fn: (x, *)-> (n_reactions,) nonneg rates.
+    n_leaps: fixed number of tau leaps; tau = t1 / n_leaps.
+    midpoint: midpoint (2nd-order) tau-leap.
+
+    Returns (n_saved, n_species) trajectory of the post-leap states.
+    """
+    tau = t1 / n_leaps
+    stoich = jnp.asarray(stoich, jnp.float32)
+
+    def leap(carry, k):
+        x = carry
+        a = jnp.maximum(propensity_fn(x), 0.0)
+        if midpoint:
+            x_mid = jnp.maximum(x + 0.5 * tau * a @ stoich, 0.0)
+            a = jnp.maximum(propensity_fn(x_mid), 0.0)
+        n_fire = jax.random.poisson(k, a * tau).astype(jnp.float32)
+        x_new = jnp.maximum(x + n_fire @ stoich, 0.0)
+        return x_new, x_new
+
+    keys = jax.random.split(key, n_leaps)
+    _, traj = jax.lax.scan(leap, jnp.asarray(x0, jnp.float32), keys)
+    if save_every > 1:
+        traj = traj[save_every - 1 :: save_every]
+    return traj
+
+
+# --------------------------------------------------------------------------
+# canonical systems
+# --------------------------------------------------------------------------
+
+def make_birth_death_model(x0: float = 40.0, t1: float = 10.0,
+                           n_leaps: int = 200, n_obs: int = 20,
+                           name: str = "birth_death") -> JaxModel:
+    """Birth-death process: 0 ->(b) X, X ->(d) 0; theta = (log10 b, log10 d)."""
+    stoich = jnp.asarray([[1.0], [-1.0]])
+    save_every = n_leaps // n_obs
+
+    def sim(key, theta):
+        b, d = 10.0 ** theta[0], 10.0 ** theta[1]
+
+        def prop(x):
+            return jnp.stack([b, d * x[0]])
+
+        traj = tau_leap(key, jnp.asarray([x0]), stoich, prop, t1, n_leaps,
+                        save_every=save_every)
+        return {"x": traj[:, 0]}
+
+    return JaxModel(sim, ["log_b", "log_d"], name=name)
+
+
+def birth_death_prior() -> Distribution:
+    return Distribution(
+        log_b=RV("uniform", -1.0, 2.0),
+        log_d=RV("uniform", -2.0, 2.0),
+    )
+
+
+def make_stochastic_lv_model(t1: float = 15.0, n_leaps: int = 300,
+                             n_obs: int = 20,
+                             name: str = "stochastic_lv") -> JaxModel:
+    """Stochastic Lotka-Volterra reaction network (3 channels):
+    prey birth, predation, predator death; theta = log10 rates."""
+    stoich = jnp.asarray([
+        [1.0, 0.0],   # prey birth
+        [-1.0, 1.0],  # predation converts prey to predator
+        [0.0, -1.0],  # predator death
+    ])
+    save_every = n_leaps // n_obs
+
+    def sim(key, theta):
+        r1, r2, r3 = 10.0 ** theta[0], 10.0 ** theta[1], 10.0 ** theta[2]
+
+        def prop(x):
+            prey, pred = x[0], x[1]
+            return jnp.stack([r1 * prey, r2 * prey * pred, r3 * pred])
+
+        traj = tau_leap(key, jnp.asarray([50.0, 100.0]), stoich, prop, t1,
+                        n_leaps, save_every=save_every)
+        return {"prey": traj[:, 0], "pred": traj[:, 1]}
+
+    return JaxModel(sim, ["log_r1", "log_r2", "log_r3"], name=name)
+
+
+def stochastic_lv_prior() -> Distribution:
+    return Distribution(
+        log_r1=RV("uniform", -1.0, 1.5),
+        log_r2=RV("uniform", -3.0, 1.5),
+        log_r3=RV("uniform", -1.0, 1.5),
+    )
+
+
+def observed_birth_death(seed: int = 0, **kwargs) -> dict:
+    model = make_birth_death_model(**kwargs)
+    theta = jnp.asarray([1.0, -0.5])  # b=10, d=0.32
+    out = model.sim(jax.random.key(seed), theta)
+    return {k: np.asarray(v) for k, v in out.items()}

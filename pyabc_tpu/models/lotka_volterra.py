@@ -1,0 +1,83 @@
+"""Lotka-Volterra predator-prey ODE benchmark (config 2, BASELINE.md).
+
+Reference analog: the pyABC Lotka-Volterra example notebook
+(doc/examples, executed as a CI integration test) — 4 parameters
+(alpha, beta, gamma, delta), noisy observations of prey/predator
+trajectories. Here the simulator is a traceable RK4-in-scan JaxModel, so a
+whole proposal round integrates as one batched XLA program on the MXU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.random_variables import RV, Distribution
+from ..model import JaxModel
+from .ode import rk4_at_times
+
+#: default true parameters (classic textbook values)
+TRUE_PARS = {"alpha": 1.0, "beta": 0.1, "gamma": 1.5, "delta": 0.075}
+Y0 = (10.0, 5.0)
+
+
+def _lv_rhs(y, alpha, beta, gamma, delta):
+    prey, pred = y[0], y[1]
+    dprey = alpha * prey - beta * prey * pred
+    dpred = delta * prey * pred - gamma * pred
+    return jnp.stack([dprey, dpred])
+
+
+def make_lv_model(n_obs: int = 20, t1: float = 15.0, n_substeps: int = 10,
+                  noise_sd: float = 0.5, log_parameters: bool = False,
+                  name: str = "lotka_volterra") -> JaxModel:
+    """Build the LV JaxModel: theta = (alpha, beta, gamma, delta).
+
+    Returns noisy trajectories {"prey": (n_obs,), "pred": (n_obs,)}.
+    ``log_parameters``: interpret theta as log10 of the rates (the common
+    pyABC formulation with uniform-in-log priors).
+    """
+    ts = np.linspace(0.0, t1, n_obs)
+
+    def sim(key, theta):
+        if log_parameters:
+            theta = 10.0 ** theta
+        alpha, beta, gamma, delta = theta[0], theta[1], theta[2], theta[3]
+        traj = rk4_at_times(
+            _lv_rhs, jnp.asarray(Y0), ts, n_substeps,
+            args=(alpha, beta, gamma, delta),
+        )
+        traj = jnp.clip(traj, 0.0, 1e6)  # extinction floor / blowup ceiling
+        k1, k2 = jax.random.split(key)
+        prey = traj[:, 0] + noise_sd * jax.random.normal(k1, (len(ts),))
+        pred = traj[:, 1] + noise_sd * jax.random.normal(k2, (len(ts),))
+        return {"prey": prey, "pred": pred}
+
+    space = ["alpha", "beta", "gamma", "delta"]
+    return JaxModel(sim, space, name=name)
+
+
+def default_prior(log_parameters: bool = False) -> Distribution:
+    if log_parameters:
+        return Distribution(
+            alpha=RV("uniform", -1.0, 1.3),   # 10^[-1, 0.3]
+            beta=RV("uniform", -2.0, 1.3),
+            gamma=RV("uniform", -1.0, 1.6),
+            delta=RV("uniform", -2.5, 1.5),
+        )
+    return Distribution(
+        alpha=RV("uniform", 0.0, 3.0),
+        beta=RV("uniform", 0.0, 0.5),
+        gamma=RV("uniform", 0.0, 3.0),
+        delta=RV("uniform", 0.0, 0.3),
+    )
+
+
+def observed_data(seed: int = 0, n_obs: int = 20, t1: float = 15.0,
+                  n_substeps: int = 10, noise_sd: float = 0.5) -> dict:
+    """Ground-truth observation generated at TRUE_PARS."""
+    model = make_lv_model(n_obs, t1, n_substeps, noise_sd)
+    theta = jnp.asarray([TRUE_PARS["alpha"], TRUE_PARS["beta"],
+                         TRUE_PARS["gamma"], TRUE_PARS["delta"]])
+    out = model.sim(jax.random.key(seed), theta)
+    return {k: np.asarray(v) for k, v in out.items()}

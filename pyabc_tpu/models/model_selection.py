@@ -1,0 +1,101 @@
+"""Multi-model selection benchmark (config 5, BASELINE.md).
+
+Reference analog: pyABC's model-selection examples (two tractable models
+with analytic posterior model probabilities) and K-ODE-model selection.
+
+Two suites:
+- `tractable_pair()`: two conjugate Gaussian models with different noise
+  scales — Bayes factors computable in closed form, the statistical anchor.
+- `ode_family(K)`: K ODE models of increasing complexity (degradation,
+  degradation+production, logistic) sharing one observation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.stats as st
+
+from ..core.random_variables import RV, Distribution
+from ..model import JaxModel
+from .ode import rk4_at_times
+
+
+def tractable_pair(noise_sds=(0.6, 1.2), prior_sd: float = 1.0):
+    """Two models: x ~ N(theta, sd_m^2), theta ~ N(0, prior_sd^2).
+
+    Marginal likelihood of model m at observation x0 is
+    N(x0; 0, prior_sd^2 + sd_m^2) — posterior model probabilities are exact.
+    Returns (models, priors, analytic_model_posterior(x0)).
+    """
+    models = []
+    priors = []
+    for i, sd in enumerate(noise_sds):
+        def make(sd=sd, i=i):
+            def sim(key, theta):
+                return {"x": theta[0] + sd * jax.random.normal(key)}
+
+            return JaxModel(sim, ["theta"], name=f"gauss_sd{i}")
+
+        models.append(make())
+        priors.append(Distribution(theta=RV("norm", 0.0, prior_sd)))
+
+    def analytic_posterior(x0: float) -> np.ndarray:
+        evid = np.asarray([
+            st.norm.pdf(x0, 0.0, np.sqrt(prior_sd**2 + sd**2))
+            for sd in noise_sds
+        ])
+        return evid / evid.sum()
+
+    return models, priors, analytic_posterior
+
+
+def ode_family(n_obs: int = 12, t1: float = 8.0, noise_sd: float = 0.3):
+    """K=3 nested ODE models for y(t), observed with noise:
+
+    m0: dy = -a y            (pure decay)
+    m1: dy = -a y + b        (decay + constant production)
+    m2: dy = a y (1 - y/k)   (logistic growth)
+    """
+    ts = np.linspace(0.0, t1, n_obs)
+    y0 = jnp.asarray([2.0])
+
+    def mk(rhs, names, name):
+        def sim(key, theta):
+            traj = rk4_at_times(rhs, y0, ts, 6, args=tuple(theta))
+            y = traj[:, 0] + noise_sd * jax.random.normal(key, (len(ts),))
+            return {"y": y}
+
+        return JaxModel(sim, names, name=name)
+
+    def rhs0(y, a):
+        return -a * y
+
+    def rhs1(y, a, b):
+        return -a * y + b
+
+    def rhs2(y, a, k):
+        return a * y * (1.0 - y / k)
+
+    models = [
+        mk(rhs0, ["a"], "decay"),
+        mk(rhs1, ["a", "b"], "decay_production"),
+        mk(rhs2, ["a", "k"], "logistic"),
+    ]
+    priors = [
+        Distribution(a=RV("uniform", 0.05, 1.0)),
+        Distribution(a=RV("uniform", 0.05, 1.0), b=RV("uniform", 0.0, 1.0)),
+        Distribution(a=RV("uniform", 0.05, 1.0), k=RV("uniform", 1.0, 9.0)),
+    ]
+    return models, priors, ts
+
+
+def observed_ode_family(seed: int = 0, true_model: int = 1,
+                        n_obs: int = 12, t1: float = 8.0,
+                        noise_sd: float = 0.3) -> dict:
+    models, _, _ = ode_family(n_obs, t1, noise_sd)
+    true_theta = {0: [0.4], 1: [0.4, 0.5], 2: [0.5, 6.0]}[true_model]
+    out = models[true_model].sim(
+        jax.random.key(seed), jnp.asarray(true_theta)
+    )
+    return {k: np.asarray(v) for k, v in out.items()}

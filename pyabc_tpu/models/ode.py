@@ -1,0 +1,126 @@
+"""Fixed-step ODE integrators, traceable and TPU-friendly.
+
+The reference delegates ODE models to user code (scipy.integrate etc. inside
+``Model.sample``, e.g. the Lotka-Volterra notebook doc/examples). On TPU,
+data-dependent adaptive stepping defeats XLA (SURVEY.md §7.3.3), so the
+framework ships bounded-iteration integrators in ``lax.scan``: classic RK4
+and Tsitouras/Dormand-Prince-style embedded RK with a *fixed* step budget and
+per-step error-controlled step-size clipping (PI controller on a bounded
+grid) — statically shaped, vmap/jit/pmap-able.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def rk4_integrate(f: Callable, y0, t0: float, dt: float, n_steps: int,
+                  save_every: int = 1, args=()):
+    """Classic RK4 with fixed dt; returns (n_saved, dim) trajectory.
+
+    ``save_every`` thins the saved trajectory (n_saved = n_steps//save_every).
+    """
+
+    def step(y, _):
+        k1 = f(y, *args)
+        k2 = f(y + 0.5 * dt * k1, *args)
+        k3 = f(y + 0.5 * dt * k2, *args)
+        k4 = f(y + dt * k3, *args)
+        y_new = y + (dt / 6.0) * (k1 + 2 * k2 + 2 * k3 + k4)
+        return y_new, y_new
+
+    _, traj = jax.lax.scan(step, jnp.asarray(y0), None, length=n_steps)
+    if save_every > 1:
+        traj = traj[save_every - 1 :: save_every]
+    return traj
+
+
+def rk45_integrate(f: Callable, y0, t0: float, t1: float, n_steps: int,
+                   rtol: float = 1e-4, atol: float = 1e-6, args=()):
+    """Embedded Dormand-Prince (RK45) with bounded adaptive stepping.
+
+    A fixed budget of ``n_steps`` stages is scanned; each stage either
+    advances with the current step (error accepted) or retries with a
+    smaller one (error rejected) — control flow is branchless `where`, so
+    the program is statically shaped. Integration that exhausts the budget
+    before t1 returns the state reached (and a flag).
+
+    Returns (y_final, t_reached, ok).
+    """
+    # Dormand-Prince coefficients
+    c = jnp.array([0.0, 1 / 5, 3 / 10, 4 / 5, 8 / 9, 1.0, 1.0])
+    a = [
+        jnp.array([]),
+        jnp.array([1 / 5]),
+        jnp.array([3 / 40, 9 / 40]),
+        jnp.array([44 / 45, -56 / 15, 32 / 9]),
+        jnp.array([19372 / 6561, -25360 / 2187, 64448 / 6561, -212 / 729]),
+        jnp.array([9017 / 3168, -355 / 33, 46732 / 5247, 49 / 176,
+                   -5103 / 18656]),
+        jnp.array([35 / 384, 0.0, 500 / 1113, 125 / 192, -2187 / 6784,
+                   11 / 84]),
+    ]
+    b5 = jnp.array([35 / 384, 0.0, 500 / 1113, 125 / 192, -2187 / 6784,
+                    11 / 84, 0.0])
+    b4 = jnp.array([5179 / 57600, 0.0, 7571 / 16695, 393 / 640,
+                    -92097 / 339200, 187 / 2100, 1 / 40])
+
+    y0 = jnp.asarray(y0, jnp.float32)
+    h0 = (t1 - t0) / n_steps * 4.0
+
+    def stage(carry, _):
+        y, t, h, ok = carry
+        h = jnp.minimum(h, t1 - t)
+        ks = []
+        for i in range(7):
+            yi = y
+            for j, aij in enumerate(a[i]):
+                yi = yi + h * aij * ks[j]
+            ks.append(f(yi, *args))
+        k_mat = jnp.stack(ks)  # (7, dim)
+        y5 = y + h * (b5 @ k_mat)
+        y4 = y + h * (b4 @ k_mat)
+        err = jnp.max(jnp.abs(y5 - y4) / (atol + rtol * jnp.abs(y5)))
+        accept = (err <= 1.0) | (h <= (t1 - t0) * 1e-7)
+        y_new = jnp.where(accept, y5, y)
+        t_new = jnp.where(accept, t + h, t)
+        # PI-ish controller, clipped
+        scale = jnp.clip(0.9 * err ** (-0.2), 0.2, 5.0)
+        h_new = jnp.clip(h * scale, (t1 - t0) * 1e-7, (t1 - t0))
+        done = t_new >= t1 - 1e-9 * (t1 - t0)
+        h_new = jnp.where(done, 0.0, h_new)
+        return (y_new, t_new, h_new, ok & jnp.all(jnp.isfinite(y_new))), None
+
+    (y, t, _, ok), _ = jax.lax.scan(
+        stage, (y0, jnp.asarray(t0, jnp.float32), jnp.asarray(h0, jnp.float32),
+                jnp.asarray(True)),
+        None, length=n_steps,
+    )
+    return y, t, ok & (t >= t1 - 1e-6 * (t1 - t0))
+
+
+def rk4_at_times(f: Callable, y0, ts, n_substeps: int, args=()):
+    """RK4 trajectory sampled at the (uniformly spaced) times ``ts``.
+
+    ``ts`` must start at t=ts[0] with constant spacing; each observation
+    interval is integrated with ``n_substeps`` RK4 steps.
+    """
+    ts = jnp.asarray(ts)
+    dt = (ts[1] - ts[0]) / n_substeps
+
+    def obs_step(y, _):
+        def micro(y, _):
+            k1 = f(y, *args)
+            k2 = f(y + 0.5 * dt * k1, *args)
+            k3 = f(y + 0.5 * dt * k2, *args)
+            k4 = f(y + dt * k3, *args)
+            return y + (dt / 6.0) * (k1 + 2 * k2 + 2 * k3 + k4), None
+
+        y_new, _ = jax.lax.scan(micro, y, None, length=n_substeps)
+        return y_new, y_new
+
+    _, traj = jax.lax.scan(obs_step, jnp.asarray(y0), None,
+                           length=ts.shape[0] - 1)
+    return jnp.concatenate([jnp.asarray(y0)[None], traj], axis=0)

@@ -1,0 +1,66 @@
+"""SIR epidemiological ODE benchmark (config 4, BASELINE.md).
+
+Reference analog: the pyABC noisy-ABC / stochastic-acceptor examples.
+2 parameters (beta, gamma = infection/recovery rates); observations are
+noisy infected counts at fixed times, to be paired with
+`IndependentNormalKernel` + `StochasticAcceptor` + `Temperature`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.random_variables import RV, Distribution
+from ..model import JaxModel
+from .ode import rk4_at_times
+
+TRUE_PARS = {"beta": 0.4, "gamma": 0.1}
+N_POP = 1000.0
+Y0 = (N_POP - 1.0, 1.0, 0.0)
+
+
+def _sir_rhs(y, beta, gamma):
+    s, i, r = y[0], y[1], y[2]
+    inf = beta * s * i / N_POP
+    rec = gamma * i
+    return jnp.stack([-inf, inf - rec, rec])
+
+
+def make_sir_model(n_obs: int = 15, t1: float = 60.0, n_substeps: int = 8,
+                   noise_sd: float = 0.0, name: str = "sir") -> JaxModel:
+    """theta = (beta, gamma); returns {"infected": (n_obs,)}.
+
+    With ``noise_sd=0`` the simulator is deterministic — observation noise is
+    then modeled by the stochastic kernel (noisy-ABC formulation).
+    """
+    ts = np.linspace(0.0, t1, n_obs)
+
+    def sim(key, theta):
+        beta, gamma = theta[0], theta[1]
+        traj = rk4_at_times(_sir_rhs, jnp.asarray(Y0), ts, n_substeps,
+                            args=(beta, gamma))
+        infected = traj[:, 1]
+        if noise_sd > 0:
+            infected = infected + noise_sd * jax.random.normal(key, (len(ts),))
+        return {"infected": infected}
+
+    return JaxModel(sim, ["beta", "gamma"], name=name)
+
+
+def default_prior() -> Distribution:
+    return Distribution(
+        beta=RV("uniform", 0.05, 0.95),
+        gamma=RV("uniform", 0.01, 0.49),
+    )
+
+
+def observed_data(seed: int = 0, n_obs: int = 15, t1: float = 60.0,
+                  noise_sd: float = 10.0) -> dict:
+    """Observation at TRUE_PARS with iid normal measurement noise."""
+    model = make_sir_model(n_obs, t1, noise_sd=0.0)
+    theta = jnp.asarray([TRUE_PARS["beta"], TRUE_PARS["gamma"]])
+    out = model.sim(jax.random.key(seed), theta)
+    infected = np.asarray(out["infected"])
+    rng = np.random.default_rng(seed)
+    return {"infected": infected + noise_sd * rng.normal(size=infected.shape)}

@@ -1,0 +1,16 @@
+from .base import Sample, SampleFactory, Sampler
+from .batched import BatchedSampler
+from .mapping import ConcurrentFutureSampler, MappingSampler
+from .multicore import (
+    MulticoreEvalParallelSampler,
+    MulticoreParticleParallelSampler,
+    nr_cores_available,
+)
+from .singlecore import SingleCoreSampler
+
+__all__ = [
+    "Sampler", "Sample", "SampleFactory",
+    "SingleCoreSampler", "BatchedSampler",
+    "MulticoreEvalParallelSampler", "MulticoreParticleParallelSampler",
+    "MappingSampler", "ConcurrentFutureSampler", "nr_cores_available",
+]

@@ -1,0 +1,134 @@
+"""Sampler base & Sample containers.
+
+Reference parity: ``pyabc/sampler/base.py::{Sampler, Sample, SampleFactory}``.
+The reference contract is ``sample_until_n_accepted(n, simulate_one, t, ...)
+-> Sample`` where simulate_one is a pickled scalar closure; the TPU-native
+contract passes a `GenerationContext` (see ``pyabc_tpu.inference.util``)
+which carries BOTH the scalar host closure (reference semantics, oracle
+path) and the batched jit-compiled round kernel (device path). Samplers
+declare which they consume.
+
+`Sample` is struct-of-arrays: the accepted particles as dense arrays plus
+(optionally) all evaluated records for adaptive components
+(``record_rejected``, set via ``configure_sampler`` by e.g.
+AdaptivePNormDistance — same coupling as the reference).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+
+class Sample:
+    """One generation's harvest (pyabc Sample), struct-of-arrays.
+
+    ``proposal_ids`` are global eval-slot indices assigned in proposal order;
+    sorting by them and trimming overshoot beyond n keeps any dynamic /
+    batched sampler statistically equivalent to sequential sampling — the
+    reference's unbiasedness invariant (SURVEY.md §3.4, §5.2).
+    """
+
+    def __init__(self, record_rejected: bool = False,
+                 max_nr_rejected: int = np.inf):
+        self.record_rejected = record_rejected
+        self.max_nr_rejected = max_nr_rejected
+        self.is_look_ahead: bool = False
+        # accepted particle arrays
+        self.ms: np.ndarray | None = None
+        self.thetas: np.ndarray | None = None
+        self.weights: np.ndarray | None = None
+        self.distances: np.ndarray | None = None
+        self.sumstats: np.ndarray | None = None
+        self.proposal_ids: np.ndarray | None = None
+        # all evaluated records (accepted + rejected), for adaptive components
+        self.all_sumstats: np.ndarray | None = None
+        self.all_distances: np.ndarray | None = None
+        self.all_accepted: np.ndarray | None = None
+
+    @property
+    def n_accepted(self) -> int:
+        return 0 if self.ms is None else len(self.ms)
+
+    def set_accepted(self, *, ms, thetas, weights, distances, sumstats,
+                     proposal_ids) -> None:
+        order = np.argsort(proposal_ids, kind="stable")
+        self.ms = np.asarray(ms)[order]
+        self.thetas = np.asarray(thetas)[order]
+        self.weights = np.asarray(weights)[order]
+        self.distances = np.asarray(distances)[order]
+        self.sumstats = np.asarray(sumstats)[order]
+        self.proposal_ids = np.asarray(proposal_ids)[order]
+
+    def trim(self, n: int) -> None:
+        """Deterministic overshoot trim: keep the first n by eval-slot id."""
+        if self.n_accepted <= n:
+            return
+        for name in ("ms", "thetas", "weights", "distances", "sumstats",
+                     "proposal_ids"):
+            setattr(self, name, getattr(self, name)[:n])
+
+    def set_all_records(self, *, sumstats, distances, accepted) -> None:
+        if not self.record_rejected:
+            return
+        k = len(sumstats)
+        if np.isfinite(self.max_nr_rejected) and k > self.max_nr_rejected:
+            keep = np.concatenate([
+                np.flatnonzero(accepted),
+                np.flatnonzero(~np.asarray(accepted))[: int(self.max_nr_rejected)],
+            ])
+            sumstats, distances, accepted = (
+                sumstats[keep], distances[keep], accepted[keep]
+            )
+        self.all_sumstats = np.asarray(sumstats)
+        self.all_distances = np.asarray(distances)
+        self.all_accepted = np.asarray(accepted)
+
+    def get_all_sum_stats(self) -> np.ndarray:
+        """All recorded sum stats (accepted + rejected if recorded)."""
+        if self.all_sumstats is not None:
+            return self.all_sumstats
+        return self.sumstats
+
+
+@dataclass
+class SampleFactory:
+    """Carries sampler-wide sample options (pyabc SampleFactory).
+
+    Adaptive components flip ``record_rejected`` in ``configure_sampler``.
+    """
+
+    record_rejected: bool = False
+    max_nr_rejected: int = np.inf
+
+    def __call__(self) -> Sample:
+        return Sample(self.record_rejected, self.max_nr_rejected)
+
+
+class Sampler:
+    """Abstract sampler (pyabc Sampler).
+
+    ``nr_evaluations_`` reports total forward simulations of the last call.
+    """
+
+    def __init__(self):
+        self.nr_evaluations_: int = 0
+        self.sample_factory = SampleFactory()
+        self.show_progress = False
+        self.analysis_id: str | None = None
+
+    def set_analysis_id(self, analysis_id: str):
+        self.analysis_id = analysis_id
+
+    def sample_until_n_accepted(self, n: int, simulate_one, t: int, *,
+                                max_eval: float = np.inf,
+                                all_accepted: bool = False,
+                                ana_vars=None) -> Sample:
+        raise NotImplementedError
+
+    def stop(self) -> None:
+        """Release resources (reference: redis/dask teardown)."""
+
+    def __repr__(self):
+        return f"{type(self).__name__}()"

@@ -1,0 +1,122 @@
+"""The TPU-native sampler: whole-generation batched rounds.
+
+This is the TPU inversion of the reference's evaluation-parallel dynamic
+samplers (``pyabc/sampler/multicore_evaluation_parallel.py::
+MulticoreEvalParallelSampler`` and the redis variant): instead of worker
+processes pulling scalar evaluations off a queue, each *round* evaluates a
+static-shape batch of B lanes as one fused XLA program; the host loop refills
+until n acceptances (mask-and-refill, SURVEY.md §7.1).
+
+Unbiasedness: lanes carry global eval-slot ids; the accepted set is sorted by
+slot id and overshoot beyond n is trimmed deterministically — exactly the
+reference's sort-by-eval-index trick that makes dynamic/batched sampling
+statistically equivalent to sequential sampling (§3.4, §5.2).
+
+Batch sizing: rounds are sized predictively from the observed acceptance
+rate (clamped to power-of-two buckets to bound recompilation) — the batched
+analog of the reference's dynamic scheduling.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.random import round_key
+from .base import Sample, Sampler
+
+
+def _pow2(n: int, lo: int, hi: int) -> int:
+    b = lo
+    while b < n and b < hi:
+        b *= 2
+    return min(b, hi)
+
+
+class BatchedSampler(Sampler):
+    """Single-host batched sampler over one device (or one jit on CPU).
+
+    ``min_batch``/``max_batch`` bound the per-round lane count;
+    ``overshoot`` is the safety factor on predictive sizing.
+    """
+
+    def __init__(self, min_batch: int = 256, max_batch: int = 1 << 17,
+                 overshoot: float = 1.3, check_max_eval: bool = False):
+        super().__init__()
+        self.min_batch = int(min_batch)
+        self.max_batch = int(max_batch)
+        self.overshoot = float(overshoot)
+        self.check_max_eval = check_max_eval
+        #: acceptance-rate estimate carried across generations: sizes the
+        #: FIRST round of the next generation so one round usually suffices,
+        #: and keeps B constant within a generation (compile reuse)
+        self._rate_estimate: float | None = None
+
+    def sample_until_n_accepted(self, n, generation_spec, t, *,
+                                max_eval=np.inf, all_accepted=False,
+                                ana_vars=None) -> Sample:
+        ctx = generation_spec.device
+        if ctx is None:
+            raise RuntimeError(
+                "BatchedSampler needs a device-compatible generation "
+                "(JaxModel models, traceable priors/components); use "
+                "SingleCoreSampler for host-only models"
+            )
+        mode, dyn = generation_spec.mode, generation_spec.dyn
+        gen_key = generation_spec.gen_key
+
+        sample = self.sample_factory()
+        chunks = []
+        nr_eval = 0
+        n_acc = 0
+        r = 0
+        # size B once per generation from the carried acceptance estimate and
+        # keep it constant across refill rounds: one compiled program per
+        # distinct B, reused across rounds AND generations
+        rate0 = self._rate_estimate if self._rate_estimate else 0.5
+        B = _pow2(max(int(n / rate0 * self.overshoot), self.min_batch),
+                  self.min_batch, self.max_batch)
+        while n_acc < n:
+            if self.check_max_eval and nr_eval >= max_eval:
+                break
+            res = ctx.run_round(round_key(gen_key, r), B, mode, dyn)
+            if all_accepted:
+                res.accepted = res.valid.copy()
+                res.log_weights = np.where(res.valid, 0.0, -np.inf)
+            res.slot_ids = nr_eval + np.arange(B)
+            chunks.append(res)
+            nr_eval += B
+            n_acc += int(res.accepted.sum())
+            r += 1
+            # grow B only on repeated undershoot (keeps compile cache warm)
+            rate = max(n_acc / nr_eval, 1.0 / nr_eval)
+            if (n - n_acc) > rate * B:
+                B = min(B * 2, self.max_batch)
+        self.nr_evaluations_ = nr_eval
+        self._rate_estimate = max(n_acc / nr_eval, 1.0 / nr_eval)
+
+        acc_mask = np.concatenate([c.accepted for c in chunks])
+        ms = np.concatenate([c.ms for c in chunks])[acc_mask]
+        thetas = np.concatenate([c.thetas for c in chunks])[acc_mask]
+        sumstats = np.concatenate([c.sumstats for c in chunks])[acc_mask]
+        distances = np.concatenate([c.distances for c in chunks])[acc_mask]
+        log_w = np.concatenate([c.log_weights for c in chunks])[acc_mask]
+        slots = np.concatenate([c.slot_ids for c in chunks])[acc_mask]
+        # stable exp-normalization of the log importance weights (float64)
+        finite = np.isfinite(log_w)
+        if finite.any():
+            mx = log_w[finite].max()
+            weights = np.where(finite, np.exp(log_w - mx), 0.0)
+        else:
+            weights = np.ones_like(log_w)
+        sample.set_accepted(
+            ms=ms, thetas=thetas, weights=weights, distances=distances,
+            sumstats=sumstats, proposal_ids=slots,
+        )
+        sample.trim(n)
+        if sample.record_rejected:
+            valid_mask = np.concatenate([c.valid for c in chunks])
+            sample.set_all_records(
+                sumstats=np.concatenate([c.sumstats for c in chunks])[valid_mask],
+                distances=np.concatenate([c.distances for c in chunks])[valid_mask],
+                accepted=acc_mask[valid_mask],
+            )
+        return sample

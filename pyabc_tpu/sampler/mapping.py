@@ -1,0 +1,145 @@
+"""Map-style and executor-based samplers.
+
+Reference parity: ``pyabc/sampler/mapping.py::MappingSampler`` and
+``pyabc/sampler/concurrent_future.py::ConcurrentFutureSampler`` (+
+``pyabc/sampler/eps_sampling_function.py::sample_until_n_accepted_proto``).
+Static batch scheduling over any user-supplied map / Executor — the
+pluggable escape hatch for ipyparallel / MPI pools / dask executors.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import Sample, Sampler
+
+
+def _batch_worker(simulate_one, seed, chunk):
+    np.random.seed(seed)
+    results = []
+    for _ in range(chunk):
+        results.append(simulate_one())
+    return results
+
+
+class MappingSampler(Sampler):
+    """Static oversubmitted batches through a map function (reference
+    MappingSampler). ``map_=`` accepts builtin map, ipyparallel view.map,
+    dask client.map-like callables."""
+
+    def __init__(self, map_=map, mapper_pickles: bool = False,
+                 chunk_size: int = 1, batch_factor: float = 2.0):
+        super().__init__()
+        self.map_ = map_
+        self.mapper_pickles = mapper_pickles
+        self.chunk_size = int(chunk_size)
+        self.batch_factor = float(batch_factor)
+
+    def sample_until_n_accepted(self, n, simulate_one, t, *, max_eval=np.inf,
+                                all_accepted=False, ana_vars=None) -> Sample:
+        if hasattr(simulate_one, "host_simulate_one"):
+            simulate_one = simulate_one.host_simulate_one
+        sample = self.sample_factory()
+        accepted = []
+        ids = []
+        all_records = []
+        n_eval = 0
+        rate_guess = 0.5
+        while len(accepted) < n:
+            needed = n - len(accepted)
+            n_jobs = max(int(needed / rate_guess * self.batch_factor), 1)
+            n_chunks = max(n_jobs // self.chunk_size, 1)
+            seeds = np.random.randint(0, 2**31 - 1, size=n_chunks)
+            from functools import partial
+
+            results = self.map_(
+                partial(_batch_worker, simulate_one),
+                [int(s) for s in seeds],
+                [self.chunk_size] * n_chunks,
+            )
+            for chunk in results:
+                for particle in chunk:
+                    slot = n_eval
+                    n_eval += 1
+                    if sample.record_rejected:
+                        all_records.append(
+                            (particle.sum_stat, particle.distance,
+                             particle.accepted)
+                        )
+                    if particle.accepted or all_accepted:
+                        accepted.append(particle)
+                        ids.append(slot)
+            rate_guess = max(len(accepted) / max(n_eval, 1), 1.0 / max(n_eval, 1))
+        self.nr_evaluations_ = n_eval
+        order = np.argsort(ids, kind="stable")[:n]
+        sample.accepted_particles = [accepted[i] for i in order]
+        sample.accepted_proposal_ids = np.asarray(ids)[order]
+        if sample.record_rejected and all_records:
+            sample.host_all_records = (
+                [r[0] for r in all_records],
+                np.asarray([r[1] for r in all_records]),
+                np.asarray([r[2] for r in all_records], bool),
+            )
+        return sample
+
+
+class ConcurrentFutureSampler(Sampler):
+    """Static batches over any ``concurrent.futures.Executor`` (reference
+    ConcurrentFutureSampler): ThreadPool, ProcessPool, or Dask's
+    ``client.get_executor()``."""
+
+    def __init__(self, cfuture_executor, client_max_jobs: int = 200,
+                 batch_size: int = 1):
+        super().__init__()
+        self.executor = cfuture_executor
+        self.client_max_jobs = int(client_max_jobs)
+        self.batch_size = int(batch_size)
+
+    def sample_until_n_accepted(self, n, simulate_one, t, *, max_eval=np.inf,
+                                all_accepted=False, ana_vars=None) -> Sample:
+        if hasattr(simulate_one, "host_simulate_one"):
+            simulate_one = simulate_one.host_simulate_one
+        import concurrent.futures as cf
+
+        sample = self.sample_factory()
+        accepted, ids, all_records = [], [], []
+        n_eval = 0
+        pending = set()
+        next_seed = np.random.randint(0, 2**30)
+        while len(accepted) < n or pending:
+            while (len(pending) < self.client_max_jobs
+                   and len(accepted) < n):
+                pending.add(self.executor.submit(
+                    _batch_worker, simulate_one, next_seed, self.batch_size
+                ))
+                next_seed += 1
+            done, pending = cf.wait(pending, return_when=cf.FIRST_COMPLETED)
+            for fut in done:
+                for particle in fut.result():
+                    slot = n_eval
+                    n_eval += 1
+                    if sample.record_rejected:
+                        all_records.append(
+                            (particle.sum_stat, particle.distance,
+                             particle.accepted)
+                        )
+                    if particle.accepted or all_accepted:
+                        accepted.append(particle)
+                        ids.append(slot)
+            if len(accepted) >= n:
+                for fut in pending:
+                    fut.cancel()
+                pending = {f for f in pending if not f.cancel()}
+                for fut in pending:
+                    fut.result()
+                pending = set()
+        self.nr_evaluations_ = n_eval
+        order = np.argsort(ids, kind="stable")[:n]
+        sample.accepted_particles = [accepted[i] for i in order]
+        sample.accepted_proposal_ids = np.asarray(ids)[order]
+        if sample.record_rejected and all_records:
+            sample.host_all_records = (
+                [r[0] for r in all_records],
+                np.asarray([r[1] for r in all_records]),
+                np.asarray([r[2] for r in all_records], bool),
+            )
+        return sample

@@ -1,0 +1,204 @@
+"""Multiprocess samplers for host-side (non-traceable) models.
+
+Reference parity: ``pyabc/sampler/multicore.py::MulticoreParticleParallelSampler``,
+``pyabc/sampler/multicore_evaluation_parallel.py::MulticoreEvalParallelSampler``
+and ``pyabc/sampler/multicorebase.py::{nr_cores_available,
+get_if_worker_healthy}``.
+
+These exist for capability parity: arbitrary Python simulators (SimpleModel,
+external processes) that cannot enter the XLA path still get single-node
+parallelism. The statistical contract is identical to the reference:
+evaluation-parallel workers share atomic counters, and the accepted set is
+sorted by eval-slot id with deterministic overshoot trim, keeping the
+dynamic scheduler unbiased (SURVEY.md §3.4). For traceable models,
+`BatchedSampler` supersedes these by orders of magnitude.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import queue as queue_mod
+
+import numpy as np
+
+from ..core.population import Particle
+from .base import Sample, Sampler
+
+DONE = "__done__"
+
+
+def nr_cores_available() -> int:
+    """Reference nr_cores_available: respects sched_getaffinity if present."""
+    try:
+        return len(os.sched_getaffinity(0))
+    except AttributeError:  # pragma: no cover - non-Linux
+        return mp.cpu_count()
+
+
+def get_if_worker_healthy(workers, q, timeout: float = 1800.0):
+    """Get from q, re-raising child failures (reference get_if_worker_healthy)."""
+    while True:
+        try:
+            return q.get(timeout=5.0)
+        except queue_mod.Empty:
+            if not any(w.is_alive() for w in workers):
+                raise RuntimeError(
+                    "all sampler workers died without producing results"
+                )
+
+
+def _eval_parallel_worker(simulate_one, n_request, n_eval, n_acc, out_q,
+                          seed, record_rejected, rej_q):
+    np.random.seed(seed)
+    while True:
+        with n_acc.get_lock():
+            if n_acc.value >= n_request:
+                break
+        with n_eval.get_lock():
+            slot = n_eval.value
+            n_eval.value += 1
+        particle = simulate_one()
+        if record_rejected:
+            rej_q.put((particle.sum_stat, particle.distance,
+                       particle.accepted))
+        if particle.accepted:
+            with n_acc.get_lock():
+                n_acc.value += 1
+            out_q.put((slot, particle))
+    out_q.put(DONE)
+
+
+def _particle_parallel_worker(simulate_one, quota, out_q, seed,
+                              record_rejected, rej_q):
+    np.random.seed(seed)
+    produced = 0
+    n_eval = 0
+    while produced < quota:
+        particle = simulate_one()
+        n_eval += 1
+        if record_rejected:
+            rej_q.put((particle.sum_stat, particle.distance,
+                       particle.accepted))
+        if particle.accepted:
+            produced += 1
+            out_q.put((None, particle))
+    out_q.put((DONE, n_eval))
+
+
+class _MulticoreBase(Sampler):
+    def __init__(self, n_procs: int | None = None, daemon: bool = True):
+        super().__init__()
+        self.n_procs = n_procs if n_procs is not None else nr_cores_available()
+        self.daemon = daemon
+
+    def _resolve(self, simulate_one):
+        if hasattr(simulate_one, "host_simulate_one"):
+            return simulate_one.host_simulate_one
+        return simulate_one
+
+    def _drain_rejected(self, sample: Sample, rej_q) -> None:
+        if not sample.record_rejected:
+            return
+        records = []
+        try:
+            while True:
+                records.append(rej_q.get_nowait())
+        except queue_mod.Empty:
+            pass
+        if records:
+            sample.host_all_records = (
+                [r[0] for r in records],
+                np.asarray([r[1] for r in records]),
+                np.asarray([r[2] for r in records], bool),
+            )
+
+
+class MulticoreEvalParallelSampler(_MulticoreBase):
+    """Evaluation-parallel dynamic multiprocessing sampler (the reference's
+    recommended multicore sampler and the BASELINE.json baseline)."""
+
+    def sample_until_n_accepted(self, n, simulate_one, t, *, max_eval=np.inf,
+                                all_accepted=False, ana_vars=None) -> Sample:
+        simulate_one = self._resolve(simulate_one)
+        sample = self.sample_factory()
+        ctx = mp.get_context("fork")
+        n_eval = ctx.Value("i", 0)
+        n_acc = ctx.Value("i", 0)
+        out_q = ctx.Queue()
+        rej_q = ctx.Queue()
+        seeds = np.random.randint(0, 2**31 - 1, size=self.n_procs)
+        workers = [
+            ctx.Process(
+                target=_eval_parallel_worker,
+                args=(simulate_one, n, n_eval, n_acc, out_q, int(seeds[i]),
+                      sample.record_rejected, rej_q),
+                daemon=self.daemon,
+            )
+            for i in range(self.n_procs)
+        ]
+        for w in workers:
+            w.start()
+        collected: list[tuple[int, Particle]] = []
+        done = 0
+        while done < self.n_procs:
+            item = get_if_worker_healthy(workers, out_q)
+            if item == DONE:
+                done += 1
+            else:
+                collected.append(item)
+        for w in workers:
+            w.join()
+        self.nr_evaluations_ = n_eval.value
+        # deterministic slot ordering + overshoot trim (reference invariant)
+        collected.sort(key=lambda x: x[0])
+        collected = collected[:n]
+        sample.accepted_particles = [p for _, p in collected]
+        sample.accepted_proposal_ids = np.asarray([s for s, _ in collected])
+        self._drain_rejected(sample, rej_q)
+        return sample
+
+
+class MulticoreParticleParallelSampler(_MulticoreBase):
+    """Particle-parallel static multiprocessing sampler (reference
+    MulticoreParticleParallelSampler): each worker fills a fixed quota."""
+
+    def sample_until_n_accepted(self, n, simulate_one, t, *, max_eval=np.inf,
+                                all_accepted=False, ana_vars=None) -> Sample:
+        simulate_one = self._resolve(simulate_one)
+        sample = self.sample_factory()
+        ctx = mp.get_context("fork")
+        out_q = ctx.Queue()
+        rej_q = ctx.Queue()
+        quotas = [n // self.n_procs] * self.n_procs
+        for i in range(n % self.n_procs):
+            quotas[i] += 1
+        seeds = np.random.randint(0, 2**31 - 1, size=self.n_procs)
+        workers = [
+            ctx.Process(
+                target=_particle_parallel_worker,
+                args=(simulate_one, quotas[i], out_q, int(seeds[i]),
+                      sample.record_rejected, rej_q),
+                daemon=self.daemon,
+            )
+            for i in range(self.n_procs)
+            if quotas[i] > 0
+        ]
+        for w in workers:
+            w.start()
+        particles: list[Particle] = []
+        n_eval = 0
+        done = 0
+        while done < len(workers):
+            item = get_if_worker_healthy(workers, out_q)
+            if isinstance(item, tuple) and item[0] == DONE:
+                n_eval += item[1]
+                done += 1
+            else:
+                particles.append(item[1])
+        for w in workers:
+            w.join()
+        self.nr_evaluations_ = n_eval
+        sample.accepted_particles = particles[:n]
+        sample.accepted_proposal_ids = np.arange(len(sample.accepted_particles))
+        self._drain_rejected(sample, rej_q)
+        return sample

@@ -1,0 +1,49 @@
+"""Single-core scalar sampler — the reference baseline semantics.
+
+Reference parity: ``pyabc/sampler/singlecore.py::SingleCoreSampler``. Loops
+the scalar ``simulate_one`` closure until n acceptances. Serves arbitrary
+Python models and acts as the statistical oracle the batched device sampler
+is tested against.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.population import Particle
+from .base import Sample, Sampler
+
+
+class SingleCoreSampler(Sampler):
+    def __init__(self, check_max_eval: bool = False):
+        super().__init__()
+        self.check_max_eval = check_max_eval
+
+    def sample_until_n_accepted(self, n, simulate_one, t, *, max_eval=np.inf,
+                                all_accepted=False, ana_vars=None) -> Sample:
+        if hasattr(simulate_one, "host_simulate_one"):
+            simulate_one = simulate_one.host_simulate_one
+        sample = self.sample_factory()
+        accepted: list[Particle] = []
+        accepted_ids: list[int] = []
+        all_ss, all_d, all_acc = [], [], []
+        nr_eval = 0
+        while len(accepted) < n:
+            if self.check_max_eval and nr_eval >= max_eval:
+                break
+            particle = simulate_one()
+            slot = nr_eval
+            nr_eval += 1
+            if sample.record_rejected:
+                all_ss.append(particle.sum_stat)
+                all_d.append(particle.distance)
+                all_acc.append(particle.accepted)
+            if particle.accepted or all_accepted:
+                accepted.append(particle)
+                accepted_ids.append(slot)
+        self.nr_evaluations_ = nr_eval
+        sample.accepted_particles = accepted  # list view for host consumers
+        sample.accepted_proposal_ids = np.asarray(accepted_ids)
+        if sample.record_rejected and all_ss:
+            sample.host_all_records = (all_ss, np.asarray(all_d),
+                                       np.asarray(all_acc, bool))
+        return sample

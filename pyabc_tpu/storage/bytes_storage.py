@@ -1,0 +1,47 @@
+"""BLOB (de)serialization for numpy arrays and pandas DataFrames.
+
+Reference parity: ``pyabc/storage/numpy_bytes_storage.py`` and
+``pyabc/storage/dataframe_bytes_storage.py`` — sum stats and parameter
+frames are stored as BLOBs in the SQL database.
+"""
+from __future__ import annotations
+
+import io
+
+import numpy as np
+import pandas as pd
+
+
+def np_to_bytes(arr) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(arr), allow_pickle=False)
+    return buf.getvalue()
+
+
+def np_from_bytes(b: bytes) -> np.ndarray:
+    return np.load(io.BytesIO(b), allow_pickle=False)
+
+
+def df_to_bytes(df: pd.DataFrame) -> bytes:
+    buf = io.BytesIO()
+    df.to_parquet(buf) if _has_parquet() else df.to_pickle(buf)
+    return buf.getvalue()
+
+
+def df_from_bytes(b: bytes) -> pd.DataFrame:
+    buf = io.BytesIO(b)
+    if _has_parquet():
+        try:
+            return pd.read_parquet(buf)
+        except Exception:
+            buf.seek(0)
+    return pd.read_pickle(buf)
+
+
+def _has_parquet() -> bool:
+    try:
+        import pyarrow  # noqa: F401
+
+        return True
+    except ImportError:
+        return False

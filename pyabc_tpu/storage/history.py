@@ -1,0 +1,427 @@
+"""History — SQL persistence of the full experiment record.
+
+Reference parity: ``pyabc/storage/history.py::History`` +
+``pyabc/storage/db_model.py`` (table/column names follow the reference ORM:
+abc_smc -> populations -> models -> particles -> parameters, samples for
+sum stats) so reference analysis idioms port. Implemented on stdlib
+``sqlite3`` (SQLAlchemy is not in this image); the db IS the per-generation
+checkpoint, and ``ABCSMC.load`` resumes from it (SURVEY.md §5.4).
+
+Observed data is stored at pseudo-generation t = PRE_TIME = -1
+(reference ``History.store_initial_data``).
+"""
+from __future__ import annotations
+
+import datetime
+import json
+import sqlite3
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+
+from .bytes_storage import np_from_bytes, np_to_bytes
+
+PRE_TIME = -1
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS abc_smc (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    start_time TEXT,
+    json_parameters TEXT,
+    distance_function TEXT,
+    epsilon_function TEXT,
+    population_strategy TEXT
+);
+CREATE TABLE IF NOT EXISTS populations (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    abc_smc_id INTEGER REFERENCES abc_smc(id),
+    t INTEGER,
+    population_end_time TEXT,
+    nr_samples INTEGER,
+    epsilon REAL
+);
+CREATE TABLE IF NOT EXISTS models (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    population_id INTEGER REFERENCES populations(id),
+    m INTEGER,
+    name TEXT,
+    p_model REAL
+);
+CREATE TABLE IF NOT EXISTS particles (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    model_id INTEGER REFERENCES models(id),
+    w REAL,
+    distance REAL
+);
+CREATE TABLE IF NOT EXISTS parameters (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    particle_id INTEGER REFERENCES particles(id),
+    name TEXT,
+    value REAL
+);
+CREATE TABLE IF NOT EXISTS samples (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    particle_id INTEGER REFERENCES particles(id),
+    name TEXT,
+    value BLOB
+);
+CREATE INDEX IF NOT EXISTS ix_pop_abc ON populations(abc_smc_id, t);
+CREATE INDEX IF NOT EXISTS ix_model_pop ON models(population_id);
+CREATE INDEX IF NOT EXISTS ix_part_model ON particles(model_id);
+CREATE INDEX IF NOT EXISTS ix_param_part ON parameters(particle_id);
+CREATE INDEX IF NOT EXISTS ix_sample_part ON samples(particle_id);
+"""
+
+
+def create_sqlite_db_id(dir_: str | None = None,
+                        file_: str = "pyabc_tpu.db") -> str:
+    """Convenience sqlite URL in a temp dir (reference create_sqlite_db_id)."""
+    import tempfile
+
+    dir_ = dir_ or tempfile.gettempdir()
+    return "sqlite:///" + str(Path(dir_) / file_)
+
+
+def _db_path(db: str) -> str:
+    if db == "sqlite://" or db == ":memory:":
+        return ":memory:"
+    if db.startswith("sqlite:///"):
+        return db[len("sqlite:///"):]
+    return db
+
+
+class History:
+    """Experiment record over one sqlite database; multiple runs per db."""
+
+    def __init__(self, db: str, _id: int | None = None):
+        self.db = db
+        self._conn = sqlite3.connect(_db_path(db))
+        self._conn.executescript(_SCHEMA)
+        self._conn.commit()
+        self.id = _id if _id is not None else self._latest_id()
+
+    def _latest_id(self) -> int | None:
+        row = self._conn.execute("SELECT MAX(id) FROM abc_smc").fetchone()
+        return row[0]
+
+    # ------------------------------------------------------------- creation
+    def store_initial_data(self, ground_truth_model: int | None,
+                           options: dict, observed_summary_statistics: dict,
+                           ground_truth_parameter: dict,
+                           model_names: list[str],
+                           distance_function_json: str,
+                           eps_function_json: str,
+                           population_strategy_json: str) -> int:
+        """Open a new run; store observed data at t = PRE_TIME."""
+        cur = self._conn.cursor()
+        cur.execute(
+            "INSERT INTO abc_smc (start_time, json_parameters, "
+            "distance_function, epsilon_function, population_strategy) "
+            "VALUES (?,?,?,?,?)",
+            (
+                datetime.datetime.now().isoformat(),
+                json.dumps(options),
+                distance_function_json,
+                eps_function_json,
+                population_strategy_json,
+            ),
+        )
+        self.id = cur.lastrowid
+        cur.execute(
+            "INSERT INTO populations (abc_smc_id, t, population_end_time, "
+            "nr_samples, epsilon) VALUES (?,?,?,?,?)",
+            (self.id, PRE_TIME, datetime.datetime.now().isoformat(), 0, 0.0),
+        )
+        pop_id = cur.lastrowid
+        gt_m = ground_truth_model if ground_truth_model is not None else 0
+        cur.execute(
+            "INSERT INTO models (population_id, m, name, p_model) "
+            "VALUES (?,?,?,?)",
+            (pop_id, gt_m, model_names[gt_m] if model_names else "m0", 1.0),
+        )
+        model_id = cur.lastrowid
+        cur.execute(
+            "INSERT INTO particles (model_id, w, distance) VALUES (?,?,?)",
+            (model_id, 1.0, 0.0),
+        )
+        particle_id = cur.lastrowid
+        for name, value in (ground_truth_parameter or {}).items():
+            cur.execute(
+                "INSERT INTO parameters (particle_id, name, value) "
+                "VALUES (?,?,?)",
+                (particle_id, name, float(value)),
+            )
+        for name, value in observed_summary_statistics.items():
+            cur.execute(
+                "INSERT INTO samples (particle_id, name, value) VALUES (?,?,?)",
+                (particle_id, name, np_to_bytes(value)),
+            )
+        self._conn.commit()
+        return self.id
+
+    # ------------------------------------------------------------ appending
+    def append_population(self, t: int, current_epsilon: float, population,
+                          nr_simulations: int, model_names: list[str]) -> None:
+        cur = self._conn.cursor()
+        cur.execute(
+            "INSERT INTO populations (abc_smc_id, t, population_end_time, "
+            "nr_samples, epsilon) VALUES (?,?,?,?,?)",
+            (self.id, int(t), datetime.datetime.now().isoformat(),
+             int(nr_simulations), float(current_epsilon)),
+        )
+        pop_id = cur.lastrowid
+        probs = population.model_probabilities_array()
+        spec = population.sumstat_spec
+        for m in population.get_alive_models():
+            cur.execute(
+                "INSERT INTO models (population_id, m, name, p_model) "
+                "VALUES (?,?,?,?)",
+                (pop_id, int(m),
+                 model_names[m] if m < len(model_names) else f"m{m}",
+                 float(probs[m])),
+            )
+            model_id = cur.lastrowid
+            mask = population.ms == m
+            idxs = np.flatnonzero(mask)
+            space = population.spaces[m]
+            # within-model normalized weights (reference stores these)
+            w_model = population.weights[mask] / probs[m]
+            rows = [(model_id, float(w), float(population.distances[i]))
+                    for w, i in zip(w_model, idxs)]
+            for (mid, w, d), i in zip(rows, idxs):
+                cur.execute(
+                    "INSERT INTO particles (model_id, w, distance) "
+                    "VALUES (?,?,?)", (mid, w, d),
+                )
+                particle_id = cur.lastrowid
+                theta = population.thetas[i, : space.dim]
+                cur.executemany(
+                    "INSERT INTO parameters (particle_id, name, value) "
+                    "VALUES (?,?,?)",
+                    [(particle_id, nm, float(v))
+                     for nm, v in zip(space.names, theta)],
+                )
+                cur.execute(
+                    "INSERT INTO samples (particle_id, name, value) "
+                    "VALUES (?,?,?)",
+                    (particle_id, "__flat__",
+                     np_to_bytes(population.sumstats[i])),
+                )
+        self._conn.commit()
+
+    # ------------------------------------------------------------- queries
+    def _pop_id(self, t: int) -> int | None:
+        t = self._resolve_t(t)
+        row = self._conn.execute(
+            "SELECT id FROM populations WHERE abc_smc_id=? AND t=?",
+            (self.id, t),
+        ).fetchone()
+        return row[0] if row else None
+
+    def _resolve_t(self, t: int | None) -> int:
+        if t is None or t < 0 and t != PRE_TIME:
+            return self.max_t
+        return t
+
+    @property
+    def max_t(self) -> int:
+        row = self._conn.execute(
+            "SELECT MAX(t) FROM populations WHERE abc_smc_id=?", (self.id,)
+        ).fetchone()
+        return row[0] if row and row[0] is not None else PRE_TIME
+
+    @property
+    def n_populations(self) -> int:
+        row = self._conn.execute(
+            "SELECT COUNT(*) FROM populations WHERE abc_smc_id=? AND t>=0",
+            (self.id,),
+        ).fetchone()
+        return int(row[0])
+
+    def all_runs(self) -> pd.DataFrame:
+        return pd.read_sql_query(
+            "SELECT * FROM abc_smc", self._conn
+        )
+
+    def get_distribution(self, m: int = 0, t: int | None = None
+                         ) -> tuple[pd.DataFrame, np.ndarray]:
+        """(parameter DataFrame, within-model weights) for model m at t."""
+        t = self._resolve_t(t)
+        pop_id = self._pop_id(t)
+        if pop_id is None:
+            raise KeyError(f"no population t={t}")
+        df = pd.read_sql_query(
+            """
+            SELECT particles.id AS pid, particles.w AS w,
+                   parameters.name AS name, parameters.value AS value
+            FROM models
+            JOIN particles ON particles.model_id = models.id
+            JOIN parameters ON parameters.particle_id = particles.id
+            WHERE models.population_id = ? AND models.m = ?
+            """,
+            self._conn, params=(pop_id, int(m)),
+        )
+        if df.empty:
+            raise KeyError(f"no particles for model {m} at t={t}")
+        wide = df.pivot(index="pid", columns="name", values="value")
+        w = df.drop_duplicates("pid").set_index("pid")["w"].loc[wide.index]
+        w = np.asarray(w, np.float64)
+        w = w / w.sum()
+        wide.columns.name = None
+        return wide.reset_index(drop=True), w
+
+    def get_model_probabilities(self, t: int | None = None) -> pd.DataFrame:
+        if t is None:
+            df = pd.read_sql_query(
+                """
+                SELECT populations.t AS t, models.m AS m, models.p_model AS p
+                FROM models JOIN populations
+                  ON models.population_id = populations.id
+                WHERE populations.abc_smc_id = ? AND populations.t >= 0
+                """,
+                self._conn, params=(self.id,),
+            )
+            return df.pivot(index="t", columns="m", values="p").fillna(0.0)
+        t = self._resolve_t(t)
+        pop_id = self._pop_id(t)
+        df = pd.read_sql_query(
+            "SELECT m, p_model AS p FROM models WHERE population_id=?",
+            self._conn, params=(pop_id,),
+        )
+        return df.set_index("m")
+
+    def get_all_populations(self) -> pd.DataFrame:
+        df = pd.read_sql_query(
+            "SELECT t, population_end_time, nr_samples AS samples, epsilon "
+            "FROM populations WHERE abc_smc_id=? AND t>=? ORDER BY t",
+            self._conn, params=(self.id, PRE_TIME),
+        )
+        return df
+
+    def get_nr_particles_per_population(self) -> pd.Series:
+        df = pd.read_sql_query(
+            """
+            SELECT populations.t AS t, COUNT(particles.id) AS n
+            FROM populations
+            LEFT JOIN models ON models.population_id = populations.id
+            LEFT JOIN particles ON particles.model_id = models.id
+            WHERE populations.abc_smc_id = ?
+            GROUP BY populations.t ORDER BY populations.t
+            """,
+            self._conn, params=(self.id,),
+        )
+        return df.set_index("t")["n"]
+
+    def get_weighted_distances(self, t: int | None = None) -> pd.DataFrame:
+        """['distance', 'w'] with overall-normalized weights (ref API)."""
+        t = self._resolve_t(t)
+        pop_id = self._pop_id(t)
+        df = pd.read_sql_query(
+            """
+            SELECT particles.distance AS distance,
+                   particles.w * models.p_model AS w
+            FROM models JOIN particles ON particles.model_id = models.id
+            WHERE models.population_id = ?
+            """,
+            self._conn, params=(pop_id,),
+        )
+        return df
+
+    def get_weighted_sum_stats(self, t: int | None = None
+                               ) -> tuple[np.ndarray, np.ndarray]:
+        t = self._resolve_t(t)
+        pop_id = self._pop_id(t)
+        df = pd.read_sql_query(
+            """
+            SELECT particles.id AS pid,
+                   particles.w * models.p_model AS w, samples.value AS blob
+            FROM models
+            JOIN particles ON particles.model_id = models.id
+            JOIN samples ON samples.particle_id = particles.id
+            WHERE models.population_id = ? AND samples.name = '__flat__'
+            """,
+            self._conn, params=(pop_id,),
+        )
+        weights = np.asarray(df["w"], np.float64)
+        stats = np.stack([np_from_bytes(b) for b in df["blob"]])
+        return weights, stats
+
+    def get_population_extended(self, t: int | None = None) -> pd.DataFrame:
+        t = self._resolve_t(t)
+        pop_id = self._pop_id(t)
+        return pd.read_sql_query(
+            """
+            SELECT models.m AS m, models.name AS model_name,
+                   particles.w AS w, particles.distance AS distance,
+                   parameters.name AS par_name, parameters.value AS par_value
+            FROM models
+            JOIN particles ON particles.model_id = models.id
+            JOIN parameters ON parameters.particle_id = particles.id
+            WHERE models.population_id = ?
+            """,
+            self._conn, params=(pop_id,),
+        )
+
+    def alive_models(self, t: int | None = None) -> list[int]:
+        t = self._resolve_t(t)
+        pop_id = self._pop_id(t)
+        rows = self._conn.execute(
+            "SELECT m FROM models WHERE population_id=? AND p_model>0",
+            (pop_id,),
+        ).fetchall()
+        return [r[0] for r in rows]
+
+    def n_alive_models(self, t: int | None = None) -> int:
+        return len(self.alive_models(t))
+
+    @property
+    def total_nr_simulations(self) -> int:
+        row = self._conn.execute(
+            "SELECT SUM(nr_samples) FROM populations WHERE abc_smc_id=?",
+            (self.id,),
+        ).fetchone()
+        return int(row[0] or 0)
+
+    def get_observed_sum_stat(self) -> dict[str, np.ndarray]:
+        pop_id = self._pop_id(PRE_TIME)
+        df = pd.read_sql_query(
+            """
+            SELECT samples.name AS name, samples.value AS blob
+            FROM models
+            JOIN particles ON particles.model_id = models.id
+            JOIN samples ON samples.particle_id = particles.id
+            WHERE models.population_id = ?
+            """,
+            self._conn, params=(pop_id,),
+        )
+        return {r["name"]: np_from_bytes(r["blob"]) for _, r in df.iterrows()}
+
+    def get_ground_truth_parameter(self) -> dict[str, float]:
+        pop_id = self._pop_id(PRE_TIME)
+        df = pd.read_sql_query(
+            """
+            SELECT parameters.name AS name, parameters.value AS value
+            FROM models
+            JOIN particles ON particles.model_id = models.id
+            JOIN parameters ON parameters.particle_id = particles.id
+            WHERE models.population_id = ?
+            """,
+            self._conn, params=(pop_id,),
+        )
+        return dict(zip(df["name"], df["value"]))
+
+    def get_json_parameters(self) -> dict:
+        row = self._conn.execute(
+            "SELECT json_parameters FROM abc_smc WHERE id=?", (self.id,)
+        ).fetchone()
+        return json.loads(row[0]) if row and row[0] else {}
+
+    def done(self) -> None:
+        self._conn.commit()
+
+    def close(self) -> None:
+        self._conn.close()
+
+    def __repr__(self):
+        return f"History(db={self.db!r}, id={self.id})"

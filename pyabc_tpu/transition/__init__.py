@@ -1,0 +1,22 @@
+from .aggregated import AggregatedTransition
+from .base import DiscreteTransition, Transition
+from .exceptions import NotEnoughParticles
+from .grid_search import GridSearchCV
+from .local_transition import LocalTransition
+from .model_perturbation import ModelPerturbationKernel
+from .multivariatenormal import MultivariateNormalTransition
+from .randomwalk import (
+    DiscreteJumpTransition,
+    DiscreteRandomWalkTransition,
+    PerturbationKernel,
+)
+from .util import scott_rule_of_thumb, silverman_rule_of_thumb, smart_cov
+
+__all__ = [
+    "Transition", "DiscreteTransition", "NotEnoughParticles",
+    "MultivariateNormalTransition", "LocalTransition",
+    "DiscreteRandomWalkTransition", "DiscreteJumpTransition",
+    "PerturbationKernel", "AggregatedTransition", "GridSearchCV",
+    "ModelPerturbationKernel",
+    "smart_cov", "scott_rule_of_thumb", "silverman_rule_of_thumb",
+]

@@ -1,0 +1,46 @@
+"""Per-parameter-subset composite transition.
+
+Reference parity: ``pyabc/transition/base.py::AggregatedTransition`` (newer
+versions) — maps disjoint parameter subsets to sub-transitions (e.g. discrete
+jump kernel for an integer parameter, MVN for the continuous rest); density is
+the product, sampling is independent per subset.
+"""
+from __future__ import annotations
+
+from typing import Mapping
+
+import numpy as np
+import pandas as pd
+
+from .base import Transition
+
+
+class AggregatedTransition(Transition):
+    def __init__(self, mapping: Mapping):
+        """``mapping``: {param_name_or_tuple: Transition}."""
+        self.mapping = {
+            (k if isinstance(k, tuple) else (k,)): v for k, v in mapping.items()
+        }
+
+    def fit(self, X: pd.DataFrame, w: np.ndarray) -> None:
+        self.store_fit_params(X, w)
+        for keys, trans in self.mapping.items():
+            trans.fit(X[list(keys)], w)
+
+    def rvs_single(self) -> pd.Series:
+        parts = [trans.rvs_single() for trans in self.mapping.values()]
+        combined = pd.concat(parts)
+        return combined[self.X.columns] if self.X is not None else combined
+
+    def pdf(self, x: pd.Series | pd.DataFrame):
+        vals = None
+        for keys, trans in self.mapping.items():
+            if isinstance(x, pd.DataFrame):
+                sub = x[list(keys)]
+            else:
+                sub = x[list(keys)]
+            p = np.asarray(trans.pdf(sub), np.float64)
+            vals = p if vals is None else vals * p
+        if np.ndim(vals) == 0 or (hasattr(vals, "shape") and vals.shape == ()):
+            return float(vals)
+        return vals

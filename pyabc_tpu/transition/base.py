@@ -1,0 +1,137 @@
+"""Transition (perturbation kernel) base.
+
+Reference parity: ``pyabc/transition/base.py::{Transition, DiscreteTransition}``
+— sklearn-estimator-like: ``fit(X: DataFrame, w)``, ``rvs()/rvs_single()``,
+``pdf(x)``, plus ``mean_cv``/``required_nr_samples`` used by the adaptive
+population-size machinery.
+
+TPU-first contract: a fitted transition additionally exposes
+``device_params() -> pytree of jnp arrays`` and the class exposes traceable
+``device_rvs(key, params) -> theta`` / ``device_logpdf(theta, params)`` used
+inside the jitted generation kernel — proposal sampling and the KDE mixture
+density for importance weights both run fully batched on device.
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+import numpy as np
+import pandas as pd
+
+from ..core.weighted_statistics import effective_sample_size
+from .exceptions import NotEnoughParticles
+
+
+class Transition(ABC):
+    """Abstract perturbation kernel."""
+
+    NR_BOOTSTRAP = 5
+    X: pd.DataFrame | None = None
+    w: np.ndarray | None = None
+
+    @abstractmethod
+    def fit(self, X: pd.DataFrame, w: np.ndarray) -> None:
+        """Fit to weighted particles (weights sum to 1)."""
+
+    @abstractmethod
+    def rvs_single(self) -> pd.Series:
+        """Draw one perturbed parameter."""
+
+    def rvs(self, size: int | None = None) -> pd.Series | pd.DataFrame:
+        if size is None:
+            return self.rvs_single()
+        return pd.DataFrame([self.rvs_single() for _ in range(size)])
+
+    @abstractmethod
+    def pdf(self, x: pd.Series | pd.DataFrame):
+        """Density of the fitted kernel at x."""
+
+    def store_fit_params(self, X: pd.DataFrame, w: np.ndarray) -> None:
+        if len(X) == 0:
+            raise NotEnoughParticles("fitting to no samples")
+        if len(X) != len(w):
+            raise ValueError("X and w must have equal length")
+        total = np.sum(w)
+        if not np.isclose(total, 1.0):
+            w = np.asarray(w, np.float64) / total
+        self.X = X
+        self.w = np.asarray(w, np.float64)
+
+    # ------------------------------------------------- adaptive pop size
+    def mean_cv(self, n_samples: int | None = None) -> float:
+        """Bootstrap coefficient of variation of the KDE under resampling
+        (reference ``Transition.mean_cv``, used by AdaptivePopulationSize)."""
+        if self.X is None:
+            raise NotEnoughParticles("transition not fitted")
+        if n_samples is None:
+            n_samples = len(self.X)
+        n_samples = max(int(n_samples), 2)
+        rng = np.random.default_rng(0)
+        test_points = self.X
+        densities = []
+        for _ in range(self.NR_BOOTSTRAP):
+            idx = rng.choice(len(self.X), size=n_samples, p=self.w)
+            boot_X = self.X.iloc[idx]
+            boot_w = np.ones(n_samples) / n_samples
+            cp = self.copy_unfitted()
+            cp.fit(boot_X, boot_w)
+            densities.append(np.asarray(cp.pdf(test_points), np.float64))
+        densities = np.stack(densities)
+        mean = densities.mean(axis=0)
+        std = densities.std(axis=0)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            cvs = np.where(mean > 0, std / mean, 0.0)
+        return float(np.average(cvs, weights=self.w))
+
+    def required_nr_samples(self, coefficient_of_variation: float) -> int:
+        """Smallest n with bootstrap CV below the target (bisection;
+        reference ``Transition.required_nr_samples``)."""
+        if self.X is None:
+            raise NotEnoughParticles("transition not fitted")
+        lo, hi = 10, max(10 * len(self.X), 1000)
+        if self.mean_cv(hi) > coefficient_of_variation:
+            return hi
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if self.mean_cv(mid) <= coefficient_of_variation:
+                hi = mid
+            else:
+                lo = mid + 1
+        return int(hi)
+
+    def copy_unfitted(self) -> "Transition":
+        """A fresh instance with the same hyperparameters."""
+        import copy
+
+        cp = copy.copy(self)
+        cp.X = None
+        cp.w = None
+        return cp
+
+    def ess(self) -> float:
+        return effective_sample_size(self.w) if self.w is not None else 0.0
+
+    # ------------------------------------------------------------- device
+    def is_device_compatible(self) -> bool:
+        return False
+
+    def device_params(self):
+        """Pytree of jnp arrays describing the fitted kernel."""
+        raise NotImplementedError
+
+    @staticmethod
+    def device_rvs(key, params):
+        """Traceable: one proposal draw from the fitted kernel."""
+        raise NotImplementedError
+
+    @staticmethod
+    def device_logpdf(theta, params):
+        """Traceable: log density of the fitted kernel at theta."""
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}()"
+
+
+class DiscreteTransition(Transition):
+    """Base for transitions over discrete parameters (pyabc DiscreteTransition)."""

@@ -1,0 +1,5 @@
+"""Reference parity: ``pyabc/transition/exceptions.py::NotEnoughParticles``."""
+
+
+class NotEnoughParticles(Exception):
+    pass

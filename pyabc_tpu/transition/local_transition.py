@@ -1,0 +1,135 @@
+"""Locally-adaptive Gaussian perturbation kernel.
+
+Reference parity: ``pyabc/transition/local_transition.py::LocalTransition`` —
+each ancestor particle gets its own covariance estimated from its k nearest
+neighbors (reference uses a scipy KDTree + per-particle Silverman scaling).
+
+TPU-first shift: neighbor search is a dense pairwise-distance + ``top_k``
+(O(n^2), MXU-friendly, fine to n ~ 1e4 — SURVEY.md §7.3.4), and the fitted
+kernel is stored as per-particle Cholesky factors ``(n, d, d)`` so device
+sampling/pdf are fully batched.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from .base import Transition
+from .exceptions import NotEnoughParticles
+from .util import silverman_rule_of_thumb
+
+_LOG_2PI = math.log(2.0 * math.pi)
+
+
+class LocalTransition(Transition):
+    """k-nearest-neighbor local-covariance Gaussian KDE (pyabc LocalTransition).
+
+    ``k`` neighbors per particle (default ``k_fraction * n``, at least
+    dim + 1); ``scaling`` multiplies the per-particle covariance.
+    """
+
+    EPS = 1e-3
+
+    def __init__(self, k: int | None = None, k_fraction: float = 0.25,
+                 scaling: float = 1.0):
+        self.k = k
+        self.k_fraction = float(k_fraction)
+        self.scaling = float(scaling)
+        self._chols: np.ndarray | None = None
+        self._precs: np.ndarray | None = None
+        self._logdets: np.ndarray | None = None
+
+    def _effective_k(self, n: int, dim: int) -> int:
+        if self.k is not None:
+            k = self.k
+        else:
+            k = int(round(self.k_fraction * n))
+        return int(np.clip(k, dim + 1, n))
+
+    def fit(self, X: pd.DataFrame, w: np.ndarray) -> None:
+        self.store_fit_params(X, w)
+        arr = np.asarray(X, np.float64)
+        n, dim = arr.shape
+        if n < dim + 1:
+            raise NotEnoughParticles(
+                f"LocalTransition needs > dim+1={dim + 1} particles, got {n}"
+            )
+        k = self._effective_k(n, dim)
+        # dense pairwise sq-distances; top-k smallest per row
+        sq = ((arr[:, None, :] - arr[None, :, :]) ** 2).sum(-1)
+        nn_idx = np.argpartition(sq, kth=k - 1, axis=1)[:, :k]  # (n, k)
+        factor = silverman_rule_of_thumb(k, dim) * self.scaling
+        covs = np.empty((n, dim, dim))
+        for i in range(n):
+            neigh = arr[nn_idx[i]]
+            centered = neigh - arr[i]
+            cov = centered.T @ centered / k
+            cov = cov * factor**2
+            # regularize: relative jitter on the diagonal (reference EPS role)
+            tr = np.trace(cov) / dim
+            cov += np.eye(dim) * max(tr, 1e-10) * self.EPS
+            covs[i] = cov
+        self._chols = np.linalg.cholesky(covs)
+        self._precs = np.linalg.inv(covs)
+        sign, logdets = np.linalg.slogdet(covs)
+        self._logdets = logdets
+
+    def rvs_single(self) -> pd.Series:
+        idx = np.random.choice(len(self.X), p=self.w)
+        theta = np.asarray(self.X.iloc[idx], np.float64)
+        perturbed = theta + self._chols[idx] @ np.random.normal(size=len(theta))
+        return pd.Series(perturbed, index=self.X.columns)
+
+    def pdf(self, x: pd.Series | pd.DataFrame):
+        arr = np.asarray(x, np.float64)
+        single = arr.ndim == 1
+        arr = np.atleast_2d(arr)
+        thetas = np.asarray(self.X, np.float64)
+        dim = thetas.shape[1]
+        diff = arr[:, None, :] - thetas[None, :, :]  # (q, n, d)
+        maha = np.einsum("qnd,nde,qne->qn", diff, self._precs, diff)
+        log_comp = -0.5 * (dim * _LOG_2PI + self._logdets[None, :] + maha)
+        dens = np.exp(log_comp) @ self.w
+        return float(dens[0]) if single else dens
+
+    # ------------------------------------------------------------- device
+    def is_device_compatible(self) -> bool:
+        return True
+
+    def device_params(self):
+        return {
+            "thetas": jnp.asarray(np.asarray(self.X, np.float64), jnp.float32),
+            "weights": jnp.asarray(self.w, jnp.float32),
+            "chols": jnp.asarray(self._chols, jnp.float32),
+            "precs": jnp.asarray(self._precs, jnp.float32),
+            "logdets": jnp.asarray(self._logdets, jnp.float32),
+            # true dim; see MultivariateNormalTransition.device_params
+            "dim": jnp.asarray(self.X.shape[1], jnp.float32),
+        }
+
+    @staticmethod
+    def device_rvs(key, params):
+        k1, k2 = jax.random.split(key)
+        idx = jax.random.choice(
+            k1, params["weights"].shape[0], p=params["weights"]
+        )
+        theta = params["thetas"][idx]
+        noise = params["chols"][idx] @ jax.random.normal(k2, theta.shape)
+        return theta + noise
+
+    @staticmethod
+    def device_logpdf(theta, params):
+        thetas = params["thetas"]
+        diff = theta[None, :] - thetas  # (n, d); padded dims diff exactly 0
+        maha = jnp.einsum("nd,nde,ne->n", diff, params["precs"], diff)
+        log_comp = -0.5 * (params["dim"] * _LOG_2PI + params["logdets"] + maha)
+        return jax.scipy.special.logsumexp(
+            log_comp, b=params["weights"], axis=0
+        )
+
+    def __repr__(self):
+        return f"LocalTransition(k={self.k}, scaling={self.scaling})"

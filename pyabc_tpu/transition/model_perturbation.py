@@ -1,0 +1,61 @@
+"""Model-index perturbation kernel for multi-model inference.
+
+Reference parity: ``pyabc/random_variables.py::ModelPerturbationKernel``
+(location varies by version; semantics identical): with probability
+``probability_to_stay`` keep the ancestor's model index, otherwise jump
+uniformly to one of the other models.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ModelPerturbationKernel:
+    def __init__(self, nr_of_models: int, probability_to_stay: float | None = None):
+        self.nr_of_models = int(nr_of_models)
+        if probability_to_stay is None:
+            self.probability_to_stay = 1.0 if nr_of_models == 1 else 0.7
+        else:
+            self.probability_to_stay = float(np.clip(probability_to_stay, 0, 1))
+
+    def _transition_matrix(self) -> np.ndarray:
+        """P[m, m'] = pmf of proposing m' from ancestor m."""
+        K = self.nr_of_models
+        if K == 1:
+            return np.ones((1, 1))
+        stay = self.probability_to_stay
+        off = (1.0 - stay) / (K - 1)
+        P = np.full((K, K), off)
+        np.fill_diagonal(P, stay)
+        return P
+
+    def rvs(self, m: int) -> int:
+        if not 0 <= m < self.nr_of_models:
+            raise ValueError(f"model index {m} out of range")
+        return int(np.random.choice(self.nr_of_models,
+                                    p=self._transition_matrix()[m]))
+
+    def pmf(self, n: int, m: int) -> float:
+        """Probability of proposing n given ancestor m."""
+        if not (0 <= n < self.nr_of_models and 0 <= m < self.nr_of_models):
+            raise ValueError("model index out of range")
+        return float(self._transition_matrix()[m, n])
+
+    # ------------------------------------------------------------- device
+    def device_params(self):
+        return jnp.asarray(self._transition_matrix(), jnp.float32)
+
+    @staticmethod
+    def device_rvs(key, m, matrix):
+        """Traceable: propose a model index from ancestor index m."""
+        return jax.random.choice(key, matrix.shape[0], p=matrix[m])
+
+    @staticmethod
+    def device_logpmf(n, m, matrix):
+        return jnp.log(matrix[m, n])
+
+    def __repr__(self):
+        return (f"ModelPerturbationKernel(nr_of_models={self.nr_of_models}, "
+                f"probability_to_stay={self.probability_to_stay})")

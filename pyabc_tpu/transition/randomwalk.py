@@ -1,0 +1,106 @@
+"""Discrete-parameter transitions.
+
+Reference parity: ``pyabc/transition/randomwalk.py::DiscreteRandomWalkTransition``
+and ``pyabc/transition/jump.py::DiscreteJumpTransition`` (names/locations vary
+slightly across versions; semantics preserved).
+"""
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+from .base import DiscreteTransition
+from .exceptions import NotEnoughParticles
+
+
+class DiscreteRandomWalkTransition(DiscreteTransition):
+    """Integer random walk: resample an ancestor, add a +-1/0 step per
+    dimension (pyabc DiscreteRandomWalkTransition)."""
+
+    def __init__(self, n_steps: int = 1,
+                 p_l: float = 1.0 / 3, p_r: float = 1.0 / 3,
+                 p_c: float = 1.0 / 3):
+        self.n_steps = int(n_steps)
+        total = p_l + p_r + p_c
+        self.p_l, self.p_r, self.p_c = p_l / total, p_r / total, p_c / total
+
+    def fit(self, X: pd.DataFrame, w: np.ndarray) -> None:
+        self.store_fit_params(X, w)
+
+    def rvs_single(self) -> pd.Series:
+        idx = np.random.choice(len(self.X), p=self.w)
+        theta = np.asarray(self.X.iloc[idx], np.float64).copy()
+        for _ in range(self.n_steps):
+            steps = np.random.choice(
+                [-1, 0, 1], size=theta.shape, p=[self.p_l, self.p_c, self.p_r]
+            )
+            theta = theta + steps
+        return pd.Series(theta, index=self.X.columns)
+
+    def pdf(self, x: pd.Series | pd.DataFrame):
+        """Probability of reaching x from the weighted ancestors by the walk."""
+        arr = np.atleast_2d(np.asarray(x, np.float64))
+        thetas = np.asarray(self.X, np.float64)
+        # n_steps-fold convolution of the single-step pmf per dimension
+        step_vals, step_probs = self._step_distribution()
+        out = np.zeros(arr.shape[0])
+        for q in range(arr.shape[0]):
+            diff = arr[q][None, :] - thetas  # (n, d)
+            p_dim = np.zeros_like(diff)
+            for v, p in zip(step_vals, step_probs):
+                p_dim += np.where(diff == v, p, 0.0)
+            out[q] = float(np.sum(self.w * np.prod(p_dim, axis=1)))
+        single = np.asarray(x).ndim == 1
+        return float(out[0]) if single else out
+
+    def _step_distribution(self):
+        """Exact pmf of the sum of n_steps iid {-1,0,1} steps."""
+        vals = {0: 1.0}
+        for _ in range(self.n_steps):
+            new: dict[int, float] = {}
+            for v, p in vals.items():
+                for s, ps in ((-1, self.p_l), (0, self.p_c), (1, self.p_r)):
+                    new[v + s] = new.get(v + s, 0.0) + p * ps
+            vals = new
+        items = sorted(vals.items())
+        return [v for v, _ in items], [p for _, p in items]
+
+
+class DiscreteJumpTransition(DiscreteTransition):
+    """Stay with probability p_stay, else jump uniformly over the domain
+    (pyabc DiscreteJumpTransition). For a single discrete parameter column."""
+
+    def __init__(self, domain, p_stay: float = 0.7):
+        self.domain = np.asarray(domain)
+        if len(self.domain) < 2:
+            raise ValueError("domain must have at least 2 values")
+        self.p_stay = float(p_stay)
+        self.p_move = (1.0 - p_stay) / (len(self.domain) - 1)
+
+    def fit(self, X: pd.DataFrame, w: np.ndarray) -> None:
+        if X.shape[1] != 1:
+            raise ValueError("DiscreteJumpTransition handles one parameter")
+        self.store_fit_params(X, w)
+
+    def rvs_single(self) -> pd.Series:
+        idx = np.random.choice(len(self.X), p=self.w)
+        val = float(np.asarray(self.X.iloc[idx])[0])
+        if np.random.uniform() >= self.p_stay:
+            others = self.domain[self.domain != val]
+            val = float(np.random.choice(others))
+        return pd.Series([val], index=self.X.columns)
+
+    def pdf(self, x: pd.Series | pd.DataFrame):
+        arr = np.atleast_1d(np.asarray(x, np.float64)).reshape(-1)
+        anc = np.asarray(self.X, np.float64).reshape(-1)
+        out = np.empty(arr.shape[0])
+        for q, v in enumerate(arr):
+            stay_mass = float(np.sum(self.w[anc == v]))
+            move_mass = float(np.sum(self.w[anc != v]))
+            out[q] = stay_mass * self.p_stay + move_mass * self.p_move
+        single = np.asarray(x).ndim <= 1 and out.shape[0] == 1
+        return float(out[0]) if single else out
+
+
+class PerturbationKernel(DiscreteJumpTransition):
+    """Alias kept for reference-name familiarity."""

@@ -1,0 +1,47 @@
+"""Transition utilities.
+
+Reference parity: ``pyabc/transition/util.py::smart_cov`` plus the bandwidth
+rules from ``pyabc/transition/multivariatenormal.py::{scott_rule_of_thumb,
+silverman_rule_of_thumb}``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def smart_cov(X: np.ndarray, w: np.ndarray) -> np.ndarray:
+    """Weighted covariance robust to degenerate input (pyabc smart_cov).
+
+    Zero-variance directions get a small positive diagonal so the Cholesky
+    factorization (and hence sampling) never fails; a single particle yields
+    a small isotropic covariance.
+    """
+    X = np.asarray(X, np.float64)
+    w = np.asarray(w, np.float64)
+    w = w / w.sum()
+    mean = w @ X
+    centered = X - mean
+    cov = (centered * w[:, None]).T @ centered
+    # degenerate fixes
+    d = X.shape[1]
+    if len(X) == 1 or not np.all(np.isfinite(cov)):
+        cov = np.eye(d) * 1e-4
+    diag = np.diag(cov).copy()
+    bad = diag <= 0
+    if bad.any():
+        fill = np.abs(mean) * 1e-4 + 1e-8
+        cov[np.diag_indices(d)] = np.where(bad, fill, diag)
+    return cov
+
+
+def scott_rule_of_thumb(n_samples: float, dimension: int) -> float:
+    """Scott bandwidth factor: n^(-1/(d+4)) (pyabc scott_rule_of_thumb)."""
+    return n_samples ** (-1.0 / (dimension + 4))
+
+
+def silverman_rule_of_thumb(n_samples: float, dimension: int) -> float:
+    """Silverman factor: (4/(d+2))^(1/(d+4)) n^(-1/(d+4))
+    (pyabc silverman_rule_of_thumb)."""
+    return (4 / (dimension + 2)) ** (1 / (dimension + 4)) * n_samples ** (
+        -1 / (dimension + 4)
+    )

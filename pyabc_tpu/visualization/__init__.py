@@ -1,0 +1,45 @@
+"""Visualization over History — reference plot API (pyabc/visualization/)."""
+from .credible import (
+    compute_credible_interval,
+    plot_credible_intervals,
+    plot_credible_intervals_for_time,
+)
+from .diagnostics import (
+    plot_acceptance_rates_trajectory,
+    plot_distance_weights,
+    plot_effective_sample_sizes,
+    plot_epsilons,
+    plot_model_probabilities,
+    plot_sample_numbers,
+    plot_sample_numbers_trajectory,
+    plot_total_walltime,
+    plot_walltime,
+)
+from .histogram import (
+    plot_histogram_1d,
+    plot_histogram_2d,
+    plot_histogram_matrix,
+)
+from .kde import (
+    kde_1d,
+    kde_2d,
+    plot_kde_1d,
+    plot_kde_1d_highlevel,
+    plot_kde_2d,
+    plot_kde_2d_highlevel,
+    plot_kde_matrix,
+    plot_kde_matrix_highlevel,
+)
+
+__all__ = [
+    "kde_1d", "kde_2d", "plot_kde_1d", "plot_kde_1d_highlevel",
+    "plot_kde_2d", "plot_kde_2d_highlevel", "plot_kde_matrix",
+    "plot_kde_matrix_highlevel",
+    "plot_histogram_1d", "plot_histogram_2d", "plot_histogram_matrix",
+    "plot_epsilons", "plot_sample_numbers", "plot_sample_numbers_trajectory",
+    "plot_acceptance_rates_trajectory", "plot_model_probabilities",
+    "plot_effective_sample_sizes", "plot_total_walltime", "plot_walltime",
+    "plot_distance_weights",
+    "compute_credible_interval", "plot_credible_intervals",
+    "plot_credible_intervals_for_time",
+]

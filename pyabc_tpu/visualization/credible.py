@@ -1,0 +1,102 @@
+"""Credible interval plots.
+
+Reference parity: ``pyabc/visualization/credible.py::{plot_credible_intervals,
+plot_credible_intervals_for_time}`` — weighted posterior quantile bands per
+generation.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.weighted_statistics import weighted_quantile
+from .util import get_figure
+
+
+def compute_credible_interval(vals, weights, level: float = 0.95):
+    """(lb, ub) weighted central credible interval (reference
+    compute_credible_interval)."""
+    alpha_lb = 0.5 * (1 - level)
+    lb = weighted_quantile(vals, weights, alpha=alpha_lb)
+    ub = weighted_quantile(vals, weights, alpha=1 - alpha_lb)
+    return lb, ub
+
+
+def plot_credible_intervals(history, m: int = 0, ts=None, par_names=None,
+                            levels=(0.95,), show_mean: bool = True,
+                            refval=None, refval_color="C1", size=None,
+                            arr_ax=None):
+    """Credible interval trajectories over generations
+    (reference plot_credible_intervals)."""
+    import matplotlib.pyplot as plt
+
+    if ts is None:
+        ts = list(range(history.max_t + 1))
+    df0, _ = history.get_distribution(m=m, t=ts[-1])
+    if par_names is None:
+        par_names = list(df0.columns)
+    n_par = len(par_names)
+    if arr_ax is None:
+        fig, arr_ax = plt.subplots(n_par, 1, squeeze=False)
+        arr_ax = [a[0] for a in arr_ax]
+        if size is not None:
+            fig.set_size_inches(size)
+    levels = sorted(levels)
+    for i, par in enumerate(par_names):
+        ax = arr_ax[i]
+        means = []
+        bands = {lv: ([], []) for lv in levels}
+        for t in ts:
+            df, w = history.get_distribution(m=m, t=t)
+            vals = np.asarray(df[par], np.float64)
+            means.append(float(np.sum(w * vals)))
+            for lv in levels:
+                lb, ub = compute_credible_interval(vals, w, lv)
+                bands[lv][0].append(lb)
+                bands[lv][1].append(ub)
+        for lv in levels:
+            ax.fill_between(ts, bands[lv][0], bands[lv][1], alpha=0.3,
+                            label=f"{lv:.0%} CI")
+        if show_mean:
+            ax.plot(ts, means, "x-", label="mean")
+        if refval is not None:
+            ax.axhline(refval[par], color=refval_color, linestyle="dotted",
+                       label="reference")
+        ax.set_ylabel(par)
+        ax.legend()
+    arr_ax[-1].set_xlabel("population index t")
+    return arr_ax
+
+
+def plot_credible_intervals_for_time(histories, m: int = 0, t=None,
+                                     par_names=None, levels=(0.95,),
+                                     labels=None, size=None, arr_ax=None):
+    """Credible intervals of multiple runs at one generation (reference
+    plot_credible_intervals_for_time)."""
+    import matplotlib.pyplot as plt
+
+    from .util import to_lists
+
+    histories, labels = to_lists(histories, labels)
+    df0, _ = histories[0].get_distribution(m=m, t=t)
+    if par_names is None:
+        par_names = list(df0.columns)
+    n_par = len(par_names)
+    if arr_ax is None:
+        fig, arr_ax = plt.subplots(n_par, 1, squeeze=False)
+        arr_ax = [a[0] for a in arr_ax]
+        if size is not None:
+            fig.set_size_inches(size)
+    for i, par in enumerate(par_names):
+        ax = arr_ax[i]
+        for j, (h, lab) in enumerate(zip(histories, labels)):
+            df, w = h.get_distribution(m=m, t=t)
+            vals = np.asarray(df[par], np.float64)
+            mean = float(np.sum(w * vals))
+            for lv in sorted(levels):
+                lb, ub = compute_credible_interval(vals, w, lv)
+                ax.plot([j, j], [lb, ub], "-", lw=2, alpha=0.6)
+            ax.plot([j], [mean], "o")
+        ax.set_xticks(range(len(histories)))
+        ax.set_xticklabels(labels)
+        ax.set_ylabel(par)
+    return arr_ax

@@ -1,0 +1,183 @@
+"""Run-diagnostic plots over History.
+
+Reference parity: ``pyabc/visualization/{epsilon,sample,model_probabilities,
+effective_sample_size,walltime,distance}.py`` — plot_epsilons,
+plot_sample_numbers(_trajectory), plot_acceptance_rates_trajectory,
+plot_model_probabilities, plot_effective_sample_sizes, plot_total_walltime,
+plot_walltime, plot_distance_weights.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+from ..core.weighted_statistics import effective_sample_size
+from .util import get_figure, to_lists
+
+
+def plot_epsilons(histories, labels=None, colors=None, scale: str = "lin",
+                  ax=None, size=None):
+    """Epsilon trajectory per run (reference plot_epsilons)."""
+    histories, labels = to_lists(histories, labels)
+    fig, ax = get_figure(ax, size)
+    for h, lab in zip(histories, labels):
+        pops = h.get_all_populations()
+        pops = pops[pops.t >= 0]
+        eps = pops["epsilon"].to_numpy()
+        if scale == "log":
+            eps = np.log10(np.maximum(eps, 1e-300))
+        ax.plot(pops["t"], eps, "x-", label=lab)
+    ax.set_xlabel("population index t")
+    ax.set_ylabel("epsilon" if scale == "lin" else "log10(epsilon)")
+    ax.legend()
+    return ax
+
+
+def plot_sample_numbers(histories, labels=None, rotation: int = 0, ax=None,
+                        size=None):
+    """Stacked bar of simulations per generation (reference
+    plot_sample_numbers)."""
+    histories, labels = to_lists(histories, labels)
+    fig, ax = get_figure(ax, size)
+    width = 0.8 / len(histories)
+    for i, (h, lab) in enumerate(zip(histories, labels)):
+        pops = h.get_all_populations()
+        pops = pops[pops.t >= 0]
+        ax.bar(pops["t"] + i * width, pops["samples"], width=width, label=lab)
+    ax.set_xlabel("population index t")
+    ax.set_ylabel("simulations")
+    ax.legend()
+    return ax
+
+
+def plot_sample_numbers_trajectory(histories, labels=None, yscale="lin",
+                                   ax=None, size=None):
+    histories, labels = to_lists(histories, labels)
+    fig, ax = get_figure(ax, size)
+    for h, lab in zip(histories, labels):
+        pops = h.get_all_populations()
+        pops = pops[pops.t >= 0]
+        ax.plot(pops["t"], pops["samples"], "x-", label=lab)
+    if yscale == "log":
+        ax.set_yscale("log")
+    ax.set_xlabel("population index t")
+    ax.set_ylabel("simulations")
+    ax.legend()
+    return ax
+
+
+def plot_acceptance_rates_trajectory(histories, labels=None, ax=None,
+                                     size=None, normalize_by_ess=False):
+    """Acceptance rate (n_particles / n_simulations) per generation
+    (reference plot_acceptance_rates_trajectory)."""
+    histories, labels = to_lists(histories, labels)
+    fig, ax = get_figure(ax, size)
+    for h, lab in zip(histories, labels):
+        pops = h.get_all_populations()
+        pops = pops[pops.t >= 0]
+        nrs = h.get_nr_particles_per_population()
+        rates = []
+        for t, samples in zip(pops["t"], pops["samples"]):
+            n = nrs.get(t, 0)
+            rates.append(n / samples if samples else np.nan)
+        ax.plot(pops["t"], rates, "x-", label=lab)
+    ax.set_xlabel("population index t")
+    ax.set_ylabel("acceptance rate")
+    ax.legend()
+    return ax
+
+
+def plot_model_probabilities(history, rotation: int = 0, ax=None, size=None):
+    """Bar plot of p(m | t) over generations (reference
+    plot_model_probabilities)."""
+    fig, ax = get_figure(ax, size)
+    probs = history.get_model_probabilities()
+    probs.plot.bar(ax=ax, rot=rotation)
+    ax.set_ylabel("model probability")
+    ax.set_xlabel("population index t")
+    return ax
+
+
+def plot_effective_sample_sizes(histories, labels=None, rotation: int = 0,
+                                relative: bool = False, ax=None, size=None):
+    """ESS of the weighted population per generation (reference
+    plot_effective_sample_sizes)."""
+    histories, labels = to_lists(histories, labels)
+    fig, ax = get_figure(ax, size)
+    for h, lab in zip(histories, labels):
+        pops = h.get_all_populations()
+        pops = pops[pops.t >= 0]
+        esss = []
+        for t in pops["t"]:
+            wd = h.get_weighted_distances(t)
+            w = np.asarray(wd["w"], np.float64)
+            ess = effective_sample_size(w)
+            if relative:
+                ess /= len(w)
+            esss.append(ess)
+        ax.plot(pops["t"], esss, "x-", label=lab)
+    ax.set_xlabel("population index t")
+    ax.set_ylabel("effective sample size" + (" (relative)" if relative else ""))
+    ax.legend()
+    return ax
+
+
+def plot_total_walltime(histories, labels=None, unit: str = "s", rotation=0,
+                        ax=None, size=None):
+    """Total run walltime bar per history (reference plot_total_walltime)."""
+    histories, labels = to_lists(histories, labels)
+    fig, ax = get_figure(ax, size)
+    factor = {"s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}[unit]
+    totals = []
+    for h in histories:
+        pops = h.get_all_populations()
+        times = pd.to_datetime(pops["population_end_time"])
+        totals.append((times.max() - times.min()).total_seconds() / factor)
+    ax.bar(np.arange(len(histories)), totals)
+    ax.set_xticks(np.arange(len(histories)))
+    ax.set_xticklabels(labels, rotation=rotation)
+    ax.set_ylabel(f"total walltime [{unit}]")
+    return ax
+
+
+def plot_walltime(histories, labels=None, unit: str = "s", rotation=0,
+                  ax=None, size=None):
+    """Per-generation walltime (reference plot_walltime)."""
+    histories, labels = to_lists(histories, labels)
+    fig, ax = get_figure(ax, size)
+    factor = {"s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}[unit]
+    for h, lab in zip(histories, labels):
+        pops = h.get_all_populations()
+        times = pd.to_datetime(pops["population_end_time"])
+        ts = pops["t"].to_numpy()
+        if len(times) < 2:
+            continue
+        durations = (times.diff().dt.total_seconds().to_numpy()[1:] / factor)
+        ax.plot(ts[1:], durations, "x-", label=lab)
+    ax.set_xlabel("population index t")
+    ax.set_ylabel(f"walltime [{unit}]")
+    ax.legend()
+    return ax
+
+
+def plot_distance_weights(distance, t=None, labels=None, ax=None, size=None,
+                          **kwargs):
+    """Per-statistic weights of an adaptive distance (reference
+    plot_distance_weights)."""
+    fig, ax = get_figure(ax, size)
+    weights = getattr(distance, "weights", None)
+    if not weights:
+        raise ValueError("distance carries no per-generation weights")
+    ts = sorted(k for k in weights if k >= 0) if t is None else [t]
+    spec = getattr(distance, "spec", None)
+    names = spec.labels() if spec is not None else None
+    for s in ts:
+        w = np.asarray(weights[s])
+        xs = np.arange(len(w))
+        ax.plot(xs, w, "x-", label=f"t={s}", **kwargs)
+    if names is not None:
+        ax.set_xticks(np.arange(len(names)))
+        ax.set_xticklabels(names, rotation=90)
+    ax.set_ylabel("weight")
+    ax.legend()
+    return ax

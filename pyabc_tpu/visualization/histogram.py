@@ -1,0 +1,96 @@
+"""Weighted histogram plots.
+
+Reference parity: ``pyabc/visualization/histogram.py::{plot_histogram_1d,
+plot_histogram_2d, plot_histogram_matrix}`` (+ _lowlevel variants).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .util import get_figure
+
+
+def plot_histogram_1d(history, x: str, m: int = 0, t=None, xmin=None,
+                      xmax=None, ax=None, size=None, refval=None,
+                      refval_color="C1", **kwargs):
+    df, w = history.get_distribution(m=m, t=t)
+    return plot_histogram_1d_lowlevel(df, w, x, xmin, xmax, ax=ax, size=size,
+                                      refval=refval,
+                                      refval_color=refval_color, **kwargs)
+
+
+def plot_histogram_1d_lowlevel(df, w, x: str, xmin=None, xmax=None, ax=None,
+                               size=None, refval=None, refval_color="C1",
+                               **kwargs):
+    fig, ax = get_figure(ax, size)
+    rng = None
+    if xmin is not None and xmax is not None:
+        rng = (xmin, xmax)
+    ax.hist(np.asarray(df[x]), weights=np.asarray(w), range=rng,
+            density=True, **kwargs)
+    if refval is not None:
+        ax.axvline(refval[x] if isinstance(refval, dict) else refval,
+                   color=refval_color, linestyle="dotted")
+    ax.set_xlabel(x)
+    ax.set_ylabel("posterior")
+    return ax
+
+
+def plot_histogram_2d(history, x: str, y: str, m: int = 0, t=None, xmin=None,
+                      xmax=None, ymin=None, ymax=None, ax=None, size=None,
+                      refval=None, refval_color="C1", **kwargs):
+    df, w = history.get_distribution(m=m, t=t)
+    return plot_histogram_2d_lowlevel(df, w, x, y, xmin, xmax, ymin, ymax,
+                                      ax=ax, size=size, refval=refval,
+                                      refval_color=refval_color, **kwargs)
+
+
+def plot_histogram_2d_lowlevel(df, w, x: str, y: str, xmin=None, xmax=None,
+                               ymin=None, ymax=None, ax=None, size=None,
+                               refval=None, refval_color="C1", **kwargs):
+    fig, ax = get_figure(ax, size)
+    rng = None
+    if all(v is not None for v in (xmin, xmax, ymin, ymax)):
+        rng = [[xmin, xmax], [ymin, ymax]]
+    _, _, _, im = ax.hist2d(np.asarray(df[x]), np.asarray(df[y]),
+                            weights=np.asarray(w), range=rng, density=True,
+                            **kwargs)
+    fig.colorbar(im, ax=ax)
+    if refval is not None:
+        ax.scatter([refval[x]], [refval[y]], color=refval_color, marker="x")
+    ax.set_xlabel(x)
+    ax.set_ylabel(y)
+    return ax
+
+
+def plot_histogram_matrix(history, m: int = 0, t=None, size=None, refval=None,
+                          refval_color="C1", **kwargs):
+    df, w = history.get_distribution(m=m, t=t)
+    return plot_histogram_matrix_lowlevel(df, w, size, refval, refval_color,
+                                          **kwargs)
+
+
+def plot_histogram_matrix_lowlevel(df, w, size=None, refval=None,
+                                   refval_color="C1", **kwargs):
+    import matplotlib.pyplot as plt
+
+    names = list(df.columns)
+    n = len(names)
+    fig, axes = plt.subplots(n, n, squeeze=False)
+    if size is not None:
+        fig.set_size_inches(size)
+    for i, yi in enumerate(names):
+        for j, xj in enumerate(names):
+            ax = axes[i][j]
+            if i == j:
+                plot_histogram_1d_lowlevel(df, w, xj, ax=ax, refval=refval,
+                                           refval_color=refval_color,
+                                           **kwargs)
+            elif i > j:
+                plot_histogram_2d_lowlevel(df, w, xj, yi, ax=ax,
+                                           refval=refval,
+                                           refval_color=refval_color)
+            else:
+                ax.axis("off")
+    fig.tight_layout()
+    return axes

@@ -1,0 +1,163 @@
+"""Weighted KDE plots over posterior samples.
+
+Reference parity: ``pyabc/visualization/kde.py::{kde_1d, plot_kde_1d,
+plot_kde_1d_highlevel, kde_2d, plot_kde_2d, plot_kde_2d_highlevel,
+plot_kde_matrix, plot_kde_matrix_highlevel}`` — weighted gaussian KDE on a
+grid from (DataFrame, weights), with the same (df, w, x, ...) signatures so
+reference plotting code ports unchanged.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+from .util import get_figure
+
+
+def _weighted_gaussian_kde(values: np.ndarray, weights: np.ndarray,
+                           grid: np.ndarray, bw_factor: float = 1.0):
+    """1-D weighted gaussian KDE evaluated on ``grid`` (Silverman bw)."""
+    w = weights / weights.sum()
+    ess = 1.0 / np.sum(w**2)
+    mu = np.sum(w * values)
+    sd = np.sqrt(np.sum(w * (values - mu) ** 2))
+    if sd <= 0:
+        sd = max(abs(mu) * 1e-2, 1e-2)
+    bw = bw_factor * sd * ess ** (-1.0 / 5.0)
+    bw = max(bw, 1e-12)
+    z = (grid[:, None] - values[None, :]) / bw
+    dens = (np.exp(-0.5 * z * z) @ w) / (bw * np.sqrt(2 * np.pi))
+    return dens
+
+
+def kde_1d(df: pd.DataFrame, w: np.ndarray, x: str, xmin=None, xmax=None,
+           numx: int = 50, kde=None):
+    """(grid, density) for parameter ``x`` (reference kde_1d)."""
+    values = np.asarray(df[x], np.float64)
+    if xmin is None:
+        xmin = values.min()
+    if xmax is None:
+        xmax = values.max()
+    if xmax <= xmin:
+        xmin, xmax = xmin - 0.5, xmax + 0.5
+    grid = np.linspace(xmin, xmax, numx)
+    dens = _weighted_gaussian_kde(values, np.asarray(w, np.float64), grid)
+    return grid, dens
+
+
+def plot_kde_1d(df, w, x, xmin=None, xmax=None, numx=50, ax=None, size=None,
+                refval=None, refval_color="C1", kde=None, label=None,
+                **kwargs):
+    fig, ax = get_figure(ax, size)
+    grid, dens = kde_1d(df, w, x, xmin, xmax, numx, kde)
+    ax.plot(grid, dens, label=label, **kwargs)
+    ax.set_xlabel(x)
+    ax.set_ylabel("posterior density")
+    if refval is not None:
+        ax.axvline(refval[x] if isinstance(refval, dict) else refval,
+                   color=refval_color, linestyle="dotted")
+    return ax
+
+
+def plot_kde_1d_highlevel(history, x, m=0, t=None, **kwargs):
+    df, w = history.get_distribution(m=m, t=t)
+    return plot_kde_1d(df, w, x, **kwargs)
+
+
+def kde_2d(df, w, x, y, xmin=None, xmax=None, ymin=None, ymax=None,
+           numx: int = 50, numy: int = 50, kde=None):
+    """(X, Y, PDF) meshgrid for parameters x, y (reference kde_2d)."""
+    xv = np.asarray(df[x], np.float64)
+    yv = np.asarray(df[y], np.float64)
+    ww = np.asarray(w, np.float64)
+    ww = ww / ww.sum()
+    xmin = xv.min() if xmin is None else xmin
+    xmax = xv.max() if xmax is None else xmax
+    ymin = yv.min() if ymin is None else ymin
+    ymax = yv.max() if ymax is None else ymax
+    if xmax <= xmin:
+        xmin, xmax = xmin - 0.5, xmax + 0.5
+    if ymax <= ymin:
+        ymin, ymax = ymin - 0.5, ymax + 0.5
+    gx = np.linspace(xmin, xmax, numx)
+    gy = np.linspace(ymin, ymax, numy)
+    ess = 1.0 / np.sum(ww**2)
+    factor = ess ** (-1.0 / 6.0)  # silverman d=2
+
+    def bw(v):
+        mu = np.sum(ww * v)
+        sd = np.sqrt(np.sum(ww * (v - mu) ** 2))
+        return max(sd * factor, 1e-12)
+
+    bx, by = bw(xv), bw(yv)
+    zx = (gx[:, None] - xv[None, :]) / bx
+    zy = (gy[:, None] - yv[None, :]) / by
+    kx = np.exp(-0.5 * zx * zx) / (bx * np.sqrt(2 * np.pi))  # (numx, n)
+    ky = np.exp(-0.5 * zy * zy) / (by * np.sqrt(2 * np.pi))  # (numy, n)
+    pdf = np.einsum("xn,yn,n->yx", kx, ky, ww)
+    X, Y = np.meshgrid(gx, gy)
+    return X, Y, pdf
+
+
+def plot_kde_2d(df, w, x, y, xmin=None, xmax=None, ymin=None, ymax=None,
+                numx=50, numy=50, ax=None, size=None, colorbar=True,
+                title=True, refval=None, refval_color="C1", kde=None,
+                **kwargs):
+    fig, ax = get_figure(ax, size)
+    X, Y, PDF = kde_2d(df, w, x, y, xmin, xmax, ymin, ymax, numx, numy, kde)
+    mesh = ax.pcolormesh(X, Y, PDF, shading="auto", **kwargs)
+    if colorbar:
+        fig.colorbar(mesh, ax=ax)
+    ax.set_xlabel(x)
+    ax.set_ylabel(y)
+    if title:
+        ax.set_title("posterior KDE")
+    if refval is not None:
+        ax.scatter([refval[x]], [refval[y]], color=refval_color, marker="x")
+    return ax
+
+
+def plot_kde_2d_highlevel(history, x, y, m=0, t=None, **kwargs):
+    df, w = history.get_distribution(m=m, t=t)
+    return plot_kde_2d(df, w, x, y, **kwargs)
+
+
+def plot_kde_matrix(df, w, limits=None, colorbar=True, refval=None,
+                    refval_color="C1", kde=None, names=None, size=None):
+    """Matrix of 1d KDEs (diagonal) and 2d KDEs (off-diagonal)
+    (reference plot_kde_matrix)."""
+    import matplotlib.pyplot as plt
+
+    if names is None:
+        names = list(df.columns)
+    n = len(names)
+    fig, axes = plt.subplots(n, n, squeeze=False)
+    if size is not None:
+        fig.set_size_inches(size)
+    limits = limits or {}
+    for i, yi in enumerate(names):
+        for j, xj in enumerate(names):
+            ax = axes[i][j]
+            if i == j:
+                xmin, xmax = limits.get(xj, (None, None))
+                plot_kde_1d(df, w, xj, xmin=xmin, xmax=xmax, ax=ax,
+                            refval=refval, refval_color=refval_color)
+            elif i > j:
+                xmin, xmax = limits.get(xj, (None, None))
+                ymin, ymax = limits.get(yi, (None, None))
+                plot_kde_2d(df, w, xj, yi, xmin=xmin, xmax=xmax, ymin=ymin,
+                            ymax=ymax, ax=ax, colorbar=False, title=False,
+                            refval=refval, refval_color=refval_color)
+            else:
+                ax.axis("off")
+            if i < n - 1:
+                ax.set_xlabel("")
+            if j > 0:
+                ax.set_ylabel("")
+    fig.tight_layout()
+    return axes
+
+
+def plot_kde_matrix_highlevel(history, m=0, t=None, **kwargs):
+    df, w = history.get_distribution(m=m, t=t)
+    return plot_kde_matrix(df, w, **kwargs)

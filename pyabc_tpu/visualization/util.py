@@ -1,0 +1,35 @@
+"""Shared helpers for the visualization module.
+
+Reference parity: ``pyabc/visualization/util.py`` (histories/labels
+normalization helpers `to_lists`, `get_labels`).
+"""
+from __future__ import annotations
+
+from ..storage.history import History
+
+
+def to_lists(histories, labels=None):
+    """Normalize (history|list, labels|None) -> (list, list) (reference
+    to_lists/get_labels)."""
+    if isinstance(histories, History):
+        histories = [histories]
+    histories = list(histories)
+    if labels is None:
+        labels = [f"run {h.id}" for h in histories]
+    elif isinstance(labels, str):
+        labels = [labels]
+    if len(labels) != len(histories):
+        raise ValueError("labels and histories must have equal length")
+    return histories, labels
+
+
+def get_figure(ax=None, size=None):
+    import matplotlib.pyplot as plt
+
+    if ax is None:
+        fig, ax = plt.subplots()
+    else:
+        fig = ax.get_figure()
+    if size is not None:
+        fig.set_size_inches(size)
+    return fig, ax

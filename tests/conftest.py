@@ -13,8 +13,16 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax
 import numpy as np
 import pytest
+
+# Under axon the TPU tunnel ignores JAX_PLATFORMS; pin the default device to
+# the (virtual 8-way) CPU platform so tests compile locally and fast.
+try:
+    jax.config.update("jax_default_device", jax.devices("cpu")[0])
+except RuntimeError:  # pragma: no cover - no cpu platform registered
+    pass
 
 
 @pytest.fixture

@@ -1,0 +1,156 @@
+"""Statistical integration tests: full ABCSMC vs analytic posteriors.
+
+Mirrors the reference's gold standard (SURVEY.md §4): posterior-vs-analytic
+asserts with loose statistical tolerances, not bit-exact asserts
+(reference test/base/test_posterior_estimation.py).
+"""
+import jax
+import numpy as np
+import pytest
+
+import pyabc_tpu as pt
+
+PRIOR_SD = 1.0
+NOISE_SD = 0.5
+X_OBS = 1.0
+POST_VAR = 1.0 / (1 / PRIOR_SD**2 + 1 / NOISE_SD**2)
+POST_MU = POST_VAR * (X_OBS / NOISE_SD**2)
+
+
+def _gauss_jax_model():
+    @pt.JaxModel.from_function(["theta"], name="gauss")
+    def model(key, theta):
+        return {"x": theta[0] + NOISE_SD * jax.random.normal(key)}
+
+    return model
+
+
+def _posterior_moments(history, m=0, par="theta"):
+    df, w = history.get_distribution(m)
+    mu = float(np.sum(df[par] * w))
+    sd = float(np.sqrt(np.sum(w * (df[par] - mu) ** 2)))
+    return mu, sd
+
+
+class TestGaussianToyDevicePath:
+    def test_posterior_matches_conjugate(self):
+        prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+        abc = pt.ABCSMC(_gauss_jax_model(), prior, pt.PNormDistance(p=2),
+                        population_size=400, eps=pt.MedianEpsilon(), seed=1)
+        assert abc._device_capable
+        assert isinstance(abc.sampler, pt.BatchedSampler)
+        abc.new("sqlite://", {"x": X_OBS})
+        h = abc.run(max_nr_populations=5)
+        mu, sd = _posterior_moments(h)
+        assert mu == pytest.approx(POST_MU, abs=0.15)
+        assert sd == pytest.approx(np.sqrt(POST_VAR), abs=0.15)
+        # history telemetry recorded per generation
+        pops = h.get_all_populations()
+        assert len(pops) == h.n_populations + 1  # + PRE_TIME row
+        eps_vals = pops[pops.t >= 0]["epsilon"].to_numpy()
+        assert np.all(np.diff(eps_vals) < 0)  # shrinking thresholds
+
+    def test_uniform_prior_variant(self):
+        prior = pt.Distribution(theta=pt.RV("uniform", -3.0, 6.0))
+        abc = pt.ABCSMC(_gauss_jax_model(), prior, pt.PNormDistance(p=2),
+                        population_size=400, seed=2)
+        abc.new("sqlite://", {"x": X_OBS})
+        h = abc.run(max_nr_populations=5)
+        mu, sd = _posterior_moments(h)
+        # flat prior on [-3,3]: posterior ~ N(x_obs, noise_sd^2) truncated
+        assert mu == pytest.approx(X_OBS, abs=0.15)
+        assert sd == pytest.approx(NOISE_SD, abs=0.15)
+
+
+class TestGaussianToyHostPath:
+    def test_host_sampler_oracle(self):
+        """The scalar host path (reference semantics) on the same toy."""
+        rng = np.random.default_rng(0)
+
+        def model(pars):
+            return {"x": pars["theta"] + NOISE_SD * rng.normal()}
+
+        prior = pt.Distribution(theta=pt.ScipyRV(
+            __import__("scipy.stats", fromlist=["norm"]).norm(0, PRIOR_SD)
+        ))
+        np.random.seed(0)
+        abc = pt.ABCSMC(model, prior, pt.PNormDistance(p=2),
+                        population_size=150,
+                        eps=pt.QuantileEpsilon(initial_epsilon=1.0, alpha=0.5),
+                        sampler=pt.SingleCoreSampler())
+        assert not abc._device_capable
+        abc.new("sqlite://", {"x": X_OBS})
+        h = abc.run(max_nr_populations=4)
+        mu, sd = _posterior_moments(h)
+        assert mu == pytest.approx(POST_MU, abs=0.3)
+        assert sd == pytest.approx(np.sqrt(POST_VAR), abs=0.25)
+
+    def test_device_and_host_agree(self):
+        """Device kernel vs scalar oracle: same posterior within tolerance
+        (SURVEY.md §7.3.5 silent-bias guard)."""
+        prior_d = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+        abc_d = pt.ABCSMC(_gauss_jax_model(), prior_d, pt.PNormDistance(p=2),
+                          population_size=300,
+                          eps=pt.ListEpsilon([1.0, 0.5, 0.25]), seed=3)
+        abc_d.new("sqlite://", {"x": X_OBS})
+        h_d = abc_d.run(max_nr_populations=3)
+        mu_d, sd_d = _posterior_moments(h_d)
+
+        rng = np.random.default_rng(5)
+
+        def model(pars):
+            return {"x": pars["theta"] + NOISE_SD * rng.normal()}
+
+        import scipy.stats as st
+
+        prior_h = pt.Distribution(theta=pt.ScipyRV(st.norm(0, PRIOR_SD)))
+        np.random.seed(5)
+        abc_h = pt.ABCSMC(model, prior_h, pt.PNormDistance(p=2),
+                          population_size=300,
+                          eps=pt.ListEpsilon([1.0, 0.5, 0.25]),
+                          sampler=pt.SingleCoreSampler())
+        abc_h.new("sqlite://", {"x": X_OBS})
+        h_h = abc_h.run(max_nr_populations=3)
+        mu_h, sd_h = _posterior_moments(h_h)
+        assert mu_d == pytest.approx(mu_h, abs=0.2)
+        assert sd_d == pytest.approx(sd_h, abs=0.15)
+
+
+class TestResume:
+    def test_load_and_continue(self, tmp_path):
+        db = f"sqlite:///{tmp_path}/resume.db"
+        prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+        abc = pt.ABCSMC(_gauss_jax_model(), prior, pt.PNormDistance(p=2),
+                        population_size=200, seed=4)
+        abc.new(db, {"x": X_OBS})
+        h1 = abc.run(max_nr_populations=2)
+        assert h1.max_t == 1
+        run_id = h1.id
+
+        abc2 = pt.ABCSMC(_gauss_jax_model(), prior, pt.PNormDistance(p=2),
+                         population_size=200, seed=5)
+        h2 = abc2.load(db, run_id)
+        assert h2.max_t == 1
+        h2 = abc2.run(max_nr_populations=4)
+        assert h2.max_t == 3
+        mu, sd = _posterior_moments(h2)
+        assert mu == pytest.approx(POST_MU, abs=0.25)
+
+
+class TestStoppingRules:
+    def test_minimum_epsilon(self):
+        prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+        abc = pt.ABCSMC(_gauss_jax_model(), prior, pt.PNormDistance(p=2),
+                        population_size=100, seed=6)
+        abc.new("sqlite://", {"x": X_OBS})
+        h = abc.run(minimum_epsilon=0.8, max_nr_populations=10)
+        # MedianEpsilon halves each generation; should stop well before 10
+        assert h.n_populations < 6
+
+    def test_max_total_simulations(self):
+        prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+        abc = pt.ABCSMC(_gauss_jax_model(), prior, pt.PNormDistance(p=2),
+                        population_size=100, seed=7)
+        abc.new("sqlite://", {"x": X_OBS})
+        h = abc.run(max_nr_populations=10, max_total_nr_simulations=600)
+        assert h.n_populations < 10

@@ -1,0 +1,98 @@
+"""Visualization smoke tests: every plot runs against a tiny History.
+
+Mirrors reference test/visualization/test_visualization.py (no-crash + axes
+invariants, Agg backend).
+"""
+import matplotlib
+
+matplotlib.use("Agg")
+
+import jax
+import numpy as np
+import pytest
+
+import pyabc_tpu as pt
+import pyabc_tpu.visualization as viz
+
+
+@pytest.fixture(scope="module")
+def history():
+    @pt.JaxModel.from_function(["a", "b"], name="toy")
+    def model(key, theta):
+        k1, k2 = jax.random.split(key)
+        return {
+            "x": theta[0] + 0.3 * jax.random.normal(k1),
+            "y": theta[1] + 0.3 * jax.random.normal(k2),
+        }
+
+    prior = pt.Distribution(a=pt.RV("norm", 0.0, 1.0),
+                            b=pt.RV("uniform", -2.0, 4.0))
+    abc = pt.ABCSMC(model, prior, pt.AdaptivePNormDistance(p=2),
+                    population_size=60, seed=0)
+    abc.new("sqlite://", {"x": 0.5, "y": 0.5})
+    h = abc.run(max_nr_populations=3)
+    h._distance = abc.distance_function
+    return h
+
+
+def test_kde_1d(history):
+    ax = viz.plot_kde_1d_highlevel(history, "a", refval={"a": 0.5})
+    assert ax.get_xlabel() == "a"
+
+
+def test_kde_2d(history):
+    ax = viz.plot_kde_2d_highlevel(history, "a", "b")
+    assert ax.get_xlabel() == "a" and ax.get_ylabel() == "b"
+
+
+def test_kde_matrix(history):
+    axes = viz.plot_kde_matrix_highlevel(history)
+    assert len(axes) == 2
+
+
+def test_histograms(history):
+    viz.plot_histogram_1d(history, "a")
+    viz.plot_histogram_2d(history, "a", "b")
+    axes = viz.plot_histogram_matrix(history)
+    assert len(axes) == 2
+
+
+def test_epsilons(history):
+    ax = viz.plot_epsilons(history)
+    assert "epsilon" in ax.get_ylabel()
+
+
+def test_sample_numbers(history):
+    viz.plot_sample_numbers(history)
+    ax = viz.plot_sample_numbers_trajectory(history)
+    assert ax.get_ylabel() == "simulations"
+
+
+def test_acceptance_rates(history):
+    ax = viz.plot_acceptance_rates_trajectory(history)
+    assert ax.get_ylabel() == "acceptance rate"
+
+
+def test_model_probabilities(history):
+    ax = viz.plot_model_probabilities(history)
+    assert ax.get_ylabel() == "model probability"
+
+
+def test_effective_sample_sizes(history):
+    viz.plot_effective_sample_sizes(history, relative=True)
+
+
+def test_walltimes(history):
+    viz.plot_total_walltime(history)
+    viz.plot_walltime(history)
+
+
+def test_credible_intervals(history):
+    axes = viz.plot_credible_intervals(history, levels=(0.5, 0.95))
+    assert len(axes) == 2
+    viz.plot_credible_intervals_for_time([history], t=history.max_t)
+
+
+def test_distance_weights(history):
+    ax = viz.plot_distance_weights(history._distance)
+    assert ax.get_ylabel() == "weight"
