@@ -93,24 +93,29 @@ class UniformAcceptor(Acceptor):
     def __init__(self, use_complete_history: bool = False):
         self.use_complete_history = bool(use_complete_history)
         self._eps_history: dict[int, float] = {}
-        self._distance_changed_ts: set[int] = set()
 
     def note_epsilon(self, t: int, eps_value: float,
                      distance_changed: bool) -> None:
-        """Orchestrator hook: record the threshold used at generation t."""
-        self._eps_history[t] = float(eps_value)
+        """Orchestrator hook: record the threshold used at generation t.
+
+        When the distance function changed, thresholds recorded under the
+        previous weighting are incomparable to new distance values — the
+        trail restarts (both paths share this rule).
+        """
         if distance_changed:
-            self._distance_changed_ts.add(t)
+            self._eps_history.clear()
+        self._eps_history[t] = float(eps_value)
+
+    def _historic_min(self, t: int | None) -> float:
+        vals = [e for s, e in self._eps_history.items()
+                if t is None or s < t]
+        return min(vals) if vals else np.inf
 
     def __call__(self, distance_function, eps, x, x_0, t, par) -> AcceptorResult:
         d = distance_function(x, x_0, t, par)
         accept = d <= eps(t)
         if accept and self.use_complete_history:
-            # only thresholds since the last distance change are comparable
-            for s, e in self._eps_history.items():
-                if s < t and s not in self._distance_changed_ts and d > e:
-                    accept = False
-                    break
+            accept = d <= self._historic_min(t)
         return AcceptorResult(distance=d, accept=bool(accept))
 
     def is_device_compatible(self) -> bool:
@@ -119,10 +124,7 @@ class UniformAcceptor(Acceptor):
     def device_params(self, t=None):
         if not self.use_complete_history:
             return ()
-        # min over applicable historical thresholds, as a single scalar
-        vals = [e for s, e in self._eps_history.items()
-                if t is None or s < t]
-        return jnp.asarray(min(vals) if vals else np.inf, jnp.float32)
+        return jnp.asarray(self._historic_min(t), jnp.float32)
 
     def device_fn(self, distance_device_fn):
         use_hist = self.use_complete_history

@@ -31,6 +31,7 @@ class TemperatureScheme:
     """Base: __call__(t, **ctx) -> proposed temperature."""
 
     def __call__(self, t: int, *, get_weighted_distances=None,
+                 get_all_records=None,
                  pdf_norm: float | None = None, kernel_scale: str = "SCALE_LOG",
                  prev_temperature: float | None = None,
                  acceptance_rate: float | None = None,
@@ -45,21 +46,29 @@ class AcceptanceRateScheme(TemperatureScheme):
     """Choose T so the *predicted* acceptance rate hits ``target_rate``
     (reference AcceptanceRateScheme).
 
-    The prediction model: importance-weighted mean over last-generation
-    kernel values v_i of min(1, exp((v_i - pdf_norm)/T)); bisection on
-    log10(T).
+    The prediction model: mean over kernel values v_i of
+    min(1, exp((v_i - pdf_norm)/T)); bisection on log10(T). Prefers the
+    ALL-simulations record (accepted + rejected — these are
+    proposal-distributed, so their uniform mean estimates E_q[accept prob]
+    unbiasedly); falls back to the importance-weighted accepted set.
     """
 
-    def __init__(self, target_rate: float = 0.3, min_rate: float | None = None):
+    def __init__(self, target_rate: float = 0.3):
         self.target_rate = float(target_rate)
-        self.min_rate = min_rate
 
-    def __call__(self, t, *, get_weighted_distances=None, pdf_norm=None,
-                 kernel_scale="SCALE_LOG", prev_temperature=None,
-                 acceptance_rate=None, max_nr_populations=None) -> float:
-        if get_weighted_distances is None or pdf_norm is None:
+    def __call__(self, t, *, get_weighted_distances=None, get_all_records=None,
+                 pdf_norm=None, kernel_scale="SCALE_LOG",
+                 prev_temperature=None, acceptance_rate=None,
+                 max_nr_populations=None) -> float:
+        if pdf_norm is None:
             return np.inf
-        df = get_weighted_distances()
+        df = None
+        if get_all_records is not None:
+            df = get_all_records()
+        if df is None or len(df) == 0:
+            if get_weighted_distances is None:
+                return np.inf
+            df = get_weighted_distances()
         vals = np.asarray(df["distance"], np.float64)
         if kernel_scale == "SCALE_LIN":
             vals = np.log(np.maximum(vals, 1e-300))
@@ -280,14 +289,15 @@ class Temperature(Epsilon):
                    max_nr_populations=None, acceptor_config=None):
         self._max_nr_populations = max_nr_populations
         self._set(t, get_weighted_distances, acceptor_config,
-                  acceptance_rate=None)
+                  acceptance_rate=None, get_all_records=get_all_records)
 
     def update(self, t, get_weighted_distances=None, get_all_records=None,
                acceptance_rate=None, acceptor_config=None):
-        self._set(t, get_weighted_distances, acceptor_config, acceptance_rate)
+        self._set(t, get_weighted_distances, acceptor_config, acceptance_rate,
+                  get_all_records=get_all_records)
 
     def _set(self, t, get_weighted_distances, acceptor_config,
-             acceptance_rate):
+             acceptance_rate, get_all_records=None):
         acceptor_config = acceptor_config or {}
         pdf_norm = acceptor_config.get("pdf_norm")
         kernel_scale = acceptor_config.get("kernel_scale", "SCALE_LOG")
@@ -305,6 +315,7 @@ class Temperature(Epsilon):
             else:
                 temp = init(
                     t, get_weighted_distances=get_weighted_distances,
+                    get_all_records=get_all_records,
                     pdf_norm=pdf_norm, kernel_scale=kernel_scale,
                     prev_temperature=None, acceptance_rate=acceptance_rate,
                     max_nr_populations=self._max_nr_populations,
@@ -317,6 +328,7 @@ class Temperature(Epsilon):
                 try:
                     proposals.append(scheme(
                         t, get_weighted_distances=get_weighted_distances,
+                        get_all_records=get_all_records,
                         pdf_norm=pdf_norm, kernel_scale=kernel_scale,
                         prev_temperature=prev,
                         acceptance_rate=acceptance_rate,

@@ -49,6 +49,21 @@ from .util import DeviceContext, create_simulate_function
 logger = logging.getLogger("ABC")
 
 
+def _call_filtered(fn, **kwargs):
+    """Call fn with only the kwargs its signature accepts.
+
+    Components follow the reference lifecycle signatures loosely (user
+    subclasses may omit newer kwargs); filtering by signature keeps the
+    dispatch tolerant WITHOUT swallowing errors raised inside fn.
+    """
+    import inspect
+
+    sig = inspect.signature(fn)
+    if any(p.kind == p.VAR_KEYWORD for p in sig.parameters.values()):
+        return fn(**kwargs)
+    return fn(**{k: v for k, v in kwargs.items() if k in sig.parameters})
+
+
 class GenerationSpec:
     """The unit handed to samplers: scalar closure + device kernel context."""
 
@@ -304,6 +319,27 @@ class ABCSMC:
         pop.proposal_ids = getattr(sample, "accepted_proposal_ids", None)
         return pop
 
+    def _all_records_provider(self, sample) -> Callable:
+        """() -> DataFrame['distance','accepted'] over ALL recorded
+        simulations (proposal-distributed; used by AcceptanceRateScheme),
+        or None when rejected records were not kept."""
+        def provider():
+            import pandas as pd
+
+            if sample.all_distances is not None:
+                return pd.DataFrame({
+                    "distance": sample.all_distances,
+                    "accepted": sample.all_accepted,
+                })
+            host = getattr(sample, "host_all_records", None)
+            if host is not None:
+                return pd.DataFrame({
+                    "distance": host[1], "accepted": host[2],
+                })
+            return None
+
+        return provider
+
     def _all_sumstats_provider(self, sample) -> Callable:
         """() -> (n, S) matrix of all recorded sum stats for adaptive comps."""
         def provider():
@@ -346,13 +382,21 @@ class ABCSMC:
         return self.acceptor.get_epsilon_config(t)
 
     # ------------------------------------------------------------------ run
-    def run(self, minimum_epsilon: float = 0.0,
+    def run(self, minimum_epsilon: float | None = None,
             max_nr_populations: float = np.inf,
             min_acceptance_rate: float = 0.0,
             max_total_nr_simulations: float = np.inf,
             max_walltime: datetime.timedelta | float | None = None) -> History:
         if self.history is None:
             raise RuntimeError("call .new(db, observed) or .load(db, id) first")
+        if minimum_epsilon is None:
+            # reference default: temperature schedules stop at T = 1 (exact
+            # posterior); distance thresholds run to the other criteria
+            from ..epsilon import Temperature
+
+            minimum_epsilon = (
+                1.0 if isinstance(self.eps, Temperature) else 0.0
+            )
         self.minimum_epsilon = minimum_epsilon
         start_walltime = time.time()
         if isinstance(max_walltime, datetime.timedelta):
@@ -419,19 +463,18 @@ class ABCSMC:
             if changed:
                 self._recompute_distances(pop, t + 1)
             get_wd = lambda: pop.get_weighted_distances()  # noqa: E731
-            self.acceptor.update(
-                t + 1, get_weighted_distances=get_wd,
+            _call_filtered(
+                self.acceptor.update,
+                t=t + 1, get_weighted_distances=get_wd,
                 prev_temp=current_eps, acceptance_rate=acceptance_rate,
             )
-            try:
-                self.eps.update(
-                    t + 1, get_weighted_distances=get_wd,
-                    get_all_records=all_ss,
-                    acceptance_rate=acceptance_rate,
-                    acceptor_config=self._acceptor_config(t + 1),
-                )
-            except TypeError:
-                self.eps.update(t + 1, get_wd)
+            _call_filtered(
+                self.eps.update,
+                t=t + 1, get_weighted_distances=get_wd,
+                get_all_records=self._all_records_provider(sample),
+                acceptance_rate=acceptance_rate,
+                acceptor_config=self._acceptor_config(t + 1),
+            )
             self.population_strategy.update(
                 [self.transitions[m] for m in pop.get_alive_models()],
                 np.asarray(
@@ -508,26 +551,36 @@ class ABCSMC:
                 "w": np.full(len(calib_distances), 1.0 / len(calib_distances)),
             })
 
-        self.acceptor.initialize(
-            0,
-            get_weighted_distances=get_wd if calib_distances is not None else None,
+        def get_records():
+            if calib_distances is None:
+                return None
+            return pd.DataFrame({
+                "distance": calib_distances,
+                "accepted": np.ones(len(calib_distances), bool),
+            })
+
+        _call_filtered(
+            self.acceptor.initialize,
+            t=0,
+            get_weighted_distances=(
+                get_wd if calib_distances is not None else None
+            ),
             distance_function=self.distance_function,
             x_0=self.x_0,
         )
-        try:
-            self.eps.initialize(
-                0,
-                get_weighted_distances=(
-                    get_wd if calib_distances is not None else None
-                ),
-                max_nr_populations=(
-                    int(max_nr_populations)
-                    if np.isfinite(max_nr_populations) else None
-                ),
-                acceptor_config=self._acceptor_config(0),
-            )
-        except TypeError:
-            self.eps.initialize(0, get_wd if calib_distances is not None else None)
+        _call_filtered(
+            self.eps.initialize,
+            t=0,
+            get_weighted_distances=(
+                get_wd if calib_distances is not None else None
+            ),
+            get_all_records=get_records,
+            max_nr_populations=(
+                int(max_nr_populations)
+                if np.isfinite(max_nr_populations) else None
+            ),
+            acceptor_config=self._acceptor_config(0),
+        )
 
     def _restore_state(self, t_last: int,
                        max_nr_populations: float = np.inf) -> None:
@@ -545,13 +598,11 @@ class ABCSMC:
             t_last + 1, (lambda: stats), self.x_0
         )
         wd0 = self.history.get_weighted_distances(t_last)
-        try:
-            self.acceptor.initialize(
-                t_last + 1, get_weighted_distances=lambda: wd0,
-                distance_function=self.distance_function, x_0=self.x_0,
-            )
-        except TypeError:
-            pass
+        _call_filtered(
+            self.acceptor.initialize,
+            t=t_last + 1, get_weighted_distances=lambda: wd0,
+            distance_function=self.distance_function, x_0=self.x_0,
+        )
         for m in self._model_probs:
             df, w = self.history.get_distribution(m, t_last)
             df = df[[c for c in df.columns if c != "pid"]]
@@ -573,9 +624,16 @@ class ABCSMC:
             for i in range(stats_mat.shape[0])
         ])
         wd = pd.DataFrame({"distance": new_d, "w": ws / ws.sum()})
-        try:
-            self.eps.initialize(
-                t_last + 1,
+        from ..epsilon import QuantileEpsilon
+
+        if isinstance(self.eps, QuantileEpsilon):
+            # a float initial_epsilon is a fresh-start value; on resume the
+            # threshold must come from the stored population's distances
+            self.eps.update(t_last + 1, get_weighted_distances=lambda: wd)
+        else:
+            _call_filtered(
+                self.eps.initialize,
+                t=t_last + 1,
                 get_weighted_distances=lambda: wd,
                 max_nr_populations=(
                     int(max_nr_populations)
@@ -583,5 +641,3 @@ class ABCSMC:
                 ),
                 acceptor_config=self._acceptor_config(t_last + 1),
             )
-        except (TypeError, ValueError):
-            pass

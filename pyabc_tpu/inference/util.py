@@ -185,11 +185,7 @@ def create_simulate_function(t, *, model_probabilities,
 # Device (batched, jitted) path
 # ===========================================================================
 
-def _pow2_bucket(n: int, lo: int = 64) -> int:
-    b = lo
-    while b < n:
-        b *= 2
-    return b
+from ..utils import pow2_bucket as _pow2_bucket
 
 
 def pad_transition_params(params: dict, n_cap: int, d_max: int) -> dict:

@@ -21,14 +21,8 @@ from __future__ import annotations
 import numpy as np
 
 from ..core.random import round_key
+from ..utils import pow2_bucket as _pow2
 from .base import Sample, Sampler
-
-
-def _pow2(n: int, lo: int, hi: int) -> int:
-    b = lo
-    while b < n and b < hi:
-        b *= 2
-    return min(b, hi)
 
 
 class BatchedSampler(Sampler):

@@ -96,15 +96,22 @@ class _MulticoreBase(Sampler):
             return simulate_one.host_simulate_one
         return simulate_one
 
-    def _drain_rejected(self, sample: Sample, rej_q) -> None:
+    def _drain_rejected(self, sample: Sample, rej_q, workers=()) -> None:
+        """Drain the rejected-record queue BEFORE joining workers: a child
+        cannot exit while its queue feeder thread still holds undelivered
+        records (the pipe is small), so join-before-drain deadlocks."""
         if not sample.record_rejected:
             return
         records = []
-        try:
-            while True:
+        while True:
+            try:
                 records.append(rej_q.get_nowait())
-        except queue_mod.Empty:
-            pass
+            except queue_mod.Empty:
+                if not any(w.is_alive() for w in workers):
+                    break
+                import time
+
+                time.sleep(0.005)
         if records:
             sample.host_all_records = (
                 [r[0] for r in records],
@@ -146,6 +153,7 @@ class MulticoreEvalParallelSampler(_MulticoreBase):
                 done += 1
             else:
                 collected.append(item)
+        self._drain_rejected(sample, rej_q, workers)
         for w in workers:
             w.join()
         self.nr_evaluations_ = n_eval.value
@@ -154,7 +162,6 @@ class MulticoreEvalParallelSampler(_MulticoreBase):
         collected = collected[:n]
         sample.accepted_particles = [p for _, p in collected]
         sample.accepted_proposal_ids = np.asarray([s for s, _ in collected])
-        self._drain_rejected(sample, rej_q)
         return sample
 
 
@@ -195,10 +202,10 @@ class MulticoreParticleParallelSampler(_MulticoreBase):
                 done += 1
             else:
                 particles.append(item[1])
+        self._drain_rejected(sample, rej_q, workers)
         for w in workers:
             w.join()
         self.nr_evaluations_ = n_eval
         sample.accepted_particles = particles[:n]
         sample.accepted_proposal_ids = np.arange(len(sample.accepted_particles))
-        self._drain_rejected(sample, rej_q)
         return sample

@@ -1,0 +1,15 @@
+"""Shared small utilities."""
+from __future__ import annotations
+
+
+def pow2_bucket(n: int, lo: int = 64, hi: int | None = None) -> int:
+    """Round n up to a power-of-two bucket in [lo, hi].
+
+    Static-shape XLA programs are compiled per shape; quantizing batch and
+    capacity dimensions to power-of-two buckets bounds the number of distinct
+    compilations over a run.
+    """
+    b = lo
+    while b < n and (hi is None or b < hi):
+        b *= 2
+    return b if hi is None else min(b, hi)

@@ -1,0 +1,78 @@
+"""Multi-model inference (model selection) statistical tests — config 5.
+
+Mirrors the reference's model-selection integration test: two analytically
+tractable models, posterior model probabilities vs exact Bayes factors
+(SURVEY.md §4 'model selection with two analytically tractable models').
+"""
+import numpy as np
+import pytest
+
+import pyabc_tpu as pt
+from pyabc_tpu.models import model_selection as msel
+
+X_OBS = 1.0
+
+
+class TestTractablePair:
+    def test_model_posterior_matches_bayes_factor(self):
+        models, priors, analytic = msel.tractable_pair()
+        abc = pt.ABCSMC(
+            models, priors, pt.PNormDistance(p=2),
+            population_size=600,
+            eps=pt.MedianEpsilon(),
+            seed=7,
+        )
+        assert abc._device_capable
+        abc.new("sqlite://", {"x": X_OBS})
+        h = abc.run(max_nr_populations=6)
+        probs = h.get_model_probabilities(h.max_t)
+        expected = analytic(X_OBS)
+        # as eps -> 0, p(m | d < eps) -> exact model posterior; tolerate
+        # SMC noise at finite eps
+        for m in range(2):
+            p = float(probs["p"].get(m, 0.0))
+            assert p == pytest.approx(expected[m], abs=0.15), (m, p, expected)
+
+    def test_within_model_posterior(self):
+        models, priors, _ = msel.tractable_pair()
+        abc = pt.ABCSMC(models, priors, pt.PNormDistance(p=2),
+                        population_size=600, seed=8)
+        abc.new("sqlite://", {"x": X_OBS})
+        h = abc.run(max_nr_populations=6)
+        # theta posterior within model 0: conjugate N with sd 0.6 noise
+        sd = 0.6
+        post_var = 1.0 / (1.0 + 1.0 / sd**2)
+        post_mu = post_var * X_OBS / sd**2
+        df, w = h.get_distribution(0)
+        mu = float(np.sum(df["theta"] * w))
+        assert mu == pytest.approx(post_mu, abs=0.2)
+
+    def test_history_tracks_alive_models(self):
+        models, priors, _ = msel.tractable_pair()
+        abc = pt.ABCSMC(models, priors, pt.PNormDistance(p=2),
+                        population_size=200, seed=9)
+        abc.new("sqlite://", {"x": X_OBS})
+        h = abc.run(max_nr_populations=3)
+        alive = h.alive_models(h.max_t)
+        assert set(alive) <= {0, 1} and len(alive) >= 1
+        probs_all = h.get_model_probabilities()
+        assert probs_all.shape[0] == h.n_populations
+
+
+class TestHeterogeneousDims:
+    """Models with different parameter dimensionality in one run (exercises
+    theta padding + per-branch density normalization)."""
+
+    def test_ode_family_runs(self):
+        models, priors, _ = msel.ode_family(n_obs=8, t1=6.0)
+        obs = msel.observed_ode_family(seed=3, true_model=1, n_obs=8, t1=6.0)
+        abc = pt.ABCSMC(models, priors, pt.PNormDistance(p=2),
+                        population_size=250, seed=10)
+        assert abc._device_capable
+        abc.new("sqlite://", obs)
+        h = abc.run(max_nr_populations=4)
+        probs = h.get_model_probabilities(h.max_t)
+        assert probs["p"].sum() == pytest.approx(1.0)
+        # the 1-param pure-decay model cannot fit the production plateau;
+        # it should not dominate
+        assert float(probs["p"].get(0, 0.0)) < 0.9

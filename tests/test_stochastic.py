@@ -1,0 +1,148 @@
+"""Stochastic (noisy) ABC integration tests — config 4.
+
+Mirrors the reference's stochastic-acceptor tests: StochasticAcceptor +
+kernel distance + Temperature must recover the exact-likelihood posterior
+(SURVEY.md §4 'stochastic-acceptor vs exact likelihood').
+"""
+import jax
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import pyabc_tpu as pt
+
+NOISE_SD = 0.7
+PRIOR_SD = 1.0
+X_OBS = 0.8
+
+
+def _deterministic_model():
+    """Simulator with NO sampling noise: y(theta) = theta; noise lives in
+    the kernel (the canonical noisy-ABC formulation)."""
+
+    @pt.JaxModel.from_function(["theta"], name="det")
+    def model(key, theta):
+        return {"x": theta[0]}
+
+    return model
+
+
+def exact_posterior():
+    var = 1.0 / (1 / PRIOR_SD**2 + 1 / NOISE_SD**2)
+    return var * X_OBS / NOISE_SD**2, np.sqrt(var)
+
+
+class TestStochasticAcceptorDevicePath:
+    def test_recovers_exact_posterior(self):
+        model = _deterministic_model()
+        prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+        kernel = pt.IndependentNormalKernel(var=[NOISE_SD**2])
+        abc = pt.ABCSMC(
+            model, prior, kernel,
+            population_size=500,
+            eps=pt.Temperature(),
+            acceptor=pt.StochasticAcceptor(),
+            seed=21,
+        )
+        abc.new("sqlite://", {"x": X_OBS})
+        # default minimum_epsilon stops at T = 1 (exact posterior), the
+        # reference convention for temperature schedules
+        h = abc.run(max_nr_populations=8)
+        mu_true, sd_true = exact_posterior()
+        df, w = h.get_distribution(0)
+        mu = float(np.sum(df["theta"] * w))
+        sd = float(np.sqrt(np.sum(w * (df["theta"] - mu) ** 2)))
+        assert mu == pytest.approx(mu_true, abs=0.15)
+        assert sd == pytest.approx(sd_true, abs=0.15)
+        # temperature must have decayed to exactly 1 in the final generation
+        assert abc.eps(h.max_t) == pytest.approx(1.0)
+
+    def test_requires_temperature_pairing(self):
+        model = _deterministic_model()
+        prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+        with pytest.raises(ValueError, match="Temperature"):
+            pt.ABCSMC(model, prior, pt.IndependentNormalKernel(var=[1.0]),
+                      acceptor=pt.StochasticAcceptor(), eps=pt.MedianEpsilon())
+        with pytest.raises(ValueError, match="StochasticKernel"):
+            pt.ABCSMC(model, prior, pt.PNormDistance(p=2),
+                      acceptor=pt.StochasticAcceptor(), eps=pt.Temperature())
+
+
+class TestTemperatureSchemes:
+    def _ctx(self, temps=None):
+        import pandas as pd
+
+        vals = -np.abs(np.random.default_rng(0).normal(0, 5, 300))
+        return {
+            "get_weighted_distances": lambda: pd.DataFrame(
+                {"distance": vals, "w": np.full(300, 1 / 300)}
+            ),
+            "pdf_norm": 0.0,
+            "kernel_scale": "SCALE_LOG",
+        }
+
+    def test_acceptance_rate_scheme_hits_target(self):
+        scheme = pt.AcceptanceRateScheme(target_rate=0.3)
+        ctx = self._ctx()
+        T = scheme(1, prev_temperature=1e4, **ctx)
+        df = ctx["get_weighted_distances"]()
+        rate = np.mean(np.minimum(1.0, np.exp(df["distance"] / T)))
+        assert rate == pytest.approx(0.3, abs=0.05)
+
+    def test_exp_decay_fixed_iter_lands_at_one(self):
+        scheme = pt.ExpDecayFixedIterScheme()
+        T = 256.0
+        temps = []
+        for t in range(1, 9):
+            T = scheme(t, prev_temperature=T, max_nr_populations=9)
+            temps.append(T)
+        assert temps[-1] == pytest.approx(1.0)
+        assert all(np.diff(temps) < 0)
+
+    def test_exp_decay_fixed_ratio(self):
+        scheme = pt.ExpDecayFixedRatioScheme(alpha=0.5)
+        assert scheme(1, prev_temperature=8.0) == pytest.approx(4.0)
+
+    def test_ess_scheme_monotone(self):
+        scheme = pt.EssScheme(target_relative_ess=0.8)
+        ctx = self._ctx()
+        T = scheme(1, prev_temperature=None, **ctx)
+        assert T >= 1.0
+
+    def test_temperature_enforces_decay_and_final_one(self):
+        temp = pt.Temperature()
+        import pandas as pd
+
+        df = pd.DataFrame({"distance": -np.abs(
+            np.random.default_rng(1).normal(0, 3, 200)),
+            "w": np.full(200, 1 / 200)})
+        temp.initialize(0, get_weighted_distances=lambda: df,
+                        max_nr_populations=4,
+                        acceptor_config={"pdf_norm": 0.0,
+                                         "kernel_scale": "SCALE_LOG"})
+        t0 = temp(0)
+        temp.update(1, get_weighted_distances=lambda: df,
+                    acceptance_rate=0.3,
+                    acceptor_config={"pdf_norm": 0.0,
+                                     "kernel_scale": "SCALE_LOG"})
+        assert temp(1) <= t0
+        temp.update(3, get_weighted_distances=lambda: df,
+                    acceptance_rate=0.3,
+                    acceptor_config={"pdf_norm": 0.0,
+                                     "kernel_scale": "SCALE_LOG"})
+        assert temp(3) == 1.0
+
+
+class TestPdfNorm:
+    def test_max_found(self):
+        assert pt.pdf_norm_max_found(pdf_max=None, max_found=-2.0,
+                                     prev_pdf_norm=-5.0) == -2.0
+        assert pt.pdf_norm_max_found(pdf_max=-1.0, max_found=-2.0,
+                                     prev_pdf_norm=None) == -1.0
+
+    def test_scaled(self):
+        norm = pt.ScaledPDFNorm(factor=10)
+        vals = np.linspace(-50, -10, 100)
+        out = norm(kernel_val=vals, pdf_max=None, max_found=-10.0,
+                   prev_pdf_norm=None)
+        assert out <= -10.0 + 1e-9
