@@ -422,6 +422,130 @@ class DeviceContext:
         self._kernels[cache_key] = fn
         return fn
 
+    # ---------------------------------------------------- fused generation
+    def generation_kernel(self, B: int, mode: str, n_cap: int, rec_cap: int,
+                          max_rounds: int):
+        """One jitted program for a WHOLE generation: a ``lax.while_loop``
+        keeps proposing B-lane rounds until n_cap acceptances (or the round
+        budget), compacting accepted lanes into a fixed reservoir in
+        proposal order — the deterministic slot-ordered trim happens by
+        construction, and the host sees exactly ONE dispatch per generation
+        (the TPU replacement for the reference's Redis counters/queues).
+
+        A bounded record ring (rec_cap) keeps (sumstat, distance, accepted)
+        of the first rec_cap evaluations for the adaptive components
+        (reference ``max_nr_rejected`` cap).
+        """
+        cache_key = ("fused", B, mode, n_cap, rec_cap, max_rounds)
+        if cache_key in self._kernels:
+            return self._kernels[cache_key]
+
+        lane = {
+            "prior": self._lane_prior,
+            "transition": self._lane_transition,
+            "calibration": self._lane_calibration,
+        }[mode]
+        d_max, S = self.d_max, self.spec.total_size
+        all_accept = mode == "calibration"
+
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            axis = self.mesh.axis_names[0]
+            lane_sharding = NamedSharding(self.mesh, P(axis))
+        else:
+            lane_sharding = None
+
+        def run_lanes(key, dyn):
+            keys = jax.random.split(key, B)
+            if lane_sharding is not None:
+                keys = jax.lax.with_sharding_constraint(keys, lane_sharding)
+            return jax.vmap(lambda k: lane(k, dyn))(keys)
+
+        def generation_fn(key, dyn):
+            res0 = {
+                "m": jnp.zeros((n_cap,), jnp.int32),
+                "theta": jnp.zeros((n_cap, d_max), jnp.float32),
+                "sumstats": jnp.zeros((n_cap, S), jnp.float32),
+                "distance": jnp.zeros((n_cap,), jnp.float32),
+                "log_weight": jnp.full((n_cap,), -jnp.inf, jnp.float32),
+                "slot": jnp.full((n_cap,), -1, jnp.int32),
+            }
+            rec0 = {
+                "sumstats": jnp.zeros((rec_cap, S), jnp.float32),
+                "distance": jnp.zeros((rec_cap,), jnp.float32),
+                "accepted": jnp.zeros((rec_cap,), bool),
+                "valid": jnp.zeros((rec_cap,), bool),
+            }
+            state0 = (jnp.zeros((), jnp.int32),  # n_acc
+                      jnp.zeros((), jnp.int32),  # round
+                      res0, rec0)
+
+            def cond(state):
+                n_acc, r, _, _ = state
+                return (n_acc < n_cap) & (r < max_rounds)
+
+            def body(state):
+                n_acc, r, res, rec = state
+                out = run_lanes(jax.random.fold_in(key, r), dyn)
+                acc = out["valid"] if all_accept else (
+                    out["accepted"] & out["valid"]
+                )
+                lanes = jnp.arange(B, dtype=jnp.int32)
+                slots = r * B + lanes
+                # compaction: lane i's accepted rank within this round
+                rank = jnp.cumsum(acc.astype(jnp.int32)) - 1
+                pos = n_acc + rank
+                write_pos = jnp.where(acc & (pos < n_cap), pos, n_cap)
+                res = {
+                    "m": res["m"].at[write_pos].set(
+                        out["m"].astype(jnp.int32), mode="drop"),
+                    "theta": res["theta"].at[write_pos].set(
+                        out["theta"], mode="drop"),
+                    "sumstats": res["sumstats"].at[write_pos].set(
+                        out["sumstats"], mode="drop"),
+                    "distance": res["distance"].at[write_pos].set(
+                        out["distance"], mode="drop"),
+                    "log_weight": res["log_weight"].at[write_pos].set(
+                        jnp.where(all_accept, 0.0, out["log_weight"]),
+                        mode="drop"),
+                    "slot": res["slot"].at[write_pos].set(
+                        slots, mode="drop"),
+                }
+                # record ring: first rec_cap evaluations, in slot order
+                rec_pos = jnp.where(out["valid"] & (slots < rec_cap),
+                                    slots, rec_cap)
+                rec = {
+                    "sumstats": rec["sumstats"].at[rec_pos].set(
+                        out["sumstats"], mode="drop"),
+                    "distance": rec["distance"].at[rec_pos].set(
+                        out["distance"], mode="drop"),
+                    "accepted": rec["accepted"].at[rec_pos].set(
+                        acc, mode="drop"),
+                    "valid": rec["valid"].at[rec_pos].set(
+                        out["valid"], mode="drop"),
+                }
+                return (n_acc + jnp.sum(acc, dtype=jnp.int32), r + 1,
+                        res, rec)
+
+            n_acc, rounds, res, rec = jax.lax.while_loop(cond, body, state0)
+            return {"n_acc": n_acc, "rounds": rounds, **res,
+                    "rec_" + "sumstats": rec["sumstats"],
+                    "rec_distance": rec["distance"],
+                    "rec_accepted": rec["accepted"],
+                    "rec_valid": rec["valid"]}
+
+        fn = jax.jit(generation_fn)
+        self._kernels[cache_key] = fn
+        return fn
+
+    def run_generation(self, key, B: int, mode: str, dyn: dict, *,
+                       n_cap: int, rec_cap: int, max_rounds: int) -> dict:
+        out = self.generation_kernel(B, mode, n_cap, rec_cap, max_rounds)(
+            key, dyn
+        )
+        return jax.device_get(out)
+
     # ------------------------------------------------------------- dispatch
     def run_round(self, key, B: int, mode: str, dyn: dict) -> RoundResult:
         out = self.round_kernel(B, mode)(key, dyn)

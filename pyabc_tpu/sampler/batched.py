@@ -33,16 +33,36 @@ class BatchedSampler(Sampler):
     """
 
     def __init__(self, min_batch: int = 256, max_batch: int = 1 << 17,
-                 overshoot: float = 1.3, check_max_eval: bool = False):
+                 overshoot: float = 1.3, check_max_eval: bool = False,
+                 fused: bool = True, max_rounds: int = 256):
         super().__init__()
         self.min_batch = int(min_batch)
         self.max_batch = int(max_batch)
         self.overshoot = float(overshoot)
         self.check_max_eval = check_max_eval
+        #: fused=True runs the whole generation (refill loop included) as a
+        #: single lax.while_loop device program — one dispatch per
+        #: generation; False keeps the per-round host loop (debugging)
+        self.fused = fused
+        self.max_rounds = int(max_rounds)
         #: acceptance-rate estimate carried across generations: sizes the
         #: FIRST round of the next generation so one round usually suffices,
         #: and keeps B constant within a generation (compile reuse)
         self._rate_estimate: float | None = None
+        self._last_B: int | None = None
+
+    def _pick_B(self, n: int) -> int:
+        """Power-of-two batch with hysteresis: stick with the previous B
+        unless the target moved by more than 2x (every distinct B is a
+        separate XLA compile)."""
+        rate = self._rate_estimate if self._rate_estimate else 0.5
+        target = _pow2(max(int(n / rate * self.overshoot), self.min_batch),
+                       self.min_batch, self.max_batch)
+        if (self._last_B is not None
+                and self._last_B // 2 <= target <= self._last_B * 2):
+            return self._last_B
+        self._last_B = target
+        return target
 
     def sample_until_n_accepted(self, n, generation_spec, t, *,
                                 max_eval=np.inf, all_accepted=False,
@@ -57,6 +77,11 @@ class BatchedSampler(Sampler):
         mode, dyn = generation_spec.mode, generation_spec.dyn
         gen_key = generation_spec.gen_key
 
+        if self.fused:
+            return self._sample_fused(n, ctx, mode, dyn, gen_key,
+                                      max_eval=max_eval,
+                                      all_accepted=all_accepted)
+
         sample = self.sample_factory()
         chunks = []
         nr_eval = 0
@@ -65,9 +90,7 @@ class BatchedSampler(Sampler):
         # size B once per generation from the carried acceptance estimate and
         # keep it constant across refill rounds: one compiled program per
         # distinct B, reused across rounds AND generations
-        rate0 = self._rate_estimate if self._rate_estimate else 0.5
-        B = _pow2(max(int(n / rate0 * self.overshoot), self.min_batch),
-                  self.min_batch, self.max_batch)
+        B = self._pick_B(n)
         while n_acc < n:
             if self.check_max_eval and nr_eval >= max_eval:
                 break
@@ -88,6 +111,57 @@ class BatchedSampler(Sampler):
         self._rate_estimate = max(n_acc / nr_eval, 1.0 / nr_eval)
 
         acc_mask = np.concatenate([c.accepted for c in chunks])
+        return self._finalize_rounds(sample, chunks, acc_mask, n)
+
+    def _sample_fused(self, n, ctx, mode, dyn, gen_key, *, max_eval,
+                      all_accepted):
+        """One device dispatch for the whole generation (fused while_loop)."""
+        sample = self.sample_factory()
+        if all_accepted and mode != "calibration":
+            mode = "calibration"
+        B = self._pick_B(n)
+        n_cap = _pow2(n, 64)
+        rec_cap = 1
+        if sample.record_rejected:
+            cap = min(sample.max_nr_rejected, 8 * n_cap)
+            rec_cap = _pow2(int(cap) if np.isfinite(cap) else 8 * n_cap, 256)
+        max_rounds = self.max_rounds
+        if self.check_max_eval and np.isfinite(max_eval):
+            max_rounds = max(1, min(max_rounds, int(max_eval) // B))
+        out = ctx.run_generation(
+            gen_key, B, mode, dyn, n_cap=n_cap, rec_cap=rec_cap,
+            max_rounds=max_rounds,
+        )
+        self.nr_evaluations_ = int(out["rounds"]) * B
+        k = min(int(out["n_acc"]), n_cap, n)
+        log_w = np.asarray(out["log_weight"][:k], np.float64)
+        finite = np.isfinite(log_w)
+        if finite.any():
+            mx = log_w[finite].max()
+            weights = np.where(finite, np.exp(log_w - mx), 0.0)
+        else:
+            weights = np.ones_like(log_w)
+        sample.set_accepted(
+            ms=out["m"][:k], thetas=np.asarray(out["theta"][:k], np.float64),
+            weights=weights,
+            distances=np.asarray(out["distance"][:k], np.float64),
+            sumstats=np.asarray(out["sumstats"][:k], np.float64),
+            proposal_ids=out["slot"][:k],
+        )
+        if sample.record_rejected:
+            valid = np.asarray(out["rec_valid"], bool)
+            sample.set_all_records(
+                sumstats=np.asarray(out["rec_sumstats"], np.float64)[valid],
+                distances=np.asarray(out["rec_distance"], np.float64)[valid],
+                accepted=np.asarray(out["rec_accepted"], bool)[valid],
+            )
+        self._rate_estimate = max(
+            int(out["n_acc"]) / max(self.nr_evaluations_, 1),
+            1.0 / max(self.nr_evaluations_, 1),
+        )
+        return sample
+
+    def _finalize_rounds(self, sample, chunks, acc_mask, n):
         ms = np.concatenate([c.ms for c in chunks])[acc_mask]
         thetas = np.concatenate([c.thetas for c in chunks])[acc_mask]
         sumstats = np.concatenate([c.sumstats for c in chunks])[acc_mask]
