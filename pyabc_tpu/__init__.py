@@ -88,7 +88,16 @@ from .sampler import (
     Sampler,
     SingleCoreSampler,
 )
+from .predictor import (
+    GPPredictor,
+    LassoPredictor,
+    LinearPredictor,
+    MLPPredictor,
+    ModelSelectionPredictor,
+    Predictor,
+)
 from .storage import History, create_sqlite_db_id
+from .sumstat import IdentitySumstat, PredictorSumstat, Sumstat
 from .transition import (
     AggregatedTransition,
     DiscreteJumpTransition,

@@ -265,7 +265,10 @@ class StochasticAcceptor(Acceptor):
         return self._kernel is not None and self._kernel.is_device_compatible()
 
     def device_params(self, t=None):
-        return jnp.asarray(self.pdf_norms[t], jnp.float32)
+        # .get with 0.0: during calibration the prior kernel runs at
+        # eps=+inf BEFORE initialize() populates pdf_norms — the log-ratio
+        # (v - pdf_norm)/inf is 0 regardless, so any finite norm is inert
+        return jnp.asarray(self.pdf_norms.get(t, 0.0), jnp.float32)
 
     def device_fn(self, distance_device_fn):
         lin = self._kernel is not None and self._kernel.ret_scale == SCALE_LIN

@@ -1,0 +1,118 @@
+"""Command-line entry points (reference parity: ``pyabc/storage/export.py``
+CLI ``abc-export`` and the setup.py console_scripts block).
+
+``abc-export``  — dump a History database to CSV/parquet/JSON.
+``abc-bench``   — run the Lotka-Volterra benchmark and print the one-line
+                  JSON record (the packaged twin of repo-root ``bench.py``).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import click
+
+
+@click.command("abc-export")
+@click.argument("db", type=click.Path(exists=True))
+@click.option("--run", "run_id", type=int, default=None,
+              help="ABC run id within the db (default: latest)")
+@click.option("--what", type=click.Choice(
+    ["particles", "populations", "model-probabilities",
+     "weighted-distances", "runs"]), default="particles",
+    help="Which table/view to export")
+@click.option("--t", "time_point", type=int, default=None,
+              help="Generation index (default: last)")
+@click.option("--model", "m", type=int, default=0,
+              help="Model index for particle export")
+@click.option("--format", "fmt", type=click.Choice(["csv", "parquet", "json"]),
+              default="csv")
+@click.option("--out", type=click.Path(), default="-",
+              help="Output file ('-' = stdout; parquet requires a file)")
+def export_cmd(db, run_id, what, time_point, m, fmt, out):
+    """Export a pyabc_tpu History database DB to CSV/parquet/JSON."""
+    from .storage import History
+
+    url = db if db.startswith("sqlite:") else f"sqlite:///{db}"
+    h = History(url, _id=run_id)
+
+    if what == "particles":
+        df, w = h.get_distribution(m=m, t=time_point)
+        df = df.copy()
+        df["w"] = w
+    elif what == "populations":
+        df = h.get_all_populations()
+    elif what == "model-probabilities":
+        df = h.get_model_probabilities(time_point)
+    elif what == "weighted-distances":
+        df = h.get_weighted_distances(time_point)
+    else:  # runs
+        df = h.all_runs()
+
+    if out == "-":
+        if fmt == "parquet":
+            raise click.UsageError("parquet needs --out FILE")
+        click.echo(
+            df.to_csv(index=False) if fmt == "csv"
+            else df.to_json(orient="records")
+        )
+        return
+    if fmt == "csv":
+        df.to_csv(out, index=False)
+    elif fmt == "parquet":
+        df.to_parquet(out, index=False)
+    else:
+        df.to_json(out, orient="records")
+    click.echo(f"wrote {len(df)} rows to {out}", err=True)
+
+
+@click.command("abc-bench")
+@click.option("--pop", type=int, default=1000, help="population size")
+@click.option("--gens", type=int, default=6, help="steady-state generations")
+@click.option("--budget-s", type=float, default=300.0,
+              help="walltime budget in seconds")
+@click.option("--cpu", is_flag=True, help="force the CPU platform")
+def bench_cmd(pop, gens, budget_s, cpu):
+    """Run the Lotka-Volterra throughput benchmark (one JSON line)."""
+    if cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    # explicit CLI flags win over any pre-existing env configuration
+    os.environ["PYABC_TPU_BENCH_POP"] = str(pop)
+    os.environ["PYABC_TPU_BENCH_GENS"] = str(gens)
+    os.environ["PYABC_TPU_BENCH_BUDGET_S"] = str(budget_s)
+    # repo-root bench.py is the canonical harness; fall back to an inline
+    # run when installed without the repo (wheel)
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bench_path = os.path.join(here, "bench.py")
+    if os.path.exists(bench_path):
+        import runpy
+
+        sys.argv = [bench_path]
+        runpy.run_path(bench_path, run_name="__main__")
+        return
+    import time
+
+    import pyabc_tpu as pt
+    from pyabc_tpu.models import lotka_volterra as lv
+
+    model = lv.make_lv_model()
+    abc = pt.ABCSMC(model, lv.default_prior(),
+                    pt.AdaptivePNormDistance(p=2), population_size=pop,
+                    eps=pt.MedianEpsilon())
+    abc.new("sqlite://", lv.observed_data(seed=123))
+    t0 = time.time()
+    h = abc.run(max_nr_populations=gens + 2, max_walltime=budget_s)
+    elapsed = time.time() - t0
+    click.echo(json.dumps({
+        "metric": "accepted_particles_per_sec_lotka_volterra",
+        "value": round(pop * h.n_populations / elapsed, 1),
+        "unit": "particles/s",
+        "generations": int(h.n_populations),
+    }))
+
+
+if __name__ == "__main__":  # pragma: no cover - manual invocation helper
+    cmd = sys.argv[1] if len(sys.argv) > 1 else ""
+    sys.argv = [sys.argv[0]] + sys.argv[2:]
+    {"export": export_cmd, "bench": bench_cmd}.get(cmd, export_cmd)()

@@ -109,7 +109,8 @@ class Population:
         weights = np.asarray([p.weight for p in particles])
         distances = np.asarray([p.distance for p in particles])
         sumstats = np.stack(
-            [np.asarray(sumstat_spec.flatten(p.sum_stat)) for p in particles]
+            [np.asarray(sumstat_spec.flatten_host(p.sum_stat))
+             for p in particles]
         )
         return cls(
             ms=ms, thetas=thetas, weights=weights, distances=distances,

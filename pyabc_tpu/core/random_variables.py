@@ -45,6 +45,25 @@ class RVBase(ABC):
     def cdf(self, x):  # pragma: no cover - overridden where closed form exists
         raise NotImplementedError
 
+    # -- host (fork-safe, JAX-free) path -------------------------------------
+    # The multiprocess samplers fork workers; initializing a JAX backend
+    # after fork deadlocks (classic fork-after-XLA-init, worse under a TPU
+    # tunnel). Builtin families override these with pure scipy/numpy
+    # implementations; this fallback routes through JAX and is only safe
+    # in-process (documented escape hatch for custom RVBase subclasses).
+
+    def rvs_host(self, rng=None):
+        """Draw one sample using numpy RNG state (no JAX). ``rng`` is a
+        ``np.random.Generator``/``RandomState`` or None (global np.random)."""
+        r = rng if rng is not None else np.random
+        draw = getattr(r, "integers", None) or r.randint  # Generator vs legacy
+        seed = int(draw(0, 2**31 - 1))
+        return np.asarray(self.rvs(jax.random.key(seed)))
+
+    def logpdf_host(self, x) -> float:
+        """Log density at x as a plain float (no JAX where overridden)."""
+        return float(np.asarray(self.logpdf(x)))
+
 
 class RV(RVBase):
     """Named-family random variable with jax-native sampling and log-pdf.
@@ -80,6 +99,29 @@ class RV(RVBase):
         if fn is None:
             raise NotImplementedError(f"cdf for {self.name}")
         return fn(x, *self._params)
+
+    # fork-safe host path: the canonical params follow scipy conventions by
+    # design, so the scipy.stats frozen distribution of the same name is the
+    # exact host twin of the jax sampler/logpdf
+    def _frozen(self):
+        frozen = getattr(self, "_frozen_cache", None)
+        if frozen is None:
+            import scipy.stats as st
+
+            if self.name == "lognorm":
+                s, scale = self._params
+                frozen = st.lognorm(s, 0.0, scale)
+            else:
+                frozen = getattr(st, self.name)(*self._params)
+            self._frozen_cache = frozen
+        return frozen
+
+    def rvs_host(self, rng=None):
+        return np.asarray(self._frozen().rvs(random_state=rng))
+
+    def logpdf_host(self, x) -> float:
+        fr = self._frozen()
+        return float(fr.logpmf(x) if self.discrete else fr.logpdf(x))
 
     def __repr__(self) -> str:
         return f"RV({self.name!r}, {', '.join(map(repr, self.args))})"
@@ -329,6 +371,12 @@ class RVDecorator(RVBase):
     def cdf(self, x):
         return self.component.cdf(x)
 
+    def rvs_host(self, rng=None):
+        return self.component.rvs_host(rng)
+
+    def logpdf_host(self, x) -> float:
+        return self.component.logpdf_host(x)
+
 
 class LowerBoundDecorator(RVDecorator):
     """Truncate the wrapped RV below ``bound`` (pyabc LowerBoundDecorator).
@@ -358,6 +406,19 @@ class LowerBoundDecorator(RVDecorator):
     def logpdf(self, x):
         return jnp.where(x > self.bound, self.component.logpdf(x), -jnp.inf)
 
+    def rvs_host(self, rng=None):
+        x = self.component.rvs_host(rng)
+        for _ in range(100):
+            if np.all(x > self.bound):
+                return x
+            x = self.component.rvs_host(rng)
+        return np.where(x > self.bound, x, 2 * self.bound - x)
+
+    def logpdf_host(self, x) -> float:
+        if np.all(np.asarray(x) > self.bound):
+            return self.component.logpdf_host(x)
+        return float(-np.inf)
+
 
 class ScipyRV(RVBase):
     """Host-only wrapper around a frozen scipy.stats distribution.
@@ -383,6 +444,12 @@ class ScipyRV(RVBase):
 
     def cdf(self, x):
         return np.asarray(self.frozen.cdf(np.asarray(x)))
+
+    def rvs_host(self, rng=None):
+        return np.asarray(self.frozen.rvs(random_state=rng))
+
+    def logpdf_host(self, x) -> float:
+        return float(np.asarray(self.logpdf(x)))
 
 
 class Distribution:
@@ -422,6 +489,21 @@ class Distribution:
 
     def pdf(self, par: Mapping[str, float]):
         return float(np.exp(self.logpdf_array(self.space.to_array(par))))
+
+    # -- fork-safe host API (no JAX; multiprocess sampler workers) -----------
+    def rvs_host(self, rng=None) -> Parameter:
+        vals = np.asarray(
+            [np.asarray(rv.rvs_host(rng)).item() for rv in self.rv_map.values()]
+        )
+        return self.space.to_dict(vals)
+
+    def logpdf_host(self, par: Mapping[str, float]) -> float:
+        return float(
+            sum(rv.logpdf_host(par[k]) for k, rv in self.rv_map.items())
+        )
+
+    def pdf_host(self, par: Mapping[str, float]) -> float:
+        return float(np.exp(self.logpdf_host(par)))
 
     # -- dense API (device, traceable) ---------------------------------------
     def rvs_array(self, key):

@@ -37,6 +37,15 @@ class SumStatSpec:
         parts = [jnp.ravel(jnp.asarray(stats[n], jnp.float32)) for n in self.names]
         return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
 
+    def flatten_host(self, stats: Mapping) -> np.ndarray:
+        """Numpy twin of flatten: NO JAX. The host sampler path runs inside
+        forked multiprocess workers, where touching a JAX backend deadlocks;
+        host distances/acceptors must flatten through this."""
+        parts = [
+            np.ravel(np.asarray(stats[n], np.float64)) for n in self.names
+        ]
+        return np.concatenate(parts) if len(parts) > 1 else parts[0]
+
     def unflatten(self, vec) -> dict[str, np.ndarray]:
         vec = np.asarray(vec)
         out = {}

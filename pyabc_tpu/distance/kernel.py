@@ -299,18 +299,37 @@ class PoissonKernel(StochasticKernel):
 
 class NegativeBinomialKernel(StochasticKernel):
     """Negative-binomial observation noise with dispersion p
-    (pyabc NegativeBinomialKernel): x_0 ~ NB(mean=sim, p)."""
+    (pyabc NegativeBinomialKernel).
+
+    ``parameterization="size"`` (default, reference-faithful): the simulated
+    value is passed directly as the NB size parameter n, i.e.
+    ``nbinom.pmf(k=x_0, n=sim, p)`` — matching
+    ``pyabc/distance/kernel.py::NegativeBinomialKernel``.
+    ``parameterization="mean"``: the simulated value is the NB *mean*,
+    n = mean * p / (1 - p) — often the more natural modeling choice, but a
+    deviation from the reference; opt in explicitly.
+    """
 
     def __init__(self, p: float, ret_scale: str = SCALE_LOG, keys=None,
-                 sumstat_spec=None):
+                 sumstat_spec=None, parameterization: str = "size"):
         super().__init__(ret_scale, keys, None, sumstat_spec)
         self.p = float(p)
+        if parameterization not in ("size", "mean"):
+            raise ValueError(
+                f"parameterization must be 'size' or 'mean', got "
+                f"{parameterization!r}"
+            )
+        self.parameterization = parameterization
+
+    def _size(self, x):
+        x = np.maximum(x, 1e-12)
+        if self.parameterization == "mean":
+            return x * self.p / (1.0 - self.p)
+        return x
 
     def __call__(self, x, x_0, t=None, par=None) -> float:
-        mean = np.maximum(self._flat(x), 1e-12)
+        n = self._size(self._flat(x))
         k = np.round(self._flat(x_0))
-        # mean = n (1-p)/p  =>  n = mean p/(1-p)
-        n = mean * self.p / (1.0 - self.p)
         from scipy.special import gammaln
 
         logp = (
@@ -326,11 +345,12 @@ class NegativeBinomialKernel(StochasticKernel):
 
     def device_fn(self, spec):
         lin = self.ret_scale == SCALE_LIN
+        mean_param = self.parameterization == "mean"
 
         def fn(x, x0, p):
-            mean = jnp.maximum(x, 1e-12)
+            x = jnp.maximum(x, 1e-12)
+            n = x * p / (1.0 - p) if mean_param else x
             k = jnp.round(x0)
-            n = mean * p / (1.0 - p)
             logp = (
                 jax.scipy.special.gammaln(k + n)
                 - jax.scipy.special.gammaln(n)

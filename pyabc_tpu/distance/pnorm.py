@@ -31,7 +31,10 @@ def _as_flat(x, spec: SumStatSpec | None) -> np.ndarray:
     """Dict-or-vector sum stats -> flat float64 vector (host path)."""
     if isinstance(x, Mapping):
         if spec is not None:
-            return np.asarray(spec.flatten(x), np.float64)
+            # flatten_host, not flatten: this runs inside forked sampler
+            # workers where a jnp op would initialize a JAX backend and
+            # deadlock (fork-after-XLA-init)
+            return np.asarray(spec.flatten_host(x), np.float64)
         parts = [np.ravel(np.asarray(x[k], np.float64)) for k in sorted(x)]
         return np.concatenate(parts) if len(parts) > 1 else parts[0]
     return np.ravel(np.asarray(x, np.float64))
@@ -45,13 +48,18 @@ class PNormDistance(Distance):
     """
 
     def __init__(self, p: float = 2.0, weights=None,
-                 factors=None, sumstat_spec: SumStatSpec | None = None):
+                 factors=None, sumstat_spec: SumStatSpec | None = None,
+                 sumstat=None):
         if p < 1:
             raise ValueError("p must be >= 1")
         self.p = float(p)
         self.spec = sumstat_spec
         self._weights_arg = weights
         self._factors_arg = factors
+        #: optional Sumstat transform applied to BOTH x and x_0 before the
+        #: norm (reference PNormDistance(sumstat=...); PredictorSumstat =
+        #: Fearnhead-Prangle learned statistics)
+        self.sumstat = sumstat
         #: resolved per-generation weights {t: (S,) array}; -1 = default key
         self.weights: dict[int, np.ndarray] = {}
 
@@ -59,7 +67,17 @@ class PNormDistance(Distance):
     def initialize(self, t, get_all_sum_stats=None, x_0=None):
         if self.spec is None and isinstance(x_0, Mapping):
             self.spec = SumStatSpec(x_0)
+        if self.sumstat is not None:
+            self.sumstat.initialize(t, get_all_sum_stats, x_0,
+                                    spec=self.spec)
         self._resolve_initial_weights()
+
+    def _transform(self, flat: np.ndarray) -> np.ndarray:
+        return flat if self.sumstat is None else self.sumstat(flat)
+
+    def _feature_dim(self) -> int:
+        S = self.spec.total_size if self.spec is not None else 0
+        return self.sumstat.out_dim(S) if self.sumstat is not None else S
 
     def _resolve_initial_weights(self):
         w = self._weights_arg
@@ -102,13 +120,36 @@ class PNormDistance(Distance):
                 return self.weights[max(past)]
         return self.weights.get(-1)
 
+    # ----------------------------------------------------------- lifecycle
+    def update(self, t, get_all_sum_stats=None, population=None) -> bool:
+        """Refit the learned summary transform, if any (base PNorm has no
+        adaptive weights; AdaptivePNormDistance extends this)."""
+        if self.sumstat is None:
+            return False
+        return self.sumstat.update(t, population)
+
     # --------------------------------------------------------------- call
     def __call__(self, x, x_0, t=None, par=None) -> float:
-        xf = _as_flat(x, self.spec)
-        x0f = _as_flat(x_0, self.spec)
+        xf = self._transform(_as_flat(x, self.spec))
+        x0f = self._transform(_as_flat(x_0, self.spec))
         w = self.weights_for(t)
         if w is None:
             w = np.ones_like(x0f)
+        elif w.shape != x0f.shape:
+            if self.sumstat is not None:
+                # a refit transform legitimately changes the feature dim;
+                # weights fitted for an older space no longer apply
+                w = np.ones_like(x0f)
+            else:
+                try:
+                    # scalar / length-1 weights broadcast fine (and always
+                    # did); only a genuine length mismatch is user error
+                    np.broadcast_shapes(w.shape, x0f.shape)
+                except ValueError:
+                    raise ValueError(
+                        f"weight vector shape {w.shape} does not match the "
+                        f"sum-stat vector shape {x0f.shape}"
+                    ) from None
         f = self._factors_arg
         if f is not None:
             w = w * self._coerce_weight_vector(f)
@@ -119,6 +160,8 @@ class PNormDistance(Distance):
 
     # ------------------------------------------------------------- device
     def is_device_compatible(self) -> bool:
+        if self.sumstat is not None:
+            return self.sumstat.is_device_compatible()
         return True
 
     def device_params(self, t=None):
@@ -126,16 +169,27 @@ class PNormDistance(Distance):
             raise RuntimeError("distance not initialized (no SumStatSpec)")
         w = self.weights_for(t)
         if w is None:
-            w = np.ones(self.spec.total_size)
+            w = np.ones(self._feature_dim())
         f = self._factors_arg
         if f is not None:
             w = w * self._coerce_weight_vector(f)
-        return jnp.asarray(w, jnp.float32)
+        w = jnp.asarray(w, jnp.float32)
+        if self.sumstat is None:
+            return w
+        return {"w": w, "ss": self.sumstat.device_params(t)}
 
     def device_fn(self, spec: SumStatSpec):
         p = self.p
+        sumstat = self.sumstat
+        ss_fn = sumstat.device_fn(spec) if sumstat is not None else None
 
-        def fn(x, x0, weights):
+        def fn(x, x0, params):
+            if ss_fn is not None:
+                weights = params["w"]
+                x = ss_fn(x, params["ss"])
+                x0 = ss_fn(x0, params["ss"])
+            else:
+                weights = params
             diff = weights * jnp.abs(x - x0)
             if np.isinf(p):
                 return jnp.max(diff)
@@ -164,8 +218,10 @@ class AdaptivePNormDistance(PNormDistance):
                  normalize_weights: bool = True,
                  max_weight_ratio: float | None = None,
                  scale_log_file: str | None = None,
-                 sumstat_spec: SumStatSpec | None = None):
-        super().__init__(p=p, weights=None, sumstat_spec=sumstat_spec)
+                 sumstat_spec: SumStatSpec | None = None,
+                 sumstat=None):
+        super().__init__(p=p, weights=None, sumstat_spec=sumstat_spec,
+                         sumstat=sumstat)
         self.scale_function = scale_function
         self.adaptive = adaptive
         self.normalize_weights = normalize_weights
@@ -181,6 +237,8 @@ class AdaptivePNormDistance(PNormDistance):
         sampler.sample_factory.record_rejected = True)."""
         if self.adaptive:
             sampler.sample_factory.record_rejected = True
+        if self.sumstat is not None:
+            self.sumstat.configure_sampler(sampler)
 
     def initialize(self, t, get_all_sum_stats=None, x_0=None):
         super().initialize(t, get_all_sum_stats, x_0)
@@ -188,16 +246,24 @@ class AdaptivePNormDistance(PNormDistance):
         if get_all_sum_stats is not None:
             self._fit(t, np.asarray(get_all_sum_stats(), np.float64))
 
-    def update(self, t, get_all_sum_stats=None) -> bool:
+    def update(self, t, get_all_sum_stats=None, population=None) -> bool:
+        changed = False
+        if self.sumstat is not None:
+            # refit the learned statistics first: the scale weights below
+            # must live in the NEW transformed feature space
+            changed = self.sumstat.update(t, population)
         if not self.adaptive or get_all_sum_stats is None:
-            return False
+            return changed
         self._fit(t, np.asarray(get_all_sum_stats(), np.float64))
         return True
 
     def _fit(self, t: int, samples: np.ndarray) -> None:
-        """weights[t] = 1/scale over the sample matrix (n, S)."""
+        """weights[t] = 1/scale over the sample matrix (n, S), computed in
+        the (possibly learned) transformed feature space."""
+        samples = self._transform(samples)
+        x0t = self._transform(self._x_0) if self._x_0 is not None else None
         try:
-            scale = self.scale_function(samples, self._x_0)
+            scale = self.scale_function(samples, x0t)
         except TypeError:
             scale = self.scale_function(samples)
         scale = np.asarray(scale, np.float64)
@@ -211,9 +277,10 @@ class AdaptivePNormDistance(PNormDistance):
             w = w * (w.size / w.sum())
         self.weights[int(t)] = w
         if self.scale_log_file:
-            labels = self.spec.labels() if self.spec else [
-                str(i) for i in range(w.size)
-            ]
+            labels = self.spec.labels() if self.spec else None
+            if labels is None or len(labels) != w.size:
+                # transformed feature space: positional labels
+                labels = [str(i) for i in range(w.size)]
             try:
                 with open(self.scale_log_file) as fh:
                     log = json.load(fh)

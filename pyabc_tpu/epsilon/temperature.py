@@ -48,9 +48,18 @@ class AcceptanceRateScheme(TemperatureScheme):
 
     The prediction model: mean over kernel values v_i of
     min(1, exp((v_i - pdf_norm)/T)); bisection on log10(T). Prefers the
-    ALL-simulations record (accepted + rejected — these are
-    proposal-distributed, so their uniform mean estimates E_q[accept prob]
-    unbiasedly); falls back to the importance-weighted accepted set.
+    ALL-simulations record (accepted + rejected); falls back to the
+    importance-weighted accepted set.
+
+    One-generation-lag approximation (deviation from the reference): the
+    records are distributed under generation t's *proposal*, while the rate
+    being predicted is under generation t+1's proposal. The reference
+    importance-reweights records by transition_pd / transition_pd_prev to
+    correct for the shift; here the records are treated as an unweighted
+    sample of the next proposal, which is biased when the proposal moves
+    appreciably between generations (it usually moves slowly once the
+    population has localized). The Temperature wrapper's min-over-schemes +
+    monotone max-decay guard bounds the impact.
     """
 
     def __init__(self, target_rate: float = 0.3):
@@ -173,7 +182,11 @@ class DalyScheme(TemperatureScheme):
             return np.inf
         k_prev = self._k.get(t - 1, prev_temperature)
         if acceptance_rate is not None and acceptance_rate < self.min_rate:
-            k = k_prev / self.alpha  # back off
+            # back off: SHRINK the contraction step so temperature decreases
+            # more slowly while acceptance recovers (reference Daly reaction;
+            # dividing by alpha would double the decrement and cool faster,
+            # worsening the collapse)
+            k = self.alpha * k_prev
         else:
             k = self.alpha * min(k_prev, prev_temperature)
         self._k[t] = k

@@ -1,0 +1,14 @@
+"""External-process model adapters (reference ``pyabc/external/``)."""
+from .base import (
+    ExternalDistance,
+    ExternalHandler,
+    ExternalModel,
+    ExternalSumStat,
+)
+
+__all__ = [
+    "ExternalHandler",
+    "ExternalModel",
+    "ExternalSumStat",
+    "ExternalDistance",
+]

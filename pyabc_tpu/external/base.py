@@ -1,0 +1,163 @@
+"""External (out-of-process) models — the non-JAX escape hatch.
+
+Reference parity: ``pyabc/external/base.py::{ExternalHandler, ExternalModel,
+ExternalSumStat, ExternalDistance}`` (SURVEY.md §2.4): simulators that are
+arbitrary executables (R, Julia, compiled binaries, shell scripts) talk to
+the framework through a file-based contract:
+
+    executable [script] --in <infile> --out <outfile>
+
+- infile:  one ``name value`` pair per line (the parameters).
+- outfile: one ``name value [value ...]`` row per line (the summary
+  statistics; multiple values become a 1-D array). ExternalDistance's
+  outfile holds a single ``distance <float>`` line.
+
+This is the ONE place the reference's CPU-process farming genuinely cannot
+be replaced by XLA collectives (SURVEY.md §5.8): external models are
+host-only and force the host sampler path (SingleCore/Multicore/Mapping),
+where every worker just shells out. They are intentionally NOT traceable —
+`ABCSMC._check_device_capable` routes around the device kernel.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import tempfile
+
+import numpy as np
+
+from ..model import Model, ModelResult
+
+
+class ExternalHandler:
+    """Runs an executable in managed temp locations (pyabc ExternalHandler)."""
+
+    def __init__(self, executable: str, script: str | None = None,
+                 tmp_dir: str | None = None, keep_tmp: bool = False,
+                 prefix: str = "abc_ext_"):
+        self.executable = executable
+        self.script = script
+        self.tmp_dir = tmp_dir
+        self.keep_tmp = keep_tmp
+        self.prefix = prefix
+
+    def create_loc(self) -> str:
+        return tempfile.mkdtemp(prefix=self.prefix, dir=self.tmp_dir)
+
+    def cleanup(self, loc: str) -> None:
+        if not self.keep_tmp:
+            shutil.rmtree(loc, ignore_errors=True)
+
+    def run(self, args: list[str], loc: str | None = None) -> dict:
+        cmd = [self.executable]
+        if self.script:
+            cmd.append(self.script)
+        cmd += args
+        proc = subprocess.run(
+            cmd, cwd=loc, capture_output=True, text=True
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"external command {' '.join(cmd)!r} failed "
+                f"(rc={proc.returncode}): {proc.stderr[-2000:]}"
+            )
+        return {"returncode": proc.returncode, "stdout": proc.stdout,
+                "stderr": proc.stderr}
+
+
+def write_parameters(path: str, par) -> None:
+    with open(path, "w") as fh:
+        for k, v in dict(par).items():
+            fh.write(f"{k} {float(v)!r}\n")
+
+
+def read_sumstats(path: str) -> dict[str, np.ndarray]:
+    out: dict[str, np.ndarray] = {}
+    with open(path) as fh:
+        for line in fh:
+            parts = line.split()
+            if not parts:
+                continue
+            name, vals = parts[0], [float(v) for v in parts[1:]]
+            out[name] = (
+                np.asarray(vals[0]) if len(vals) == 1 else np.asarray(vals)
+            )
+    return out
+
+
+class ExternalModel(Model):
+    """A simulator that is an external executable (pyabc ExternalModel).
+
+    ``ExternalModel("/bin/sh", script="sim.sh")`` calls
+    ``/bin/sh sim.sh --in <params> --out <sumstats>`` per evaluation.
+    """
+
+    def __init__(self, executable: str, script: str | None = None,
+                 name: str | None = None, **handler_kwargs):
+        super().__init__(name=name or f"ExternalModel({executable})")
+        self.handler = ExternalHandler(executable, script, **handler_kwargs)
+
+    def sample(self, pars):
+        loc = self.handler.create_loc()
+        try:
+            fin = os.path.join(loc, "in.txt")
+            fout = os.path.join(loc, "out.txt")
+            write_parameters(fin, pars)
+            self.handler.run(["--in", fin, "--out", fout], loc=loc)
+            return read_sumstats(fout)
+        finally:
+            self.handler.cleanup(loc)
+
+
+class ExternalSumStat:
+    """sumstat-calculator executable: maps a model output dir/file to
+    statistics (pyabc ExternalSumStat). Used as a ``summary_statistics``
+    callable on raw ExternalModel output written to a temp file."""
+
+    def __init__(self, executable: str, script: str | None = None,
+                 **handler_kwargs):
+        self.handler = ExternalHandler(executable, script, **handler_kwargs)
+
+    def __call__(self, model_output: dict) -> dict:
+        loc = self.handler.create_loc()
+        try:
+            fin = os.path.join(loc, "in.txt")
+            fout = os.path.join(loc, "out.txt")
+            with open(fin, "w") as fh:
+                for k, v in model_output.items():
+                    vals = " ".join(repr(float(x)) for x in np.ravel(v))
+                    fh.write(f"{k} {vals}\n")
+            self.handler.run(["--in", fin, "--out", fout], loc=loc)
+            return read_sumstats(fout)
+        finally:
+            self.handler.cleanup(loc)
+
+
+class ExternalDistance:
+    """distance executable: reads two sum-stat files, writes
+    ``distance <float>`` (pyabc ExternalDistance). Wrap with
+    ``to_distance`` / pass directly as the distance callable."""
+
+    def __init__(self, executable: str, script: str | None = None,
+                 **handler_kwargs):
+        self.handler = ExternalHandler(executable, script, **handler_kwargs)
+
+    def __call__(self, x: dict, x_0: dict) -> float:
+        loc = self.handler.create_loc()
+        try:
+            fx = os.path.join(loc, "x.txt")
+            fx0 = os.path.join(loc, "x0.txt")
+            fout = os.path.join(loc, "out.txt")
+            for path, stats in ((fx, x), (fx0, x_0)):
+                with open(path, "w") as fh:
+                    for k, v in stats.items():
+                        vals = " ".join(repr(float(s)) for s in np.ravel(v))
+                        fh.write(f"{k} {vals}\n")
+            self.handler.run(
+                ["--in", fx, "--in0", fx0, "--out", fout], loc=loc
+            )
+            out = read_sumstats(fout)
+            return float(out["distance"])
+        finally:
+            self.handler.cleanup(loc)

@@ -96,7 +96,8 @@ class ABCSMC:
                  stop_if_only_single_model_alive: bool = False,
                  max_nr_recorded_particles: float = np.inf,
                  seed: int = 0,
-                 mesh=None):
+                 mesh=None,
+                 pipeline: bool = True):
         self.models: list[Model] = assert_models(models)
         if isinstance(parameter_priors, Distribution):
             parameter_priors = [parameter_priors]
@@ -155,6 +156,11 @@ class ABCSMC:
         self.max_nr_recorded_particles = max_nr_recorded_particles
         self.seed = seed
         self.mesh = mesh
+        #: overlap host persistence with the next generation's device run
+        #: (the look-ahead analog; proposals use FINAL weights so no weight
+        #: correction is needed — reference redis_eps look_ahead semantics
+        #: without the preliminary-weight bias)
+        self.pipeline = pipeline
         self._root_key = root_key(seed)
 
         self._device_capable = self._check_device_capable()
@@ -180,6 +186,11 @@ class ABCSMC:
 
     # ------------------------------------------------------------- plumbing
     def _check_device_capable(self) -> bool:
+        if self.summary_statistics is not None:
+            # a user summary_statistics callable runs host-side on raw model
+            # output; the device kernel flattens model.sim(...) directly and
+            # would silently skip it — force the host path
+            return False
         if not all(isinstance(m, JaxModel) for m in self.models):
             return False
         if not all(p.traceable for p in self.parameter_priors):
@@ -268,7 +279,15 @@ class ABCSMC:
         mode = dyn = None
         if device is not None:
             if calibration:
-                mode, dyn = "calibration", {}
+                # calibration = the PRIOR kernel at eps = +inf: every valid
+                # lane accepts with log-weight 0, which is exactly the
+                # all-accepted calibration semantics — and it SHARES the
+                # prior kernel's compilation instead of tracing a third
+                # program (compile time is the dominant cost of short runs)
+                if getattr(self.distance_function, "spec", None) is None \
+                        and hasattr(self.distance_function, "spec"):
+                    self.distance_function.spec = self.spec
+                mode, dyn = device.build_dyn_args(t=0, eps_value=np.inf)
             else:
                 mode, dyn = device.build_dyn_args(
                     t=t,
@@ -386,9 +405,31 @@ class ABCSMC:
             max_nr_populations: float = np.inf,
             min_acceptance_rate: float = 0.0,
             max_total_nr_simulations: float = np.inf,
-            max_walltime: datetime.timedelta | float | None = None) -> History:
+            max_walltime: datetime.timedelta | float | None = None,
+            profile_dir: str | None = None) -> History:
         if self.history is None:
             raise RuntimeError("call .new(db, observed) or .load(db, id) first")
+        if profile_dir is not None:
+            # device-level tracing around the whole run (SURVEY.md §5.1:
+            # "add jax.profiler trace hooks"); view with tensorboard/xprof
+            import jax.profiler
+
+            jax.profiler.start_trace(profile_dir)
+            try:
+                return self._run_impl(
+                    minimum_epsilon, max_nr_populations, min_acceptance_rate,
+                    max_total_nr_simulations, max_walltime,
+                )
+            finally:
+                jax.profiler.stop_trace()
+        return self._run_impl(
+            minimum_epsilon, max_nr_populations, min_acceptance_rate,
+            max_total_nr_simulations, max_walltime,
+        )
+
+    def _run_impl(self, minimum_epsilon, max_nr_populations,
+                  min_acceptance_rate, max_total_nr_simulations,
+                  max_walltime) -> History:
         if minimum_epsilon is None:
             # reference default: temperature schedules stop at T = 1 (exact
             # posterior); distance thresholds run to the other criteria
@@ -411,6 +452,16 @@ class ABCSMC:
         self.distance_function.configure_sampler(self.sampler)
         self.eps.configure_sampler(self.sampler)
 
+        if (self.pipeline
+                and getattr(self.sampler, "supports_pipelining", False)
+                and getattr(self.sampler, "fused", False)
+                and self._device_capable):
+            return self._loop_pipelined(
+                t0, minimum_epsilon, max_nr_populations,
+                min_acceptance_rate, max_total_nr_simulations,
+                max_walltime, start_walltime,
+            )
+
         t = t0
         sims_total = self.history.total_nr_simulations
         distance_changed_at_t = False
@@ -427,10 +478,12 @@ class ABCSMC:
                 if min_acceptance_rate > 0 else np.inf
             )
             logger.info("t: %d, eps: %.8g", t, current_eps)
+            t_gen0 = time.time()
             gen_spec = self._generation_spec(t)
             sample = self.sampler.sample_until_n_accepted(
                 n_t, gen_spec, t, max_eval=max_eval
             )
+            sample_s = time.time() - t_gen0
             n_acc = sample.n_accepted if sample.ms is not None else len(
                 sample.accepted_particles
             )
@@ -443,67 +496,209 @@ class ABCSMC:
             nr_evals = self.sampler.nr_evaluations_
             sims_total += nr_evals
             acceptance_rate = n_t / nr_evals
+            t_persist0 = time.time()
             self.history.append_population(
-                t, current_eps, pop, nr_evals, self.model_names
+                t, current_eps, pop, nr_evals, self.model_names,
+                telemetry={"sample_s": round(sample_s, 4),
+                           "n_evaluations": int(nr_evals)},
             )
+            persist_s = time.time() - t_persist0
             logger.info(
                 "acceptance rate: %.5f (%d evaluations)", acceptance_rate,
                 nr_evals,
             )
-            self._model_probs = {
-                m: float(pop.model_probabilities_array()[m])
-                for m in pop.get_alive_models()
+            t_adapt0 = time.time()
+            distance_changed_at_t = self._adapt_components(
+                t, sample, pop, current_eps, acceptance_rate
+            )
+            self.history.update_telemetry(t, {
+                "adapt_s": round(time.time() - t_adapt0, 4),
+                "persist_s": round(persist_s, 4),
+                "acceptance_rate": round(acceptance_rate, 6),
+            })
+
+            if self._check_stop(t, current_eps, minimum_epsilon,
+                                max_nr_populations, acceptance_rate,
+                                min_acceptance_rate, sims_total,
+                                max_total_nr_simulations, max_walltime,
+                                start_walltime):
+                break
+            t += 1
+        self.history.done()
+        return self.history
+
+    def _adapt_components(self, t, sample, pop, current_eps,
+                          acceptance_rate) -> bool:
+        """Central adaptation after generation t (reference §3.2 ADAPTATION
+        block) — shared by the serial and pipelined loops. Returns True if
+        the distance changed (pop.distances is then recomputed in place;
+        persist BEFORE calling this, or pin a copy, to keep the reference's
+        history-keeps-old-distances semantics)."""
+        self._model_probs = {
+            m: float(pop.model_probabilities_array()[m])
+            for m in pop.get_alive_models()
+        }
+        self._fit_transitions(pop)
+        all_ss = self._all_sumstats_provider(sample)
+        changed = _call_filtered(
+            self.distance_function.update,
+            t=t + 1, get_all_sum_stats=all_ss, population=pop,
+        )
+        if changed:
+            self._recompute_distances(pop, t + 1)
+        get_wd = lambda: pop.get_weighted_distances()  # noqa: E731
+        _call_filtered(
+            self.acceptor.update,
+            t=t + 1, get_weighted_distances=get_wd,
+            prev_temp=current_eps, acceptance_rate=acceptance_rate,
+        )
+        _call_filtered(
+            self.eps.update,
+            t=t + 1, get_weighted_distances=get_wd,
+            get_all_records=self._all_records_provider(sample),
+            acceptance_rate=acceptance_rate,
+            acceptor_config=self._acceptor_config(t + 1),
+        )
+        self.population_strategy.update(
+            [self.transitions[m] for m in pop.get_alive_models()],
+            np.asarray(
+                [self._model_probs[m] for m in pop.get_alive_models()]
+            ),
+            t,
+        )
+        return bool(changed)
+
+    def _check_stop(self, t, current_eps, minimum_epsilon,
+                    max_nr_populations, acceptance_rate,
+                    min_acceptance_rate, sims_total,
+                    max_total_nr_simulations, max_walltime,
+                    start_walltime) -> bool:
+        """Stopping rules after generation t (reference §3.2) — shared by
+        the serial and pipelined loops."""
+        if current_eps <= minimum_epsilon:
+            logger.info("stopping: eps=%.8g <= minimum_epsilon", current_eps)
+            return True
+        if t + 1 >= max_nr_populations:
+            logger.info("stopping: max_nr_populations reached")
+            return True
+        if acceptance_rate < min_acceptance_rate:
+            logger.info("stopping: acceptance rate below minimum")
+            return True
+        if sims_total >= max_total_nr_simulations:
+            logger.info("stopping: max_total_nr_simulations reached")
+            return True
+        if (max_walltime is not None
+                and time.time() - start_walltime > max_walltime):
+            logger.info("stopping: max_walltime reached")
+            return True
+        if (self.stop_if_only_single_model_alive
+                and len(self._model_probs) == 1 and self.K > 1):
+            logger.info("stopping: single model alive")
+            return True
+        return False
+
+    def _loop_pipelined(self, t0, minimum_epsilon, max_nr_populations,
+                        min_acceptance_rate, max_total_nr_simulations,
+                        max_walltime, start_walltime) -> History:
+        """Cross-generation pipelined loop (the look-ahead analog).
+
+        Generation t+1 is DISPATCHED to the device as soon as the adaptive
+        components are refit on generation t's final results; the host then
+        persists generation t to the History while the device is already
+        simulating t+1. Unlike the reference's Redis look-ahead
+        (``redis_eps/sampler.py`` look_ahead mode), proposals always use
+        FINAL generation-t weights, so the run is statistically identical to
+        the serial loop — no preliminary-weight correction is needed; only
+        host-side persistence/analysis is overlapped.
+        """
+        import copy
+
+        t = t0
+        sims_total = self.history.total_nr_simulations
+        distance_changed_at_t = False
+
+        def _dispatch(t_next):
+            t_d0 = time.time()
+            current_eps = self.eps(t_next)
+            if hasattr(self.acceptor, "note_epsilon"):
+                self.acceptor.note_epsilon(t_next, current_eps,
+                                           distance_changed_at_t)
+            n_t = self.population_strategy(t_next)
+            max_eval = (
+                n_t / min_acceptance_rate
+                if min_acceptance_rate > 0 else np.inf
+            )
+            logger.info("t: %d, eps: %.8g", t_next, current_eps)
+            spec = self._generation_spec(t_next)
+            spec_s = time.time() - t_d0
+            handle = self.sampler.dispatch(n_t, spec, t_next,
+                                           max_eval=max_eval)
+            handle["dispatch_telemetry"] = {
+                "spec_s": round(spec_s, 4),
+                "enqueue_s": round(time.time() - t_d0 - spec_s, 4),
             }
+            return handle, current_eps, n_t
 
-            # central adaptation (reference §3.2 ADAPTATION block)
-            self._fit_transitions(pop)
-            all_ss = self._all_sumstats_provider(sample)
-            changed = self.distance_function.update(t + 1, all_ss)
-            distance_changed_at_t = bool(changed)
-            if changed:
-                self._recompute_distances(pop, t + 1)
-            get_wd = lambda: pop.get_weighted_distances()  # noqa: E731
-            _call_filtered(
-                self.acceptor.update,
-                t=t + 1, get_weighted_distances=get_wd,
-                prev_temp=current_eps, acceptance_rate=acceptance_rate,
+        handle, current_eps, n_t = _dispatch(t)
+        while True:
+            t_gen0 = time.time()
+            sample = self.sampler.collect(handle)
+            sample_s = time.time() - t_gen0
+            n_acc = sample.n_accepted if sample.ms is not None else len(
+                sample.accepted_particles
             )
-            _call_filtered(
-                self.eps.update,
-                t=t + 1, get_weighted_distances=get_wd,
-                get_all_records=self._all_records_provider(sample),
-                acceptance_rate=acceptance_rate,
-                acceptor_config=self._acceptor_config(t + 1),
+            if n_acc < n_t:
+                logger.info(
+                    "stopping: only %d/%d accepted within budget", n_acc, n_t
+                )
+                break
+            pop = self._sample_to_population(sample)
+            nr_evals = self.sampler.nr_evaluations_
+            sims_total += nr_evals
+            acceptance_rate = n_t / nr_evals
+            logger.info(
+                "acceptance rate: %.5f (%d evaluations)", acceptance_rate,
+                nr_evals,
             )
-            self.population_strategy.update(
-                [self.transitions[m] for m in pop.get_alive_models()],
-                np.asarray(
-                    [self._model_probs[m] for m in pop.get_alive_models()]
-                ),
-                t,
-            )
+            # shallow copy pins the PRE-adaptation distances for the db
+            # (_recompute_distances rebinds pop.distances; reference history
+            # keeps the original values)
+            db_pop = copy.copy(pop)
 
-            # stopping rules (reference §3.2)
-            if current_eps <= minimum_epsilon:
-                logger.info("stopping: eps=%.8g <= minimum_epsilon", current_eps)
+            # central adaptation — must finish before t+1 can be proposed
+            t_adapt0 = time.time()
+            distance_changed_at_t = self._adapt_components(
+                t, sample, pop, current_eps, acceptance_rate
+            )
+            adapt_s = time.time() - t_adapt0
+
+            stop = self._check_stop(t, current_eps, minimum_epsilon,
+                                    max_nr_populations, acceptance_rate,
+                                    min_acceptance_rate, sims_total,
+                                    max_total_nr_simulations, max_walltime,
+                                    start_walltime)
+
+            if not stop:
+                # LOOK-AHEAD: device starts generation t+1 now ...
+                next_handle, next_eps, next_n = _dispatch(t + 1)
+
+            # ... while the host persists generation t
+            t_persist0 = time.time()
+            self.history.append_population(
+                t, current_eps, db_pop, nr_evals, self.model_names,
+                telemetry={"sample_s": round(sample_s, 4),
+                           "adapt_s": round(adapt_s, 4),
+                           "n_evaluations": int(nr_evals),
+                           "acceptance_rate": round(acceptance_rate, 6),
+                           "pipelined": True,
+                           **handle.get("dispatch_telemetry", {})},
+            )
+            self.history.update_telemetry(
+                t, {"persist_s": round(time.time() - t_persist0, 4)}
+            )
+            if stop:
                 break
-            if t + 1 >= max_nr_populations:
-                logger.info("stopping: max_nr_populations reached")
-                break
-            if acceptance_rate < min_acceptance_rate:
-                logger.info("stopping: acceptance rate below minimum")
-                break
-            if sims_total >= max_total_nr_simulations:
-                logger.info("stopping: max_total_nr_simulations reached")
-                break
-            if (max_walltime is not None
-                    and time.time() - start_walltime > max_walltime):
-                logger.info("stopping: max_walltime reached")
-                break
-            if (self.stop_if_only_single_model_alive
-                    and len(self._model_probs) == 1 and self.K > 1):
-                logger.info("stopping: single model alive")
-                break
+            handle, current_eps, n_t = next_handle, next_eps, next_n
             t += 1
         self.history.done()
         return self.history

@@ -48,7 +48,9 @@ from ..model import JaxModel, Model
 
 def create_prior_pdf(model_prior_pmf, parameter_priors):
     def prior_pdf(m, theta):
-        return model_prior_pmf(m) * parameter_priors[m].pdf(theta)
+        # pdf_host: the host closure must stay JAX-free — it runs inside
+        # forked multiprocess workers where touching a JAX backend deadlocks
+        return model_prior_pmf(m) * parameter_priors[m].pdf_host(theta)
 
     return prior_pdf
 
@@ -81,7 +83,10 @@ def generate_valid_proposal(t, model_probabilities, model_perturbation_kernel,
     parameters, retry until prior > 0."""
     if t == 0:
         m = model_prior_rvs()
-        theta = parameter_priors[m].rvs(_np_key())
+        # rvs_host: numpy/scipy draw seeded from global np.random — workers
+        # of the multiprocess samplers reseed np.random per fork; a JAX key
+        # here would initialize an XLA backend after fork and deadlock
+        theta = parameter_priors[m].rvs_host()
         return m, theta
     ms = np.asarray(list(model_probabilities.keys()))
     ps = np.asarray(list(model_probabilities.values()), np.float64)
@@ -93,13 +98,9 @@ def generate_valid_proposal(t, model_probabilities, model_perturbation_kernel,
             continue  # never-fitted model cannot propose
         theta_ser = transitions[m].rvs_single()
         theta = Parameter(dict(theta_ser))
-        if parameter_priors[m].pdf(theta) > 0:
+        if parameter_priors[m].pdf_host(theta) > 0:
             return m, theta
     raise RuntimeError("could not generate a valid proposal")
-
-
-def _np_key():
-    return jax.random.key(np.random.randint(0, 2**31 - 1))
 
 
 def evaluate_proposal(m, theta, t, models, summary_statistics, distance_function,
@@ -462,7 +463,10 @@ class DeviceContext:
                 keys = jax.lax.with_sharding_constraint(keys, lane_sharding)
             return jax.vmap(lambda k: lane(k, dyn))(keys)
 
-        def generation_fn(key, dyn):
+        def generation_fn(key, dyn, n_target):
+            # n_target (dynamic scalar <= n_cap): stop at the REQUESTED count,
+            # not the padded reservoir capacity — with n not a power of two,
+            # looping to pow2(n) acceptances would waste up to 2x rounds
             res0 = {
                 "m": jnp.zeros((n_cap,), jnp.int32),
                 "theta": jnp.zeros((n_cap, d_max), jnp.float32),
@@ -479,14 +483,15 @@ class DeviceContext:
             }
             state0 = (jnp.zeros((), jnp.int32),  # n_acc
                       jnp.zeros((), jnp.int32),  # round
+                      jnp.zeros((), jnp.int32),  # n_valid (true model evals)
                       res0, rec0)
 
             def cond(state):
-                n_acc, r, _, _ = state
-                return (n_acc < n_cap) & (r < max_rounds)
+                n_acc, r, _, _, _ = state
+                return (n_acc < n_target) & (r < max_rounds)
 
             def body(state):
-                n_acc, r, res, rec = state
+                n_acc, r, n_valid, res, rec = state
                 out = run_lanes(jax.random.fold_in(key, r), dyn)
                 acc = out["valid"] if all_accept else (
                     out["accepted"] & out["valid"]
@@ -526,23 +531,57 @@ class DeviceContext:
                         out["valid"], mode="drop"),
                 }
                 return (n_acc + jnp.sum(acc, dtype=jnp.int32), r + 1,
+                        n_valid + jnp.sum(out["valid"], dtype=jnp.int32),
                         res, rec)
 
-            n_acc, rounds, res, rec = jax.lax.while_loop(cond, body, state0)
-            return {"n_acc": n_acc, "rounds": rounds, **res,
+            n_acc, rounds, n_valid, res, rec = jax.lax.while_loop(
+                cond, body, state0
+            )
+            return {"n_acc": n_acc, "rounds": rounds, "n_valid": n_valid,
+                    **res,
                     "rec_" + "sumstats": rec["sumstats"],
                     "rec_distance": rec["distance"],
                     "rec_accepted": rec["accepted"],
                     "rec_valid": rec["valid"]}
 
-        fn = jax.jit(generation_fn)
+        if self.mesh is not None and len(
+            {d.process_index for d in self.mesh.devices.flat}
+        ) > 1:
+            # multi-host: replicate outputs (an all-gather over DCN at the
+            # generation barrier — the reference's result-queue drain) so
+            # every host can device_get the full reservoir for the
+            # replicated adaptation step
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            fn = jax.jit(
+                generation_fn,
+                out_shardings=NamedSharding(self.mesh, P()),
+            )
+        else:
+            fn = jax.jit(generation_fn)
         self._kernels[cache_key] = fn
         return fn
 
+    def dispatch_generation(self, key, B: int, mode: str, dyn: dict, *,
+                            n_cap: int, rec_cap: int, max_rounds: int,
+                            n_target: int | None = None) -> dict:
+        """Launch the fused generation kernel WITHOUT blocking: returns the
+        dict of device arrays (jax dispatch is async — the host is free
+        until someone calls device_get). This is the hook for
+        cross-generation pipelining: persist/analyze generation t on the
+        host while the device already runs generation t+1."""
+        if n_target is None:
+            n_target = n_cap
+        return self.generation_kernel(B, mode, n_cap, rec_cap, max_rounds)(
+            key, dyn, jnp.asarray(min(n_target, n_cap), jnp.int32)
+        )
+
     def run_generation(self, key, B: int, mode: str, dyn: dict, *,
-                       n_cap: int, rec_cap: int, max_rounds: int) -> dict:
-        out = self.generation_kernel(B, mode, n_cap, rec_cap, max_rounds)(
-            key, dyn
+                       n_cap: int, rec_cap: int, max_rounds: int,
+                       n_target: int | None = None) -> dict:
+        out = self.dispatch_generation(
+            key, B, mode, dyn, n_cap=n_cap, rec_cap=rec_cap,
+            max_rounds=max_rounds, n_target=n_target,
         )
         return jax.device_get(out)
 

@@ -1,0 +1,4 @@
+"""Multi-device / multi-host execution utilities."""
+from . import distributed
+
+__all__ = ["distributed"]

@@ -1,0 +1,106 @@
+"""Multi-host (multi-process) execution over DCN.
+
+The reference scales beyond one node with a Redis broker + elastic workers
+(``pyabc/sampler/redis_eps/sampler.py``, SURVEY.md §2.3/§5.8). The
+TPU-native replacement is the JAX multi-controller runtime: every host runs
+the SAME ABCSMC program (SPMD), the particle axis is sharded over a global
+``Mesh`` spanning all hosts' devices, and the per-generation barrier that
+the reference implements with Redis counters is simply the collective at
+the end of the fused generation kernel — XLA moves data over ICI within a
+slice and DCN across slices.
+
+Usage (one process per host, identical code on each)::
+
+    from pyabc_tpu.parallel import distributed as dist
+
+    dist.initialize()                     # env-driven (or pass args)
+    mesh = dist.global_mesh()
+    abc = pt.ABCSMC(model, prior, ..., mesh=mesh, seed=0)
+    abc.new(dist.primary_db("sqlite:///run.db"), obs)
+    abc.run(max_nr_populations=10)
+
+Determinism contract: every host must construct ABCSMC with the SAME seed
+and configuration. All device work is collective; all host-side adaptation
+is replicated deterministically (numpy on identical inputs), so the hosts
+stay in lock-step without any broker. Only the primary host persists to a
+real database (``primary_db``); the others write to throwaway in-memory
+stores.
+
+Elasticity note (honest deviation): TPU slices are gang-scheduled — worker
+join/leave mid-generation (the Redis sampler's elasticity) does not exist
+here; recovery is checkpoint/resume via the History db (SURVEY.md §5.3/§5.4).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None, *,
+               platform: str | None = None,
+               num_cpu_devices: int | None = None,
+               cpu_collectives: str = "gloo") -> None:
+    """``jax.distributed.initialize`` with env-var defaults.
+
+    Env fallbacks: ``PYABC_TPU_COORDINATOR``, ``PYABC_TPU_NUM_PROCESSES``,
+    ``PYABC_TPU_PROCESS_ID`` — or, on real multi-host TPU pods, pass nothing
+    and let JAX's cluster auto-detection fill everything in.
+
+    ``platform='cpu'`` + ``num_cpu_devices=N`` force an N-virtual-device CPU
+    backend per process (the multi-host-as-multi-process-on-localhost test
+    rig, mirroring the reference's localhost Redis tests); CPU cross-process
+    collectives use ``cpu_collectives`` ('gloo').
+    """
+    import jax
+
+    if platform is not None:
+        jax.config.update("jax_platforms", platform)
+    if num_cpu_devices is not None:
+        jax.config.update("jax_num_cpu_devices", int(num_cpu_devices))
+    if platform == "cpu" or num_cpu_devices is not None:
+        jax.config.update(
+            "jax_cpu_collectives_implementation", cpu_collectives
+        )
+    coordinator_address = coordinator_address or os.environ.get(
+        "PYABC_TPU_COORDINATOR"
+    )
+    if num_processes is None and "PYABC_TPU_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["PYABC_TPU_NUM_PROCESSES"])
+    if process_id is None and "PYABC_TPU_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["PYABC_TPU_PROCESS_ID"])
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes, process_id=process_id,
+    )
+
+
+def global_mesh(axis_name: str = "particles"):
+    """1-D mesh over ALL devices of ALL processes (DCN + ICI)."""
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()), axis_names=(axis_name,))
+
+
+def is_primary() -> bool:
+    import jax
+
+    return jax.process_index() == 0
+
+
+def primary_db(db: str) -> str:
+    """The real db url on the primary host, a throwaway in-memory store on
+    the others (the History is written identically everywhere; one copy is
+    enough and sqlite files must not be shared over NFS)."""
+    return db if is_primary() else "sqlite://"
+
+
+def barrier(name: str = "pyabc_tpu_barrier") -> None:
+    """Explicit cross-host sync point (rarely needed: every generation's
+    collective already synchronizes)."""
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)

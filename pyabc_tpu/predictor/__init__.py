@@ -1,0 +1,19 @@
+"""Regression predictors for learned summary statistics
+(reference ``pyabc/predictor/``)."""
+from .predictor import (
+    GPPredictor,
+    LassoPredictor,
+    LinearPredictor,
+    MLPPredictor,
+    ModelSelectionPredictor,
+    Predictor,
+)
+
+__all__ = [
+    "Predictor",
+    "LinearPredictor",
+    "LassoPredictor",
+    "MLPPredictor",
+    "GPPredictor",
+    "ModelSelectionPredictor",
+]

@@ -1,0 +1,403 @@
+"""Regression predictors for learned summary statistics.
+
+Reference parity: ``pyabc/predictor/predictor.py::{Predictor,
+LinearPredictor, LassoPredictor, GPPredictor, MLPPredictor,
+ModelSelectionPredictor}`` (SURVEY.md §2.2 last row) — the regression
+models behind Fearnhead-Prangle learned statistics: fit theta ~ f(x) on
+recorded simulations, then use s(x) = f(x) as the summary statistic.
+
+TPU-first: fitting runs host-side once per generation (small problems), but
+every predictor exposes a TRACEABLE ``device_predict(x, params)`` +
+``device_params()`` pair so the learned transform itself executes inside
+the jitted generation kernel — per-generation refits swap array arguments,
+never recompile.
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _standardize_fit(x: np.ndarray):
+    mu = x.mean(axis=0)
+    sd = x.std(axis=0)
+    sd = np.where(sd > 1e-12, sd, 1.0)
+    return mu, sd
+
+
+class Predictor(ABC):
+    """y ~ f(x) regression with a traceable predict path."""
+
+    @abstractmethod
+    def fit(self, x: np.ndarray, y: np.ndarray,
+            w: np.ndarray | None = None) -> None:
+        """Fit on (n, S) inputs and (n, d) targets, optional weights."""
+
+    @abstractmethod
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        """(n, S) or (S,) -> (n, d) or (d,). Host path."""
+
+    @property
+    @abstractmethod
+    def fitted(self) -> bool: ...
+
+    # ------------------------------------------------------------- device
+    def is_device_compatible(self) -> bool:
+        return True
+
+    @abstractmethod
+    def device_params(self):
+        """Pytree of jnp arrays representing the fitted transform."""
+
+    @abstractmethod
+    def device_predict(self, x, params):
+        """Traceable: (S,) flat vector + params pytree -> (d,) prediction."""
+
+    def __repr__(self):
+        return f"{type(self).__name__}()"
+
+
+class LinearPredictor(Predictor):
+    """Weighted ridge regression (reference LinearPredictor).
+
+    Closed form W = (X'ΛX + αI)^-1 X'Λ y on standardized inputs.
+    """
+
+    def __init__(self, alpha: float = 1e-6, normalize: bool = True):
+        self.alpha = float(alpha)
+        self.normalize = normalize
+        self._W = None  # (S, d)
+        self._b = None  # (d,)
+        self._mu = None
+        self._sd = None
+
+    @property
+    def fitted(self) -> bool:
+        return self._W is not None
+
+    def fit(self, x, y, w=None):
+        x = np.asarray(x, np.float64)
+        y = np.asarray(y, np.float64)
+        if y.ndim == 1:
+            y = y[:, None]
+        n, S = x.shape
+        if self.normalize:
+            self._mu, self._sd = _standardize_fit(x)
+        else:
+            self._mu, self._sd = np.zeros(S), np.ones(S)
+        xs = (x - self._mu) / self._sd
+        if w is None:
+            w = np.ones(n)
+        w = np.asarray(w, np.float64) * n / np.sum(w)
+        xw = xs * w[:, None]
+        A = xs.T @ xw + self.alpha * np.eye(S)
+        ym = (w @ y) / n
+        B = xs.T @ (w[:, None] * (y - ym))
+        self._W = np.linalg.solve(A, B)
+        self._b = ym
+
+    def predict(self, x):
+        x = np.asarray(x, np.float64)
+        single = x.ndim == 1
+        xs = (np.atleast_2d(x) - self._mu) / self._sd
+        out = xs @ self._W + self._b
+        return out[0] if single else out
+
+    def device_params(self):
+        return {
+            "W": jnp.asarray(self._W, jnp.float32),
+            "b": jnp.asarray(self._b, jnp.float32),
+            "mu": jnp.asarray(self._mu, jnp.float32),
+            "sd": jnp.asarray(self._sd, jnp.float32),
+        }
+
+    def device_predict(self, x, params):
+        xs = (x - params["mu"]) / params["sd"]
+        return xs @ params["W"] + params["b"]
+
+
+class LassoPredictor(LinearPredictor):
+    """L1-regularized linear regression via ISTA (reference LassoPredictor,
+    sklearn Lasso there; here a dependency-free proximal gradient solve).
+    Shares the linear device transform."""
+
+    def __init__(self, alpha: float = 0.01, n_iter: int = 500,
+                 normalize: bool = True):
+        super().__init__(alpha=alpha, normalize=normalize)
+        self.n_iter = int(n_iter)
+
+    def fit(self, x, y, w=None):
+        x = np.asarray(x, np.float64)
+        y = np.asarray(y, np.float64)
+        if y.ndim == 1:
+            y = y[:, None]
+        n, S = x.shape
+        if self.normalize:
+            self._mu, self._sd = _standardize_fit(x)
+        else:
+            self._mu, self._sd = np.zeros(S), np.ones(S)
+        xs = (x - self._mu) / self._sd
+        ym = y.mean(axis=0)
+        yc = y - ym
+        # ISTA: step 1/L with L = largest eigenvalue of X'X/n
+        gram = xs.T @ xs / n
+        L = float(np.linalg.eigvalsh(gram)[-1]) + 1e-12
+        step = 1.0 / L
+        W = np.zeros((S, yc.shape[1]))
+        thr = self.alpha * step
+        xty = xs.T @ yc / n
+        for _ in range(self.n_iter):
+            grad = gram @ W - xty
+            W = W - step * grad
+            W = np.sign(W) * np.maximum(np.abs(W) - thr, 0.0)
+        self._W = W
+        self._b = ym
+
+
+class MLPPredictor(Predictor):
+    """Small MLP regressor trained with Adam (reference MLPPredictor;
+    jax-native instead of sklearn MLPRegressor)."""
+
+    def __init__(self, hidden: tuple = (64, 64), n_steps: int = 400,
+                 lr: float = 1e-3, seed: int = 0):
+        self.hidden = tuple(hidden)
+        self.n_steps = int(n_steps)
+        self.lr = float(lr)
+        self.seed = int(seed)
+        self._params = None
+        self._mu = self._sd = None
+        self._ymu = self._ysd = None
+
+    @property
+    def fitted(self) -> bool:
+        return self._params is not None
+
+    def _init_params(self, key, sizes):
+        params = []
+        for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+            key, sub = jax.random.split(key)
+            scale = np.sqrt(2.0 / fan_in)
+            params.append({
+                "w": jax.random.normal(sub, (fan_in, fan_out)) * scale,
+                "b": jnp.zeros((fan_out,)),
+            })
+        return params
+
+    @staticmethod
+    def _forward(params, x):
+        h = x
+        for layer in params[:-1]:
+            h = jnp.tanh(h @ layer["w"] + layer["b"])
+        last = params[-1]
+        return h @ last["w"] + last["b"]
+
+    def fit(self, x, y, w=None):
+        import optax
+
+        x = np.asarray(x, np.float64)
+        y = np.asarray(y, np.float64)
+        if y.ndim == 1:
+            y = y[:, None]
+        self._mu, self._sd = _standardize_fit(x)
+        self._ymu, self._ysd = _standardize_fit(y)
+        xs = jnp.asarray((x - self._mu) / self._sd, jnp.float32)
+        ys = jnp.asarray((y - self._ymu) / self._ysd, jnp.float32)
+        wts = jnp.asarray(
+            np.ones(len(x)) if w is None else w / np.mean(w), jnp.float32
+        )
+        sizes = (x.shape[1], *self.hidden, y.shape[1])
+        params = self._init_params(jax.random.key(self.seed), sizes)
+        opt = optax.adam(self.lr)
+        opt_state = opt.init(params)
+
+        def loss_fn(p):
+            pred = self._forward(p, xs)
+            return jnp.mean(wts[:, None] * (pred - ys) ** 2)
+
+        @jax.jit
+        def train(params, opt_state):
+            def step(carry, _):
+                p, s = carry
+                g = jax.grad(loss_fn)(p)
+                updates, s = opt.update(g, s)
+                p = optax.apply_updates(p, updates)
+                return (p, s), ()
+
+            (params, opt_state), _ = jax.lax.scan(
+                step, (params, opt_state), None, length=self.n_steps
+            )
+            return params
+
+        self._params = jax.device_get(train(params, opt_state))
+
+    def predict(self, x):
+        x = np.asarray(x, np.float64)
+        single = x.ndim == 1
+        xs = (np.atleast_2d(x) - self._mu) / self._sd
+        out = np.asarray(
+            self._forward(
+                jax.tree.map(jnp.asarray, self._params),
+                jnp.asarray(xs, jnp.float32),
+            )
+        )
+        out = out * self._ysd + self._ymu
+        return out[0] if single else out
+
+    def device_params(self):
+        return {
+            "layers": jax.tree.map(
+                lambda v: jnp.asarray(v, jnp.float32), self._params
+            ),
+            "mu": jnp.asarray(self._mu, jnp.float32),
+            "sd": jnp.asarray(self._sd, jnp.float32),
+            "ymu": jnp.asarray(self._ymu, jnp.float32),
+            "ysd": jnp.asarray(self._ysd, jnp.float32),
+        }
+
+    def device_predict(self, x, params):
+        xs = (x - params["mu"]) / params["sd"]
+        out = self._forward(params["layers"], xs)
+        return out * params["ysd"] + params["ymu"]
+
+
+class GPPredictor(Predictor):
+    """RBF kernel-ridge regression (reference GPPredictor; exact GP mean).
+
+    Training points are subsampled to ``cap`` and ZERO-PADDED to a static
+    size so the device transform k(x, X_train) @ alpha keeps one compiled
+    shape across generations (padded rows get alpha = 0: exact no-op).
+    """
+
+    def __init__(self, length_scale: float | None = None, alpha: float = 1e-4,
+                 cap: int = 512, seed: int = 0):
+        self.length_scale = length_scale
+        self.alpha = float(alpha)
+        self.cap = int(cap)
+        self.seed = int(seed)
+        self._X = None       # (cap, S) padded
+        self._alpha_w = None  # (cap, d) padded
+        self._ls = None
+        self._mu = self._sd = None
+        self._ymu = None
+
+    @property
+    def fitted(self) -> bool:
+        return self._X is not None
+
+    def fit(self, x, y, w=None):
+        x = np.asarray(x, np.float64)
+        y = np.asarray(y, np.float64)
+        if y.ndim == 1:
+            y = y[:, None]
+        rng = np.random.default_rng(self.seed)
+        n = len(x)
+        if n > self.cap:
+            idx = rng.choice(n, self.cap, replace=False)
+            x, y = x[idx], y[idx]
+            n = self.cap
+        self._mu, self._sd = _standardize_fit(x)
+        xs = (x - self._mu) / self._sd
+        self._ymu = y.mean(axis=0)
+        yc = y - self._ymu
+        if self.length_scale is None:
+            # median heuristic on pairwise distances
+            d2 = ((xs[:, None] - xs[None, :]) ** 2).sum(-1)
+            med = np.median(d2[d2 > 0]) if (d2 > 0).any() else 1.0
+            self._ls = float(np.sqrt(med / 2.0) + 1e-12)
+        else:
+            self._ls = float(self.length_scale)
+        K = np.exp(-((xs[:, None] - xs[None, :]) ** 2).sum(-1)
+                   / (2 * self._ls**2))
+        a = np.linalg.solve(K + self.alpha * np.eye(n), yc)
+        # zero-pad to the static cap (alpha rows of 0 contribute nothing)
+        S, d = xs.shape[1], yc.shape[1]
+        Xp = np.zeros((self.cap, S))
+        ap = np.zeros((self.cap, d))
+        Xp[:n] = xs
+        ap[:n] = a
+        self._X, self._alpha_w = Xp, ap
+
+    def predict(self, x):
+        x = np.asarray(x, np.float64)
+        single = x.ndim == 1
+        xs = (np.atleast_2d(x) - self._mu) / self._sd
+        K = np.exp(-((xs[:, None] - self._X[None, :]) ** 2).sum(-1)
+                   / (2 * self._ls**2))
+        out = K @ self._alpha_w + self._ymu
+        return out[0] if single else out
+
+    def device_params(self):
+        return {
+            "X": jnp.asarray(self._X, jnp.float32),
+            "a": jnp.asarray(self._alpha_w, jnp.float32),
+            "ls": jnp.asarray(self._ls, jnp.float32),
+            "mu": jnp.asarray(self._mu, jnp.float32),
+            "sd": jnp.asarray(self._sd, jnp.float32),
+            "ymu": jnp.asarray(self._ymu, jnp.float32),
+        }
+
+    def device_predict(self, x, params):
+        xs = (x - params["mu"]) / params["sd"]
+        k = jnp.exp(-jnp.sum((xs[None, :] - params["X"]) ** 2, axis=-1)
+                    / (2 * params["ls"] ** 2))
+        return k @ params["a"] + params["ymu"]
+
+
+class ModelSelectionPredictor(Predictor):
+    """Pick the best of several predictors by validation MSE (reference
+    ModelSelectionPredictor)."""
+
+    def __init__(self, predictors: list, split: float = 0.2, seed: int = 0):
+        self.predictors = list(predictors)
+        self.split = float(split)
+        self.seed = int(seed)
+        self.chosen: Predictor | None = None
+
+    @property
+    def fitted(self) -> bool:
+        return self.chosen is not None and self.chosen.fitted
+
+    def fit(self, x, y, w=None):
+        x = np.asarray(x, np.float64)
+        y = np.asarray(y, np.float64)
+        if y.ndim == 1:
+            y = y[:, None]
+        rng = np.random.default_rng(self.seed)
+        n = len(x)
+        perm = rng.permutation(n)
+        n_val = max(int(n * self.split), 1)
+        val, train = perm[:n_val], perm[n_val:]
+        best, best_mse = None, np.inf
+        for p in self.predictors:
+            try:
+                p.fit(x[train], y[train],
+                      None if w is None else np.asarray(w)[train])
+                mse = float(np.mean((p.predict(x[val]) - y[val]) ** 2))
+            except Exception:  # singular fits etc.: skip candidate
+                continue
+            if mse < best_mse:
+                best, best_mse = p, mse
+        if best is None:
+            raise RuntimeError("no predictor could be fit")
+        best.fit(x, y, w)  # refit the winner on everything
+        self.chosen = best
+
+    def predict(self, x):
+        return self.chosen.predict(x)
+
+    def is_device_compatible(self):
+        return all(p.is_device_compatible() for p in self.predictors)
+
+    def device_params(self):
+        return self.chosen.device_params()
+
+    def device_predict(self, x, params):
+        # NOTE: the chosen predictor class is baked into the trace; a change
+        # of winner across generations retriggers one compile (rare, cheap)
+        return self.chosen.device_predict(x, params)
+
+    def __repr__(self):
+        return f"ModelSelectionPredictor({self.predictors!r})"

@@ -52,14 +52,16 @@ class BatchedSampler(Sampler):
         self._last_B: int | None = None
 
     def _pick_B(self, n: int) -> int:
-        """Power-of-two batch with hysteresis: stick with the previous B
-        unless the target moved by more than 2x (every distinct B is a
-        separate XLA compile)."""
+        """Power-of-two batch with WIDE hysteresis: stick with the previous
+        B unless the target moved by more than 8x. Every distinct B is a
+        separate XLA compile (~10s on a TPU) while extra while_loop rounds
+        at a stale B cost milliseconds — recompiling to chase the
+        acceptance rate is almost never worth it."""
         rate = self._rate_estimate if self._rate_estimate else 0.5
         target = _pow2(max(int(n / rate * self.overshoot), self.min_batch),
                        self.min_batch, self.max_batch)
         if (self._last_B is not None
-                and self._last_B // 2 <= target <= self._last_B * 2):
+                and self._last_B // 8 <= target <= self._last_B * 8):
             return self._last_B
         self._last_B = target
         return target
@@ -84,7 +86,8 @@ class BatchedSampler(Sampler):
 
         sample = self.sample_factory()
         chunks = []
-        nr_eval = 0
+        lanes_total = 0  # all lanes (slot-id base)
+        nr_eval = 0      # valid lanes only = true model evaluations
         n_acc = 0
         r = 0
         # size B once per generation from the carried acceptance estimate and
@@ -92,33 +95,54 @@ class BatchedSampler(Sampler):
         # distinct B, reused across rounds AND generations
         B = self._pick_B(n)
         while n_acc < n:
-            if self.check_max_eval and nr_eval >= max_eval:
+            # guard on lanes_total, not valid-only nr_eval: an all-invalid
+            # regime (every simulation NaN) would never advance nr_eval and
+            # spin forever; max_rounds is the unconditional backstop
+            if self.check_max_eval and lanes_total >= max_eval:
+                break
+            if r >= self.max_rounds:
                 break
             res = ctx.run_round(round_key(gen_key, r), B, mode, dyn)
             if all_accepted:
                 res.accepted = res.valid.copy()
                 res.log_weights = np.where(res.valid, 0.0, -np.inf)
-            res.slot_ids = nr_eval + np.arange(B)
+            res.slot_ids = lanes_total + np.arange(B)
             chunks.append(res)
-            nr_eval += B
+            lanes_total += B
+            nr_eval += int(res.valid.sum())
             n_acc += int(res.accepted.sum())
             r += 1
             # grow B only on repeated undershoot (keeps compile cache warm)
-            rate = max(n_acc / nr_eval, 1.0 / nr_eval)
+            rate = max(n_acc / lanes_total, 1.0 / lanes_total)
             if (n - n_acc) > rate * B:
                 B = min(B * 2, self.max_batch)
-        self.nr_evaluations_ = nr_eval
-        self._rate_estimate = max(n_acc / nr_eval, 1.0 / nr_eval)
+        self.nr_evaluations_ = max(nr_eval, 1)
+        self._rate_estimate = max(n_acc / lanes_total, 1.0 / lanes_total)
 
         acc_mask = np.concatenate([c.accepted for c in chunks])
         return self._finalize_rounds(sample, chunks, acc_mask, n)
 
-    def _sample_fused(self, n, ctx, mode, dyn, gen_key, *, max_eval,
-                      all_accepted):
-        """One device dispatch for the whole generation (fused while_loop)."""
+    #: the fused path can dispatch a generation asynchronously and collect
+    #: later — the hook ABCSMC uses for cross-generation pipelining
+    supports_pipelining = True
+
+    def dispatch(self, n, generation_spec, t, *, max_eval=np.inf,
+                 all_accepted=False):
+        """Launch the whole generation on the device WITHOUT blocking.
+
+        Returns an opaque handle for :meth:`collect`. The TPU analog of the
+        reference Redis sampler's look-ahead: while the device crunches
+        generation t+1, the host persists/analyzes generation t
+        (SURVEY.md §2.3 look-ahead row; here proposals are built from FINAL
+        generation-t weights, so no weight correction is needed).
+        """
+        ctx = generation_spec.device
+        if ctx is None:
+            raise RuntimeError("dispatch() needs a device-capable generation")
+        mode, dyn = generation_spec.mode, generation_spec.dyn
+        # all_accepted arrives as the prior kernel with eps=+inf (calibration
+        # shares the prior compile); legacy 'calibration' mode still works
         sample = self.sample_factory()
-        if all_accepted and mode != "calibration":
-            mode = "calibration"
         B = self._pick_B(n)
         n_cap = _pow2(n, 64)
         rec_cap = 1
@@ -128,11 +152,37 @@ class BatchedSampler(Sampler):
         max_rounds = self.max_rounds
         if self.check_max_eval and np.isfinite(max_eval):
             max_rounds = max(1, min(max_rounds, int(max_eval) // B))
-        out = ctx.run_generation(
-            gen_key, B, mode, dyn, n_cap=n_cap, rec_cap=rec_cap,
-            max_rounds=max_rounds,
+        out = ctx.dispatch_generation(
+            generation_spec.gen_key, B, mode, dyn, n_cap=n_cap,
+            rec_cap=rec_cap, max_rounds=max_rounds, n_target=n,
         )
-        self.nr_evaluations_ = int(out["rounds"]) * B
+        return {"out": out, "sample": sample, "n": n, "n_cap": n_cap}
+
+    def collect(self, handle) -> Sample:
+        """Block on a dispatched generation and build the Sample."""
+        import jax
+
+        out = jax.device_get(handle["out"])
+        return self._finalize_fused(out, handle["sample"], handle["n"],
+                                    handle["n_cap"])
+
+    def _sample_fused(self, n, ctx, mode, dyn, gen_key, *, max_eval,
+                      all_accepted):
+        """One device dispatch for the whole generation (fused while_loop)."""
+        from types import SimpleNamespace
+
+        spec = SimpleNamespace(device=ctx, mode=mode, dyn=dyn,
+                               gen_key=gen_key)
+        return self.collect(self.dispatch(
+            n, spec, None, max_eval=max_eval, all_accepted=all_accepted
+        ))
+
+    def _finalize_fused(self, out, sample, n, n_cap):
+        # count only valid lanes as model evaluations: proposals that failed
+        # the prior-support redraws never reach the model in the reference
+        # (generate_valid_proposal retries without counting), and counting
+        # them skews acceptance-rate telemetry feeding adaptive schemes
+        self.nr_evaluations_ = max(int(out["n_valid"]), 1)
         k = min(int(out["n_acc"]), n_cap, n)
         log_w = np.asarray(out["log_weight"][:k], np.float64)
         finite = np.isfinite(log_w)

@@ -39,7 +39,8 @@ CREATE TABLE IF NOT EXISTS populations (
     t INTEGER,
     population_end_time TEXT,
     nr_samples INTEGER,
-    epsilon REAL
+    epsilon REAL,
+    telemetry TEXT
 );
 CREATE TABLE IF NOT EXISTS models (
     id INTEGER PRIMARY KEY AUTOINCREMENT,
@@ -98,6 +99,13 @@ class History:
         self.db = db
         self._conn = sqlite3.connect(_db_path(db))
         self._conn.executescript(_SCHEMA)
+        # schema migration for dbs created before the telemetry column
+        cols = [r[1] for r in self._conn.execute(
+            "PRAGMA table_info(populations)")]
+        if "telemetry" not in cols:
+            self._conn.execute(
+                "ALTER TABLE populations ADD COLUMN telemetry TEXT"
+            )
         self._conn.commit()
         self.id = _id if _id is not None else self._latest_id()
 
@@ -162,13 +170,22 @@ class History:
 
     # ------------------------------------------------------------ appending
     def append_population(self, t: int, current_epsilon: float, population,
-                          nr_simulations: int, model_names: list[str]) -> None:
+                          nr_simulations: int, model_names: list[str],
+                          telemetry: dict | None = None) -> None:
         cur = self._conn.cursor()
+        try:
+            # grab the write lock up front: the batched particle insert
+            # allocates explicit ids from SELECT MAX(id), which would race
+            # with another process appending to the same file
+            cur.execute("BEGIN IMMEDIATE")
+        except sqlite3.OperationalError:
+            pass  # already inside a transaction
         cur.execute(
             "INSERT INTO populations (abc_smc_id, t, population_end_time, "
-            "nr_samples, epsilon) VALUES (?,?,?,?,?)",
+            "nr_samples, epsilon, telemetry) VALUES (?,?,?,?,?,?)",
             (self.id, int(t), datetime.datetime.now().isoformat(),
-             int(nr_simulations), float(current_epsilon)),
+             int(nr_simulations), float(current_epsilon),
+             json.dumps(telemetry) if telemetry else None),
         )
         pop_id = cur.lastrowid
         probs = population.model_probabilities_array()
@@ -187,28 +204,61 @@ class History:
             space = population.spaces[m]
             # within-model normalized weights (reference stores these)
             w_model = population.weights[mask] / probs[m]
-            rows = [(model_id, float(w), float(population.distances[i]))
-                    for w, i in zip(w_model, idxs)]
-            for (mid, w, d), i in zip(rows, idxs):
-                cur.execute(
-                    "INSERT INTO particles (model_id, w, distance) "
-                    "VALUES (?,?,?)", (mid, w, d),
-                )
-                particle_id = cur.lastrowid
-                theta = population.thetas[i, : space.dim]
-                cur.executemany(
-                    "INSERT INTO parameters (particle_id, name, value) "
-                    "VALUES (?,?,?)",
-                    [(particle_id, nm, float(v))
-                     for nm, v in zip(space.names, theta)],
-                )
-                cur.execute(
-                    "INSERT INTO samples (particle_id, name, value) "
-                    "VALUES (?,?,?)",
-                    (particle_id, "__flat__",
-                     np_to_bytes(population.sumstats[i])),
-                )
+            # batched inserts with explicit particle ids: one executemany per
+            # table instead of 2+d statements per particle (at pop sizes of
+            # 10^3-10^5 the per-row Python round-trips dominate persistence)
+            base = cur.execute(
+                "SELECT COALESCE(MAX(id), 0) FROM particles"
+            ).fetchone()[0]
+            pids = range(base + 1, base + 1 + len(idxs))
+            cur.executemany(
+                "INSERT INTO particles (id, model_id, w, distance) "
+                "VALUES (?,?,?,?)",
+                [(pid, model_id, float(w), float(population.distances[i]))
+                 for pid, w, i in zip(pids, w_model, idxs)],
+            )
+            cur.executemany(
+                "INSERT INTO parameters (particle_id, name, value) "
+                "VALUES (?,?,?)",
+                [(pid, nm, float(v))
+                 for pid, i in zip(pids, idxs)
+                 for nm, v in zip(space.names,
+                                  population.thetas[i, : space.dim])],
+            )
+            cur.executemany(
+                "INSERT INTO samples (particle_id, name, value) "
+                "VALUES (?,?,?)",
+                [(pid, "__flat__", np_to_bytes(population.sumstats[i]))
+                 for pid, i in zip(pids, idxs)],
+            )
         self._conn.commit()
+
+    def update_telemetry(self, t: int, telemetry: dict) -> None:
+        """Merge keys into the telemetry json of generation t (adaptation
+        timings only exist after the row is first written)."""
+        pop_id = self._pop_id(t)
+        if pop_id is None:
+            return
+        row = self._conn.execute(
+            "SELECT telemetry FROM populations WHERE id=?", (pop_id,)
+        ).fetchone()
+        merged = dict(json.loads(row[0]) if row and row[0] else {})
+        merged.update(telemetry)
+        self._conn.execute(
+            "UPDATE populations SET telemetry=? WHERE id=?",
+            (json.dumps(merged), pop_id),
+        )
+        self._conn.commit()
+
+    def get_telemetry(self, t: int | None = None) -> dict:
+        """Per-generation timing/telemetry json (empty dict if none)."""
+        pop_id = self._pop_id(self._resolve_t(t))
+        if pop_id is None:
+            return {}
+        row = self._conn.execute(
+            "SELECT telemetry FROM populations WHERE id=?", (pop_id,)
+        ).fetchone()
+        return json.loads(row[0]) if row and row[0] else {}
 
     # ------------------------------------------------------------- queries
     def _pop_id(self, t: int) -> int | None:

@@ -1,0 +1,4 @@
+"""Summary-statistic transforms (reference ``pyabc/sumstat/``)."""
+from .base import IdentitySumstat, PredictorSumstat, Sumstat
+
+__all__ = ["Sumstat", "IdentitySumstat", "PredictorSumstat"]

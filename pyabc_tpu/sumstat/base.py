@@ -1,0 +1,180 @@
+"""Summary-statistic layer: transforms of raw simulator output.
+
+Reference parity: ``pyabc/sumstat/base.py::{Sumstat, IdentitySumstat}`` and
+``pyabc/sumstat/subset.py`` era trafos (SURVEY.md §2.2 last row). A Sumstat
+maps the FLAT raw sum-stat vector (see ``SumStatSpec``) to the feature
+vector the distance actually compares; ``PredictorSumstat`` learns that
+mapping each generation (Fearnhead-Prangle 2012: s(x) = E[theta | x]
+regression estimates are near-optimal summaries).
+
+TPU-first contract mirroring Distance: ``device_params(t)`` is a pytree of
+arrays swapped per generation (refits never recompile),
+``device_fn(spec) -> fn(x_flat, params) -> s`` is traceable and runs inside
+the generation kernel.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.sumstat_spec import SumStatSpec
+from ..predictor import Predictor
+
+
+class Sumstat:
+    """Identity base class (pyabc Sumstat/IdentitySumstat without trafos)."""
+
+    def initialize(self, t: int, get_all_sum_stats: Callable | None = None,
+                   x_0=None, spec: SumStatSpec | None = None) -> None:
+        self.spec = spec
+
+    def configure_sampler(self, sampler) -> None:
+        pass
+
+    def update(self, t: int, population=None,
+               get_all_sum_stats: Callable | None = None) -> bool:
+        """Refit on generation data; True if the transform changed."""
+        return False
+
+    def out_dim(self, in_dim: int) -> int:
+        return in_dim
+
+    def __call__(self, flat: np.ndarray) -> np.ndarray:
+        """Host transform of a flat (S,) or (n, S) sum-stat array."""
+        return np.asarray(flat, np.float64)
+
+    # ---------------------------------------------------------------- device
+    def is_device_compatible(self) -> bool:
+        return True
+
+    def device_params(self, t: int | None = None):
+        return ()
+
+    def device_fn(self, spec: SumStatSpec):
+        def fn(x, params):
+            return x
+
+        return fn
+
+    def requires_calibration(self) -> bool:
+        return False
+
+    def __repr__(self):
+        return f"{type(self).__name__}()"
+
+
+class IdentitySumstat(Sumstat):
+    """Raw statistics, optionally expanded through elementwise trafos
+    (pyabc IdentitySumstat(trafos=...)): e.g. ``[lambda x: x,
+    lambda x: x**2]`` doubles the feature vector with squares."""
+
+    def __init__(self, trafos: Sequence[Callable] | None = None):
+        self.trafos = list(trafos) if trafos is not None else None
+
+    def out_dim(self, in_dim: int) -> int:
+        return in_dim * (len(self.trafos) if self.trafos else 1)
+
+    def __call__(self, flat: np.ndarray) -> np.ndarray:
+        flat = np.asarray(flat, np.float64)
+        if not self.trafos:
+            return flat
+        return np.concatenate([np.asarray(tr(flat)) for tr in self.trafos],
+                              axis=-1)
+
+    def device_fn(self, spec: SumStatSpec):
+        trafos = self.trafos
+
+        def fn(x, params):
+            if not trafos:
+                return x
+            return jnp.concatenate([jnp.asarray(tr(x)) for tr in trafos],
+                                   axis=-1)
+
+        return fn
+
+    def __repr__(self):
+        n = len(self.trafos) if self.trafos else 1
+        return f"IdentitySumstat(trafos={n})"
+
+
+class PredictorSumstat(Sumstat):
+    """Learned statistics s(x) = predicted theta (pyabc PredictorSumstat;
+    Fearnhead-Prangle).
+
+    Each generation the predictor refits theta ~ x on the accepted
+    population (plus, when available, recorded rejected simulations carry no
+    thetas in the ring — the accepted set is the reference's default fit
+    set too), and the fitted regression becomes the next generation's
+    summary transform. Until first fit the transform is the identity.
+
+    ``fit_every``: refit cadence; 1 = every generation.
+    """
+
+    def __init__(self, predictor: Predictor, normalize_labels: bool = True,
+                 fit_every: int = 1, min_samples: int | None = None):
+        self.predictor = predictor
+        self.normalize_labels = normalize_labels
+        self.fit_every = int(fit_every)
+        self.min_samples = min_samples
+        self._out_dim: int | None = None
+        self._last_fit_t: int | None = None
+
+    def out_dim(self, in_dim: int) -> int:
+        return self._out_dim if self._out_dim is not None else in_dim
+
+    def update(self, t: int, population=None,
+               get_all_sum_stats: Callable | None = None) -> bool:
+        if population is None:
+            return False
+        if (self._last_fit_t is not None
+                and t - self._last_fit_t < self.fit_every):
+            return False
+        x = np.asarray(population.sumstats, np.float64)
+        # fit targets: the free parameters of the dominant model's space
+        # (multi-model: thetas are zero-padded to d_max; regression on the
+        # padded matrix is well-defined — padded columns predict constants)
+        y = np.asarray(population.thetas, np.float64)
+        w = np.asarray(population.weights, np.float64)
+        need = self.min_samples if self.min_samples is not None else (
+            x.shape[1] + 2
+        )
+        if len(x) < need:
+            return False
+        self.predictor.fit(x, y, w)
+        self._out_dim = y.shape[1]
+        self._last_fit_t = t
+        return True
+
+    def __call__(self, flat: np.ndarray) -> np.ndarray:
+        if not self.predictor.fitted:
+            return np.asarray(flat, np.float64)
+        return np.asarray(self.predictor.predict(flat), np.float64)
+
+    def is_device_compatible(self) -> bool:
+        return self.predictor.is_device_compatible()
+
+    def device_params(self, t: int | None = None):
+        if not self.predictor.fitted:
+            return ()
+        return self.predictor.device_params()
+
+    def device_fn(self, spec: SumStatSpec):
+        predictor = self.predictor
+
+        def fn(x, params):
+            # read .fitted at TRACE time (inside fn), not at closure build:
+            # the first fit changes the dyn pytree structure, which triggers
+            # a retrace, which re-evaluates this branch with fitted=True
+            if not predictor.fitted:
+                return x
+            return predictor.device_predict(x, params)
+
+        return fn
+
+    def requires_calibration(self) -> bool:
+        return False
+
+    def __repr__(self):
+        return f"PredictorSumstat({self.predictor!r})"

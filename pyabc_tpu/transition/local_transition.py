@@ -51,13 +51,16 @@ class LocalTransition(Transition):
         return int(np.clip(k, dim + 1, n))
 
     def fit(self, X: pd.DataFrame, w: np.ndarray) -> None:
-        self.store_fit_params(X, w)
+        # validate BEFORE store_fit_params: self.X non-None is the fitted
+        # indicator downstream (device_params / rvs_single); storing first and
+        # then raising would leave a half-fitted object that crashes later
         arr = np.asarray(X, np.float64)
         n, dim = arr.shape
         if n < dim + 1:
             raise NotEnoughParticles(
                 f"LocalTransition needs > dim+1={dim + 1} particles, got {n}"
             )
+        self.store_fit_params(X, w)
         k = self._effective_k(n, dim)
         # dense pairwise sq-distances; top-k smallest per row
         sq = ((arr[:, None, :] - arr[None, :, :]) ** 2).sum(-1)
@@ -102,13 +105,13 @@ class LocalTransition(Transition):
 
     def device_params(self):
         return {
-            "thetas": jnp.asarray(np.asarray(self.X, np.float64), jnp.float32),
-            "weights": jnp.asarray(self.w, jnp.float32),
-            "chols": jnp.asarray(self._chols, jnp.float32),
-            "precs": jnp.asarray(self._precs, jnp.float32),
-            "logdets": jnp.asarray(self._logdets, jnp.float32),
+            "thetas": np.asarray(self.X, np.float32),
+            "weights": np.asarray(self.w, np.float32),
+            "chols": np.asarray(self._chols, np.float32),
+            "precs": np.asarray(self._precs, np.float32),
+            "logdets": np.asarray(self._logdets, np.float32),
             # true dim; see MultivariateNormalTransition.device_params
-            "dim": jnp.asarray(self.X.shape[1], jnp.float32),
+            "dim": np.float32(self.X.shape[1]),
         }
 
     @staticmethod

@@ -45,7 +45,9 @@ class ModelPerturbationKernel:
 
     # ------------------------------------------------------------- device
     def device_params(self):
-        return jnp.asarray(self._transition_matrix(), jnp.float32)
+        # numpy, not jnp: this feeds host-side dyn-arg assembly every
+        # generation; a device array here costs a TPU round-trip per call
+        return np.asarray(self._transition_matrix(), np.float32)
 
     @staticmethod
     def device_rvs(key, m, matrix):

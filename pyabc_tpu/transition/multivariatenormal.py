@@ -94,15 +94,15 @@ class MultivariateNormalTransition(Transition):
 
     def device_params(self):
         return {
-            "thetas": jnp.asarray(np.asarray(self.X, np.float64), jnp.float32),
-            "weights": jnp.asarray(self.w, jnp.float32),
-            "chol": jnp.asarray(self._chol, jnp.float32),
-            "prec": jnp.asarray(self._prec, jnp.float32),
-            "logdet": jnp.asarray(self._logdet, jnp.float32),
+            "thetas": np.asarray(self.X, np.float32),
+            "weights": np.asarray(self.w, np.float32),
+            "chol": np.asarray(self._chol, np.float32),
+            "prec": np.asarray(self._prec, np.float32),
+            "logdet": np.asarray(self._logdet, np.float32),
             # true parameter dim: padded copies keep this so the density
             # normalization constant is not biased by padding (thetas may be
             # padded to d_max for multi-model batching)
-            "dim": jnp.asarray(self.X.shape[1], jnp.float32),
+            "dim": np.float32(self.X.shape[1]),
         }
 
     @staticmethod

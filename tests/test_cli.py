@@ -1,0 +1,61 @@
+"""CLI entry-point tests (abc-export; reference `pyabc/storage/export.py`)."""
+import numpy as np
+from click.testing import CliRunner
+
+import pyabc_tpu as pt
+from pyabc_tpu.cli import export_cmd
+
+
+def _make_db(tmp_path):
+    db = f"{tmp_path}/cli.db"
+
+    def model(par):
+        return {"y": par["mu"] + 0.3 * np.random.normal()}
+
+    np.random.seed(0)
+    abc = pt.ABCSMC(
+        pt.SimpleModel(model),
+        pt.Distribution(mu=pt.RV("uniform", -2.0, 4.0)),
+        pt.PNormDistance(p=2), population_size=30,
+        eps=pt.QuantileEpsilon(initial_epsilon=2.0, alpha=0.5),
+        sampler=pt.SingleCoreSampler(),
+    )
+    abc.new(f"sqlite:///{db}", {"y": 0.5})
+    abc.run(max_nr_populations=2)
+    return db
+
+
+def test_export_populations_csv(tmp_path):
+    db = _make_db(tmp_path)
+    res = CliRunner().invoke(export_cmd, [db, "--what", "populations"])
+    assert res.exit_code == 0, res.output
+    lines = res.output.strip().splitlines()
+    assert lines[0].startswith("t,")
+    assert len(lines) >= 3  # PRE_TIME + 2 generations
+
+
+def test_export_particles_to_file(tmp_path):
+    db = _make_db(tmp_path)
+    out = f"{tmp_path}/particles.csv"
+    res = CliRunner().invoke(export_cmd, [db, "--out", out])
+    assert res.exit_code == 0, res.output
+    import pandas as pd
+
+    df = pd.read_csv(out)
+    assert {"mu", "w"} <= set(df.columns)
+    assert len(df) == 30
+    assert np.isclose(df["w"].sum(), 1.0)
+
+
+def test_export_model_probabilities(tmp_path):
+    db = _make_db(tmp_path)
+    res = CliRunner().invoke(
+        export_cmd, [db, "--what", "model-probabilities", "--format", "json"]
+    )
+    assert res.exit_code == 0, res.output
+    import json
+
+    rows = json.loads(res.output)
+    # one row per generation; single model => probability 1.0
+    assert len(rows) == 2
+    assert all(np.isclose(sum(r.values()), 1.0) for r in rows)

@@ -1,0 +1,142 @@
+"""Multi-chip (mesh) correctness tests on the virtual 8-device CPU platform.
+
+The TPU analog of the reference's multi-node-as-multi-process-on-localhost
+testing (SURVEY.md §4): conftest forces
+``--xla_force_host_platform_device_count=8``, so a real 8-device
+``jax.sharding.Mesh`` exists and GSPMD inserts real cross-device
+partitioning — no fake backend.
+
+Covers VERDICT r1 #2: (i) posterior agreement between meshed and
+single-device runs, (ii) the compiled kernel actually carries sharded
+shapes across devices, (iii) slot-trim determinism across shardings.
+"""
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import pyabc_tpu as pt
+from pyabc_tpu.models import model_selection as msel
+
+PRIOR_SD = 1.0
+NOISE_SD = 0.5
+X_OBS = 1.0
+POST_VAR = 1.0 / (1 / PRIOR_SD**2 + 1 / NOISE_SD**2)
+POST_MU = POST_VAR * (X_OBS / NOISE_SD**2)
+
+
+def _mesh(n=8):
+    devs = jax.devices("cpu")
+    if len(devs) < n:
+        pytest.skip(f"need {n} virtual cpu devices, have {len(devs)}")
+    return Mesh(np.asarray(devs[:n]), axis_names=("particles",))
+
+
+def _gauss_model():
+    @pt.JaxModel.from_function(["theta"], name="gauss")
+    def model(key, theta):
+        return {"x": theta[0] + NOISE_SD * jax.random.normal(key)}
+
+    return model
+
+
+def _moments(h, m=0, par="theta"):
+    df, w = h.get_distribution(m)
+    mu = float(np.sum(df[par] * w))
+    sd = float(np.sqrt(np.sum(w * (df[par] - mu) ** 2)))
+    return mu, sd
+
+
+class TestMeshedGaussianToy:
+    def test_posterior_agrees_with_single_device(self):
+        kwargs = dict(
+            population_size=400, eps=pt.ListEpsilon([1.0, 0.5, 0.3]), seed=21
+        )
+        prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+
+        abc1 = pt.ABCSMC(_gauss_model(), prior, pt.PNormDistance(p=2),
+                         **kwargs)
+        abc1.new("sqlite://", {"x": X_OBS})
+        h1 = abc1.run(max_nr_populations=3)
+        mu1, sd1 = _moments(h1)
+
+        abc8 = pt.ABCSMC(_gauss_model(), prior, pt.PNormDistance(p=2),
+                         mesh=_mesh(), **kwargs)
+        assert isinstance(abc8.sampler, pt.BatchedSampler)
+        abc8.new("sqlite://", {"x": X_OBS})
+        h8 = abc8.run(max_nr_populations=3)
+        mu8, sd8 = _moments(h8)
+
+        assert mu8 == pytest.approx(POST_MU, abs=0.2)
+        assert mu8 == pytest.approx(mu1, abs=0.2)
+        assert sd8 == pytest.approx(sd1, abs=0.15)
+
+    def test_multimodel_on_mesh(self):
+        models, priors, analytic = msel.tractable_pair()
+        abc = pt.ABCSMC(models, priors, pt.PNormDistance(p=2),
+                        population_size=600, eps=pt.MedianEpsilon(),
+                        seed=22, mesh=_mesh())
+        abc.new("sqlite://", {"x": X_OBS})
+        h = abc.run(max_nr_populations=5)
+        probs = h.get_model_probabilities(h.max_t)
+        expected = analytic(X_OBS)
+        for m in range(2):
+            p = float(probs["p"].get(m, 0.0))
+            assert p == pytest.approx(expected[m], abs=0.18), (m, p, expected)
+
+
+class TestShardingMechanics:
+    """The kernel must genuinely shard over the mesh, not replicate."""
+
+    def _ctx(self, mesh):
+        from pyabc_tpu.inference.util import DeviceContext
+
+        model = _gauss_model()
+        prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+        obs = {"x": np.asarray(X_OBS)}
+        spec = pt.SumStatSpec(obs)
+        distance = pt.PNormDistance(p=2, sumstat_spec=spec)
+        distance.initialize(0, None, obs)
+        return DeviceContext(
+            models=[model], parameter_priors=[prior],
+            model_prior_logits=np.asarray([0.0]),
+            distance=distance, acceptor=pt.UniformAcceptor(), spec=spec,
+            x_0_flat=np.asarray(spec.flatten(obs)),
+            transition_cls=pt.MultivariateNormalTransition, mesh=mesh,
+        )
+
+    def test_round_outputs_sharded_over_devices(self):
+        mesh = _mesh()
+        ctx = self._ctx(mesh)
+        _, dyn = ctx.build_dyn_args(t=0, eps_value=1.0)
+        B = 64
+        out = ctx.round_kernel(B, "prior")(jax.random.key(0), dyn)
+        sh = out["theta"].sharding
+        assert isinstance(sh, NamedSharding)
+        assert sh.spec == P("particles")
+        assert len(sh.mesh.devices.ravel()) == 8
+        # each device holds exactly B/8 lanes, not a replica of all B
+        shard_shapes = {s.data.shape for s in out["theta"].addressable_shards}
+        assert shard_shapes == {(B // 8, 1)}
+        assert len(out["theta"].addressable_shards) == 8
+
+    def test_slot_trim_deterministic_across_shardings(self):
+        """Same key => identical accepted set with and without the mesh:
+        the slot-ordered compaction is sharding-invariant (the reference's
+        dynamic-scheduler unbiasedness invariant, SURVEY.md §3.4)."""
+        key = jax.random.key(42)
+        results = []
+        for mesh in (None, _mesh()):
+            ctx = self._ctx(mesh)
+            _, dyn = ctx.build_dyn_args(t=0, eps_value=0.8)
+            out = ctx.run_generation(
+                key, 64, "prior", dyn, n_cap=32, rec_cap=64, max_rounds=16
+            )
+            results.append(out)
+        a, b = results
+        assert a["n_acc"] == b["n_acc"]
+        np.testing.assert_array_equal(a["slot"], b["slot"])
+        np.testing.assert_allclose(a["theta"], b["theta"], rtol=1e-5)
+        np.testing.assert_allclose(
+            a["log_weight"], b["log_weight"], rtol=1e-5
+        )
