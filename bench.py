@@ -149,27 +149,44 @@ def run_host_baseline(pop_size: int = 60, n_gens: int = 2, seed: int = 0,
     host closure path (reference-faithful simulate_one loop) via
     SingleCoreSampler, scaled by assumed_cores as an upper bound on
     MulticoreEvalParallelSampler. Replace with a real pyABC run the moment
-    the reference mount/network appears (BASELINE.md)."""
-    import numpy as np
+    the reference mount/network appears (BASELINE.md).
 
-    import pyabc_tpu as pt
-    from pyabc_tpu.models import lotka_volterra as lv
-
-    model = lv.make_lv_model()
-    prior = lv.default_prior()
-    obs = lv.observed_data(seed=123)
-    np.random.seed(seed)
-    abc = pt.ABCSMC(
-        model, prior, pt.PNormDistance(p=2), population_size=pop_size,
-        eps=pt.QuantileEpsilon(initial_epsilon=200.0, alpha=0.5),
-        sampler=pt.SingleCoreSampler(),
+    Runs in a SUBPROCESS with JAX_PLATFORMS=cpu: the reference is a CPU
+    framework, and the scalar path issues one tiny dispatch per simulation —
+    over the axon TPU tunnel each dispatch pays ~0.1s of RPC latency, which
+    would understate the baseline ~25x and flatter vs_baseline dishonestly.
+    """
+    code = f"""
+import time, numpy as np
+import jax
+# the axon plugin ignores JAX_PLATFORMS; pin the default device instead
+jax.config.update("jax_default_device", jax.devices("cpu")[0])
+import pyabc_tpu as pt
+from pyabc_tpu.models import lotka_volterra as lv
+model = lv.make_lv_model(); prior = lv.default_prior()
+obs = lv.observed_data(seed=123)
+np.random.seed({seed})
+abc = pt.ABCSMC(model, prior, pt.PNormDistance(p=2),
+                population_size={pop_size},
+                eps=pt.QuantileEpsilon(initial_epsilon=200.0, alpha=0.5),
+                sampler=pt.SingleCoreSampler())
+abc.new("sqlite://", obs)
+t0 = time.time()
+h = abc.run(max_nr_populations={n_gens}, max_walltime={budget_s})
+elapsed = time.time() - t0
+print("BASELINE_PPS", {pop_size} * h.n_populations / elapsed * {assumed_cores})
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=budget_s + 120, env=env, cwd=HERE,
     )
-    abc.new("sqlite://", obs)
-    t0 = time.time()
-    h = abc.run(max_nr_populations=n_gens, max_walltime=budget_s)
-    elapsed = time.time() - t0
-    accepted = pop_size * h.n_populations
-    return accepted / elapsed * assumed_cores
+    for line in proc.stdout.splitlines():
+        if line.startswith("BASELINE_PPS"):
+            return float(line.split()[1])
+    raise RuntimeError(
+        f"host baseline failed: rc={proc.returncode}\n{proc.stderr[-2000:]}"
+    )
 
 
 def main():

@@ -1,0 +1,64 @@
+"""Micro-profile one LV generation: kernel vs transfer vs host adaptation.
+
+Not part of the package — a diagnostic harness for BASELINE.md's gap
+analysis. Run on the real chip (default) or CPU (JAX_PLATFORMS=cpu).
+"""
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import pyabc_tpu as pt
+    from pyabc_tpu.models import lotka_volterra as lv
+
+    model = lv.make_lv_model()
+    prior = lv.default_prior()
+    obs = lv.observed_data(seed=123)
+
+    abc = pt.ABCSMC(
+        model, prior, pt.AdaptivePNormDistance(p=2),
+        population_size=1000, eps=pt.MedianEpsilon(), seed=0,
+    )
+    abc.new("sqlite://", obs)
+    print("platform:", jax.devices()[0].platform)
+
+    # run 3 generations to reach steady state (transition kernel compiled)
+    h = abc.run(max_nr_populations=3)
+    for t in range(h.max_t + 1):
+        print(f"t={t} telemetry:", h.get_telemetry(t))
+
+    # now profile one more generation by hand, split into stages
+    t = h.max_t + 1
+    sampler = abc.sampler
+    n_t = 1000
+    for rep in range(3):
+        t0 = time.perf_counter()
+        spec = abc._generation_spec(t)
+        t_spec = time.perf_counter()
+        handle = sampler.dispatch(n_t, spec, t)
+        t_dispatch = time.perf_counter()
+        # block until device compute done (sync on a scalar, cheap transfer)
+        out = handle["out"]
+        n_acc = int(out["n_acc"])  # forces kernel completion, 4-byte fetch
+        rounds = int(out["rounds"])
+        t_kernel = time.perf_counter()
+        sample = sampler.collect(handle)
+        t_fetch = time.perf_counter()
+        pop = abc._sample_to_population(sample)
+        nr_evals = sampler.nr_evaluations_
+        abc._adapt_components(t, sample, pop, abc.eps(t), n_t / nr_evals)
+        t_adapt = time.perf_counter()
+        print(
+            f"rep{rep}: spec={t_spec-t0:.4f}s dispatch={t_dispatch-t_spec:.4f}s "
+            f"kernel={t_kernel-t_dispatch:.4f}s collect={t_fetch-t_kernel:.4f}s "
+            f"adapt={t_adapt-t_fetch:.4f}s "
+            f"| rounds={rounds} n_acc={n_acc} B={sampler._last_B}"
+        )
+        t += 1
+
+
+if __name__ == "__main__":
+    main()

@@ -61,6 +61,15 @@ class Distance(ABC):
         """Traceable ``fn(x_flat, x0_flat, params) -> scalar distance``."""
         raise NotImplementedError(f"{type(self).__name__} has no device form")
 
+    def device_record_reduce(self, spec: SumStatSpec):
+        """Optional traceable reduction folded into the generation kernel:
+        ``fn(rec_sumstats (n,S), rec_valid (n,), x0 (S,)) -> (S,)``.
+
+        Adaptive distances use it to compute their per-statistic scale ON
+        DEVICE so the record ring never crosses the host link (one extra
+        sync over a TPU tunnel costs more than the whole reduction)."""
+        return None
+
     def requires_calibration(self) -> bool:
         """True if initialize() needs a prior calibration sample."""
         return False

@@ -243,8 +243,9 @@ class AdaptivePNormDistance(PNormDistance):
     def initialize(self, t, get_all_sum_stats=None, x_0=None):
         super().initialize(t, get_all_sum_stats, x_0)
         self._x_0 = _as_flat(x_0, self.spec) if x_0 is not None else None
+        self._x0_dev = None
         if get_all_sum_stats is not None:
-            self._fit(t, np.asarray(get_all_sum_stats(), np.float64))
+            self._fit(t, get_all_sum_stats())
 
     def update(self, t, get_all_sum_stats=None, population=None) -> bool:
         changed = False
@@ -254,18 +255,73 @@ class AdaptivePNormDistance(PNormDistance):
             changed = self.sumstat.update(t, population)
         if not self.adaptive or get_all_sum_stats is None:
             return changed
-        self._fit(t, np.asarray(get_all_sum_stats(), np.float64))
+        self._fit(t, get_all_sum_stats())
         return True
 
-    def _fit(self, t: int, samples: np.ndarray) -> None:
+    def device_record_reduce(self, spec=None):
+        """Scale reduction traced INTO the generation kernel (the (S,) scale
+        ships with the kernel's main fetch; see Distance.device_record_reduce)."""
+        if self.sumstat is not None or not self.adaptive:
+            return None
+        from .scale import SCALE_FUNCTIONS, _device_scale_impls
+
+        name = getattr(self.scale_function, "__name__", "")
+        # identity check: a custom scale fn shadowing a builtin NAME must
+        # run on the host, not be silently replaced by the builtin twin
+        if SCALE_FUNCTIONS.get(name) is not self.scale_function:
+            return None
+        return _device_scale_impls().get(name)
+
+    def _device_scale(self, records) -> np.ndarray | None:
+        """Scale vector from the ON-DEVICE record ring without fetching it.
+
+        Preferred source: the reduction already folded into the generation
+        kernel (``records.scale``, zero extra syncs). Fallback: a separate
+        jitted reduce on the ring (one extra sync — still far cheaper than
+        shipping the ring over a TPU tunnel). None when no device twin
+        applies (learned sumstat transform, custom scale fn)."""
+        if self.sumstat is not None:
+            return None
+        from .scale import SCALE_FUNCTIONS, device_scale_fn
+
+        name = getattr(self.scale_function, "__name__", "")
+        if SCALE_FUNCTIONS.get(name) is not self.scale_function:
+            return None  # custom fn shadowing a builtin name: host path
+        if records.scale is not None:
+            return np.asarray(records.scale, np.float64)
+        fn = device_scale_fn(name)
+        if fn is None or records.valid_dev is None:
+            return None
+        import jax
+        import jax.numpy as jnp
+
+        if getattr(self, "_x0_dev", None) is None:
+            self._x0_dev = jnp.asarray(
+                self._x_0 if self._x_0 is not None
+                else np.zeros(records.sumstats_dev.shape[1]),
+                jnp.float32,
+            )
+        out = fn(records.sumstats_dev, records.valid_dev, self._x0_dev)
+        return np.asarray(jax.device_get(out), np.float64)
+
+    def _fit(self, t: int, samples) -> None:
         """weights[t] = 1/scale over the sample matrix (n, S), computed in
-        the (possibly learned) transformed feature space."""
-        samples = self._transform(samples)
-        x0t = self._transform(self._x_0) if self._x_0 is not None else None
-        try:
-            scale = self.scale_function(samples, x0t)
-        except TypeError:
-            scale = self.scale_function(samples)
+        the (possibly learned) transformed feature space. ``samples`` may be
+        a host (n, S) matrix or an on-device ``DeviceRecords`` ring."""
+        from ..sampler.base import DeviceRecords
+
+        scale = None
+        if isinstance(samples, DeviceRecords):
+            scale = self._device_scale(samples)
+        if scale is None:
+            samples = self._transform(np.asarray(samples, np.float64))
+            x0t = (
+                self._transform(self._x_0) if self._x_0 is not None else None
+            )
+            try:
+                scale = self.scale_function(samples, x0t)
+            except TypeError:
+                scale = self.scale_function(samples)
         scale = np.asarray(scale, np.float64)
         w = np.zeros_like(scale)
         pos = scale > 0

@@ -97,3 +97,128 @@ SCALE_FUNCTIONS = {
         standard_deviation_to_observation,
     ]
 }
+
+
+# ---------------------------------------------------------------------------
+# Device twins: masked reductions over the ON-DEVICE record ring.
+#
+# The fused generation kernel keeps all evaluated sum stats in a device
+# reservoir; fetching it to the host costs ~100ms/MB over a TPU tunnel while
+# the reduction itself is microseconds of VPU time. Each twin takes the
+# UNMASKED ring ``samples (n, S)`` + ``valid (n,)`` + ``x_0 (S,)`` and
+# returns the (S,) scale vector; only those S floats cross the wire.
+# Masked medians go through NaN-substitution + nanquantile.
+# ---------------------------------------------------------------------------
+
+def _device_scale_impls():
+    import jax.numpy as jnp
+
+    def _masked(samples, valid):
+        return jnp.where(valid[:, None], samples, jnp.nan)
+
+    def _nanmedian(x):
+        return jnp.nanquantile(x, 0.5, axis=0)
+
+    def _mean(samples, valid):
+        n = jnp.maximum(valid.sum(), 1)
+        return jnp.where(valid[:, None], samples, 0.0).sum(axis=0) / n
+
+    def _std(samples, valid):
+        mu = _mean(samples, valid)
+        n = jnp.maximum(valid.sum(), 1)
+        var = (jnp.where(valid[:, None], (samples - mu) ** 2, 0.0).sum(axis=0)
+               / n)
+        return jnp.sqrt(var)
+
+    def mad(samples, valid, x_0):
+        m = _masked(samples, valid)
+        med = _nanmedian(m)
+        return _nanmedian(jnp.abs(m - med))
+
+    def mean_ad(samples, valid, x_0):
+        mu = _mean(samples, valid)
+        n = jnp.maximum(valid.sum(), 1)
+        return (jnp.where(valid[:, None], jnp.abs(samples - mu), 0.0)
+                .sum(axis=0) / n)
+
+    def std(samples, valid, x_0):
+        return _std(samples, valid)
+
+    def span_(samples, valid, x_0):
+        big = jnp.where(valid[:, None], samples, -jnp.inf).max(axis=0)
+        small = jnp.where(valid[:, None], samples, jnp.inf).min(axis=0)
+        return big - small
+
+    def mean_(samples, valid, x_0):
+        return _mean(samples, valid)
+
+    def median_(samples, valid, x_0):
+        return _nanmedian(_masked(samples, valid))
+
+    def bias_(samples, valid, x_0):
+        return jnp.abs(_mean(samples, valid) - x_0)
+
+    def rmsd(samples, valid, x_0):
+        b = bias_(samples, valid, x_0)
+        s = _std(samples, valid)
+        return jnp.sqrt(b * b + s * s)
+
+    def mad_to_obs(samples, valid, x_0):
+        return _nanmedian(jnp.abs(_masked(samples, valid) - x_0))
+
+    def mean_ad_to_obs(samples, valid, x_0):
+        n = jnp.maximum(valid.sum(), 1)
+        return (jnp.where(valid[:, None], jnp.abs(samples - x_0), 0.0)
+                .sum(axis=0) / n)
+
+    def combined_mad(samples, valid, x_0):
+        m = _masked(samples, valid)
+        return mad(samples, valid, x_0) + jnp.abs(_nanmedian(m) - x_0)
+
+    def combined_mean_ad(samples, valid, x_0):
+        return (mean_ad(samples, valid, x_0)
+                + jnp.abs(_mean(samples, valid) - x_0))
+
+    def std_to_obs(samples, valid, x_0):
+        n = jnp.maximum(valid.sum(), 1)
+        return jnp.sqrt(
+            jnp.where(valid[:, None], (samples - x_0) ** 2, 0.0).sum(axis=0)
+            / n
+        )
+
+    return {
+        "median_absolute_deviation": mad,
+        "mean_absolute_deviation": mean_ad,
+        "standard_deviation": std,
+        "span": span_,
+        "mean": mean_,
+        "median": median_,
+        "bias": bias_,
+        "root_mean_square_deviation": rmsd,
+        "median_absolute_deviation_to_observation": mad_to_obs,
+        "mean_absolute_deviation_to_observation": mean_ad_to_obs,
+        "combined_median_absolute_deviation": combined_mad,
+        "combined_mean_absolute_deviation": combined_mean_ad,
+        "standard_deviation_to_observation": std_to_obs,
+    }
+
+
+_device_scale_cache: dict = {}
+
+
+def device_scale_fn(name: str):
+    """Jitted masked device twin of the named scale function, or None.
+
+    Signature: ``fn(samples (n,S) f32, valid (n,) bool, x_0 (S,)) -> (S,)``.
+    """
+    if name in _device_scale_cache:
+        return _device_scale_cache[name]
+    impls = _device_scale_impls()
+    if name not in impls:
+        _device_scale_cache[name] = None
+        return None
+    import jax
+
+    fn = jax.jit(impls[name])
+    _device_scale_cache[name] = fn
+    return fn

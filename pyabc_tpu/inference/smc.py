@@ -362,6 +362,10 @@ class ABCSMC:
     def _all_sumstats_provider(self, sample) -> Callable:
         """() -> (n, S) matrix of all recorded sum stats for adaptive comps."""
         def provider():
+            if sample.device_records is not None:
+                # record ring still on device: adaptive distances reduce it
+                # in place; np.asarray(...) fetches for anything else
+                return sample.device_records
             if sample.all_sumstats is not None:
                 return sample.all_sumstats
             if getattr(sample, "host_all_records", None) is not None:
@@ -725,8 +729,10 @@ class ABCSMC:
             )
             all_ss = self._all_sumstats_provider(calib_sample)
             self.distance_function.initialize(0, all_ss, self.x_0)
-            # distances under the (possibly just-calibrated) distance
-            ss_mat = all_ss()
+            # distances under the (possibly just-calibrated) distance;
+            # one coerced host fetch (row-wise indexing of a device ring
+            # would be one RPC per row over a TPU tunnel)
+            ss_mat = np.asarray(all_ss(), np.float64)
             calib_distances = np.asarray([
                 self.distance_function(
                     self.spec.unflatten(ss_mat[i]), self.x_0, 0

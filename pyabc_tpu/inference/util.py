@@ -537,12 +537,22 @@ class DeviceContext:
             n_acc, rounds, n_valid, res, rec = jax.lax.while_loop(
                 cond, body, state0
             )
-            return {"n_acc": n_acc, "rounds": rounds, "n_valid": n_valid,
-                    **res,
-                    "rec_" + "sumstats": rec["sumstats"],
-                    "rec_distance": rec["distance"],
-                    "rec_accepted": rec["accepted"],
-                    "rec_valid": rec["valid"]}
+            out = {"n_acc": n_acc, "rounds": rounds, "n_valid": n_valid,
+                   **res,
+                   "rec_" + "sumstats": rec["sumstats"],
+                   "rec_distance": rec["distance"],
+                   "rec_accepted": rec["accepted"],
+                   "rec_valid": rec["valid"]}
+            # adaptive-distance scale reduction IN the kernel: over a TPU
+            # tunnel every extra host sync costs ~10x the reduction itself,
+            # so the (S,) scale ships with the main fetch instead of a
+            # second device round trip on the record ring
+            reduce_fn = self.distance.device_record_reduce(self.spec)
+            if reduce_fn is not None and rec_cap > 1:
+                out["rec_scale"] = reduce_fn(
+                    rec["sumstats"], rec["valid"], self.x0
+                )
+            return out
 
         if self.mesh is not None and len(
             {d.process_index for d in self.mesh.devices.flat}

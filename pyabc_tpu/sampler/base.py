@@ -21,6 +21,44 @@ from typing import Callable
 import numpy as np
 
 
+class DeviceRecords:
+    """All-evaluations record ring kept ON DEVICE (lazy fetch).
+
+    The fused generation kernel's record ring is ~100s of KB; over a TPU
+    tunnel fetching it dominates the generation wall time, while its only
+    consumer (adaptive distance reweighting) is a per-column reduction that
+    the device does in microseconds. Components that understand devices
+    reduce in place (``pyabc_tpu.distance.scale.device_scale_fn``); anything
+    else triggers a one-time host fetch via :meth:`to_host` (also wired to
+    ``np.asarray``).
+    """
+
+    def __init__(self, sumstats_dev, valid_dev, scale=None):
+        self.sumstats_dev = sumstats_dev
+        self.valid_dev = valid_dev
+        #: (S,) scale vector precomputed by the in-kernel reduction, if the
+        #: active distance registered one (Distance.device_record_reduce)
+        self.scale = scale
+        self._host: np.ndarray | None = None
+
+    def to_host(self) -> np.ndarray:
+        """Fetch and mask: (n_valid, S) float64 matrix."""
+        if self._host is None:
+            import jax
+
+            ss, valid = jax.device_get((self.sumstats_dev, self.valid_dev))
+            self._host = np.asarray(ss, np.float64)[np.asarray(valid, bool)]
+        return self._host
+
+    def __array__(self, dtype=None, copy=None):
+        host = self.to_host()
+        return host.astype(dtype) if dtype is not None else host
+
+    @property
+    def shape(self):
+        return self.to_host().shape
+
+
 class Sample:
     """One generation's harvest (pyabc Sample), struct-of-arrays.
 
@@ -46,6 +84,9 @@ class Sample:
         self.all_sumstats: np.ndarray | None = None
         self.all_distances: np.ndarray | None = None
         self.all_accepted: np.ndarray | None = None
+        #: on-device record ring (fused sampler): lazily fetched alternative
+        #: to ``all_sumstats``
+        self.device_records: DeviceRecords | None = None
 
     @property
     def n_accepted(self) -> int:
@@ -89,6 +130,8 @@ class Sample:
         """All recorded sum stats (accepted + rejected if recorded)."""
         if self.all_sumstats is not None:
             return self.all_sumstats
+        if self.device_records is not None:
+            return self.device_records.to_host()
         return self.sumstats
 
 

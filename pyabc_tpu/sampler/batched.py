@@ -159,11 +159,21 @@ class BatchedSampler(Sampler):
         return {"out": out, "sample": sample, "n": n, "n_cap": n_cap}
 
     def collect(self, handle) -> Sample:
-        """Block on a dispatched generation and build the Sample."""
+        """Block on a dispatched generation and build the Sample.
+
+        The record-ring sum stats stay ON DEVICE (the single largest part
+        of the payload; its consumer is a device-side reduction — see
+        DeviceRecords); everything else is fetched in one transfer.
+        """
         import jax
 
-        out = jax.device_get(handle["out"])
-        return self._finalize_fused(out, handle["sample"], handle["n"],
+        out = handle["out"]
+        host = jax.device_get(
+            {k: v for k, v in out.items() if k != "rec_sumstats"}
+        )
+        host["rec_sumstats_dev"] = out.get("rec_sumstats")
+        host["rec_valid_dev"] = out.get("rec_valid")
+        return self._finalize_fused(host, handle["sample"], handle["n"],
                                     handle["n_cap"])
 
     def _sample_fused(self, n, ctx, mode, dyn, gen_key, *, max_eval,
@@ -199,12 +209,35 @@ class BatchedSampler(Sampler):
             proposal_ids=out["slot"][:k],
         )
         if sample.record_rejected:
+            from .base import DeviceRecords
+
+            import jax
+
             valid = np.asarray(out["rec_valid"], bool)
-            sample.set_all_records(
-                sumstats=np.asarray(out["rec_sumstats"], np.float64)[valid],
-                distances=np.asarray(out["rec_distance"], np.float64)[valid],
-                accepted=np.asarray(out["rec_accepted"], bool)[valid],
-            )
+            rec_dev = out.get("rec_sumstats_dev")
+            if np.isfinite(sample.max_nr_rejected) or rec_dev is None:
+                # a finite cap has reference accepted-first retention
+                # semantics that set_all_records enforces — fetch the ring
+                ss = out.get("rec_sumstats")
+                if ss is None:
+                    ss = jax.device_get(rec_dev)
+                sample.set_all_records(
+                    sumstats=np.asarray(ss, np.float64)[valid],
+                    distances=np.asarray(
+                        out["rec_distance"], np.float64)[valid],
+                    accepted=np.asarray(out["rec_accepted"], bool)[valid],
+                )
+            else:
+                sample.all_distances = np.asarray(
+                    out["rec_distance"], np.float64
+                )[valid]
+                sample.all_accepted = np.asarray(
+                    out["rec_accepted"], bool
+                )[valid]
+                sample.device_records = DeviceRecords(
+                    rec_dev, out.get("rec_valid_dev", None),
+                    scale=out.get("rec_scale"),
+                )
         self._rate_estimate = max(
             int(out["n_acc"]) / max(self.nr_evaluations_, 1),
             1.0 / max(self.nr_evaluations_, 1),
