@@ -272,6 +272,27 @@ class AdaptivePNormDistance(PNormDistance):
             return None
         return _device_scale_impls().get(name)
 
+    def device_weight_update(self):
+        """Traceable scale -> weight post-processing for the multi-generation
+        device run: ``fn(scale (S,)) -> (S,)`` mirroring :meth:`_fit`
+        (1/scale, optional max_weight_ratio clip, mean-1 normalization)."""
+        max_ratio = self.max_weight_ratio
+        normalize = self.normalize_weights
+
+        def fn(scale):
+            w = jnp.where(scale > 0, 1.0 / jnp.where(scale > 0, scale, 1.0),
+                          0.0)
+            if max_ratio is not None:
+                wmin = jnp.min(jnp.where(w > 0, w, jnp.inf))
+                w = jnp.minimum(w, wmin * max_ratio)
+            if normalize:
+                s = w.sum()
+                w = jnp.where(s > 0, w * (w.size / jnp.where(s > 0, s, 1.0)),
+                              w)
+            return w
+
+        return fn
+
     def _device_scale(self, records) -> np.ndarray | None:
         """Scale vector from the ON-DEVICE record ring without fetching it.
 

@@ -30,7 +30,13 @@ from ..core.population import Population
 from ..core.random import generation_key, root_key
 from ..core.random_variables import Distribution
 from ..core.sumstat_spec import SumStatSpec
-from ..distance import Distance, PNormDistance, StochasticKernel, to_distance
+from ..distance import (
+    AdaptivePNormDistance,
+    Distance,
+    PNormDistance,
+    StochasticKernel,
+    to_distance,
+)
 from ..epsilon import Epsilon, MedianEpsilon, NoEpsilon
 from ..model import JaxModel, Model, assert_models
 from ..populationstrategy import ConstantPopulationSize, PopulationStrategy
@@ -97,7 +103,8 @@ class ABCSMC:
                  max_nr_recorded_particles: float = np.inf,
                  seed: int = 0,
                  mesh=None,
-                 pipeline: bool = True):
+                 pipeline: bool = True,
+                 fused_generations: int = 8):
         self.models: list[Model] = assert_models(models)
         if isinstance(parameter_priors, Distribution):
             parameter_priors = [parameter_priors]
@@ -161,6 +168,13 @@ class ABCSMC:
         #: correction is needed — reference redis_eps look_ahead semantics
         #: without the preliminary-weight bias)
         self.pipeline = pipeline
+        #: run up to this many WHOLE GENERATIONS per device dispatch when
+        #: every component has a device-adaptation twin (K=1, constant pop,
+        #: MVN transition, quantile/list epsilon, (adaptive) p-norm,
+        #: uniform acceptor): transition refit, distance reweighting and the
+        #: epsilon update all happen on device inside one lax.scan. <=1
+        #: disables chunking (per-generation dispatch as usual).
+        self.fused_generations = int(fused_generations)
         self._root_key = root_key(seed)
 
         self._device_capable = self._check_device_capable()
@@ -456,6 +470,13 @@ class ABCSMC:
         self.distance_function.configure_sampler(self.sampler)
         self.eps.configure_sampler(self.sampler)
 
+        if self._fused_chunk_capable():
+            return self._loop_fused(
+                t0, minimum_epsilon, max_nr_populations,
+                min_acceptance_rate, max_total_nr_simulations,
+                max_walltime, start_walltime,
+            )
+
         if (self.pipeline
                 and getattr(self.sampler, "supports_pipelining", False)
                 and getattr(self.sampler, "fused", False)
@@ -600,6 +621,309 @@ class ABCSMC:
             logger.info("stopping: single model alive")
             return True
         return False
+
+    # -------------------------------------------------- fused multi-gen loop
+    def _fused_chunk_capable(self) -> bool:
+        """True when whole generations can be chained ON DEVICE: every
+        between-generation adaptation (transition refit, distance
+        reweighting, epsilon update) has a traceable twin. See
+        DeviceContext.multigen_kernel."""
+        from ..distance.scale import SCALE_FUNCTIONS
+        from ..epsilon import ConstantEpsilon, ListEpsilon, QuantileEpsilon
+        from ..transition.util import (
+            scott_rule_of_thumb,
+            silverman_rule_of_thumb,
+        )
+
+        if self.fused_generations <= 1 or not self._device_capable:
+            return False
+        if self.K != 1:
+            return False
+        if not isinstance(self.sampler, BatchedSampler) or not getattr(
+            self.sampler, "fused", False
+        ):
+            return False
+        if self.mesh is not None and len(
+            {d.process_index for d in self.mesh.devices.flat}
+        ) > 1:
+            return False  # multi-host barrier runs per generation
+        if not isinstance(self.population_strategy, ConstantPopulationSize):
+            return False
+        if type(self.acceptor) is not UniformAcceptor \
+                or self.acceptor.use_complete_history:
+            return False
+        tr = self.transitions[0]
+        if type(tr) is not MultivariateNormalTransition:
+            return False
+        if tr.bandwidth_selector not in (scott_rule_of_thumb,
+                                         silverman_rule_of_thumb):
+            return False
+        if not (isinstance(self.eps, QuantileEpsilon)
+                or type(self.eps) in (ListEpsilon, ConstantEpsilon)):
+            return False
+        if np.isfinite(self.max_nr_recorded_particles):
+            return False  # capped retention semantics need the host path
+        d = self.distance_function
+        if isinstance(d, AdaptivePNormDistance):
+            if d.sumstat is not None:
+                return False
+            if d.adaptive and (
+                SCALE_FUNCTIONS.get(
+                    getattr(d.scale_function, "__name__", "")
+                ) is not d.scale_function
+            ):
+                return False
+            if d.scale_log_file:
+                return False  # per-generation host logging: stay unfused
+        elif type(d) is PNormDistance:
+            if d.sumstat is not None:
+                return False
+            # per-generation user weight schedules can't ride a constant
+            # carry; a single default weight vector can
+            if any(k >= 0 for k in d.weights):
+                return False
+        else:
+            return False
+        return True
+
+    def _loop_fused(self, t0, minimum_epsilon, max_nr_populations,
+                    min_acceptance_rate, max_total_nr_simulations,
+                    max_walltime, start_walltime) -> History:
+        """Chunked whole-run-on-device loop: G generations per dispatch.
+
+        Generation 0 (prior mode) runs through the ordinary single-
+        generation kernel; afterwards the host only (a) persists fetched
+        populations, (b) mirrors the device-side component updates into the
+        host objects (epsilon values, adaptive distance weights, transition
+        refit from the last population) so resume/config/telemetry stay
+        exactly as in the per-generation paths, and (c) applies stopping
+        rules between chunks (in-chunk stops are handled by the kernel's
+        carried flag; walltime/sim budgets are checked at chunk granularity).
+        """
+        import copy
+
+        import jax
+        import jax.numpy as jnp
+
+        from ..epsilon import ListEpsilon, QuantileEpsilon
+        from ..utils import pow2_bucket as _pow2
+        from .util import pad_transition_params
+
+        t = t0
+        sims_total = self.history.total_nr_simulations
+        n = self.population_strategy(t)
+
+        if t == 0:
+            current_eps = self.eps(0)
+            if hasattr(self.acceptor, "note_epsilon"):
+                self.acceptor.note_epsilon(0, current_eps, False)
+            logger.info("t: 0, eps: %.8g", current_eps)
+            t_gen0 = time.time()
+            gen_spec = self._generation_spec(0)
+            sample = self.sampler.sample_until_n_accepted(
+                n, gen_spec, 0,
+                max_eval=(n / min_acceptance_rate
+                          if min_acceptance_rate > 0 else np.inf),
+            )
+            sample_s = time.time() - t_gen0
+            if sample.n_accepted < n:
+                logger.info("stopping: only %d/%d accepted within budget",
+                            sample.n_accepted, n)
+                self.history.done()
+                return self.history
+            pop = self._sample_to_population(sample)
+            nr_evals = self.sampler.nr_evaluations_
+            sims_total += nr_evals
+            acceptance_rate = n / nr_evals
+            db_pop = copy.copy(pop)
+            t_adapt0 = time.time()
+            self._adapt_components(0, sample, pop, current_eps,
+                                   acceptance_rate)
+            adapt_s = time.time() - t_adapt0
+            t_persist0 = time.time()
+            self.history.append_population(
+                0, current_eps, db_pop, nr_evals, self.model_names,
+                telemetry={"sample_s": round(sample_s, 4),
+                           "adapt_s": round(adapt_s, 4),
+                           "n_evaluations": int(nr_evals),
+                           "acceptance_rate": round(acceptance_rate, 6)},
+            )
+            self.history.update_telemetry(
+                0, {"persist_s": round(time.time() - t_persist0, 4)}
+            )
+            if self._check_stop(0, current_eps, minimum_epsilon,
+                                max_nr_populations, acceptance_rate,
+                                min_acceptance_rate, sims_total,
+                                max_total_nr_simulations, max_walltime,
+                                start_walltime):
+                self.history.done()
+                return self.history
+            t = 1
+
+        ctx = self._build_device_ctx()
+        tr = self.transitions[0]
+        eps_quantile = isinstance(self.eps, QuantileEpsilon)
+        adaptive = (isinstance(self.distance_function, AdaptivePNormDistance)
+                    and self.distance_function.adaptive)
+        n_cap = _pow2(n, 64)
+        rec_cap = _pow2(8 * n_cap, 256) if adaptive else 1
+        B = self.sampler._pick_B(n)
+        max_rounds = self.sampler.max_rounds
+        if min_acceptance_rate > 0:
+            max_rounds = max(1, min(
+                max_rounds, int(n / min_acceptance_rate) // B + 1
+            ))
+
+        G = self.fused_generations
+        kern = ctx.multigen_kernel(
+            B, n_cap, rec_cap, max_rounds, G,
+            adaptive=adaptive, eps_quantile=eps_quantile,
+            eps_weighted=getattr(self.eps, "weighted", True),
+            alpha=getattr(self.eps, "alpha", 0.5),
+            multiplier=getattr(self.eps, "quantile_multiplier", 1.0),
+            trans_cls=type(tr), scaling=tr.scaling,
+            bandwidth_selector=tr.bandwidth_selector,
+            dim=self.parameter_priors[0].space.dim,
+        )
+
+        def _g_limit(t_at: int) -> int:
+            g = G
+            if np.isfinite(max_nr_populations):
+                g = min(g, int(max_nr_populations) - t_at)
+            if isinstance(self.eps, ListEpsilon):
+                g = min(g, len(self.eps.epsilon_values) - t_at)
+            return max(g, 0)
+
+        def _dispatch_chunk(carry, t_at: int, g_limit: int):
+            """Enqueue one chunk (async). ``carry`` is either the host-built
+            initial carry or the PREVIOUS chunk's on-device final carry —
+            chaining device-to-device lets chunk k+1 compute while chunk
+            k's outputs are still being fetched/persisted."""
+            eps_fixed = np.zeros(G, np.float32)
+            if not eps_quantile:
+                for g in range(g_limit):
+                    eps_fixed[g] = self.eps(t_at + g)
+            return kern(
+                self._root_key, jnp.asarray(t_at, jnp.int32),
+                jnp.asarray(n, jnp.int32),
+                jnp.asarray(g_limit, jnp.int32), carry,
+                jnp.asarray(eps_fixed),
+                jnp.asarray(minimum_epsilon, jnp.float32),
+                jnp.asarray(min_acceptance_rate, jnp.float32),
+            )
+
+        raw = jax.tree.map(np.asarray, tr.device_params())
+        trans0 = pad_transition_params(raw, n_cap, ctx.d_max)
+        dist_w0 = jnp.asarray(
+            np.asarray(self.distance_function.device_params(t), np.float32)
+        )
+        carry0 = (trans0, dist_w0, jnp.asarray(self.eps(t), jnp.float32),
+                  jnp.asarray(False))
+
+        chunk_index = 0
+        g_limit = _g_limit(t)
+        if g_limit <= 0:
+            self.history.done()
+            return self.history
+        t_chunk0 = time.time()
+        res = _dispatch_chunk(carry0, t, g_limit)
+        while True:
+            chunk_index += 1
+            logger.info("t: %d..%d (fused chunk of %d)", t, t + g_limit - 1,
+                        g_limit)
+            # speculative: enqueue the NEXT chunk off the device-side carry
+            # BEFORE fetching this one (in-device `stopped` flag chains, so
+            # a stop inside this chunk makes the speculative one a no-op)
+            g_next = _g_limit(t + g_limit)
+            res_next = (
+                _dispatch_chunk(res["carry"], t + g_limit, g_next)
+                if g_next > 0 else None
+            )
+            fetched = jax.device_get(res["outs"])
+            now = time.time()
+            chunk_s = now - t_chunk0  # pipeline period: fetch-to-fetch
+            t_chunk0 = now
+
+            stop = False
+            last_pop = None
+            for g in range(g_limit):
+                if not bool(fetched["gen_ok"][g]):
+                    logger.info(
+                        "stopping: fused generation %d incomplete "
+                        "(n_acc=%d/%d)", t, int(fetched["n_acc"][g]), n,
+                    )
+                    stop = True
+                    break
+                from ..sampler.base import Sample, exp_normalize_log_weights
+
+                weights = exp_normalize_log_weights(
+                    fetched["log_weight"][g][:n]
+                )
+                sample = Sample()
+                sample.set_accepted(
+                    ms=fetched["m"][g][:n],
+                    thetas=np.asarray(fetched["theta"][g][:n], np.float64),
+                    weights=weights,
+                    distances=np.asarray(fetched["distance"][g][:n],
+                                         np.float64),
+                    sumstats=np.asarray(fetched["sumstats"][g][:n],
+                                        np.float64),
+                    proposal_ids=fetched["slot"][g][:n],
+                )
+                pop = self._sample_to_population(sample)
+                current_eps = float(fetched["eps_used"][g])
+                nr_evals = int(fetched["n_valid"][g])
+                self.sampler.nr_evaluations_ = nr_evals
+                sims_total += nr_evals
+                acceptance_rate = n / max(nr_evals, 1)
+                self.history.append_population(
+                    t, current_eps, pop, nr_evals, self.model_names,
+                    telemetry={
+                        "fused_chunk": g_limit,
+                        "chunk_index": chunk_index,
+                        "chunk_s": round(chunk_s, 4),
+                        "rounds": int(fetched["rounds"][g]),
+                        "sample_s": round(chunk_s / g_limit, 4),
+                        "n_evaluations": nr_evals,
+                        "acceptance_rate": round(acceptance_rate, 6),
+                    },
+                )
+                logger.info(
+                    "t: %d, eps: %.8g, acceptance rate: %.5f "
+                    "(%d evaluations)", t, current_eps, acceptance_rate,
+                    nr_evals,
+                )
+                # mirror the device-side adaptation into host state so
+                # resume / further chunks / telemetry are consistent
+                if eps_quantile:
+                    self.eps._values[t + 1] = float(fetched["eps_next"][g])
+                if adaptive:
+                    self.distance_function.weights[t + 1] = np.asarray(
+                        fetched["dist_w_next"][g], np.float64
+                    )
+                if hasattr(self.acceptor, "note_epsilon"):
+                    self.acceptor.note_epsilon(t, current_eps, adaptive)
+                last_pop = pop
+                if self._check_stop(t, current_eps, minimum_epsilon,
+                                    max_nr_populations, acceptance_rate,
+                                    min_acceptance_rate, sims_total,
+                                    max_total_nr_simulations, max_walltime,
+                                    start_walltime):
+                    stop = True
+                    break
+                t += 1
+            if last_pop is not None:
+                self._model_probs = {
+                    m: float(last_pop.model_probabilities_array()[m])
+                    for m in last_pop.get_alive_models()
+                }
+                self._fit_transitions(last_pop)
+            if stop or last_pop is None or res_next is None:
+                break
+            # advance to the speculatively-dispatched chunk
+            res, g_limit = res_next, g_next
+        self.history.done()
+        return self.history
 
     def _loop_pipelined(self, t0, minimum_epsilon, max_nr_populations,
                         min_acceptance_rate, max_total_nr_simulations,

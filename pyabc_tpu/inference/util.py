@@ -424,6 +424,86 @@ class DeviceContext:
         return fn
 
     # ---------------------------------------------------- fused generation
+    def _generation_while(self, key, dyn, n_target, *, B, n_cap, rec_cap,
+                          max_rounds, run_lanes, all_accept=False):
+        """Traceable mask-and-refill loop for ONE generation.
+
+        Proposes B-lane rounds until ``n_target`` acceptances (or the round
+        budget), compacting accepted lanes into a fixed reservoir in
+        proposal order — the deterministic slot-ordered trim happens by
+        construction. Shared by the single-generation kernel and the
+        multi-generation scan. Returns (n_acc, rounds, n_valid, res, rec).
+        """
+        d_max, S = self.d_max, self.spec.total_size
+        res0 = {
+            "m": jnp.zeros((n_cap,), jnp.int32),
+            "theta": jnp.zeros((n_cap, d_max), jnp.float32),
+            "sumstats": jnp.zeros((n_cap, S), jnp.float32),
+            "distance": jnp.zeros((n_cap,), jnp.float32),
+            "log_weight": jnp.full((n_cap,), -jnp.inf, jnp.float32),
+            "slot": jnp.full((n_cap,), -1, jnp.int32),
+        }
+        rec0 = {
+            "sumstats": jnp.zeros((rec_cap, S), jnp.float32),
+            "distance": jnp.zeros((rec_cap,), jnp.float32),
+            "accepted": jnp.zeros((rec_cap,), bool),
+            "valid": jnp.zeros((rec_cap,), bool),
+        }
+        state0 = (jnp.zeros((), jnp.int32),  # n_acc
+                  jnp.zeros((), jnp.int32),  # round
+                  jnp.zeros((), jnp.int32),  # n_valid (true model evals)
+                  res0, rec0)
+
+        def cond(state):
+            n_acc, r, _, _, _ = state
+            return (n_acc < n_target) & (r < max_rounds)
+
+        def body(state):
+            n_acc, r, n_valid, res, rec = state
+            out = run_lanes(jax.random.fold_in(key, r), dyn)
+            acc = out["valid"] if all_accept else (
+                out["accepted"] & out["valid"]
+            )
+            lanes = jnp.arange(B, dtype=jnp.int32)
+            slots = r * B + lanes
+            # compaction: lane i's accepted rank within this round
+            rank = jnp.cumsum(acc.astype(jnp.int32)) - 1
+            pos = n_acc + rank
+            write_pos = jnp.where(acc & (pos < n_cap), pos, n_cap)
+            res = {
+                "m": res["m"].at[write_pos].set(
+                    out["m"].astype(jnp.int32), mode="drop"),
+                "theta": res["theta"].at[write_pos].set(
+                    out["theta"], mode="drop"),
+                "sumstats": res["sumstats"].at[write_pos].set(
+                    out["sumstats"], mode="drop"),
+                "distance": res["distance"].at[write_pos].set(
+                    out["distance"], mode="drop"),
+                "log_weight": res["log_weight"].at[write_pos].set(
+                    jnp.where(all_accept, 0.0, out["log_weight"]),
+                    mode="drop"),
+                "slot": res["slot"].at[write_pos].set(
+                    slots, mode="drop"),
+            }
+            # record ring: first rec_cap evaluations, in slot order
+            rec_pos = jnp.where(out["valid"] & (slots < rec_cap),
+                                slots, rec_cap)
+            rec = {
+                "sumstats": rec["sumstats"].at[rec_pos].set(
+                    out["sumstats"], mode="drop"),
+                "distance": rec["distance"].at[rec_pos].set(
+                    out["distance"], mode="drop"),
+                "accepted": rec["accepted"].at[rec_pos].set(
+                    acc, mode="drop"),
+                "valid": rec["valid"].at[rec_pos].set(
+                    out["valid"], mode="drop"),
+            }
+            return (n_acc + jnp.sum(acc, dtype=jnp.int32), r + 1,
+                    n_valid + jnp.sum(out["valid"], dtype=jnp.int32),
+                    res, rec)
+
+        return jax.lax.while_loop(cond, body, state0)
+
     def generation_kernel(self, B: int, mode: str, n_cap: int, rec_cap: int,
                           max_rounds: int):
         """One jitted program for a WHOLE generation: a ``lax.while_loop``
@@ -464,78 +544,10 @@ class DeviceContext:
             return jax.vmap(lambda k: lane(k, dyn))(keys)
 
         def generation_fn(key, dyn, n_target):
-            # n_target (dynamic scalar <= n_cap): stop at the REQUESTED count,
-            # not the padded reservoir capacity — with n not a power of two,
-            # looping to pow2(n) acceptances would waste up to 2x rounds
-            res0 = {
-                "m": jnp.zeros((n_cap,), jnp.int32),
-                "theta": jnp.zeros((n_cap, d_max), jnp.float32),
-                "sumstats": jnp.zeros((n_cap, S), jnp.float32),
-                "distance": jnp.zeros((n_cap,), jnp.float32),
-                "log_weight": jnp.full((n_cap,), -jnp.inf, jnp.float32),
-                "slot": jnp.full((n_cap,), -1, jnp.int32),
-            }
-            rec0 = {
-                "sumstats": jnp.zeros((rec_cap, S), jnp.float32),
-                "distance": jnp.zeros((rec_cap,), jnp.float32),
-                "accepted": jnp.zeros((rec_cap,), bool),
-                "valid": jnp.zeros((rec_cap,), bool),
-            }
-            state0 = (jnp.zeros((), jnp.int32),  # n_acc
-                      jnp.zeros((), jnp.int32),  # round
-                      jnp.zeros((), jnp.int32),  # n_valid (true model evals)
-                      res0, rec0)
-
-            def cond(state):
-                n_acc, r, _, _, _ = state
-                return (n_acc < n_target) & (r < max_rounds)
-
-            def body(state):
-                n_acc, r, n_valid, res, rec = state
-                out = run_lanes(jax.random.fold_in(key, r), dyn)
-                acc = out["valid"] if all_accept else (
-                    out["accepted"] & out["valid"]
-                )
-                lanes = jnp.arange(B, dtype=jnp.int32)
-                slots = r * B + lanes
-                # compaction: lane i's accepted rank within this round
-                rank = jnp.cumsum(acc.astype(jnp.int32)) - 1
-                pos = n_acc + rank
-                write_pos = jnp.where(acc & (pos < n_cap), pos, n_cap)
-                res = {
-                    "m": res["m"].at[write_pos].set(
-                        out["m"].astype(jnp.int32), mode="drop"),
-                    "theta": res["theta"].at[write_pos].set(
-                        out["theta"], mode="drop"),
-                    "sumstats": res["sumstats"].at[write_pos].set(
-                        out["sumstats"], mode="drop"),
-                    "distance": res["distance"].at[write_pos].set(
-                        out["distance"], mode="drop"),
-                    "log_weight": res["log_weight"].at[write_pos].set(
-                        jnp.where(all_accept, 0.0, out["log_weight"]),
-                        mode="drop"),
-                    "slot": res["slot"].at[write_pos].set(
-                        slots, mode="drop"),
-                }
-                # record ring: first rec_cap evaluations, in slot order
-                rec_pos = jnp.where(out["valid"] & (slots < rec_cap),
-                                    slots, rec_cap)
-                rec = {
-                    "sumstats": rec["sumstats"].at[rec_pos].set(
-                        out["sumstats"], mode="drop"),
-                    "distance": rec["distance"].at[rec_pos].set(
-                        out["distance"], mode="drop"),
-                    "accepted": rec["accepted"].at[rec_pos].set(
-                        acc, mode="drop"),
-                    "valid": rec["valid"].at[rec_pos].set(
-                        out["valid"], mode="drop"),
-                }
-                return (n_acc + jnp.sum(acc, dtype=jnp.int32), r + 1,
-                        n_valid + jnp.sum(out["valid"], dtype=jnp.int32),
-                        res, rec)
-
-            n_acc, rounds, n_valid, res, rec = jax.lax.while_loop(
-                cond, body, state0
+            n_acc, rounds, n_valid, res, rec = self._generation_while(
+                key, dyn, n_target, B=B, n_cap=n_cap, rec_cap=rec_cap,
+                max_rounds=max_rounds, run_lanes=run_lanes,
+                all_accept=all_accept,
             )
             out = {"n_acc": n_acc, "rounds": rounds, "n_valid": n_valid,
                    **res,
@@ -585,6 +597,177 @@ class DeviceContext:
         return self.generation_kernel(B, mode, n_cap, rec_cap, max_rounds)(
             key, dyn, jnp.asarray(min(n_target, n_cap), jnp.int32)
         )
+
+    # ------------------------------------------- multi-generation device run
+    def multigen_kernel(self, B: int, n_cap: int, rec_cap: int,
+                        max_rounds: int, G: int, *, adaptive: bool,
+                        eps_quantile: bool, eps_weighted: bool, alpha: float,
+                        multiplier: float, trans_cls, scaling: float,
+                        bandwidth_selector, dim: int):
+        """One jitted program for G WHOLE GENERATIONS (K=1, transition mode).
+
+        The TPU-native endgame of the reference's per-generation scatter/
+        gather: a ``lax.scan`` over generations where EVERYTHING the host
+        used to do between generations happens on device — transition refit
+        (``MultivariateNormalTransition.device_fit``), adaptive-distance
+        reweighting + distance recompute, and the weighted-quantile epsilon
+        update. One dispatch and ONE host sync per G generations; over a
+        TPU tunnel (~0.1s per sync) this is the difference between ~7 and
+        ~30+ generations per second at pop 1000.
+
+        Early stop is a carried flag: a generation that misses ``n_target``
+        within the round budget, hits ``min_eps``, or collapses below
+        ``min_acc_rate`` marks the rest of the chunk skipped (lax.cond) and
+        its outputs ``gen_ok=False`` for the host to discard.
+        """
+        cache_key = ("multigen", B, n_cap, rec_cap, max_rounds, G, adaptive,
+                     eps_quantile, eps_weighted, alpha, multiplier,
+                     trans_cls.__name__, scaling,
+                     getattr(bandwidth_selector, "__name__", "?"), dim)
+        if cache_key in self._kernels:
+            return self._kernels[cache_key]
+
+        from ..ops.stats import normalize_log_weights, weighted_quantile
+
+        lane = self._lane_transition
+        S = self.spec.total_size
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            axis = self.mesh.axis_names[0]
+            lane_sharding = NamedSharding(self.mesh, P(axis))
+        else:
+            lane_sharding = None
+
+        dist_fn = self.distance.device_fn(self.spec)
+        weight_post = (
+            self.distance.device_weight_update() if adaptive else None
+        )
+        scale_reduce = (
+            self.distance.device_record_reduce(self.spec) if adaptive
+            else None
+        )
+        if adaptive and (weight_post is None or scale_reduce is None):
+            raise RuntimeError(
+                "adaptive multigen run needs device scale + weight twins"
+            )
+
+        def multigen_fn(root, t0, n_target, g_limit, carry0, eps_fixed,
+                        min_eps, min_acc_rate):
+            def run_lanes(key, dyn):
+                keys = jax.random.split(key, B)
+                if lane_sharding is not None:
+                    keys = jax.lax.with_sharding_constraint(
+                        keys, lane_sharding
+                    )
+                return jax.vmap(lambda k: lane(k, dyn))(keys)
+
+            def gen_step(carry, g):
+                trans_params, dist_w, eps_carry, stopped = carry
+                # g_limit (dynamic) caps the active generations so the LAST
+                # chunk of a run reuses the same compiled G-kernel instead
+                # of tracing a shorter scan (a ~20s compile per distinct G)
+                stopped = stopped | (g >= g_limit)
+                t = t0 + g
+                gen_key = jax.random.fold_in(root, t + 1)  # generation_key
+                eps_g = eps_carry if eps_quantile else eps_fixed[g]
+                dyn = {
+                    "eps": eps_g,
+                    "dist_params": dist_w,
+                    "acc_params": (),
+                    "log_model_probs": jnp.zeros((1,), jnp.float32),
+                    "mpk_matrix": jnp.ones((1, 1), jnp.float32),
+                    "log_model_factor": jnp.zeros((1,), jnp.float32),
+                    "trans_params": (trans_params,),
+                }
+
+                def run_gen(_):
+                    return self._generation_while(
+                        gen_key, dyn, n_target, B=B, n_cap=n_cap,
+                        rec_cap=rec_cap, max_rounds=max_rounds,
+                        run_lanes=run_lanes,
+                    )
+
+                def skip_gen(_):
+                    z32 = jnp.zeros((), jnp.int32)
+                    res = {
+                        "m": jnp.zeros((n_cap,), jnp.int32),
+                        "theta": jnp.zeros((n_cap, self.d_max), jnp.float32),
+                        "sumstats": jnp.zeros((n_cap, S), jnp.float32),
+                        "distance": jnp.zeros((n_cap,), jnp.float32),
+                        "log_weight": jnp.full((n_cap,), -jnp.inf,
+                                               jnp.float32),
+                        "slot": jnp.full((n_cap,), -1, jnp.int32),
+                    }
+                    rec = {
+                        "sumstats": jnp.zeros((rec_cap, S), jnp.float32),
+                        "distance": jnp.zeros((rec_cap,), jnp.float32),
+                        "accepted": jnp.zeros((rec_cap,), bool),
+                        "valid": jnp.zeros((rec_cap,), bool),
+                    }
+                    return z32, z32, z32, res, rec
+
+                n_acc, rounds, n_valid, res, rec = jax.lax.cond(
+                    stopped, skip_gen, run_gen, None
+                )
+                gen_ok = (n_acc >= jnp.minimum(n_target, n_cap)) & ~stopped
+                k_mask = (
+                    jnp.arange(n_cap) < jnp.minimum(n_acc, n_target)
+                )
+                w_norm = normalize_log_weights(res["log_weight"], k_mask)
+
+                if adaptive:
+                    scale = scale_reduce(rec["sumstats"], rec["valid"],
+                                         self.x0)
+                    dist_w_next = weight_post(scale)
+                    # recompute accepted distances under the NEW weights
+                    # before the epsilon update (host _recompute_distances
+                    # semantics; history keeps the original values)
+                    d_new = jax.vmap(
+                        lambda s: dist_fn(s, self.x0, dist_w_next)
+                    )(res["sumstats"])
+                else:
+                    dist_w_next, d_new = dist_w, res["distance"]
+
+                if eps_quantile:
+                    pts = jnp.where(k_mask, d_new, jnp.inf)
+                    wts = (
+                        jnp.where(k_mask, w_norm, 0.0) if eps_weighted
+                        else k_mask.astype(jnp.float32)
+                    )
+                    eps_next = weighted_quantile(pts, wts, alpha) * multiplier
+                else:
+                    eps_next = eps_carry
+
+                trans_next = trans_cls.device_fit(
+                    res["theta"], w_norm, dim=dim, scaling=scaling,
+                    bandwidth_selector=bandwidth_selector,
+                )
+                acc_rate = n_acc / jnp.maximum(n_valid, 1)
+                stopped_next = (
+                    stopped | ~gen_ok | (eps_g <= min_eps)
+                    | (acc_rate < min_acc_rate)
+                )
+                out = {
+                    **res,
+                    "eps_used": eps_g, "eps_next": eps_next,
+                    "dist_w_next": dist_w_next, "n_acc": n_acc,
+                    "rounds": rounds, "n_valid": n_valid, "gen_ok": gen_ok,
+                }
+                return (trans_next, dist_w_next, eps_next,
+                        stopped_next), out
+
+            final_carry, outs = jax.lax.scan(gen_step, carry0, jnp.arange(G))
+            # the final carry is returned ON DEVICE so the host can chain
+            # the next chunk's dispatch directly off it — chunk k+1 starts
+            # computing while chunk k's outputs are still in flight to the
+            # host (cross-chunk pipelining; the carried `stopped` flag
+            # propagates in-device stops into speculative chunks)
+            return {"outs": outs, "carry": final_carry}
+
+        fn = jax.jit(multigen_fn)
+        self._kernels[cache_key] = fn
+        return fn
 
     def run_generation(self, key, B: int, mode: str, dyn: dict, *,
                        n_cap: int, rec_cap: int, max_rounds: int,

@@ -21,6 +21,21 @@ from typing import Callable
 import numpy as np
 
 
+def exp_normalize_log_weights(log_w) -> np.ndarray:
+    """Stable exp of relative log importance weights (float64).
+
+    -inf entries get weight 0; an all-non-finite input degrades to uniform
+    weights (an all-accepted calibration round). Shared by the fused-sampler
+    finalization and the multi-generation chunk loop.
+    """
+    log_w = np.asarray(log_w, np.float64)
+    finite = np.isfinite(log_w)
+    if finite.any():
+        mx = log_w[finite].max()
+        return np.where(finite, np.exp(log_w - mx), 0.0)
+    return np.ones_like(log_w)
+
+
 class DeviceRecords:
     """All-evaluations record ring kept ON DEVICE (lazy fetch).
 

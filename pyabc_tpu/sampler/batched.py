@@ -22,7 +22,7 @@ import numpy as np
 
 from ..core.random import round_key
 from ..utils import pow2_bucket as _pow2
-from .base import Sample, Sampler
+from .base import Sample, Sampler, exp_normalize_log_weights
 
 
 class BatchedSampler(Sampler):
@@ -194,13 +194,7 @@ class BatchedSampler(Sampler):
         # them skews acceptance-rate telemetry feeding adaptive schemes
         self.nr_evaluations_ = max(int(out["n_valid"]), 1)
         k = min(int(out["n_acc"]), n_cap, n)
-        log_w = np.asarray(out["log_weight"][:k], np.float64)
-        finite = np.isfinite(log_w)
-        if finite.any():
-            mx = log_w[finite].max()
-            weights = np.where(finite, np.exp(log_w - mx), 0.0)
-        else:
-            weights = np.ones_like(log_w)
+        weights = exp_normalize_log_weights(out["log_weight"][:k])
         sample.set_accepted(
             ms=out["m"][:k], thetas=np.asarray(out["theta"][:k], np.float64),
             weights=weights,
@@ -251,13 +245,7 @@ class BatchedSampler(Sampler):
         distances = np.concatenate([c.distances for c in chunks])[acc_mask]
         log_w = np.concatenate([c.log_weights for c in chunks])[acc_mask]
         slots = np.concatenate([c.slot_ids for c in chunks])[acc_mask]
-        # stable exp-normalization of the log importance weights (float64)
-        finite = np.isfinite(log_w)
-        if finite.any():
-            mx = log_w[finite].max()
-            weights = np.where(finite, np.exp(log_w - mx), 0.0)
-        else:
-            weights = np.ones_like(log_w)
+        weights = exp_normalize_log_weights(log_w)
         sample.set_accepted(
             ms=ms, thetas=thetas, weights=weights, distances=distances,
             sumstats=sumstats, proposal_ids=slots,

@@ -116,6 +116,47 @@ class MultivariateNormalTransition(Transition):
         return theta + noise
 
     @staticmethod
+    def device_fit(thetas, weights, *, dim: int, scaling: float,
+                   bandwidth_selector: Callable):
+        """Traceable twin of :meth:`fit` for the multi-generation device run.
+
+        ``thetas (n_cap, d)`` zero-padded, ``weights (n_cap,)`` normalized
+        with zeros on empty slots (they contribute nothing to the weighted
+        moments and are never resampled). Mirrors the host math: smart_cov
+        weighted covariance -> bandwidth factor on the effective sample size
+        -> Cholesky/precision/logdet, with the same degenerate-diagonal and
+        positive-definiteness guards (in traceable ``where`` form).
+        """
+        w = weights / jnp.maximum(weights.sum(), 1e-38)
+        mean = w @ thetas
+        centered = thetas - mean
+        cov = (centered * w[:, None]).T @ centered
+        # smart_cov degenerate guard: non-positive diagonal gets a small fill
+        diag = jnp.diagonal(cov)
+        fill = jnp.abs(mean) * 1e-4 + 1e-8
+        cov = cov + jnp.diag(jnp.where(diag <= 0, fill - diag, 0.0))
+        ess = 1.0 / jnp.maximum(jnp.sum(w * w), 1e-38)
+        factor = bandwidth_selector(ess, dim)
+        cov = cov * (scaling * factor) ** 2
+        chol = jnp.linalg.cholesky(cov)
+        # host path retries with a jittered diagonal on factorization failure
+        bad = ~jnp.all(jnp.isfinite(chol))
+        cov = jnp.where(bad, cov + jnp.eye(cov.shape[0]) * 1e-10, cov)
+        chol = jnp.where(bad, jnp.linalg.cholesky(cov), chol)
+        prec = jnp.linalg.inv(cov)
+        logdet = 2.0 * jnp.sum(jnp.log(jnp.maximum(
+            jnp.diagonal(chol), 1e-38
+        )))
+        return {
+            "thetas": thetas,
+            "weights": w,
+            "chol": chol,
+            "prec": prec,
+            "logdet": logdet,
+            "dim": jnp.float32(dim),
+        }
+
+    @staticmethod
     def device_logpdf(theta, params):
         thetas = params["thetas"]
         diff = theta[None, :] - thetas  # (n, d); padded dims diff exactly 0

@@ -1,0 +1,156 @@
+"""Multi-generation fused device run (whole-run-on-device) tests.
+
+The fused chunk loop replays the reference per-generation semantics with all
+between-generation adaptation on device (DeviceContext.multigen_kernel):
+transition refit, adaptive-distance reweighting, quantile epsilon. It must
+agree statistically with the per-generation pipelined loop; the device math
+is f32 vs the host's f64, so agreement is statistical, not bitwise.
+"""
+import jax
+import numpy as np
+import pytest
+
+import pyabc_tpu as pt
+
+PRIOR_SD = 1.0
+NOISE_SD = 0.5
+X_OBS = 1.0
+POST_VAR = 1.0 / (1 / PRIOR_SD**2 + 1 / NOISE_SD**2)
+POST_MU = POST_VAR * (X_OBS / NOISE_SD**2)
+
+
+def _gauss_model():
+    @pt.JaxModel.from_function(["theta"], name="gauss")
+    def model(key, theta):
+        return {"x": theta[0] + NOISE_SD * jax.random.normal(key)}
+
+    return model
+
+
+def _run(fused_generations, *, distance=None, eps=None, n_gens=5, seed=11,
+         pop=400, **kwargs):
+    prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+    abc = pt.ABCSMC(
+        _gauss_model(), prior,
+        distance if distance is not None else pt.AdaptivePNormDistance(p=2),
+        population_size=pop,
+        eps=eps if eps is not None else pt.MedianEpsilon(),
+        seed=seed, fused_generations=fused_generations, **kwargs,
+    )
+    abc.new("sqlite://", {"x": X_OBS})
+    h = abc.run(max_nr_populations=n_gens)
+    return abc, h
+
+
+def test_fused_capability_detected():
+    prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+    abc = pt.ABCSMC(_gauss_model(), prior, pt.AdaptivePNormDistance(p=2),
+                    population_size=100, eps=pt.MedianEpsilon())
+    assert abc._fused_chunk_capable()
+    # chunking disabled
+    abc_off = pt.ABCSMC(_gauss_model(), prior, pt.AdaptivePNormDistance(p=2),
+                        population_size=100, eps=pt.MedianEpsilon(),
+                        fused_generations=1)
+    assert not abc_off._fused_chunk_capable()
+    # stochastic acceptor family: not fused-eligible
+    abc_k = pt.ABCSMC(_gauss_model(), prior, pt.PNormDistance(p=2),
+                      population_size=100,
+                      eps=pt.ListEpsilon([1.0, 0.5]),
+                      acceptor=pt.UniformAcceptor(use_complete_history=True))
+    assert not abc_k._fused_chunk_capable()
+    # custom scale function shadowing a builtin name: host path only
+    def median_absolute_deviation(samples, x_0=None):
+        return 2.0 * np.median(np.abs(samples - np.median(samples, 0)), 0)
+
+    abc_c = pt.ABCSMC(
+        _gauss_model(), prior,
+        pt.AdaptivePNormDistance(p=2,
+                                 scale_function=median_absolute_deviation),
+        population_size=100, eps=pt.MedianEpsilon(),
+    )
+    assert not abc_c._fused_chunk_capable()
+
+
+def test_fused_matches_pipelined_posterior():
+    """Fused chunks vs per-generation loop: same posterior within MC error,
+    same epsilon trajectory within f32 tolerance."""
+    abc_f, h_f = _run(fused_generations=8, seed=11)
+    abc_p, h_p = _run(fused_generations=1, seed=11)
+    assert h_f.n_populations == h_p.n_populations
+    eps_f = h_f.get_all_populations().query("t >= 0")["epsilon"].to_numpy()
+    eps_p = h_p.get_all_populations().query("t >= 0")["epsilon"].to_numpy()
+    # same seed and same proposal kernels: gen 0 identical, later gens see
+    # f32-vs-f64 adaptation drift — trajectories must stay close
+    np.testing.assert_allclose(eps_f, eps_p, rtol=0.15)
+    df_f, w_f = h_f.get_distribution(0)
+    df_p, w_p = h_p.get_distribution(0)
+    mu_f = float(np.sum(df_f["theta"] * w_f))
+    mu_p = float(np.sum(df_p["theta"] * w_p))
+    assert mu_f == pytest.approx(POST_MU, abs=0.3)
+    assert mu_f == pytest.approx(mu_p, abs=0.25)
+    # adaptive weights mirrored into host state for every generation
+    assert set(abc_f.distance_function.weights) >= {1, 2, 3, 4}
+    tel = h_f.get_telemetry(2)
+    assert tel.get("fused_chunk", 0) >= 2
+
+
+def test_fused_multiple_chunks_advance():
+    """Regression: with more generations than one chunk holds, every chunk
+    must carry NEW device results — a replayed chunk shows up as a repeating
+    epsilon trajectory and duplicate populations."""
+    abc, h = _run(fused_generations=2, n_gens=7, seed=13)
+    assert h.n_populations == 7
+    eps = h.get_all_populations().query("t >= 0")["epsilon"].to_numpy()
+    # strictly decreasing across chunk boundaries (t=1..6 adaptive)
+    assert (np.diff(eps[1:]) < 0).all(), eps
+    # chunk indices advance
+    cis = [h.get_telemetry(t).get("chunk_index") for t in range(1, 7)]
+    assert cis == [1, 1, 2, 2, 3, 3], cis
+
+
+def test_fused_fixed_distance_and_list_epsilon():
+    abc, h = _run(
+        fused_generations=4,
+        distance=pt.PNormDistance(p=2),
+        eps=pt.ListEpsilon([2.0, 1.0, 0.6, 0.4]),
+        n_gens=4, seed=3,
+    )
+    assert h.n_populations == 4
+    eps = h.get_all_populations().query("t >= 0")["epsilon"].to_numpy()
+    np.testing.assert_allclose(eps, [2.0, 1.0, 0.6, 0.4], rtol=1e-6)
+    df, w = h.get_distribution(0)
+    assert float(np.sum(df["theta"] * w)) == pytest.approx(POST_MU, abs=0.35)
+
+
+def test_fused_respects_min_acceptance_stop():
+    prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+    abc = pt.ABCSMC(_gauss_model(), prior, pt.PNormDistance(p=2),
+                    population_size=100,
+                    eps=pt.ListEpsilon([1.0, 1e-4, 1e-5, 1e-6]),
+                    seed=5, fused_generations=4)
+    abc.new("sqlite://", {"x": X_OBS})
+    h = abc.run(max_nr_populations=4, min_acceptance_rate=0.05)
+    # the tiny thresholds collapse acceptance; the chunk must stop early
+    # instead of returning 4 full (garbage) generations
+    assert h.n_populations < 4
+
+
+def test_fused_resume_roundtrip(tmp_path):
+    db = f"sqlite:///{tmp_path}/fused.db"
+    prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+    abc = pt.ABCSMC(_gauss_model(), prior, pt.AdaptivePNormDistance(p=2),
+                    population_size=200, eps=pt.MedianEpsilon(), seed=9,
+                    fused_generations=3)
+    abc.new(db, {"x": X_OBS})
+    h1 = abc.run(max_nr_populations=3)
+    n1 = h1.n_populations  # capture BEFORE resume re-populates the db
+    abc2 = pt.ABCSMC(_gauss_model(), prior, pt.AdaptivePNormDistance(p=2),
+                     population_size=200, eps=pt.MedianEpsilon(), seed=9,
+                     fused_generations=3)
+    abc2.load(db, h1.id)
+    # max_nr_populations is an ABSOLUTE generation budget (matches
+    # test_inference.py::test_load_and_continue)
+    h2 = abc2.run(max_nr_populations=5)
+    assert h2.n_populations == n1 + 2
+    eps = h2.get_all_populations().query("t >= 0")["epsilon"].to_numpy()
+    assert (np.diff(eps[1:]) < 0).all()

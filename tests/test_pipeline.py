@@ -27,10 +27,12 @@ def _gauss_model():
 
 def _run(pipeline: bool):
     prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+    # fused_generations=1: this file tests the PER-GENERATION pipelined
+    # loop specifically (the fused chunk loop has its own test_fused.py)
     abc = pt.ABCSMC(_gauss_model(), prior, pt.PNormDistance(p=2),
                     population_size=300,
                     eps=pt.ListEpsilon([1.0, 0.5, 0.3]),
-                    seed=31, pipeline=pipeline)
+                    seed=31, pipeline=pipeline, fused_generations=1)
     abc.new("sqlite://", {"x": X_OBS})
     h = abc.run(max_nr_populations=3)
     df, w = h.get_distribution(0)
