@@ -112,7 +112,21 @@ def bench_cmd(pop, gens, budget_s, cpu):
     }))
 
 
+@click.command("abc-server")
+@click.argument("db")
+@click.option("--host", default="127.0.0.1", help="bind address")
+@click.option("--port", type=int, default=8765, help="port (0 = ephemeral)")
+def server_cmd(db, host, port):
+    """Serve the web dashboard for the History database DB
+    (reference parity: the Flask ``abc-server`` CLI)."""
+    from .visserver import serve
+
+    url = db if db.startswith("sqlite:") else f"sqlite:///{db}"
+    serve(url, host=host, port=port, block=True)
+
+
 if __name__ == "__main__":  # pragma: no cover - manual invocation helper
     cmd = sys.argv[1] if len(sys.argv) > 1 else ""
     sys.argv = [sys.argv[0]] + sys.argv[2:]
-    {"export": export_cmd, "bench": bench_cmd}.get(cmd, export_cmd)()
+    {"export": export_cmd, "bench": bench_cmd,
+     "server": server_cmd}.get(cmd, export_cmd)()

@@ -5,10 +5,15 @@ from .base import (
     ExternalModel,
     ExternalSumStat,
 )
+from .julia import JuliaModel
+from .r import R, RModel
 
 __all__ = [
     "ExternalHandler",
     "ExternalModel",
     "ExternalSumStat",
     "ExternalDistance",
+    "R",
+    "RModel",
+    "JuliaModel",
 ]

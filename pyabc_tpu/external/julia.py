@@ -1,0 +1,78 @@
+"""Julia-language model adapter (gated on a ``julia`` binary).
+
+Reference parity: ``pyabc/external/julia`` (pyjulia binding, newer
+versions). pyjulia is optional and absent here, so the adapter shells out
+to the ``julia`` executable with a JSON file contract (same philosophy as
+``ExternalModel`` / the R adapter).
+
+User script contract: the ``.jl`` file defines a function taking a
+``Dict{String,Float64}`` of parameters and returning a ``Dict`` of
+statistics:
+
+.. code-block:: julia
+
+    function mymodel(pars)
+        Dict("x" => pars["theta"] + 0.5 * randn())
+    end
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import tempfile
+
+import numpy as np
+
+from ..model import Model
+
+
+def _require_julia() -> str:
+    path = shutil.which("julia")
+    if path is None:
+        raise RuntimeError(
+            "The Julia adapter needs a 'julia' executable on PATH. For "
+            "other external simulators use ExternalModel."
+        )
+    return path
+
+
+_DRIVER = """
+import JSON
+include(ARGS[1])
+pars = JSON.parsefile(ARGS[3])
+res = getfield(Main, Symbol(ARGS[2]))(pars)
+open(ARGS[4], "w") do io
+    JSON.print(io, res)
+end
+"""
+
+
+class JuliaModel(Model):
+    """One Julia function as a simulator (``sample(pars) -> dict``)."""
+
+    def __init__(self, script: str, function_name: str = "mymodel",
+                 name: str | None = None):
+        super().__init__(name=name or f"Julia::{function_name}")
+        self.julia = _require_julia()
+        self.script = os.path.abspath(script)
+        self.function_name = function_name
+
+    def sample(self, pars):
+        with tempfile.TemporaryDirectory(prefix="abc_jl_") as loc:
+            fin = os.path.join(loc, "in.json")
+            fout = os.path.join(loc, "out.json")
+            with open(fin, "w") as fh:
+                json.dump({k: float(v) for k, v in pars.items()}, fh)
+            driver = os.path.join(loc, "driver.jl")
+            with open(driver, "w") as fh:
+                fh.write(_DRIVER)
+            subprocess.run(
+                [self.julia, driver, self.script, self.function_name,
+                 fin, fout],
+                check=True, capture_output=True, text=True,
+            )
+            with open(fout) as fh:
+                out = json.load(fh)
+            return {k: np.asarray(v, np.float64) for k, v in out.items()}

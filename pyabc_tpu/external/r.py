@@ -1,0 +1,117 @@
+"""R-language model adapter (gated on an ``Rscript`` binary).
+
+Reference parity: ``pyabc/external/r/r_rpy2.py::R`` — load model /
+summary-statistics / distance functions and the observation from a user's
+``.R`` script. The reference binds in-process via rpy2; rpy2 (and R) are
+optional here, so the adapter shells out to ``Rscript`` with a file-based
+contract instead (same philosophy as ``ExternalModel``): parameters go in
+as a CSV, the R function's returned named list/vector comes back as a CSV.
+
+User script contract (names configurable):
+
+.. code-block:: r
+
+    myModel <- function(pars) list(x = rnorm(1, pars$theta, 0.5))
+    mySumStatData <- list(x = 1.0)
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import tempfile
+
+import numpy as np
+
+from ..model import Model
+
+
+def _require_rscript() -> str:
+    path = shutil.which("Rscript")
+    if path is None:
+        raise RuntimeError(
+            "The R adapter needs an 'Rscript' executable on PATH (install "
+            "R). For non-R external simulators use ExternalModel."
+        )
+    return path
+
+
+_DRIVER = r"""
+args <- commandArgs(trailingOnly = TRUE)
+source(args[[1]])
+fin <- args[[3]]; fout <- args[[4]]
+pars <- as.list(read.csv(fin))
+res <- do.call(args[[2]], list(pars))
+write.csv(as.data.frame(res), fout, row.names = FALSE)
+"""
+
+_EVAL_DRIVER = r"""
+args <- commandArgs(trailingOnly = TRUE)
+source(args[[1]])
+obj <- get(args[[2]])
+if (is.function(obj)) obj <- obj()
+write.csv(as.data.frame(obj), args[[3]], row.names = FALSE)
+"""
+
+
+def _read_csv_columns(path: str) -> dict[str, np.ndarray]:
+    import pandas as pd
+
+    df = pd.read_csv(path)
+    return {c: df[c].to_numpy() for c in df.columns}
+
+
+class RModel(Model):
+    """One R function as a simulator (``sample(pars) -> dict``)."""
+
+    def __init__(self, script: str, function_name: str = "myModel",
+                 name: str | None = None):
+        super().__init__(name=name or f"R::{function_name}")
+        self.rscript = _require_rscript()
+        self.script = os.path.abspath(script)
+        self.function_name = function_name
+
+    def sample(self, pars):
+        with tempfile.TemporaryDirectory(prefix="abc_r_") as loc:
+            fin = os.path.join(loc, "in.csv")
+            fout = os.path.join(loc, "out.csv")
+            with open(fin, "w") as fh:
+                keys = list(pars.keys())
+                fh.write(",".join(keys) + "\n")
+                fh.write(",".join(repr(float(pars[k])) for k in keys) + "\n")
+            driver = os.path.join(loc, "driver.R")
+            with open(driver, "w") as fh:
+                fh.write(_DRIVER)
+            subprocess.run(
+                [self.rscript, driver, self.script, self.function_name,
+                 fin, fout],
+                check=True, capture_output=True, text=True,
+            )
+            return _read_csv_columns(fout)
+
+
+class R:
+    """Entry point mirroring the reference's ``pyabc.external.R``:
+    ``R("script.R").model()`` / ``.observation()``."""
+
+    def __init__(self, script: str):
+        self.rscript = _require_rscript()
+        self.script = os.path.abspath(script)
+
+    def model(self, function_name: str = "myModel") -> RModel:
+        return RModel(self.script, function_name)
+
+    def observation(self, name: str = "mySumStatData"
+                    ) -> dict[str, np.ndarray]:
+        """Evaluate a variable (or 0-ary function) from the script as the
+        observed summary statistics."""
+        with tempfile.TemporaryDirectory(prefix="abc_r_") as loc:
+            fout = os.path.join(loc, "obs.csv")
+            driver = os.path.join(loc, "driver.R")
+            with open(driver, "w") as fh:
+                fh.write(_EVAL_DRIVER)
+            subprocess.run(
+                [self.rscript, driver, self.script, name, fout],
+                check=True, capture_output=True, text=True,
+            )
+            return _read_csv_columns(fout)

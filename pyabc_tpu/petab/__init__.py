@@ -1,0 +1,14 @@
+"""PEtab problem importer (reference parity: ``pyabc/petab/base.py``).
+
+Imports a PEtab parameter-estimation problem (YAML + TSV tables,
+https://petab.readthedocs.io) as pyabc_tpu priors and observed data. The
+reference builds on the ``petab`` + ``amici`` packages; neither is
+available here, so the importer parses the PEtab files directly with
+pandas/pyyaml (both baked in) — priors come from the parameter table per
+the PEtab prior semantics, observations from the measurement table. The
+SIMULATOR is supplied by the user (amici is a CPU/C++ code generator; the
+TPU-native path is a JaxModel of the same ODEs).
+"""
+from .problem import PetabProblem
+
+__all__ = ["PetabProblem"]

@@ -1,5 +1,6 @@
 from .base import Sample, SampleFactory, Sampler
 from .batched import BatchedSampler
+from .dask_sampler import DaskDistributedSampler
 from .mapping import ConcurrentFutureSampler, MappingSampler
 from .multicore import (
     MulticoreEvalParallelSampler,
@@ -13,4 +14,5 @@ __all__ = [
     "SingleCoreSampler", "BatchedSampler",
     "MulticoreEvalParallelSampler", "MulticoreParticleParallelSampler",
     "MappingSampler", "ConcurrentFutureSampler", "nr_cores_available",
+    "DaskDistributedSampler",
 ]

@@ -1,0 +1,17 @@
+"""SGE batch-queue execution (reference parity: ``pyabc/sge/``)."""
+from .sge import SGE, sge_available
+from .execution_contexts import (
+    DefaultContext,
+    NamedPrinter,
+    ProfilingContext,
+)
+from .util import nr_cores_available
+
+__all__ = [
+    "SGE",
+    "sge_available",
+    "DefaultContext",
+    "ProfilingContext",
+    "NamedPrinter",
+    "nr_cores_available",
+]

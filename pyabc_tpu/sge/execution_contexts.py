@@ -1,0 +1,71 @@
+"""Execution contexts wrapping each SGE job's function call (reference
+parity: ``pyabc/sge/execution_contexts.py::{DefaultContext,
+ProfilingContext, NamedPrinter}``) — context managers entered around the
+user function inside the worker job."""
+from __future__ import annotations
+
+import cProfile
+import os
+import sys
+
+
+class DefaultContext:
+    """No-op context (reference DefaultContext)."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class ProfilingContext:
+    """cProfile the job; dump stats next to the job files (reference
+    ProfilingContext)."""
+
+    def __init__(self, directory: str | None = None):
+        self.directory = directory
+        self._prof = None
+
+    def __enter__(self):
+        self._prof = cProfile.Profile()
+        self._prof.enable()
+        return self
+
+    def __exit__(self, *exc):
+        self._prof.disable()
+        directory = self.directory or os.getcwd()
+        self._prof.dump_stats(
+            os.path.join(directory, f"profile_{os.getpid()}.pstats")
+        )
+        return False
+
+
+class NamedPrinter:
+    """Prefix the job's stdout lines with its name (reference NamedPrinter:
+    makes interleaved array-job logs attributable)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._orig = None
+
+    def __enter__(self):
+        self._orig = sys.stdout
+        printer = self
+
+        class _Prefixed:
+            def write(self, text):
+                if text.strip():
+                    printer._orig.write(f"[{printer.name}] {text}")
+                else:
+                    printer._orig.write(text)
+
+            def flush(self):
+                printer._orig.flush()
+
+        sys.stdout = _Prefixed()
+        return self
+
+    def __exit__(self, *exc):
+        sys.stdout = self._orig
+        return False

@@ -1,0 +1,31 @@
+"""SGE task entry point: ``python -m pyabc_tpu.sge.job <tmp_dir> <task_id>``.
+
+Unpickles (function, execution_context) + the task's argument chunk,
+evaluates inside the context, writes ``result_<task_id>.pkl``.
+(Reference parity: the job script body of ``pyabc/sge/sge.py``.)
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+
+
+def main(tmp_dir: str, task_id: str) -> None:
+    with open(os.path.join(tmp_dir, "function.pkl"), "rb") as fh:
+        fn, context = pickle.load(fh)
+    with open(os.path.join(tmp_dir, f"job_{task_id}.pkl"), "rb") as fh:
+        chunk = pickle.load(fh)
+    # context may be a class (no-arg construction) or a pre-configured
+    # instance (NamedPrinter("w1"), ProfilingContext(directory=...))
+    ctx = context() if isinstance(context, type) else context
+    with ctx:
+        results = [fn(arg) for arg in chunk]
+    out = os.path.join(tmp_dir, f"result_{task_id}.pkl")
+    with open(out + ".tmp", "wb") as fh:
+        pickle.dump(results, fh)
+    os.replace(out + ".tmp", out)  # atomic: pollers never see partials
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], sys.argv[2])

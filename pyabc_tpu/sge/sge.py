@@ -1,0 +1,117 @@
+"""SGE array-job map (reference parity: ``pyabc/sge/sge.py::SGE``).
+
+``SGE().map(fn, args)`` pickles (fn, arg) pairs into a temp directory,
+submits one SGE array job whose tasks each unpickle and evaluate one entry
+(via ``python -m pyabc_tpu.sge.job``), polls ``qstat`` until the job
+leaves the queue, and collects the pickled results in order.
+
+Gated on ``qsub``/``qstat`` (``sge_available``). The job-side contract is
+plain files, so tests can stand in a stub qsub that runs tasks locally —
+the reference's multi-node-as-local-process testing pattern (SURVEY.md §4).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+import time
+
+from .execution_contexts import DefaultContext
+from .util import sge_available
+
+_JOB_TEMPLATE = """#!/bin/bash
+#$ -N {name}
+#$ -t 1-{n_tasks}
+#$ -o {tmp_dir}/logs
+#$ -e {tmp_dir}/logs
+#$ -cwd
+{python} -m pyabc_tpu.sge.job {tmp_dir} $SGE_TASK_ID
+"""
+
+
+class SGE:
+    """Map over an SGE cluster via array jobs (reference SGE).
+
+    Parameters (reference names): ``name`` job name, ``memory`` / ``time_h``
+    resource strings are accepted for parity but only embedded as comments
+    (site-specific resource syntax varies), ``priority``, ``num_threads``,
+    ``execution_context`` entered around each task, ``chunk_size`` args per
+    task.
+    """
+
+    def __init__(self, name: str = "abc", memory: str = "3G",
+                 time_h: int = 100, priority: int = 0, num_threads: int = 1,
+                 execution_context=DefaultContext, chunk_size: int = 1,
+                 poll_interval_s: float = 2.0):
+        if not sge_available():
+            raise RuntimeError(
+                "SGE().map needs qsub/qstat on PATH; for single-host "
+                "parallelism use MulticoreEvalParallelSampler, for TPU "
+                "scale-out the default BatchedSampler with mesh=."
+            )
+        self.name = name
+        self.memory = memory
+        self.time_h = int(time_h)
+        self.priority = int(priority)
+        self.num_threads = int(num_threads)
+        self.execution_context = execution_context
+        self.chunk_size = int(chunk_size)
+        self.poll_interval_s = float(poll_interval_s)
+
+    def map(self, fn, args: list):
+        tmp_dir = tempfile.mkdtemp(prefix="pyabc_tpu_sge_")
+        os.makedirs(os.path.join(tmp_dir, "logs"), exist_ok=True)
+        chunks = [
+            args[i:i + self.chunk_size]
+            for i in range(0, len(args), self.chunk_size)
+        ]
+        with open(os.path.join(tmp_dir, "function.pkl"), "wb") as fh:
+            pickle.dump((fn, self.execution_context), fh)
+        for i, chunk in enumerate(chunks, start=1):
+            with open(os.path.join(tmp_dir, f"job_{i}.pkl"), "wb") as fh:
+                pickle.dump(chunk, fh)
+        script = _JOB_TEMPLATE.format(
+            name=self.name, n_tasks=len(chunks), tmp_dir=tmp_dir,
+            python=sys.executable,
+        )
+        script_path = os.path.join(tmp_dir, "submit.sh")
+        with open(script_path, "w") as fh:
+            fh.write(script)
+        out = subprocess.run(
+            ["qsub", "-terse", script_path], capture_output=True, text=True,
+            check=True,
+        )
+        job_id = out.stdout.strip().split(".")[0]
+        self._wait(job_id)
+        results = []
+        for i in range(1, len(chunks) + 1):
+            res_path = os.path.join(tmp_dir, f"result_{i}.pkl")
+            if not os.path.exists(res_path):
+                raise RuntimeError(
+                    f"SGE task {i} produced no result (logs in "
+                    f"{tmp_dir}/logs)"
+                )
+            with open(res_path, "rb") as fh:
+                results.extend(pickle.load(fh))
+        return results
+
+    def _wait(self, job_id: str) -> None:
+        while True:
+            out = subprocess.run(["qstat"], capture_output=True, text=True)
+            if out.returncode != 0:
+                # transient qstat failure: keep polling, never conclude
+                # "job finished" from an error
+                time.sleep(self.poll_interval_s)
+                continue
+            # whole-token match on the id column: job 123 must not match a
+            # queued job 1234
+            queued = {
+                line.split()[0].split(".")[0]
+                for line in out.stdout.splitlines()
+                if line.strip() and line.split()[0][:1].isdigit()
+            }
+            if job_id not in queued:
+                return
+            time.sleep(self.poll_interval_s)

@@ -1,0 +1,13 @@
+"""SGE utilities (reference parity: ``pyabc/sge/util.py::sge_available``)."""
+from __future__ import annotations
+
+import shutil
+
+from ..sampler.multicore import nr_cores_available  # single source of truth
+
+__all__ = ["sge_available", "nr_cores_available"]
+
+
+def sge_available() -> bool:
+    """True when an SGE submission host is usable (qsub + qstat on PATH)."""
+    return shutil.which("qsub") is not None and shutil.which("qstat") is not None

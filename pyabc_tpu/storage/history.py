@@ -321,6 +321,27 @@ class History:
         wide.columns.name = None
         return wide.reset_index(drop=True), w
 
+    def get_parameter_names(self, m: int = 0, t: int | None = None
+                            ) -> list[str]:
+        """Parameter names of model m at generation t (cheap DISTINCT query
+        — no particle data is loaded)."""
+        t = self._resolve_t(t)
+        pop_id = self._pop_id(t)
+        if pop_id is None:
+            raise KeyError(f"no population t={t}")
+        rows = self._conn.execute(
+            """
+            SELECT DISTINCT parameters.name
+            FROM models
+            JOIN particles ON particles.model_id = models.id
+            JOIN parameters ON parameters.particle_id = particles.id
+            WHERE models.population_id = ? AND models.m = ?
+            ORDER BY parameters.name
+            """,
+            (pop_id, int(m)),
+        ).fetchall()
+        return [r[0] for r in rows]
+
     def get_model_probabilities(self, t: int | None = None) -> pd.DataFrame:
         if t is None:
             df = pd.read_sql_query(

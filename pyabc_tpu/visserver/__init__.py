@@ -1,0 +1,5 @@
+"""Web dashboard over a History database (reference parity:
+``pyabc/visserver/server.py`` + the ``abc-server`` CLI)."""
+from .server import AbcDashboard, serve
+
+__all__ = ["AbcDashboard", "serve"]

@@ -1,0 +1,266 @@
+"""Dashboard web server for browsing a History database.
+
+Reference parity: ``pyabc/visserver/server.py`` (Flask + bokeh dashboard:
+runs overview, model probabilities, per-generation KDEs) and the
+``abc-server <db>`` CLI entry point. The reference leans on Flask; this
+environment has no Flask, so the dashboard is built on the stdlib
+``http.server`` (ThreadingHTTPServer) with matplotlib-Agg-rendered PNGs —
+zero extra dependencies, same browsing surface:
+
+- ``/``                         runs in the db
+- ``/abc/<id>``                 run detail: config, populations, plots
+- ``/abc/<id>/plot/<name>.png`` diagnostic plots (epsilons, sample numbers,
+                                acceptance rates, ESS, walltime,
+                                model probabilities)
+- ``/abc/<id>/kde/<m>/<param>.png?t=<t>``   1-d posterior KDE
+- ``/abc/<id>/kde_matrix/<m>.png?t=<t>``    KDE matrix
+- ``/api/<id>/populations``     JSON of the populations table
+"""
+from __future__ import annotations
+
+import html
+import io
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from ..storage.history import History
+
+
+def _render_png(plot_fn) -> bytes:
+    """Run a plot function (returning an Axes or Figure) to PNG bytes."""
+    import matplotlib
+
+    matplotlib.use("Agg", force=False)
+    import matplotlib.pyplot as plt
+
+    out = plot_fn()
+    if isinstance(out, np.ndarray):  # axes grid (plot_kde_matrix)
+        out = out.flat[0]
+    fig = out.get_figure() if hasattr(out, "get_figure") else out
+    if fig is None:  # pragma: no cover - axes always carry a figure
+        fig = plt.gcf()
+    buf = io.BytesIO()
+    fig.savefig(buf, format="png", dpi=96, bbox_inches="tight")
+    plt.close(fig)
+    return buf.getvalue()
+
+
+_PAGE = """<!doctype html><html><head><title>{title}</title>
+<style>
+ body {{ font-family: sans-serif; margin: 2em; color: #222; }}
+ table {{ border-collapse: collapse; }}
+ td, th {{ border: 1px solid #ccc; padding: 4px 10px; text-align: right; }}
+ th {{ background: #f0f0f0; }}
+ img {{ max-width: 46%; margin: 6px; border: 1px solid #eee; }}
+ a {{ color: #06c; }}
+ code {{ background: #f6f6f6; padding: 1px 4px; }}
+</style></head><body>{body}</body></html>"""
+
+
+class AbcDashboard:
+    """Routes + rendering over one History db (no server state)."""
+
+    def __init__(self, db_url: str):
+        self.db_url = db_url
+
+    # -------------------------------------------------------------- helpers
+    def _history(self, run_id: int | None = None) -> History:
+        # one History per request: sqlite connections are thread-bound and
+        # the ThreadingHTTPServer serves each request on its own thread
+        return History(self.db_url, _id=run_id)
+
+    def _param_names(self, h: History, m: int) -> list[str]:
+        return h.get_parameter_names(m=m)
+
+    # --------------------------------------------------------------- routes
+    def index(self) -> str:
+        h = self._history()
+        runs = h.all_runs()
+        rows = "".join(
+            f"<tr><td><a href='/abc/{int(r.id)}'>{int(r.id)}</a></td>"
+            f"<td>{html.escape(str(r.start_time))}</td>"
+            f"<td>{html.escape(str(r.distance_function))}</td>"
+            f"<td>{html.escape(str(r.epsilon_function))}</td></tr>"
+            for r in runs.itertuples()
+        )
+        body = (f"<h1>ABC runs in <code>{html.escape(self.db_url)}</code>"
+                f"</h1><table><tr><th>id</th><th>started</th>"
+                f"<th>distance</th><th>epsilon</th></tr>{rows}</table>")
+        return _PAGE.format(title="pyabc_tpu dashboard", body=body)
+
+    def run_page(self, run_id: int) -> str:
+        h = self._history(run_id)
+        pops = h.get_all_populations()
+        pops = pops[pops.t >= 0]
+        rows = "".join(
+            "<tr>" + "".join(
+                f"<td>{html.escape(str(v))}</td>"
+                for v in (int(r.t), f"{r.epsilon:.6g}", int(r.samples),
+                          str(r.population_end_time))
+            ) + "</tr>"
+            for r in pops.itertuples()
+        )
+        plots = "".join(
+            f"<img src='/abc/{run_id}/plot/{p}.png' alt='{p}'>"
+            for p in ("epsilons", "sample_numbers", "acceptance_rates",
+                      "effective_sample_sizes", "walltime",
+                      "model_probabilities")
+        )
+        probs = h.get_model_probabilities(h.max_t)
+        alive = [int(m) for m, p in probs["p"].items() if p > 0]
+        kde_links = []
+        for m in alive:
+            names = self._param_names(h, m)
+            kde_links.append(
+                f"<li>model {m}: "
+                + " ".join(
+                    f"<a href='/abc/{run_id}/kde/{m}/{html.escape(nm)}.png'>"
+                    f"{html.escape(nm)}</a>" for nm in names
+                )
+                + f" | <a href='/abc/{run_id}/kde_matrix/{m}.png'>matrix</a>"
+                "</li>"
+            )
+        body = (
+            f"<h1>ABC run {run_id}</h1>"
+            f"<p><a href='/'>&larr; all runs</a> | "
+            f"<a href='/api/{run_id}/populations'>populations JSON</a></p>"
+            f"<h2>Populations</h2><table><tr><th>t</th><th>epsilon</th>"
+            f"<th>samples</th><th>end time</th></tr>{rows}</table>"
+            f"<h2>Posterior KDEs (final generation)</h2>"
+            f"<ul>{''.join(kde_links)}</ul>"
+            f"<h2>Diagnostics</h2>{plots}"
+        )
+        return _PAGE.format(title=f"ABC run {run_id}", body=body)
+
+    def diagnostic_png(self, run_id: int, name: str) -> bytes:
+        from ..visualization import diagnostics as d
+
+        h = self._history(run_id)
+        fns = {
+            "epsilons": d.plot_epsilons,
+            "sample_numbers": d.plot_sample_numbers,
+            "acceptance_rates": d.plot_acceptance_rates_trajectory,
+            "effective_sample_sizes": d.plot_effective_sample_sizes,
+            "walltime": d.plot_total_walltime,
+            "model_probabilities": d.plot_model_probabilities,
+        }
+        if name not in fns:
+            raise KeyError(name)
+        return _render_png(lambda: fns[name](h))
+
+    def kde_png(self, run_id: int, m: int, param: str,
+                t: int | None) -> bytes:
+        from ..visualization.kde import plot_kde_1d_highlevel
+
+        h = self._history(run_id)
+        return _render_png(
+            lambda: plot_kde_1d_highlevel(h, param, m=m, t=t)
+        )
+
+    def kde_matrix_png(self, run_id: int, m: int, t: int | None) -> bytes:
+        from ..visualization.kde import plot_kde_matrix_highlevel
+
+        h = self._history(run_id)
+        return _render_png(lambda: plot_kde_matrix_highlevel(h, m=m, t=t))
+
+    def populations_json(self, run_id: int) -> str:
+        h = self._history(run_id)
+        pops = h.get_all_populations()
+        out = []
+        for r in pops.itertuples():
+            out.append({
+                "t": int(r.t), "epsilon": float(r.epsilon),
+                "samples": int(r.samples),
+                "population_end_time": str(r.population_end_time),
+                "telemetry": h.get_telemetry(int(r.t)) if r.t >= 0 else {},
+            })
+        return json.dumps(out)
+
+
+_ROUTES = [
+    (re.compile(r"^/$"), "index"),
+    (re.compile(r"^/abc/(\d+)$"), "run"),
+    (re.compile(r"^/abc/(\d+)/plot/([a-z_]+)\.png$"), "plot"),
+    (re.compile(r"^/abc/(\d+)/kde/(\d+)/([^/]+)\.png$"), "kde"),
+    (re.compile(r"^/abc/(\d+)/kde_matrix/(\d+)\.png$"), "kde_matrix"),
+    (re.compile(r"^/api/(\d+)/populations$"), "api_populations"),
+]
+
+
+def _make_handler(dash: AbcDashboard):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # quiet by default
+            pass
+
+        def _send(self, code: int, ctype: str, payload: bytes) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def do_GET(self):  # noqa: N802 - stdlib API
+            url = urlparse(self.path)
+            try:
+                q = parse_qs(url.query)
+                t = int(q["t"][0]) if "t" in q else None
+                for pat, kind in _ROUTES:
+                    mobj = pat.match(url.path)
+                    if not mobj:
+                        continue
+                    g = mobj.groups()
+                    if kind == "index":
+                        return self._send(
+                            200, "text/html",
+                            dash.index().encode())
+                    if kind == "run":
+                        return self._send(
+                            200, "text/html",
+                            dash.run_page(int(g[0])).encode())
+                    if kind == "plot":
+                        return self._send(
+                            200, "image/png",
+                            dash.diagnostic_png(int(g[0]), g[1]))
+                    if kind == "kde":
+                        return self._send(
+                            200, "image/png",
+                            dash.kde_png(int(g[0]), int(g[1]), g[2], t))
+                    if kind == "kde_matrix":
+                        return self._send(
+                            200, "image/png",
+                            dash.kde_matrix_png(int(g[0]), int(g[1]), t))
+                    if kind == "api_populations":
+                        return self._send(
+                            200, "application/json",
+                            dash.populations_json(int(g[0])).encode())
+                self._send(404, "text/plain", b"not found")
+            except Exception as exc:  # surface errors as 500s, keep serving
+                self._send(500, "text/plain",
+                           f"error: {exc!r}".encode())
+
+    return Handler
+
+
+def serve(db_url: str, host: str = "127.0.0.1", port: int = 8765,
+          block: bool = True) -> ThreadingHTTPServer:
+    """Serve the dashboard; ``block=False`` runs it on a daemon thread and
+    returns the server (tests / embedding)."""
+    dash = AbcDashboard(db_url)
+    httpd = ThreadingHTTPServer((host, port), _make_handler(dash))
+    if block:  # pragma: no cover - manual invocation
+        print(f"pyabc_tpu dashboard on http://{host}:{httpd.server_port}")
+        try:
+            httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            httpd.server_close()
+        return httpd
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    return httpd
