@@ -109,5 +109,6 @@ from .transition import (
     NotEnoughParticles,
     Transition,
 )
+from . import visualization  # noqa: E402  (pt.visualization.plot_* UX)
 
 __version__ = "0.1.0"

@@ -622,6 +622,24 @@ class ABCSMC:
             return True
         return False
 
+    @staticmethod
+    def _device_memory_telemetry() -> dict:
+        """Device memory highwater, when the runtime exposes it (real local
+        TPU/GPU runtimes do; CPU and tunneled devices yield {})."""
+        try:
+            import jax
+
+            stats = jax.devices()[0].memory_stats()
+        except Exception:
+            return {}
+        if not stats:
+            return {}
+        out = {}
+        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if key in stats:
+                out[f"device_{key}"] = int(stats[key])
+        return out
+
     # -------------------------------------------------- fused multi-gen loop
     def _fused_chunk_capable(self) -> bool:
         """True when whole generations can be chained ON DEVICE: every
@@ -846,6 +864,10 @@ class ABCSMC:
 
             stop = False
             last_pop = None
+            # one post-chunk snapshot: memory stats are process-level
+            # high-water marks; per-generation re-reads inside the persist
+            # loop would record the same value g_limit times
+            mem_telemetry = self._device_memory_telemetry()
             for g in range(g_limit):
                 if not bool(fetched["gen_ok"][g]):
                     logger.info(
@@ -886,6 +908,7 @@ class ABCSMC:
                         "sample_s": round(chunk_s / g_limit, 4),
                         "n_evaluations": nr_evals,
                         "acceptance_rate": round(acceptance_rate, 6),
+                        **(mem_telemetry if g == 0 else {}),
                     },
                 )
                 logger.info(
