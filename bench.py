@@ -105,7 +105,7 @@ def run_tpu_bench(pop_size: int, n_gens: int, budget_s: float, seed: int = 0):
         population_size=pop_size,
         eps=pt.MedianEpsilon(),
         seed=seed,
-        fused_generations=6,
+        fused_generations=8,
     )
     abc.new("sqlite://", obs)
     t0 = time.time()
@@ -224,10 +224,10 @@ print("BASELINE_PPS", {pop_size} * h.n_populations / elapsed * {assumed_cores})
 def main():
     budget = float(os.environ.get("PYABC_TPU_BENCH_BUDGET_S", 300))
     pop = int(os.environ.get("PYABC_TPU_BENCH_POP", 1000))
-    # enough generations for >=2 post-compile fused chunks (G=6) while
-    # staying in the reference config's regime (~8-16 generations; deeper
-    # MedianEpsilon schedules collapse acceptance at the noise floor)
-    gens = int(os.environ.get("PYABC_TPU_BENCH_GENS", 17))
+    # enough generations for >=2 post-compile fused chunks (G=8) while
+    # staying clear of the deep-schedule acceptance collapse (MedianEpsilon
+    # at the noise floor, t >~ 30)
+    gens = int(os.environ.get("PYABC_TPU_BENCH_GENS", 23))
     t_start = time.time()
 
     _state["phase"] = "probe"

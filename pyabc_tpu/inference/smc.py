@@ -838,11 +838,42 @@ class ABCSMC:
         carry0 = (trans0, dist_w0, jnp.asarray(self.eps(t), jnp.float32),
                   jnp.asarray(False))
 
-        chunk_index = 0
         g_limit = _g_limit(t)
         if g_limit <= 0:
             self.history.done()
             return self.history
+        # sqlite persistence moves to a writer thread: the host path per
+        # chunk becomes fetch + dispatch, and appends overlap the next
+        # chunk's device compute; history.done() flushes before returning
+        self.history.start_async_writer()
+        try:
+            return self._fused_chunk_loop(
+                t, g_limit, n, carry0, _g_limit, _dispatch_chunk,
+                minimum_epsilon, max_nr_populations, min_acceptance_rate,
+                max_total_nr_simulations, max_walltime, start_walltime,
+                sims_total, eps_quantile, adaptive,
+            )
+        except BaseException:
+            # drain queued generations before propagating — a mid-loop
+            # failure (device error, interrupt) must not silently abandon
+            # populations already handed to the writer
+            try:
+                self.history.flush()
+            except Exception:
+                pass  # the original error wins; chained context preserved
+            raise
+
+    def _fused_chunk_loop(self, t, g_limit, n, carry0, _g_limit,
+                          _dispatch_chunk, minimum_epsilon,
+                          max_nr_populations, min_acceptance_rate,
+                          max_total_nr_simulations, max_walltime,
+                          start_walltime, sims_total, eps_quantile,
+                          adaptive) -> History:
+        import jax
+
+        from ..sampler.base import Sample, exp_normalize_log_weights
+
+        chunk_index = 0
         t_chunk0 = time.time()
         res = _dispatch_chunk(carry0, t, g_limit)
         while True:
@@ -876,8 +907,6 @@ class ABCSMC:
                     )
                     stop = True
                     break
-                from ..sampler.base import Sample, exp_normalize_log_weights
-
                 weights = exp_normalize_log_weights(
                     fetched["log_weight"][g][:n]
                 )
@@ -898,7 +927,7 @@ class ABCSMC:
                 self.sampler.nr_evaluations_ = nr_evals
                 sims_total += nr_evals
                 acceptance_rate = n / max(nr_evals, 1)
-                self.history.append_population(
+                self.history.append_population_async(
                     t, current_eps, pop, nr_evals, self.model_names,
                     telemetry={
                         "fused_chunk": g_limit,

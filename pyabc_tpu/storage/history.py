@@ -92,12 +92,97 @@ def _db_path(db: str) -> str:
     return db
 
 
+def _locked(fn):
+    """Serialize a History method against the shared sqlite connection.
+
+    With an async writer active, reads from other threads must not observe
+    a half-written generation (the writer's explicit transaction is visible
+    connection-wide); every public read/write entry point takes the lock.
+    """
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self._lock:
+            return fn(self, *args, **kwargs)
+
+    return wrapper
+
+
+class _AsyncWriter:
+    """Single background thread draining queued db writes in order.
+
+    sqlite's serialized threading mode (sqlite3.threadsafety == 3) makes a
+    shared connection safe; History additionally locks multi-statement
+    transactions. Worker exceptions are re-raised on the next submit/flush
+    so a failed persist cannot pass silently.
+    """
+
+    def __init__(self):
+        import queue
+        import threading
+
+        self._queue: "queue.Queue" = queue.Queue()
+        self._error: BaseException | None = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            item = self._queue.get()
+            if item is None:
+                self._queue.task_done()
+                return
+            fn, args, kwargs = item
+            try:
+                # after a failure, drain without executing: later appends
+                # must not commit on top of a possibly broken db state
+                if self._error is None:
+                    fn(*args, **kwargs)
+            except BaseException as exc:  # noqa: BLE001 - surfaced later
+                self._error = exc
+            finally:
+                self._queue.task_done()
+
+    def _check(self):
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    def submit(self, fn, *args, **kwargs):
+        self._check()
+        self._queue.put((fn, args, kwargs))
+
+    def flush(self):
+        """Block until everything queued so far is written."""
+        self._queue.join()
+        self._check()
+
+    def close(self):
+        self._queue.join()
+        self._queue.put(None)
+        self._thread.join(timeout=30)
+        self._check()
+
+
 class History:
-    """Experiment record over one sqlite database; multiple runs per db."""
+    """Experiment record over one sqlite database; multiple runs per db.
+
+    Writes may be moved off the caller's thread with
+    :meth:`start_async_writer` + :meth:`append_population_async` (used by
+    the fused-chunk loop so sqlite persistence overlaps device compute);
+    :meth:`done` flushes, so post-run reads always see every generation.
+    """
 
     def __init__(self, db: str, _id: int | None = None):
+        import threading
+
         self.db = db
-        self._conn = sqlite3.connect(_db_path(db))
+        # check_same_thread=False: the async writer thread shares this
+        # connection; sqlite serialized mode + self._lock make it safe
+        self._conn = sqlite3.connect(_db_path(db), check_same_thread=False)
+        self._lock = threading.RLock()
+        self._writer: _AsyncWriter | None = None
         self._conn.executescript(_SCHEMA)
         # schema migration for dbs created before the telemetry column
         cols = [r[1] for r in self._conn.execute(
@@ -109,11 +194,30 @@ class History:
         self._conn.commit()
         self.id = _id if _id is not None else self._latest_id()
 
+    # ------------------------------------------------------- async writing
+    def start_async_writer(self) -> "_AsyncWriter":
+        if self._writer is None:
+            self._writer = _AsyncWriter()
+        return self._writer
+
+    def append_population_async(self, *args, **kwargs) -> None:
+        """Queue an append on the writer thread (falls back to synchronous
+        when no writer is active)."""
+        if self._writer is None:
+            self.append_population(*args, **kwargs)
+            return
+        self._writer.submit(self.append_population, *args, **kwargs)
+
+    def flush(self) -> None:
+        if self._writer is not None:
+            self._writer.flush()
+
     def _latest_id(self) -> int | None:
         row = self._conn.execute("SELECT MAX(id) FROM abc_smc").fetchone()
         return row[0]
 
     # ------------------------------------------------------------- creation
+    @_locked
     def store_initial_data(self, ground_truth_model: int | None,
                            options: dict, observed_summary_statistics: dict,
                            ground_truth_parameter: dict,
@@ -172,6 +276,25 @@ class History:
     def append_population(self, t: int, current_epsilon: float, population,
                           nr_simulations: int, model_names: list[str],
                           telemetry: dict | None = None) -> None:
+        with self._lock:
+            try:
+                self._append_population_locked(
+                    t, current_epsilon, population, nr_simulations,
+                    model_names, telemetry,
+                )
+            except BaseException:
+                # never leave the shared connection inside a broken
+                # transaction: a later append's commit would otherwise
+                # durably persist this generation's partial rows
+                try:
+                    self._conn.rollback()
+                except sqlite3.Error:
+                    pass
+                raise
+
+    def _append_population_locked(self, t, current_epsilon, population,
+                                  nr_simulations, model_names,
+                                  telemetry) -> None:
         cur = self._conn.cursor()
         try:
             # grab the write lock up front: the batched particle insert
@@ -236,20 +359,22 @@ class History:
     def update_telemetry(self, t: int, telemetry: dict) -> None:
         """Merge keys into the telemetry json of generation t (adaptation
         timings only exist after the row is first written)."""
-        pop_id = self._pop_id(t)
-        if pop_id is None:
-            return
-        row = self._conn.execute(
-            "SELECT telemetry FROM populations WHERE id=?", (pop_id,)
-        ).fetchone()
-        merged = dict(json.loads(row[0]) if row and row[0] else {})
-        merged.update(telemetry)
-        self._conn.execute(
-            "UPDATE populations SET telemetry=? WHERE id=?",
-            (json.dumps(merged), pop_id),
-        )
-        self._conn.commit()
+        with self._lock:
+            pop_id = self._pop_id(t)
+            if pop_id is None:
+                return
+            row = self._conn.execute(
+                "SELECT telemetry FROM populations WHERE id=?", (pop_id,)
+            ).fetchone()
+            merged = dict(json.loads(row[0]) if row and row[0] else {})
+            merged.update(telemetry)
+            self._conn.execute(
+                "UPDATE populations SET telemetry=? WHERE id=?",
+                (json.dumps(merged), pop_id),
+            )
+            self._conn.commit()
 
+    @_locked
     def get_telemetry(self, t: int | None = None) -> dict:
         """Per-generation timing/telemetry json (empty dict if none)."""
         pop_id = self._pop_id(self._resolve_t(t))
@@ -275,6 +400,7 @@ class History:
         return t
 
     @property
+    @_locked
     def max_t(self) -> int:
         row = self._conn.execute(
             "SELECT MAX(t) FROM populations WHERE abc_smc_id=?", (self.id,)
@@ -282,6 +408,7 @@ class History:
         return row[0] if row and row[0] is not None else PRE_TIME
 
     @property
+    @_locked
     def n_populations(self) -> int:
         row = self._conn.execute(
             "SELECT COUNT(*) FROM populations WHERE abc_smc_id=? AND t>=0",
@@ -289,11 +416,13 @@ class History:
         ).fetchone()
         return int(row[0])
 
+    @_locked
     def all_runs(self) -> pd.DataFrame:
         return pd.read_sql_query(
             "SELECT * FROM abc_smc", self._conn
         )
 
+    @_locked
     def get_distribution(self, m: int = 0, t: int | None = None
                          ) -> tuple[pd.DataFrame, np.ndarray]:
         """(parameter DataFrame, within-model weights) for model m at t."""
@@ -321,6 +450,7 @@ class History:
         wide.columns.name = None
         return wide.reset_index(drop=True), w
 
+    @_locked
     def get_parameter_names(self, m: int = 0, t: int | None = None
                             ) -> list[str]:
         """Parameter names of model m at generation t (cheap DISTINCT query
@@ -342,6 +472,7 @@ class History:
         ).fetchall()
         return [r[0] for r in rows]
 
+    @_locked
     def get_model_probabilities(self, t: int | None = None) -> pd.DataFrame:
         if t is None:
             df = pd.read_sql_query(
@@ -362,6 +493,7 @@ class History:
         )
         return df.set_index("m")
 
+    @_locked
     def get_all_populations(self) -> pd.DataFrame:
         df = pd.read_sql_query(
             "SELECT t, population_end_time, nr_samples AS samples, epsilon "
@@ -370,6 +502,7 @@ class History:
         )
         return df
 
+    @_locked
     def get_nr_particles_per_population(self) -> pd.Series:
         df = pd.read_sql_query(
             """
@@ -384,6 +517,7 @@ class History:
         )
         return df.set_index("t")["n"]
 
+    @_locked
     def get_weighted_distances(self, t: int | None = None) -> pd.DataFrame:
         """['distance', 'w'] with overall-normalized weights (ref API)."""
         t = self._resolve_t(t)
@@ -399,6 +533,7 @@ class History:
         )
         return df
 
+    @_locked
     def get_weighted_sum_stats(self, t: int | None = None
                                ) -> tuple[np.ndarray, np.ndarray]:
         t = self._resolve_t(t)
@@ -418,6 +553,7 @@ class History:
         stats = np.stack([np_from_bytes(b) for b in df["blob"]])
         return weights, stats
 
+    @_locked
     def get_population_extended(self, t: int | None = None) -> pd.DataFrame:
         t = self._resolve_t(t)
         pop_id = self._pop_id(t)
@@ -434,6 +570,7 @@ class History:
             self._conn, params=(pop_id,),
         )
 
+    @_locked
     def alive_models(self, t: int | None = None) -> list[int]:
         t = self._resolve_t(t)
         pop_id = self._pop_id(t)
@@ -443,10 +580,12 @@ class History:
         ).fetchall()
         return [r[0] for r in rows]
 
+    @_locked
     def n_alive_models(self, t: int | None = None) -> int:
         return len(self.alive_models(t))
 
     @property
+    @_locked
     def total_nr_simulations(self) -> int:
         row = self._conn.execute(
             "SELECT SUM(nr_samples) FROM populations WHERE abc_smc_id=?",
@@ -454,6 +593,7 @@ class History:
         ).fetchone()
         return int(row[0] or 0)
 
+    @_locked
     def get_observed_sum_stat(self) -> dict[str, np.ndarray]:
         pop_id = self._pop_id(PRE_TIME)
         df = pd.read_sql_query(
@@ -468,6 +608,7 @@ class History:
         )
         return {r["name"]: np_from_bytes(r["blob"]) for _, r in df.iterrows()}
 
+    @_locked
     def get_ground_truth_parameter(self) -> dict[str, float]:
         pop_id = self._pop_id(PRE_TIME)
         df = pd.read_sql_query(
@@ -482,6 +623,7 @@ class History:
         )
         return dict(zip(df["name"], df["value"]))
 
+    @_locked
     def get_json_parameters(self) -> dict:
         row = self._conn.execute(
             "SELECT json_parameters FROM abc_smc WHERE id=?", (self.id,)
@@ -489,10 +631,17 @@ class History:
         return json.loads(row[0]) if row and row[0] else {}
 
     def done(self) -> None:
-        self._conn.commit()
+        self.flush()  # drain the async writer first, if one is active
+        with self._lock:
+            self._conn.commit()
 
     def close(self) -> None:
-        self._conn.close()
+        try:
+            if self._writer is not None:
+                writer, self._writer = self._writer, None
+                writer.close()  # may re-raise a deferred persist error
+        finally:
+            self._conn.close()
 
     def __repr__(self):
         return f"History(db={self.db!r}, id={self.id})"
