@@ -1,0 +1,41 @@
+"""Gaussian conjugate toy — the correctness anchor.
+
+Reference analog: the pyABC quickstart notebook (``doc/examples``):
+a 1-d Gaussian mean with a normal prior, where the exact posterior is
+available in closed form and the ABC posterior must approach it as the
+threshold shrinks.
+
+Run: ``python examples/01_gaussian_toy.py`` (env: EX_POP, EX_GENS).
+"""
+import os
+
+import numpy as np
+
+import pyabc_tpu as pt
+from pyabc_tpu.models import gaussian
+
+POP = int(os.environ.get("EX_POP", 500))
+GENS = int(os.environ.get("EX_GENS", 6))
+X_OBS = 1.0
+
+
+def main():
+    model = gaussian.make_mean_only_model(noise_sd=0.5)
+    prior = gaussian.mean_only_prior()
+    abc = pt.ABCSMC(model, prior, pt.PNormDistance(p=2),
+                    population_size=POP, eps=pt.MedianEpsilon(), seed=1)
+    abc.new("sqlite://", {"x": X_OBS})
+    history = abc.run(max_nr_populations=GENS)
+
+    df, w = history.get_distribution()
+    mu = float(np.sum(df["theta"] * w))
+    sd = float(np.sqrt(np.sum(w * (df["theta"] - mu) ** 2)))
+    mu_true, sd_true = gaussian.conjugate_posterior(X_OBS, noise_sd=0.5)
+    print(f"ABC posterior:      mean={mu:.3f} sd={sd:.3f}")
+    print(f"analytic posterior: mean={mu_true:.3f} sd={sd_true:.3f}")
+    assert abs(mu - mu_true) < 0.3
+    return history
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""Lotka-Volterra ODE with adaptive distance — the headline benchmark.
+
+Reference analog: the pyABC Lotka-Volterra example notebook. 4 parameters
+(alpha, beta, gamma, delta), noisy prey/predator trajectories,
+AdaptivePNormDistance reweighting each statistic per generation,
+MedianEpsilon. On a device-capable setup this runs through the fused
+multi-generation kernel (whole chunks of generations per dispatch).
+
+Run: ``python examples/02_lotka_volterra.py`` (env: EX_POP, EX_GENS).
+"""
+import os
+
+import numpy as np
+
+import pyabc_tpu as pt
+from pyabc_tpu.models import lotka_volterra as lv
+
+POP = int(os.environ.get("EX_POP", 1000))
+GENS = int(os.environ.get("EX_GENS", 8))
+
+
+def main():
+    model = lv.make_lv_model()
+    prior = lv.default_prior()
+    obs = lv.observed_data(seed=123)
+
+    abc = pt.ABCSMC(model, prior, pt.AdaptivePNormDistance(p=2),
+                    population_size=POP, eps=pt.MedianEpsilon(), seed=0)
+    abc.new("sqlite://", obs)
+    history = abc.run(max_nr_populations=GENS)
+
+    df, w = history.get_distribution()
+    print("true parameters: ", lv.TRUE_PARS)
+    for name in ("alpha", "beta", "gamma", "delta"):
+        mu = float(np.sum(df[name] * w))
+        print(f"  {name}: posterior mean {mu:.4f} "
+              f"(true {lv.TRUE_PARS[name]})")
+    eps = history.get_all_populations().query("t >= 0")["epsilon"]
+    print("epsilon trajectory:", [round(e, 2) for e in eps])
+    # loose sanity bound: meaningful vs the uniform(0, 3) prior while
+    # holding for shrunk smoke-test configs (few generations)
+    alpha = float(np.sum(df["alpha"] * w))
+    assert abs(alpha - lv.TRUE_PARS["alpha"]) < 0.8
+    return history
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""External (non-Python) simulator through the file contract.
+
+Reference analog: the pyABC external-simulators example
+(``pyabc/external``): the model is an arbitrary executable invoked as
+``prog --in params.txt --out sumstats.txt``. Anything that can read and
+write key=value text files can be a simulator — here a tiny shell script
+stands in for an R/Julia/C++ program (see also
+``pyabc_tpu.external.R`` / ``JuliaModel`` for language-specific
+adapters).
+
+Run: ``python examples/05_external_model.py`` (env: EX_POP, EX_GENS).
+"""
+import os
+import stat
+import tempfile
+
+import numpy as np
+
+import pyabc_tpu as pt
+from pyabc_tpu.external import ExternalModel
+
+POP = int(os.environ.get("EX_POP", 120))
+GENS = int(os.environ.get("EX_GENS", 3))
+
+SCRIPT = """#!/bin/sh
+# file contract: $2 = params file ('name value' lines), $4 = output file
+theta=$(grep '^theta ' "$2" | cut -d' ' -f2)
+x=$(awk "BEGIN {print 2.0 * $theta}")
+echo "x $x" > "$4"
+"""
+
+
+def main():
+    with tempfile.TemporaryDirectory() as d:
+        prog = os.path.join(d, "sim.sh")
+        with open(prog, "w") as fh:
+            fh.write(SCRIPT)
+        os.chmod(prog, os.stat(prog).st_mode | stat.S_IEXEC)
+
+        model = ExternalModel(prog, name="shell_sim")
+        prior = pt.Distribution(theta=pt.RV("norm", 0.0, 1.0))
+        abc = pt.ABCSMC(model, prior, pt.PNormDistance(p=2),
+                        population_size=POP,
+                        eps=pt.MedianEpsilon(),
+                        sampler=pt.SingleCoreSampler(), seed=13)
+        abc.new("sqlite://", {"x": 1.0})  # true theta = 0.5
+        history = abc.run(max_nr_populations=GENS)
+
+        df, w = history.get_distribution()
+        mu = float(np.sum(df["theta"] * w))
+        print(f"posterior mean theta = {mu:.3f} (true 0.5)")
+        assert abs(mu - 0.5) < 0.3
+        return history
+
+
+if __name__ == "__main__":
+    main()

@@ -860,7 +860,12 @@ class ABCSMC:
             try:
                 self.history.flush()
             except Exception:
-                pass  # the original error wins; chained context preserved
+                # the original loop error propagates; the persist failure
+                # stays sticky on the writer (re-raised by done()/close())
+                # but must not pass without a trace
+                logger.exception(
+                    "async history writer also failed while draining"
+                )
             raise
 
     def _fused_chunk_loop(self, t, g_limit, n, carry0, _g_limit,

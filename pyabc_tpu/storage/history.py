@@ -145,9 +145,12 @@ class _AsyncWriter:
                 self._queue.task_done()
 
     def _check(self):
+        # the error stays STICKY: once a persist failed, the writer is dead
+        # (queued work drains without executing) and every later
+        # submit/flush/close re-raises — a caller that swallows one raise
+        # cannot accidentally resume committing on a broken db state
         if self._error is not None:
-            err, self._error = self._error, None
-            raise err
+            raise self._error
 
     def submit(self, fn, *args, **kwargs):
         self._check()
@@ -631,7 +634,12 @@ class History:
         return json.loads(row[0]) if row and row[0] else {}
 
     def done(self) -> None:
-        self.flush()  # drain the async writer first, if one is active
+        # drain AND retire the writer: long-lived processes (dashboard,
+        # notebooks) would otherwise leak one idle thread per run;
+        # start_async_writer lazily recreates it on a resumed run
+        if self._writer is not None:
+            writer, self._writer = self._writer, None
+            writer.close()  # re-raises a deferred persist error
         with self._lock:
             self._conn.commit()
 

@@ -1,0 +1,22 @@
+"""Execute every example script (reference parity: doc/examples notebooks
+run as CI integration smoke tests). Shrunk via the EX_POP / EX_GENS env
+knobs each example honors; each example asserts its own statistical sanity.
+"""
+import os
+import runpy
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+EXAMPLES = os.path.join(os.path.dirname(HERE), "examples")
+
+
+@pytest.mark.parametrize("script", sorted(
+    f for f in os.listdir(EXAMPLES) if f.endswith(".py")
+))
+def test_example_runs(script, monkeypatch):
+    monkeypatch.setenv("EX_POP", "150")
+    monkeypatch.setenv("EX_GENS", "3")
+    mod = runpy.run_path(os.path.join(EXAMPLES, script), run_name="example")
+    history = mod["main"]()
+    assert history.n_populations >= 1

@@ -1,0 +1,180 @@
+"""Database schema migration tests (reference parity: ``test/migrate/`` —
+old db schema versions must still load).
+
+The fixture db is built with the ORIGINAL round-1 schema (no ``telemetry``
+column on populations) plus hand-inserted rows; opening it through History
+must migrate in place and serve every read API, and a resumed run must
+append to it.
+"""
+import sqlite3
+
+import jax
+import numpy as np
+
+import pyabc_tpu as pt
+
+OLD_SCHEMA = """
+CREATE TABLE abc_smc (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    start_time TEXT,
+    json_parameters TEXT,
+    distance_function TEXT,
+    epsilon_function TEXT,
+    population_strategy TEXT
+);
+CREATE TABLE populations (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    abc_smc_id INTEGER REFERENCES abc_smc(id),
+    t INTEGER,
+    population_end_time TEXT,
+    nr_samples INTEGER,
+    epsilon REAL
+);
+CREATE TABLE models (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    population_id INTEGER REFERENCES populations(id),
+    m INTEGER,
+    name TEXT,
+    p_model REAL
+);
+CREATE TABLE particles (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    model_id INTEGER REFERENCES models(id),
+    w REAL,
+    distance REAL
+);
+CREATE TABLE parameters (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    particle_id INTEGER REFERENCES particles(id),
+    name TEXT,
+    value REAL
+);
+CREATE TABLE samples (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    particle_id INTEGER REFERENCES particles(id),
+    name TEXT,
+    value BLOB
+);
+"""
+
+
+def _make_old_db(path: str) -> None:
+    from pyabc_tpu.storage.bytes_storage import np_to_bytes
+
+    conn = sqlite3.connect(path)
+    conn.executescript(OLD_SCHEMA)
+    cur = conn.cursor()
+    cur.execute(
+        "INSERT INTO abc_smc (start_time, json_parameters, distance_function,"
+        " epsilon_function, population_strategy) VALUES (?,?,?,?,?)",
+        ("2025-01-01T00:00:00", "{}", "{}", "{}", "{}"),
+    )
+    abc_id = cur.lastrowid
+    rng = np.random.default_rng(0)
+    for t, eps in [(-1, np.inf), (0, 1.2), (1, 0.6)]:
+        cur.execute(
+            "INSERT INTO populations (abc_smc_id, t, population_end_time, "
+            "nr_samples, epsilon) VALUES (?,?,?,?,?)",
+            (abc_id, t, "2025-01-01T00:01:00", 100, float(eps)),
+        )
+        pop_id = cur.lastrowid
+        cur.execute(
+            "INSERT INTO models (population_id, m, name, p_model) "
+            "VALUES (?,?,?,?)", (pop_id, 0, "gauss", 1.0),
+        )
+        model_id = cur.lastrowid
+        n = 1 if t == -1 else 50
+        for _ in range(n):
+            theta = float(rng.normal(0.8, 0.4))
+            cur.execute(
+                "INSERT INTO particles (model_id, w, distance) "
+                "VALUES (?,?,?)", (model_id, 1.0 / n, abs(theta - 0.8)),
+            )
+            pid = cur.lastrowid
+            cur.execute(
+                "INSERT INTO parameters (particle_id, name, value) "
+                "VALUES (?,?,?)", (pid, "theta", theta),
+            )
+            cur.execute(
+                "INSERT INTO samples (particle_id, name, value) "
+                "VALUES (?,?,?)",
+                (pid, "__flat__" if t >= 0 else "x",
+                 np_to_bytes(np.asarray([theta]))),
+            )
+    conn.commit()
+    conn.close()
+
+
+def test_old_schema_migrates_and_reads(tmp_path):
+    db_file = tmp_path / "old.db"
+    _make_old_db(str(db_file))
+    h = pt.History(f"sqlite:///{db_file}")
+    # telemetry column was added in place
+    cols = [r[1] for r in h._conn.execute("PRAGMA table_info(populations)")]
+    assert "telemetry" in cols
+    assert h.max_t == 1
+    assert h.n_populations == 2
+    df, w = h.get_distribution(0, 1)
+    assert len(df) == 50 and abs(w.sum() - 1.0) < 1e-9
+    assert h.get_parameter_names(0) == ["theta"]
+    assert h.get_telemetry(1) == {}
+    pops = h.get_all_populations()
+    assert list(pops[pops.t >= 0]["epsilon"]) == [1.2, 0.6]
+
+
+def test_old_schema_resume_appends(tmp_path):
+    db_file = tmp_path / "old_resume.db"
+    _make_old_db(str(db_file))
+
+    @pt.JaxModel.from_function(["theta"], name="gauss")
+    def model(key, theta):
+        return {"x": theta[0] + 0.5 * jax.random.normal(key)}
+
+    prior = pt.Distribution(theta=pt.RV("norm", 0.0, 1.0))
+    abc = pt.ABCSMC(model, prior, pt.PNormDistance(p=2),
+                    population_size=50, eps=pt.MedianEpsilon(), seed=3)
+    abc.load(f"sqlite:///{db_file}", 1, observed_sum_stat={"x": 1.0})
+    h = abc.run(max_nr_populations=4)
+    assert h.n_populations == 4
+    eps = h.get_all_populations().query("t >= 0")["epsilon"].to_numpy()
+    assert (np.diff(eps[1:]) < 0).all()
+
+
+class TestAsyncWriter:
+    """Async persistence lifecycle: errors are sticky, done() retires the
+    writer thread (no leak per run), resumed runs get a fresh writer."""
+
+    def test_error_is_sticky_and_drains_without_executing(self):
+        import pytest
+
+        from pyabc_tpu.storage.history import _AsyncWriter
+
+        w = _AsyncWriter()
+        calls = []
+
+        def boom():
+            raise RuntimeError("persist failed")
+
+        w.submit(boom)
+        with pytest.raises(RuntimeError, match="persist failed"):
+            w.flush()
+        # still sticky after being raised once
+        with pytest.raises(RuntimeError, match="persist failed"):
+            w.submit(calls.append, 1)
+        # nothing queued after the failure ever executes
+        assert calls == []
+        with pytest.raises(RuntimeError, match="persist failed"):
+            w.close()
+
+    def test_done_retires_writer_thread(self):
+        import threading
+
+        h = pt.History("sqlite://")
+        before = threading.active_count()
+        h.start_async_writer()
+        assert threading.active_count() == before + 1
+        h.done()
+        assert h._writer is None
+        # lazily recreated for a resumed run
+        h.start_async_writer()
+        h.done()
