@@ -655,8 +655,6 @@ class ABCSMC:
 
         if self.fused_generations <= 1 or not self._device_capable:
             return False
-        if self.K != 1:
-            return False
         if not isinstance(self.sampler, BatchedSampler) or not getattr(
             self.sampler, "fused", False
         ):
@@ -670,9 +668,17 @@ class ABCSMC:
         if type(self.acceptor) is not UniformAcceptor \
                 or self.acceptor.use_complete_history:
             return False
-        tr = self.transitions[0]
-        if type(tr) is not MultivariateNormalTransition:
+        if type(self.model_perturbation_kernel) is not ModelPerturbationKernel:
+            # the kernel only honors the stock static transition matrix;
+            # custom jump kernels fall back to the per-generation loop
             return False
+        tr = self.transitions[0]
+        for other in self.transitions:
+            # per-model refits share ONE traced device_fit configuration
+            if (type(other) is not MultivariateNormalTransition
+                    or other.scaling != tr.scaling
+                    or other.bandwidth_selector is not tr.bandwidth_selector):
+                return False
         if tr.bandwidth_selector not in (scott_rule_of_thumb,
                                          silverman_rule_of_thumb):
             return False
@@ -801,7 +807,7 @@ class ABCSMC:
             multiplier=getattr(self.eps, "quantile_multiplier", 1.0),
             trans_cls=type(tr), scaling=tr.scaling,
             bandwidth_selector=tr.bandwidth_selector,
-            dim=self.parameter_priors[0].space.dim,
+            dims=tuple(p.space.dim for p in self.parameter_priors),
         )
 
         def _g_limit(t_at: int) -> int:
@@ -825,17 +831,44 @@ class ABCSMC:
                 self._root_key, jnp.asarray(t_at, jnp.int32),
                 jnp.asarray(n, jnp.int32),
                 jnp.asarray(g_limit, jnp.int32), carry,
+                jnp.asarray(self.model_perturbation_kernel.device_params()),
                 jnp.asarray(eps_fixed),
                 jnp.asarray(minimum_epsilon, jnp.float32),
                 jnp.asarray(min_acceptance_rate, jnp.float32),
             )
 
-        raw = jax.tree.map(np.asarray, tr.device_params())
-        trans0 = pad_transition_params(raw, n_cap, ctx.d_max)
+        # per-model initial transition params (host fit of the previous
+        # generation), padded to the reservoir shape; never-fitted models
+        # get zero placeholders and a False fitted-mask entry (the kernel
+        # masks them out of the model-perturbation matrix)
+        trans0 = []
+        fitted0 = np.zeros(self.K, bool)
+        ref_fitted = next(
+            (x for x in self.transitions if x.X is not None), None
+        )
+        if ref_fitted is None:
+            raise RuntimeError("no fitted transition to start a fused chunk")
+        for m, tr_m in enumerate(self.transitions):
+            if tr_m.X is not None:
+                raw = jax.tree.map(np.asarray, tr_m.device_params())
+                fitted0[m] = True
+            else:
+                raw = jax.tree.map(
+                    lambda v: np.zeros_like(np.asarray(v)),
+                    ref_fitted.device_params(),
+                )
+            trans0.append(pad_transition_params(raw, n_cap, ctx.d_max))
+        probs0 = np.zeros(self.K)
+        for m, p in self._model_probs.items():
+            probs0[int(m)] = p
+        with np.errstate(divide="ignore"):
+            log_probs0 = np.log(probs0)
         dist_w0 = jnp.asarray(
             np.asarray(self.distance_function.device_params(t), np.float32)
         )
-        carry0 = (trans0, dist_w0, jnp.asarray(self.eps(t), jnp.float32),
+        carry0 = (tuple(trans0), jnp.asarray(log_probs0, jnp.float32),
+                  jnp.asarray(fitted0), dist_w0,
+                  jnp.asarray(self.eps(t), jnp.float32),
                   jnp.asarray(False))
 
         g_limit = _g_limit(t)
@@ -960,6 +993,13 @@ class ABCSMC:
                     )
                 if hasattr(self.acceptor, "note_epsilon"):
                     self.acceptor.note_epsilon(t, current_eps, adaptive)
+                # device-side model probabilities of this generation (the
+                # stop_if_only_single_model_alive rule reads _model_probs)
+                self._model_probs = {
+                    m: float(p)
+                    for m, p in enumerate(fetched["model_probs"][g])
+                    if p > 0
+                }
                 last_pop = pop
                 if self._check_stop(t, current_eps, minimum_epsilon,
                                     max_nr_populations, acceptance_rate,

@@ -603,17 +603,25 @@ class DeviceContext:
                         max_rounds: int, G: int, *, adaptive: bool,
                         eps_quantile: bool, eps_weighted: bool, alpha: float,
                         multiplier: float, trans_cls, scaling: float,
-                        bandwidth_selector, dim: int):
-        """One jitted program for G WHOLE GENERATIONS (K=1, transition mode).
+                        bandwidth_selector, dims: tuple):
+        """One jitted program for G WHOLE GENERATIONS (transition mode).
 
         The TPU-native endgame of the reference's per-generation scatter/
         gather: a ``lax.scan`` over generations where EVERYTHING the host
-        used to do between generations happens on device — transition refit
-        (``MultivariateNormalTransition.device_fit``), adaptive-distance
-        reweighting + distance recompute, and the weighted-quantile epsilon
-        update. One dispatch and ONE host sync per G generations; over a
-        TPU tunnel (~0.1s per sync) this is the difference between ~7 and
-        ~30+ generations per second at pop 1000.
+        used to do between generations happens on device — per-model
+        transition refits (``MultivariateNormalTransition.device_fit``),
+        model-probability updates with the never-fitted proposal mask,
+        adaptive-distance reweighting + distance recompute, and the
+        weighted-quantile epsilon update. One dispatch and ONE host sync
+        per G generations; over a TPU tunnel (~0.1s per sync) this is the
+        difference between ~7 and ~40+ generations per second at pop 1000.
+
+        Multi-model: the carry holds K fitted-transition param sets, the
+        model log-probabilities, and a per-model ``fitted`` mask (a model
+        with fewer than dim+1 accepted particles cannot propose next
+        generation — the host's NotEnoughParticles semantics); the model
+        perturbation matrix is re-masked and renormalized on device each
+        generation exactly as ``build_dyn_args`` does on the host.
 
         Early stop is a carried flag: a generation that misses ``n_target``
         within the round budget, hits ``min_eps``, or collapses below
@@ -623,7 +631,7 @@ class DeviceContext:
         cache_key = ("multigen", B, n_cap, rec_cap, max_rounds, G, adaptive,
                      eps_quantile, eps_weighted, alpha, multiplier,
                      trans_cls.__name__, scaling,
-                     getattr(bandwidth_selector, "__name__", "?"), dim)
+                     getattr(bandwidth_selector, "__name__", "?"), dims)
         if cache_key in self._kernels:
             return self._kernels[cache_key]
 
@@ -652,8 +660,10 @@ class DeviceContext:
                 "adaptive multigen run needs device scale + weight twins"
             )
 
-        def multigen_fn(root, t0, n_target, g_limit, carry0, eps_fixed,
-                        min_eps, min_acc_rate):
+        K = self.K
+
+        def multigen_fn(root, t0, n_target, g_limit, carry0, mpk_base,
+                        eps_fixed, min_eps, min_acc_rate):
             def run_lanes(key, dyn):
                 keys = jax.random.split(key, B)
                 if lane_sharding is not None:
@@ -663,7 +673,8 @@ class DeviceContext:
                 return jax.vmap(lambda k: lane(k, dyn))(keys)
 
             def gen_step(carry, g):
-                trans_params, dist_w, eps_carry, stopped = carry
+                (trans_params, log_model_probs, fitted, dist_w, eps_carry,
+                 stopped) = carry
                 # g_limit (dynamic) caps the active generations so the LAST
                 # chunk of a run reuses the same compiled G-kernel instead
                 # of tracing a shorter scan (a ~20s compile per distinct G)
@@ -671,14 +682,28 @@ class DeviceContext:
                 t = t0 + g
                 gen_key = jax.random.fold_in(root, t + 1)  # generation_key
                 eps_g = eps_carry if eps_quantile else eps_fixed[g]
+                # mask & renormalize the model-perturbation matrix like the
+                # host build_dyn_args: never-fitted models cannot propose
+                matrix = mpk_base * fitted[None, :].astype(jnp.float32)
+                row_sums = matrix.sum(axis=1, keepdims=True)
+                matrix = jnp.where(
+                    row_sums > 0, matrix / jnp.where(row_sums > 0,
+                                                     row_sums, 1.0), 0.0
+                )
+                probs = jnp.exp(log_model_probs)
+                model_factor = probs @ matrix
+                log_model_factor = jnp.where(
+                    model_factor > 0,
+                    jnp.log(jnp.maximum(model_factor, 1e-38)), -jnp.inf,
+                )
                 dyn = {
                     "eps": eps_g,
                     "dist_params": dist_w,
                     "acc_params": (),
-                    "log_model_probs": jnp.zeros((1,), jnp.float32),
-                    "mpk_matrix": jnp.ones((1, 1), jnp.float32),
-                    "log_model_factor": jnp.zeros((1,), jnp.float32),
-                    "trans_params": (trans_params,),
+                    "log_model_probs": log_model_probs,
+                    "mpk_matrix": matrix,
+                    "log_model_factor": log_model_factor,
+                    "trans_params": trans_params,
                 }
 
                 def run_gen(_):
@@ -739,9 +764,35 @@ class DeviceContext:
                 else:
                     eps_next = eps_carry
 
-                trans_next = trans_cls.device_fit(
-                    res["theta"], w_norm, dim=dim, scaling=scaling,
-                    bandwidth_selector=bandwidth_selector,
+                # per-model: probabilities, fitted mask, transition refits
+                # (reference per-model masked refits + NotEnoughParticles:
+                # a model needs > dim accepted particles to propose)
+                m_arr = res["m"]
+                model_probs_next = jnp.stack([
+                    jnp.where((m_arr == m) & k_mask, w_norm, 0.0).sum()
+                    for m in range(K)
+                ])
+                counts = jnp.stack([
+                    (k_mask & (m_arr == m)).sum() for m in range(K)
+                ])
+                # host rule: a transition fits from ANY non-empty particle
+                # set (store_fit_params only rejects zero particles; the
+                # single-particle degenerate covariance is guarded inside
+                # device_fit like smart_cov) — a stricter mask here would
+                # make model survival depend on chunk boundaries
+                fitted_next = counts > 0
+                log_model_probs_next = jnp.where(
+                    model_probs_next > 0,
+                    jnp.log(jnp.maximum(model_probs_next, 1e-38)), -jnp.inf,
+                )
+                trans_next = tuple(
+                    trans_cls.device_fit(
+                        res["theta"],
+                        jnp.where(m_arr == m, w_norm, 0.0),
+                        dim=dims[m], scaling=scaling,
+                        bandwidth_selector=bandwidth_selector,
+                    )
+                    for m in range(K)
                 )
                 acc_rate = n_acc / jnp.maximum(n_valid, 1)
                 stopped_next = (
@@ -753,9 +804,10 @@ class DeviceContext:
                     "eps_used": eps_g, "eps_next": eps_next,
                     "dist_w_next": dist_w_next, "n_acc": n_acc,
                     "rounds": rounds, "n_valid": n_valid, "gen_ok": gen_ok,
+                    "model_probs": model_probs_next,
                 }
-                return (trans_next, dist_w_next, eps_next,
-                        stopped_next), out
+                return (trans_next, log_model_probs_next, fitted_next,
+                        dist_w_next, eps_next, stopped_next), out
 
             final_carry, outs = jax.lax.scan(gen_step, carry0, jnp.arange(G))
             # the final carry is returned ON DEVICE so the host can chain

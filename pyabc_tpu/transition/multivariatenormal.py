@@ -127,6 +127,12 @@ class MultivariateNormalTransition(Transition):
         -> Cholesky/precision/logdet, with the same degenerate-diagonal and
         positive-definiteness guards (in traceable ``where`` form).
         """
+        d_max = thetas.shape[1]
+        # padded trailing dims (multi-model batching pads theta to d_max):
+        # they carry zero variance and must contribute NOTHING — zeroed out
+        # of chol/prec and excluded from logdet, exactly like the host's
+        # pad_transition_params zero-padding
+        vmask = (jnp.arange(d_max) < dim).astype(thetas.dtype)
         w = weights / jnp.maximum(weights.sum(), 1e-38)
         mean = w @ thetas
         centered = thetas - mean
@@ -144,14 +150,17 @@ class MultivariateNormalTransition(Transition):
         cov = jnp.where(bad, cov + jnp.eye(cov.shape[0]) * 1e-10, cov)
         chol = jnp.where(bad, jnp.linalg.cholesky(cov), chol)
         prec = jnp.linalg.inv(cov)
-        logdet = 2.0 * jnp.sum(jnp.log(jnp.maximum(
+        # logdet over the REAL dims only (padded block is block-diagonal,
+        # so the leading diag of chol equals the submatrix factorization)
+        logdet = 2.0 * jnp.sum(vmask * jnp.log(jnp.maximum(
             jnp.diagonal(chol), 1e-38
         )))
+        outer = vmask[:, None] * vmask[None, :]
         return {
-            "thetas": thetas,
+            "thetas": thetas * vmask[None, :],
             "weights": w,
-            "chol": chol,
-            "prec": prec,
+            "chol": chol * outer,
+            "prec": prec * outer,
             "logdet": logdet,
             "dim": jnp.float32(dim),
         }
