@@ -69,7 +69,9 @@ def export_cmd(db, run_id, what, time_point, m, fmt, out):
 
 @click.command("abc-bench")
 @click.option("--pop", type=int, default=1000, help="population size")
-@click.option("--gens", type=int, default=6, help="steady-state generations")
+@click.option("--gens", type=int, default=None,
+              help="steady-state generations (default: bench.py's default, "
+                   "sized for >=2 post-compile fused chunks)")
 @click.option("--budget-s", type=float, default=300.0,
               help="walltime budget in seconds")
 @click.option("--cpu", is_flag=True, help="force the CPU platform")
@@ -79,7 +81,8 @@ def bench_cmd(pop, gens, budget_s, cpu):
         os.environ["JAX_PLATFORMS"] = "cpu"
     # explicit CLI flags win over any pre-existing env configuration
     os.environ["PYABC_TPU_BENCH_POP"] = str(pop)
-    os.environ["PYABC_TPU_BENCH_GENS"] = str(gens)
+    if gens is not None:
+        os.environ["PYABC_TPU_BENCH_GENS"] = str(gens)
     os.environ["PYABC_TPU_BENCH_BUDGET_S"] = str(budget_s)
     # repo-root bench.py is the canonical harness; fall back to an inline
     # run when installed without the repo (wheel)
@@ -96,6 +99,11 @@ def bench_cmd(pop, gens, budget_s, cpu):
     import pyabc_tpu as pt
     from pyabc_tpu.models import lotka_volterra as lv
 
+    if gens is None:
+        # mirror the repo bench.py default resolution (env wins, then the
+        # >=2-post-compile-chunks sizing) so wheel installs run the same
+        # benchmark as repo checkouts
+        gens = int(os.environ.get("PYABC_TPU_BENCH_GENS", 23))
     model = lv.make_lv_model()
     abc = pt.ABCSMC(model, lv.default_prior(),
                     pt.AdaptivePNormDistance(p=2), population_size=pop,

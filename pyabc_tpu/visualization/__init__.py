@@ -15,6 +15,7 @@ from .diagnostics import (
     plot_total_walltime,
     plot_walltime,
 )
+from .sensitivity import plot_sensitivity_sankey
 from .histogram import (
     plot_histogram_1d,
     plot_histogram_2d,
@@ -40,6 +41,7 @@ __all__ = [
     "plot_acceptance_rates_trajectory", "plot_model_probabilities",
     "plot_effective_sample_sizes", "plot_total_walltime", "plot_walltime",
     "plot_distance_weights",
+    "plot_sensitivity_sankey",
     "compute_credible_interval", "plot_credible_intervals",
     "plot_credible_intervals_for_time",
 ]

@@ -1,0 +1,117 @@
+"""Sensitivity flow plot (reference parity:
+``pyabc/visualization/sankey.py::plot_sensitivity_sankey``).
+
+Visualizes how strongly each summary statistic informs each parameter,
+from the fitted regression matrix of a learned-summary-statistics
+predictor (Fearnhead-Prangle; see ``pyabc_tpu.predictor``). The reference
+draws a plotly Sankey; plotly is not available here, so the same
+two-column flow diagram is drawn with matplotlib ribbons — statistic
+nodes on the left, parameter nodes on the right, ribbon width
+proportional to |W[s, p]| on standardized inputs.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .util import get_figure
+
+
+def _sensitivity_matrix(source) -> np.ndarray:
+    """(S, d) absolute sensitivity matrix from a PredictorSumstat /
+    Predictor / raw matrix."""
+    pred = getattr(source, "predictor", source)
+    for attr in ("_W", "W"):
+        W = getattr(pred, attr, None)
+        if W is not None:
+            return np.abs(np.asarray(W, np.float64))
+    from ..predictor import Predictor
+
+    if isinstance(pred, Predictor):
+        raise ValueError(
+            f"{type(pred).__name__} carries no linear sensitivity matrix "
+            "(not fitted, or a non-linear predictor) — pass a raw (S, d) "
+            "matrix, e.g. finite-difference sensitivities of .predict"
+        )
+    W = np.abs(np.asarray(source, np.float64))
+    if W.ndim != 2:
+        raise ValueError(
+            f"sensitivity matrix must be 2-d (S, d), got shape {W.shape}"
+        )
+    return W
+
+
+def plot_sensitivity_sankey(source, sumstat_labels=None, par_labels=None,
+                            ax=None, size=None, min_frac: float = 0.01,
+                            cmap: str = "tab10"):
+    """Two-column sensitivity flow: statistics (left) -> parameters (right).
+
+    ``source``: a fitted ``PredictorSumstat``/``Predictor`` (its regression
+    matrix is used) or a raw (S, d) sensitivity matrix. Ribbons thinner
+    than ``min_frac`` of the LARGEST flow are dropped for readability.
+    """
+    import matplotlib.pyplot as plt
+
+    W = _sensitivity_matrix(source)
+    S, d = W.shape
+    if sumstat_labels is None:
+        sumstat_labels = [f"s{i}" for i in range(S)]
+    if par_labels is None:
+        par_labels = [f"p{j}" for j in range(d)]
+    fig, ax = get_figure(ax, size)
+    total = W.sum()
+    if total <= 0:
+        raise ValueError("sensitivity matrix is all zeros")
+    Wn = W / total
+
+    # node extents: stacked by outgoing / incoming flow, with small gaps
+    gap = 0.01
+    left_sizes = Wn.sum(axis=1)
+    right_sizes = Wn.sum(axis=0)
+
+    def stack(sizes):
+        tops = []
+        y = 0.0
+        for sz in sizes:
+            tops.append(y)
+            y += sz + gap
+        return tops, y - gap
+
+    left_tops, left_h = stack(left_sizes)
+    right_tops, right_h = stack(right_sizes)
+    h = max(left_h, right_h)
+    colors = plt.get_cmap(cmap)
+
+    # ribbons
+    left_cursor = list(left_tops)
+    right_cursor = list(right_tops)
+    for i in range(S):
+        for j in range(d):
+            flow = Wn[i, j]
+            if flow < min_frac * Wn.max() or flow <= 0:
+                continue
+            y0 = left_cursor[i]
+            y1 = right_cursor[j]
+            left_cursor[i] += flow
+            right_cursor[j] += flow
+            xs = np.linspace(0.12, 0.88, 50)
+            ease = (1 - np.cos(np.pi * (xs - 0.12) / 0.76)) / 2
+            top = y0 + (y1 - y0) * ease
+            ax.fill_between(xs, top, top + flow,
+                            color=colors(j % 10), alpha=0.45, lw=0)
+    # node bars + labels
+    for i in range(S):
+        ax.fill_between([0.08, 0.12], left_tops[i],
+                        left_tops[i] + left_sizes[i], color="0.3")
+        ax.text(0.07, left_tops[i] + left_sizes[i] / 2,
+                str(sumstat_labels[i]), ha="right", va="center", fontsize=8)
+    for j in range(d):
+        ax.fill_between([0.88, 0.92], right_tops[j],
+                        right_tops[j] + right_sizes[j],
+                        color=colors(j % 10))
+        ax.text(0.93, right_tops[j] + right_sizes[j] / 2,
+                str(par_labels[j]), ha="left", va="center", fontsize=8)
+    ax.set_xlim(0, 1)
+    ax.set_ylim(h + gap, -gap)
+    ax.axis("off")
+    ax.set_title("summary-statistic -> parameter sensitivity")
+    return ax

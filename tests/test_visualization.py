@@ -96,3 +96,42 @@ def test_credible_intervals(history):
 def test_distance_weights(history):
     ax = viz.plot_distance_weights(history._distance)
     assert ax.get_ylabel() == "weight"
+
+
+def test_plot_sensitivity_sankey():
+    """Sensitivity flow plot from a fitted LinearPredictor and from a raw
+    matrix (reference plot_sensitivity_sankey, matplotlib-rendered)."""
+    import numpy as np
+
+    from pyabc_tpu.predictor import LinearPredictor
+    from pyabc_tpu.visualization import plot_sensitivity_sankey
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(200, 5))
+    y = np.stack([2 * x[:, 0] + x[:, 3], -x[:, 1]], axis=1)
+    pred = LinearPredictor()
+    pred.fit(x, y)
+    ax = plot_sensitivity_sankey(
+        pred, sumstat_labels=list("abcde"), par_labels=["p", "q"]
+    )
+    assert ax is not None
+    # raw-matrix input
+    ax2 = plot_sensitivity_sankey(np.abs(rng.normal(size=(4, 3))))
+    assert ax2 is not None
+    import pytest
+
+    with pytest.raises(ValueError, match="all zeros"):
+        plot_sensitivity_sankey(np.zeros((3, 2)))
+
+
+def test_plot_sensitivity_sankey_errors():
+    import numpy as np
+    import pytest
+
+    from pyabc_tpu.predictor import LinearPredictor
+    from pyabc_tpu.visualization import plot_sensitivity_sankey
+
+    with pytest.raises(ValueError, match="no linear sensitivity"):
+        plot_sensitivity_sankey(LinearPredictor())  # unfitted
+    with pytest.raises(ValueError, match="must be 2-d"):
+        plot_sensitivity_sankey(np.ones(4))
