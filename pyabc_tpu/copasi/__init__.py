@@ -1,0 +1,4 @@
+"""COPASI model adapter (reference parity: ``pyabc/copasi``)."""
+from .model import BasicoModel
+
+__all__ = ["BasicoModel"]

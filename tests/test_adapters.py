@@ -179,3 +179,38 @@ def test_petab_lognormal_prior(petab_dir):
     logs = np.log([prior.rvs_host()["k1"] for _ in range(800)])
     assert logs.mean() == pytest.approx(0.5, abs=0.05)
     assert logs.std() == pytest.approx(0.25, abs=0.04)
+
+
+# --------------------------------------------------------------- COPASI
+
+HAS_BASICO = False
+try:
+    import basico  # noqa: F401
+
+    HAS_BASICO = True
+except ImportError:
+    pass
+
+
+@pytest.mark.skipif(HAS_BASICO, reason="basico installed")
+def test_copasi_basico_gating(tmp_path):
+    from pyabc_tpu.copasi import BasicoModel
+
+    with pytest.raises(ImportError, match="basico"):
+        BasicoModel(str(tmp_path / "model.cps"))
+
+
+@pytest.mark.skipif(not HAS_BASICO, reason="needs basico")
+def test_copasi_basico_runs(tmp_path):  # pragma: no cover - needs basico
+    from pyabc_tpu.copasi import BasicoModel
+
+    import basico
+
+    dm = basico.new_model(name="decay")
+    basico.add_reaction("decay", "A ->")
+    basico.set_species("A", initial_concentration=10.0)
+    path = str(tmp_path / "decay.cps")
+    basico.save_model(path, model=dm)
+    model = BasicoModel(path, duration=1.0, n_points=5)
+    out = model.sample(pt.Parameter({"(decay).k1": 0.5}))
+    assert any(len(v) == 5 for v in out.values())
