@@ -101,13 +101,15 @@ def bench_cmd(pop, gens, budget_s, cpu):
 
     if gens is None:
         # mirror the repo bench.py default resolution (env wins, then the
-        # >=2-post-compile-chunks sizing) so wheel installs run the same
-        # benchmark as repo checkouts
-        gens = int(os.environ.get("PYABC_TPU_BENCH_GENS", 23))
+        # G-aligned sizing) so wheel installs run the same benchmark as
+        # repo checkouts
+        gens = int(os.environ.get("PYABC_TPU_BENCH_GENS", 31))
     model = lv.make_lv_model()
     abc = pt.ABCSMC(model, lv.default_prior(),
                     pt.AdaptivePNormDistance(p=2), population_size=pop,
-                    eps=pt.MedianEpsilon())
+                    eps=pt.MedianEpsilon(),
+                    fused_generations=int(
+                        os.environ.get("PYABC_TPU_BENCH_G", 16)))
     abc.new("sqlite://", lv.observed_data(seed=123))
     t0 = time.time()
     h = abc.run(max_nr_populations=gens + 2, max_walltime=budget_s)

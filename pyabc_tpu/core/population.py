@@ -51,7 +51,7 @@ class Population:
         thetas: np.ndarray,
         weights: np.ndarray,
         distances: np.ndarray,
-        sumstats: np.ndarray,
+        sumstats: np.ndarray | None,
         spaces: Sequence[ParameterSpace],
         sumstat_spec: SumStatSpec,
         model_names: Sequence[str] | None = None,
@@ -59,7 +59,8 @@ class Population:
     ):
         n = len(ms)
         assert thetas.shape[0] == n and weights.shape[0] == n
-        assert distances.shape[0] == n and sumstats.shape[0] == n
+        assert distances.shape[0] == n
+        assert sumstats is None or sumstats.shape[0] == n
         self.ms = np.asarray(ms, dtype=np.int32)
         self.thetas = np.asarray(thetas, dtype=np.float64)
         w = np.asarray(weights, dtype=np.float64)
@@ -68,7 +69,12 @@ class Population:
             raise ValueError(f"population total weight invalid: {total}")
         self.weights = w / total
         self.distances = np.asarray(distances, dtype=np.float64)
-        self.sumstats = np.asarray(sumstats, dtype=np.float64)
+        #: None when the sampler skipped the sumstat fetch
+        #: (History.store_sum_stats turned it off for this generation)
+        self.sumstats = (
+            np.asarray(sumstats, dtype=np.float64)
+            if sumstats is not None else None
+        )
         self.spaces = list(spaces)
         self.sumstat_spec = sumstat_spec
         self.model_names = (

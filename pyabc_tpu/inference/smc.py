@@ -230,14 +230,22 @@ class ABCSMC:
     # ------------------------------------------------------------ lifecycle
     def new(self, db: str, observed_sum_stat: dict | None = None, *,
             gt_model: int | None = None, gt_par: dict | None = None,
-            meta_info: dict | None = None) -> History:
-        """Open a new run in ``db``; store observed data (reference .new)."""
+            meta_info: dict | None = None,
+            store_sum_stats: bool | int = True) -> History:
+        """Open a new run in ``db``; store observed data (reference .new).
+
+        ``store_sum_stats``: per-particle sumstat retention (True = every
+        generation; False = never; int k = every k-th generation). On the
+        fused device path, skipped generations avoid the sumstat
+        device->host fetch entirely — the dominant share of the per-chunk
+        transfer payload.
+        """
         observed = {
             k: np.asarray(v) for k, v in (observed_sum_stat or {}).items()
         }
         self.x_0 = observed
         self.spec = SumStatSpec(observed) if observed else None
-        self.history = History(db)
+        self.history = History(db, store_sum_stats=store_sum_stats)
         options = dict(meta_info or {})
         options["parameter_names"] = {
             m: list(p.space.names)
@@ -259,6 +267,43 @@ class ABCSMC:
         self.x_0 = {k: np.asarray(v) for k, v in observed.items()}
         self.spec = SumStatSpec(self.x_0)
         return self.history
+
+    def adopt_device_context(self, other: "ABCSMC") -> None:
+        """Share another run's compiled device kernels.
+
+        For repeated runs of the SAME statistical configuration (same
+        models, priors, observed data shape, distance/acceptor/transition
+        types), the jitted generation kernels are identical programs;
+        adopting the previous run's ``DeviceContext`` skips re-trace and
+        re-compile entirely (used by ``bench.py`` to spend its budget on
+        steady-state windows instead of compiles).
+        """
+        import copy
+
+        ctx = other._device_ctx
+        if ctx is None:
+            return
+        if not self._device_capable or self.spec is None:
+            raise RuntimeError("this run is not device-capable")
+        if self.spec.total_size != ctx.spec.total_size or self.K != ctx.K:
+            raise ValueError("incompatible configuration for kernel reuse")
+        x0_new = np.asarray(self.spec.flatten(self.x_0), np.float32)
+        if not np.array_equal(x0_new, np.asarray(ctx.x0)):
+            raise ValueError(
+                "observed data differs: kernels close over x_0; reuse "
+                "requires identical observations"
+            )
+        # Rebind the context's component references to THIS run's instances
+        # (shallow copy shares the compiled-kernel cache): device kernels
+        # take all per-generation state (distance weights, pdf norms,
+        # epsilon) as ARRAY ARGUMENTS, so compiled programs stay valid, but
+        # build_dyn_args reads params off ctx.distance/ctx.acceptor — left
+        # pointing at the donor they would leak its fully-adapted state
+        # into this run's calibration and generation 0.
+        ctx = copy.copy(ctx)
+        ctx.distance = self.distance_function
+        ctx.acceptor = self.acceptor
+        self._device_ctx = ctx
 
     # ------------------------------------------------------------ internals
     def _build_device_ctx(self) -> DeviceContext | None:
@@ -926,7 +971,25 @@ class ABCSMC:
                 _dispatch_chunk(res["carry"], t + g_limit, g_next)
                 if g_next > 0 else None
             )
-            fetched = jax.device_get(res["outs"])
+            outs = res["outs"]
+            # per-particle sum stats dominate the chunk fetch payload
+            # (~70%); when the History doesn't retain them for a generation
+            # the row never leaves the device
+            ss_wanted = [self.history.wants_sum_stats(t + g)
+                         for g in range(g_limit)]
+            if all(ss_wanted):
+                fetched = jax.device_get(outs)
+                ss_rows = None
+            else:
+                # single batched transfer: everything but the sumstat block,
+                # plus only the retained generations' sumstat rows
+                tree = {k: v for k, v in outs.items() if k != "sumstats"}
+                tree["__ss_rows__"] = {
+                    g: outs["sumstats"][g]
+                    for g in range(g_limit) if ss_wanted[g]
+                }
+                fetched = jax.device_get(tree)
+                ss_rows = fetched.pop("__ss_rows__")
             now = time.time()
             chunk_s = now - t_chunk0  # pipeline period: fetch-to-fetch
             t_chunk0 = now
@@ -948,6 +1011,12 @@ class ABCSMC:
                 weights = exp_normalize_log_weights(
                     fetched["log_weight"][g][:n]
                 )
+                if ss_rows is None:
+                    ss_g = np.asarray(fetched["sumstats"][g][:n], np.float64)
+                elif g in ss_rows:
+                    ss_g = np.asarray(ss_rows[g][:n], np.float64)
+                else:
+                    ss_g = None
                 sample = Sample()
                 sample.set_accepted(
                     ms=fetched["m"][g][:n],
@@ -955,8 +1024,7 @@ class ABCSMC:
                     weights=weights,
                     distances=np.asarray(fetched["distance"][g][:n],
                                          np.float64),
-                    sumstats=np.asarray(fetched["sumstats"][g][:n],
-                                        np.float64),
+                    sumstats=ss_g,
                     proposal_ids=fetched["slot"][g][:n],
                 )
                 pop = self._sample_to_population(sample)

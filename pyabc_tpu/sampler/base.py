@@ -114,7 +114,10 @@ class Sample:
         self.thetas = np.asarray(thetas)[order]
         self.weights = np.asarray(weights)[order]
         self.distances = np.asarray(distances)[order]
-        self.sumstats = np.asarray(sumstats)[order]
+        # None: the fetch skipped sum stats (History.store_sum_stats off)
+        self.sumstats = (
+            np.asarray(sumstats)[order] if sumstats is not None else None
+        )
         self.proposal_ids = np.asarray(proposal_ids)[order]
 
     def trim(self, n: int) -> None:
@@ -123,7 +126,9 @@ class Sample:
             return
         for name in ("ms", "thetas", "weights", "distances", "sumstats",
                      "proposal_ids"):
-            setattr(self, name, getattr(self, name)[:n])
+            v = getattr(self, name)
+            if v is not None:
+                setattr(self, name, v[:n])
 
     def set_all_records(self, *, sumstats, distances, accepted) -> None:
         if not self.record_rejected:

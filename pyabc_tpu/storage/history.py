@@ -177,10 +177,19 @@ class History:
     :meth:`done` flushes, so post-run reads always see every generation.
     """
 
-    def __init__(self, db: str, _id: int | None = None):
+    def __init__(self, db: str, _id: int | None = None,
+                 store_sum_stats: bool | int = True):
         import threading
 
         self.db = db
+        #: per-particle summary-statistic retention policy: ``True`` stores
+        #: every generation (reference behavior), ``False`` stores none, an
+        #: int k stores every k-th generation (t % k == 0). Skipping sum
+        #: stats cuts the device->host fetch and the db size by ~10x per
+        #: generation; the trade-off is that sumstat-based analysis
+        #: (get_weighted_sum_stats, KDE-on-stats plots) and adaptive-distance
+        #: resume only work for stored generations.
+        self.store_sum_stats = store_sum_stats
         # check_same_thread=False: the async writer thread shares this
         # connection; sqlite serialized mode + self._lock make it safe
         self._conn = sqlite3.connect(_db_path(db), check_same_thread=False)
@@ -214,6 +223,16 @@ class History:
     def flush(self) -> None:
         if self._writer is not None:
             self._writer.flush()
+
+    def wants_sum_stats(self, t: int) -> bool:
+        """Whether generation t's per-particle sum stats should be stored
+        (see ``store_sum_stats``)."""
+        if self.store_sum_stats is True:
+            return True
+        if self.store_sum_stats is False:
+            return False
+        k = int(self.store_sum_stats)
+        return k > 0 and t % k == 0
 
     def _latest_id(self) -> int | None:
         row = self._conn.execute("SELECT MAX(id) FROM abc_smc").fetchone()
@@ -351,12 +370,13 @@ class History:
                  for nm, v in zip(space.names,
                                   population.thetas[i, : space.dim])],
             )
-            cur.executemany(
-                "INSERT INTO samples (particle_id, name, value) "
-                "VALUES (?,?,?)",
-                [(pid, "__flat__", np_to_bytes(population.sumstats[i]))
-                 for pid, i in zip(pids, idxs)],
-            )
+            if population.sumstats is not None and self.wants_sum_stats(t):
+                cur.executemany(
+                    "INSERT INTO samples (particle_id, name, value) "
+                    "VALUES (?,?,?)",
+                    [(pid, "__flat__", np_to_bytes(population.sumstats[i]))
+                     for pid, i in zip(pids, idxs)],
+                )
         self._conn.commit()
 
     def update_telemetry(self, t: int, telemetry: dict) -> None:
@@ -552,6 +572,16 @@ class History:
             """,
             self._conn, params=(pop_id,),
         )
+        if len(df) == 0:
+            # populations always have particles, so an empty join means the
+            # sum stats were skipped at write time (store_sum_stats policy —
+            # possibly of the History instance that WROTE the run; the
+            # policy is not persisted in the db)
+            raise ValueError(
+                f"no sum stats stored for generation {t}: the run was "
+                f"written with store_sum_stats disabled for this generation "
+                f"(this handle has store_sum_stats={self.store_sum_stats!r})"
+            )
         weights = np.asarray(df["w"], np.float64)
         stats = np.stack([np_from_bytes(b) for b in df["blob"]])
         return weights, stats

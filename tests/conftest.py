@@ -24,6 +24,20 @@ try:
 except RuntimeError:  # pragma: no cover - no cpu platform registered
     pass
 
+# Persistent compilation cache: the fused multi-generation programs cost
+# ~15-23 s of XLA compile each on CPU; cache them across test runs so the
+# suite pays that tax once per machine, not once per run. Set via the env
+# var (not jax.config) so subprocess-based tests (examples, graft-entry
+# dryrun, multihost workers) inherit it.
+_cache_dir = os.environ.get(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.expanduser("~"), ".cache", "pyabc_tpu_xla_cache"),
+)
+os.makedirs(_cache_dir, exist_ok=True)
+os.environ["JAX_COMPILATION_CACHE_DIR"] = _cache_dir
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 
 @pytest.fixture
 def rng():

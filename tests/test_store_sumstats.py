@@ -1,0 +1,111 @@
+"""Sum-stat retention policy (History.store_sum_stats) + kernel adoption.
+
+``store_sum_stats=False`` / ``=k`` lets the History skip per-particle
+summary statistics — on the fused device path the skipped generations avoid
+the sumstat device->host fetch entirely (the dominant share of the chunk
+payload). Parameters, weights and distances must be byte-identical to a
+full-retention run of the same seed. ``ABCSMC.adopt_device_context`` reuses
+a previous run's compiled kernels for repeated identical configurations
+(bench.py's budget-spending loop).
+"""
+import jax
+import numpy as np
+import pytest
+
+import pyabc_tpu as pt
+
+NOISE_SD = 0.5
+X_OBS = 1.0
+
+
+def _gauss_model():
+    @pt.JaxModel.from_function(["theta"], name="gauss")
+    def model(key, theta):
+        return {"x": theta[0] + NOISE_SD * jax.random.normal(key)}
+
+    return model
+
+
+def _abc(seed=7, fused_generations=3, pop=200):
+    prior = pt.Distribution(theta=pt.RV("norm", 0.0, 1.0))
+    return pt.ABCSMC(
+        _gauss_model(), prior, pt.AdaptivePNormDistance(p=2),
+        population_size=pop, eps=pt.MedianEpsilon(), seed=seed,
+        fused_generations=fused_generations,
+    )
+
+
+def test_store_sum_stats_false_identical_posterior():
+    abc_full = _abc()
+    abc_full.new("sqlite://", {"x": X_OBS})
+    h_full = abc_full.run(max_nr_populations=5)
+
+    abc_off = _abc()
+    abc_off.new("sqlite://", {"x": X_OBS}, store_sum_stats=False)
+    h_off = abc_off.run(max_nr_populations=5)
+
+    assert h_off.n_populations == h_full.n_populations
+    for t in range(h_full.n_populations):
+        df_f, w_f = h_full.get_distribution(m=0, t=t)
+        df_o, w_o = h_off.get_distribution(m=0, t=t)
+        np.testing.assert_array_equal(df_f["theta"], df_o["theta"])
+        np.testing.assert_array_equal(w_f, w_o)
+        wd_f = h_full.get_weighted_distances(t)
+        wd_o = h_off.get_weighted_distances(t)
+        np.testing.assert_array_equal(wd_f["distance"], wd_o["distance"])
+    # full run has stats; the off run raises a clear error
+    _, stats = h_full.get_weighted_sum_stats(1)
+    assert stats.shape[0] == 200
+    with pytest.raises(ValueError, match="store_sum_stats"):
+        h_off.get_weighted_sum_stats(1)
+
+
+def test_store_sum_stats_every_k():
+    abc = _abc()
+    abc.new("sqlite://", {"x": X_OBS}, store_sum_stats=2)
+    h = abc.run(max_nr_populations=5)
+    assert h.n_populations >= 4
+    for t in range(h.n_populations):
+        if t % 2 == 0:
+            _, stats = h.get_weighted_sum_stats(t)
+            assert stats.shape[0] == 200
+        else:
+            with pytest.raises(ValueError, match="store_sum_stats"):
+                h.get_weighted_sum_stats(t)
+
+
+def test_adopt_device_context_identical_results():
+    # donor run with a DIFFERENT seed: its adaptive distance ends fully
+    # adapted, and that state must NOT leak into the adopting run (the
+    # context is rebound to the adopter's own components)
+    donor = _abc(seed=11)
+    donor.new("sqlite://", {"x": X_OBS})
+    donor.run(max_nr_populations=4)
+
+    ref = _abc(seed=3)
+    ref.new("sqlite://", {"x": X_OBS})
+    h1 = ref.run(max_nr_populations=4)
+
+    abc2 = _abc(seed=3)
+    abc2.new("sqlite://", {"x": X_OBS})
+    abc2.adopt_device_context(donor)
+    assert abc2._device_ctx._kernels is donor._device_ctx._kernels
+    assert abc2._device_ctx.distance is abc2.distance_function
+    h2 = abc2.run(max_nr_populations=4)
+
+    assert h2.n_populations == h1.n_populations
+    for t in range(h1.n_populations):
+        df1, w1 = h1.get_distribution(m=0, t=t)
+        df2, w2 = h2.get_distribution(m=0, t=t)
+        np.testing.assert_array_equal(df1["theta"], df2["theta"])
+        np.testing.assert_array_equal(w1, w2)
+
+
+def test_adopt_device_context_rejects_different_obs():
+    abc1 = _abc(seed=3)
+    abc1.new("sqlite://", {"x": X_OBS})
+    abc1.run(max_nr_populations=2)
+    abc2 = _abc(seed=3)
+    abc2.new("sqlite://", {"x": X_OBS + 1.0})
+    with pytest.raises(ValueError, match="observed data"):
+        abc2.adopt_device_context(abc1)
