@@ -34,6 +34,11 @@ class Particle:
     distance: float
     accepted: bool = True
     preliminary: bool = False
+    #: density of (m, parameter) under the proposal it was drawn from
+    #: (prior at t=0, transition mixture at t>0); recorded for the
+    #: AcceptanceRateScheme's record reweighting (reference
+    #: transition_pd_prev) — NaN when not recorded
+    proposal_pd: float = float("nan")
 
 
 class Population:

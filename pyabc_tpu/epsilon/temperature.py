@@ -46,20 +46,20 @@ class AcceptanceRateScheme(TemperatureScheme):
     """Choose T so the *predicted* acceptance rate hits ``target_rate``
     (reference AcceptanceRateScheme).
 
-    The prediction model: mean over kernel values v_i of
+    The prediction model: weighted mean over kernel values v_i of
     min(1, exp((v_i - pdf_norm)/T)); bisection on log10(T). Prefers the
     ALL-simulations record (accepted + rejected); falls back to the
     importance-weighted accepted set.
 
-    One-generation-lag approximation (deviation from the reference): the
-    records are distributed under generation t's *proposal*, while the rate
-    being predicted is under generation t+1's proposal. The reference
-    importance-reweights records by transition_pd / transition_pd_prev to
-    correct for the shift; here the records are treated as an unweighted
-    sample of the next proposal, which is biased when the proposal moves
-    appreciably between generations (it usually moves slowly once the
-    population has localized). The Temperature wrapper's min-over-schemes +
-    monotone max-decay guard bounds the impact.
+    Record reweighting (reference semantics): the records are distributed
+    under generation t's *proposal*, while the rate being predicted is
+    under generation t+1's proposal. When the record carries
+    ``transition_pd_prev`` (density under the proposal it was drawn from)
+    and ``transition_pd`` (density under the NEXT proposal, computed after
+    the transition refit), each record is importance-reweighted by
+    transition_pd / transition_pd_prev — correcting for the proposal shift
+    between generations. Records without the columns fall back to uniform
+    weights (one-generation-lag approximation).
     """
 
     def __init__(self, target_rate: float = 0.3):
@@ -81,7 +81,17 @@ class AcceptanceRateScheme(TemperatureScheme):
         vals = np.asarray(df["distance"], np.float64)
         if kernel_scale == "SCALE_LIN":
             vals = np.log(np.maximum(vals, 1e-300))
-        w = np.asarray(df["w"], np.float64) if "w" in df else np.ones_like(vals)
+        if "transition_pd_prev" in df and "transition_pd" in df:
+            pd_prev = np.asarray(df["transition_pd_prev"], np.float64)
+            pd_new = np.asarray(df["transition_pd"], np.float64)
+            ok = np.isfinite(pd_prev) & (pd_prev > 0) & np.isfinite(pd_new)
+            w = np.where(ok, pd_new / np.where(ok, pd_prev, 1.0), 0.0)
+            if w.sum() <= 0:
+                w = np.ones_like(vals)
+        elif "w" in df:
+            w = np.asarray(df["w"], np.float64)
+        else:
+            w = np.ones_like(vals)
         w = w / w.sum()
         diff = vals - pdf_norm  # <= 0 typically
 
@@ -285,8 +295,11 @@ class Temperature(Epsilon):
         return True
 
     def configure_sampler(self, sampler):
-        # acceptance-rate prediction wants all simulations, incl. rejected
+        # acceptance-rate prediction wants all simulations, incl. rejected,
+        # with the proposal identity/density per record so the prediction
+        # can be importance-reweighted to the next generation's proposal
         sampler.sample_factory.record_rejected = True
+        sampler.sample_factory.record_proposal_info = True
 
     def _effective_schemes(self) -> list[TemperatureScheme]:
         if self.schemes is not None:

@@ -370,6 +370,13 @@ class ABCSMC:
             eps=self.eps,
             acceptor=self.acceptor,
             evaluate=not calibration,
+            # record_proposal_info (set by Temperature), NOT record_rejected:
+            # adaptive-distance runs record rejected sumstats but have no
+            # use for an extra per-simulation transition-pdf evaluation
+            record_proposal_pd=(
+                self.sampler.sample_factory.record_rejected
+                and self.sampler.sample_factory.record_proposal_info
+            ),
         )
         return GenerationSpec(
             t=t, host_simulate_one=host, device=device, mode=mode, dyn=dyn,
@@ -398,25 +405,73 @@ class ABCSMC:
         return pop
 
     def _all_records_provider(self, sample) -> Callable:
-        """() -> DataFrame['distance','accepted'] over ALL recorded
-        simulations (proposal-distributed; used by AcceptanceRateScheme),
-        or None when rejected records were not kept."""
+        """() -> DataFrame['distance','accepted'(,'transition_pd_prev',
+        'transition_pd')] over ALL recorded simulations
+        (proposal-distributed; used by AcceptanceRateScheme), or None when
+        rejected records were not kept.
+
+        The two transition-density columns carry the reference's record
+        reweighting: records were drawn under generation t's proposal
+        (``transition_pd_prev``, recorded at simulation time) while the
+        scheme predicts acceptance under generation t+1's proposal
+        (``transition_pd``, computed HERE — the provider runs inside
+        eps.update, after the transitions were refit on population t)."""
         def provider():
             import pandas as pd
 
             if sample.all_distances is not None:
-                return pd.DataFrame({
+                df = pd.DataFrame({
                     "distance": sample.all_distances,
                     "accepted": sample.all_accepted,
                 })
+                if getattr(sample, "all_proposal_pds", None) is not None:
+                    df["transition_pd_prev"] = sample.all_proposal_pds
+                    df["transition_pd"] = self._proposal_pds_now(
+                        sample.all_ms, sample.all_thetas
+                    )
+                return df
             host = getattr(sample, "host_all_records", None)
             if host is not None:
-                return pd.DataFrame({
-                    "distance": host[1], "accepted": host[2],
+                df = pd.DataFrame({
+                    "distance": host.distances, "accepted": host.accepted,
                 })
+                if (host.proposal_pds is not None
+                        and np.isfinite(host.proposal_pds).all()):
+                    df["transition_pd_prev"] = host.proposal_pds
+                    df["transition_pd"] = self._proposal_pds_now(
+                        host.ms, host.parameters
+                    )
+                return df
             return None
 
         return provider
+
+    def _proposal_pds_now(self, ms, thetas) -> np.ndarray:
+        """Density of recorded (m, theta) under the CURRENT (just-refit)
+        proposal — the reference's record ``transition_pd``. ``thetas`` is
+        either a list of Parameter dicts or an (n, d) array in the fitted
+        column order."""
+        import pandas as pd
+
+        ms = np.asarray(ms, np.int64)
+        out = np.zeros(len(ms), np.float64)
+        for m in np.unique(ms):
+            tr = self.transitions[m]
+            model_factor = sum(
+                p * self.model_perturbation_kernel.pmf(int(m), int(anc))
+                for anc, p in self._model_probs.items()
+            )
+            mask = ms == m
+            if model_factor <= 0 or tr.X is None:
+                continue
+            cols = list(tr.X.columns)
+            if isinstance(thetas, np.ndarray):
+                df = pd.DataFrame(thetas[mask][:, : len(cols)], columns=cols)
+            else:
+                idx = np.flatnonzero(mask)
+                df = pd.DataFrame([dict(thetas[i]) for i in idx])[cols]
+            out[mask] = model_factor * np.asarray(tr.pdf(df), np.float64)
+        return out
 
     def _all_sumstats_provider(self, sample) -> Callable:
         """() -> (n, S) matrix of all recorded sum stats for adaptive comps."""
@@ -428,10 +483,10 @@ class ABCSMC:
             if sample.all_sumstats is not None:
                 return sample.all_sumstats
             if getattr(sample, "host_all_records", None) is not None:
-                ss_dicts, _, _ = sample.host_all_records
-                return np.stack(
-                    [np.asarray(self.spec.flatten(s)) for s in ss_dicts]
-                )
+                return np.stack([
+                    np.asarray(self.spec.flatten(s))
+                    for s in sample.host_all_records.sum_stats
+                ])
             if sample.sumstats is not None:
                 return sample.sumstats
             return np.stack([
@@ -710,6 +765,8 @@ class ABCSMC:
             return False  # multi-host barrier runs per generation
         if not isinstance(self.population_strategy, ConstantPopulationSize):
             return False
+        if type(self.acceptor) is StochasticAcceptor:
+            return self._fused_stochastic_capable()
         if type(self.acceptor) is not UniformAcceptor \
                 or self.acceptor.use_complete_history:
             return False
@@ -754,6 +811,95 @@ class ABCSMC:
         else:
             return False
         return True
+
+    #: temperature schemes with device twins (DeviceContext.
+    #: _stochastic_gen_update); Daly (stateful contraction) and Ess fall
+    #: back to the per-generation loop
+    _DEVICE_TEMP_SCHEMES = {
+        "AcceptanceRateScheme", "ExpDecayFixedIterScheme",
+        "ExpDecayFixedRatioScheme", "PolynomialDecayFixedIterScheme",
+        "FrielPettittScheme",
+    }
+
+    def _fused_stochastic_capable(self) -> bool:
+        """Noisy-ABC configs the multigen kernel can chain on device:
+        single model, max-found pdf norm, Temperature with min-aggregated
+        monotone schemes from the device-twin set, device-compatible
+        stochastic kernel distance (static params)."""
+        from ..acceptor.pdf_norm import pdf_norm_max_found
+        from ..epsilon import Temperature
+
+        if self.K != 1:
+            return False
+        a = self.acceptor
+        if a.pdf_norm_method is not pdf_norm_max_found or a.log_file:
+            return False
+        eps = self.eps
+        if type(eps) is not Temperature:
+            return False
+        if eps.aggregate_fun is not min or not eps.enforce_less_equal_prev \
+                or eps.log_file:
+            return False
+        need_horizon = {"ExpDecayFixedIterScheme",
+                        "PolynomialDecayFixedIterScheme",
+                        "FrielPettittScheme"}
+        for sch in eps._effective_schemes():
+            name = type(sch).__name__
+            if name not in self._DEVICE_TEMP_SCHEMES:
+                return False
+            if name in need_horizon and eps._max_nr_populations is None:
+                return False
+        d = self.distance_function
+        if not isinstance(d, StochasticKernel) or not d.is_device_compatible():
+            return False
+        tr = self.transitions[0]
+        from ..transition.util import (
+            scott_rule_of_thumb,
+            silverman_rule_of_thumb,
+        )
+
+        if type(tr) is not MultivariateNormalTransition:
+            return False
+        if tr.bandwidth_selector not in (scott_rule_of_thumb,
+                                         silverman_rule_of_thumb):
+            return False
+        if type(self.model_perturbation_kernel) is not ModelPerturbationKernel:
+            return False
+        if np.isfinite(self.max_nr_recorded_particles):
+            return False
+        return True
+
+    def _temp_config(self) -> tuple:
+        """Static scheme descriptor tuple for the device temperature twin."""
+        from ..distance.kernel import SCALE_LIN
+
+        eps = self.eps
+        schemes = []
+        for sch in eps._effective_schemes():
+            name = type(sch).__name__
+            if name == "AcceptanceRateScheme":
+                schemes.append(("acceptance_rate", float(sch.target_rate)))
+            elif name == "ExpDecayFixedIterScheme":
+                schemes.append(("exp_decay_fixed_iter",))
+            elif name == "ExpDecayFixedRatioScheme":
+                schemes.append(("exp_decay_fixed_ratio", float(sch.alpha),
+                                float(sch.min_rate), float(sch.max_rate)))
+            elif name == "PolynomialDecayFixedIterScheme":
+                schemes.append(("poly_decay_fixed_iter",
+                                float(sch.exponent)))
+            elif name == "FrielPettittScheme":
+                schemes.append(("friel_pettitt",))
+        max_np = (int(eps._max_nr_populations)
+                  if eps._max_nr_populations is not None else -1)
+        kernel = self.distance_function
+        pdf_max = kernel.pdf_max
+        lin = kernel.ret_scale == SCALE_LIN
+        if pdf_max is not None:
+            pdf_max = float(np.log(max(pdf_max, 1e-300))) if lin \
+                else float(pdf_max)
+            if not np.isfinite(pdf_max):
+                pdf_max = None
+        return (tuple(schemes), max_np, pdf_max, lin)
 
     def _loop_fused(self, t0, minimum_epsilon, max_nr_populations,
                     min_acceptance_rate, max_total_nr_simulations,
@@ -831,11 +977,12 @@ class ABCSMC:
 
         ctx = self._build_device_ctx()
         tr = self.transitions[0]
+        stochastic = type(self.acceptor) is StochasticAcceptor
         eps_quantile = isinstance(self.eps, QuantileEpsilon)
         adaptive = (isinstance(self.distance_function, AdaptivePNormDistance)
                     and self.distance_function.adaptive)
         n_cap = _pow2(n, 64)
-        rec_cap = _pow2(8 * n_cap, 256) if adaptive else 1
+        rec_cap = _pow2(8 * n_cap, 256) if (adaptive or stochastic) else 1
         B = self.sampler._pick_B(n)
         max_rounds = self.sampler.max_rounds
         if min_acceptance_rate > 0:
@@ -853,6 +1000,8 @@ class ABCSMC:
             trans_cls=type(tr), scaling=tr.scaling,
             bandwidth_selector=tr.bandwidth_selector,
             dims=tuple(p.space.dim for p in self.parameter_priors),
+            stochastic=stochastic,
+            temp_config=self._temp_config() if stochastic else None,
         )
 
         def _g_limit(t_at: int) -> int:
@@ -869,7 +1018,7 @@ class ABCSMC:
             chaining device-to-device lets chunk k+1 compute while chunk
             k's outputs are still being fetched/persisted."""
             eps_fixed = np.zeros(G, np.float32)
-            if not eps_quantile:
+            if not eps_quantile and not stochastic:
                 for g in range(g_limit):
                     eps_fixed[g] = self.eps(t_at + g)
             return kern(
@@ -908,12 +1057,29 @@ class ABCSMC:
             probs0[int(m)] = p
         with np.errstate(divide="ignore"):
             log_probs0 = np.log(probs0)
-        dist_w0 = jnp.asarray(
-            np.asarray(self.distance_function.device_params(t), np.float32)
+        # pytree-generic: stochastic kernels may carry structured params
+        dist_w0 = jax.tree.map(
+            lambda v: jnp.asarray(np.asarray(v, np.float32)),
+            self.distance_function.device_params(t),
         )
+        if stochastic:
+            # seed the device pdf-norm recursion from the host acceptor's
+            # state for generation t (calibration + generations < t)
+            acc_state0 = (
+                jnp.asarray(self.acceptor.pdf_norms.get(t, 0.0),
+                            jnp.float32),
+                jnp.asarray(
+                    self.acceptor._max_found
+                    if np.isfinite(self.acceptor._max_found) else -1e30,
+                    jnp.float32),
+            )
+        else:
+            acc_state0 = (jnp.zeros((), jnp.float32),
+                          jnp.asarray(-1e30, jnp.float32))
         carry0 = (tuple(trans0), jnp.asarray(log_probs0, jnp.float32),
                   jnp.asarray(fitted0), dist_w0,
                   jnp.asarray(self.eps(t), jnp.float32),
+                  acc_state0,
                   jnp.asarray(False))
 
         g_limit = _g_limit(t)
@@ -929,7 +1095,7 @@ class ABCSMC:
                 t, g_limit, n, carry0, _g_limit, _dispatch_chunk,
                 minimum_epsilon, max_nr_populations, min_acceptance_rate,
                 max_total_nr_simulations, max_walltime, start_walltime,
-                sims_total, eps_quantile, adaptive,
+                sims_total, eps_quantile, adaptive, stochastic,
             )
         except BaseException:
             # drain queued generations before propagating — a mid-loop
@@ -951,7 +1117,7 @@ class ABCSMC:
                           max_nr_populations, min_acceptance_rate,
                           max_total_nr_simulations, max_walltime,
                           start_walltime, sims_total, eps_quantile,
-                          adaptive) -> History:
+                          adaptive, stochastic=False) -> History:
         import jax
 
         from ..sampler.base import Sample, exp_normalize_log_weights
@@ -1055,6 +1221,20 @@ class ABCSMC:
                 # resume / further chunks / telemetry are consistent
                 if eps_quantile:
                     self.eps._values[t + 1] = float(fetched["eps_next"][g])
+                if stochastic:
+                    # mirror the device temperature / pdf-norm recursions
+                    # into the host objects (resume, config, telemetry)
+                    self.eps.temperatures[t + 1] = float(
+                        fetched["eps_next"][g]
+                    )
+                    self.acceptor.pdf_norms[t + 1] = float(
+                        fetched["pdf_norm_next"][g]
+                    )
+                    mf = float(fetched["max_found_next"][g])
+                    if mf > -1e29:
+                        self.acceptor._max_found = max(
+                            self.acceptor._max_found, mf
+                        )
                 if adaptive:
                     self.distance_function.weights[t + 1] = np.asarray(
                         fetched["dist_w_next"][g], np.float64

@@ -131,12 +131,17 @@ def create_simulate_function(t, *, model_probabilities,
                              parameter_priors, models,
                              summary_statistics, x_0, distance_function,
                              eps, acceptor,
-                             evaluate: bool = True) -> Callable[[], Particle]:
+                             evaluate: bool = True,
+                             record_proposal_pd: bool = False
+                             ) -> Callable[[], Particle]:
     """The reference's unit of distribution: a closure producing one Particle.
 
     With ``evaluate=False`` the particle is returned all-accepted without the
     accept test (calibration population, reference
-    ``only_simulate_data_for_proposal``).
+    ``only_simulate_data_for_proposal``). With ``record_proposal_pd``, every
+    particle carries the density of (m, theta) under the proposal it was
+    drawn from (reference ``transition_pd_prev``) so record-keeping samplers
+    can feed the AcceptanceRateScheme's importance reweighting.
     """
     prior_pdf = create_prior_pdf(model_prior_pmf, parameter_priors)
     transition_pdf = (
@@ -152,6 +157,13 @@ def create_simulate_function(t, *, model_probabilities,
         return float(
             acceptance_weight * prior_pdf(m, theta) / transition_pdf(m, theta)
         )
+
+    def proposal_pd(m, theta) -> float:
+        if not record_proposal_pd:
+            return float("nan")
+        if t == 0 or transition_pdf is None:
+            return float(prior_pdf(m, theta))
+        return float(transition_pdf(m, theta))
 
     def simulate_one() -> Particle:
         m, theta = generate_valid_proposal(
@@ -170,13 +182,14 @@ def create_simulate_function(t, *, model_probabilities,
             return Particle(
                 m=m, parameter=theta, weight=weight,
                 sum_stat=result.sum_stat, distance=float(result.distance),
-                accepted=accepted,
+                accepted=accepted, proposal_pd=proposal_pd(m, theta),
             )
         res = models[m].summary_statistics(t, theta, summary_statistics)
         d = distance_function(res.sum_stat, x_0, t, theta)
         return Particle(
             m=m, parameter=theta, weight=weight_function(m, theta, 1.0),
             sum_stat=res.sum_stat, distance=float(d), accepted=True,
+            proposal_pd=proposal_pd(m, theta),
         )
 
     return simulate_one
@@ -234,6 +247,8 @@ class RoundResult:
     accepted: np.ndarray
     valid: np.ndarray
     log_weights: np.ndarray
+    #: proposal log-density per lane (transition_pd_prev in log form)
+    logqs: np.ndarray | None = None
 
 
 class DeviceContext:
@@ -278,7 +293,7 @@ class DeviceContext:
         """One lane, generation 0: proposal from the prior."""
         km, kt, ksim, kacc = jax.random.split(key, 4)
         m = jax.random.categorical(km, self.model_prior_logits)
-        theta, ss = self._switch_sim_prior(m, kt, ksim)
+        theta, ss, logpri = self._switch_sim_prior(m, kt, ksim)
         d, accept, log_acc_w = self._accept_fn(
             kacc, ss, dyn["eps"], dyn["dist_params"], dyn["acc_params"]
         )
@@ -286,6 +301,9 @@ class DeviceContext:
             m=m, theta=theta, sumstats=ss, distance=d,
             accepted=accept, valid=jnp.asarray(True),
             log_weight=log_acc_w,
+            # proposal log-density (drawn from the prior): model prior x
+            # parameter prior — the record's transition_pd_prev in log form
+            logq=self.model_prior_logits[m] + logpri,
         )
 
     def _lane_calibration(self, key, dyn):
@@ -293,11 +311,12 @@ class DeviceContext:
         the distance may itself still need this sample to initialize)."""
         km, kt, ksim = jax.random.split(key, 3)
         m = jax.random.categorical(km, self.model_prior_logits)
-        theta, ss = self._switch_sim_prior(m, kt, ksim)
+        theta, ss, logpri = self._switch_sim_prior(m, kt, ksim)
         return dict(
             m=m, theta=theta, sumstats=ss,
             distance=jnp.zeros(()), accepted=jnp.asarray(True),
             valid=jnp.asarray(True), log_weight=jnp.zeros(()),
+            logq=self.model_prior_logits[m] + logpri,
         )
 
     def _switch_sim_prior(self, m, kt, ksim):
@@ -307,10 +326,11 @@ class DeviceContext:
 
             def branch(kt, ksim):
                 theta = prior.rvs_array(kt)
+                logpri = prior.logpdf_array(theta)
                 ss = self.spec.flatten(model.sim(ksim, theta))
                 pad = self.d_max - theta.shape[0]
                 theta = jnp.pad(theta, (0, pad)) if pad else theta
-                return theta, ss
+                return theta, ss, logpri
 
             return branch
 
@@ -344,6 +364,9 @@ class DeviceContext:
         return dict(
             m=m, theta=theta, sumstats=ss, distance=d, accepted=accept,
             valid=valid, log_weight=jnp.where(valid, log_w, -jnp.inf),
+            # full proposal log-density (model factor x particle kernel):
+            # the record's transition_pd_prev in log form
+            logq=dyn["log_model_factor"][m] + logq,
         )
 
     def _switch_propose_sim(self, m, kt, ksim, dyn):
@@ -425,7 +448,8 @@ class DeviceContext:
 
     # ---------------------------------------------------- fused generation
     def _generation_while(self, key, dyn, n_target, *, B, n_cap, rec_cap,
-                          max_rounds, run_lanes, all_accept=False):
+                          max_rounds, run_lanes, all_accept=False,
+                          record_proposal=False):
         """Traceable mask-and-refill loop for ONE generation.
 
         Proposes B-lane rounds until ``n_target`` acceptances (or the round
@@ -433,6 +457,11 @@ class DeviceContext:
         proposal order — the deterministic slot-ordered trim happens by
         construction. Shared by the single-generation kernel and the
         multi-generation scan. Returns (n_acc, rounds, n_valid, res, rec).
+
+        ``record_proposal`` extends the record ring with the proposal
+        identity (m, theta) and its log-density under the generation's
+        proposal (``logq``) — the AcceptanceRateScheme's record
+        reweighting needs them (reference transition_pd_prev).
         """
         d_max, S = self.d_max, self.spec.total_size
         res0 = {
@@ -449,6 +478,10 @@ class DeviceContext:
             "accepted": jnp.zeros((rec_cap,), bool),
             "valid": jnp.zeros((rec_cap,), bool),
         }
+        if record_proposal:
+            rec0["m"] = jnp.zeros((rec_cap,), jnp.int32)
+            rec0["theta"] = jnp.zeros((rec_cap, d_max), jnp.float32)
+            rec0["logq"] = jnp.zeros((rec_cap,), jnp.float32)
         state0 = (jnp.zeros((), jnp.int32),  # n_acc
                   jnp.zeros((), jnp.int32),  # round
                   jnp.zeros((), jnp.int32),  # n_valid (true model evals)
@@ -488,7 +521,7 @@ class DeviceContext:
             # record ring: first rec_cap evaluations, in slot order
             rec_pos = jnp.where(out["valid"] & (slots < rec_cap),
                                 slots, rec_cap)
-            rec = {
+            rec_next = {
                 "sumstats": rec["sumstats"].at[rec_pos].set(
                     out["sumstats"], mode="drop"),
                 "distance": rec["distance"].at[rec_pos].set(
@@ -498,6 +531,14 @@ class DeviceContext:
                 "valid": rec["valid"].at[rec_pos].set(
                     out["valid"], mode="drop"),
             }
+            if record_proposal:
+                rec_next["m"] = rec["m"].at[rec_pos].set(
+                    out["m"].astype(jnp.int32), mode="drop")
+                rec_next["theta"] = rec["theta"].at[rec_pos].set(
+                    out["theta"], mode="drop")
+                rec_next["logq"] = rec["logq"].at[rec_pos].set(
+                    out["logq"], mode="drop")
+            rec = rec_next
             return (n_acc + jnp.sum(acc, dtype=jnp.int32), r + 1,
                     n_valid + jnp.sum(out["valid"], dtype=jnp.int32),
                     res, rec)
@@ -505,7 +546,7 @@ class DeviceContext:
         return jax.lax.while_loop(cond, body, state0)
 
     def generation_kernel(self, B: int, mode: str, n_cap: int, rec_cap: int,
-                          max_rounds: int):
+                          max_rounds: int, record_proposal: bool = False):
         """One jitted program for a WHOLE generation: a ``lax.while_loop``
         keeps proposing B-lane rounds until n_cap acceptances (or the round
         budget), compacting accepted lanes into a fixed reservoir in
@@ -517,7 +558,8 @@ class DeviceContext:
         of the first rec_cap evaluations for the adaptive components
         (reference ``max_nr_rejected`` cap).
         """
-        cache_key = ("fused", B, mode, n_cap, rec_cap, max_rounds)
+        cache_key = ("fused", B, mode, n_cap, rec_cap, max_rounds,
+                     record_proposal)
         if cache_key in self._kernels:
             return self._kernels[cache_key]
 
@@ -547,7 +589,7 @@ class DeviceContext:
             n_acc, rounds, n_valid, res, rec = self._generation_while(
                 key, dyn, n_target, B=B, n_cap=n_cap, rec_cap=rec_cap,
                 max_rounds=max_rounds, run_lanes=run_lanes,
-                all_accept=all_accept,
+                all_accept=all_accept, record_proposal=record_proposal,
             )
             out = {"n_acc": n_acc, "rounds": rounds, "n_valid": n_valid,
                    **res,
@@ -555,6 +597,10 @@ class DeviceContext:
                    "rec_distance": rec["distance"],
                    "rec_accepted": rec["accepted"],
                    "rec_valid": rec["valid"]}
+            if record_proposal:
+                out["rec_m"] = rec["m"]
+                out["rec_theta"] = rec["theta"]
+                out["rec_logq"] = rec["logq"]
             # adaptive-distance scale reduction IN the kernel: over a TPU
             # tunnel every extra host sync costs ~10x the reduction itself,
             # so the (S,) scale ships with the main fetch instead of a
@@ -586,7 +632,8 @@ class DeviceContext:
 
     def dispatch_generation(self, key, B: int, mode: str, dyn: dict, *,
                             n_cap: int, rec_cap: int, max_rounds: int,
-                            n_target: int | None = None) -> dict:
+                            n_target: int | None = None,
+                            record_proposal: bool = False) -> dict:
         """Launch the fused generation kernel WITHOUT blocking: returns the
         dict of device arrays (jax dispatch is async — the host is free
         until someone calls device_get). This is the hook for
@@ -594,16 +641,19 @@ class DeviceContext:
         host while the device already runs generation t+1."""
         if n_target is None:
             n_target = n_cap
-        return self.generation_kernel(B, mode, n_cap, rec_cap, max_rounds)(
-            key, dyn, jnp.asarray(min(n_target, n_cap), jnp.int32)
-        )
+        return self.generation_kernel(
+            B, mode, n_cap, rec_cap, max_rounds,
+            record_proposal=record_proposal,
+        )(key, dyn, jnp.asarray(min(n_target, n_cap), jnp.int32))
 
     # ------------------------------------------- multi-generation device run
     def multigen_kernel(self, B: int, n_cap: int, rec_cap: int,
                         max_rounds: int, G: int, *, adaptive: bool,
                         eps_quantile: bool, eps_weighted: bool, alpha: float,
                         multiplier: float, trans_cls, scaling: float,
-                        bandwidth_selector, dims: tuple):
+                        bandwidth_selector, dims: tuple,
+                        stochastic: bool = False,
+                        temp_config: tuple | None = None):
         """One jitted program for G WHOLE GENERATIONS (transition mode).
 
         The TPU-native endgame of the reference's per-generation scatter/
@@ -627,13 +677,30 @@ class DeviceContext:
         within the round budget, hits ``min_eps``, or collapses below
         ``min_acc_rate`` marks the rest of the chunk skipped (lax.cond) and
         its outputs ``gen_ok=False`` for the host to discard.
+
+        Noisy ABC (``stochastic=True``, single model only): the acceptor is
+        a StochasticAcceptor and the epsilon a Temperature — the carry
+        additionally holds (pdf_norm, max_found) and the TEMPERATURE, all
+        updated on device each generation: pdf_norm via the reference
+        ``pdf_norm_max_found`` recursion over accepted kernel values, and
+        the temperature as the min over ``temp_config`` scheme twins
+        (AcceptanceRateScheme with the reference's record reweighting by
+        transition_pd/transition_pd_prev — the record ring keeps per-record
+        theta + proposal log-density, and the new proposal density is
+        evaluated against the JUST-REFIT transition — plus the
+        ExpDecay/PolynomialDecay/FrielPettitt ladders), with monotone decay
+        and the final-generation T=1 override (reference
+        ``pyabc/epsilon/temperature.py::Temperature._set`` semantics).
         """
         cache_key = ("multigen", B, n_cap, rec_cap, max_rounds, G, adaptive,
                      eps_quantile, eps_weighted, alpha, multiplier,
                      trans_cls.__name__, scaling,
-                     getattr(bandwidth_selector, "__name__", "?"), dims)
+                     getattr(bandwidth_selector, "__name__", "?"), dims,
+                     stochastic, temp_config)
         if cache_key in self._kernels:
             return self._kernels[cache_key]
+        if stochastic and self.K != 1:
+            raise ValueError("stochastic fused chunks support K=1 only")
 
         from ..ops.stats import normalize_log_weights, weighted_quantile
 
@@ -674,14 +741,18 @@ class DeviceContext:
 
             def gen_step(carry, g):
                 (trans_params, log_model_probs, fitted, dist_w, eps_carry,
-                 stopped) = carry
+                 acc_state, stopped) = carry
+                pdf_norm, max_found = acc_state
                 # g_limit (dynamic) caps the active generations so the LAST
                 # chunk of a run reuses the same compiled G-kernel instead
                 # of tracing a shorter scan (a ~20s compile per distinct G)
                 stopped = stopped | (g >= g_limit)
                 t = t0 + g
                 gen_key = jax.random.fold_in(root, t + 1)  # generation_key
-                eps_g = eps_carry if eps_quantile else eps_fixed[g]
+                if stochastic or eps_quantile:
+                    eps_g = eps_carry
+                else:
+                    eps_g = eps_fixed[g]
                 # mask & renormalize the model-perturbation matrix like the
                 # host build_dyn_args: never-fitted models cannot propose
                 matrix = mpk_base * fitted[None, :].astype(jnp.float32)
@@ -699,7 +770,7 @@ class DeviceContext:
                 dyn = {
                     "eps": eps_g,
                     "dist_params": dist_w,
-                    "acc_params": (),
+                    "acc_params": pdf_norm if stochastic else (),
                     "log_model_probs": log_model_probs,
                     "mpk_matrix": matrix,
                     "log_model_factor": log_model_factor,
@@ -710,7 +781,7 @@ class DeviceContext:
                     return self._generation_while(
                         gen_key, dyn, n_target, B=B, n_cap=n_cap,
                         rec_cap=rec_cap, max_rounds=max_rounds,
-                        run_lanes=run_lanes,
+                        run_lanes=run_lanes, record_proposal=stochastic,
                     )
 
                 def skip_gen(_):
@@ -730,6 +801,11 @@ class DeviceContext:
                         "accepted": jnp.zeros((rec_cap,), bool),
                         "valid": jnp.zeros((rec_cap,), bool),
                     }
+                    if stochastic:
+                        rec["m"] = jnp.zeros((rec_cap,), jnp.int32)
+                        rec["theta"] = jnp.zeros((rec_cap, self.d_max),
+                                                 jnp.float32)
+                        rec["logq"] = jnp.zeros((rec_cap,), jnp.float32)
                     return z32, z32, z32, res, rec
 
                 n_acc, rounds, n_valid, res, rec = jax.lax.cond(
@@ -795,6 +871,16 @@ class DeviceContext:
                     for m in range(K)
                 )
                 acc_rate = n_acc / jnp.maximum(n_valid, 1)
+
+                if stochastic:
+                    (eps_next, acc_state_next, temp_extra
+                     ) = self._stochastic_gen_update(
+                        temp_config, trans_cls, trans_next, rec, res, k_mask,
+                        pdf_norm, max_found, eps_carry, acc_rate, t,
+                    )
+                else:
+                    acc_state_next, temp_extra = (pdf_norm, max_found), {}
+
                 stopped_next = (
                     stopped | ~gen_ok | (eps_g <= min_eps)
                     | (acc_rate < min_acc_rate)
@@ -805,9 +891,11 @@ class DeviceContext:
                     "dist_w_next": dist_w_next, "n_acc": n_acc,
                     "rounds": rounds, "n_valid": n_valid, "gen_ok": gen_ok,
                     "model_probs": model_probs_next,
+                    **temp_extra,
                 }
                 return (trans_next, log_model_probs_next, fitted_next,
-                        dist_w_next, eps_next, stopped_next), out
+                        dist_w_next, eps_next, acc_state_next,
+                        stopped_next), out
 
             final_carry, outs = jax.lax.scan(gen_step, carry0, jnp.arange(G))
             # the final carry is returned ON DEVICE so the host can chain
@@ -820,6 +908,112 @@ class DeviceContext:
         fn = jax.jit(multigen_fn)
         self._kernels[cache_key] = fn
         return fn
+
+    def _stochastic_gen_update(self, temp_config, trans_cls, trans_next,
+                               rec, res, k_mask, pdf_norm, max_found,
+                               temp, acc_rate, t):
+        """Traceable per-generation noisy-ABC adaptation (K=1).
+
+        Twin of the host pair ``StochasticAcceptor._update_norm`` (pdf_norm
+        via the pdf_norm_max_found recursion over accepted kernel values)
+        and ``Temperature._set`` (min over scheme proposals, monotone decay,
+        final-generation T=1). The AcceptanceRateScheme twin carries the
+        reference record reweighting: each record in the ring was drawn
+        with proposal log-density ``rec['logq']``; its density under the
+        NEXT generation's proposal is evaluated against the just-refit
+        transition params — weights transition_pd / transition_pd_prev
+        (SURVEY.md §2.2 Temperature row).
+
+        Returns (eps_next, (pdf_norm_next, max_found_next), extra_outputs).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        schemes, max_np, pdf_max_s, lin_scale = temp_config
+        # pdf_norm update from ACCEPTED kernel values (host semantics:
+        # acceptor.update reads the weighted accepted distances)
+        v_acc = res["distance"]
+        logv_acc = (jnp.log(jnp.maximum(v_acc, 1e-30)) if lin_scale
+                    else v_acc)
+        mx = jnp.max(jnp.where(k_mask, logv_acc, -jnp.inf))
+        max_found_next = jnp.maximum(max_found, mx)
+        if pdf_max_s is not None:
+            pdf_norm_next = jnp.full((), pdf_max_s, jnp.float32)
+        else:
+            pdf_norm_next = jnp.maximum(pdf_norm, max_found_next)
+
+        t_next = (t + 1).astype(jnp.float32)
+        proposals = []
+        for sch in schemes:
+            if sch[0] == "acceptance_rate":
+                target = sch[1]
+                # record reweighting to the NEXT proposal (reference
+                # transition_pd / transition_pd_prev)
+                logq_new = jax.vmap(
+                    lambda th: trans_cls.device_logpdf(th, trans_next[0])
+                )(rec["theta"])
+                lw = jnp.clip(logq_new - rec["logq"], -60.0, 60.0)
+                rv = rec["valid"]
+                w_rec = jnp.where(rv, jnp.exp(lw), 0.0)
+                w_sum = w_rec.sum()
+                w_unif = rv.astype(jnp.float32) / jnp.maximum(
+                    rv.sum(), 1).astype(jnp.float32)
+                w_rec = jnp.where(w_sum > 0,
+                                  w_rec / jnp.maximum(w_sum, 1e-38), w_unif)
+                v_rec = rec["distance"]
+                logv_rec = (jnp.log(jnp.maximum(v_rec, 1e-30)) if lin_scale
+                            else v_rec)
+                diff = logv_rec - pdf_norm_next
+
+                def rate_at(T_):
+                    return jnp.sum(
+                        w_rec * jnp.minimum(1.0, jnp.exp(diff / T_)))
+
+                def bisect_body(_, lohi):
+                    lo, hi = lohi
+                    mid = 0.5 * (lo + hi)
+                    ok = rate_at(10.0 ** mid) >= target
+                    return (jnp.where(ok, lo, mid), jnp.where(ok, mid, hi))
+
+                lo, hi = jax.lax.fori_loop(
+                    0, 60, bisect_body,
+                    (jnp.zeros(()), jnp.full((), 12.0)))
+                prop = jnp.where(rate_at(1.0) >= target, 1.0, 10.0 ** hi)
+            elif sch[0] == "exp_decay_fixed_iter":
+                t_to_go = max_np - t_next
+                prop = jnp.where(
+                    t_to_go <= 1.0, 1.0,
+                    temp ** ((t_to_go - 1.0) / jnp.maximum(t_to_go, 1.0)))
+            elif sch[0] == "poly_decay_fixed_iter":
+                exponent = sch[1]
+                t_to_go = max_np - t_next
+                frac = (t_to_go - 1.0) / jnp.maximum(t_to_go, 1.0)
+                prop = jnp.where(t_to_go <= 1.0, 1.0,
+                                 1.0 + (temp - 1.0) * frac ** exponent)
+            elif sch[0] == "exp_decay_fixed_ratio":
+                a0, min_r, max_r = sch[1:]
+                a_eff = jnp.where(
+                    acc_rate < min_r, jnp.sqrt(a0),
+                    jnp.where(acc_rate > max_r, a0 ** 2, a0))
+                prop = jnp.maximum(1.0, a_eff * temp)
+            elif sch[0] == "friel_pettitt":
+                beta = ((t_next + 1.0) / max_np) ** 2
+                prop = 1.0 / jnp.maximum(beta, 1e-12)
+            else:  # pragma: no cover - guarded by _fused_chunk_capable
+                raise ValueError(f"unsupported device scheme: {sch[0]}")
+            proposals.append(jnp.asarray(prop, jnp.float32))
+
+        props = jnp.stack(proposals)
+        props = jnp.where(jnp.isfinite(props), props, jnp.inf)
+        temp_next = jnp.min(props)
+        temp_next = jnp.where(jnp.isfinite(temp_next), temp_next, temp)
+        # monotone decay + T >= 1 + final-generation exact sampling
+        temp_next = jnp.maximum(jnp.minimum(temp_next, temp), 1.0)
+        if max_np > 0:
+            temp_next = jnp.where(t_next >= max_np - 1, 1.0, temp_next)
+        extra = {"pdf_norm_next": pdf_norm_next,
+                 "max_found_next": max_found_next}
+        return temp_next, (pdf_norm_next, max_found_next), extra
 
     def run_generation(self, key, B: int, mode: str, dyn: dict, *,
                        n_cap: int, rec_cap: int, max_rounds: int,
@@ -842,6 +1036,8 @@ class DeviceContext:
             accepted=np.asarray(out["accepted"], bool),
             valid=np.asarray(out["valid"], bool),
             log_weights=np.asarray(out["log_weight"], np.float64),
+            logqs=(np.asarray(out["logq"], np.float64)
+                   if "logq" in out else None),
         )
 
     # ---------------------------------------------------- per-generation args

@@ -74,6 +74,58 @@ class DeviceRecords:
         return self.to_host().shape
 
 
+@dataclass
+class HostRecords:
+    """All-evaluations records from the host (scalar-closure) samplers.
+
+    Mirrors the reference's rejected-particle record: summary statistics,
+    distance and acceptance per evaluation, plus the proposal identity
+    (m, parameter) and the proposal density the particle was drawn under
+    (``proposal_pds`` = reference ``transition_pd_prev``) so the
+    AcceptanceRateScheme can importance-reweight the record to the NEXT
+    generation's proposal.
+    """
+
+    sum_stats: list
+    distances: np.ndarray
+    accepted: np.ndarray
+    ms: np.ndarray | None = None
+    parameters: list | None = None
+    proposal_pds: np.ndarray | None = None
+
+    @classmethod
+    def from_particles(cls, particles) -> "HostRecords":
+        return cls(
+            sum_stats=[p.sum_stat for p in particles],
+            distances=np.asarray([p.distance for p in particles]),
+            accepted=np.asarray([p.accepted for p in particles], bool),
+            ms=np.asarray([p.m for p in particles], np.int32),
+            parameters=[p.parameter for p in particles],
+            proposal_pds=np.asarray(
+                [p.proposal_pd for p in particles], np.float64
+            ),
+        )
+
+    @classmethod
+    def from_tuples(cls, records) -> "HostRecords":
+        """From (sum_stat, distance, accepted, m, parameter, proposal_pd)
+        tuples (the queue-friendly form the multiprocess workers ship)."""
+        return cls(
+            sum_stats=[r[0] for r in records],
+            distances=np.asarray([r[1] for r in records]),
+            accepted=np.asarray([r[2] for r in records], bool),
+            ms=np.asarray([r[3] for r in records], np.int32),
+            parameters=[r[4] for r in records],
+            proposal_pds=np.asarray([r[5] for r in records], np.float64),
+        )
+
+
+def particle_record(p) -> tuple:
+    """The picklable per-evaluation record tuple for HostRecords.from_tuples."""
+    return (p.sum_stat, p.distance, p.accepted, p.m, p.parameter,
+            p.proposal_pd)
+
+
 class Sample:
     """One generation's harvest (pyabc Sample), struct-of-arrays.
 
@@ -84,9 +136,11 @@ class Sample:
     """
 
     def __init__(self, record_rejected: bool = False,
-                 max_nr_rejected: int = np.inf):
+                 max_nr_rejected: int = np.inf,
+                 record_proposal_info: bool = False):
         self.record_rejected = record_rejected
         self.max_nr_rejected = max_nr_rejected
+        self.record_proposal_info = record_proposal_info
         self.is_look_ahead: bool = False
         # accepted particle arrays
         self.ms: np.ndarray | None = None
@@ -99,6 +153,12 @@ class Sample:
         self.all_sumstats: np.ndarray | None = None
         self.all_distances: np.ndarray | None = None
         self.all_accepted: np.ndarray | None = None
+        # proposal identity + density of every record (device samplers;
+        # host samplers carry the same via HostRecords) — feeds the
+        # AcceptanceRateScheme record reweighting
+        self.all_ms: np.ndarray | None = None
+        self.all_thetas: np.ndarray | None = None
+        self.all_proposal_pds: np.ndarray | None = None
         #: on-device record ring (fused sampler): lazily fetched alternative
         #: to ``all_sumstats``
         self.device_records: DeviceRecords | None = None
@@ -130,7 +190,12 @@ class Sample:
             if v is not None:
                 setattr(self, name, v[:n])
 
-    def set_all_records(self, *, sumstats, distances, accepted) -> None:
+    def set_all_records(self, *, sumstats, distances, accepted,
+                        ms=None, thetas=None, proposal_pds=None) -> None:
+        """Store the all-evaluations record, applying the finite
+        ``max_nr_rejected`` retention (accepted-first) to EVERY array so
+        the optional proposal-info columns stay row-aligned with the
+        distances."""
         if not self.record_rejected:
             return
         k = len(sumstats)
@@ -142,9 +207,17 @@ class Sample:
             sumstats, distances, accepted = (
                 sumstats[keep], distances[keep], accepted[keep]
             )
+            if ms is not None:
+                ms, thetas, proposal_pds = (
+                    ms[keep], thetas[keep], proposal_pds[keep]
+                )
         self.all_sumstats = np.asarray(sumstats)
         self.all_distances = np.asarray(distances)
         self.all_accepted = np.asarray(accepted)
+        if ms is not None:
+            self.all_ms = np.asarray(ms)
+            self.all_thetas = np.asarray(thetas)
+            self.all_proposal_pds = np.asarray(proposal_pds)
 
     def get_all_sum_stats(self) -> np.ndarray:
         """All recorded sum stats (accepted + rejected if recorded)."""
@@ -159,14 +232,19 @@ class Sample:
 class SampleFactory:
     """Carries sampler-wide sample options (pyabc SampleFactory).
 
-    Adaptive components flip ``record_rejected`` in ``configure_sampler``.
+    Adaptive components flip ``record_rejected`` in ``configure_sampler``;
+    Temperature additionally flips ``record_proposal_info`` so records
+    carry (m, theta, proposal density) for the AcceptanceRateScheme's
+    reweighting.
     """
 
     record_rejected: bool = False
     max_nr_rejected: int = np.inf
+    record_proposal_info: bool = False
 
     def __call__(self) -> Sample:
-        return Sample(self.record_rejected, self.max_nr_rejected)
+        return Sample(self.record_rejected, self.max_nr_rejected,
+                      self.record_proposal_info)
 
 
 class Sampler:

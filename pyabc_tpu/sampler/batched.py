@@ -155,6 +155,8 @@ class BatchedSampler(Sampler):
         out = ctx.dispatch_generation(
             generation_spec.gen_key, B, mode, dyn, n_cap=n_cap,
             rec_cap=rec_cap, max_rounds=max_rounds, n_target=n,
+            record_proposal=(sample.record_rejected
+                             and sample.record_proposal_info),
         )
         return {"out": out, "sample": sample, "n": n, "n_cap": n_cap}
 
@@ -209,9 +211,19 @@ class BatchedSampler(Sampler):
 
             valid = np.asarray(out["rec_valid"], bool)
             rec_dev = out.get("rec_sumstats_dev")
+            if "rec_logq" in out:
+                prop_kw = dict(
+                    ms=np.asarray(out["rec_m"], np.int32)[valid],
+                    thetas=np.asarray(out["rec_theta"], np.float64)[valid],
+                    proposal_pds=np.exp(np.asarray(
+                        out["rec_logq"], np.float64))[valid],
+                )
+            else:
+                prop_kw = {}
             if np.isfinite(sample.max_nr_rejected) or rec_dev is None:
                 # a finite cap has reference accepted-first retention
-                # semantics that set_all_records enforces — fetch the ring
+                # semantics that set_all_records enforces (on EVERY record
+                # array, keeping proposal info row-aligned) — fetch the ring
                 ss = out.get("rec_sumstats")
                 if ss is None:
                     ss = jax.device_get(rec_dev)
@@ -220,6 +232,7 @@ class BatchedSampler(Sampler):
                     distances=np.asarray(
                         out["rec_distance"], np.float64)[valid],
                     accepted=np.asarray(out["rec_accepted"], bool)[valid],
+                    **prop_kw,
                 )
             else:
                 sample.all_distances = np.asarray(
@@ -232,6 +245,10 @@ class BatchedSampler(Sampler):
                     rec_dev, out.get("rec_valid_dev", None),
                     scale=out.get("rec_scale"),
                 )
+                if prop_kw:
+                    sample.all_ms = prop_kw["ms"]
+                    sample.all_thetas = prop_kw["thetas"]
+                    sample.all_proposal_pds = prop_kw["proposal_pds"]
         self._rate_estimate = max(
             int(out["n_acc"]) / max(self.nr_evaluations_, 1),
             1.0 / max(self.nr_evaluations_, 1),
@@ -253,9 +270,20 @@ class BatchedSampler(Sampler):
         sample.trim(n)
         if sample.record_rejected:
             valid_mask = np.concatenate([c.valid for c in chunks])
+            if sample.record_proposal_info and chunks[0].logqs is not None:
+                prop_kw = dict(
+                    ms=np.concatenate([c.ms for c in chunks])[valid_mask],
+                    thetas=np.concatenate(
+                        [c.thetas for c in chunks])[valid_mask],
+                    proposal_pds=np.exp(np.concatenate(
+                        [c.logqs for c in chunks]))[valid_mask],
+                )
+            else:
+                prop_kw = {}
             sample.set_all_records(
                 sumstats=np.concatenate([c.sumstats for c in chunks])[valid_mask],
                 distances=np.concatenate([c.distances for c in chunks])[valid_mask],
                 accepted=acc_mask[valid_mask],
+                **prop_kw,
             )
         return sample

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from .base import Sample, Sampler
+from .base import HostRecords, Sample, Sampler, particle_record
 
 
 def _batch_worker(simulate_one, seed, chunk):
@@ -61,10 +61,7 @@ class MappingSampler(Sampler):
                     slot = n_eval
                     n_eval += 1
                     if sample.record_rejected:
-                        all_records.append(
-                            (particle.sum_stat, particle.distance,
-                             particle.accepted)
-                        )
+                        all_records.append(particle_record(particle))
                     if particle.accepted or all_accepted:
                         accepted.append(particle)
                         ids.append(slot)
@@ -74,11 +71,7 @@ class MappingSampler(Sampler):
         sample.accepted_particles = [accepted[i] for i in order]
         sample.accepted_proposal_ids = np.asarray(ids)[order]
         if sample.record_rejected and all_records:
-            sample.host_all_records = (
-                [r[0] for r in all_records],
-                np.asarray([r[1] for r in all_records]),
-                np.asarray([r[2] for r in all_records], bool),
-            )
+            sample.host_all_records = HostRecords.from_tuples(all_records)
         return sample
 
 
@@ -118,10 +111,7 @@ class ConcurrentFutureSampler(Sampler):
                     slot = n_eval
                     n_eval += 1
                     if sample.record_rejected:
-                        all_records.append(
-                            (particle.sum_stat, particle.distance,
-                             particle.accepted)
-                        )
+                        all_records.append(particle_record(particle))
                     if particle.accepted or all_accepted:
                         accepted.append(particle)
                         ids.append(slot)
@@ -137,9 +127,5 @@ class ConcurrentFutureSampler(Sampler):
         sample.accepted_particles = [accepted[i] for i in order]
         sample.accepted_proposal_ids = np.asarray(ids)[order]
         if sample.record_rejected and all_records:
-            sample.host_all_records = (
-                [r[0] for r in all_records],
-                np.asarray([r[1] for r in all_records]),
-                np.asarray([r[2] for r in all_records], bool),
-            )
+            sample.host_all_records = HostRecords.from_tuples(all_records)
         return sample

@@ -22,7 +22,7 @@ import queue as queue_mod
 import numpy as np
 
 from ..core.population import Particle
-from .base import Sample, Sampler
+from .base import HostRecords, Sample, Sampler, particle_record
 
 DONE = "__done__"
 
@@ -59,8 +59,7 @@ def _eval_parallel_worker(simulate_one, n_request, n_eval, n_acc, out_q,
             n_eval.value += 1
         particle = simulate_one()
         if record_rejected:
-            rej_q.put((particle.sum_stat, particle.distance,
-                       particle.accepted))
+            rej_q.put(particle_record(particle))
         if particle.accepted:
             with n_acc.get_lock():
                 n_acc.value += 1
@@ -77,8 +76,7 @@ def _particle_parallel_worker(simulate_one, quota, out_q, seed,
         particle = simulate_one()
         n_eval += 1
         if record_rejected:
-            rej_q.put((particle.sum_stat, particle.distance,
-                       particle.accepted))
+            rej_q.put(particle_record(particle))
         if particle.accepted:
             produced += 1
             out_q.put((None, particle))
@@ -113,11 +111,7 @@ class _MulticoreBase(Sampler):
 
                 time.sleep(0.005)
         if records:
-            sample.host_all_records = (
-                [r[0] for r in records],
-                np.asarray([r[1] for r in records]),
-                np.asarray([r[2] for r in records], bool),
-            )
+            sample.host_all_records = HostRecords.from_tuples(records)
 
 
 class MulticoreEvalParallelSampler(_MulticoreBase):

@@ -10,7 +10,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..core.population import Particle
-from .base import Sample, Sampler
+from .base import HostRecords, Sample, Sampler
 
 
 class SingleCoreSampler(Sampler):
@@ -25,7 +25,7 @@ class SingleCoreSampler(Sampler):
         sample = self.sample_factory()
         accepted: list[Particle] = []
         accepted_ids: list[int] = []
-        all_ss, all_d, all_acc = [], [], []
+        records: list[Particle] = []
         nr_eval = 0
         while len(accepted) < n:
             if self.check_max_eval and nr_eval >= max_eval:
@@ -34,16 +34,13 @@ class SingleCoreSampler(Sampler):
             slot = nr_eval
             nr_eval += 1
             if sample.record_rejected:
-                all_ss.append(particle.sum_stat)
-                all_d.append(particle.distance)
-                all_acc.append(particle.accepted)
+                records.append(particle)
             if particle.accepted or all_accepted:
                 accepted.append(particle)
                 accepted_ids.append(slot)
         self.nr_evaluations_ = nr_eval
         sample.accepted_particles = accepted  # list view for host consumers
         sample.accepted_proposal_ids = np.asarray(accepted_ids)
-        if sample.record_rejected and all_ss:
-            sample.host_all_records = (all_ss, np.asarray(all_d),
-                                       np.asarray(all_acc, bool))
+        if sample.record_rejected and records:
+            sample.host_all_records = HostRecords.from_particles(records)
         return sample

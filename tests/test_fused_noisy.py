@@ -1,0 +1,196 @@
+"""Fused (multi-generation on-device) noisy ABC.
+
+The stochastic acceptor + Temperature configs now ride the fused chunk
+loop: pdf-norm recursion, temperature schemes (including the
+AcceptanceRateScheme with the reference's record reweighting by
+transition_pd / transition_pd_prev) and the stochastic accept/weight all
+run inside the multigen kernel. These tests pin (a) capability detection,
+(b) the reference temperature math on host and device, (c) fused-vs-unfused
+posterior parity, (d) the record reweighting itself.
+"""
+import jax
+import numpy as np
+import pytest
+
+import pyabc_tpu as pt
+from pyabc_tpu.epsilon.temperature import (
+    AcceptanceRateScheme,
+    DalyScheme,
+    ExpDecayFixedIterScheme,
+)
+
+NOISE_SD = 0.3
+PRIOR_SD = 1.0
+X_OBS = 0.8
+
+
+def _det_model():
+    @pt.JaxModel.from_function(["theta"], name="det")
+    def model(key, theta):
+        return {"x": theta[0]}
+
+    return model
+
+
+def _noisy_abc(seed=21, fused_generations=4, pop=400, eps=None, **kwargs):
+    prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+    return pt.ABCSMC(
+        _det_model(), prior,
+        pt.IndependentNormalKernel(var=[NOISE_SD**2]),
+        population_size=pop,
+        eps=eps if eps is not None else pt.Temperature(),
+        acceptor=pt.StochasticAcceptor(),
+        seed=seed, fused_generations=fused_generations, **kwargs,
+    )
+
+
+def exact_posterior():
+    var = 1.0 / (1 / PRIOR_SD**2 + 1 / NOISE_SD**2)
+    return var * X_OBS / NOISE_SD**2, np.sqrt(var)
+
+
+class TestCapability:
+    def test_default_noisy_config_is_fused_capable(self):
+        abc = _noisy_abc()
+        abc.new("sqlite://", {"x": X_OBS})
+        abc._initialize_components(8)
+        assert abc._fused_chunk_capable()
+
+    def test_daly_scheme_falls_back(self):
+        abc = _noisy_abc(eps=pt.Temperature(schemes=[DalyScheme()]))
+        abc.new("sqlite://", {"x": X_OBS})
+        abc._initialize_components(8)
+        assert not abc._fused_chunk_capable()
+
+    def test_log_file_falls_back(self):
+        abc = _noisy_abc()
+        abc.acceptor.log_file = "/tmp/nope.json"
+        abc.new("sqlite://", {"x": X_OBS})
+        abc._initialize_components(8)
+        assert not abc._fused_chunk_capable()
+
+
+class TestDeterministicLadderParity:
+    """With a deterministic scheme, the fused device temperature trajectory
+    must reproduce the reference recursion exactly (up to f32)."""
+
+    def _run(self, fused_generations):
+        abc = _noisy_abc(
+            seed=7, fused_generations=fused_generations, pop=300,
+            eps=pt.Temperature(schemes=[ExpDecayFixedIterScheme()],
+                               initial_temperature=64.0),
+        )
+        abc.new("sqlite://", {"x": X_OBS})
+        h = abc.run(max_nr_populations=7)
+        return abc, h
+
+    def test_fused_trajectory_matches_reference_recursion(self):
+        abc, h = self._run(4)
+        assert h.get_telemetry(2).get("fused_chunk"), "fused path not taken"
+        # reference: T_{t+1} = T_t ** ((n-t-1-1+1)/(n-t-1)); final gen T=1
+        T, n = 64.0, 7
+        expected = {0: 64.0}
+        for t in range(1, n):
+            t_to_go = n - t
+            T = 1.0 if t_to_go <= 1 else T ** ((t_to_go - 1) / t_to_go)
+            expected[t] = T
+        for t, exp_T in expected.items():
+            if t in abc.eps.temperatures:
+                assert abc.eps.temperatures[t] == pytest.approx(
+                    exp_T, rel=1e-3
+                ), f"t={t}"
+
+    def test_fused_posterior_matches_unfused(self):
+        _, h_f = self._run(4)
+        _, h_u = self._run(1)
+        mu_true, sd_true = exact_posterior()
+        for h in (h_f, h_u):
+            df, w = h.get_distribution(0, h.max_t)
+            mu = float(np.sum(df["theta"] * w))
+            sd = float(np.sqrt(np.sum(w * (df["theta"] - mu) ** 2)))
+            assert mu == pytest.approx(mu_true, abs=0.15)
+            assert sd == pytest.approx(sd_true, abs=0.12)
+
+
+class TestFusedDefaultTemperature:
+    def test_posterior_and_mirrored_state(self):
+        abc = _noisy_abc(seed=3, fused_generations=4, pop=500)
+        abc.new("sqlite://", {"x": X_OBS})
+        h = abc.run(max_nr_populations=10)
+        mu_true, sd_true = exact_posterior()
+        df, w = h.get_distribution(0, h.max_t)
+        mu = float(np.sum(df["theta"] * w))
+        assert mu == pytest.approx(mu_true, abs=0.15)
+        # final generation runs at T = 1 (exact posterior convention)
+        assert abc.eps(h.max_t) == pytest.approx(1.0)
+        # host mirrors of the device recursions exist for every generation
+        for t in range(h.n_populations):
+            assert t in abc.eps.temperatures
+            assert t in abc.acceptor.pdf_norms
+
+
+class TestAcceptanceRateReweighting:
+    def test_reweighted_bisection_closed_form(self):
+        """Two records: one at the norm (rate 1), one 10 nats below.
+        With all weight on the second, T solves exp(-10/T) = target."""
+        import pandas as pd
+
+        scheme = AcceptanceRateScheme(target_rate=0.3)
+
+        def records(w1, w2):
+            return pd.DataFrame({
+                "distance": [0.0, -10.0],
+                "accepted": [True, False],
+                "transition_pd_prev": [1.0, 1.0],
+                "transition_pd": [w1, w2],
+            })
+
+        t_all_first = scheme(
+            1, get_all_records=lambda: records(1.0, 0.0), pdf_norm=0.0,
+        )
+        assert t_all_first == pytest.approx(1.0)
+        t_all_second = scheme(
+            1, get_all_records=lambda: records(0.0, 1.0), pdf_norm=0.0,
+        )
+        assert t_all_second == pytest.approx(-10.0 / np.log(0.3), rel=1e-3)
+
+    def test_host_records_carry_proposal_density(self):
+        """SingleCoreSampler + Temperature: records must carry finite
+        proposal densities so the provider adds the reweighting columns."""
+        abc = _noisy_abc(seed=5, pop=60,
+                         sampler=pt.SingleCoreSampler())
+        abc.new("sqlite://", {"x": X_OBS})
+        h = abc.run(max_nr_populations=3)
+        assert h.n_populations >= 2
+
+    def test_capped_retention_keeps_proposal_arrays_aligned(self):
+        """finite max_nr_recorded_particles trims accepted-first; the
+        proposal arrays must follow the same retention (they feed the same
+        DataFrame as the distances)."""
+        abc = _noisy_abc(seed=5, pop=100, fused_generations=1,
+                         max_nr_recorded_particles=150)
+        abc.new("sqlite://", {"x": X_OBS})
+        h = abc.run(max_nr_populations=3)
+        assert h.n_populations >= 2
+
+    def test_device_records_carry_proposal_density(self):
+        """BatchedSampler unfused noisy generation: the record ring ships
+        (m, theta, logq) and the Sample exposes proposal densities."""
+        abc = _noisy_abc(seed=5, pop=200, fused_generations=1)
+        abc.new("sqlite://", {"x": X_OBS})
+        abc._initialize_components(5)
+        abc.distance_function.configure_sampler(abc.sampler)
+        abc.eps.configure_sampler(abc.sampler)
+        spec = abc._generation_spec(0)
+        sample = abc.sampler.sample_until_n_accepted(200, spec, 0)
+        assert sample.all_proposal_pds is not None
+        assert np.isfinite(sample.all_proposal_pds).all()
+        assert (sample.all_proposal_pds > 0).all()
+        assert sample.all_thetas.shape[1] == 1
+        # prior-mode records: proposal density == prior pdf
+        import scipy.stats as st
+
+        expect = st.norm(0.0, PRIOR_SD).pdf(sample.all_thetas[:, 0])
+        np.testing.assert_allclose(
+            sample.all_proposal_pds, expect, rtol=2e-3
+        )
