@@ -88,6 +88,7 @@ from .sampler import (
     Sampler,
     SingleCoreSampler,
 )
+from .broker import ElasticSampler
 from .predictor import (
     GPPredictor,
     LassoPredictor,

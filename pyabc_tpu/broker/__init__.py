@@ -1,0 +1,19 @@
+"""Elastic host-worker farming over TCP (the Redis-sampler analog).
+
+Reference parity: ``pyabc/sampler/redis_eps/{sampler,worker,cli}.py``
+(SURVEY.md §2.3 Redis row, §5.3): a broker hands out evaluation slots to
+workers that may JOIN AND LEAVE AT ANY TIME — mid-generation included; a
+SIGKILLed worker costs nothing but throughput. Implemented with stdlib
+sockets (no redis dependency): the broker is a length-prefixed-pickle TCP
+server owned by :class:`ElasticSampler`; ``abc-worker`` processes connect
+from anywhere, pull slots in batches, and push back evaluated particles.
+
+This serves HOST-side (non-traceable) models — external simulators, R /
+Julia / shell models — scaled across machines. Traceable JaxModels scale
+via the device mesh instead (``BatchedSampler`` + ``jax.sharding``).
+"""
+from .broker import BrokerStatus, EvalBroker
+from .sampler import ElasticSampler
+from .worker import run_worker
+
+__all__ = ["EvalBroker", "ElasticSampler", "run_worker", "BrokerStatus"]

@@ -1,0 +1,209 @@
+"""The evaluation broker: atomic slot handout + result collection.
+
+Reference parity: ``pyabc/sampler/redis_eps/sampler.py``'s Redis-side
+bookkeeping — ``N_EVAL``/``N_ACC``/``N_WORKER`` counters, the result
+queue, and the generation start/stop signaling — implemented as one
+threaded TCP server with an in-process lock instead of a Redis instance.
+
+Elasticity contract (the reference's signature capability, SURVEY.md
+§5.3): workers are never registered ahead of time and never waited upon.
+A worker that dies mid-generation simply stops requesting slots — its
+outstanding slot ids are abandoned, which is harmless: slots are
+provenance ids for the deterministic sort-by-slot trim, and generation
+completion is driven solely by DELIVERED accepted results. A worker that
+joins mid-generation gets the current generation's payload on hello.
+"""
+from __future__ import annotations
+
+import socketserver
+import threading
+import time
+from dataclasses import dataclass, field
+
+from .protocol import recv_msg, send_msg
+
+
+@dataclass
+class BrokerStatus:
+    generation: int
+    t: int | None
+    n_target: int
+    n_acc: int
+    n_eval_handed: int
+    n_results: int
+    workers: dict = field(default_factory=dict)
+    done: bool = True
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):  # one request per connection (stateless workers)
+        broker: EvalBroker = self.server.broker  # type: ignore[attr-defined]
+        try:
+            msg = recv_msg(self.request)
+        except (ConnectionError, ValueError, EOFError):
+            return
+        try:
+            reply = broker._dispatch(msg)
+        except Exception as e:  # defensive: a bad frame must not kill serve
+            reply = ("error", repr(e))
+        try:
+            send_msg(self.request, reply)
+        except (ConnectionError, BrokenPipeError, OSError):
+            pass
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class EvalBroker:
+    """Owns generation state; thread-safe; runs inside the sampler process.
+
+    SECURITY: frames are pickle — anyone who can reach the port can run
+    code in this process (same trust model as the reference's Redis
+    instance, which is equally unauthenticated by default). The default
+    bind is loopback; bind ``0.0.0.0`` explicitly ONLY on a trusted
+    cluster network.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 max_eval: float = float("inf")):
+        self._lock = threading.Lock()
+        self._gen = 0               # monotonically increasing generation id
+        self._payload: bytes | None = None  # pickled simulate_one closure
+        self._t: int | None = None
+        self._n_target = 0
+        self._max_eval = max_eval
+        self._all_accepted = False
+        self._next_slot = 0
+        self._n_acc = 0
+        self._results: list[tuple[int, bytes, bool]] = []
+        self._done = True
+        self._done_event = threading.Event()
+        self._workers: dict[str, dict] = {}
+        self._server = _Server((host, port), _Handler)
+        self._server.broker = self  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="pyabc-tpu-broker",
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------------ api
+    @property
+    def address(self) -> tuple[str, int]:
+        host, port = self._server.server_address[:2]
+        return (host, port)
+
+    def start_generation(self, t: int, payload: bytes, n_target: int,
+                         *, max_eval: float = float("inf"),
+                         all_accepted: bool = False,
+                         batch: int = 1) -> None:
+        with self._lock:
+            self._gen += 1
+            self._t = t
+            self._payload = payload
+            self._n_target = int(n_target)
+            self._max_eval = max_eval
+            self._all_accepted = all_accepted
+            self._batch = max(int(batch), 1)
+            self._next_slot = 0
+            self._n_acc = 0
+            self._results = []
+            self._done = False
+            self._done_event.clear()
+
+    def wait(self, poll_s: float = 0.05, timeout: float | None = None
+             ) -> list[tuple[int, bytes, bool]]:
+        """Block until the generation completes; returns (slot,
+        particle_bytes, accepted) triples of every delivered result."""
+        deadline = time.time() + timeout if timeout else None
+        while not self._done_event.wait(poll_s):
+            if deadline and time.time() > deadline:
+                raise TimeoutError(
+                    f"generation incomplete: {self.status()}"
+                )
+        with self._lock:
+            return list(self._results)
+
+    def status(self) -> BrokerStatus:
+        with self._lock:
+            now = time.time()
+            return BrokerStatus(
+                generation=self._gen, t=self._t, n_target=self._n_target,
+                n_acc=self._n_acc, n_eval_handed=self._next_slot,
+                n_results=len(self._results),
+                workers={
+                    w: dict(info, idle_s=round(now - info["last_seen"], 1))
+                    for w, info in self._workers.items()
+                },
+                done=self._done,
+            )
+
+    def stop(self) -> None:
+        with self._lock:
+            self._done = True
+        self._done_event.set()
+        self._server.shutdown()
+        self._server.server_close()
+
+    # ------------------------------------------------------------ dispatch
+    def _touch(self, worker_id: str, **updates) -> None:
+        info = self._workers.setdefault(
+            worker_id, {"n_results": 0, "joined": time.time()}
+        )
+        info["last_seen"] = time.time()
+        for k, v in updates.items():
+            info[k] = info.get(k, 0) + v
+
+    def _dispatch(self, msg):
+        kind = msg[0]
+        if kind == "hello":
+            with self._lock:
+                self._touch(msg[1])
+                if self._done or self._payload is None:
+                    return ("wait",)
+                return ("work", self._gen, self._t, self._payload,
+                        self._batch)
+        if kind == "get_slots":
+            _, worker_id, gen, k = msg
+            with self._lock:
+                self._touch(worker_id)
+                if gen != self._gen or self._done:
+                    return ("done",)
+                if self._next_slot >= self._max_eval:
+                    # eval budget exhausted: finish with what was delivered
+                    self._finish_locked()
+                    return ("done",)
+                start = self._next_slot
+                stop = int(min(start + int(k), self._max_eval))
+                self._next_slot = stop
+                return ("slots", start, stop)
+        if kind == "results":
+            _, worker_id, gen, triples = msg
+            with self._lock:
+                self._touch(worker_id, n_results=len(triples))
+                if gen != self._gen:
+                    return ("done",)
+                if self._done:
+                    return ("done",)
+                for slot, blob, accepted in triples:
+                    self._results.append((int(slot), blob, bool(accepted)))
+                    if accepted:
+                        self._n_acc += 1
+                if self._n_acc >= self._n_target:
+                    self._finish_locked()
+                    return ("done",)
+                return ("ok",)
+        if kind == "status":
+            return ("status", self.status())
+        if kind == "shutdown":
+            with self._lock:
+                self._finish_locked()
+            return ("ok",)
+        return ("error", f"unknown request {kind!r}")
+
+    def _finish_locked(self) -> None:
+        self._done = True
+        self._done_event.set()

@@ -1,0 +1,56 @@
+"""Wire protocol: length-prefixed pickle frames over a blocking socket.
+
+Reference analog: the Redis sampler's key/queue schema
+(``pyabc/sampler/redis_eps/cmd.py``: START/STOP/GENERATION counters and
+result queues) — collapsed into five request types against one broker:
+
+- ``("hello", worker_id)``  -> ("work", gen, t, payload, batch) | ("wait",)
+- ``("get_slots", worker_id, gen, k)``
+                            -> ("slots", start, stop) | ("done",)
+- ``("results", worker_id, gen, [(slot, particle_bytes, accepted), ...])``
+                            -> ("ok",) | ("done",)
+- ``("status",)``           -> ("status", BrokerStatus)
+- ``("shutdown",)``         -> ("ok",)
+
+Particles travel pre-pickled (``particle_bytes``) so the broker thread
+never unpickles model-specific payloads while holding its lock.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+
+_LEN = struct.Struct("!Q")
+MAX_FRAME = 1 << 31  # 2 GiB sanity bound
+
+
+def send_msg(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_msg(sock: socket.socket):
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if n > MAX_FRAME:
+        raise ValueError(f"frame too large: {n}")
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def request(addr: tuple[str, int], obj, timeout: float = 30.0):
+    """One connect-send-receive round trip (workers keep it simple and
+    stateless: any broker restart or network blip costs one retry, not a
+    corrupted session)."""
+    with socket.create_connection(addr, timeout=timeout) as sock:
+        send_msg(sock, obj)
+        return recv_msg(sock)

@@ -1,0 +1,80 @@
+"""ElasticSampler — the ABCSMC-facing side of the broker.
+
+Reference parity: ``pyabc/sampler/redis_eps/sampler.py::
+RedisEvalParallelSampler`` (static-scheduling variant; the look-ahead mode
+is served by ABCSMC's own pipelined loops). Publishes each generation's
+pickled ``simulate_one`` closure to the broker, blocks until enough
+acceptances were DELIVERED, and applies the deterministic sort-by-slot
+overshoot trim — the same unbiasedness invariant every other dynamic
+sampler in this package honors (SURVEY.md §3.4).
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+try:  # closures (simulate_one) need cloudpickle; plain functions don't
+    import cloudpickle as _closure_pickle
+except ImportError:  # pragma: no cover - cloudpickle is usually present
+    _closure_pickle = pickle
+
+from .broker import EvalBroker
+from ..sampler.base import HostRecords, Sample, Sampler
+
+
+class ElasticSampler(Sampler):
+    """Farm host-model evaluations to elastic TCP workers.
+
+    ``host``/``port``: broker bind address (port 0 = ephemeral; read
+    ``.broker.address`` and hand it to ``abc-worker``). ``batch``: slots
+    per worker round trip. Workers may join/leave at any time; at least
+    one worker must be alive for a generation to finish —
+    ``generation_timeout`` bounds the wait (None = forever).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 batch: int = 10,
+                 generation_timeout: float | None = None):
+        super().__init__()
+        self.batch = int(batch)
+        self.generation_timeout = generation_timeout
+        self.broker = EvalBroker(host, port)
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self.broker.address
+
+    def sample_until_n_accepted(self, n, simulate_one, t, *,
+                                max_eval=np.inf, all_accepted=False,
+                                ana_vars=None) -> Sample:
+        if hasattr(simulate_one, "host_simulate_one"):
+            simulate_one = simulate_one.host_simulate_one
+        payload = _closure_pickle.dumps(simulate_one)
+        self.broker.start_generation(
+            t if t is not None else -1, payload, n, max_eval=max_eval,
+            all_accepted=all_accepted, batch=self.batch,
+        )
+        triples = self.broker.wait(timeout=self.generation_timeout)
+
+        sample = self.sample_factory()
+        accepted, accepted_ids, records = [], [], []
+        for slot, blob, acc in sorted(triples, key=lambda x: x[0]):
+            particle = pickle.loads(blob)
+            if sample.record_rejected:
+                records.append(particle)
+            if acc or all_accepted or particle.accepted:
+                accepted.append(particle)
+                accepted_ids.append(slot)
+        self.nr_evaluations_ = len(triples)
+        # deterministic overshoot trim by eval-slot id
+        accepted = accepted[:n]
+        accepted_ids = accepted_ids[:n]
+        sample.accepted_particles = accepted
+        sample.accepted_proposal_ids = np.asarray(accepted_ids)
+        if sample.record_rejected and records:
+            sample.host_all_records = HostRecords.from_particles(records)
+        return sample
+
+    def stop(self) -> None:
+        self.broker.stop()

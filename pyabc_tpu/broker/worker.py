@@ -1,0 +1,112 @@
+"""The elastic worker: join anytime, pull slots, push particles, die freely.
+
+Reference parity: ``pyabc/sampler/redis_eps/work.py::work`` + the
+``abc-redis-worker`` CLI: a worker process polls the broker for the current
+generation, evaluates ``simulate_one`` per handed-out slot, ships results
+back in batches, and loops into the next generation. Death at ANY point is
+safe (abandoned slots are provenance ids only); joining mid-generation is
+the normal case. Per-worker CSV logging mirrors the reference worker's
+runtime bookkeeping.
+"""
+from __future__ import annotations
+
+import csv
+import os
+import pickle
+import socket
+import time
+import uuid
+
+import numpy as np
+
+from .protocol import request
+
+
+def run_worker(host: str, port: int, *, worker_id: str | None = None,
+               poll_s: float = 0.3, max_generations: float = float("inf"),
+               runtime_s: float = float("inf"),
+               log_file: str | None = None,
+               _stop_check=None) -> int:
+    """Serve generations until the broker goes away / runtime ends.
+
+    Returns the number of evaluations performed. Reconnects with backoff
+    while the broker is unreachable (a worker may be started BEFORE the
+    manager — reference semantics).
+    """
+    addr = (host, int(port))
+    wid = worker_id or f"{socket.gethostname()}-{os.getpid()}-{uuid.uuid4().hex[:6]}"
+    # worker-unique numpy seed: host simulate_one draws via np.random
+    np.random.seed((os.getpid() * 1000003 + int(time.time())) % (2**31 - 1))
+    t_end = time.time() + runtime_s if np.isfinite(runtime_s) else None
+    n_eval_total = 0
+    gens_served = 0
+    last_counted_gen = -1
+    log_writer = None
+    if log_file:
+        fh = open(log_file, "a", newline="")
+        log_writer = csv.writer(fh)
+        if fh.tell() == 0:
+            log_writer.writerow(
+                ["worker_id", "generation", "t", "n_eval", "n_accepted",
+                 "wall_s"])
+
+    while True:
+        if _stop_check is not None and _stop_check():
+            break
+        if t_end and time.time() > t_end:
+            break
+        if gens_served >= max_generations:
+            break
+        try:
+            reply = request(addr, ("hello", wid))
+        except (ConnectionError, OSError):
+            time.sleep(min(poll_s * 4, 2.0))
+            continue
+        if reply[0] != "work":
+            time.sleep(poll_s)
+            continue
+        # NOTE: no served-generation memory on purpose — a transport blip
+        # mid-generation must NOT bench the worker for the rest of that
+        # generation; re-entering a still-running generation just pulls
+        # more slots (a finished generation answers hello with "wait")
+        _, gen, t, payload, batch = reply
+        simulate_one = pickle.loads(payload)
+        t0 = time.time()
+        n_eval = n_acc = 0
+        while True:
+            try:
+                r = request(addr, ("get_slots", wid, gen, batch))
+            except (ConnectionError, OSError):
+                break  # broker gone; outer loop will reconnect
+            if r[0] != "slots":
+                break
+            _, start, stop = r
+            triples = []
+            for slot in range(start, stop):
+                particle = simulate_one()
+                n_eval += 1
+                n_acc += int(bool(particle.accepted))
+                triples.append((
+                    slot,
+                    pickle.dumps(particle, pickle.HIGHEST_PROTOCOL),
+                    bool(particle.accepted),
+                ))
+            try:
+                r2 = request(addr, ("results", wid, gen, triples))
+            except (ConnectionError, OSError):
+                break
+            if r2[0] != "ok":
+                break
+        if gen != last_counted_gen:
+            gens_served += 1
+            last_counted_gen = gen
+        n_eval_total += n_eval
+        if n_eval == 0:
+            # nothing handed out (generation ending / transport blip):
+            # don't hot-spin on hello
+            time.sleep(poll_s)
+        if log_writer is not None:
+            log_writer.writerow(
+                [wid, gen, t, n_eval, n_acc, round(time.time() - t0, 3)])
+            fh.flush()
+    return n_eval_total

@@ -122,6 +122,58 @@ def bench_cmd(pop, gens, budget_s, cpu):
     }))
 
 
+@click.command("abc-worker")
+@click.argument("host")
+@click.argument("port", type=int)
+@click.option("--id", "worker_id", default=None, help="worker id (default: "
+              "hostname-pid-rand)")
+@click.option("--runtime-s", type=float, default=float("inf"),
+              help="leave the pool after this many seconds")
+@click.option("--max-generations", type=float, default=float("inf"),
+              help="leave the pool after serving this many generations")
+@click.option("--log-file", default=None,
+              help="per-worker CSV runtime log (reference parity)")
+def worker_cmd(host, port, worker_id, runtime_s, max_generations, log_file):
+    """Join an ElasticSampler broker at HOST:PORT as an evaluation worker
+    (reference parity: the ``abc-redis-worker`` CLI). Workers may join and
+    leave at any time, including mid-generation."""
+    from .broker import run_worker
+
+    n = run_worker(host, port, worker_id=worker_id, runtime_s=runtime_s,
+                   max_generations=max_generations, log_file=log_file)
+    click.echo(f"worker done: {n} evaluations", err=True)
+
+
+@click.command("abc-manager")
+@click.argument("host")
+@click.argument("port", type=int)
+@click.option("--watch", is_flag=True, help="refresh every 2s")
+def manager_cmd(host, port, watch):
+    """Show an ElasticSampler broker's live status (reference parity: the
+    ``abc-redis-manager`` CLI): generation, counters, connected workers."""
+    import time as _time
+
+    from .broker.protocol import request
+
+    while True:
+        kind, status = request((host, port), ("status",))
+        assert kind == "status", (kind, status)
+        click.echo(
+            f"generation={status.generation} t={status.t} "
+            f"acc={status.n_acc}/{status.n_target} "
+            f"handed={status.n_eval_handed} results={status.n_results} "
+            f"done={status.done}"
+        )
+        for wid, info in sorted(status.workers.items()):
+            click.echo(
+                f"  worker {wid}: results={info.get('n_results', 0)} "
+                f"idle={info.get('idle_s', '?')}s"
+            )
+        if not watch:
+            break
+        _time.sleep(2.0)
+
+
 @click.command("abc-server")
 @click.argument("db")
 @click.option("--host", default="127.0.0.1", help="bind address")
@@ -138,5 +190,5 @@ def server_cmd(db, host, port):
 if __name__ == "__main__":  # pragma: no cover - manual invocation helper
     cmd = sys.argv[1] if len(sys.argv) > 1 else ""
     sys.argv = [sys.argv[0]] + sys.argv[2:]
-    {"export": export_cmd, "bench": bench_cmd,
-     "server": server_cmd}.get(cmd, export_cmd)()
+    {"export": export_cmd, "bench": bench_cmd, "server": server_cmd,
+     "worker": worker_cmd, "manager": manager_cmd}.get(cmd, export_cmd)()

@@ -263,6 +263,16 @@ class AdaptivePNormDistance(PNormDistance):
         ships with the kernel's main fetch; see Distance.device_record_reduce)."""
         if self.sumstat is not None or not self.adaptive:
             return None
+        return self.device_scale_impl()
+
+    def device_scale_impl(self):
+        """The raw traceable scale twin ``fn(stats (n,S), valid (n,), x0)
+        -> (S,)`` for this distance's scale function, or None if the scale
+        function has no device twin. Unlike :meth:`device_record_reduce`
+        this ignores the sumstat transform — the multigen kernel composes
+        it with the sumstat's own device_fn when one is active."""
+        if not self.adaptive:
+            return None
         from .scale import SCALE_FUNCTIONS, _device_scale_impls
 
         name = getattr(self.scale_function, "__name__", "")

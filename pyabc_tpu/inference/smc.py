@@ -355,13 +355,26 @@ class ABCSMC:
                     transitions=self.transitions if t > 0 else None,
                     model_perturbation_kernel=self.model_perturbation_kernel,
                 )
+        # standalone closures over the prior-probability array, NOT bound
+        # methods: the host closure must stay picklable (cloudpickle for
+        # the elastic/SGE/Dask farms) without dragging the whole ABCSMC —
+        # History db handles, sampler broker threads, locks — along
+        prior_probs = self.model_prior_probs
+        K = self.K
+
+        def model_prior_rvs() -> int:
+            return int(np.random.choice(K, p=prior_probs))
+
+        def model_prior_pmf(m: int) -> float:
+            return float(prior_probs[m])
+
         host = create_simulate_function(
             0 if calibration else t,
             model_probabilities=self._model_probs,
             model_perturbation_kernel=self.model_perturbation_kernel,
             transitions=self.transitions,
-            model_prior_rvs=self._model_prior_rvs,
-            model_prior_pmf=self._model_prior_pmf,
+            model_prior_rvs=model_prior_rvs,
+            model_prior_pmf=model_prior_pmf,
             parameter_priors=self.parameter_priors,
             models=self.models,
             summary_statistics=self.summary_statistics,
@@ -759,10 +772,6 @@ class ABCSMC:
             self.sampler, "fused", False
         ):
             return False
-        if self.mesh is not None and len(
-            {d.process_index for d in self.mesh.devices.flat}
-        ) > 1:
-            return False  # multi-host barrier runs per generation
         if not isinstance(self.population_strategy, ConstantPopulationSize):
             return False
         if type(self.acceptor) is StochasticAcceptor:
@@ -791,21 +800,18 @@ class ABCSMC:
             return False  # capped retention semantics need the host path
         d = self.distance_function
         if isinstance(d, AdaptivePNormDistance):
-            if d.sumstat is not None:
+            if d.sumstat is not None and not d.sumstat.is_device_compatible():
                 return False
-            if d.adaptive and (
-                SCALE_FUNCTIONS.get(
-                    getattr(d.scale_function, "__name__", "")
-                ) is not d.scale_function
-            ):
+            if d.adaptive and d.device_scale_impl() is None:
                 return False
             if d.scale_log_file:
                 return False  # per-generation host logging: stay unfused
         elif type(d) is PNormDistance:
-            if d.sumstat is not None:
+            if d.sumstat is not None and not d.sumstat.is_device_compatible():
                 return False
-            # per-generation user weight schedules can't ride a constant
-            # carry; a single default weight vector can
+            # per-generation user weight schedules can't ride a chunk-
+            # constant carry (with or without a sumstat transform); a
+            # single default weight vector can
             if any(k >= 0 for k in d.weights):
                 return False
         else:
@@ -981,6 +987,12 @@ class ABCSMC:
         eps_quantile = isinstance(self.eps, QuantileEpsilon)
         adaptive = (isinstance(self.distance_function, AdaptivePNormDistance)
                     and self.distance_function.adaptive)
+        # learned/transformed statistics ride the chunk as constant device
+        # params; the predictor refits on the host BETWEEN chunks (next
+        # chunk gets a fresh carry), so chunks are dispatched non-
+        # speculatively in this mode
+        sumstat_mode = getattr(self.distance_function, "sumstat", None) \
+            is not None
         n_cap = _pow2(n, 64)
         rec_cap = _pow2(8 * n_cap, 256) if (adaptive or stochastic) else 1
         B = self.sampler._pick_B(n)
@@ -1002,6 +1014,7 @@ class ABCSMC:
             dims=tuple(p.space.dim for p in self.parameter_priors),
             stochastic=stochastic,
             temp_config=self._temp_config() if stochastic else None,
+            sumstat_transform=sumstat_mode,
         )
 
         def _g_limit(t_at: int) -> int:
@@ -1031,56 +1044,64 @@ class ABCSMC:
                 jnp.asarray(min_acceptance_rate, jnp.float32),
             )
 
-        # per-model initial transition params (host fit of the previous
-        # generation), padded to the reservoir shape; never-fitted models
-        # get zero placeholders and a False fitted-mask entry (the kernel
-        # masks them out of the model-perturbation matrix)
-        trans0 = []
-        fitted0 = np.zeros(self.K, bool)
-        ref_fitted = next(
-            (x for x in self.transitions if x.X is not None), None
-        )
-        if ref_fitted is None:
-            raise RuntimeError("no fitted transition to start a fused chunk")
-        for m, tr_m in enumerate(self.transitions):
-            if tr_m.X is not None:
-                raw = jax.tree.map(np.asarray, tr_m.device_params())
-                fitted0[m] = True
-            else:
-                raw = jax.tree.map(
-                    lambda v: np.zeros_like(np.asarray(v)),
-                    ref_fitted.device_params(),
-                )
-            trans0.append(pad_transition_params(raw, n_cap, ctx.d_max))
-        probs0 = np.zeros(self.K)
-        for m, p in self._model_probs.items():
-            probs0[int(m)] = p
-        with np.errstate(divide="ignore"):
-            log_probs0 = np.log(probs0)
-        # pytree-generic: stochastic kernels may carry structured params
-        dist_w0 = jax.tree.map(
-            lambda v: jnp.asarray(np.asarray(v, np.float32)),
-            self.distance_function.device_params(t),
-        )
-        if stochastic:
-            # seed the device pdf-norm recursion from the host acceptor's
-            # state for generation t (calibration + generations < t)
-            acc_state0 = (
-                jnp.asarray(self.acceptor.pdf_norms.get(t, 0.0),
-                            jnp.float32),
-                jnp.asarray(
-                    self.acceptor._max_found
-                    if np.isfinite(self.acceptor._max_found) else -1e30,
-                    jnp.float32),
+        def _build_chunk_carry(t_at: int):
+            """Host-state -> device chunk carry: per-model transition params
+            (host fit of the previous generation) padded to the reservoir
+            shape — never-fitted models get zero placeholders and a False
+            fitted-mask entry (the kernel masks them out of the model-
+            perturbation matrix) — plus model log-probs, distance params,
+            epsilon/temperature and the stochastic pdf-norm state."""
+            trans0 = []
+            fitted0 = np.zeros(self.K, bool)
+            ref_fitted = next(
+                (x for x in self.transitions if x.X is not None), None
             )
-        else:
-            acc_state0 = (jnp.zeros((), jnp.float32),
-                          jnp.asarray(-1e30, jnp.float32))
-        carry0 = (tuple(trans0), jnp.asarray(log_probs0, jnp.float32),
-                  jnp.asarray(fitted0), dist_w0,
-                  jnp.asarray(self.eps(t), jnp.float32),
-                  acc_state0,
-                  jnp.asarray(False))
+            if ref_fitted is None:
+                raise RuntimeError(
+                    "no fitted transition to start a fused chunk"
+                )
+            for m, tr_m in enumerate(self.transitions):
+                if tr_m.X is not None:
+                    raw = jax.tree.map(np.asarray, tr_m.device_params())
+                    fitted0[m] = True
+                else:
+                    raw = jax.tree.map(
+                        lambda v: np.zeros_like(np.asarray(v)),
+                        ref_fitted.device_params(),
+                    )
+                trans0.append(pad_transition_params(raw, n_cap, ctx.d_max))
+            probs0 = np.zeros(self.K)
+            for m, p in self._model_probs.items():
+                probs0[int(m)] = p
+            with np.errstate(divide="ignore"):
+                log_probs0 = np.log(probs0)
+            # pytree-generic: stochastic kernels / sumstat-bearing
+            # distances carry structured params
+            dist_w0 = jax.tree.map(
+                lambda v: jnp.asarray(np.asarray(v, np.float32)),
+                self.distance_function.device_params(t_at),
+            )
+            if stochastic:
+                # seed the device pdf-norm recursion from the host
+                # acceptor's state for generation t_at
+                acc_state0 = (
+                    jnp.asarray(self.acceptor.pdf_norms.get(t_at, 0.0),
+                                jnp.float32),
+                    jnp.asarray(
+                        self.acceptor._max_found
+                        if np.isfinite(self.acceptor._max_found) else -1e30,
+                        jnp.float32),
+                )
+            else:
+                acc_state0 = (jnp.zeros((), jnp.float32),
+                              jnp.asarray(-1e30, jnp.float32))
+            return (tuple(trans0), jnp.asarray(log_probs0, jnp.float32),
+                    jnp.asarray(fitted0), dist_w0,
+                    jnp.asarray(self.eps(t_at), jnp.float32),
+                    acc_state0,
+                    jnp.asarray(False))
+
+        carry0 = _build_chunk_carry(t)
 
         g_limit = _g_limit(t)
         if g_limit <= 0:
@@ -1096,6 +1117,8 @@ class ABCSMC:
                 minimum_epsilon, max_nr_populations, min_acceptance_rate,
                 max_total_nr_simulations, max_walltime, start_walltime,
                 sims_total, eps_quantile, adaptive, stochastic,
+                sumstat_refit=sumstat_mode,
+                rebuild_carry=_build_chunk_carry,
             )
         except BaseException:
             # drain queued generations before propagating — a mid-loop
@@ -1117,7 +1140,8 @@ class ABCSMC:
                           max_nr_populations, min_acceptance_rate,
                           max_total_nr_simulations, max_walltime,
                           start_walltime, sims_total, eps_quantile,
-                          adaptive, stochastic=False) -> History:
+                          adaptive, stochastic=False, sumstat_refit=False,
+                          rebuild_carry=None) -> History:
         import jax
 
         from ..sampler.base import Sample, exp_normalize_log_weights
@@ -1131,18 +1155,25 @@ class ABCSMC:
                         g_limit)
             # speculative: enqueue the NEXT chunk off the device-side carry
             # BEFORE fetching this one (in-device `stopped` flag chains, so
-            # a stop inside this chunk makes the speculative one a no-op)
+            # a stop inside this chunk makes the speculative one a no-op).
+            # sumstat_refit mode can't speculate: the next chunk's carry
+            # needs the host predictor refit on THIS chunk's last population
             g_next = _g_limit(t + g_limit)
             res_next = (
                 _dispatch_chunk(res["carry"], t + g_limit, g_next)
-                if g_next > 0 else None
+                if g_next > 0 and not sumstat_refit else None
             )
             outs = res["outs"]
             # per-particle sum stats dominate the chunk fetch payload
             # (~70%); when the History doesn't retain them for a generation
-            # the row never leaves the device
-            ss_wanted = [self.history.wants_sum_stats(t + g)
-                         for g in range(g_limit)]
+            # the row never leaves the device. The sumstat-refit mode needs
+            # only the chunk's FINAL generation (the boundary refit fits on
+            # it; an early-stopped chunk never refits).
+            ss_wanted = [
+                (sumstat_refit and g == g_limit - 1)
+                or self.history.wants_sum_stats(t + g)
+                for g in range(g_limit)
+            ]
             if all(ss_wanted):
                 fetched = jax.device_get(outs)
                 ss_rows = None
@@ -1236,8 +1267,11 @@ class ABCSMC:
                             self.acceptor._max_found, mf
                         )
                 if adaptive:
+                    dwn = fetched["dist_w_next"]
+                    # sumstat-bearing distances carry {"w": ..., "ss": ...}
+                    w_next = dwn["w"][g] if isinstance(dwn, dict) else dwn[g]
                     self.distance_function.weights[t + 1] = np.asarray(
-                        fetched["dist_w_next"][g], np.float64
+                        w_next, np.float64
                     )
                 if hasattr(self.acceptor, "note_epsilon"):
                     self.acceptor.note_epsilon(t, current_eps, adaptive)
@@ -1249,6 +1283,8 @@ class ABCSMC:
                     if p > 0
                 }
                 last_pop = pop
+                last_sample = sample
+                last_eps, last_acc_rate = current_eps, acceptance_rate
                 if self._check_stop(t, current_eps, minimum_epsilon,
                                     max_nr_populations, acceptance_rate,
                                     min_acceptance_rate, sims_total,
@@ -1257,16 +1293,36 @@ class ABCSMC:
                     stop = True
                     break
                 t += 1
-            if last_pop is not None:
+            continuing = not stop and last_pop is not None and g_next > 0
+            if last_pop is not None and not (continuing and sumstat_refit):
+                # (the sumstat-refit continue path fits these inside
+                # _adapt_components below — don't pay the KDE fit twice)
                 self._model_probs = {
                     m: float(last_pop.model_probabilities_array()[m])
                     for m in last_pop.get_alive_models()
                 }
                 self._fit_transitions(last_pop)
-            if stop or last_pop is None or res_next is None:
+            if not continuing:
                 break
-            # advance to the speculatively-dispatched chunk
-            res, g_limit = res_next, g_next
+            if sumstat_refit:
+                # host boundary adaptation: refit the learned statistics on
+                # this chunk's final population, refit the scale weights in
+                # the NEW feature space and re-derive the epsilon under the
+                # updated distance (the per-generation _adapt_components
+                # semantics applied at chunk granularity), then dispatch the
+                # next chunk off a fresh host-built carry.
+                # Declared deviation: the boundary scale refit sees the
+                # ACCEPTED population only (the reference's
+                # all_particles=False convention) — the all-evaluations
+                # ring stays on device; in-chunk refits use the full ring.
+                self._adapt_components(t - 1, last_sample, last_pop,
+                                       last_eps, last_acc_rate)
+                res, g_limit = (
+                    _dispatch_chunk(rebuild_carry(t), t, g_next), g_next
+                )
+            else:
+                # advance to the speculatively-dispatched chunk
+                res, g_limit = res_next, g_next
         self.history.done()
         return self.history
 

@@ -653,7 +653,8 @@ class DeviceContext:
                         multiplier: float, trans_cls, scaling: float,
                         bandwidth_selector, dims: tuple,
                         stochastic: bool = False,
-                        temp_config: tuple | None = None):
+                        temp_config: tuple | None = None,
+                        sumstat_transform: bool = False):
         """One jitted program for G WHOLE GENERATIONS (transition mode).
 
         The TPU-native endgame of the reference's per-generation scatter/
@@ -696,7 +697,7 @@ class DeviceContext:
                      eps_quantile, eps_weighted, alpha, multiplier,
                      trans_cls.__name__, scaling,
                      getattr(bandwidth_selector, "__name__", "?"), dims,
-                     stochastic, temp_config)
+                     stochastic, temp_config, sumstat_transform)
         if cache_key in self._kernels:
             return self._kernels[cache_key]
         if stochastic and self.K != 1:
@@ -718,14 +719,24 @@ class DeviceContext:
         weight_post = (
             self.distance.device_weight_update() if adaptive else None
         )
-        scale_reduce = (
-            self.distance.device_record_reduce(self.spec) if adaptive
-            else None
-        )
-        if adaptive and (weight_post is None or scale_reduce is None):
-            raise RuntimeError(
-                "adaptive multigen run needs device scale + weight twins"
-            )
+        scale_reduce = ss_fn = scale_impl = None
+        if adaptive and sumstat_transform:
+            # the record ring holds RAW sumstats; the scale reduction runs
+            # in the TRANSFORMED feature space of the (chunk-constant)
+            # learned statistics, so compose the sumstat device twin with
+            # the raw scale twin
+            scale_impl = self.distance.device_scale_impl()
+            ss_fn = self.distance.sumstat.device_fn(self.spec)
+            if weight_post is None or scale_impl is None:
+                raise RuntimeError(
+                    "adaptive multigen run needs device scale + weight twins"
+                )
+        elif adaptive:
+            scale_reduce = self.distance.device_record_reduce(self.spec)
+            if weight_post is None or scale_reduce is None:
+                raise RuntimeError(
+                    "adaptive multigen run needs device scale + weight twins"
+                )
 
         K = self.K
 
@@ -817,10 +828,19 @@ class DeviceContext:
                 )
                 w_norm = normalize_log_weights(res["log_weight"], k_mask)
 
-                if adaptive:
+                if adaptive and sumstat_transform:
+                    ssp = dist_w["ss"]
+                    rec_t = jax.vmap(lambda r: ss_fn(r, ssp))(rec["sumstats"])
+                    scale = scale_impl(rec_t, rec["valid"],
+                                       ss_fn(self.x0, ssp))
+                    dist_w_next = {"w": weight_post(scale), "ss": ssp}
+                elif adaptive:
                     scale = scale_reduce(rec["sumstats"], rec["valid"],
                                          self.x0)
                     dist_w_next = weight_post(scale)
+                else:
+                    dist_w_next = dist_w
+                if adaptive:
                     # recompute accepted distances under the NEW weights
                     # before the epsilon update (host _recompute_distances
                     # semantics; history keeps the original values)
@@ -828,7 +848,7 @@ class DeviceContext:
                         lambda s: dist_fn(s, self.x0, dist_w_next)
                     )(res["sumstats"])
                 else:
-                    dist_w_next, d_new = dist_w, res["distance"]
+                    d_new = res["distance"]
 
                 if eps_quantile:
                     pts = jnp.where(k_mask, d_new, jnp.inf)
@@ -905,7 +925,23 @@ class DeviceContext:
             # propagates in-device stops into speculative chunks)
             return {"outs": outs, "carry": final_carry}
 
-        fn = jax.jit(multigen_fn)
+        if self.mesh is not None and len(
+            {d.process_index for d in self.mesh.devices.flat}
+        ) > 1:
+            # multi-host: replicate the per-generation outputs (one
+            # all-gather over DCN at the CHUNK barrier — G generations per
+            # cross-host sync instead of one) so every host can device_get
+            # the reservoirs for the replicated persist/adaptation step;
+            # the carry stays device-resident for chunk chaining
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            fn = jax.jit(
+                multigen_fn,
+                out_shardings={"outs": NamedSharding(self.mesh, P()),
+                               "carry": None},
+            )
+        else:
+            fn = jax.jit(multigen_fn)
         self._kernels[cache_key] = fn
         return fn
 

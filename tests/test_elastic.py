@@ -1,0 +1,153 @@
+"""Elastic worker pool (the Redis-sampler analog) — join/leave/die freely.
+
+The reference's signature execution capability (SURVEY.md §2.3 Redis row,
+§5.3): workers connect to a broker at any time, a worker SIGKILLed
+mid-generation costs nothing but throughput, and a late joiner picks up
+the current generation. Exercised here with REAL worker subprocesses
+against the in-process TCP broker.
+"""
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import pyabc_tpu as pt
+from pyabc_tpu.broker.protocol import request
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+WORKER_CODE = (
+    "from pyabc_tpu.broker import run_worker; "
+    "import sys; run_worker('127.0.0.1', int(sys.argv[1]))"
+)
+
+NOISE_SD = 0.5
+X_OBS = 1.0
+
+
+def _host_model(delay_s: float = 0.0):
+    def sim(pars):
+        if delay_s:
+            time.sleep(delay_s)
+        return {"x": pars["theta"] + NOISE_SD * np.random.normal()}
+
+    return pt.SimpleModel(sim, name="gauss_host")
+
+
+def _abc(sampler, delay_s=0.0, pop=80):
+    prior = pt.Distribution(theta=pt.RV("norm", 0.0, 1.0))
+    return pt.ABCSMC(_host_model(delay_s), prior, pt.PNormDistance(p=2),
+                     population_size=pop,
+                     eps=pt.QuantileEpsilon(initial_epsilon=1.5, alpha=0.5),
+                     sampler=sampler, seed=4)
+
+
+def _spawn_worker(port):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.Popen(
+        [sys.executable, "-c", WORKER_CODE, str(port)], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+
+
+@pytest.fixture
+def sampler():
+    s = pt.ElasticSampler(host="127.0.0.1", port=0, batch=5,
+                          generation_timeout=240.0)
+    yield s
+    s.stop()
+
+
+def test_posterior_with_two_workers(sampler):
+    port = sampler.address[1]
+    workers = [_spawn_worker(port) for _ in range(2)]
+    try:
+        abc = _abc(sampler)
+        abc.new("sqlite://", {"x": X_OBS})
+        h = abc.run(max_nr_populations=3)
+        assert h.n_populations == 3
+        df, w = h.get_distribution(0, h.max_t)
+        mu = float(np.sum(df["theta"] * w))
+        # conjugate posterior mean 0.8 (prior N(0,1), noise sd 0.5)
+        assert mu == pytest.approx(0.8, abs=0.35)
+        # both workers contributed
+        kind, status = request(("127.0.0.1", port), ("status",))
+        assert kind == "status"
+        contributing = [w_ for w_, info in status.workers.items()
+                        if info.get("n_results", 0) > 0]
+        assert len(contributing) == 2
+    finally:
+        for p in workers:
+            p.kill()
+
+
+def test_worker_killed_mid_generation_costs_only_throughput(sampler):
+    port = sampler.address[1]
+    workers = [_spawn_worker(port) for _ in range(2)]
+    killed = {}
+
+    def killer():
+        # let the generation get going, then SIGKILL one worker cold
+        time.sleep(1.5)
+        workers[0].send_signal(signal.SIGKILL)
+        killed["at"] = time.time()
+
+    th = threading.Thread(target=killer)
+    try:
+        abc = _abc(sampler, delay_s=0.01, pop=60)
+        abc.new("sqlite://", {"x": X_OBS})
+        th.start()
+        h = abc.run(max_nr_populations=2)  # ~2.4k evals x 10ms / workers
+        assert h.n_populations == 2, "run must complete despite the kill"
+        assert "at" in killed
+        df, w = h.get_distribution(0, h.max_t)
+        mu = float(np.sum(df["theta"] * w))
+        assert mu == pytest.approx(0.8, abs=0.45)
+    finally:
+        th.join()
+        for p in workers:
+            p.kill()
+
+
+def test_late_joining_worker_picks_up_current_generation(sampler):
+    port = sampler.address[1]
+    late = {}
+    workers = [_spawn_worker(port)]
+
+    def joiner():
+        time.sleep(1.0)
+        workers.append(_spawn_worker(port))
+        late["at"] = time.time()
+
+    th = threading.Thread(target=joiner)
+    try:
+        abc = _abc(sampler, delay_s=0.01, pop=60)
+        abc.new("sqlite://", {"x": X_OBS})
+        th.start()
+        h = abc.run(max_nr_populations=2)
+        assert h.n_populations == 2
+        assert "at" in late
+        kind, status = request(("127.0.0.1", port), ("status",))
+        contributing = [w_ for w_, info in status.workers.items()
+                       if info.get("n_results", 0) > 0]
+        assert len(contributing) == 2, "late joiner must have contributed"
+    finally:
+        th.join()
+        for p in workers:
+            p.kill()
+
+
+def test_manager_status_roundtrip(sampler):
+    port = sampler.address[1]
+    kind, status = request(("127.0.0.1", port), ("status",))
+    assert kind == "status"
+    assert status.done
+    assert status.n_target == 0

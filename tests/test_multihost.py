@@ -50,12 +50,85 @@ print(f"RESULT pid={pid} mu={mu:.4f} n={len(df)}", flush=True)
 """
 
 
+WORKER_FUSED = """
+import sys
+pid = int(sys.argv[1])
+port = sys.argv[2]
+db_path = sys.argv[3]
+from pyabc_tpu.parallel import distributed as dist
+dist.initialize(f"127.0.0.1:{port}", num_processes=2, process_id=pid,
+                platform="cpu", num_cpu_devices=4)
+import jax
+import numpy as np
+import pyabc_tpu as pt
+
+NOISE_SD = 0.5
+
+@pt.JaxModel.from_function(["theta"], name="gauss")
+def model(key, theta):
+    return {"x": theta[0] + NOISE_SD * jax.random.normal(key)}
+
+mesh = dist.global_mesh()
+prior = pt.Distribution(theta=pt.RV("norm", 0.0, 1.0))
+abc = pt.ABCSMC(model, prior, pt.AdaptivePNormDistance(p=2),
+                population_size=200, eps=pt.MedianEpsilon(), seed=13,
+                mesh=mesh, fused_generations=3)
+abc.new(dist.primary_db(f"sqlite:///{db_path}"), {"x": 1.0})
+assert abc._fused_chunk_capable(), "fused chunks must be mesh-capable"
+h = abc.run(max_nr_populations=6)
+fused = [h.get_telemetry(t).get("fused_chunk") for t in range(h.n_populations)]
+assert any(fused), f"chunked loop not taken: {fused}"
+df, w = h.get_distribution(0, h.max_t)
+mu = float(np.sum(df["theta"] * w))
+print(f"RESULT pid={pid} mu={mu:.4f} n={len(df)} gens={h.n_populations}",
+      flush=True)
+"""
+
+
 def _free_port():
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+@pytest.mark.slow
+def test_two_process_fused_chunks(tmp_path):
+    """Fused multi-generation chunks over a TWO-PROCESS global mesh: the
+    chunk is the cross-host barrier unit (G generations per DCN sync), and
+    both hosts must stay in lock-step through the on-device adaptation."""
+    script = tmp_path / "worker_fused.py"
+    script.write_text(WORKER_FUSED)
+    db = tmp_path / "mh_fused.db"
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(pid), str(port), str(db)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=420)
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid} failed:\n{out[-3000:]}"
+    results = [
+        line for out in outs for line in out.splitlines()
+        if line.startswith("RESULT")
+    ]
+    assert len(results) == 2, outs
+    mus = [float(r.split("mu=")[1].split()[0]) for r in results]
+    assert mus[0] == pytest.approx(mus[1], abs=1e-6)
+    assert mus[0] == pytest.approx(0.8, abs=0.3)
+    assert db.exists()
 
 
 @pytest.mark.slow
