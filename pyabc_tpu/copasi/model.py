@@ -70,7 +70,7 @@ class BasicoModel(Model):
                 f"nor a global quantity of the COPASI model"
             )
 
-    def sample(self, pars):  # pragma: no cover - needs basico installed
+    def sample(self, pars):  # exercised against a mock basico in tests
         import basico
 
         dm = basico.load_model(self.model_file)

@@ -168,6 +168,16 @@ class ABCSMC:
         #: correction is needed — reference redis_eps look_ahead semantics
         #: without the preliminary-weight bias)
         self.pipeline = pipeline
+        #: speculative eps=+inf look-ahead rounds only pay off when the
+        #: host's strategy adaptation outweighs one extra device round
+        #: trip; measured per generation and gated on this threshold
+        #: (seconds). 0 forces speculation for every eligible generation;
+        #: inf disables it. Measured on a v5e via the axon tunnel: a
+        #: sync costs ~0.1-0.2 s, and toy/medium configs (pop <= 2000,
+        #: ARS records <= ~20k) adapt faster than that — speculation LOST
+        #: 19-88% there, so the default only engages for genuinely slow
+        #: adaptation (huge record sets, big LocalTransition KDTree fits).
+        self.speculation_min_adapt_s = 0.25
         #: run up to this many WHOLE GENERATIONS per device dispatch when
         #: every component has a device-adaptation twin (K=1, constant pop,
         #: MVN transition, quantile/list epsilon, (adaptive) p-norm,
@@ -672,11 +682,24 @@ class ABCSMC:
         the distance changed (pop.distances is then recomputed in place;
         persist BEFORE calling this, or pin a copy, to keep the reference's
         history-keeps-old-distances semantics)."""
+        self._adapt_proposal(pop)
+        return self._adapt_strategies(t, sample, pop, current_eps,
+                                      acceptance_rate)
+
+    def _adapt_proposal(self, pop) -> None:
+        """The proposal-defining part of adaptation (model probabilities +
+        transition refits) — split out so the pipelined loop can dispatch a
+        SPECULATIVE t+1 proposal round before the slow strategy updates."""
         self._model_probs = {
             m: float(pop.model_probabilities_array()[m])
             for m in pop.get_alive_models()
         }
         self._fit_transitions(pop)
+
+    def _adapt_strategies(self, t, sample, pop, current_eps,
+                          acceptance_rate) -> bool:
+        """Distance / acceptor / epsilon / population-size updates (the
+        slow, proposal-independent part of adaptation)."""
         all_ss = self._all_sumstats_provider(sample)
         changed = _call_filtered(
             self.distance_function.update,
@@ -1326,6 +1349,93 @@ class ABCSMC:
         self.history.done()
         return self.history
 
+    # ------------------------------------------------ speculative proposals
+    def _speculation_capable(self) -> bool:
+        """Look-ahead analog for UNFUSED device configs (reference
+        ``redis_eps`` look_ahead, SURVEY.md §2.3): a full proposal round
+        for generation t+1 is dispatched at eps=+inf as soon as the
+        transitions are refit on generation t — i.e. BEFORE the slow
+        strategy updates (ARS temperature bisection, epsilon quantile,
+        acceptor norms) — and acceptance is applied on the host once the
+        true threshold is known (delayed evaluation). Sound whenever the
+        recorded per-lane distance is invariant under the pending strategy
+        updates: the distance must not re-weight between generations and
+        the acceptor must decide from (distance, eps) alone."""
+        if not self._device_capable:
+            return False
+        if not isinstance(self.sampler, BatchedSampler):
+            return False
+        if np.isfinite(self.max_nr_recorded_particles):
+            return False  # capped record retention: keep one record path
+        d = self.distance_function
+        # WHITELIST of generation-invariant distances: a plain p-norm
+        # without weight schedules/sumstats, or a stochastic kernel (static
+        # noise model). Anything adaptive (AdaptivePNormDistance,
+        # AdaptiveAggregatedDistance, ...) re-weights between generations,
+        # making speculative distances incomparable to the new threshold.
+        static_pnorm = (
+            type(d) is PNormDistance and d.sumstat is None
+            and not any(k >= 0 for k in d.weights)
+        )
+        if not static_pnorm and not isinstance(d, StochasticKernel):
+            return False
+        a = self.acceptor
+        if type(a) is UniformAcceptor and not a.use_complete_history:
+            return True
+        # StochasticAcceptor: the kernel value v is temperature-independent,
+        # so acceptance can be applied on the host once T/pdf_norm are known
+        return type(a) is StochasticAcceptor
+
+    def _dispatch_speculative_round(self, t_next: int, n_estimate: int):
+        """Enqueue ONE eps=+inf proposal round for generation t_next off the
+        just-refit transitions (async; the host continues adapting)."""
+        import jax
+
+        from ..core.random import generation_key
+
+        ctx = self._build_device_ctx()
+        B = self.sampler._pick_B(n_estimate)
+        mode, dyn = ctx.build_dyn_args(
+            t=t_next, eps_value=np.inf,
+            model_probabilities=self._model_probs,
+            transitions=self.transitions,
+            model_perturbation_kernel=self.model_perturbation_kernel,
+        )
+        # dedicated key stream: must not collide with the generation
+        # kernel's fold_in(gen_key, round) sequence
+        key = jax.random.fold_in(
+            generation_key(self._root_key, t_next), 1 << 20
+        )
+        out = ctx.round_kernel(B, mode)(key, dyn)
+        return {"out": out, "B": B, "accept": self._speculative_accept,
+                "t": t_next}
+
+    def _speculative_accept(self, t_next: int, fetched: dict):
+        """Delayed acceptance for a speculative round, applied AFTER the
+        strategy updates fixed generation t_next's threshold/temperature.
+        Returns (accept_mask, extra_log_weight)."""
+        valid = np.asarray(fetched["valid"], bool)
+        d = np.asarray(fetched["distance"], np.float64)
+        if type(self.acceptor) is StochasticAcceptor:
+            from ..distance.kernel import SCALE_LIN
+
+            logv = (np.log(np.maximum(d, 1e-300))
+                    if self.distance_function.ret_scale == SCALE_LIN else d)
+            norm = self.acceptor.pdf_norms[t_next]
+            temp = self.eps(t_next)
+            log_ratio = (logv - norm) / temp
+            # keyed stream (seed, generation): the delayed acceptance must
+            # stay reproducible like every other draw in the device path
+            rng = np.random.default_rng((self.seed, t_next, 0x5BEC))
+            u = rng.uniform(size=len(d))
+            accept = valid & (np.log(np.maximum(u, 1e-300)) < log_ratio)
+            extra = (np.clip(log_ratio, 0.0, None)
+                     if self.acceptor.apply_importance_weighting
+                     else np.zeros_like(d))
+            return accept, extra
+        accept = valid & (d <= self.eps(t_next))
+        return accept, np.zeros_like(d)
+
     def _loop_pipelined(self, t0, minimum_epsilon, max_nr_populations,
                         min_acceptance_rate, max_total_nr_simulations,
                         max_walltime, start_walltime) -> History:
@@ -1345,8 +1455,9 @@ class ABCSMC:
         t = t0
         sims_total = self.history.total_nr_simulations
         distance_changed_at_t = False
+        last_strategies_s = 0.0  # first generation never speculates
 
-        def _dispatch(t_next):
+        def _dispatch(t_next, speculative=None):
             t_d0 = time.time()
             current_eps = self.eps(t_next)
             if hasattr(self.acceptor, "note_epsilon"):
@@ -1361,11 +1472,17 @@ class ABCSMC:
             spec = self._generation_spec(t_next)
             spec_s = time.time() - t_d0
             handle = self.sampler.dispatch(n_t, spec, t_next,
-                                           max_eval=max_eval)
+                                           max_eval=max_eval,
+                                           speculative=speculative)
             handle["dispatch_telemetry"] = {
                 "spec_s": round(spec_s, 4),
                 "enqueue_s": round(time.time() - t_d0 - spec_s, 4),
             }
+            if speculative is not None:
+                handle["dispatch_telemetry"]["speculative_accepted"] = (
+                    len(handle["spec"]["slots"])
+                    if handle.get("spec") else 0
+                )
             return handle, current_eps, n_t
 
         handle, current_eps, n_t = _dispatch(t)
@@ -1394,11 +1511,23 @@ class ABCSMC:
             # keeps the original values)
             db_pop = copy.copy(pop)
 
-            # central adaptation — must finish before t+1 can be proposed
+            # central adaptation — the PROPOSAL part (transition refits)
+            # runs first so a speculative eps=+inf round for t+1 can start
+            # on the device WHILE the slow strategy updates (temperature
+            # bisection, epsilon quantiles, acceptor norms) run on the host;
+            # its delayed acceptance is applied at dispatch time (reference
+            # look-ahead with delayed evaluation, SURVEY.md §2.3)
             t_adapt0 = time.time()
-            distance_changed_at_t = self._adapt_components(
+            spec_round = None
+            self._adapt_proposal(pop)
+            if (self._speculation_capable()
+                    and last_strategies_s > self.speculation_min_adapt_s):
+                spec_round = self._dispatch_speculative_round(t + 1, n_t)
+            t_strat0 = time.time()
+            distance_changed_at_t = self._adapt_strategies(
                 t, sample, pop, current_eps, acceptance_rate
             )
+            last_strategies_s = time.time() - t_strat0
             adapt_s = time.time() - t_adapt0
 
             stop = self._check_stop(t, current_eps, minimum_epsilon,
@@ -1409,7 +1538,8 @@ class ABCSMC:
 
             if not stop:
                 # LOOK-AHEAD: device starts generation t+1 now ...
-                next_handle, next_eps, next_n = _dispatch(t + 1)
+                next_handle, next_eps, next_n = _dispatch(
+                    t + 1, speculative=spec_round)
 
             # ... while the host persists generation t
             t_persist0 = time.time()

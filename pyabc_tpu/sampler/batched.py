@@ -127,7 +127,7 @@ class BatchedSampler(Sampler):
     supports_pipelining = True
 
     def dispatch(self, n, generation_spec, t, *, max_eval=np.inf,
-                 all_accepted=False):
+                 all_accepted=False, speculative=None):
         """Launch the whole generation on the device WITHOUT blocking.
 
         Returns an opaque handle for :meth:`collect`. The TPU analog of the
@@ -135,6 +135,11 @@ class BatchedSampler(Sampler):
         generation t+1, the host persists/analyzes generation t
         (SURVEY.md §2.3 look-ahead row; here proposals are built from FINAL
         generation-t weights, so no weight correction is needed).
+
+        ``speculative``: an eps=+inf proposal round ALREADY dispatched for
+        this generation (ABCSMC._dispatch_speculative_round) — its delayed
+        host acceptance is applied now that the thresholds are final, and
+        the main generation kernel only samples the SHORTFALL.
         """
         ctx = generation_spec.device
         if ctx is None:
@@ -143,6 +148,43 @@ class BatchedSampler(Sampler):
         # all_accepted arrives as the prior kernel with eps=+inf (calibration
         # shares the prior compile); legacy 'calibration' mode still works
         sample = self.sample_factory()
+        spec_block = None
+        n_target = n
+        if speculative is not None:
+            import jax
+
+            fetched = jax.device_get(speculative["out"])
+            accept, extra_lw = speculative["accept"](
+                speculative["t"], fetched
+            )
+            B_spec = speculative["B"]
+            idx = np.flatnonzero(accept)
+            spec_block = {
+                "ms": np.asarray(fetched["m"], np.int32)[idx],
+                "thetas": np.asarray(fetched["theta"], np.float64)[idx],
+                "sumstats": np.asarray(fetched["sumstats"], np.float64)[idx],
+                "distances": np.asarray(fetched["distance"],
+                                        np.float64)[idx],
+                "log_weights": (np.asarray(fetched["log_weight"],
+                                           np.float64)[idx]
+                                + np.asarray(extra_lw, np.float64)[idx]),
+                # negative slots: the speculative round chronologically
+                # precedes every main-kernel round, and the sort-by-slot
+                # trim must reflect that
+                "slots": idx - B_spec,
+                "n_valid": int(np.asarray(fetched["valid"], bool).sum()),
+                "records": {
+                    "distances": np.asarray(
+                        fetched["distance"], np.float64),
+                    "accepted": np.asarray(accept, bool),
+                    "valid": np.asarray(fetched["valid"], bool),
+                    "ms": np.asarray(fetched["m"], np.int32),
+                    "thetas": np.asarray(fetched["theta"], np.float64),
+                    "logqs": np.asarray(fetched.get("logq"), np.float64)
+                    if "logq" in fetched else None,
+                },
+            }
+            n_target = max(n - len(idx), 0)
         B = self._pick_B(n)
         n_cap = _pow2(n, 64)
         rec_cap = 1
@@ -154,11 +196,12 @@ class BatchedSampler(Sampler):
             max_rounds = max(1, min(max_rounds, int(max_eval) // B))
         out = ctx.dispatch_generation(
             generation_spec.gen_key, B, mode, dyn, n_cap=n_cap,
-            rec_cap=rec_cap, max_rounds=max_rounds, n_target=n,
+            rec_cap=rec_cap, max_rounds=max_rounds, n_target=n_target,
             record_proposal=(sample.record_rejected
                              and sample.record_proposal_info),
         )
-        return {"out": out, "sample": sample, "n": n, "n_cap": n_cap}
+        return {"out": out, "sample": sample, "n": n, "n_cap": n_cap,
+                "spec": spec_block}
 
     def collect(self, handle) -> Sample:
         """Block on a dispatched generation and build the Sample.
@@ -176,7 +219,8 @@ class BatchedSampler(Sampler):
         host["rec_sumstats_dev"] = out.get("rec_sumstats")
         host["rec_valid_dev"] = out.get("rec_valid")
         return self._finalize_fused(host, handle["sample"], handle["n"],
-                                    handle["n_cap"])
+                                    handle["n_cap"],
+                                    spec=handle.get("spec"))
 
     def _sample_fused(self, n, ctx, mode, dyn, gen_key, *, max_eval,
                       all_accepted):
@@ -189,21 +233,35 @@ class BatchedSampler(Sampler):
             n, spec, None, max_eval=max_eval, all_accepted=all_accepted
         ))
 
-    def _finalize_fused(self, out, sample, n, n_cap):
+    def _finalize_fused(self, out, sample, n, n_cap, spec=None):
         # count only valid lanes as model evaluations: proposals that failed
         # the prior-support redraws never reach the model in the reference
         # (generate_valid_proposal retries without counting), and counting
         # them skews acceptance-rate telemetry feeding adaptive schemes
-        self.nr_evaluations_ = max(int(out["n_valid"]), 1)
+        n_valid = int(out["n_valid"]) + (spec["n_valid"] if spec else 0)
+        self.nr_evaluations_ = max(n_valid, 1)
         k = min(int(out["n_acc"]), n_cap, n)
-        weights = exp_normalize_log_weights(out["log_weight"][:k])
+        ms = np.asarray(out["m"][:k], np.int32)
+        thetas = np.asarray(out["theta"][:k], np.float64)
+        distances = np.asarray(out["distance"][:k], np.float64)
+        sumstats = np.asarray(out["sumstats"][:k], np.float64)
+        log_w = np.asarray(out["log_weight"][:k], np.float64)
+        slots = np.asarray(out["slot"][:k])
+        if spec is not None and len(spec["slots"]):
+            # speculative round accepted first (negative slots): merge at
+            # the RAW log-weight level so relative weighting stays exact
+            ms = np.concatenate([spec["ms"], ms])
+            thetas = np.concatenate([spec["thetas"], thetas])
+            distances = np.concatenate([spec["distances"], distances])
+            sumstats = np.concatenate([spec["sumstats"], sumstats])
+            log_w = np.concatenate([spec["log_weights"], log_w])
+            slots = np.concatenate([spec["slots"], slots])
+        weights = exp_normalize_log_weights(log_w)
         sample.set_accepted(
-            ms=out["m"][:k], thetas=np.asarray(out["theta"][:k], np.float64),
-            weights=weights,
-            distances=np.asarray(out["distance"][:k], np.float64),
-            sumstats=np.asarray(out["sumstats"][:k], np.float64),
-            proposal_ids=out["slot"][:k],
+            ms=ms, thetas=thetas, weights=weights, distances=distances,
+            sumstats=sumstats, proposal_ids=slots,
         )
+        sample.trim(n)
         if sample.record_rejected:
             from .base import DeviceRecords
 
@@ -249,8 +307,32 @@ class BatchedSampler(Sampler):
                     sample.all_ms = prop_kw["ms"]
                     sample.all_thetas = prop_kw["thetas"]
                     sample.all_proposal_pds = prop_kw["proposal_pds"]
+            if spec is not None:
+                # speculative lanes are real evaluations: prepend their
+                # records (distance/accepted + proposal info) so adaptive
+                # schemes (e.g. the AcceptanceRateScheme) see them; their
+                # sumstats are not folded into the device ring — configs
+                # that reduce the ring (adaptive distances) never speculate
+                r = spec["records"]
+                rv = r["valid"]
+                def _pre(a, b):
+                    return np.concatenate([a[rv], b]) if b is not None \
+                        else a[rv]
+                if sample.all_distances is not None:
+                    sample.all_distances = _pre(
+                        r["distances"], sample.all_distances)
+                    sample.all_accepted = _pre(
+                        r["accepted"], sample.all_accepted)
+                if sample.all_proposal_pds is not None \
+                        and r["logqs"] is not None:
+                    sample.all_ms = _pre(r["ms"], sample.all_ms)
+                    sample.all_thetas = _pre(r["thetas"], sample.all_thetas)
+                    sample.all_proposal_pds = np.concatenate(
+                        [np.exp(r["logqs"][rv]), sample.all_proposal_pds])
+        n_acc_total = int(out["n_acc"]) + (
+            len(spec["slots"]) if spec is not None else 0)
         self._rate_estimate = max(
-            int(out["n_acc"]) / max(self.nr_evaluations_, 1),
+            n_acc_total / max(self.nr_evaluations_, 1),
             1.0 / max(self.nr_evaluations_, 1),
         )
         return sample

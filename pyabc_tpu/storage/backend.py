@@ -1,0 +1,214 @@
+"""Database backend seam for :class:`History`.
+
+Reference parity: the reference History is SQLAlchemy over any dialect —
+in practice SQLite for single-host runs and PostgreSQL for shared cluster
+databases (SURVEY.md §2.4). Here the seam is a thin DB-API adapter layer
+instead of an ORM:
+
+- ``sqlite:...`` URLs return the raw stdlib ``sqlite3`` connection —
+  zero overhead, identical behavior to the pre-seam code, and the path
+  every test exercises.
+- ``postgresql://...`` URLs return a translating adapter over psycopg2
+  that maps the sqlite idioms History speaks (``?`` placeholders,
+  ``AUTOINCREMENT`` DDL, ``BLOB``, ``BEGIN IMMEDIATE``, ``executescript``,
+  ``lastrowid``) onto PostgreSQL. psycopg2 is optional; without it the
+  URL raises an informative error at construction (the gating contract
+  shared by all optional integrations). The translation layer itself is
+  unit-tested against a recording fake DB-API driver
+  (``tests/test_backend.py``) — the same stub-contract pattern used for
+  the SGE/R/Julia adapters.
+
+The TPU-pod scope note: a pod's hosts do NOT share one History — only the
+primary process persists (``parallel.distributed.primary_db``), so sqlite
+is fully sufficient for on-pod runs; postgres matters when many SEPARATE
+studies feed one shared lab database, which is exactly the adapter's use
+case.
+"""
+from __future__ import annotations
+
+import re
+import sqlite3
+
+
+def translate_sql(sql: str) -> str:
+    """sqlite-idiom SQL -> postgres: qmark params to %s (string literals in
+    our schema contain no '?')."""
+    return sql.replace("?", "%s")
+
+
+def translate_ddl(schema: str) -> str:
+    """Schema DDL rewrite for postgres."""
+    out = schema.replace(
+        "INTEGER PRIMARY KEY AUTOINCREMENT", "BIGSERIAL PRIMARY KEY"
+    )
+    out = out.replace(" BLOB", " BYTEA")
+    return out
+
+
+def split_script(script: str) -> list[str]:
+    """executescript emulation: split on ';' (our schema has no literals
+    or triggers containing semicolons)."""
+    return [s.strip() for s in script.split(";") if s.strip()]
+
+
+_INSERT_RE = re.compile(r"^\s*INSERT\b", re.IGNORECASE)
+_EXPLICIT_ID_RE = re.compile(r"\(\s*id\s*[,)]", re.IGNORECASE)
+_INSERT_TABLE_RE = re.compile(r"^\s*INSERT\s+INTO\s+(\w+)", re.IGNORECASE)
+
+
+def wants_returning_id(sql: str) -> bool:
+    """lastrowid emulation: append RETURNING id to INSERTs that rely on
+    autoincrement (not to executemany-style inserts with explicit ids)."""
+    return bool(_INSERT_RE.match(sql)) and not _EXPLICIT_ID_RE.search(sql)
+
+
+def explicit_id_insert_table(sql: str) -> str | None:
+    """Table name of an INSERT carrying explicit ids, else None.
+
+    Postgres sequences do NOT advance on explicit-id inserts (unlike
+    sqlite AUTOINCREMENT, which tracks max id), so the adapter must
+    resynchronize the table's sequence afterwards or the next
+    autoincrement insert collides with an existing id."""
+    if not _EXPLICIT_ID_RE.search(sql):
+        return None
+    m = _INSERT_TABLE_RE.match(sql)
+    return m.group(1) if m else None
+
+
+class PgCursor:
+    """DB-API cursor adapter translating History's sqlite idioms."""
+
+    def __init__(self, cur):
+        self._cur = cur
+        self.lastrowid = None
+
+    def execute(self, sql, params=()):
+        if sql.strip().upper().startswith("BEGIN IMMEDIATE"):
+            # sqlite's BEGIN IMMEDIATE takes the db write lock up front
+            # (History allocates explicit ids from SELECT MAX(id) under
+            # it); the postgres equivalent is a transaction-scoped
+            # advisory lock serializing all History appenders
+            self._cur.execute("BEGIN")
+            self._cur.execute(
+                "SELECT pg_advisory_xact_lock(hashtext('pyabc_tpu_history'))"
+            )
+            return self
+        sql_t = translate_sql(sql)
+        if wants_returning_id(sql):
+            self._cur.execute(sql_t + " RETURNING id", params)
+            self.lastrowid = self._cur.fetchone()[0]
+            return self
+        self._cur.execute(sql_t, params)
+        return self
+
+    def executemany(self, sql, seq_of_params):
+        self._cur.executemany(translate_sql(sql), list(seq_of_params))
+        table = explicit_id_insert_table(sql)
+        if table is not None:
+            # keep the BIGSERIAL sequence ahead of explicitly-inserted ids
+            self._cur.execute(
+                f"SELECT setval(pg_get_serial_sequence('{table}', 'id'), "
+                f"(SELECT COALESCE(MAX(id), 1) FROM {table}))"
+            )
+        return self
+
+    def fetchone(self):
+        return self._cur.fetchone()
+
+    def fetchall(self):
+        return self._cur.fetchall()
+
+    @property
+    def description(self):
+        return self._cur.description
+
+    def close(self):
+        self._cur.close()
+
+
+class PgConnection:
+    """DB-API connection adapter with the sqlite3 convenience surface
+    History uses (``.execute`` shortcut, ``executescript``)."""
+
+    def __init__(self, conn):
+        self._conn = conn
+
+    def cursor(self):
+        return PgCursor(self._conn.cursor())
+
+    def execute(self, sql, params=()):
+        cur = self.cursor()
+        cur.execute(sql, params)
+        return cur
+
+    def executescript(self, script):
+        cur = self._conn.cursor()
+        for stmt in split_script(translate_ddl(script)):
+            cur.execute(translate_sql(stmt))
+        self._conn.commit()
+
+    def table_columns(self, table: str) -> list[str]:
+        cur = self._conn.cursor()
+        cur.execute(
+            "SELECT column_name FROM information_schema.columns "
+            "WHERE table_name = %s", (table,),
+        )
+        return [r[0] for r in cur.fetchall()]
+
+    def commit(self):
+        self._conn.commit()
+
+    def rollback(self):
+        self._conn.rollback()
+
+    def close(self):
+        self._conn.close()
+
+
+class Dialect:
+    """Per-backend behavior History depends on."""
+
+    name = "sqlite"
+    Error = sqlite3.Error
+    OperationalError = sqlite3.OperationalError
+
+    @staticmethod
+    def table_columns(conn, table: str) -> list[str]:
+        return [r[1] for r in conn.execute(f"PRAGMA table_info({table})")]
+
+
+class PostgresDialect(Dialect):
+    name = "postgresql"
+
+    def __init__(self):
+        import psycopg2
+
+        self.Error = psycopg2.Error
+        self.OperationalError = psycopg2.OperationalError
+
+    @staticmethod
+    def table_columns(conn, table: str) -> list[str]:
+        return conn.table_columns(table)
+
+
+def open_database(db: str, sqlite_path_fn):
+    """(connection, dialect) for a History db url.
+
+    sqlite URLs return the RAW sqlite3 connection (the default, fully
+    tested path); postgresql URLs return the translating psycopg2 adapter.
+    ``sqlite_path_fn``: lazy url->filesystem-path resolver (only invoked
+    for sqlite urls).
+    """
+    if db.startswith("postgresql:") or db.startswith("postgres:"):
+        try:
+            import psycopg2
+        except ImportError as err:
+            raise ImportError(
+                "postgresql History urls need the optional 'psycopg2' "
+                "package (pip install psycopg2-binary); sqlite urls work "
+                "without any extra dependency"
+            ) from err
+        conn = PgConnection(psycopg2.connect(db))
+        return conn, PostgresDialect()
+    conn = sqlite3.connect(sqlite_path_fn(db), check_same_thread=False)
+    return conn, Dialect()

@@ -3,9 +3,12 @@
 Reference parity: ``pyabc/storage/history.py::History`` +
 ``pyabc/storage/db_model.py`` (table/column names follow the reference ORM:
 abc_smc -> populations -> models -> particles -> parameters, samples for
-sum stats) so reference analysis idioms port. Implemented on stdlib
-``sqlite3`` (SQLAlchemy is not in this image); the db IS the per-generation
-checkpoint, and ``ABCSMC.load`` resumes from it (SURVEY.md §5.4).
+sum stats) so reference analysis idioms port. Implemented on the DB-API
+seam in ``storage/backend.py``: stdlib ``sqlite3`` by default (the db IS
+the per-generation checkpoint, and ``ABCSMC.load`` resumes from it,
+SURVEY.md §5.4); ``postgresql://`` urls ride a translating psycopg2
+adapter for shared cluster databases (reference: SQLAlchemy multi-dialect
+History, SURVEY.md §2.4).
 
 Observed data is stored at pseudo-generation t = PRE_TIME = -1
 (reference ``History.store_initial_data``).
@@ -191,14 +194,16 @@ class History:
         #: resume only work for stored generations.
         self.store_sum_stats = store_sum_stats
         # check_same_thread=False: the async writer thread shares this
-        # connection; sqlite serialized mode + self._lock make it safe
-        self._conn = sqlite3.connect(_db_path(db), check_same_thread=False)
+        # connection; sqlite serialized mode + self._lock make it safe.
+        # Non-sqlite urls go through the backend seam (storage/backend.py)
+        from .backend import open_database
+
+        self._conn, self._dialect = open_database(db, _db_path)
         self._lock = threading.RLock()
         self._writer: _AsyncWriter | None = None
         self._conn.executescript(_SCHEMA)
         # schema migration for dbs created before the telemetry column
-        cols = [r[1] for r in self._conn.execute(
-            "PRAGMA table_info(populations)")]
+        cols = self._dialect.table_columns(self._conn, "populations")
         if "telemetry" not in cols:
             self._conn.execute(
                 "ALTER TABLE populations ADD COLUMN telemetry TEXT"
@@ -310,7 +315,7 @@ class History:
                 # durably persist this generation's partial rows
                 try:
                     self._conn.rollback()
-                except sqlite3.Error:
+                except self._dialect.Error:
                     pass
                 raise
 
@@ -323,7 +328,7 @@ class History:
             # allocates explicit ids from SELECT MAX(id), which would race
             # with another process appending to the same file
             cur.execute("BEGIN IMMEDIATE")
-        except sqlite3.OperationalError:
+        except self._dialect.OperationalError:
             pass  # already inside a transaction
         cur.execute(
             "INSERT INTO populations (abc_smc_id, t, population_end_time, "

@@ -1,0 +1,126 @@
+"""Storage backend seam: sqlite stays raw; postgres translation is unit-
+tested against a recording fake DB-API driver (no server needed — the
+SGE-stub contract pattern)."""
+import sqlite3
+import sys
+import types
+
+import pytest
+
+from pyabc_tpu.storage import History
+from pyabc_tpu.storage.backend import (
+    PgConnection,
+    explicit_id_insert_table,
+    split_script,
+    translate_ddl,
+    translate_sql,
+    wants_returning_id,
+)
+from pyabc_tpu.storage.history import _SCHEMA
+
+
+def test_sqlite_urls_return_raw_connection(tmp_path):
+    h = History(f"sqlite:///{tmp_path}/t.db")
+    assert isinstance(h._conn, sqlite3.Connection)
+    assert h._dialect.name == "sqlite"
+
+
+def test_postgres_url_gated_without_psycopg2(monkeypatch):
+    monkeypatch.setitem(sys.modules, "psycopg2", None)
+    with pytest.raises(ImportError, match="psycopg2"):
+        History("postgresql://user@host/db")
+
+
+def test_sql_translation():
+    assert translate_sql("SELECT * FROM t WHERE a = ? AND b = ?") == \
+        "SELECT * FROM t WHERE a = %s AND b = %s"
+
+
+def test_ddl_translation():
+    ddl = translate_ddl(_SCHEMA)
+    assert "AUTOINCREMENT" not in ddl
+    assert "BIGSERIAL PRIMARY KEY" in ddl
+    assert " BLOB" not in ddl and " BYTEA" in ddl
+    # every schema statement survives the split
+    assert len(split_script(ddl)) == len(split_script(_SCHEMA))
+
+
+def test_returning_id_heuristic():
+    assert wants_returning_id("INSERT INTO models (population_id) VALUES (?)")
+    # explicit-id batched inserts must NOT get RETURNING (executemany)
+    assert not wants_returning_id(
+        "INSERT INTO particles (id, model_id, w, distance) VALUES (?,?,?,?)"
+    )
+    assert not wants_returning_id("SELECT 1")
+
+
+class _FakeCursor:
+    def __init__(self, log):
+        self.log = log
+
+    def execute(self, sql, params=()):
+        self.log.append(("execute", sql, tuple(params)))
+
+    def executemany(self, sql, seq):
+        self.log.append(("executemany", sql, len(list(seq))))
+
+    def fetchone(self):
+        self.log.append(("fetchone",))
+        return (42,)
+
+    def fetchall(self):
+        return []
+
+    description = None
+
+    def close(self):
+        pass
+
+
+class _FakeConn:
+    def __init__(self):
+        self.log = []
+
+    def cursor(self):
+        return _FakeCursor(self.log)
+
+    def commit(self):
+        self.log.append(("commit",))
+
+    def rollback(self):
+        self.log.append(("rollback",))
+
+
+def test_explicit_id_table_detection():
+    assert explicit_id_insert_table(
+        "INSERT INTO particles (id, model_id) VALUES (?,?)") == "particles"
+    assert explicit_id_insert_table(
+        "INSERT INTO models (m) VALUES (?)") is None
+
+
+def test_pg_adapter_translates_and_emulates_lastrowid():
+    fake = _FakeConn()
+    conn = PgConnection(fake)
+    cur = conn.cursor()
+    cur.execute("BEGIN IMMEDIATE")
+    # BEGIN IMMEDIATE's write lock maps to BEGIN + an advisory xact lock
+    assert fake.log[-2] == ("execute", "BEGIN", ())
+    assert "pg_advisory_xact_lock" in fake.log[-1][1]
+    cur.execute("INSERT INTO models (m) VALUES (?)", (3,))
+    assert fake.log[-2] == (
+        "execute", "INSERT INTO models (m) VALUES (%s) RETURNING id", (3,))
+    assert fake.log[-1] == ("fetchone",)
+    assert cur.lastrowid == 42
+    cur.executemany(
+        "INSERT INTO particles (id, model_id, w, distance) VALUES (?,?,?,?)",
+        [(1, 1, 0.5, 0.1)],
+    )
+    # explicit-id batch insert resynchronizes the table's sequence
+    assert "setval" in fake.log[-1][1] and "particles" in fake.log[-1][1]
+    assert fake.log[-2][1].count("%s") == 4
+    conn.executescript(_SCHEMA)
+    assert fake.log[-1] == ("commit",)
+    executed_ddl = [e for e in fake.log if e[0] == "execute"
+                    and "CREATE" in e[1]]
+    assert len(executed_ddl) == len(split_script(_SCHEMA))
+    assert all("AUTOINCREMENT" not in e[1] for e in executed_ddl)
