@@ -1,0 +1,62 @@
+"""Elastic worker farming for host-side (non-JAX) simulators.
+
+Reference analog: the pyABC Redis setup (``abc-redis-worker`` processes
+joining a running study from any machine). Here the broker is a stdlib
+TCP server owned by ``ElasticSampler`` — no redis required — and workers
+(`abc-worker HOST PORT`) may join and leave at ANY time, mid-generation
+included. This example spawns two local worker subprocesses; on a
+cluster you would start them on other machines instead.
+
+Run: ``python examples/07_elastic_workers.py`` (env: EX_POP, EX_GENS).
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+import pyabc_tpu as pt
+
+POP = int(os.environ.get("EX_POP", 80))
+GENS = int(os.environ.get("EX_GENS", 3))
+
+WORKER = ("from pyabc_tpu.broker import run_worker; "
+          "import sys; run_worker('127.0.0.1', int(sys.argv[1]))")
+
+
+def main():
+    def sim(pars):  # any plain-Python simulator
+        return {"x": pars["theta"] + 0.5 * np.random.normal()}
+
+    model = pt.SimpleModel(sim, name="gauss")
+    prior = pt.Distribution(theta=pt.RV("norm", 0.0, 1.0))
+    sampler = pt.ElasticSampler(port=0, generation_timeout=300.0)
+    port = sampler.address[1]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    workers = [
+        subprocess.Popen([sys.executable, "-c", WORKER, str(port)], env=env)
+        for _ in range(2)
+    ]
+    try:
+        abc = pt.ABCSMC(
+            model, prior, pt.PNormDistance(p=2), population_size=POP,
+            eps=pt.QuantileEpsilon(initial_epsilon=1.5, alpha=0.5),
+            sampler=sampler, seed=7,
+        )
+        abc.new("sqlite://", {"x": 1.0})
+        history = abc.run(max_nr_populations=GENS)
+        df, w = history.get_distribution(0, history.max_t)
+        mu = float(np.sum(df["theta"] * w))
+        print(f"posterior mean {mu:.3f} (conjugate exact 0.8)")
+        assert abs(mu - 0.8) < 0.4
+        return history
+    finally:
+        sampler.stop()
+        for p in workers:
+            p.kill()
+
+
+if __name__ == "__main__":
+    main()

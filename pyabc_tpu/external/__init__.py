@@ -6,6 +6,7 @@ from .base import (
     ExternalSumStat,
 )
 from .julia import JuliaModel
+from .morpheus import MorpheusModel
 from .r import R, RModel
 
 __all__ = [
@@ -16,4 +17,5 @@ __all__ = [
     "R",
     "RModel",
     "JuliaModel",
+    "MorpheusModel",
 ]

@@ -1,0 +1,87 @@
+"""Morpheus multicellular-simulation adapter (gated on a ``morpheus``
+binary).
+
+Reference parity: ``pyabc/external/morpheus.py::MorpheusModel`` (newer
+reference versions; SURVEY.md §2.4 external row): a Morpheus model is an
+XML file; sampled parameters are written into the XML via XPath-addressed
+``value`` attributes, the ``morpheus`` CLI runs the simulation into a
+temp directory, and the logger CSV comes back as summary statistics.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import tempfile
+import xml.etree.ElementTree as ET
+
+import numpy as np
+
+from ..model import Model
+
+
+def _require_morpheus(executable: str) -> str:
+    path = shutil.which(executable)
+    if path is None:
+        raise RuntimeError(
+            f"The Morpheus adapter needs a {executable!r} executable on "
+            "PATH (install Morpheus, morpheus.gitlab.io). For other "
+            "external simulators use ExternalModel."
+        )
+    return path
+
+
+class MorpheusModel(Model):
+    """A Morpheus XML model as a simulator.
+
+    ``par_map``: parameter name -> XPath (ElementTree syntax, relative to
+    the XML root) of the element whose ``value`` attribute receives the
+    sampled value — the reference's parameter mapping contract.
+    ``output_file``: the logger CSV Morpheus writes (TSV/CSV autodetected).
+    """
+
+    def __init__(self, model_file: str, par_map: dict[str, str],
+                 executable: str = "morpheus",
+                 output_file: str = "logger.csv",
+                 timeout_s: float | None = None,
+                 name: str | None = None):
+        super().__init__(
+            name=name or f"Morpheus({os.path.basename(model_file)})"
+        )
+        self.executable = _require_morpheus(executable)
+        self.model_file = os.path.abspath(model_file)
+        self.par_map = dict(par_map)
+        self.output_file = output_file
+        self.timeout_s = timeout_s
+
+    def _write_model(self, pars, path: str) -> None:
+        tree = ET.parse(self.model_file)
+        root = tree.getroot()
+        for key, xpath in self.par_map.items():
+            node = root.find(xpath)
+            if node is None:
+                raise KeyError(
+                    f"par_map[{key!r}]: XPath {xpath!r} matches no element "
+                    f"in {self.model_file}"
+                )
+            node.set("value", repr(float(pars[key])))
+        tree.write(path)
+
+    def sample(self, pars):
+        with tempfile.TemporaryDirectory(prefix="abc_morpheus_") as loc:
+            model_xml = os.path.join(loc, "model.xml")
+            self._write_model(pars, model_xml)
+            subprocess.run(
+                [self.executable, "-file", model_xml, "-outdir", loc],
+                check=True, capture_output=True, text=True,
+                timeout=self.timeout_s,
+            )
+            out = os.path.join(loc, self.output_file)
+            if not os.path.exists(out):
+                raise RuntimeError(
+                    f"morpheus produced no {self.output_file!r} in {loc}"
+                )
+            import pandas as pd
+
+            df = pd.read_csv(out, sep=None, engine="python")
+            return {c: df[c].to_numpy(np.float64) for c in df.columns}

@@ -1520,7 +1520,17 @@ class ABCSMC:
             t_adapt0 = time.time()
             spec_round = None
             self._adapt_proposal(pop)
-            if (self._speculation_capable()
+            # the deterministic stop rules are decidable BEFORE the slow
+            # strategy updates — don't burn a speculative round on a
+            # generation that will never be dispatched
+            surely_stopping = (
+                t + 1 >= max_nr_populations
+                or sims_total >= max_total_nr_simulations
+                or (max_walltime is not None
+                    and time.time() - start_walltime > max_walltime)
+            )
+            if (not surely_stopping
+                    and self._speculation_capable()
                     and last_strategies_s > self.speculation_min_adapt_s):
                 spec_round = self._dispatch_speculative_round(t + 1, n_t)
             t_strat0 = time.time()

@@ -185,6 +185,8 @@ class BatchedSampler(Sampler):
                 },
             }
             n_target = max(n - len(idx), 0)
+            # the speculative lanes already spent evaluation budget
+            max_eval = max(max_eval - B_spec, 1)
         B = self._pick_B(n)
         n_cap = _pow2(n, 64)
         rec_cap = 1

@@ -1,0 +1,122 @@
+"""Stub/mock execution tests for the Morpheus adapter and the Dask sampler.
+
+Same philosophy as ``test_adapters_stub.py``: a fake ``morpheus`` binary
+exercises the XML parameter-substitution + CLI + logger-CSV contract, and
+a mock ``distributed`` module (Client.get_executor -> a real
+ThreadPoolExecutor) drives DaskDistributedSampler's delegation loop with
+actual concurrent futures.
+"""
+import os
+import stat
+import sys
+import textwrap
+import types
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+import pyabc_tpu as pt
+
+MORPHEUS_STUB = textwrap.dedent("""\
+    #!{python}
+    import sys
+    import xml.etree.ElementTree as ET
+    args = dict(zip(sys.argv[1::2], sys.argv[2::2]))
+    root = ET.parse(args["-file"]).getroot()
+    k = float(root.find("./Global/Constant[@symbol='k']").get("value"))
+    with open(args["-outdir"] + "/logger.csv", "w") as fh:
+        fh.write("time,cells\\n")
+        for t in range(4):
+            fh.write("%d,%r\\n" % (t, k * t))
+""")
+
+MODEL_XML = """<MorpheusModel>
+  <Global>
+    <Constant symbol="k" value="1.0"/>
+    <Constant symbol="other" value="7.0"/>
+  </Global>
+</MorpheusModel>
+"""
+
+
+@pytest.fixture
+def fake_morpheus(tmp_path, monkeypatch):
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    p = bindir / "morpheus"
+    p.write_text(MORPHEUS_STUB.format(python=sys.executable))
+    p.chmod(p.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+    model = tmp_path / "model.xml"
+    model.write_text(MODEL_XML)
+    return model
+
+
+class TestMorpheusAdapter:
+    def test_parameter_substitution_and_output(self, fake_morpheus):
+        from pyabc_tpu.external import MorpheusModel
+
+        model = MorpheusModel(
+            str(fake_morpheus),
+            par_map={"k": "./Global/Constant[@symbol='k']"},
+        )
+        out = model.sample({"k": 2.5})
+        np.testing.assert_allclose(out["cells"], [0.0, 2.5, 5.0, 7.5])
+        np.testing.assert_allclose(out["time"], [0, 1, 2, 3])
+
+    def test_bad_xpath_raises(self, fake_morpheus):
+        from pyabc_tpu.external import MorpheusModel
+
+        model = MorpheusModel(
+            str(fake_morpheus),
+            par_map={"k": "./Global/Constant[@symbol='missing']"},
+        )
+        with pytest.raises(KeyError, match="matches no element"):
+            model.sample({"k": 1.0})
+
+    def test_gated_without_binary(self, tmp_path):
+        from pyabc_tpu.external import MorpheusModel
+
+        with pytest.raises(RuntimeError, match="morpheus"):
+            MorpheusModel(str(tmp_path / "m.xml"), par_map={},
+                          executable="definitely-not-morpheus")
+
+
+class TestDaskSamplerWithMockDistributed:
+    def test_delegation_runs_real_futures(self, monkeypatch):
+        executor = ThreadPoolExecutor(max_workers=4)
+
+        class _Client:
+            def get_executor(self):
+                return executor
+
+            def close(self):
+                executor.shutdown(wait=False)
+
+        mod = types.ModuleType("distributed")
+        mod.Client = _Client
+        monkeypatch.setitem(sys.modules, "distributed", mod)
+        from pyabc_tpu.sampler.dask_sampler import DaskDistributedSampler
+
+        sampler = DaskDistributedSampler(dask_client=_Client(),
+                                         batch_size=4)
+
+        def sim(pars):
+            return {"x": pars["theta"] + 0.5 * np.random.normal()}
+
+        model = pt.SimpleModel(sim, name="g")
+        prior = pt.Distribution(theta=pt.RV("norm", 0.0, 1.0))
+        abc = pt.ABCSMC(model, prior, pt.PNormDistance(p=2),
+                        population_size=60,
+                        eps=pt.QuantileEpsilon(initial_epsilon=1.5,
+                                               alpha=0.5),
+                        sampler=sampler, seed=4)
+        abc.new("sqlite://", {"x": 1.0})
+        h = abc.run(max_nr_populations=3)
+        assert h.n_populations == 3
+        df, w = h.get_distribution(0, h.max_t)
+        mu = float(np.sum(df["theta"] * w))
+        assert mu == pytest.approx(0.8, abs=0.35)
+        assert sampler.nr_evaluations_ > 0
+        sampler.stop()
