@@ -97,6 +97,7 @@ def run_tpu_bench(pop_size: int, n_gens: int, budget_s: float, seed: int = 0,
 
     import pyabc_tpu as pt
     from pyabc_tpu.models import lotka_volterra as lv
+    from pyabc_tpu.utils.bench_defaults import DEFAULT_G
 
     model = lv.make_lv_model()
     prior = lv.default_prior()
@@ -108,7 +109,7 @@ def run_tpu_bench(pop_size: int, n_gens: int, budget_s: float, seed: int = 0,
         population_size=pop_size,
         eps=pt.MedianEpsilon(),
         seed=seed,
-        fused_generations=int(os.environ.get("PYABC_TPU_BENCH_G", 16)),
+        fused_generations=int(os.environ.get("PYABC_TPU_BENCH_G", DEFAULT_G)),
     )
     # skip per-particle sumstat storage (and with it the dominant share of
     # the per-chunk device->host fetch) unless explicitly requested
@@ -250,14 +251,17 @@ print("BASELINE_PPS", {pop_size} * h.n_populations / elapsed * {assumed_cores})
 
 
 def main():
-    budget = float(os.environ.get("PYABC_TPU_BENCH_BUDGET_S", 300))
-    pop = int(os.environ.get("PYABC_TPU_BENCH_POP", 1000))
-    # (gens+1) must be a multiple of G so no stub tail chunk is scheduled;
-    # 31 with G=16 gives chunks t=1..16 and 17..32, staying just clear of
-    # the deep-schedule acceptance collapse (MedianEpsilon at the noise
-    # floor, t >~ 33). G=16 beats G=8 by halving per-generation sync cost
-    # (measured: 83k vs 45k pps) and G=20+ overruns the floor.
-    gens = int(os.environ.get("PYABC_TPU_BENCH_GENS", 31))
+    from pyabc_tpu.utils.bench_defaults import (
+        DEFAULT_BUDGET_S,
+        DEFAULT_GENS,
+        DEFAULT_POP,
+    )
+
+    budget = float(
+        os.environ.get("PYABC_TPU_BENCH_BUDGET_S", DEFAULT_BUDGET_S))
+    pop = int(os.environ.get("PYABC_TPU_BENCH_POP", DEFAULT_POP))
+    # sizing rationale: pyabc_tpu/utils/bench_defaults.py
+    gens = int(os.environ.get("PYABC_TPU_BENCH_GENS", DEFAULT_GENS))
     t_start = time.time()
 
     _state["phase"] = "probe"

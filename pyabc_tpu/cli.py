@@ -13,6 +13,13 @@ import sys
 
 import click
 
+from .utils.bench_defaults import (
+    DEFAULT_BUDGET_S,
+    DEFAULT_G,
+    DEFAULT_GENS,
+    DEFAULT_POP,
+)
+
 
 @click.command("abc-export")
 @click.argument("db", type=click.Path(exists=True))
@@ -68,11 +75,12 @@ def export_cmd(db, run_id, what, time_point, m, fmt, out):
 
 
 @click.command("abc-bench")
-@click.option("--pop", type=int, default=1000, help="population size")
+@click.option("--pop", type=int, default=DEFAULT_POP,
+              help="population size")
 @click.option("--gens", type=int, default=None,
-              help="steady-state generations (default: bench.py's default, "
-                   "sized for >=2 post-compile fused chunks)")
-@click.option("--budget-s", type=float, default=300.0,
+              help="steady-state generations (default: the shared bench "
+                   "default, sized for >=2 post-compile fused chunks)")
+@click.option("--budget-s", type=float, default=DEFAULT_BUDGET_S,
               help="walltime budget in seconds")
 @click.option("--cpu", is_flag=True, help="force the CPU platform")
 def bench_cmd(pop, gens, budget_s, cpu):
@@ -101,15 +109,15 @@ def bench_cmd(pop, gens, budget_s, cpu):
 
     if gens is None:
         # mirror the repo bench.py default resolution (env wins, then the
-        # G-aligned sizing) so wheel installs run the same benchmark as
-        # repo checkouts
-        gens = int(os.environ.get("PYABC_TPU_BENCH_GENS", 31))
+        # shared bench_defaults sizing) so wheel installs run the same
+        # benchmark as repo checkouts
+        gens = int(os.environ.get("PYABC_TPU_BENCH_GENS", DEFAULT_GENS))
     model = lv.make_lv_model()
     abc = pt.ABCSMC(model, lv.default_prior(),
                     pt.AdaptivePNormDistance(p=2), population_size=pop,
                     eps=pt.MedianEpsilon(),
                     fused_generations=int(
-                        os.environ.get("PYABC_TPU_BENCH_G", 16)))
+                        os.environ.get("PYABC_TPU_BENCH_G", DEFAULT_G)))
     abc.new("sqlite://", lv.observed_data(seed=123))
     t0 = time.time()
     h = abc.run(max_nr_populations=gens + 2, max_walltime=budget_s)

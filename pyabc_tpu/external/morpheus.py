@@ -71,11 +71,17 @@ class MorpheusModel(Model):
         with tempfile.TemporaryDirectory(prefix="abc_morpheus_") as loc:
             model_xml = os.path.join(loc, "model.xml")
             self._write_model(pars, model_xml)
-            subprocess.run(
-                [self.executable, "-file", model_xml, "-outdir", loc],
-                check=True, capture_output=True, text=True,
-                timeout=self.timeout_s,
+            cmd = [self.executable, "-file", model_xml, "-outdir", loc]
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=self.timeout_s,
             )
+            if proc.returncode != 0:
+                # surface morpheus's own diagnostics (ExternalHandler.run
+                # pattern) instead of an opaque CalledProcessError
+                raise RuntimeError(
+                    f"morpheus command {' '.join(cmd)!r} failed "
+                    f"(rc={proc.returncode}): {proc.stderr[-2000:]}"
+                )
             out = os.path.join(loc, self.output_file)
             if not os.path.exists(out):
                 raise RuntimeError(

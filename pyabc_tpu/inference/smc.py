@@ -45,6 +45,7 @@ from ..sampler.batched import BatchedSampler
 from ..sampler.singlecore import SingleCoreSampler
 from ..storage.history import History
 from ..transition import (
+    LocalTransition,
     ModelPerturbationKernel,
     MultivariateNormalTransition,
     NotEnoughParticles,
@@ -807,14 +808,24 @@ class ABCSMC:
             # custom jump kernels fall back to the per-generation loop
             return False
         tr = self.transitions[0]
-        for other in self.transitions:
-            # per-model refits share ONE traced device_fit configuration
-            if (type(other) is not MultivariateNormalTransition
-                    or other.scaling != tr.scaling
-                    or other.bandwidth_selector is not tr.bandwidth_selector):
+        if type(tr) is LocalTransition:
+            # local-covariance KDE refits on device (dense pairwise +
+            # top_k); k is static only when every generation accepts
+            # exactly the (constant) population size of ONE model
+            if self.K != 1:
                 return False
-        if tr.bandwidth_selector not in (scott_rule_of_thumb,
-                                         silverman_rule_of_thumb):
+        elif type(tr) is MultivariateNormalTransition:
+            for other in self.transitions:
+                # per-model refits share ONE traced device_fit configuration
+                if (type(other) is not MultivariateNormalTransition
+                        or other.scaling != tr.scaling
+                        or other.bandwidth_selector
+                        is not tr.bandwidth_selector):
+                    return False
+            if tr.bandwidth_selector not in (scott_rule_of_thumb,
+                                             silverman_rule_of_thumb):
+                return False
+        else:
             return False
         if not (isinstance(self.eps, QuantileEpsilon)
                 or type(self.eps) in (ListEpsilon, ConstantEpsilon)):
@@ -842,12 +853,13 @@ class ABCSMC:
         return True
 
     #: temperature schemes with device twins (DeviceContext.
-    #: _stochastic_gen_update); Daly (stateful contraction) and Ess fall
-    #: back to the per-generation loop
+    #: _stochastic_gen_update); Daly's contraction state rides the chunk
+    #: carry, Ess bisects relative ESS in-kernel — ALL reference schemes
+    #: can chain on device
     _DEVICE_TEMP_SCHEMES = {
         "AcceptanceRateScheme", "ExpDecayFixedIterScheme",
         "ExpDecayFixedRatioScheme", "PolynomialDecayFixedIterScheme",
-        "FrielPettittScheme",
+        "FrielPettittScheme", "DalyScheme", "EssScheme",
     }
 
     def _fused_stochastic_capable(self) -> bool:
@@ -887,16 +899,36 @@ class ABCSMC:
             silverman_rule_of_thumb,
         )
 
-        if type(tr) is not MultivariateNormalTransition:
-            return False
-        if tr.bandwidth_selector not in (scott_rule_of_thumb,
-                                         silverman_rule_of_thumb):
+        if type(tr) is MultivariateNormalTransition:
+            if tr.bandwidth_selector not in (scott_rule_of_thumb,
+                                             silverman_rule_of_thumb):
+                return False
+        elif type(tr) is not LocalTransition:
             return False
         if type(self.model_perturbation_kernel) is not ModelPerturbationKernel:
             return False
         if np.isfinite(self.max_nr_recorded_particles):
             return False
         return True
+
+    def _transition_fit_statics(self, n: int) -> tuple:
+        """Per-model static kwargs for the in-kernel ``device_fit`` refits.
+
+        MVN: (scaling, bandwidth_selector). LocalTransition: (scaling, k) —
+        k from the host ``_effective_k`` rule at the constant population
+        size, which is exactly what the host path would use every
+        generation under ConstantPopulationSize.
+        """
+        out = []
+        for m, tr in enumerate(self.transitions):
+            dim = self.parameter_priors[m].space.dim
+            if type(tr) is LocalTransition:
+                out.append((("scaling", tr.scaling),
+                            ("k", tr._effective_k(n, dim))))
+            else:
+                out.append((("scaling", tr.scaling),
+                            ("bandwidth_selector", tr.bandwidth_selector)))
+        return tuple(out)
 
     def _temp_config(self) -> tuple:
         """Static scheme descriptor tuple for the device temperature twin."""
@@ -918,6 +950,11 @@ class ABCSMC:
                                 float(sch.exponent)))
             elif name == "FrielPettittScheme":
                 schemes.append(("friel_pettitt",))
+            elif name == "DalyScheme":
+                schemes.append(("daly", float(sch.alpha),
+                                float(sch.min_rate)))
+            elif name == "EssScheme":
+                schemes.append(("ess", float(sch.target_relative_ess)))
         max_np = (int(eps._max_nr_populations)
                   if eps._max_nr_populations is not None else -1)
         kernel = self.distance_function
@@ -1032,8 +1069,8 @@ class ABCSMC:
             eps_weighted=getattr(self.eps, "weighted", True),
             alpha=getattr(self.eps, "alpha", 0.5),
             multiplier=getattr(self.eps, "quantile_multiplier", 1.0),
-            trans_cls=type(tr), scaling=tr.scaling,
-            bandwidth_selector=tr.bandwidth_selector,
+            trans_cls=type(tr),
+            fit_statics=self._transition_fit_statics(n),
             dims=tuple(p.space.dim for p in self.parameter_priors),
             stochastic=stochastic,
             temp_config=self._temp_config() if stochastic else None,
@@ -1106,7 +1143,15 @@ class ABCSMC:
             )
             if stochastic:
                 # seed the device pdf-norm recursion from the host
-                # acceptor's state for generation t_at
+                # acceptor's state for generation t_at; seed Daly's
+                # contraction state from the host scheme's _k dict (its
+                # default when never called: the current temperature)
+                temp_at = float(self.eps(t_at))
+                daly_k0 = temp_at if np.isfinite(temp_at) else 1e4
+                for sch in self.eps._effective_schemes():
+                    if type(sch).__name__ == "DalyScheme":
+                        k = sch._k.get(t_at, daly_k0)
+                        daly_k0 = k if np.isfinite(k) else daly_k0
                 acc_state0 = (
                     jnp.asarray(self.acceptor.pdf_norms.get(t_at, 0.0),
                                 jnp.float32),
@@ -1114,10 +1159,12 @@ class ABCSMC:
                         self.acceptor._max_found
                         if np.isfinite(self.acceptor._max_found) else -1e30,
                         jnp.float32),
+                    jnp.asarray(daly_k0, jnp.float32),
                 )
             else:
                 acc_state0 = (jnp.zeros((), jnp.float32),
-                              jnp.asarray(-1e30, jnp.float32))
+                              jnp.asarray(-1e30, jnp.float32),
+                              jnp.zeros((), jnp.float32))
             return (tuple(trans0), jnp.asarray(log_probs0, jnp.float32),
                     jnp.asarray(fitted0), dist_w0,
                     jnp.asarray(self.eps(t_at), jnp.float32),
@@ -1289,6 +1336,12 @@ class ABCSMC:
                         self.acceptor._max_found = max(
                             self.acceptor._max_found, mf
                         )
+                    if "daly_k_next" in fetched:
+                        for sch in self.eps._effective_schemes():
+                            if type(sch).__name__ == "DalyScheme":
+                                sch._k[t + 1] = float(
+                                    fetched["daly_k_next"][g]
+                                )
                 if adaptive:
                     dwn = fetched["dist_w_next"]
                     # sumstat-bearing distances carry {"w": ..., "ss": ...}
@@ -1520,16 +1573,17 @@ class ABCSMC:
             t_adapt0 = time.time()
             spec_round = None
             self._adapt_proposal(pop)
-            # the deterministic stop rules are decidable BEFORE the slow
-            # strategy updates — don't burn a speculative round on a
-            # generation that will never be dispatched
-            surely_stopping = (
-                t + 1 >= max_nr_populations
-                or sims_total >= max_total_nr_simulations
-                or (max_walltime is not None
-                    and time.time() - start_walltime > max_walltime)
-            )
-            if (not surely_stopping
+            # every stop rule is decidable BEFORE the slow strategy updates
+            # (model probs were refreshed by _adapt_proposal above, nothing
+            # in _adapt_strategies feeds _check_stop) — don't burn a
+            # speculative round on a generation that will never be
+            # dispatched
+            stop = self._check_stop(t, current_eps, minimum_epsilon,
+                                    max_nr_populations, acceptance_rate,
+                                    min_acceptance_rate, sims_total,
+                                    max_total_nr_simulations, max_walltime,
+                                    start_walltime)
+            if (not stop
                     and self._speculation_capable()
                     and last_strategies_s > self.speculation_min_adapt_s):
                 spec_round = self._dispatch_speculative_round(t + 1, n_t)
@@ -1539,12 +1593,6 @@ class ABCSMC:
             )
             last_strategies_s = time.time() - t_strat0
             adapt_s = time.time() - t_adapt0
-
-            stop = self._check_stop(t, current_eps, minimum_epsilon,
-                                    max_nr_populations, acceptance_rate,
-                                    min_acceptance_rate, sims_total,
-                                    max_total_nr_simulations, max_walltime,
-                                    start_walltime)
 
             if not stop:
                 # LOOK-AHEAD: device starts generation t+1 now ...

@@ -650,8 +650,8 @@ class DeviceContext:
     def multigen_kernel(self, B: int, n_cap: int, rec_cap: int,
                         max_rounds: int, G: int, *, adaptive: bool,
                         eps_quantile: bool, eps_weighted: bool, alpha: float,
-                        multiplier: float, trans_cls, scaling: float,
-                        bandwidth_selector, dims: tuple,
+                        multiplier: float, trans_cls, fit_statics: tuple,
+                        dims: tuple,
                         stochastic: bool = False,
                         temp_config: tuple | None = None,
                         sumstat_transform: bool = False):
@@ -695,8 +695,7 @@ class DeviceContext:
         """
         cache_key = ("multigen", B, n_cap, rec_cap, max_rounds, G, adaptive,
                      eps_quantile, eps_weighted, alpha, multiplier,
-                     trans_cls.__name__, scaling,
-                     getattr(bandwidth_selector, "__name__", "?"), dims,
+                     trans_cls.__name__, fit_statics, dims,
                      stochastic, temp_config, sumstat_transform)
         if cache_key in self._kernels:
             return self._kernels[cache_key]
@@ -753,7 +752,7 @@ class DeviceContext:
             def gen_step(carry, g):
                 (trans_params, log_model_probs, fitted, dist_w, eps_carry,
                  acc_state, stopped) = carry
-                pdf_norm, max_found = acc_state
+                pdf_norm, max_found, daly_k = acc_state
                 # g_limit (dynamic) caps the active generations so the LAST
                 # chunk of a run reuses the same compiled G-kernel instead
                 # of tracing a shorter scan (a ~20s compile per distinct G)
@@ -881,12 +880,13 @@ class DeviceContext:
                     model_probs_next > 0,
                     jnp.log(jnp.maximum(model_probs_next, 1e-38)), -jnp.inf,
                 )
+                # per-class static fit config (scaling + bandwidth selector
+                # for MVN; scaling + neighbor count k for LocalTransition)
                 trans_next = tuple(
                     trans_cls.device_fit(
                         res["theta"],
                         jnp.where(m_arr == m, w_norm, 0.0),
-                        dim=dims[m], scaling=scaling,
-                        bandwidth_selector=bandwidth_selector,
+                        dim=dims[m], **dict(fit_statics[m]),
                     )
                     for m in range(K)
                 )
@@ -896,10 +896,12 @@ class DeviceContext:
                     (eps_next, acc_state_next, temp_extra
                      ) = self._stochastic_gen_update(
                         temp_config, trans_cls, trans_next, rec, res, k_mask,
-                        pdf_norm, max_found, eps_carry, acc_rate, t,
+                        w_norm, pdf_norm, max_found, daly_k, eps_carry,
+                        acc_rate, t,
                     )
                 else:
-                    acc_state_next, temp_extra = (pdf_norm, max_found), {}
+                    acc_state_next = (pdf_norm, max_found, daly_k)
+                    temp_extra = {}
 
                 stopped_next = (
                     stopped | ~gen_ok | (eps_g <= min_eps)
@@ -946,8 +948,8 @@ class DeviceContext:
         return fn
 
     def _stochastic_gen_update(self, temp_config, trans_cls, trans_next,
-                               rec, res, k_mask, pdf_norm, max_found,
-                               temp, acc_rate, t):
+                               rec, res, k_mask, w_norm, pdf_norm, max_found,
+                               daly_k, temp, acc_rate, t):
         """Traceable per-generation noisy-ABC adaptation (K=1).
 
         Twin of the host pair ``StochasticAcceptor._update_norm`` (pdf_norm
@@ -960,7 +962,12 @@ class DeviceContext:
         transition params — weights transition_pd / transition_pd_prev
         (SURVEY.md §2.2 Temperature row).
 
-        Returns (eps_next, (pdf_norm_next, max_found_next), extra_outputs).
+        DalyScheme's contraction state k rides the carry as ``daly_k``
+        (host twin: ``DalyScheme._k``); EssScheme bisects the relative-ESS
+        condition over the accepted set like the host scheme.
+
+        Returns (eps_next, (pdf_norm_next, max_found_next, daly_k_next),
+        extra_outputs).
         """
         import jax
         import jax.numpy as jnp
@@ -979,6 +986,7 @@ class DeviceContext:
             pdf_norm_next = jnp.maximum(pdf_norm, max_found_next)
 
         t_next = (t + 1).astype(jnp.float32)
+        daly_k_next = daly_k
         proposals = []
         for sch in schemes:
             if sch[0] == "acceptance_rate":
@@ -1035,6 +1043,46 @@ class DeviceContext:
             elif sch[0] == "friel_pettitt":
                 beta = ((t_next + 1.0) / max_np) ** 2
                 prop = 1.0 / jnp.maximum(beta, 1e-12)
+            elif sch[0] == "daly":
+                # stateful contraction (host DalyScheme._k) rides the chunk
+                # carry as daly_k; on acceptance collapse SHRINK the step so
+                # temperature cools more slowly while acceptance recovers
+                alpha, min_r = sch[1:]
+                daly_k_next = jnp.where(
+                    acc_rate < min_r,
+                    alpha * daly_k,
+                    alpha * jnp.minimum(daly_k, temp),
+                )
+                prop = jnp.maximum(1.0, temp - daly_k_next)
+            elif sch[0] == "ess":
+                # T s.t. relative ESS of the tempering reweight factors
+                # (beta_new - beta_old) * v over the ACCEPTED set hits the
+                # target (host EssScheme; bisection on log10 T)
+                target = sch[1]
+                w_acc = jnp.where(k_mask, w_norm, 0.0)
+                w_acc = w_acc / jnp.maximum(w_acc.sum(), 1e-38)
+                beta_old = 1.0 / temp
+                n_accd = jnp.maximum(k_mask.sum(), 1).astype(jnp.float32)
+
+                def rel_ess(T_):
+                    lw = (1.0 / T_ - beta_old) * logv_acc
+                    lw = lw - jnp.max(jnp.where(k_mask, lw, -jnp.inf))
+                    ww = w_acc * jnp.where(k_mask, jnp.exp(lw), 0.0)
+                    s = ww.sum()
+                    wn = ww / jnp.maximum(s, 1e-38)
+                    ess = 1.0 / jnp.maximum((wn ** 2).sum(), 1e-38) / n_accd
+                    return jnp.where(s > 0, ess, 0.0)
+
+                def ess_bisect(_, lohi):
+                    lo, hi = lohi
+                    mid = 0.5 * (lo + hi)
+                    ok = rel_ess(10.0 ** mid) >= target
+                    return (jnp.where(ok, lo, mid), jnp.where(ok, mid, hi))
+
+                lo, hi = jax.lax.fori_loop(
+                    0, 60, ess_bisect,
+                    (jnp.zeros(()), jnp.full((), 12.0)))
+                prop = jnp.where(rel_ess(1.0) >= target, 1.0, 10.0 ** hi)
             else:  # pragma: no cover - guarded by _fused_chunk_capable
                 raise ValueError(f"unsupported device scheme: {sch[0]}")
             proposals.append(jnp.asarray(prop, jnp.float32))
@@ -1048,8 +1096,10 @@ class DeviceContext:
         if max_np > 0:
             temp_next = jnp.where(t_next >= max_np - 1, 1.0, temp_next)
         extra = {"pdf_norm_next": pdf_norm_next,
-                 "max_found_next": max_found_next}
-        return temp_next, (pdf_norm_next, max_found_next), extra
+                 "max_found_next": max_found_next,
+                 "daly_k_next": daly_k_next}
+        return (temp_next, (pdf_norm_next, max_found_next, daly_k_next),
+                extra)
 
     def run_generation(self, key, B: int, mode: str, dyn: dict, *,
                        n_cap: int, rec_cap: int, max_rounds: int,

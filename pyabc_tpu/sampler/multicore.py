@@ -49,6 +49,7 @@ def get_if_worker_healthy(workers, q, timeout: float = 1800.0):
 
 def _eval_parallel_worker(simulate_one, n_request, n_eval, n_acc, out_q,
                           seed, record_rejected, rej_q):
+    simulate_one = _load_payload(simulate_one)
     np.random.seed(seed)
     while True:
         with n_acc.get_lock():
@@ -69,6 +70,7 @@ def _eval_parallel_worker(simulate_one, n_request, n_eval, n_acc, out_q,
 
 def _particle_parallel_worker(simulate_one, quota, out_q, seed,
                               record_rejected, rej_q):
+    simulate_one = _load_payload(simulate_one)
     np.random.seed(seed)
     produced = 0
     n_eval = 0
@@ -83,15 +85,42 @@ def _particle_parallel_worker(simulate_one, quota, out_q, seed,
     out_q.put((DONE, n_eval))
 
 
+def _load_payload(simulate_one):
+    """Worker-side inverse of the spawn-context cloudpickle wrapping."""
+    if isinstance(simulate_one, bytes):
+        import cloudpickle
+
+        return cloudpickle.loads(simulate_one)
+    return simulate_one
+
+
 class _MulticoreBase(Sampler):
-    def __init__(self, n_procs: int | None = None, daemon: bool = True):
+    """start_method: 'fork' (default, reference behavior — cheap worker
+    startup, guarded by a pre-fork jax-reference scan of the closure) or
+    'spawn'/'forkserver' (robust against forked-backend deadlocks by
+    construction; the closure travels via cloudpickle, workers re-import)."""
+
+    def __init__(self, n_procs: int | None = None, daemon: bool = True,
+                 start_method: str = "fork", check_fork_safety: bool = True):
         super().__init__()
         self.n_procs = n_procs if n_procs is not None else nr_cores_available()
         self.daemon = daemon
+        self.start_method = start_method
+        self.check_fork_safety = check_fork_safety
 
     def _resolve(self, simulate_one):
         if hasattr(simulate_one, "host_simulate_one"):
-            return simulate_one.host_simulate_one
+            simulate_one = simulate_one.host_simulate_one
+        if self.start_method == "fork" and self.check_fork_safety:
+            # fail fast (with the offending access path) instead of
+            # deadlocking a forked child on the parent's XLA mutexes
+            from ..utils.fork_safety import assert_fork_safe
+
+            assert_fork_safe(simulate_one)
+        elif self.start_method != "fork":
+            import cloudpickle
+
+            simulate_one = cloudpickle.dumps(simulate_one)
         return simulate_one
 
     def _drain_rejected(self, sample: Sample, rej_q, workers=()) -> None:
@@ -122,7 +151,7 @@ class MulticoreEvalParallelSampler(_MulticoreBase):
                                 all_accepted=False, ana_vars=None) -> Sample:
         simulate_one = self._resolve(simulate_one)
         sample = self.sample_factory()
-        ctx = mp.get_context("fork")
+        ctx = mp.get_context(self.start_method)
         n_eval = ctx.Value("i", 0)
         n_acc = ctx.Value("i", 0)
         out_q = ctx.Queue()
@@ -167,7 +196,7 @@ class MulticoreParticleParallelSampler(_MulticoreBase):
                                 all_accepted=False, ana_vars=None) -> Sample:
         simulate_one = self._resolve(simulate_one)
         sample = self.sample_factory()
-        ctx = mp.get_context("fork")
+        ctx = mp.get_context(self.start_method)
         out_q = ctx.Queue()
         rej_q = ctx.Queue()
         quotas = [n // self.n_procs] * self.n_procs

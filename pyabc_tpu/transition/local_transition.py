@@ -115,6 +115,58 @@ class LocalTransition(Transition):
         }
 
     @staticmethod
+    def device_fit(thetas, weights, *, dim: int, scaling: float, k: int):
+        """Traceable twin of :meth:`fit` for the fused multi-generation run.
+
+        ``thetas (n_cap, d_max)`` zero-padded accepted particles,
+        ``weights (n_cap,)`` normalized with zeros on empty slots. Neighbor
+        search is the same dense pairwise-distance + ``top_k`` as the host
+        path (invalid slots are excluded as neighbor CANDIDATES via an inf
+        distance; their own rows get finite jittered covariances but carry
+        zero weight, so they are never resampled and contribute nothing to
+        the mixture pdf). ``k`` is static: with the fused path's
+        ConstantPopulationSize every successful generation accepts exactly
+        n_cap particles, so host ``_effective_k`` is generation-invariant.
+        """
+        n_cap, d_max = thetas.shape
+        vmask = (jnp.arange(d_max) < dim).astype(thetas.dtype)
+        outer = vmask[:, None] * vmask[None, :]
+        w = weights / jnp.maximum(weights.sum(), 1e-38)
+        valid = weights > 0
+        X = thetas * vmask[None, :]
+        diff = X[:, None, :] - X[None, :, :]
+        sq = (diff * diff).sum(-1)
+        sq = jnp.where(valid[None, :], sq, jnp.inf)
+        _, nn_idx = jax.lax.top_k(-sq, k)  # k smallest distances, self incl.
+        neigh = X[nn_idx]  # (n_cap, k, d_max)
+        centered = neigh - X[:, None, :]
+        cov = jnp.einsum("nkd,nke->nde", centered, centered) / k
+        factor = silverman_rule_of_thumb(k, dim) * scaling
+        cov = cov * factor**2
+        # host regularization: relative jitter on the REAL diagonal; padded
+        # dims get a unit diagonal so the factorization is well-posed (they
+        # are zeroed out of the outputs below, like pad_transition_params)
+        tr = jnp.trace(cov, axis1=1, axis2=2) / dim
+        jit = jnp.maximum(tr, 1e-10) * LocalTransition.EPS
+        diag_add = jit[:, None] * vmask[None, :] + (1.0 - vmask)[None, :]
+        cov = cov * outer[None] + jax.vmap(jnp.diag)(diag_add)
+        chols = jnp.linalg.cholesky(cov)
+        precs = jnp.linalg.inv(cov)
+        logdets = 2.0 * jnp.sum(
+            vmask[None, :] * jnp.log(jnp.maximum(
+                jnp.diagonal(chols, axis1=1, axis2=2), 1e-38)),
+            axis=1,
+        )
+        return {
+            "thetas": X,
+            "weights": w,
+            "chols": chols * outer[None],
+            "precs": precs * outer[None],
+            "logdets": logdets,
+            "dim": jnp.float32(dim),
+        }
+
+    @staticmethod
     def device_rvs(key, params):
         k1, k2 = jax.random.split(key)
         idx = jax.random.choice(

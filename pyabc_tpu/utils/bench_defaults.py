@@ -1,0 +1,20 @@
+"""Single source of truth for the benchmark's default configuration.
+
+Both the repo-root ``bench.py`` harness and the ``abc-bench`` CLI fallback
+(used for wheel installs without the repo checkout) read these constants,
+so the two entry points cannot drift apart (round-2 advisor finding: the
+CLI re-hardcoded the generation count by hand).
+
+Sizing rationale lives with the numbers:
+- ``(DEFAULT_GENS + 1)`` must be a multiple of ``DEFAULT_G`` so no stub
+  tail chunk is scheduled; 31 with G=16 gives chunks t=1..16 and 17..32,
+  staying just clear of the deep-schedule acceptance collapse
+  (MedianEpsilon at the noise floor, t >~ 33).
+- G=16 beats G=8 by halving per-generation sync cost over the tunnel
+  (measured round 3: 83k vs 45k pps); G=20+ overruns the floor.
+"""
+
+DEFAULT_POP = 1000
+DEFAULT_GENS = 31
+DEFAULT_G = 16
+DEFAULT_BUDGET_S = 300.0

@@ -1,0 +1,109 @@
+"""Fork-safety guard for host proposal closures.
+
+The multicore samplers distribute work by forking a parent whose JAX/XLA
+backend is already initialized (reference parity:
+``pyabc/sampler/multicorebase.py`` forks the same way over a torch-free
+parent). Forking a process with live XLA threads is safe ONLY as long as
+the child never touches the backend — which is why the whole host proposal
+path (`inference/util.py` host section) is written JAX-free: numpy/scipy
+draws, pandas transitions, float math.
+
+That invariant is one stray captured ``jax.Array`` away from the round-1
+multicore deadlock (a child touching a forked XLA backend hangs in its
+mutex). This module makes the invariant *checkable*: `find_jax_refs`
+recursively walks a closure — cells, function defaults, instance
+attributes, containers — and returns the access paths of any object whose
+type lives in ``jax``/``jaxlib``. The multicore samplers run the check once
+per generation before forking, and fail fast with the offending path
+instead of deadlocking silently.
+"""
+from __future__ import annotations
+
+from dataclasses import fields, is_dataclass
+
+#: module prefixes whose instances must not be captured by a forked closure
+_BANNED_PREFIXES = ("jax", "jaxlib")
+
+#: walk at most this deep; the proposal closure graph is shallow (closure ->
+#: strategy objects -> numpy/scipy state)
+_MAX_DEPTH = 8
+
+
+def _is_banned(obj) -> bool:
+    mod = type(obj).__module__ or ""
+    return mod == "jax" or any(
+        mod.startswith(p + ".") or mod == p for p in _BANNED_PREFIXES
+    )
+
+
+def _children(obj):
+    """(label, child) pairs to recurse into. Deliberately NOT __globals__:
+    module namespaces legitimately import jax for the device path; the
+    hazard is jax OBJECTS reachable from the closure's data graph."""
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            yield f"[{k!r}]", v
+        return
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        for i, v in enumerate(obj):
+            yield f"[{i}]", v
+        return
+    if callable(obj):
+        closure = getattr(obj, "__closure__", None)
+        names = getattr(getattr(obj, "__code__", None), "co_freevars", ())
+        for name, cell in zip(names, closure or ()):
+            try:
+                yield f".<cell {name}>", cell.cell_contents
+            except ValueError:  # pragma: no cover - empty cell
+                continue
+        for i, d in enumerate(getattr(obj, "__defaults__", None) or ()):
+            yield f".<default {i}>", d
+        self_obj = getattr(obj, "__self__", None)
+        if self_obj is not None:
+            yield ".__self__", self_obj
+    if is_dataclass(obj) and not isinstance(obj, type):
+        for f in fields(obj):
+            yield f".{f.name}", getattr(obj, f.name, None)
+        return
+    d = getattr(obj, "__dict__", None)
+    if isinstance(d, dict):
+        for k, v in d.items():
+            yield f".{k}", v
+
+
+def find_jax_refs(root, max_depth: int = _MAX_DEPTH) -> list[str]:
+    """Access paths of jax/jaxlib-typed objects reachable from ``root``."""
+    found: list[str] = []
+    seen: set[int] = set()
+    stack = [("<root>", root, 0)]
+    while stack:
+        path, obj, depth = stack.pop()
+        if obj is None or isinstance(obj, (str, bytes, int, float, bool)):
+            continue
+        if id(obj) in seen:
+            continue
+        seen.add(id(obj))
+        if isinstance(obj, type) or type(obj).__name__ == "module":
+            continue  # classes/modules referencing jax are fine; values not
+        if _is_banned(obj):
+            found.append(f"{path}: {type(obj).__module__}."
+                         f"{type(obj).__qualname__}")
+            continue
+        if depth >= max_depth:
+            continue
+        for label, child in _children(obj):
+            stack.append((path + label, child, depth + 1))
+    return found
+
+
+def assert_fork_safe(simulate_one) -> None:
+    """Raise with the offending paths if the closure captures jax state."""
+    refs = find_jax_refs(simulate_one)
+    if refs:
+        raise RuntimeError(
+            "proposal closure captures JAX state and cannot be forked into "
+            "multiprocess workers (a child touching a forked XLA backend "
+            "deadlocks). Offending references:\n  " + "\n  ".join(refs)
+            + "\nUse BatchedSampler for device models, or a spawn-context "
+            "sampler (start_method='spawn')."
+        )

@@ -59,3 +59,34 @@ def test_export_model_probabilities(tmp_path):
     # one row per generation; single model => probability 1.0
     assert len(rows) == 2
     assert all(np.isclose(sum(r.values()), 1.0) for r in rows)
+
+
+def test_bench_defaults_single_source():
+    """bench.py and abc-bench resolve defaults from ONE module (round-2
+    advisor: the CLI had re-hardcoded the generation count by hand)."""
+    import ast
+    import os
+
+    from pyabc_tpu.utils import bench_defaults as bd
+
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for fname in ("bench.py", os.path.join("pyabc_tpu", "cli.py")):
+        src = open(os.path.join(here, fname)).read()
+        tree = ast.parse(src)
+        # no stray numeric fallback next to the bench env knobs: every
+        # os.environ.get("PYABC_TPU_BENCH_*", <default>) must take its
+        # default from bench_defaults, not a literal
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "get"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and str(node.args[0].value).startswith("PYABC_TPU_BENCH_")
+                    and len(node.args) > 1):
+                assert not isinstance(node.args[1], ast.Constant), (
+                    f"{fname}: literal default for {node.args[0].value}; "
+                    "use pyabc_tpu.utils.bench_defaults"
+                )
+    # the G-alignment invariant the sizing comment promises
+    assert (bd.DEFAULT_GENS + 1) % bd.DEFAULT_G == 0

@@ -202,3 +202,49 @@ def test_fused_multimodel_matches_pergen_loop():
     assert float(pf.get(0, 0.0)) == pytest.approx(
         float(pp.get(0, 0.0)), abs=0.15
     )
+
+
+def test_fused_local_transition_matches_pergen_loop():
+    """LocalTransition rides the fused path: k-NN local-covariance refits
+    happen IN-KERNEL (dense pairwise + top_k). Posterior must match the
+    per-generation loop with the same transition within MC error."""
+    tr_kwargs = dict(transitions=pt.LocalTransition(k_fraction=0.3))
+    abc_f, h_f = _run(4, seed=17, pop=300, **tr_kwargs)
+    assert h_f.get_telemetry(2).get("fused_chunk"), "fused path not taken"
+    abc_p, h_p = _run(1, seed=17, pop=300, **tr_kwargs)
+    assert h_f.n_populations == h_p.n_populations
+    mu_true = POST_MU
+    for h in (h_f, h_p):
+        df, w = h.get_distribution(0, h.max_t)
+        mu = float(np.sum(df["theta"] * w))
+        assert mu == pytest.approx(mu_true, abs=0.3)
+    eps_f = h_f.get_all_populations().query("t >= 1")["epsilon"].to_numpy()
+    eps_p = h_p.get_all_populations().query("t >= 1")["epsilon"].to_numpy()
+    np.testing.assert_allclose(eps_f, eps_p, rtol=0.25)
+
+
+def test_local_transition_device_fit_matches_host_fit():
+    """Same particle set: in-kernel device_fit must reproduce the host
+    fit's per-particle covariances (f32 vs f64)."""
+    import pandas as pd
+
+    rng = np.random.default_rng(3)
+    n, dim = 60, 2
+    X = pd.DataFrame({"a": rng.normal(0, 1, n), "b": rng.normal(2, 0.5, n)})
+    w = np.full(n, 1.0 / n)
+    host = pt.LocalTransition(k_fraction=0.3)
+    host.fit(X, w)
+    k = host._effective_k(n, dim)
+
+    import jax.numpy as jnp
+
+    dev = pt.LocalTransition.device_fit(
+        jnp.asarray(np.asarray(X), jnp.float32), jnp.asarray(w, jnp.float32),
+        dim=dim, scaling=1.0, k=k,
+    )
+    np.testing.assert_allclose(
+        np.asarray(dev["logdets"]), host._logdets, rtol=2e-3, atol=2e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(dev["chols"]), host._chols, rtol=5e-3, atol=5e-3
+    )

@@ -56,11 +56,14 @@ class TestCapability:
         abc._initialize_components(8)
         assert abc._fused_chunk_capable()
 
-    def test_daly_scheme_falls_back(self):
-        abc = _noisy_abc(eps=pt.Temperature(schemes=[DalyScheme()]))
-        abc.new("sqlite://", {"x": X_OBS})
-        abc._initialize_components(8)
-        assert not abc._fused_chunk_capable()
+    def test_daly_and_ess_schemes_are_fused_capable(self):
+        from pyabc_tpu.epsilon.temperature import EssScheme
+
+        for scheme in (DalyScheme(), EssScheme()):
+            abc = _noisy_abc(eps=pt.Temperature(schemes=[scheme]))
+            abc.new("sqlite://", {"x": X_OBS})
+            abc._initialize_components(8)
+            assert abc._fused_chunk_capable(), scheme
 
     def test_log_file_falls_back(self):
         abc = _noisy_abc()
@@ -110,6 +113,106 @@ class TestDeterministicLadderParity:
             sd = float(np.sqrt(np.sum(w * (df["theta"] - mu) ** 2)))
             assert mu == pytest.approx(mu_true, abs=0.15)
             assert sd == pytest.approx(sd_true, abs=0.12)
+
+
+class TestDalyFused:
+    """DalyScheme's contraction state k rides the chunk carry; away from
+    acceptance collapse the recursion is deterministic: k_t = alpha *
+    min(k_{t-1}, T_{t-1}), T_t = max(1, T_{t-1} - k_t) -> T_t = T_0/2^t
+    for alpha = 0.5 and T_0 = k_0."""
+
+    def _run(self, fused_generations):
+        abc = _noisy_abc(
+            seed=11, fused_generations=fused_generations, pop=300,
+            eps=pt.Temperature(schemes=[DalyScheme()],
+                               initial_temperature=64.0),
+        )
+        abc.new("sqlite://", {"x": X_OBS})
+        h = abc.run(max_nr_populations=7)
+        return abc, h
+
+    def test_fused_trajectory_matches_reference_recursion(self):
+        abc, h = self._run(4)
+        assert h.get_telemetry(2).get("fused_chunk"), "fused path not taken"
+        for t in range(min(6, h.n_populations)):
+            if t in abc.eps.temperatures:
+                assert abc.eps.temperatures[t] == pytest.approx(
+                    max(1.0, 64.0 / 2**t), rel=1e-3
+                ), f"t={t}"
+        # the host scheme state mirrors the device carry (resume safety)
+        sch = abc.eps.schemes[0]
+        assert sch._k, "host DalyScheme._k never mirrored from device"
+
+    def test_fused_posterior_matches_unfused(self):
+        _, h_f = self._run(4)
+        _, h_u = self._run(1)
+        mu_true, sd_true = exact_posterior()
+        for h in (h_f, h_u):
+            df, w = h.get_distribution(0, h.max_t)
+            mu = float(np.sum(df["theta"] * w))
+            assert mu == pytest.approx(mu_true, abs=0.15)
+
+
+class TestEssFused:
+    def test_fused_posterior_and_monotone_trajectory(self):
+        from pyabc_tpu.epsilon.temperature import EssScheme
+
+        abc = _noisy_abc(
+            seed=13, fused_generations=4, pop=400,
+            eps=pt.Temperature(schemes=[EssScheme()],
+                               initial_temperature=64.0),
+        )
+        abc.new("sqlite://", {"x": X_OBS})
+        h = abc.run(max_nr_populations=8)
+        assert h.get_telemetry(2).get("fused_chunk"), "fused path not taken"
+        temps = [abc.eps.temperatures[t] for t in sorted(abc.eps.temperatures)]
+        assert all(b <= a + 1e-6 for a, b in zip(temps, temps[1:]))
+        assert temps[-1] == pytest.approx(1.0)
+        mu_true, _ = exact_posterior()
+        df, w = h.get_distribution(0, h.max_t)
+        mu = float(np.sum(df["theta"] * w))
+        assert mu == pytest.approx(mu_true, abs=0.15)
+
+    def test_ess_device_bisection_matches_host_scheme(self):
+        """Same weighted distances -> the in-kernel bisection and the host
+        EssScheme must agree on the proposed temperature."""
+        import pandas as pd
+
+        from pyabc_tpu.epsilon.temperature import EssScheme
+
+        rng = np.random.default_rng(0)
+        vals = -np.abs(rng.normal(3.0, 2.0, 200))  # log kernel values
+        w = rng.uniform(0.2, 1.0, 200)
+        w = w / w.sum()
+        host = EssScheme(target_relative_ess=0.6)
+        t_host = host(
+            2,
+            get_weighted_distances=lambda: pd.DataFrame(
+                {"distance": vals, "w": w}),
+            prev_temperature=50.0,
+        )
+
+        import jax.numpy as jnp
+
+        from pyabc_tpu.inference.util import DeviceContext
+
+        ctx = object.__new__(DeviceContext)  # stateless: method needs no init
+        temp = jnp.asarray(50.0, jnp.float32)
+        t_dev = float(
+            DeviceContext._stochastic_gen_update(
+                ctx,
+                ((("ess", 0.6),), -1, None, False),
+                None, None,
+                {"theta": None, "logq": None, "valid": None,
+                 "distance": None},
+                {"distance": jnp.asarray(vals, jnp.float32)},
+                jnp.ones(200, bool),
+                jnp.asarray(w, jnp.float32),
+                jnp.zeros(()), jnp.asarray(-1e30), jnp.zeros(()),
+                temp, jnp.asarray(0.5), jnp.asarray(2),
+            )[0]
+        )
+        assert t_dev == pytest.approx(t_host, rel=5e-3)
 
 
 class TestFusedDefaultTemperature:

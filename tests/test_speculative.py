@@ -28,8 +28,8 @@ def _model():
 
 
 def _noisy_daly(seed):
-    """Daly scheme has host-only state -> NOT fused-chunk capable ->
-    pipelined loop with speculation."""
+    """fused_generations=1 (user opt-out; Daly itself now has a device
+    twin) -> pipelined per-generation loop with speculation."""
     prior = pt.Distribution(theta=pt.RV("norm", 0.0, 1.0))
     return pt.ABCSMC(
         _model(), prior, pt.IndependentNormalKernel(var=[NOISE_SD**2]),
@@ -37,11 +37,13 @@ def _noisy_daly(seed):
         eps=pt.Temperature(schemes=[DalyScheme()],
                            initial_temperature=32.0),
         acceptor=pt.StochasticAcceptor(), seed=seed,
+        fused_generations=1,
     )
 
 
 def _local_transition(seed, pipeline=True):
-    """LocalTransition -> NOT fused-chunk capable -> pipelined loop."""
+    """fused_generations=1 (LocalTransition itself now refits in-kernel)
+    -> per-generation loop; pipeline toggles the speculative look-ahead."""
     prior = pt.Distribution(theta=pt.RV("norm", 0.0, 1.0))
 
     @pt.JaxModel.from_function(["theta"], name="gauss")
@@ -52,6 +54,7 @@ def _local_transition(seed, pipeline=True):
         model, prior, pt.PNormDistance(p=2), population_size=300,
         eps=pt.MedianEpsilon(),
         transitions=pt.LocalTransition(), seed=seed, pipeline=pipeline,
+        fused_generations=1,
     )
 
 
@@ -62,7 +65,7 @@ def exact_posterior():
 
 def test_daly_config_speculates_and_recovers_posterior():
     abc = _noisy_daly(seed=9)
-    assert not abc._fused_chunk_capable() if abc._device_capable else True
+    assert not abc._fused_chunk_capable()  # fused_generations=1 opt-out
     abc.speculation_min_adapt_s = 0.0  # force the auto-gate open for the test
     abc.new("sqlite://", {"x": X_OBS})
     h = abc.run(max_nr_populations=6)
