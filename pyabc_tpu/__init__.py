@@ -27,7 +27,9 @@ from .core import (
     RVDecorator,
     ScipyRV,
     SumStatSpec,
+    fast_random_choice,
 )
+from .settings import set_figure_params
 from .distance import (
     AcceptAllDistance,
     AdaptiveAggregatedDistance,
@@ -63,6 +65,7 @@ from .epsilon import (
     ExpDecayFixedRatioScheme,
     FrielPettittScheme,
     ListEpsilon,
+    ListTemperature,
     MedianEpsilon,
     NoEpsilon,
     PolynomialDecayFixedIterScheme,

@@ -1,6 +1,7 @@
 from .parameters import Parameter, ParameterSpace
 from .population import Particle, Population
 from .random import generation_key, root_key, round_key
+from .random_choice import fast_random_choice
 from .random_variables import (
     RV,
     Distribution,
@@ -24,7 +25,7 @@ __all__ = [
     "Parameter", "ParameterSpace", "Particle", "Population",
     "RV", "Distribution", "RVBase", "RVDecorator", "LowerBoundDecorator",
     "ScipyRV", "SumStatSpec",
-    "root_key", "generation_key", "round_key",
+    "root_key", "generation_key", "round_key", "fast_random_choice",
     "weighted_quantile", "weighted_median", "weighted_mean", "weighted_std",
     "weighted_var", "effective_sample_size", "resample",
 ]

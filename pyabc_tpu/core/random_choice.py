@@ -1,0 +1,36 @@
+"""Fast weighted index draw for the host proposal path.
+
+Reference parity: ``pyabc/random_choice.py::fast_random_choice`` — the
+ancestor/model draw happens once per proposal in every host sampler
+worker, and ``np.random.choice`` pays ~microseconds of validation and
+normalization overhead per call. For small n an inline cumulative-sum
+scan beats it by an order of magnitude; for large n ``np.searchsorted``
+over the cumsum is used.
+
+The device path never calls this (``jax.random.categorical`` draws whole
+batches in-kernel); this exists for the reference-faithful scalar closure
+(`inference/util.py::generate_valid_proposal`).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+#: below this many weights the plain python scan wins over vectorization
+_SMALL_N = 16
+
+
+def fast_random_choice(weights) -> int:
+    """Draw an index ~ ``weights`` (assumed normalized, as the reference
+    does; callers hold normalized model/particle probabilities)."""
+    n = len(weights)
+    if n <= _SMALL_N:
+        u = np.random.uniform()
+        acc = 0.0
+        for i in range(n - 1):
+            acc += weights[i]
+            if u < acc:
+                return i
+        return n - 1
+    cdf = np.cumsum(weights)
+    u = np.random.uniform(high=cdf[-1])
+    return int(np.searchsorted(cdf, u, side="right").clip(0, n - 1))

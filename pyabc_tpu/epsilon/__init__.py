@@ -13,6 +13,7 @@ from .temperature import (
     ExpDecayFixedIterScheme,
     ExpDecayFixedRatioScheme,
     FrielPettittScheme,
+    ListTemperature,
     PolynomialDecayFixedIterScheme,
     Temperature,
     TemperatureScheme,
@@ -24,5 +25,5 @@ __all__ = [
     "Temperature", "TemperatureScheme", "AcceptanceRateScheme",
     "ExpDecayFixedIterScheme", "ExpDecayFixedRatioScheme",
     "PolynomialDecayFixedIterScheme", "DalyScheme", "FrielPettittScheme",
-    "EssScheme",
+    "EssScheme", "ListTemperature",
 ]

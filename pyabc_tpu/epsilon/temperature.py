@@ -385,3 +385,41 @@ class Temperature(Epsilon):
 
     def __repr__(self):
         return f"Temperature(schemes={self.schemes})"
+
+
+class ListTemperature(Epsilon):
+    """Pre-specified temperature ladder (reference ListTemperature): the
+    user supplies T_t for every generation; the last entry is typically 1
+    for exact sampling. No calibration, no adaptation."""
+
+    def __init__(self, values: Sequence[float]):
+        self.values = [float(v) for v in values]
+        #: mirror Temperature's attribute so StochasticAcceptor/telemetry
+        #: code paths that read `.temperatures` work unchanged
+        self.temperatures = {t: v for t, v in enumerate(self.values)}
+
+    def requires_calibration(self) -> bool:
+        return False
+
+    def initialize(self, t, get_weighted_distances=None,
+                   get_all_records=None, max_nr_populations=None,
+                   acceptor_config=None):
+        pass
+
+    def update(self, t, get_weighted_distances=None, get_all_records=None,
+               acceptance_rate=None, acceptor_config=None):
+        pass
+
+    def configure_sampler(self, sampler):
+        pass
+
+    def __call__(self, t: int) -> float:
+        if t >= len(self.values):
+            return self.values[-1]
+        return self.values[t]
+
+    def get_config(self):
+        return {"name": type(self).__name__, "values": self.values}
+
+    def __repr__(self):
+        return f"ListTemperature({self.values})"

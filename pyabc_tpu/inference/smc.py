@@ -129,9 +129,9 @@ class ABCSMC:
                 raise ValueError(
                     "StochasticAcceptor requires a StochasticKernel distance"
                 )
-            from ..epsilon import Temperature
+            from ..epsilon import ListTemperature, Temperature
 
-            if not isinstance(self.eps, Temperature):
+            if not isinstance(self.eps, (Temperature, ListTemperature)):
                 raise ValueError(
                     "StochasticAcceptor requires a Temperature epsilon "
                     "(a distance-quantile epsilon would yield a negative "
@@ -868,7 +868,7 @@ class ABCSMC:
         monotone schemes from the device-twin set, device-compatible
         stochastic kernel distance (static params)."""
         from ..acceptor.pdf_norm import pdf_norm_max_found
-        from ..epsilon import Temperature
+        from ..epsilon import ListTemperature, Temperature
 
         if self.K != 1:
             return False
@@ -876,20 +876,23 @@ class ABCSMC:
         if a.pdf_norm_method is not pdf_norm_max_found or a.log_file:
             return False
         eps = self.eps
-        if type(eps) is not Temperature:
+        if type(eps) is ListTemperature:
+            pass  # deterministic ladder rides the eps_fixed chunk input
+        elif type(eps) is not Temperature:
             return False
-        if eps.aggregate_fun is not min or not eps.enforce_less_equal_prev \
-                or eps.log_file:
-            return False
-        need_horizon = {"ExpDecayFixedIterScheme",
-                        "PolynomialDecayFixedIterScheme",
-                        "FrielPettittScheme"}
-        for sch in eps._effective_schemes():
-            name = type(sch).__name__
-            if name not in self._DEVICE_TEMP_SCHEMES:
+        else:
+            if eps.aggregate_fun is not min \
+                    or not eps.enforce_less_equal_prev or eps.log_file:
                 return False
-            if name in need_horizon and eps._max_nr_populations is None:
-                return False
+            need_horizon = {"ExpDecayFixedIterScheme",
+                            "PolynomialDecayFixedIterScheme",
+                            "FrielPettittScheme"}
+            for sch in eps._effective_schemes():
+                name = type(sch).__name__
+                if name not in self._DEVICE_TEMP_SCHEMES:
+                    return False
+                if name in need_horizon and eps._max_nr_populations is None:
+                    return False
         d = self.distance_function
         if not isinstance(d, StochasticKernel) or not d.is_device_compatible():
             return False
@@ -936,7 +939,9 @@ class ABCSMC:
 
         eps = self.eps
         schemes = []
-        for sch in eps._effective_schemes():
+        # ListTemperature has no schemes: the ladder arrives via eps_fixed
+        for sch in (eps._effective_schemes()
+                    if hasattr(eps, "_effective_schemes") else ()):
             name = type(sch).__name__
             if name == "AcceptanceRateScheme":
                 schemes.append(("acceptance_rate", float(sch.target_rate)))
@@ -955,8 +960,8 @@ class ABCSMC:
                                 float(sch.min_rate)))
             elif name == "EssScheme":
                 schemes.append(("ess", float(sch.target_relative_ess)))
-        max_np = (int(eps._max_nr_populations)
-                  if eps._max_nr_populations is not None else -1)
+        max_np_raw = getattr(eps, "_max_nr_populations", None)
+        max_np = int(max_np_raw) if max_np_raw is not None else -1
         kernel = self.distance_function
         pdf_max = kernel.pdf_max
         lin = kernel.ret_scale == SCALE_LIN
@@ -986,7 +991,7 @@ class ABCSMC:
         import jax
         import jax.numpy as jnp
 
-        from ..epsilon import ListEpsilon, QuantileEpsilon
+        from ..epsilon import ListEpsilon, ListTemperature, QuantileEpsilon
         from ..utils import pow2_bucket as _pow2
         from .util import pad_transition_params
 
@@ -1063,6 +1068,7 @@ class ABCSMC:
             ))
 
         G = self.fused_generations
+        temp_fixed = stochastic and type(self.eps) is ListTemperature
         kern = ctx.multigen_kernel(
             B, n_cap, rec_cap, max_rounds, G,
             adaptive=adaptive, eps_quantile=eps_quantile,
@@ -1074,6 +1080,7 @@ class ABCSMC:
             dims=tuple(p.space.dim for p in self.parameter_priors),
             stochastic=stochastic,
             temp_config=self._temp_config() if stochastic else None,
+            temp_fixed=temp_fixed,
             sumstat_transform=sumstat_mode,
         )
 
@@ -1091,7 +1098,7 @@ class ABCSMC:
             chaining device-to-device lets chunk k+1 compute while chunk
             k's outputs are still being fetched/persisted."""
             eps_fixed = np.zeros(G, np.float32)
-            if not eps_quantile and not stochastic:
+            if (not eps_quantile and not stochastic) or temp_fixed:
                 for g in range(g_limit):
                     eps_fixed[g] = self.eps(t_at + g)
             return kern(
@@ -1148,7 +1155,9 @@ class ABCSMC:
                 # default when never called: the current temperature)
                 temp_at = float(self.eps(t_at))
                 daly_k0 = temp_at if np.isfinite(temp_at) else 1e4
-                for sch in self.eps._effective_schemes():
+                for sch in (self.eps._effective_schemes()
+                            if hasattr(self.eps, "_effective_schemes")
+                            else ()):
                     if type(sch).__name__ == "DalyScheme":
                         k = sch._k.get(t_at, daly_k0)
                         daly_k0 = k if np.isfinite(k) else daly_k0
@@ -1187,6 +1196,7 @@ class ABCSMC:
                 minimum_epsilon, max_nr_populations, min_acceptance_rate,
                 max_total_nr_simulations, max_walltime, start_walltime,
                 sims_total, eps_quantile, adaptive, stochastic,
+                temp_fixed=temp_fixed,
                 sumstat_refit=sumstat_mode,
                 rebuild_carry=_build_chunk_carry,
             )
@@ -1210,7 +1220,8 @@ class ABCSMC:
                           max_nr_populations, min_acceptance_rate,
                           max_total_nr_simulations, max_walltime,
                           start_walltime, sims_total, eps_quantile,
-                          adaptive, stochastic=False, sumstat_refit=False,
+                          adaptive, stochastic=False, temp_fixed=False,
+                          sumstat_refit=False,
                           rebuild_carry=None) -> History:
         import jax
 
@@ -1324,10 +1335,14 @@ class ABCSMC:
                     self.eps._values[t + 1] = float(fetched["eps_next"][g])
                 if stochastic:
                     # mirror the device temperature / pdf-norm recursions
-                    # into the host objects (resume, config, telemetry)
-                    self.eps.temperatures[t + 1] = float(
-                        fetched["eps_next"][g]
-                    )
+                    # into the host objects (resume, config, telemetry) —
+                    # except for a fixed ladder (ListTemperature), whose
+                    # constructor-built dict is already authoritative and
+                    # would be clobbered with chunk-clamped values
+                    if not temp_fixed:
+                        self.eps.temperatures[t + 1] = float(
+                            fetched["eps_next"][g]
+                        )
                     self.acceptor.pdf_norms[t + 1] = float(
                         fetched["pdf_norm_next"][g]
                     )
@@ -1336,7 +1351,8 @@ class ABCSMC:
                         self.acceptor._max_found = max(
                             self.acceptor._max_found, mf
                         )
-                    if "daly_k_next" in fetched:
+                    if "daly_k_next" in fetched and hasattr(
+                            self.eps, "_effective_schemes"):
                         for sch in self.eps._effective_schemes():
                             if type(sch).__name__ == "DalyScheme":
                                 sch._k[t + 1] = float(
@@ -1574,16 +1590,14 @@ class ABCSMC:
             spec_round = None
             self._adapt_proposal(pop)
             # every stop rule is decidable BEFORE the slow strategy updates
-            # (model probs were refreshed by _adapt_proposal above, nothing
-            # in _adapt_strategies feeds _check_stop) — don't burn a
-            # speculative round on a generation that will never be
+            # (model probs were refreshed by _adapt_proposal above) — don't
+            # burn a speculative round on a generation that will never be
             # dispatched
-            stop = self._check_stop(t, current_eps, minimum_epsilon,
-                                    max_nr_populations, acceptance_rate,
-                                    min_acceptance_rate, sims_total,
-                                    max_total_nr_simulations, max_walltime,
-                                    start_walltime)
-            if (not stop
+            surely_stopping = self._check_stop(
+                t, current_eps, minimum_epsilon, max_nr_populations,
+                acceptance_rate, min_acceptance_rate, sims_total,
+                max_total_nr_simulations, max_walltime, start_walltime)
+            if (not surely_stopping
                     and self._speculation_capable()
                     and last_strategies_s > self.speculation_min_adapt_s):
                 spec_round = self._dispatch_speculative_round(t + 1, n_t)
@@ -1593,6 +1607,14 @@ class ABCSMC:
             )
             last_strategies_s = time.time() - t_strat0
             adapt_s = time.time() - t_adapt0
+
+            # re-check AFTER the strategy updates: their duration counts
+            # against max_walltime (slow temperature bisections / distance
+            # refits must not buy an extra generation past the budget)
+            stop = surely_stopping or self._check_stop(
+                t, current_eps, minimum_epsilon, max_nr_populations,
+                acceptance_rate, min_acceptance_rate, sims_total,
+                max_total_nr_simulations, max_walltime, start_walltime)
 
             if not stop:
                 # LOOK-AHEAD: device starts generation t+1 now ...

@@ -88,11 +88,13 @@ def generate_valid_proposal(t, model_probabilities, model_perturbation_kernel,
         # here would initialize an XLA backend after fork and deadlock
         theta = parameter_priors[m].rvs_host()
         return m, theta
+    from ..core.random_choice import fast_random_choice
+
     ms = np.asarray(list(model_probabilities.keys()))
     ps = np.asarray(list(model_probabilities.values()), np.float64)
     ps = ps / ps.sum()
     for _ in range(max_retries):
-        m_anc = int(np.random.choice(ms, p=ps))
+        m_anc = int(ms[fast_random_choice(ps)])
         m = model_perturbation_kernel.rvs(m_anc)
         if transitions[m].X is None:
             continue  # never-fitted model cannot propose
@@ -654,6 +656,7 @@ class DeviceContext:
                         dims: tuple,
                         stochastic: bool = False,
                         temp_config: tuple | None = None,
+                        temp_fixed: bool = False,
                         sumstat_transform: bool = False):
         """One jitted program for G WHOLE GENERATIONS (transition mode).
 
@@ -696,7 +699,7 @@ class DeviceContext:
         cache_key = ("multigen", B, n_cap, rec_cap, max_rounds, G, adaptive,
                      eps_quantile, eps_weighted, alpha, multiplier,
                      trans_cls.__name__, fit_statics, dims,
-                     stochastic, temp_config, sumstat_transform)
+                     stochastic, temp_config, temp_fixed, sumstat_transform)
         if cache_key in self._kernels:
             return self._kernels[cache_key]
         if stochastic and self.K != 1:
@@ -759,9 +762,11 @@ class DeviceContext:
                 stopped = stopped | (g >= g_limit)
                 t = t0 + g
                 gen_key = jax.random.fold_in(root, t + 1)  # generation_key
-                if stochastic or eps_quantile:
+                if (stochastic and not temp_fixed) or eps_quantile:
                     eps_g = eps_carry
                 else:
+                    # deterministic schedule (ListEpsilon/ConstantEpsilon,
+                    # or a ListTemperature ladder) precomputed by the host
                     eps_g = eps_fixed[g]
                 # mask & renormalize the model-perturbation matrix like the
                 # host build_dyn_args: never-fitted models cannot propose
@@ -899,6 +904,10 @@ class DeviceContext:
                         w_norm, pdf_norm, max_found, daly_k, eps_carry,
                         acc_rate, t,
                     )
+                    if temp_fixed:
+                        # fixed ladder: next generation's temperature comes
+                        # from the host-precomputed schedule, not a scheme
+                        eps_next = eps_fixed[jnp.minimum(g + 1, G - 1)]
                 else:
                     acc_state_next = (pdf_norm, max_found, daly_k)
                     temp_extra = {}
@@ -987,6 +996,14 @@ class DeviceContext:
 
         t_next = (t + 1).astype(jnp.float32)
         daly_k_next = daly_k
+        if not schemes:
+            # fixed ladder (ListTemperature): only the pdf-norm recursion is
+            # scheme-free state; the caller substitutes the ladder value
+            extra = {"pdf_norm_next": pdf_norm_next,
+                     "max_found_next": max_found_next,
+                     "daly_k_next": daly_k_next}
+            return (temp, (pdf_norm_next, max_found_next, daly_k_next),
+                    extra)
         proposals = []
         for sch in schemes:
             if sch[0] == "acceptance_rate":

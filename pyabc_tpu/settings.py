@@ -1,0 +1,37 @@
+"""Global matplotlib styling helper.
+
+Reference parity: ``pyabc/settings.py::set_figure_params`` — the one
+global configuration hook the reference exposes (everything else is
+constructor kwargs). Styles matplotlib for the visualization module;
+a no-op import-wise when matplotlib is absent until actually called.
+"""
+from __future__ import annotations
+
+
+def set_figure_params(theme: str = "pyabc", style: str | None = None,
+                      color_map: str = "viridis") -> None:
+    """Apply a plotting theme to matplotlib rcParams.
+
+    ``theme='pyabc'`` mirrors the reference's look (clean spines, colormap
+    default); ``theme='default'`` restores matplotlib defaults. ``style``
+    forwards to ``matplotlib.style.use`` when given.
+    """
+    import matplotlib as mpl
+
+    if theme == "default":
+        mpl.rcdefaults()
+        return
+    if theme != "pyabc":
+        raise ValueError(f"unknown theme: {theme!r} (use 'pyabc'/'default')")
+    if style is not None:
+        import matplotlib.style
+
+        matplotlib.style.use(style)
+    mpl.rcParams.update({
+        "image.cmap": color_map,
+        "axes.spines.top": False,
+        "axes.spines.right": False,
+        "axes.grid": True,
+        "grid.alpha": 0.3,
+        "figure.autolayout": True,
+    })

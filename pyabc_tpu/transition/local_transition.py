@@ -82,7 +82,9 @@ class LocalTransition(Transition):
         self._logdets = logdets
 
     def rvs_single(self) -> pd.Series:
-        idx = np.random.choice(len(self.X), p=self.w)
+        from ..core.random_choice import fast_random_choice
+
+        idx = fast_random_choice(self.w)
         theta = np.asarray(self.X.iloc[idx], np.float64)
         perturbed = theta + self._chols[idx] @ np.random.normal(size=len(theta))
         return pd.Series(perturbed, index=self.X.columns)

@@ -63,7 +63,9 @@ class MultivariateNormalTransition(Transition):
         return self._cov
 
     def rvs_single(self) -> pd.Series:
-        idx = np.random.choice(len(self.X), p=self.w)
+        from ..core.random_choice import fast_random_choice
+
+        idx = fast_random_choice(self.w)
         theta = np.asarray(self.X.iloc[idx], np.float64)
         perturbed = theta + self._chol @ np.random.normal(size=len(theta))
         return pd.Series(perturbed, index=self.X.columns)

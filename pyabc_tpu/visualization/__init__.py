@@ -15,6 +15,7 @@ from .diagnostics import (
     plot_total_walltime,
     plot_walltime,
 )
+from .data import plot_data_callback, plot_data_default
 from .sensitivity import plot_sensitivity_sankey
 from .histogram import (
     plot_histogram_1d,
@@ -42,6 +43,7 @@ __all__ = [
     "plot_effective_sample_sizes", "plot_total_walltime", "plot_walltime",
     "plot_distance_weights",
     "plot_sensitivity_sankey",
+    "plot_data_default", "plot_data_callback",
     "compute_credible_interval", "plot_credible_intervals",
     "plot_credible_intervals_for_time",
 ]

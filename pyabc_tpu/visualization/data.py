@@ -1,0 +1,76 @@
+"""Observed-vs-simulated data comparison plots.
+
+Reference parity: ``pyabc/visualization/data.py::{plot_data_default,
+plot_data_callback}`` — quick visual goodness-of-fit checks: one panel per
+summary statistic, observed data against one or many simulated datasets.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _panel_grid(n: int):
+    import matplotlib.pyplot as plt
+
+    ncols = int(np.ceil(np.sqrt(n)))
+    nrows = int(np.ceil(n / ncols))
+    fig, axes = plt.subplots(nrows, ncols, squeeze=False,
+                             figsize=(4 * ncols, 3 * nrows))
+    flat = [ax for row in axes for ax in row]
+    for ax in flat[n:]:
+        ax.set_axis_off()
+    return fig, flat[:n]
+
+
+def _as_arrays(data: dict) -> dict:
+    return {k: np.atleast_1d(np.asarray(v, np.float64))
+            for k, v in data.items()}
+
+
+def plot_data_default(obs_data: dict, sim_data: dict | list[dict],
+                      keys=None):
+    """One panel per summary statistic: observed (thick) vs simulated
+    (thin); vector statistics as index-plots, scalars as paired bars.
+    ``sim_data`` may be a single dict or a list of dicts (e.g. posterior
+    predictive draws). Returns the axes array."""
+    sims = sim_data if isinstance(sim_data, list) else [sim_data]
+    obs = _as_arrays(obs_data)
+    sims = [_as_arrays(s) for s in sims]
+    if keys is None:
+        keys = list(obs.keys())
+    fig, axes = _panel_grid(len(keys))
+    for ax_, key in zip(axes, keys):
+        y0 = obs[key]
+        if y0.size == 1:
+            vals = [float(s[key][0]) for s in sims if key in s]
+            ax_.bar(["observed"] + [f"sim {i}" for i in range(len(vals))],
+                    [float(y0[0])] + vals)
+        else:
+            for i, s in enumerate(sims):
+                if key in s:
+                    ax_.plot(s[key], color="C1", alpha=0.6, lw=1,
+                             label="simulated" if i == 0 else None)
+            ax_.plot(y0, color="C0", lw=2.5, label="observed")
+            ax_.legend()
+        ax_.set_title(key)
+    return axes
+
+
+def plot_data_callback(obs_data: dict, sim_data: dict | list[dict],
+                       f_plot, f_plot_aggregated=None, keys=None):
+    """Per-statistic user callback ``f_plot(key, obs_array, sim_arrays,
+    ax)``; optional ``f_plot_aggregated(obs_data, sim_data, ax)`` gets one
+    extra panel at the end (reference plot_data_callback contract)."""
+    sims = sim_data if isinstance(sim_data, list) else [sim_data]
+    obs = _as_arrays(obs_data)
+    sims_arr = [_as_arrays(s) for s in sims]
+    if keys is None:
+        keys = list(obs.keys())
+    n = len(keys) + (1 if f_plot_aggregated is not None else 0)
+    fig, axes = _panel_grid(n)
+    for ax_, key in zip(axes, keys):
+        f_plot(key, obs[key], [s[key] for s in sims_arr if key in s], ax_)
+        ax_.set_title(key)
+    if f_plot_aggregated is not None:
+        f_plot_aggregated(obs_data, sim_data, axes[-1])
+    return axes

@@ -1,0 +1,131 @@
+"""BasicoModel driven against a mock ``basico`` module.
+
+The real COPASI bindings are not installable here; this pins the exact
+basico API call sequence the adapter relies on (load_model ->
+get/set_parameters / get/set_global_quantities -> run_time_course ->
+remove_datamodel, including cleanup on error), so API drift or a typo in
+the adapter fails HERE rather than on a user's machine (the fake-qsub
+pattern of test_sge.py applied to an in-process dependency).
+"""
+import sys
+import types
+
+import numpy as np
+import pandas as pd
+import pytest
+
+
+class MockBasico(types.ModuleType):
+    """Scriptable basico stand-in recording every call."""
+
+    def __init__(self, reaction_params=("k1",), global_quantities=("beta",)):
+        super().__init__("basico")
+        self.calls = []
+        self._reaction_params = set(reaction_params)
+        self._globals = set(global_quantities)
+        self.removed = []
+
+    def load_model(self, path):
+        self.calls.append(("load_model", path))
+        return {"path": path, "id": len(self.calls)}
+
+    def get_parameters(self, key, model=None):
+        self.calls.append(("get_parameters", key))
+        if key in self._reaction_params:
+            return pd.DataFrame({"name": [key], "value": [1.0]})
+        return None
+
+    def set_parameters(self, key, initial_value=None, model=None):
+        self.calls.append(("set_parameters", key, initial_value))
+
+    def get_global_quantities(self, key, model=None):
+        self.calls.append(("get_global_quantities", key))
+        if key in self._globals:
+            return pd.DataFrame({"name": [key], "initial_value": [0.5]})
+        return None
+
+    def set_global_quantities(self, key, initial_value=None, model=None):
+        self.calls.append(("set_global_quantities", key, initial_value))
+
+    def run_time_course(self, duration=None, intervals=None, method=None,
+                        model=None):
+        self.calls.append(("run_time_course", duration, intervals, method))
+        t = np.linspace(0.0, duration, intervals + 1)
+        return pd.DataFrame({"S": np.exp(-t), "P": 1.0 - np.exp(-t)})
+
+    def remove_datamodel(self, dm):
+        self.calls.append(("remove_datamodel",))
+        self.removed.append(dm)
+
+
+@pytest.fixture
+def mock_basico(monkeypatch, tmp_path):
+    mod = MockBasico()
+    monkeypatch.setitem(sys.modules, "basico", mod)
+    model_file = tmp_path / "decay.cps"
+    model_file.write_text("<COPASI/>")
+    return mod, str(model_file)
+
+
+def test_sample_call_sequence_and_outputs(mock_basico):
+    mod, model_file = mock_basico
+    from pyabc_tpu.copasi import BasicoModel
+
+    m = BasicoModel(model_file, duration=10.0, n_points=6,
+                    method="stochastic")
+    out = m.sample({"k1": 2.5, "beta": 0.1})
+
+    assert out.keys() == {"S", "P"}
+    assert out["S"].shape == (6,) and out["S"].dtype == np.float64
+
+    names = [c[0] for c in mod.calls]
+    assert names[0] == "load_model"
+    assert names[-1] == "remove_datamodel", "datamodel leaked"
+    # k1 is a reaction parameter: set via set_parameters, NOT globals
+    assert ("set_parameters", "k1", 2.5) in mod.calls
+    assert not any(c[0] == "set_global_quantities" and c[1] == "k1"
+                   for c in mod.calls)
+    # beta is a global quantity: set via set_global_quantities
+    assert ("set_global_quantities", "beta", 0.1) in mod.calls
+    # n_points=6 -> intervals=5; method forwarded
+    assert ("run_time_course", 10.0, 5, "stochastic") in mod.calls
+
+
+def test_outputs_filter_selects_columns(mock_basico):
+    mod, model_file = mock_basico
+    from pyabc_tpu.copasi import BasicoModel
+
+    m = BasicoModel(model_file, duration=4.0, n_points=3, outputs=["P"])
+    out = m.sample({"k1": 1.0})
+    assert list(out.keys()) == ["P"]
+
+
+def test_unknown_parameter_raises_and_still_cleans_up(mock_basico):
+    mod, model_file = mock_basico
+    from pyabc_tpu.copasi import BasicoModel
+
+    m = BasicoModel(model_file)
+    with pytest.raises(KeyError, match="neither a reaction parameter"):
+        m.sample({"nope": 1.0})
+    assert mod.removed, "remove_datamodel must run on the error path"
+
+
+def test_model_runs_inside_abc_loop(mock_basico):
+    """The adapter as a real Model in a (tiny) ABC run: integration over
+    SimpleModel-style dict summary statistics."""
+    mod, model_file = mock_basico
+    import pyabc_tpu as pt
+    from pyabc_tpu.copasi import BasicoModel
+
+    model = BasicoModel(model_file, duration=5.0, n_points=4)
+    obs = model.sample({"k1": 1.0})
+    np.random.seed(0)
+    abc = pt.ABCSMC(
+        model, pt.Distribution(k1=pt.RV("uniform", 0.5, 1.0)),
+        pt.PNormDistance(p=2), population_size=20,
+        eps=pt.QuantileEpsilon(initial_epsilon=1.0, alpha=0.5),
+        sampler=pt.SingleCoreSampler(),
+    )
+    abc.new("sqlite://", obs)
+    h = abc.run(max_nr_populations=2)
+    assert h.n_populations == 2

@@ -212,3 +212,34 @@ class TestSumStatSpec:
     def test_labels(self):
         spec = SumStatSpec({"x": 0.0, "y": np.zeros(2)})
         assert spec.labels() == ["x", "y[0]", "y[1]"]
+
+
+def test_fast_random_choice_distribution():
+    """fast_random_choice (reference pyabc/random_choice.py) must sample
+    the given weights for both the small-n scan and large-n searchsorted
+    branches."""
+    import pyabc_tpu as pt
+
+    np.random.seed(0)
+    for n in (3, 40):  # straddles the small-n cutoff
+        w = np.random.uniform(0.1, 1.0, n)
+        w /= w.sum()
+        draws = np.bincount(
+            [pt.fast_random_choice(w) for _ in range(20000)], minlength=n
+        ) / 20000
+        np.testing.assert_allclose(draws, w, atol=0.02)
+
+
+def test_set_figure_params_roundtrip():
+    import matplotlib as mpl
+
+    import pyabc_tpu as pt
+
+    pt.set_figure_params("pyabc", color_map="plasma")
+    assert mpl.rcParams["image.cmap"] == "plasma"
+    assert mpl.rcParams["axes.spines.top"] is False
+    pt.set_figure_params("default")
+    import pytest
+
+    with pytest.raises(ValueError, match="unknown theme"):
+        pt.set_figure_params("nope")

@@ -297,3 +297,38 @@ class TestAcceptanceRateReweighting:
         np.testing.assert_allclose(
             sample.all_proposal_pds, expect, rtol=2e-3
         )
+
+
+class TestListTemperatureFused:
+    """ListTemperature is a deterministic ladder: it rides the chunk's
+    eps_fixed input (like ListEpsilon), with only the pdf-norm recursion
+    carried on device."""
+
+    def _run(self, fused_generations):
+        ladder = [32.0, 16.0, 8.0, 4.0, 2.0, 1.0]
+        abc = _noisy_abc(
+            seed=23, fused_generations=fused_generations, pop=300,
+            eps=pt.ListTemperature(ladder),
+        )
+        abc.new("sqlite://", {"x": X_OBS})
+        h = abc.run(max_nr_populations=len(ladder))
+        return abc, h, ladder
+
+    def test_capable_and_ladder_respected(self):
+        abc, h, ladder = self._run(4)
+        assert h.get_telemetry(2).get("fused_chunk"), "fused path not taken"
+        eps_used = h.get_all_populations().query(
+            "t >= 0")["epsilon"].to_numpy()
+        np.testing.assert_allclose(eps_used, ladder[: len(eps_used)])
+        # the constructor-built ladder dict must survive the device mirror
+        # (chunk-clamped eps_next values must NOT clobber it)
+        assert abc.eps.temperatures == dict(enumerate(ladder))
+
+    def test_fused_posterior_matches_unfused(self):
+        _, h_f, _ = self._run(4)
+        _, h_u, _ = self._run(1)
+        mu_true, sd_true = exact_posterior()
+        for h in (h_f, h_u):
+            df, w = h.get_distribution(0, h.max_t)
+            mu = float(np.sum(df["theta"] * w))
+            assert mu == pytest.approx(mu_true, abs=0.15)

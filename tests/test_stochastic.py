@@ -146,3 +146,27 @@ class TestPdfNorm:
         out = norm(kernel_val=vals, pdf_max=None, max_found=-10.0,
                    prev_pdf_norm=None)
         assert out <= -10.0 + 1e-9
+
+
+def test_list_temperature_ladder_is_respected():
+    """ListTemperature (reference parity): user-pinned temperature ladder,
+    no adaptation; the run's temperature trajectory IS the list."""
+    import jax
+
+    @pt.JaxModel.from_function(["theta"], name="det")
+    def model(key, theta):
+        return {"x": theta[0]}
+
+    ladder = [16.0, 8.0, 2.0, 1.0]
+    abc = pt.ABCSMC(
+        model, pt.Distribution(theta=pt.RV("norm", 0.0, 1.0)),
+        pt.IndependentNormalKernel(var=[0.09]),
+        population_size=200,
+        eps=pt.ListTemperature(ladder),
+        acceptor=pt.StochasticAcceptor(), seed=4,
+    )
+    abc.new("sqlite://", {"x": 0.5})
+    h = abc.run(max_nr_populations=4)
+    eps_used = h.get_all_populations().query("t >= 0")["epsilon"].to_numpy()
+    np.testing.assert_allclose(eps_used, ladder[: len(eps_used)])
+    assert h.n_populations == 4

@@ -135,3 +135,37 @@ def test_plot_sensitivity_sankey_errors():
         plot_sensitivity_sankey(LinearPredictor())  # unfitted
     with pytest.raises(ValueError, match="must be 2-d"):
         plot_sensitivity_sankey(np.ones(4))
+
+
+def test_plot_data_default_and_callback():
+    """plot_data_default / plot_data_callback (reference
+    pyabc/visualization/data.py): observed-vs-simulated panels for vector
+    and scalar statistics, plus the user-callback variant."""
+    from pyabc_tpu.visualization import plot_data_callback, plot_data_default
+
+    obs = {"traj": np.sin(np.linspace(0, 1, 20)), "peak": 0.9}
+    sims = [{"traj": np.cos(np.linspace(0, 1, 20)), "peak": 0.7},
+            {"traj": np.zeros(20), "peak": 1.1}]
+    axes = plot_data_default(obs, sims)
+    assert len(axes) == 2
+    axes1 = plot_data_default(obs, sims[0], keys=["traj"])
+    assert len(axes1) == 1
+
+    seen = []
+
+    def f_plot(key, y0, ys, ax):
+        seen.append((key, len(ys)))
+        ax.plot(y0)
+
+    agg = []
+
+    def f_agg(o, s, ax):
+        agg.append(True)
+
+    axes2 = plot_data_callback(obs, sims, f_plot, f_plot_aggregated=f_agg)
+    assert len(axes2) == 3
+    assert ("traj", 2) in seen and ("peak", 2) in seen
+    assert agg == [True]
+    import matplotlib.pyplot as plt
+
+    plt.close("all")
