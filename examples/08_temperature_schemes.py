@@ -1,0 +1,72 @@
+"""Every temperature scheme fused on device: Daly, Ess, fixed ladders.
+
+Round-3 capability tour: ALL of the reference's temperature schemes now
+have in-kernel device twins, so noisy ABC chains whole generations on
+device regardless of the annealing strategy — DalyScheme's contraction
+state and EssScheme's relative-ESS bisection run inside the fused chunk,
+and a user-pinned ListTemperature ladder rides the chunk as a
+precomputed schedule. The three runs below recover the same exact
+posterior (deterministic model + normal noise kernel => analytically
+known) from three different annealing strategies.
+
+Run: ``python examples/08_temperature_schemes.py`` (env: EX_POP).
+"""
+import os
+
+import numpy as np
+
+import pyabc_tpu as pt
+from pyabc_tpu.epsilon.temperature import DalyScheme, EssScheme
+
+POP = int(os.environ.get("EX_POP", 300))
+NOISE_SD = 0.3
+X_OBS = 0.8
+
+
+def exact_posterior():
+    var = 1.0 / (1.0 + 1.0 / NOISE_SD**2)
+    return var * X_OBS / NOISE_SD**2, np.sqrt(var)
+
+
+def run(eps, label, gens):
+    @pt.JaxModel.from_function(["theta"], name="det")
+    def model(key, theta):
+        return {"x": theta[0]}
+
+    abc = pt.ABCSMC(
+        model, pt.Distribution(theta=pt.RV("norm", 0.0, 1.0)),
+        pt.IndependentNormalKernel(var=[NOISE_SD**2]),
+        population_size=POP, eps=eps,
+        acceptor=pt.StochasticAcceptor(), seed=17, fused_generations=4,
+    )
+    abc.new("sqlite://", {"x": X_OBS})
+    h = abc.run(max_nr_populations=gens)
+    fused = h.get_telemetry(min(2, h.max_t)).get("fused_chunk")
+    df, w = h.get_distribution(0, h.max_t)
+    mu = float(np.sum(df["theta"] * w))
+    temps = [round(abc.eps.temperatures[t], 3)
+             for t in sorted(abc.eps.temperatures) if t <= h.max_t]
+    print(f"{label:28s} fused={bool(fused)!s:5s} mu={mu:+.3f} "
+          f"temps={temps}")
+    return mu, h
+
+
+def main():
+    mu_true, _ = exact_posterior()
+    print(f"exact posterior mean: {mu_true:+.3f}")
+    results = [
+        run(pt.Temperature(schemes=[DalyScheme()],
+                           initial_temperature=64.0), "Daly contraction", 7),
+        run(pt.Temperature(schemes=[EssScheme()],
+                           initial_temperature=64.0), "Ess bisection", 8),
+        run(pt.ListTemperature([32.0, 8.0, 2.0, 1.0]),
+            "ListTemperature ladder", 4),
+    ]
+    for mu, _h in results:
+        assert abs(mu - mu_true) < 0.25, (mu, mu_true)
+    print("all three annealing strategies agree with the exact posterior")
+    return results[-1][1]
+
+
+if __name__ == "__main__":
+    main()

@@ -39,7 +39,11 @@ from ..distance import (
 )
 from ..epsilon import Epsilon, MedianEpsilon, NoEpsilon
 from ..model import JaxModel, Model, assert_models
-from ..populationstrategy import ConstantPopulationSize, PopulationStrategy
+from ..populationstrategy import (
+    ConstantPopulationSize,
+    ListPopulationSize,
+    PopulationStrategy,
+)
 from ..sampler.base import Sampler
 from ..sampler.batched import BatchedSampler
 from ..sampler.singlecore import SingleCoreSampler
@@ -796,7 +800,10 @@ class ABCSMC:
             self.sampler, "fused", False
         ):
             return False
-        if not isinstance(self.population_strategy, ConstantPopulationSize):
+        if not isinstance(self.population_strategy,
+                          (ConstantPopulationSize, ListPopulationSize)):
+            # AdaptivePopulationSize needs the host's bootstrap-CV loop
+            # between every pair of generations
             return False
         if type(self.acceptor) is StochasticAcceptor:
             return self._fused_stochastic_capable()
@@ -812,7 +819,8 @@ class ABCSMC:
             # local-covariance KDE refits on device (dense pairwise +
             # top_k); k is static only when every generation accepts
             # exactly the (constant) population size of ONE model
-            if self.K != 1:
+            if self.K != 1 or not isinstance(self.population_strategy,
+                                             ConstantPopulationSize):
                 return False
         elif type(tr) is MultivariateNormalTransition:
             for other in self.transitions:
@@ -872,8 +880,12 @@ class ABCSMC:
 
         if self.K != 1:
             return False
+        from ..acceptor.pdf_norm import ScaledPDFNorm
+
         a = self.acceptor
-        if a.pdf_norm_method is not pdf_norm_max_found or a.log_file:
+        meth = a.pdf_norm_method
+        if not (meth is pdf_norm_max_found
+                or isinstance(meth, ScaledPDFNorm)) or a.log_file:
             return False
         eps = self.eps
         if type(eps) is ListTemperature:
@@ -883,6 +895,10 @@ class ABCSMC:
         else:
             if eps.aggregate_fun is not min \
                     or not eps.enforce_less_equal_prev or eps.log_file:
+                return False
+            if not eps._effective_schemes():
+                # schemes=[] means no device annealing recursion exists;
+                # the host loop handles that degenerate configuration
                 return False
             need_horizon = {"ExpDecayFixedIterScheme",
                             "PolynomialDecayFixedIterScheme",
@@ -906,7 +922,13 @@ class ABCSMC:
             if tr.bandwidth_selector not in (scott_rule_of_thumb,
                                              silverman_rule_of_thumb):
                 return False
-        elif type(tr) is not LocalTransition:
+        elif type(tr) is LocalTransition:
+            # static neighbor count k needs a constant population size
+            # (same gate as the uniform-acceptor branch)
+            if not isinstance(self.population_strategy,
+                              ConstantPopulationSize):
+                return False
+        else:
             return False
         if type(self.model_perturbation_kernel) is not ModelPerturbationKernel:
             return False
@@ -970,7 +992,12 @@ class ABCSMC:
                 else float(pdf_max)
             if not np.isfinite(pdf_max):
                 pdf_max = None
-        return (tuple(schemes), max_np, pdf_max, lin)
+        from ..acceptor.pdf_norm import ScaledPDFNorm
+
+        meth = self.acceptor.pdf_norm_method
+        pdf_scaled = ((float(meth.factor), float(meth.alpha))
+                      if isinstance(meth, ScaledPDFNorm) else None)
+        return (tuple(schemes), max_np, pdf_max, lin, pdf_scaled)
 
     def _loop_fused(self, t0, minimum_epsilon, max_nr_populations,
                     min_acceptance_rate, max_total_nr_simulations,
@@ -1058,13 +1085,18 @@ class ABCSMC:
         # speculatively in this mode
         sumstat_mode = getattr(self.distance_function, "sumstat", None) \
             is not None
-        n_cap = _pow2(n, 64)
+        # static shapes are sized for the LARGEST generation of a varying
+        # (ListPopulationSize) schedule; smaller generations mask down
+        n_max = (max(self.population_strategy.values)
+                 if isinstance(self.population_strategy, ListPopulationSize)
+                 else n)
+        n_cap = _pow2(n_max, 64)
         rec_cap = _pow2(8 * n_cap, 256) if (adaptive or stochastic) else 1
-        B = self.sampler._pick_B(n)
+        B = self.sampler._pick_B(n_max)
         max_rounds = self.sampler.max_rounds
         if min_acceptance_rate > 0:
             max_rounds = max(1, min(
-                max_rounds, int(n / min_acceptance_rate) // B + 1
+                max_rounds, int(n_max / min_acceptance_rate) // B + 1
             ))
 
         G = self.fused_generations
@@ -1090,6 +1122,8 @@ class ABCSMC:
                 g = min(g, int(max_nr_populations) - t_at)
             if isinstance(self.eps, ListEpsilon):
                 g = min(g, len(self.eps.epsilon_values) - t_at)
+            if isinstance(self.population_strategy, ListPopulationSize):
+                g = min(g, len(self.population_strategy.values) - t_at)
             return max(g, 0)
 
         def _dispatch_chunk(carry, t_at: int, g_limit: int):
@@ -1101,9 +1135,12 @@ class ABCSMC:
             if (not eps_quantile and not stochastic) or temp_fixed:
                 for g in range(g_limit):
                     eps_fixed[g] = self.eps(t_at + g)
+            n_sched = np.full(G, n, np.int32)
+            for g in range(g_limit):
+                n_sched[g] = self.population_strategy(t_at + g)
             return kern(
                 self._root_key, jnp.asarray(t_at, jnp.int32),
-                jnp.asarray(n, jnp.int32),
+                jnp.asarray(n_sched),
                 jnp.asarray(g_limit, jnp.int32), carry,
                 jnp.asarray(self.model_perturbation_kernel.device_params()),
                 jnp.asarray(eps_fixed),
@@ -1192,7 +1229,8 @@ class ABCSMC:
         self.history.start_async_writer()
         try:
             return self._fused_chunk_loop(
-                t, g_limit, n, carry0, _g_limit, _dispatch_chunk,
+                t, g_limit, self.population_strategy, carry0, _g_limit,
+                _dispatch_chunk,
                 minimum_epsilon, max_nr_populations, min_acceptance_rate,
                 max_total_nr_simulations, max_walltime, start_walltime,
                 sims_total, eps_quantile, adaptive, stochastic,
@@ -1215,7 +1253,7 @@ class ABCSMC:
                 )
             raise
 
-    def _fused_chunk_loop(self, t, g_limit, n, carry0, _g_limit,
+    def _fused_chunk_loop(self, t, g_limit, n_of, carry0, _g_limit,
                           _dispatch_chunk, minimum_epsilon,
                           max_nr_populations, min_acceptance_rate,
                           max_total_nr_simulations, max_walltime,
@@ -1279,6 +1317,7 @@ class ABCSMC:
             # loop would record the same value g_limit times
             mem_telemetry = self._device_memory_telemetry()
             for g in range(g_limit):
+                n = n_of(t)  # per-generation target (t advances below)
                 if not bool(fetched["gen_ok"][g]):
                     logger.info(
                         "stopping: fused generation %d incomplete "

@@ -38,6 +38,7 @@ import numpy as np
 from ..core.parameters import Parameter
 from ..core.population import Particle
 from ..core.random import round_key
+from ..core.random_choice import fast_random_choice
 from ..core.sumstat_spec import SumStatSpec
 from ..model import JaxModel, Model
 
@@ -88,8 +89,6 @@ def generate_valid_proposal(t, model_probabilities, model_perturbation_kernel,
         # here would initialize an XLA backend after fork and deadlock
         theta = parameter_priors[m].rvs_host()
         return m, theta
-    from ..core.random_choice import fast_random_choice
-
     ms = np.asarray(list(model_probabilities.keys()))
     ps = np.asarray(list(model_probabilities.values()), np.float64)
     ps = ps / ps.sum()
@@ -742,7 +741,7 @@ class DeviceContext:
 
         K = self.K
 
-        def multigen_fn(root, t0, n_target, g_limit, carry0, mpk_base,
+        def multigen_fn(root, t0, n_sched, g_limit, carry0, mpk_base,
                         eps_fixed, min_eps, min_acc_rate):
             def run_lanes(key, dyn):
                 keys = jax.random.split(key, B)
@@ -761,6 +760,9 @@ class DeviceContext:
                 # of tracing a shorter scan (a ~20s compile per distinct G)
                 stopped = stopped | (g >= g_limit)
                 t = t0 + g
+                # per-generation population target (constant schedules pass
+                # a constant-filled array; ListPopulationSize varies it)
+                n_target = n_sched[g]
                 gen_key = jax.random.fold_in(root, t + 1)  # generation_key
                 if (stochastic and not temp_fixed) or eps_quantile:
                     eps_g = eps_carry
@@ -981,7 +983,8 @@ class DeviceContext:
         import jax
         import jax.numpy as jnp
 
-        schemes, max_np, pdf_max_s, lin_scale = temp_config
+        schemes, max_np, pdf_max_s, lin_scale, *rest = temp_config
+        pdf_scaled = rest[0] if rest else None
         # pdf_norm update from ACCEPTED kernel values (host semantics:
         # acceptor.update reads the weighted accepted distances)
         v_acc = res["distance"]
@@ -992,7 +995,24 @@ class DeviceContext:
         if pdf_max_s is not None:
             pdf_norm_next = jnp.full((), pdf_max_s, jnp.float32)
         else:
+            # the scaled carry never exceeds max_found, so taking the max
+            # with it reproduces the host's prev_pdf_norm recursion for
+            # both the plain and the ScaledPDFNorm method
             pdf_norm_next = jnp.maximum(pdf_norm, max_found_next)
+        if pdf_scaled is not None:
+            # ScaledPDFNorm twin: cap the norm at the alpha-quantile of the
+            # accepted kernel values plus log(factor) (host uses
+            # np.quantile's linear interpolation — replicated exactly)
+            factor, q_alpha = pdf_scaled
+            svals = jnp.sort(jnp.where(k_mask, logv_acc, jnp.inf))
+            n_accd = jnp.maximum(k_mask.sum(), 1)
+            pos = q_alpha * (n_accd - 1).astype(jnp.float32)
+            lo_i = jnp.floor(pos).astype(jnp.int32)
+            hi_i = jnp.ceil(pos).astype(jnp.int32)
+            frac = pos - lo_i.astype(jnp.float32)
+            quant = svals[lo_i] * (1.0 - frac) + svals[hi_i] * frac
+            pdf_norm_next = jnp.minimum(
+                pdf_norm_next, quant + jnp.log(factor))
 
         t_next = (t + 1).astype(jnp.float32)
         daly_k_next = daly_k

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 
+from ..core.random_choice import fast_random_choice
 from .base import Transition
 from .exceptions import NotEnoughParticles
 from .util import silverman_rule_of_thumb
@@ -82,8 +83,6 @@ class LocalTransition(Transition):
         self._logdets = logdets
 
     def rvs_single(self) -> pd.Series:
-        from ..core.random_choice import fast_random_choice
-
         idx = fast_random_choice(self.w)
         theta = np.asarray(self.X.iloc[idx], np.float64)
         perturbed = theta + self._chols[idx] @ np.random.normal(size=len(theta))

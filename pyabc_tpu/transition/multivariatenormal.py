@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 
+from ..core.random_choice import fast_random_choice
 from .base import Transition
 from .util import scott_rule_of_thumb, silverman_rule_of_thumb, smart_cov
 
@@ -63,8 +64,6 @@ class MultivariateNormalTransition(Transition):
         return self._cov
 
     def rvs_single(self) -> pd.Series:
-        from ..core.random_choice import fast_random_choice
-
         idx = fast_random_choice(self.w)
         theta = np.asarray(self.X.iloc[idx], np.float64)
         perturbed = theta + self._chol @ np.random.normal(size=len(theta))

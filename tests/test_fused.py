@@ -248,3 +248,18 @@ def test_local_transition_device_fit_matches_host_fit():
     np.testing.assert_allclose(
         np.asarray(dev["chols"]), host._chols, rtol=5e-3, atol=5e-3
     )
+
+
+def test_fused_list_population_size():
+    """ListPopulationSize rides fused chunks: static shapes are sized for
+    the largest generation, smaller generations mask down; the History
+    must hold exactly the scheduled particle counts per generation."""
+    sched = [200, 300, 150, 250, 100]
+    abc, h = _run(4, pop=pt.ListPopulationSize(sched), n_gens=len(sched))
+    assert h.get_telemetry(2).get("fused_chunk"), "fused path not taken"
+    counts = h.get_nr_particles_per_population()
+    for t, n_t in enumerate(sched):
+        assert counts[t] == n_t, (t, counts)
+    df, w = h.get_distribution(0, h.max_t)
+    mu = float(np.sum(df["theta"] * w))
+    assert mu == pytest.approx(POST_MU, abs=0.35)

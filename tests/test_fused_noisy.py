@@ -332,3 +332,99 @@ class TestListTemperatureFused:
             df, w = h.get_distribution(0, h.max_t)
             mu = float(np.sum(df["theta"] * w))
             assert mu == pytest.approx(mu_true, abs=0.15)
+
+
+class TestScaledPDFNormFused:
+    def test_capable_and_posterior_parity(self):
+        """ScaledPDFNorm (down-scale the norm when acceptance would
+        collapse) now has an in-kernel twin; fused and unfused runs must
+        agree with the exact posterior, and the host pdf_norms mirror the
+        device recursion."""
+        from pyabc_tpu.acceptor.pdf_norm import ScaledPDFNorm
+
+        def make(fused_generations):
+            # a forced decay ladder keeps T > 1 for several generations
+            # (with the scaled norm, acceptance-rate-driven schedules hit
+            # T=1 immediately — correct host semantics, but then nothing
+            # would exercise the in-kernel scaled-norm recursion)
+            prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+            return pt.ABCSMC(
+                _det_model(), prior,
+                pt.IndependentNormalKernel(var=[NOISE_SD**2]),
+                population_size=300,
+                eps=pt.Temperature(schemes=[ExpDecayFixedIterScheme()],
+                                   initial_temperature=64.0),
+                acceptor=pt.StochasticAcceptor(
+                    pdf_norm_method=ScaledPDFNorm(factor=5.0, alpha=0.5)),
+                seed=29, fused_generations=fused_generations,
+            )
+
+        abc_f = make(4)
+        abc_f.new("sqlite://", {"x": X_OBS})
+        h_f = abc_f.run(max_nr_populations=7)
+        assert h_f.get_telemetry(2).get("fused_chunk"), "not fused"
+        abc_u = make(1)
+        abc_u.new("sqlite://", {"x": X_OBS})
+        h_u = abc_u.run(max_nr_populations=7)
+        mu_true, _ = exact_posterior()
+        for h in (h_f, h_u):
+            df, w = h.get_distribution(0, h.max_t)
+            mu = float(np.sum(df["theta"] * w))
+            assert mu == pytest.approx(mu_true, abs=0.15)
+        # scaled norms mirrored for every fused generation
+        for t in range(1, h_f.n_populations):
+            assert t in abc_f.acceptor.pdf_norms
+
+    def test_device_scaled_norm_matches_host_method(self):
+        """Same accepted kernel values -> the in-kernel quantile cap must
+        equal the host ScaledPDFNorm (np.quantile linear interpolation)."""
+        import jax.numpy as jnp
+
+        from pyabc_tpu.acceptor.pdf_norm import ScaledPDFNorm
+        from pyabc_tpu.inference.util import DeviceContext
+
+        rng = np.random.default_rng(1)
+        vals = -np.abs(rng.normal(2.0, 1.5, 128))
+        host = ScaledPDFNorm(factor=5.0, alpha=0.5)
+        norm_host = host(kernel_val=vals, pdf_max=None,
+                         max_found=float(vals.max()), prev_pdf_norm=-1e30)
+
+        ctx = object.__new__(DeviceContext)
+        out = DeviceContext._stochastic_gen_update(
+            ctx,
+            ((("exp_decay_fixed_ratio", 0.5, 1e-4, 0.5),), -1, None, False,
+             (5.0, 0.5)),
+            None, None,
+            {"theta": None, "logq": None, "valid": None, "distance": None},
+            {"distance": jnp.asarray(vals, jnp.float32)},
+            jnp.ones(128, bool),
+            jnp.full(128, 1 / 128, jnp.float32),
+            jnp.asarray(-1e30), jnp.asarray(-1e30), jnp.zeros(()),
+            jnp.asarray(50.0, jnp.float32), jnp.asarray(0.5),
+            jnp.asarray(2),
+        )
+        norm_dev = float(out[1][0])
+        assert norm_dev == pytest.approx(norm_host, rel=1e-4, abs=1e-4)
+
+
+class TestCapabilityGates:
+    """Configs that must NOT take the fused path (review regressions)."""
+
+    def test_stochastic_local_transition_needs_constant_population(self):
+        abc = _noisy_abc(
+            transitions=pt.LocalTransition(),
+        )
+        abc.population_strategy = pt.ListPopulationSize([400] * 8)
+        abc.new("sqlite://", {"x": X_OBS})
+        abc._initialize_components(8)
+        assert not abc._fused_chunk_capable()
+
+    def test_empty_scheme_list_falls_back(self):
+        """Temperature(schemes=[]) has no annealing recursion for the
+        device to run; it must use the host loop (which applies the
+        final-generation T=1 forcing)."""
+        abc = _noisy_abc(eps=pt.Temperature(schemes=[],
+                                            initial_temperature=64.0))
+        abc.new("sqlite://", {"x": X_OBS})
+        abc._initialize_components(8)
+        assert not abc._fused_chunk_capable()
