@@ -807,8 +807,17 @@ class ABCSMC:
             return False
         if type(self.acceptor) is StochasticAcceptor:
             return self._fused_stochastic_capable()
-        if type(self.acceptor) is not UniformAcceptor \
-                or self.acceptor.use_complete_history:
+        if type(self.acceptor) is not UniformAcceptor:
+            return False
+        if self.acceptor.use_complete_history and (
+                (isinstance(self.distance_function, AdaptivePNormDistance)
+                 and self.distance_function.adaptive)
+                or getattr(self.distance_function, "sumstat", None)
+                is not None):
+            # a distance whose space can change between generations
+            # (adaptive reweighting, learned-sumstat refits) restarts the
+            # epsilon trail via note_epsilon(distance_changed=True); the
+            # host loop keeps those subtle semantics
             return False
         if type(self.model_perturbation_kernel) is not ModelPerturbationKernel:
             # the kernel only honors the stock static transition matrix;
@@ -1101,6 +1110,10 @@ class ABCSMC:
 
         G = self.fused_generations
         temp_fixed = stochastic and type(self.eps) is ListTemperature
+        complete_history = (
+            type(self.acceptor) is UniformAcceptor
+            and self.acceptor.use_complete_history
+        )
         kern = ctx.multigen_kernel(
             B, n_cap, rec_cap, max_rounds, G,
             adaptive=adaptive, eps_quantile=eps_quantile,
@@ -1113,6 +1126,7 @@ class ABCSMC:
             stochastic=stochastic,
             temp_config=self._temp_config() if stochastic else None,
             temp_fixed=temp_fixed,
+            complete_history=complete_history,
             sumstat_transform=sumstat_mode,
         )
 
@@ -1208,7 +1222,11 @@ class ABCSMC:
                     jnp.asarray(daly_k0, jnp.float32),
                 )
             else:
-                acc_state0 = (jnp.zeros((), jnp.float32),
+                # with use_complete_history, slot 0 seeds the running min
+                # of all epsilons BEFORE the chunk's first generation
+                hist_min = (float(self.acceptor._historic_min(t_at))
+                            if complete_history else 0.0)
+                acc_state0 = (jnp.asarray(hist_min, jnp.float32),
                               jnp.asarray(-1e30, jnp.float32),
                               jnp.zeros((), jnp.float32))
             return (tuple(trans0), jnp.asarray(log_probs0, jnp.float32),

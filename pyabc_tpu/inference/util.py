@@ -656,6 +656,7 @@ class DeviceContext:
                         stochastic: bool = False,
                         temp_config: tuple | None = None,
                         temp_fixed: bool = False,
+                        complete_history: bool = False,
                         sumstat_transform: bool = False):
         """One jitted program for G WHOLE GENERATIONS (transition mode).
 
@@ -698,7 +699,8 @@ class DeviceContext:
         cache_key = ("multigen", B, n_cap, rec_cap, max_rounds, G, adaptive,
                      eps_quantile, eps_weighted, alpha, multiplier,
                      trans_cls.__name__, fit_statics, dims,
-                     stochastic, temp_config, temp_fixed, sumstat_transform)
+                     stochastic, temp_config, temp_fixed, complete_history,
+                     sumstat_transform)
         if cache_key in self._kernels:
             return self._kernels[cache_key]
         if stochastic and self.K != 1:
@@ -784,10 +786,14 @@ class DeviceContext:
                     model_factor > 0,
                     jnp.log(jnp.maximum(model_factor, 1e-38)), -jnp.inf,
                 )
+                # non-stochastic with use_complete_history: the pdf_norm
+                # carry slot holds the running min of all past epsilons
+                # (UniformAcceptor.device_fn reads it as acc_params)
                 dyn = {
                     "eps": eps_g,
                     "dist_params": dist_w,
-                    "acc_params": pdf_norm if stochastic else (),
+                    "acc_params": (pdf_norm if stochastic or complete_history
+                                   else ()),
                     "log_model_probs": log_model_probs,
                     "mpk_matrix": matrix,
                     "log_model_factor": log_model_factor,
@@ -911,7 +917,9 @@ class DeviceContext:
                         # from the host-precomputed schedule, not a scheme
                         eps_next = eps_fixed[jnp.minimum(g + 1, G - 1)]
                 else:
-                    acc_state_next = (pdf_norm, max_found, daly_k)
+                    eps_min_next = (jnp.minimum(pdf_norm, eps_g)
+                                    if complete_history else pdf_norm)
+                    acc_state_next = (eps_min_next, max_found, daly_k)
                     temp_extra = {}
 
                 stopped_next = (

@@ -52,12 +52,19 @@ def test_fused_capability_detected():
                         population_size=100, eps=pt.MedianEpsilon(),
                         fused_generations=1)
     assert not abc_off._fused_chunk_capable()
-    # stochastic acceptor family: not fused-eligible
+    # complete-history acceptance is fused-capable with a FIXED distance
+    # (the epsilon-min carry) but not with an adaptive one (the host loop
+    # keeps the trail-restart semantics)
     abc_k = pt.ABCSMC(_gauss_model(), prior, pt.PNormDistance(p=2),
                       population_size=100,
                       eps=pt.ListEpsilon([1.0, 0.5]),
                       acceptor=pt.UniformAcceptor(use_complete_history=True))
-    assert not abc_k._fused_chunk_capable()
+    assert abc_k._fused_chunk_capable()
+    abc_k2 = pt.ABCSMC(_gauss_model(), prior, pt.AdaptivePNormDistance(p=2),
+                       population_size=100, eps=pt.MedianEpsilon(),
+                       acceptor=pt.UniformAcceptor(
+                           use_complete_history=True))
+    assert not abc_k2._fused_chunk_capable()
     # custom scale function shadowing a builtin name: host path only
     def median_absolute_deviation(samples, x_0=None):
         return 2.0 * np.median(np.abs(samples - np.median(samples, 0)), 0)
@@ -263,3 +270,46 @@ def test_fused_list_population_size():
     df, w = h.get_distribution(0, h.max_t)
     mu = float(np.sum(df["theta"] * w))
     assert mu == pytest.approx(POST_MU, abs=0.35)
+
+
+def test_fused_complete_history_acceptor():
+    """use_complete_history rides fused chunks: the running min of past
+    epsilons is a carry; a deliberately NON-monotone ListEpsilon makes the
+    historic bound bite (eps jumps back up at t=2, but particles must
+    still satisfy the earlier tighter threshold)."""
+    eps_list = [2.0, 0.8, 1.5, 0.6, 0.5]
+    kwargs = dict(
+        distance=pt.PNormDistance(p=2),
+        eps=pt.ListEpsilon(eps_list),
+        acceptor=pt.UniformAcceptor(use_complete_history=True),
+        n_gens=len(eps_list), pop=300,
+    )
+    abc_f, h_f = _run(4, seed=31, **kwargs)
+    assert h_f.get_telemetry(2).get("fused_chunk"), "fused path not taken"
+    abc_u, h_u = _run(1, seed=31, **kwargs)
+    assert h_f.n_populations == h_u.n_populations
+    # at t=2 (eps back up to 1.5) every accepted distance must still obey
+    # the historic min 0.8 — on BOTH paths
+    for h in (h_f, h_u):
+        wd = h.get_weighted_distances(2)
+        assert float(wd["distance"].max()) <= 0.8 + 1e-6
+    mu_f = float(np.sum(h_f.get_distribution(0, h_f.max_t)[0]["theta"]
+                        * h_f.get_distribution(0, h_f.max_t)[1]))
+    mu_u = float(np.sum(h_u.get_distribution(0, h_u.max_t)[0]["theta"]
+                        * h_u.get_distribution(0, h_u.max_t)[1]))
+    assert mu_f == pytest.approx(mu_u, abs=0.25)
+
+
+def test_complete_history_with_changing_distance_falls_back():
+    """A distance whose space changes between generations (adaptive
+    weights OR learned-sumstat refits) restarts the epsilon trail on the
+    host; complete-history acceptance must not fuse with either."""
+    prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+    abc = pt.ABCSMC(
+        _gauss_model(), prior,
+        pt.PNormDistance(p=2, sumstat=pt.PredictorSumstat(
+            pt.LinearPredictor())),
+        population_size=100, eps=pt.MedianEpsilon(),
+        acceptor=pt.UniformAcceptor(use_complete_history=True),
+    )
+    assert not abc._fused_chunk_capable()
