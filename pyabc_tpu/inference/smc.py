@@ -1224,11 +1224,12 @@ class ABCSMC:
             else:
                 # with use_complete_history, slot 0 seeds the running min
                 # of all epsilons BEFORE the chunk's first generation
-                hist_min = (float(self.acceptor._historic_min(t_at))
-                            if complete_history else 0.0)
-                acc_state0 = (jnp.asarray(hist_min, jnp.float32),
-                              jnp.asarray(-1e30, jnp.float32),
-                              jnp.zeros((), jnp.float32))
+                # (device_params(t) IS the acceptor's historic-min export)
+                acc_state0 = (
+                    jnp.asarray(self.acceptor.device_params(t_at)
+                                if complete_history else 0.0, jnp.float32),
+                    jnp.asarray(-1e30, jnp.float32),
+                    jnp.zeros((), jnp.float32))
             return (tuple(trans0), jnp.asarray(log_probs0, jnp.float32),
                     jnp.asarray(fitted0), dist_w0,
                     jnp.asarray(self.eps(t_at), jnp.float32),

@@ -8,6 +8,7 @@ from .diagnostics import (
     plot_acceptance_rates_trajectory,
     plot_distance_weights,
     plot_effective_sample_sizes,
+    plot_eps_walltime,
     plot_epsilons,
     plot_model_probabilities,
     plot_sample_numbers,
@@ -41,6 +42,7 @@ __all__ = [
     "plot_epsilons", "plot_sample_numbers", "plot_sample_numbers_trajectory",
     "plot_acceptance_rates_trajectory", "plot_model_probabilities",
     "plot_effective_sample_sizes", "plot_total_walltime", "plot_walltime",
+    "plot_eps_walltime",
     "plot_distance_weights",
     "plot_sensitivity_sankey",
     "plot_data_default", "plot_data_callback",

@@ -160,6 +160,26 @@ def plot_walltime(histories, labels=None, unit: str = "s", rotation=0,
     return ax
 
 
+def plot_eps_walltime(histories, labels=None, unit: str = "s",
+                      ax=None, size=None, yscale: str = "log"):
+    """Epsilon against CUMULATIVE walltime (reference plot_eps_walltime):
+    the convergence-per-compute view used to compare samplers."""
+    histories, labels = to_lists(histories, labels)
+    fig, ax = get_figure(ax, size)
+    factor = {"s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}[unit]
+    for h, lab in zip(histories, labels):
+        pops = h.get_all_populations().query("t >= 0")
+        times = pd.to_datetime(pops["population_end_time"])
+        cum = (times - times.min()).dt.total_seconds().to_numpy() / factor
+        ax.plot(cum, pops["epsilon"].to_numpy(), "x-", label=lab)
+    ax.set_xlabel(f"cumulative walltime [{unit}]")
+    ax.set_ylabel("epsilon")
+    if yscale:
+        ax.set_yscale(yscale)
+    ax.legend()
+    return ax
+
+
 def plot_distance_weights(distance, t=None, labels=None, ax=None, size=None,
                           **kwargs):
     """Per-statistic weights of an adaptive distance (reference

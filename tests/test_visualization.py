@@ -85,6 +85,8 @@ def test_effective_sample_sizes(history):
 def test_walltimes(history):
     viz.plot_total_walltime(history)
     viz.plot_walltime(history)
+    ax = viz.plot_eps_walltime(history, unit="m")
+    assert ax.get_xlabel() == "cumulative walltime [m]"
 
 
 def test_credible_intervals(history):
