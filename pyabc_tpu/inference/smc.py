@@ -809,15 +809,12 @@ class ABCSMC:
             return self._fused_stochastic_capable()
         if type(self.acceptor) is not UniformAcceptor:
             return False
-        if self.acceptor.use_complete_history and (
-                (isinstance(self.distance_function, AdaptivePNormDistance)
-                 and self.distance_function.adaptive)
-                or getattr(self.distance_function, "sumstat", None)
-                is not None):
+        if self.acceptor.use_complete_history \
+                and self._distance_may_change():
             # a distance whose space can change between generations
-            # (adaptive reweighting, learned-sumstat refits) restarts the
-            # epsilon trail via note_epsilon(distance_changed=True); the
-            # host loop keeps those subtle semantics
+            # restarts the epsilon trail via
+            # note_epsilon(distance_changed=True); the host loop keeps
+            # those subtle semantics
             return False
         if type(self.model_perturbation_kernel) is not ModelPerturbationKernel:
             # the kernel only honors the stock static transition matrix;
@@ -944,6 +941,16 @@ class ABCSMC:
         if np.isfinite(self.max_nr_recorded_particles):
             return False
         return True
+
+    def _distance_may_change(self) -> bool:
+        """True when the distance's space can change between generations
+        (update() may return True: adaptive reweighting — AdaptivePNorm,
+        AdaptiveAggregated — or learned-sumstat refits). Such changes make
+        past epsilon thresholds incomparable (the complete-history trail
+        restarts on them)."""
+        d = self.distance_function
+        return bool(getattr(d, "adaptive", False)) \
+            or getattr(d, "sumstat", None) is not None
 
     def _transition_fit_statics(self, n: int) -> tuple:
         """Per-model static kwargs for the in-kernel ``device_fit`` refits.
@@ -1797,6 +1804,18 @@ class ABCSMC:
             t=t_last + 1, get_weighted_distances=lambda: wd0,
             distance_function=self.distance_function, x_0=self.x_0,
         )
+        # replay the epsilon trail from the stored populations so the
+        # complete-history acceptor resumes with the SAME historic minimum
+        # it would have had in an uninterrupted run (the trail is not
+        # serialized; with an adaptive distance the restart rule below
+        # keeps only the last threshold, matching the live loop)
+        if hasattr(self.acceptor, "note_epsilon"):
+            adaptive = self._distance_may_change()
+            pops = self.history.get_all_populations().query("t >= 0")
+            for t_row, eps_row in zip(pops["t"], pops["epsilon"]):
+                if t_row <= t_last and np.isfinite(eps_row):
+                    self.acceptor.note_epsilon(
+                        int(t_row), float(eps_row), adaptive)
         for m in self._model_probs:
             df, w = self.history.get_distribution(m, t_last)
             df = df[[c for c in df.columns if c != "pid"]]

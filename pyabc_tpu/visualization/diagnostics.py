@@ -168,9 +168,13 @@ def plot_eps_walltime(histories, labels=None, unit: str = "s",
     fig, ax = get_figure(ax, size)
     factor = {"s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}[unit]
     for h, lab in zip(histories, labels):
-        pops = h.get_all_populations().query("t >= 0")
+        all_pops = h.get_all_populations()
+        # anchor at the run start (the t=-1 calibration row, like
+        # plot_total_walltime) so generation 0's cost is visible
+        t0 = pd.to_datetime(all_pops["population_end_time"]).min()
+        pops = all_pops.query("t >= 0")
         times = pd.to_datetime(pops["population_end_time"])
-        cum = (times - times.min()).dt.total_seconds().to_numpy() / factor
+        cum = (times - t0).dt.total_seconds().to_numpy() / factor
         ax.plot(cum, pops["epsilon"].to_numpy(), "x-", label=lab)
     ax.set_xlabel(f"cumulative walltime [{unit}]")
     ax.set_ylabel("epsilon")

@@ -313,3 +313,36 @@ def test_complete_history_with_changing_distance_falls_back():
         acceptor=pt.UniformAcceptor(use_complete_history=True),
     )
     assert not abc._fused_chunk_capable()
+
+
+@pytest.mark.parametrize("resume_fused_g", [2, 1])
+def test_complete_history_resume_replays_epsilon_trail(tmp_path,
+                                                       resume_fused_g):
+    """Resume must rebuild the complete-history acceptor's epsilon trail
+    from the db: after load(), the historic min equals the min of all
+    stored epsilons — on the fused path (resume_fused_g=2) AND the host
+    per-generation loop (resume_fused_g=1)."""
+    db = f"sqlite:///{tmp_path}/uch.db"
+    eps_list = [2.0, 0.8, 1.5, 0.6, 0.5]
+
+    def make(fused_g):
+        prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+        return pt.ABCSMC(
+            _gauss_model(), prior, pt.PNormDistance(p=2),
+            population_size=200, eps=pt.ListEpsilon(eps_list),
+            acceptor=pt.UniformAcceptor(use_complete_history=True),
+            seed=41, fused_generations=fused_g,
+        )
+
+    abc = make(2)
+    abc.new(db, {"x": X_OBS})
+    h1 = abc.run(max_nr_populations=3)  # t = 0, 1, 2 (eps 2.0, 0.8, 1.5)
+    abc2 = make(resume_fused_g)
+    abc2.load(db, h1.id)
+    h2 = abc2.run(max_nr_populations=5)
+    # the trail was replayed: min over stored epsilons (0.8) bounded every
+    # post-resume generation even though eps itself was higher at t=2
+    assert abc2.acceptor._historic_min(3) == pytest.approx(0.8)
+    for t in (3, 4):
+        wd = h2.get_weighted_distances(t)
+        assert float(wd["distance"].max()) <= min(eps_list[: t + 1]) + 1e-6
