@@ -8,6 +8,12 @@ threshold shrinks.
 Run: ``python examples/01_gaussian_toy.py`` (env: EX_POP, EX_GENS).
 """
 import os
+import sys
+
+# make `python examples/<name>.py` work from a repo checkout
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
 
 import numpy as np
 

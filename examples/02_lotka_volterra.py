@@ -9,6 +9,12 @@ multi-generation kernel (whole chunks of generations per dispatch).
 Run: ``python examples/02_lotka_volterra.py`` (env: EX_POP, EX_GENS).
 """
 import os
+import sys
+
+# make `python examples/<name>.py` work from a repo checkout
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
 
 import numpy as np
 

@@ -10,6 +10,12 @@ model (no epsilon bias).
 Run: ``python examples/04_noisy_abc_sir.py`` (env: EX_POP, EX_GENS).
 """
 import os
+import sys
+
+# make `python examples/<name>.py` work from a repo checkout
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
 
 import numpy as np
 

@@ -11,6 +11,12 @@ adapters).
 Run: ``python examples/05_external_model.py`` (env: EX_POP, EX_GENS).
 """
 import os
+import sys
+
+# make `python examples/<name>.py` work from a repo checkout
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
 import stat
 import tempfile
 

@@ -10,8 +10,13 @@ cluster you would start them on other machines instead.
 Run: ``python examples/07_elastic_workers.py`` (env: EX_POP, EX_GENS).
 """
 import os
-import subprocess
 import sys
+
+# make `python examples/<name>.py` work from a repo checkout
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+import subprocess
 
 import numpy as np
 

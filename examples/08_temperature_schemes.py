@@ -12,6 +12,12 @@ known) from three different annealing strategies.
 Run: ``python examples/08_temperature_schemes.py`` (env: EX_POP).
 """
 import os
+import sys
+
+# make `python examples/<name>.py` work from a repo checkout
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
 
 import numpy as np
 

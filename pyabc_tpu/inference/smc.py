@@ -260,6 +260,7 @@ class ABCSMC:
         }
         self.x_0 = observed
         self.spec = SumStatSpec(observed) if observed else None
+        self._resumed_distance_changed = False  # only load() sets this
         self.history = History(db, store_sum_stats=store_sum_stats)
         options = dict(meta_info or {})
         options["parameter_names"] = {
@@ -617,7 +618,8 @@ class ABCSMC:
 
         t = t0
         sims_total = self.history.total_nr_simulations
-        distance_changed_at_t = False
+        distance_changed_at_t = getattr(
+            self, "_resumed_distance_changed", False)
         while True:
             current_eps = self.eps(t)
             if hasattr(self.acceptor, "note_epsilon"):
@@ -668,6 +670,10 @@ class ABCSMC:
                 "adapt_s": round(time.time() - t_adapt0, 4),
                 "persist_s": round(persist_s, 4),
                 "acceptance_rate": round(acceptance_rate, 6),
+                # "the distance changed AFTER generation t" — the resume
+                # replay reads this to restart the epsilon trail exactly
+                # where the live run did
+                "distance_changed": bool(distance_changed_at_t),
             })
 
             if self._check_stop(t, current_eps, minimum_epsilon,
@@ -1386,6 +1392,9 @@ class ABCSMC:
                         "sample_s": round(chunk_s / g_limit, 4),
                         "n_evaluations": nr_evals,
                         "acceptance_rate": round(acceptance_rate, 6),
+                        "distance_changed": bool(
+                            adaptive
+                            or (sumstat_refit and g == g_limit - 1)),
                         **(mem_telemetry if g == 0 else {}),
                     },
                 )
@@ -1588,7 +1597,8 @@ class ABCSMC:
 
         t = t0
         sims_total = self.history.total_nr_simulations
-        distance_changed_at_t = False
+        distance_changed_at_t = getattr(
+            self, "_resumed_distance_changed", False)
         last_strategies_s = 0.0  # first generation never speculates
 
         def _dispatch(t_next, speculative=None):
@@ -1694,6 +1704,7 @@ class ABCSMC:
                            "adapt_s": round(adapt_s, 4),
                            "n_evaluations": int(nr_evals),
                            "acceptance_rate": round(acceptance_rate, 6),
+                           "distance_changed": bool(distance_changed_at_t),
                            "pipelined": True,
                            **handle.get("dispatch_telemetry", {})},
             )
@@ -1806,16 +1817,27 @@ class ABCSMC:
         )
         # replay the epsilon trail from the stored populations so the
         # complete-history acceptor resumes with the SAME historic minimum
-        # it would have had in an uninterrupted run (the trail is not
-        # serialized; with an adaptive distance the restart rule below
-        # keeps only the last threshold, matching the live loop)
+        # it would have had in an uninterrupted run. Each generation's
+        # telemetry records whether the distance changed AFTER it (the
+        # live loops write "distance_changed"); dbs from before that
+        # column fall back to the conservative may-change rule.
         if hasattr(self.acceptor, "note_epsilon"):
-            adaptive = self._distance_may_change()
+            fallback = self._distance_may_change()
+
+            def _changed_after(t_row: int) -> bool:
+                tel = self.history.get_telemetry(t_row)
+                return bool(tel.get("distance_changed", fallback))
+
             pops = self.history.get_all_populations().query("t >= 0")
             for t_row, eps_row in zip(pops["t"], pops["epsilon"]):
                 if t_row <= t_last and np.isfinite(eps_row):
+                    restart = _changed_after(int(t_row) - 1) \
+                        if t_row > 0 else False
                     self.acceptor.note_epsilon(
-                        int(t_row), float(eps_row), adaptive)
+                        int(t_row), float(eps_row), restart)
+            # the resumed loop's FIRST note_epsilon must see whether the
+            # distance changed after the last stored generation
+            self._resumed_distance_changed = _changed_after(t_last)
         for m in self._model_probs:
             df, w = self.history.get_distribution(m, t_last)
             df = df[[c for c in df.columns if c != "pid"]]

@@ -346,3 +346,35 @@ def test_complete_history_resume_replays_epsilon_trail(tmp_path,
     for t in (3, 4):
         wd = h2.get_weighted_distances(t)
         assert float(wd["distance"].max()) <= min(eps_list[: t + 1]) + 1e-6
+
+
+def test_resume_trail_respects_recorded_distance_changes(tmp_path):
+    """The live loops record "distance_changed" per generation; the resume
+    replay restarts the trail exactly where the live run did — with an
+    adaptive distance (changes every generation) only the LAST threshold
+    survives, not the historic min."""
+    db = f"sqlite:///{tmp_path}/uch_adaptive.db"
+    prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+
+    def make():
+        return pt.ABCSMC(
+            _gauss_model(), prior, pt.AdaptivePNormDistance(p=2),
+            population_size=150, eps=pt.MedianEpsilon(),
+            acceptor=pt.UniformAcceptor(use_complete_history=True),
+            seed=43,  # host loop: complete-history + adaptive never fuses
+        )
+
+    abc = make()
+    abc.new(db, {"x": X_OBS})
+    h1 = abc.run(max_nr_populations=3)
+    assert h1.get_telemetry(1).get("distance_changed") is True
+    abc2 = make()
+    abc2.load(db, h1.id)
+    abc2._restore_state(2)  # run() invokes this before the resumed loop
+    # trail restarted at every recorded change: only t_last's threshold
+    # remains comparable, exactly as in the uninterrupted run
+    eps_lastgen = float(
+        h1.get_all_populations().query("t == 2")["epsilon"].iloc[0])
+    assert abc2.acceptor._historic_min(3) == pytest.approx(eps_lastgen)
+    # and the resumed loop's first generation sees the pending change flag
+    assert abc2._resumed_distance_changed is True
