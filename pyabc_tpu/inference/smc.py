@@ -32,6 +32,7 @@ from ..core.random_variables import Distribution
 from ..core.sumstat_spec import SumStatSpec
 from ..distance import (
     AdaptivePNormDistance,
+    AggregatedDistance,
     Distance,
     PNormDistance,
     StochasticKernel,
@@ -359,9 +360,7 @@ class ABCSMC:
                 # all-accepted calibration semantics — and it SHARES the
                 # prior kernel's compilation instead of tracing a third
                 # program (compile time is the dominant cost of short runs)
-                if getattr(self.distance_function, "spec", None) is None \
-                        and hasattr(self.distance_function, "spec"):
-                    self.distance_function.spec = self.spec
+                self._ensure_distance_spec(self.distance_function)
                 mode, dyn = device.build_dyn_args(t=0, eps_value=np.inf)
             else:
                 mode, dyn = device.build_dyn_args(
@@ -868,6 +867,18 @@ class ABCSMC:
             # single default weight vector can
             if any(k >= 0 for k in d.weights):
                 return False
+        elif type(d) is AggregatedDistance:
+            # non-adaptive weighted sum of plain p-norm sub-distances: its
+            # params are chunk-constant (the sub checks imply device
+            # compatibility); AdaptiveAggregatedDistance (per-generation
+            # scale refits) keeps the host loop
+            if any(k >= 0 for k in d.weights):
+                return False
+            for sub in d.distances:
+                if (type(sub) is not PNormDistance
+                        or sub.sumstat is not None
+                        or any(k >= 0 for k in sub.weights)):
+                    return False
         else:
             return False
         return True
@@ -948,15 +959,29 @@ class ABCSMC:
             return False
         return True
 
-    def _distance_may_change(self) -> bool:
+    def _ensure_distance_spec(self, d) -> None:
+        """Attach the observed-data SumStatSpec to a distance (and any
+        sub-distances of an aggregate) that hasn't been initialized yet —
+        device_params needs the spec before the calibration generation."""
+        if hasattr(d, "spec") and getattr(d, "spec", None) is None:
+            d.spec = self.spec
+        for sub in getattr(d, "distances", ()) or ():
+            self._ensure_distance_spec(sub)
+
+    def _distance_may_change(self, d=None) -> bool:
         """True when the distance's space can change between generations
         (update() may return True: adaptive reweighting — AdaptivePNorm,
-        AdaptiveAggregated — or learned-sumstat refits). Such changes make
+        AdaptiveAggregated — or learned-sumstat refits, in the distance
+        itself or any sub-distance of an aggregate). Such changes make
         past epsilon thresholds incomparable (the complete-history trail
         restarts on them)."""
-        d = self.distance_function
-        return bool(getattr(d, "adaptive", False)) \
-            or getattr(d, "sumstat", None) is not None
+        if d is None:
+            d = self.distance_function
+        if bool(getattr(d, "adaptive", False)) \
+                or getattr(d, "sumstat", None) is not None:
+            return True
+        return any(self._distance_may_change(sub)
+                   for sub in getattr(d, "distances", ()) or ())
 
     def _transition_fit_statics(self, n: int) -> tuple:
         """Per-model static kwargs for the in-kernel ``device_fit`` refits.

@@ -378,3 +378,34 @@ def test_resume_trail_respects_recorded_distance_changes(tmp_path):
     assert abc2.acceptor._historic_min(3) == pytest.approx(eps_lastgen)
     # and the resumed loop's first generation sees the pending change flag
     assert abc2._resumed_distance_changed is True
+
+
+def test_fused_aggregated_distance_matches_pergen_loop():
+    """Non-adaptive AggregatedDistance (weighted sum of sub-distances)
+    rides fused chunks with chunk-constant params; posterior and epsilon
+    trajectory must match the per-generation loop."""
+    def make_distance():
+        return pt.AggregatedDistance(
+            [pt.PNormDistance(p=2), pt.PNormDistance(p=1)],
+            weights=[1.0, 0.5],
+        )
+
+    abc_f, h_f = _run(4, seed=47, pop=300, distance=make_distance())
+    assert h_f.get_telemetry(2).get("fused_chunk"), "fused path not taken"
+    abc_u, h_u = _run(1, seed=47, pop=300, distance=make_distance())
+    assert h_f.n_populations == h_u.n_populations
+    eps_f = h_f.get_all_populations().query("t >= 1")["epsilon"].to_numpy()
+    eps_u = h_u.get_all_populations().query("t >= 1")["epsilon"].to_numpy()
+    np.testing.assert_allclose(eps_f, eps_u, rtol=0.2)
+    for h in (h_f, h_u):
+        df, w = h.get_distribution(0, h.max_t)
+        mu = float(np.sum(df["theta"] * w))
+        assert mu == pytest.approx(POST_MU, abs=0.3)
+    # adaptive variant stays on the host loop
+    abc_a = pt.ABCSMC(
+        _gauss_model(), pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD)),
+        pt.AdaptiveAggregatedDistance([pt.PNormDistance(p=2),
+                                       pt.PNormDistance(p=1)]),
+        population_size=100, eps=pt.MedianEpsilon(),
+    )
+    assert not abc_a._fused_chunk_capable()
