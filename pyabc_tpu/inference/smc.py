@@ -50,6 +50,7 @@ from ..sampler.batched import BatchedSampler
 from ..sampler.singlecore import SingleCoreSampler
 from ..storage.history import History
 from ..transition import (
+    GridSearchCV,
     LocalTransition,
     ModelPerturbationKernel,
     MultivariateNormalTransition,
@@ -844,6 +845,24 @@ class ABCSMC:
             if tr.bandwidth_selector not in (scott_rule_of_thumb,
                                              silverman_rule_of_thumb):
                 return False
+        elif type(tr) is GridSearchCV:
+            # in-kernel cross-validated bandwidth selection over the MVN
+            # scaling grid (the reference's canonical GridSearchCV use)
+            if self.K != 1:
+                return False
+            if set(tr.param_grid) != {"scaling"} \
+                    or not tr.param_grid["scaling"] \
+                    or any(s <= 0 for s in tr.param_grid["scaling"]):
+                # a non-positive candidate would NaN the in-kernel scores
+                # (log 0, maha/0) and argmax would silently pick it; the
+                # host path survives such grids, so it keeps them
+                return False
+            est = tr.estimator
+            if type(est) is not MultivariateNormalTransition:
+                return False
+            if est.bandwidth_selector not in (scott_rule_of_thumb,
+                                              silverman_rule_of_thumb):
+                return False
         else:
             return False
         if not (isinstance(self.eps, QuantileEpsilon)
@@ -997,6 +1016,14 @@ class ABCSMC:
             if type(tr) is LocalTransition:
                 out.append((("scaling", tr.scaling),
                             ("k", tr._effective_k(n, dim))))
+            elif type(tr) is GridSearchCV:
+                out.append((
+                    ("scalings", tuple(
+                        float(s) for s in tr.param_grid["scaling"])),
+                    ("cv", int(tr.cv)),
+                    ("bandwidth_selector",
+                     tr.estimator.bandwidth_selector),
+                ))
             else:
                 out.append((("scaling", tr.scaling),
                             ("bandwidth_selector", tr.bandwidth_selector)))

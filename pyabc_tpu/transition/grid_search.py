@@ -10,10 +10,13 @@ from __future__ import annotations
 import copy
 from itertools import product
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 
 from .base import Transition
+from .multivariatenormal import _LOG_2PI, MultivariateNormalTransition
 
 
 class GridSearchCV(Transition):
@@ -88,19 +91,77 @@ class GridSearchCV(Transition):
         return self.best_estimator_.pdf(x)
 
     def is_device_compatible(self):
-        return (self.best_estimator_ is not None
-                and self.best_estimator_.is_device_compatible())
+        # the class-level device fns below delegate to the MVN statics
+        # (the reference's canonical GridSearchCV use); other estimators
+        # run the host path
+        return type(self.estimator) is MultivariateNormalTransition
 
     def device_params(self):
         return self.best_estimator_.device_params()
 
-    @property
-    def device_rvs(self):
-        return type(self.best_estimator_).device_rvs
+    device_rvs = staticmethod(MultivariateNormalTransition.device_rvs)
+    device_logpdf = staticmethod(MultivariateNormalTransition.device_logpdf)
 
-    @property
-    def device_logpdf(self):
-        return type(self.best_estimator_).device_logpdf
+    @staticmethod
+    def device_fit(thetas, weights, *, dim: int, scalings: tuple,
+                   cv: int, bandwidth_selector):
+        """Traceable twin of :meth:`fit` for the fused multi-generation
+        run: IN-KERNEL cross-validated bandwidth selection.
+
+        One scaling=1 MVN fit per fold is shared across all candidate
+        scalings (scaling enters the covariance multiplicatively, so a
+        candidate's held-out log-density is the fold fit's density with
+        ``maha / s^2`` and ``logdet + 2 dim log s``); the winner scales
+        the full-data fit the same way. Fold assignment replicates the
+        host rule (arange % cv shuffled by a fixed seed) over the padded
+        lane count — zero-weight padding slots contribute to neither
+        train nor test sums.
+        """
+        n_cap = thetas.shape[0]
+        n_folds = max(2, min(int(cv), n_cap))
+        folds_np = np.arange(n_cap) % n_folds
+        np.random.default_rng(0).shuffle(folds_np)
+        folds = jnp.asarray(folds_np)
+        s_arr = jnp.asarray(scalings, jnp.float32)
+        log_s = jnp.log(s_arr)
+        scores = jnp.zeros(len(scalings), jnp.float32)
+        for f in range(n_folds):
+            train_w = jnp.where(folds != f, weights, 0.0)
+            # fold membership is host-side static: gather the test rows so
+            # the per-fold scoring costs ~1/cv of the full maha matrix
+            test_idx = np.where(folds_np == f)[0]
+            fit_f = MultivariateNormalTransition.device_fit(
+                thetas, train_w, dim=dim, scaling=1.0,
+                bandwidth_selector=bandwidth_selector,
+            )
+            q = thetas[test_idx]
+            qw = weights[test_idx]
+            diff = q[:, None, :] - fit_f["thetas"][None, :, :]
+            maha = jnp.einsum("qnd,de,qne->qn", diff, fit_f["prec"], diff)
+            for i in range(len(scalings)):
+                s2 = jnp.exp(2.0 * log_s[i])
+                log_comp = -0.5 * (
+                    dim * _LOG_2PI + fit_f["logdet"]
+                    + 2.0 * dim * log_s[i] + maha / s2
+                )
+                logdens = jax.scipy.special.logsumexp(
+                    log_comp, b=fit_f["weights"][None, :], axis=1
+                )
+                logdens = jnp.maximum(logdens, np.log(1e-300))
+                scores = scores.at[i].add(jnp.sum(qw * logdens))
+        s_best = s_arr[jnp.argmax(scores)]
+        full = MultivariateNormalTransition.device_fit(
+            thetas, weights, dim=dim, scaling=1.0,
+            bandwidth_selector=bandwidth_selector,
+        )
+        return {
+            "thetas": full["thetas"],
+            "weights": full["weights"],
+            "chol": full["chol"] * s_best,
+            "prec": full["prec"] / (s_best * s_best),
+            "logdet": full["logdet"] + 2.0 * dim * jnp.log(s_best),
+            "dim": full["dim"],
+        }
 
     def __repr__(self):
         return f"GridSearchCV({self.estimator!r}, {self.param_grid})"

@@ -409,3 +409,69 @@ def test_fused_aggregated_distance_matches_pergen_loop():
         population_size=100, eps=pt.MedianEpsilon(),
     )
     assert not abc_a._fused_chunk_capable()
+
+
+def test_gridsearch_device_fit_matches_host_winner():
+    """In-kernel cross-validated bandwidth selection: on the same
+    (unpadded) particle set with the same fold rule, the device winner
+    must be the host GridSearchCV's best scaling, and the returned params
+    must equal an MVN fit at that scaling."""
+    import jax.numpy as jnp
+    import pandas as pd
+
+    from pyabc_tpu.transition.util import silverman_rule_of_thumb
+
+    rng = np.random.default_rng(5)
+    n, dim = 60, 2
+    X = pd.DataFrame({"a": rng.normal(0, 1, n),
+                      "b": rng.normal(1, 0.4, n)})
+    w = rng.uniform(0.5, 1.0, n)
+    w = w / w.sum()
+    scalings = (0.25, 1.0, 4.0)
+    host = pt.GridSearchCV(pt.MultivariateNormalTransition(),
+                           {"scaling": list(scalings)}, cv=3)
+    host.fit(X, w)
+    dev = pt.GridSearchCV.device_fit(
+        jnp.asarray(np.asarray(X), jnp.float32),
+        jnp.asarray(w, jnp.float32),
+        dim=dim, scalings=scalings, cv=3,
+        bandwidth_selector=silverman_rule_of_thumb,
+    )
+    s_host = host.best_params_["scaling"]
+    ref = pt.MultivariateNormalTransition(scaling=s_host)
+    ref.fit(X, w)
+    np.testing.assert_allclose(
+        np.asarray(dev["chol"]), ref._chol, rtol=5e-3, atol=5e-3,
+    )
+    np.testing.assert_allclose(
+        float(dev["logdet"]), ref._logdet, rtol=2e-3, atol=2e-3,
+    )
+
+
+def test_fused_gridsearch_transition_runs_and_recovers_posterior():
+    """GridSearchCV over the MVN scaling rides fused chunks: the CV fold
+    fits and candidate scoring happen inside the multigen kernel."""
+    abc, h = _run(
+        4, seed=53, pop=300,
+        distance=pt.PNormDistance(p=2),
+        transitions=pt.GridSearchCV(pt.MultivariateNormalTransition(),
+                                    {"scaling": [0.5, 1.0, 2.0]}, cv=3),
+    )
+    assert h.get_telemetry(2).get("fused_chunk"), "fused path not taken"
+    df, w = h.get_distribution(0, h.max_t)
+    mu = float(np.sum(df["theta"] * w))
+    assert mu == pytest.approx(POST_MU, abs=0.3)
+    eps = h.get_all_populations().query("t >= 0")["epsilon"].to_numpy()
+    assert (np.diff(eps) < 0).all()
+
+
+def test_gridsearch_nonpositive_scaling_falls_back():
+    """A grid containing a non-positive scaling would NaN the in-kernel
+    scores; such configs must keep the host path."""
+    abc = pt.ABCSMC(
+        _gauss_model(), pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD)),
+        pt.PNormDistance(p=2), population_size=100, eps=pt.MedianEpsilon(),
+        transitions=pt.GridSearchCV(pt.MultivariateNormalTransition(),
+                                    {"scaling": [0.0, 1.0, 2.0]}),
+    )
+    assert not abc._fused_chunk_capable()
