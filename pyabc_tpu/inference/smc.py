@@ -857,6 +857,11 @@ class ABCSMC:
                 # (log 0, maha/0) and argmax would silently pick it; the
                 # host path survives such grids, so it keeps them
                 return False
+            if tr.cv < 2 or tr.cv > self.population_strategy(0):
+                # degenerate fold counts behave differently on host
+                # (empty train sets -> first-entry fallback) than the
+                # device rule would; keep host semantics
+                return False
             est = tr.estimator
             if type(est) is not MultivariateNormalTransition:
                 return False

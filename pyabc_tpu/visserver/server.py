@@ -107,9 +107,9 @@ class AbcDashboard:
         )
         plots = "".join(
             f"<img src='/abc/{run_id}/plot/{p}.png' alt='{p}'>"
-            for p in ("epsilons", "sample_numbers", "acceptance_rates",
-                      "effective_sample_sizes", "walltime",
-                      "model_probabilities")
+            for p in ("epsilons", "eps_walltime", "sample_numbers",
+                      "acceptance_rates", "effective_sample_sizes",
+                      "walltime", "model_probabilities")
         )
         probs = h.get_model_probabilities(h.max_t)
         alive = [int(m) for m, p in probs["p"].items() if p > 0]
@@ -143,6 +143,7 @@ class AbcDashboard:
         h = self._history(run_id)
         fns = {
             "epsilons": d.plot_epsilons,
+            "eps_walltime": d.plot_eps_walltime,
             "sample_numbers": d.plot_sample_numbers,
             "acceptance_rates": d.plot_acceptance_rates_trajectory,
             "effective_sample_sizes": d.plot_effective_sample_sizes,

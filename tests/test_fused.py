@@ -475,3 +475,18 @@ def test_gridsearch_nonpositive_scaling_falls_back():
                                     {"scaling": [0.0, 1.0, 2.0]}),
     )
     assert not abc._fused_chunk_capable()
+
+
+def test_gridsearch_degenerate_cv_falls_back():
+    """cv<2 (or cv larger than the population) behaves differently on the
+    host (empty train folds -> first-entry fallback) than the device fold
+    rule would; such configs must keep the host path."""
+    prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+    for cv in (1, 10_000):
+        abc = pt.ABCSMC(
+            _gauss_model(), prior, pt.PNormDistance(p=2),
+            population_size=100, eps=pt.MedianEpsilon(),
+            transitions=pt.GridSearchCV(pt.MultivariateNormalTransition(),
+                                        {"scaling": [0.5, 2.0]}, cv=cv),
+        )
+        assert not abc._fused_chunk_capable(), cv

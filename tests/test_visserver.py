@@ -61,7 +61,7 @@ def test_run_page(served_history):
 
 
 @pytest.mark.parametrize("plot", [
-    "epsilons", "sample_numbers", "acceptance_rates",
+    "epsilons", "eps_walltime", "sample_numbers", "acceptance_rates",
     "effective_sample_sizes", "walltime", "model_probabilities",
 ])
 def test_diagnostic_plots(served_history, plot):
