@@ -1,0 +1,229 @@
+"""AdaptivePopulationSize & bootstrap-CV machinery.
+
+Reference parity: ``pyabc/populationstrategy.py::AdaptivePopulationSize``
+and ``pyabc/cv/bootstrap.py::calc_cv`` (SURVEY.md §2.1 Population-size row).
+Covers the closed-form weighting of ``calc_cv``, the statistical behavior
+of ``Transition.mean_cv`` under bootstrap resampling, the bisection of
+``required_nr_samples``/``AdaptivePopulationSize.update``, and end-to-end
+runs where the CV criterion visibly drives n across generations on the
+Gaussian toy — host and device paths.
+"""
+import jax
+import numpy as np
+import pandas as pd
+import pytest
+
+import pyabc_tpu as pt
+from pyabc_tpu.populationstrategy import AdaptivePopulationSize, calc_cv
+from pyabc_tpu.transition import MultivariateNormalTransition
+
+PRIOR_SD = 1.0
+NOISE_SD = 0.5
+X_OBS = 1.0
+POST_VAR = 1.0 / (1 / PRIOR_SD**2 + 1 / NOISE_SD**2)
+POST_MU = POST_VAR * (X_OBS / NOISE_SD**2)
+
+
+class _FixedCVTransition:
+    """Transition stub whose mean_cv is a known function of n — lets
+    calc_cv/bisection be checked against closed forms."""
+
+    NR_BOOTSTRAP = 5
+
+    def __init__(self, cv_fn):
+        self.cv_fn = cv_fn
+        self.seen_bootstrap = []
+
+    def mean_cv(self, n):
+        self.seen_bootstrap.append(self.NR_BOOTSTRAP)
+        return self.cv_fn(n)
+
+
+class TestCalcCV:
+    def test_weighted_average_closed_form(self):
+        """calc_cv = Σ_m w_m · mean_cv_m (model-weighted bootstrap CV)."""
+        t1 = _FixedCVTransition(lambda n: 0.2)
+        t2 = _FixedCVTransition(lambda n: 0.6)
+        cv = calc_cv(100, np.array([0.25, 0.75]), 7, [t1, t2])
+        assert cv == pytest.approx(0.25 * 0.2 + 0.75 * 0.6)
+
+    def test_model_weights_normalized(self):
+        t1 = _FixedCVTransition(lambda n: 0.4)
+        cv = calc_cv(100, np.array([2.0]), 3, [t1])
+        assert cv == pytest.approx(0.4)
+
+    def test_nr_bootstrap_applied_and_restored(self):
+        t1 = _FixedCVTransition(lambda n: 0.1)
+        t1.NR_BOOTSTRAP = 11
+        calc_cv(50, np.array([1.0]), 3, [t1])
+        assert t1.seen_bootstrap == [3]  # override active during the call
+        assert t1.NR_BOOTSTRAP == 11  # restored afterwards
+
+
+def _fitted_mvn(n=250, d=2, seed=0):
+    rng = np.random.default_rng(seed)
+    X = pd.DataFrame(rng.normal(size=(n, d)),
+                     columns=[f"p{i}" for i in range(d)])
+    w = np.full(n, 1.0 / n)
+    tr = MultivariateNormalTransition()
+    tr.fit(X, w)
+    return tr
+
+
+class TestMeanCV:
+    def test_cv_positive_and_decreasing_in_n(self):
+        """Bootstrap CV of the KDE density shrinks as the (re)sample grows
+        — the monotonicity AdaptivePopulationSize's bisection relies on."""
+        tr = _fitted_mvn()
+        tr.NR_BOOTSTRAP = 10
+        cv_small = tr.mean_cv(20)
+        cv_large = tr.mean_cv(2000)
+        assert cv_small > 0
+        assert cv_large > 0
+        assert cv_large < cv_small
+
+    def test_required_nr_samples_meets_target(self):
+        tr = _fitted_mvn()
+        target = 1.2 * tr.mean_cv(500)  # reachable target
+        n_req = tr.required_nr_samples(target)
+        assert tr.mean_cv(n_req) <= target
+
+    def test_required_nr_samples_unreachable_returns_hi(self):
+        tr = _fitted_mvn(n=50)
+        n_req = tr.required_nr_samples(1e-9)  # unreachably tight
+        assert n_req == max(10 * 50, 1000)
+
+
+class TestAdaptivePopulationSizeUpdate:
+    def test_bisection_finds_threshold_n(self):
+        """With mean_cv(n) = 1/sqrt(n), target cv c ⇒ n* = ceil(1/c²)."""
+        aps = AdaptivePopulationSize(
+            start_nr_particles=100, mean_cv=0.1,
+            min_population_size=10, max_population_size=10_000,
+        )
+        tr = _FixedCVTransition(lambda n: 1.0 / np.sqrt(n))
+        aps.update([tr], np.array([1.0]), t=0)
+        assert aps.nr_particles == 100  # 1/0.1² = 100 exactly
+
+    def test_unreachable_target_caps_at_max(self):
+        aps = AdaptivePopulationSize(
+            start_nr_particles=100, mean_cv=1e-6,
+            min_population_size=10, max_population_size=500,
+        )
+        tr = _FixedCVTransition(lambda n: 1.0 / np.sqrt(n))
+        aps.update([tr], np.array([1.0]), t=0)
+        assert aps.nr_particles == 500
+
+    def test_loose_target_floors_at_min(self):
+        aps = AdaptivePopulationSize(
+            start_nr_particles=100, mean_cv=10.0,
+            min_population_size=25, max_population_size=1000,
+        )
+        tr = _FixedCVTransition(lambda n: 1.0 / np.sqrt(n))
+        aps.update([tr], np.array([1.0]), t=0)
+        assert aps.nr_particles == 25
+
+    def test_degenerate_transition_keeps_previous_n(self):
+        aps = AdaptivePopulationSize(start_nr_particles=77, mean_cv=0.05)
+
+        class _Boom:
+            NR_BOOTSTRAP = 5
+
+            def mean_cv(self, n):
+                raise pt.NotEnoughParticles("degenerate")
+
+        aps.update([_Boom()], np.array([1.0]), t=0)
+        assert aps.nr_particles == 77
+
+    def test_real_mvn_adapts_with_target(self):
+        """On a real fitted MVN, a loose target shrinks n and a tight
+        target grows it — CV drives the decision in both directions."""
+        tr = _fitted_mvn(n=200, d=1, seed=3)
+        cv_at_200 = calc_cv(200, np.array([1.0]), 10, [tr])
+
+        loose = AdaptivePopulationSize(
+            start_nr_particles=200, mean_cv=3.0 * cv_at_200,
+            min_population_size=10, max_population_size=2000,
+        )
+        loose.update([tr], np.array([1.0]), t=0)
+        assert loose.nr_particles < 200
+
+        tight = AdaptivePopulationSize(
+            start_nr_particles=200, mean_cv=cv_at_200 / 3.0,
+            min_population_size=10, max_population_size=2000,
+        )
+        tight.update([tr], np.array([1.0]), t=0)
+        assert tight.nr_particles > 200
+
+
+def _gauss_jax_model():
+    @pt.JaxModel.from_function(["theta"], name="gauss")
+    def model(key, theta):
+        return {"x": theta[0] + NOISE_SD * jax.random.normal(key)}
+
+    return model
+
+
+def _posterior_moments(history, m=0, par="theta"):
+    df, w = history.get_distribution(m)
+    mu = float(np.sum(df[par] * w))
+    sd = float(np.sqrt(np.sum(w * (df[par] - mu) ** 2)))
+    return mu, sd
+
+
+def _per_generation_n(history):
+    counts = history.get_nr_particles_per_population()
+    return counts[counts.index >= 0].to_numpy()
+
+
+class TestAdaptiveNEndToEnd:
+    def test_host_path_cv_drives_n(self):
+        """Gaussian toy on the scalar host path: the CV criterion must
+        visibly move n away from the start size across generations."""
+        rng = np.random.default_rng(0)
+
+        def model(pars):
+            return {"x": pars["theta"] + NOISE_SD * rng.normal()}
+
+        import scipy.stats as st
+
+        prior = pt.Distribution(theta=pt.ScipyRV(st.norm(0, PRIOR_SD)))
+        np.random.seed(0)
+        aps = AdaptivePopulationSize(
+            start_nr_particles=150, mean_cv=0.5,
+            min_population_size=20, max_population_size=600, n_bootstrap=5,
+        )
+        abc = pt.ABCSMC(model, prior, pt.PNormDistance(p=2),
+                        population_size=aps,
+                        eps=pt.QuantileEpsilon(initial_epsilon=1.0,
+                                               alpha=0.5),
+                        sampler=pt.SingleCoreSampler())
+        abc.new("sqlite://", {"x": X_OBS})
+        h = abc.run(max_nr_populations=4)
+        ns = _per_generation_n(h)
+        assert len(ns) >= 2
+        assert ns[0] == 150  # first generation uses the start size
+        assert any(n != 150 for n in ns[1:])  # CV moved n
+        assert all(20 <= n <= 600 for n in ns)
+        mu, _sd = _posterior_moments(h)
+        assert mu == pytest.approx(POST_MU, abs=0.35)
+
+    def test_device_unfused_path_cv_drives_n(self):
+        """Same criterion on the batched device path (per-generation loop:
+        AdaptivePopulationSize's host bisection runs between kernels)."""
+        prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+        aps = AdaptivePopulationSize(
+            start_nr_particles=150, mean_cv=0.5,
+            min_population_size=20, max_population_size=600, n_bootstrap=5,
+        )
+        abc = pt.ABCSMC(_gauss_jax_model(), prior, pt.PNormDistance(p=2),
+                        population_size=aps, eps=pt.MedianEpsilon(), seed=11)
+        assert abc._device_capable
+        abc.new("sqlite://", {"x": X_OBS})
+        h = abc.run(max_nr_populations=4)
+        ns = _per_generation_n(h)
+        assert len(ns) >= 2
+        assert any(n != 150 for n in ns[1:])
+        assert all(20 <= n <= 600 for n in ns)
+        mu, _sd = _posterior_moments(h)
+        assert mu == pytest.approx(POST_MU, abs=0.35)
