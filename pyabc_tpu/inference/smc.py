@@ -807,9 +807,8 @@ class ABCSMC:
         ):
             return False
         if not isinstance(self.population_strategy,
-                          (ConstantPopulationSize, ListPopulationSize)):
-            # AdaptivePopulationSize needs the host's bootstrap-CV loop
-            # between every pair of generations
+                          (ConstantPopulationSize, ListPopulationSize)) \
+                and not self._fused_adaptive_n_capable():
             return False
         if type(self.acceptor) is StochasticAcceptor:
             return self._fused_stochastic_capable()
@@ -906,6 +905,22 @@ class ABCSMC:
         else:
             return False
         return True
+
+    def _fused_adaptive_n_capable(self) -> bool:
+        """AdaptivePopulationSize configs whose bootstrap-CV bisection can
+        run IN-KERNEL (MultivariateNormalTransition.device_required_nr):
+        single model, plain MVN transition (the bandwidth gate runs in the
+        caller), and a finite max_population_size — static shapes are sized
+        to it, so an unbounded adaptive growth target cannot ride a chunk.
+        """
+        from ..populationstrategy import AdaptivePopulationSize
+
+        return (
+            isinstance(self.population_strategy, AdaptivePopulationSize)
+            and self.K == 1
+            and type(self.transitions[0]) is MultivariateNormalTransition
+            and np.isfinite(self.population_strategy.max_population_size)
+        )
 
     #: temperature schemes with device twins (DeviceContext.
     #: _stochastic_gen_update); Daly's contraction state rides the chunk
@@ -1165,10 +1180,17 @@ class ABCSMC:
         sumstat_mode = getattr(self.distance_function, "sumstat", None) \
             is not None
         # static shapes are sized for the LARGEST generation of a varying
-        # (ListPopulationSize) schedule; smaller generations mask down
-        n_max = (max(self.population_strategy.values)
-                 if isinstance(self.population_strategy, ListPopulationSize)
-                 else n)
+        # (ListPopulationSize) schedule; smaller generations mask down.
+        # In-kernel adaptive n sizes them to the strategy's hard cap.
+        adaptive_n = self._fused_adaptive_n_capable()
+        if isinstance(self.population_strategy, ListPopulationSize):
+            n_max = max(self.population_strategy.values)
+        elif adaptive_n:
+            n_max = max(
+                n, int(self.population_strategy.max_population_size)
+            )
+        else:
+            n_max = n
         n_cap = _pow2(n_max, 64)
         rec_cap = _pow2(8 * n_cap, 256) if (adaptive or stochastic) else 1
         B = self.sampler._pick_B(n_max)
@@ -1198,6 +1220,14 @@ class ABCSMC:
             temp_fixed=temp_fixed,
             complete_history=complete_history,
             sumstat_transform=sumstat_mode,
+            adaptive_n=(
+                (float(self.population_strategy.mean_cv),
+                 int(self.population_strategy.min_population_size),
+                 int(min(self.population_strategy.max_population_size,
+                         n_cap)),
+                 int(self.population_strategy.n_bootstrap))
+                if adaptive_n else None
+            ),
         )
 
         def _g_limit(t_at: int) -> int:
@@ -1300,11 +1330,17 @@ class ABCSMC:
                                 if complete_history else 0.0, jnp.float32),
                     jnp.asarray(-1e30, jnp.float32),
                     jnp.zeros((), jnp.float32))
-            return (tuple(trans0), jnp.asarray(log_probs0, jnp.float32),
+            base = (tuple(trans0), jnp.asarray(log_probs0, jnp.float32),
                     jnp.asarray(fitted0), dist_w0,
                     jnp.asarray(self.eps(t_at), jnp.float32),
                     acc_state0,
                     jnp.asarray(False))
+            if adaptive_n:
+                # seed the in-kernel n recursion from the host strategy's
+                # current decision (gen 0 / resume adapt on the host)
+                base = base + (jnp.asarray(
+                    min(self.population_strategy(t_at), n_cap), jnp.int32),)
+            return base
 
         carry0 = _build_chunk_carry(t)
 
@@ -1326,6 +1362,7 @@ class ABCSMC:
                 temp_fixed=temp_fixed,
                 sumstat_refit=sumstat_mode,
                 rebuild_carry=_build_chunk_carry,
+                adaptive_n=adaptive_n,
             )
         except BaseException:
             # drain queued generations before propagating — a mid-loop
@@ -1349,7 +1386,8 @@ class ABCSMC:
                           start_walltime, sims_total, eps_quantile,
                           adaptive, stochastic=False, temp_fixed=False,
                           sumstat_refit=False,
-                          rebuild_carry=None) -> History:
+                          rebuild_carry=None,
+                          adaptive_n=False) -> History:
         import jax
 
         from ..sampler.base import Sample, exp_normalize_log_weights
@@ -1406,7 +1444,10 @@ class ABCSMC:
             # loop would record the same value g_limit times
             mem_telemetry = self._device_memory_telemetry()
             for g in range(g_limit):
-                n = n_of(t)  # per-generation target (t advances below)
+                # per-generation target (t advances below); in-kernel
+                # adaptive n is read back from the chunk outputs
+                n = (int(fetched["n_target"][g]) if adaptive_n
+                     else n_of(t))
                 if not bool(fetched["gen_ok"][g]):
                     logger.info(
                         "stopping: fused generation %d incomplete "
@@ -1495,6 +1536,12 @@ class ABCSMC:
                     w_next = dwn["w"][g] if isinstance(dwn, dict) else dwn[g]
                     self.distance_function.weights[t + 1] = np.asarray(
                         w_next, np.float64
+                    )
+                if adaptive_n:
+                    # mirror the in-kernel bootstrap-CV decision into the
+                    # host strategy (resume / post-loop host generations)
+                    self.population_strategy.nr_particles = int(
+                        fetched["n_next"][g]
                     )
                 if hasattr(self.acceptor, "note_epsilon"):
                     self.acceptor.note_epsilon(t, current_eps, adaptive)

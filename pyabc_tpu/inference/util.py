@@ -657,7 +657,8 @@ class DeviceContext:
                         temp_config: tuple | None = None,
                         temp_fixed: bool = False,
                         complete_history: bool = False,
-                        sumstat_transform: bool = False):
+                        sumstat_transform: bool = False,
+                        adaptive_n: tuple | None = None):
         """One jitted program for G WHOLE GENERATIONS (transition mode).
 
         The TPU-native endgame of the reference's per-generation scatter/
@@ -700,7 +701,7 @@ class DeviceContext:
                      eps_quantile, eps_weighted, alpha, multiplier,
                      trans_cls.__name__, fit_statics, dims,
                      stochastic, temp_config, temp_fixed, complete_history,
-                     sumstat_transform)
+                     sumstat_transform, adaptive_n)
         if cache_key in self._kernels:
             return self._kernels[cache_key]
         if stochastic and self.K != 1:
@@ -754,8 +755,13 @@ class DeviceContext:
                 return jax.vmap(lambda k: lane(k, dyn))(keys)
 
             def gen_step(carry, g):
-                (trans_params, log_model_probs, fitted, dist_w, eps_carry,
-                 acc_state, stopped) = carry
+                if adaptive_n is not None:
+                    (trans_params, log_model_probs, fitted, dist_w,
+                     eps_carry, acc_state, stopped, n_carry) = carry
+                else:
+                    (trans_params, log_model_probs, fitted, dist_w,
+                     eps_carry, acc_state, stopped) = carry
+                    n_carry = None
                 pdf_norm, max_found, daly_k = acc_state
                 # g_limit (dynamic) caps the active generations so the LAST
                 # chunk of a run reuses the same compiled G-kernel instead
@@ -763,8 +769,10 @@ class DeviceContext:
                 stopped = stopped | (g >= g_limit)
                 t = t0 + g
                 # per-generation population target (constant schedules pass
-                # a constant-filled array; ListPopulationSize varies it)
-                n_target = n_sched[g]
+                # a constant-filled array; ListPopulationSize varies it;
+                # AdaptivePopulationSize carries the in-kernel bootstrap-CV
+                # decision of the PREVIOUS generation)
+                n_target = n_sched[g] if n_carry is None else n_carry
                 gen_key = jax.random.fold_in(root, t + 1)  # generation_key
                 if (stochastic and not temp_fixed) or eps_quantile:
                     eps_g = eps_carry
@@ -934,6 +942,32 @@ class DeviceContext:
                     "model_probs": model_probs_next,
                     **temp_extra,
                 }
+                if adaptive_n is not None:
+                    # in-kernel AdaptivePopulationSize (K=1, MVN): the
+                    # bootstrap-CV bisection runs on the JUST-REFIT kernel —
+                    # exactly where the host's population_strategy.update
+                    # sits in the per-generation loop
+                    target_cv, min_n, max_n, n_boot = adaptive_n
+                    fit_kw = dict(fit_statics[0])
+                    # bootstrap key OUTSIDE the proposal-round key space
+                    # [0, max_rounds): fold_in(gen_key, r) seeds round r's
+                    # lanes, so a tag below max_rounds would reuse a
+                    # proposal stream for the CV resampling
+                    n_next = jax.lax.cond(
+                        stopped_next,
+                        lambda: n_target,
+                        lambda: trans_cls.device_required_nr(
+                            trans_next[0],
+                            jax.random.fold_in(gen_key, max_rounds),
+                            target_cv=target_cv, min_n=min_n, max_n=max_n,
+                            dim=dims[0], n_bootstrap=n_boot, **fit_kw,
+                        ),
+                    )
+                    out["n_target"] = n_target
+                    out["n_next"] = n_next
+                    return (trans_next, log_model_probs_next, fitted_next,
+                            dist_w_next, eps_next, acc_state_next,
+                            stopped_next, n_next), out
                 return (trans_next, log_model_probs_next, fitted_next,
                         dist_w_next, eps_next, acc_state_next,
                         stopped_next), out

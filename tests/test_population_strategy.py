@@ -176,6 +176,135 @@ def _per_generation_n(history):
     return counts[counts.index >= 0].to_numpy()
 
 
+class TestDeviceBootstrapCV:
+    def test_device_mean_cv_tracks_host(self):
+        """device_mean_cv (traceable bootstrap CV) agrees with the host
+        Transition.mean_cv statistically on identical fitted particles."""
+        import jax.numpy as jnp
+
+        tr = _fitted_mvn(n=128, d=2, seed=7)
+        params = {k: jnp.asarray(v) for k, v in tr.device_params().items()}
+        dev = float(MultivariateNormalTransition.device_mean_cv(
+            params, jax.random.PRNGKey(0), jnp.asarray(64),
+            dim=2, scaling=tr.scaling,
+            bandwidth_selector=tr.bandwidth_selector, n_bootstrap=30,
+        ))
+        tr.NR_BOOTSTRAP = 30
+        host = tr.mean_cv(64)
+        assert dev > 0
+        assert dev == pytest.approx(host, rel=0.5)
+
+    def test_device_cv_decreases_with_n(self):
+        import jax.numpy as jnp
+
+        tr = _fitted_mvn(n=128, d=2, seed=7)
+        params = {k: jnp.asarray(v) for k, v in tr.device_params().items()}
+
+        def cv(n):
+            return float(MultivariateNormalTransition.device_mean_cv(
+                params, jax.random.PRNGKey(0), jnp.asarray(n),
+                dim=2, scaling=tr.scaling,
+                bandwidth_selector=tr.bandwidth_selector, n_bootstrap=20,
+            ))
+
+        # n stays within the 128-lane capacity: beyond n_cap the bootstrap
+        # degenerates to n_cap draws (production clamps max_n to n_cap)
+        assert cv(128) < cv(8)
+
+    def test_device_required_nr_bisection(self):
+        """The in-kernel bisection lands where its own CV criterion flips,
+        inside [min_n, max_n], and returns max_n for unreachable targets."""
+        import jax.numpy as jnp
+
+        tr = _fitted_mvn(n=128, d=2, seed=7)
+        params = {k: jnp.asarray(v) for k, v in tr.device_params().items()}
+        kw = dict(dim=2, scaling=tr.scaling,
+                  bandwidth_selector=tr.bandwidth_selector, n_bootstrap=20)
+        key = jax.random.PRNGKey(3)
+        cv_at_96 = float(MultivariateNormalTransition.device_mean_cv(
+            params, key, jnp.asarray(96), **kw))
+        n_req = int(MultivariateNormalTransition.device_required_nr(
+            params, key, target_cv=cv_at_96, min_n=10, max_n=128, **kw))
+        assert 10 <= n_req <= 128
+        cv_found = float(MultivariateNormalTransition.device_mean_cv(
+            params, key, jnp.asarray(n_req), **kw))
+        assert cv_found <= cv_at_96
+        # unreachable target caps at max_n
+        n_hi = int(MultivariateNormalTransition.device_required_nr(
+            params, key, target_cv=1e-9, min_n=10, max_n=128, **kw))
+        assert n_hi == 128
+
+
+class TestAdaptiveNFused:
+    def _aps(self):
+        return AdaptivePopulationSize(
+            start_nr_particles=150, mean_cv=0.5,
+            min_population_size=20, max_population_size=600, n_bootstrap=5,
+        )
+
+    def test_capability_gate(self):
+        prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+        abc = pt.ABCSMC(_gauss_jax_model(), prior, pt.PNormDistance(p=2),
+                        population_size=self._aps(),
+                        eps=pt.MedianEpsilon(), seed=11)
+        assert abc._fused_chunk_capable()
+        # unbounded adaptive growth cannot ride static shapes
+        unbounded = AdaptivePopulationSize(start_nr_particles=150,
+                                           mean_cv=0.5)
+        abc_u = pt.ABCSMC(_gauss_jax_model(), prior, pt.PNormDistance(p=2),
+                          population_size=unbounded,
+                          eps=pt.MedianEpsilon(), seed=11)
+        assert not abc_u._fused_chunk_capable()
+        # LocalTransition's static k needs a constant n
+        abc_l = pt.ABCSMC(_gauss_jax_model(), prior, pt.PNormDistance(p=2),
+                          population_size=self._aps(),
+                          eps=pt.MedianEpsilon(), seed=11,
+                          transitions=pt.LocalTransition())
+        assert not abc_l._fused_chunk_capable()
+
+    def test_fused_cv_drives_n(self):
+        """The fused chunk runs the bootstrap-CV bisection in-kernel; n
+        must move off the start size and stay inside the bounds, with the
+        host strategy mirroring the device decision."""
+        prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+        aps = self._aps()
+        abc = pt.ABCSMC(_gauss_jax_model(), prior, pt.PNormDistance(p=2),
+                        population_size=aps, eps=pt.MedianEpsilon(),
+                        seed=11, fused_generations=3)
+        assert abc._fused_chunk_capable()
+        abc.new("sqlite://", {"x": X_OBS})
+        h = abc.run(max_nr_populations=5)
+        ns = _per_generation_n(h)
+        assert len(ns) >= 3
+        assert ns[0] == 150
+        assert any(n != 150 for n in ns[1:])
+        assert all(20 <= n <= 600 for n in ns)
+        # host mirror of the device decision
+        assert 20 <= aps.nr_particles <= 600
+        mu, _sd = _posterior_moments(h)
+        assert mu == pytest.approx(POST_MU, abs=0.35)
+
+    def test_fused_matches_unfused_direction(self):
+        """Fused (in-kernel CV) and unfused (host CV) runs of the same
+        config agree on the adaptation direction and the posterior."""
+        prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+        runs = {}
+        for label, fused_g in (("fused", 3), ("unfused", 1)):
+            abc = pt.ABCSMC(_gauss_jax_model(), prior,
+                            pt.PNormDistance(p=2),
+                            population_size=self._aps(),
+                            eps=pt.MedianEpsilon(), seed=17,
+                            fused_generations=fused_g)
+            abc.new("sqlite://", {"x": X_OBS})
+            h = abc.run(max_nr_populations=4)
+            runs[label] = (_per_generation_n(h), _posterior_moments(h))
+        ns_f, (mu_f, _) = runs["fused"]
+        ns_u, (mu_u, _) = runs["unfused"]
+        # same direction of adaptation off the start size
+        assert np.sign(ns_f[1] - 150) == np.sign(ns_u[1] - 150)
+        assert mu_f == pytest.approx(mu_u, abs=0.3)
+
+
 class TestAdaptiveNEndToEnd:
     def test_host_path_cv_drives_n(self):
         """Gaussian toy on the scalar host path: the CV criterion must
