@@ -20,11 +20,16 @@ _SMALL_N = 16
 
 
 def fast_random_choice(weights) -> int:
-    """Draw an index ~ ``weights`` (assumed normalized, as the reference
-    does; callers hold normalized model/particle probabilities)."""
+    """Draw an index ~ ``weights``. Both branches normalize by the running
+    total (like ``np.random.choice`` after its validation), so unnormalized
+    input skews nothing — a caller bug cannot silently dump missing
+    probability mass on the last index."""
     n = len(weights)
     if n <= _SMALL_N:
-        u = np.random.uniform()
+        total = 0.0
+        for i in range(n):
+            total += weights[i]
+        u = np.random.uniform(high=total)
         acc = 0.0
         for i in range(n - 1):
             acc += weights[i]

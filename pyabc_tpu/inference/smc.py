@@ -849,6 +849,12 @@ class ABCSMC:
             # scaling grid (the reference's canonical GridSearchCV use)
             if self.K != 1:
                 return False
+            if not isinstance(self.population_strategy,
+                              ConstantPopulationSize):
+                # the in-kernel fold assignment is host-static over the
+                # population size; a varying schedule could shrink below
+                # cv mid-chunk and diverge from host fold semantics
+                return False
             if set(tr.param_grid) != {"scaling"} \
                     or not tr.param_grid["scaling"] \
                     or any(s <= 0 for s in tr.param_grid["scaling"]):
@@ -1043,6 +1049,9 @@ class ABCSMC:
                     ("cv", int(tr.cv)),
                     ("bandwidth_selector",
                      tr.estimator.bandwidth_selector),
+                    # folds are assigned over the actual population size,
+                    # matching the host fit on n accepted rows
+                    ("n", int(n)),
                 ))
             else:
                 out.append((("scaling", tr.scaling),
@@ -1490,9 +1499,11 @@ class ABCSMC:
                         "sample_s": round(chunk_s / g_limit, 4),
                         "n_evaluations": nr_evals,
                         "acceptance_rate": round(acceptance_rate, 6),
-                        "distance_changed": bool(
-                            adaptive
-                            or (sumstat_refit and g == g_limit - 1)),
+                        # sumstat-mode boundary refits are flagged AFTER
+                        # they actually execute (the loop may stop at the
+                        # chunk edge, where no refit happens and a resume
+                        # must not restart the epsilon trail)
+                        "distance_changed": bool(adaptive),
                         **(mem_telemetry if g == 0 else {}),
                     },
                 )
@@ -1587,6 +1598,14 @@ class ABCSMC:
                 # ring stays on device; in-chunk refits use the full ring.
                 self._adapt_components(t - 1, last_sample, last_pop,
                                        last_eps, last_acc_rate)
+                # the boundary refit DID run: flag it for resume's epsilon-
+                # trail replay (flush first — the row may still be queued
+                # on the writer thread, and update_telemetry skips missing
+                # rows)
+                self.history.flush()
+                self.history.update_telemetry(
+                    t - 1, {"distance_changed": True}
+                )
                 res, g_limit = (
                     _dispatch_chunk(rebuild_carry(t), t, g_next), g_next
                 )

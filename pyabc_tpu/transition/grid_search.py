@@ -104,7 +104,7 @@ class GridSearchCV(Transition):
 
     @staticmethod
     def device_fit(thetas, weights, *, dim: int, scalings: tuple,
-                   cv: int, bandwidth_selector):
+                   cv: int, bandwidth_selector, n: int | None = None):
         """Traceable twin of :meth:`fit` for the fused multi-generation
         run: IN-KERNEL cross-validated bandwidth selection.
 
@@ -113,14 +113,19 @@ class GridSearchCV(Transition):
         candidate's held-out log-density is the fold fit's density with
         ``maha / s^2`` and ``logdet + 2 dim log s``); the winner scales
         the full-data fit the same way. Fold assignment replicates the
-        host rule (arange % cv shuffled by a fixed seed) over the padded
-        lane count — zero-weight padding slots contribute to neither
-        train nor test sums.
+        host rule exactly — ``arange(n) % n_folds`` shuffled by the same
+        fixed seed over the ACTUAL population size ``n`` (host-static
+        under the ConstantPopulationSize fused gate) — and padding lanes
+        beyond n belong to no fold: they stay in every train set with
+        zero weight and are never test rows.
         """
         n_cap = thetas.shape[0]
-        n_folds = max(2, min(int(cv), n_cap))
-        folds_np = np.arange(n_cap) % n_folds
-        np.random.default_rng(0).shuffle(folds_np)
+        n_rows = n_cap if n is None else min(int(n), n_cap)
+        n_folds = min(int(cv), n_rows)
+        folds_np = np.full(n_cap, -1)
+        head = np.arange(n_rows) % n_folds
+        np.random.default_rng(0).shuffle(head)
+        folds_np[:n_rows] = head
         folds = jnp.asarray(folds_np)
         s_arr = jnp.asarray(scalings, jnp.float32)
         log_s = jnp.log(s_arr)
