@@ -18,6 +18,7 @@ from __future__ import annotations
 import multiprocessing as mp
 import os
 import queue as queue_mod
+import weakref
 
 import numpy as np
 
@@ -47,10 +48,11 @@ def get_if_worker_healthy(workers, q, timeout: float = 1800.0):
                 )
 
 
-def _eval_parallel_worker(simulate_one, n_request, n_eval, n_acc, out_q,
-                          seed, record_rejected, rej_q):
-    simulate_one = _load_payload(simulate_one)
-    np.random.seed(seed)
+def _eval_loop(simulate_one, n_request, n_eval, n_acc, out_q,
+               record_rejected, rej_q):
+    """Evaluation-parallel inner loop (shared by the one-shot fork worker
+    and the persistent pool worker): claim a global eval slot, simulate,
+    count acceptances on the shared counter until n_request is reached."""
     while True:
         with n_acc.get_lock():
             if n_acc.value >= n_request:
@@ -68,10 +70,9 @@ def _eval_parallel_worker(simulate_one, n_request, n_eval, n_acc, out_q,
     out_q.put(DONE)
 
 
-def _particle_parallel_worker(simulate_one, quota, out_q, seed,
-                              record_rejected, rej_q):
-    simulate_one = _load_payload(simulate_one)
-    np.random.seed(seed)
+def _quota_loop(simulate_one, quota, out_q, record_rejected, rej_q) -> int:
+    """Particle-parallel inner loop (shared like _eval_loop): fill a fixed
+    acceptance quota; returns the local evaluation count."""
     produced = 0
     n_eval = 0
     while produced < quota:
@@ -83,6 +84,22 @@ def _particle_parallel_worker(simulate_one, quota, out_q, seed,
             produced += 1
             out_q.put((None, particle))
     out_q.put((DONE, n_eval))
+    return n_eval
+
+
+def _eval_parallel_worker(simulate_one, n_request, n_eval, n_acc, out_q,
+                          seed, record_rejected, rej_q):
+    simulate_one = _load_payload(simulate_one)
+    np.random.seed(seed)
+    _eval_loop(simulate_one, n_request, n_eval, n_acc, out_q,
+               record_rejected, rej_q)
+
+
+def _particle_parallel_worker(simulate_one, quota, out_q, seed,
+                              record_rejected, rej_q):
+    simulate_one = _load_payload(simulate_one)
+    np.random.seed(seed)
+    _quota_loop(simulate_one, quota, out_q, record_rejected, rej_q)
 
 
 def _load_payload(simulate_one):
@@ -94,19 +111,65 @@ def _load_payload(simulate_one):
     return simulate_one
 
 
+def _pool_worker(task_q, out_q, rej_q, n_eval, n_acc):
+    """Persistent pool worker: serves one generation task at a time until
+    the None sentinel. Spawn/forkserver pay the interpreter+import cost
+    ONCE per sampler instead of once per generation (the per-generation
+    respawn is ~10x a small generation's work)."""
+    while True:
+        task = task_q.get()
+        if task is None:
+            break
+        kind, payload, arg, seed, record_rejected = task
+        simulate_one = _load_payload(payload)
+        np.random.seed(seed)
+        if kind == "eval":
+            _eval_loop(simulate_one, arg, n_eval, n_acc, out_q,
+                       record_rejected, rej_q)
+        else:  # quota
+            _quota_loop(simulate_one, arg, out_q, record_rejected, rej_q)
+        if record_rejected:
+            # cross-queue delivery order is not guaranteed (separate
+            # feeder threads), and pool workers never exit — a DONE per
+            # TASK on the record queue is the drain signal
+            # (_drain_rejected_pool counts tasks, not workers: a fast
+            # worker may serve several tasks of one generation)
+            rej_q.put(DONE)
+
+
+def _shutdown_pool(workers, task_q):
+    """Stop sentinels + join; terminate stragglers. Module-level so a
+    weakref.finalize can run it at interpreter exit BEFORE multiprocessing
+    joins non-daemon children (a daemon=False pool would otherwise hang
+    shutdown: workers block forever on task_q.get())."""
+    for _ in workers:
+        try:
+            task_q.put(None)
+        except (ValueError, OSError):  # queue already closed
+            break
+    for w in workers:
+        w.join(timeout=5.0)
+        if w.is_alive():
+            w.terminate()
+
+
 class _MulticoreBase(Sampler):
-    """start_method: 'fork' (default, reference behavior — cheap worker
-    startup, guarded by a pre-fork jax-reference scan of the closure) or
-    'spawn'/'forkserver' (robust against forked-backend deadlocks by
-    construction; the closure travels via cloudpickle, workers re-import)."""
+    """start_method: 'spawn' (default) / 'forkserver' run a PERSISTENT
+    worker pool — robust against forked-backend deadlocks by construction
+    (the closure travels via cloudpickle into fresh interpreters), with
+    the startup cost amortized over the whole run. 'fork' (opt-in,
+    reference behavior) forks per generation — cheap startup and no
+    pickling requirement on the closure, guarded by a pre-fork
+    jax-reference scan."""
 
     def __init__(self, n_procs: int | None = None, daemon: bool = True,
-                 start_method: str = "fork", check_fork_safety: bool = True):
+                 start_method: str = "spawn", check_fork_safety: bool = True):
         super().__init__()
         self.n_procs = n_procs if n_procs is not None else nr_cores_available()
         self.daemon = daemon
         self.start_method = start_method
         self.check_fork_safety = check_fork_safety
+        self._pool = None
 
     def _resolve(self, simulate_one):
         if hasattr(simulate_one, "host_simulate_one"):
@@ -123,10 +186,120 @@ class _MulticoreBase(Sampler):
             simulate_one = cloudpickle.dumps(simulate_one)
         return simulate_one
 
+    # --------------------------------------------------- persistent pool
+    def _ensure_pool(self):
+        """Start (or reuse) the persistent worker pool; counters are reset
+        by the caller between generations while workers idle on the task
+        queue."""
+        if self._pool is not None:
+            if all(w.is_alive() for w in self._pool[1]):
+                return self._pool
+            self.stop()
+        ctx = mp.get_context(self.start_method)
+        task_q, out_q, rej_q = ctx.Queue(), ctx.Queue(), ctx.Queue()
+        n_eval, n_acc = ctx.Value("i", 0), ctx.Value("i", 0)
+        workers = [
+            ctx.Process(target=_pool_worker,
+                        args=(task_q, out_q, rej_q, n_eval, n_acc),
+                        daemon=self.daemon)
+            for _ in range(self.n_procs)
+        ]
+        for w in workers:
+            w.start()
+        self._pool = (ctx, workers, task_q, out_q, rej_q, n_eval, n_acc)
+        # runs on GC of the sampler AND at interpreter shutdown (before
+        # multiprocessing's atexit join of non-daemon children)
+        self._pool_finalizer = weakref.finalize(
+            self, _shutdown_pool, workers, task_q
+        )
+        return self._pool
+
+    def stop(self) -> None:
+        """Shut the pool down (None sentinel per worker, then join)."""
+        if self._pool is None:
+            return
+        fin = getattr(self, "_pool_finalizer", None)
+        if fin is not None:
+            fin.detach()
+            self._pool_finalizer = None
+        _shutdown_pool(self._pool[1], self._pool[2])
+        self._pool = None
+
+    def __getstate__(self):
+        # the pool (processes/queues/finalizer) never travels; a pickled
+        # sampler re-creates it lazily on first use
+        state = self.__dict__.copy()
+        state["_pool"] = None
+        state["_pool_finalizer"] = None
+        return state
+
+    def _pool_get(self, workers, q):
+        """Get from q; a dead pool worker mid-generation is unrecoverable
+        (its DONE will never arrive), so tear down and re-raise — the
+        pool-mode analog of the reference get_if_worker_healthy."""
+        while True:
+            try:
+                return q.get(timeout=5.0)
+            except queue_mod.Empty:
+                if not all(w.is_alive() for w in workers):
+                    self.stop()
+                    raise RuntimeError(
+                        "a sampler pool worker died mid-generation"
+                    )
+
+    def _run_pool(self, kind, payload, args, seeds, sample):
+        """One generation on the persistent pool: reset shared counters,
+        enqueue one task per worker slot, collect until every task's DONE.
+        Tasks are pulled greedily, so DONE sentinels are counted per TASK
+        (a fast worker may serve two tasks back-to-back)."""
+        _, workers, task_q, out_q, rej_q, n_eval, n_acc = self._ensure_pool()
+        n_eval.value = 0
+        n_acc.value = 0
+        n_tasks = 0
+        for i, arg in enumerate(args):
+            if arg <= 0:
+                continue
+            task_q.put((kind, payload, arg, int(seeds[i]),
+                        sample.record_rejected))
+            n_tasks += 1
+        collected: list[tuple] = []
+        done = 0
+        n_evals = 0
+        while done < n_tasks:
+            item = self._pool_get(workers, out_q)
+            if isinstance(item, str) and item == DONE:
+                done += 1
+            elif isinstance(item, tuple) and item[0] == DONE:
+                n_evals += item[1]
+                done += 1
+            else:
+                collected.append(item)
+        if kind == "eval":
+            n_evals = n_eval.value
+        self._drain_rejected_pool(sample, workers, rej_q, n_tasks)
+        return collected, n_evals
+
+    def _drain_rejected_pool(self, sample: Sample, workers, rej_q,
+                             n_tasks) -> None:
+        """Collect rejected records until every task's DONE sentinel."""
+        if not sample.record_rejected:
+            return
+        records = []
+        done = 0
+        while done < n_tasks:
+            item = self._pool_get(workers, rej_q)
+            if isinstance(item, str) and item == DONE:
+                done += 1
+            else:
+                records.append(item)
+        if records:
+            sample.host_all_records = HostRecords.from_tuples(records)
+
     def _drain_rejected(self, sample: Sample, rej_q, workers=()) -> None:
         """Drain the rejected-record queue BEFORE joining workers: a child
         cannot exit while its queue feeder thread still holds undelivered
-        records (the pipe is small), so join-before-drain deadlocks."""
+        records (the pipe is small), so join-before-drain deadlocks.
+        (fork path only — pool workers signal with DONE sentinels.)"""
         if not sample.record_rejected:
             return
         records = []
@@ -151,35 +324,41 @@ class MulticoreEvalParallelSampler(_MulticoreBase):
                                 all_accepted=False, ana_vars=None) -> Sample:
         simulate_one = self._resolve(simulate_one)
         sample = self.sample_factory()
-        ctx = mp.get_context(self.start_method)
-        n_eval = ctx.Value("i", 0)
-        n_acc = ctx.Value("i", 0)
-        out_q = ctx.Queue()
-        rej_q = ctx.Queue()
         seeds = np.random.randint(0, 2**31 - 1, size=self.n_procs)
-        workers = [
-            ctx.Process(
-                target=_eval_parallel_worker,
-                args=(simulate_one, n, n_eval, n_acc, out_q, int(seeds[i]),
-                      sample.record_rejected, rej_q),
-                daemon=self.daemon,
+        if self.start_method != "fork":
+            collected, n_evals = self._run_pool(
+                "eval", simulate_one, [n] * self.n_procs, seeds, sample
             )
-            for i in range(self.n_procs)
-        ]
-        for w in workers:
-            w.start()
-        collected: list[tuple[int, Particle]] = []
-        done = 0
-        while done < self.n_procs:
-            item = get_if_worker_healthy(workers, out_q)
-            if item == DONE:
-                done += 1
-            else:
-                collected.append(item)
-        self._drain_rejected(sample, rej_q, workers)
-        for w in workers:
-            w.join()
-        self.nr_evaluations_ = n_eval.value
+        else:
+            ctx = mp.get_context(self.start_method)
+            n_eval = ctx.Value("i", 0)
+            n_acc = ctx.Value("i", 0)
+            out_q = ctx.Queue()
+            rej_q = ctx.Queue()
+            workers = [
+                ctx.Process(
+                    target=_eval_parallel_worker,
+                    args=(simulate_one, n, n_eval, n_acc, out_q,
+                          int(seeds[i]), sample.record_rejected, rej_q),
+                    daemon=self.daemon,
+                )
+                for i in range(self.n_procs)
+            ]
+            for w in workers:
+                w.start()
+            collected = []
+            done = 0
+            while done < self.n_procs:
+                item = get_if_worker_healthy(workers, out_q)
+                if item == DONE:
+                    done += 1
+                else:
+                    collected.append(item)
+            self._drain_rejected(sample, rej_q, workers)
+            for w in workers:
+                w.join()
+            n_evals = n_eval.value
+        self.nr_evaluations_ = n_evals
         # deterministic slot ordering + overshoot trim (reference invariant)
         collected.sort(key=lambda x: x[0])
         collected = collected[:n]
@@ -196,38 +375,44 @@ class MulticoreParticleParallelSampler(_MulticoreBase):
                                 all_accepted=False, ana_vars=None) -> Sample:
         simulate_one = self._resolve(simulate_one)
         sample = self.sample_factory()
-        ctx = mp.get_context(self.start_method)
-        out_q = ctx.Queue()
-        rej_q = ctx.Queue()
         quotas = [n // self.n_procs] * self.n_procs
         for i in range(n % self.n_procs):
             quotas[i] += 1
         seeds = np.random.randint(0, 2**31 - 1, size=self.n_procs)
-        workers = [
-            ctx.Process(
-                target=_particle_parallel_worker,
-                args=(simulate_one, quotas[i], out_q, int(seeds[i]),
-                      sample.record_rejected, rej_q),
-                daemon=self.daemon,
+        if self.start_method != "fork":
+            collected, n_eval = self._run_pool(
+                "quota", simulate_one, quotas, seeds, sample
             )
-            for i in range(self.n_procs)
-            if quotas[i] > 0
-        ]
-        for w in workers:
-            w.start()
-        particles: list[Particle] = []
-        n_eval = 0
-        done = 0
-        while done < len(workers):
-            item = get_if_worker_healthy(workers, out_q)
-            if isinstance(item, tuple) and item[0] == DONE:
-                n_eval += item[1]
-                done += 1
-            else:
-                particles.append(item[1])
-        self._drain_rejected(sample, rej_q, workers)
-        for w in workers:
-            w.join()
+            particles = [p for _, p in collected]
+        else:
+            ctx = mp.get_context(self.start_method)
+            out_q = ctx.Queue()
+            rej_q = ctx.Queue()
+            workers = [
+                ctx.Process(
+                    target=_particle_parallel_worker,
+                    args=(simulate_one, quotas[i], out_q, int(seeds[i]),
+                          sample.record_rejected, rej_q),
+                    daemon=self.daemon,
+                )
+                for i in range(self.n_procs)
+                if quotas[i] > 0
+            ]
+            for w in workers:
+                w.start()
+            particles = []
+            n_eval = 0
+            done = 0
+            while done < len(workers):
+                item = get_if_worker_healthy(workers, out_q)
+                if isinstance(item, tuple) and item[0] == DONE:
+                    n_eval += item[1]
+                    done += 1
+                else:
+                    particles.append(item[1])
+            self._drain_rejected(sample, rej_q, workers)
+            for w in workers:
+                w.join()
         self.nr_evaluations_ = n_eval
         sample.accepted_particles = particles[:n]
         sample.accepted_proposal_ids = np.arange(len(sample.accepted_particles))

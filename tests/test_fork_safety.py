@@ -56,11 +56,14 @@ def test_find_jax_refs_catches_nested_attribute():
     assert refs and ".weights" in refs[0]
 
 
+@pytest.mark.filterwarnings("ignore:os.fork:RuntimeWarning")
+@pytest.mark.filterwarnings("ignore:This process:DeprecationWarning")
 def test_host_closure_passes_guard_both_generations():
     """The real proposal closure (t=0 prior mode AND t>0 transition mode)
     must contain zero jax references — enforced every generation by the
-    fork-context multicore samplers before they fork."""
-    abc = _make_abc(pt.MulticoreEvalParallelSampler(n_procs=2))
+    (opt-in) fork-context multicore samplers before they fork."""
+    abc = _make_abc(pt.MulticoreEvalParallelSampler(n_procs=2,
+                                                    start_method="fork"))
     abc.new("sqlite://", {"y": 0.5})
     h = abc.run(max_nr_populations=2)  # t=0 (prior) + t=1 (transition)
     assert h.n_populations == 2
@@ -82,7 +85,8 @@ def test_guard_failure_is_loud_not_a_deadlock():
         pt.Distribution(mu=pt.RV("uniform", -2.0, 4.0)),
         PoisonedDistance(p=2), population_size=10,
         eps=pt.QuantileEpsilon(initial_epsilon=2.0, alpha=0.5),
-        sampler=pt.MulticoreEvalParallelSampler(n_procs=2),
+        sampler=pt.MulticoreEvalParallelSampler(n_procs=2,
+                                                start_method="fork"),
     )
     abc.new("sqlite://", {"y": 0.5})
     with pytest.raises(RuntimeError, match="poison"):

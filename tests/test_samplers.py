@@ -114,5 +114,5 @@ def test_multicore_worker_exception_propagates():
         sampler=pt.MulticoreEvalParallelSampler(n_procs=2),
     )
     abc.new("sqlite://", {"x": X_OBS})
-    with pytest.raises(RuntimeError, match="workers died"):
+    with pytest.raises(RuntimeError, match="worker(s)? died"):
         abc.run(max_nr_populations=1)
