@@ -120,17 +120,30 @@ class AdaptiveAggregatedDistance(AggregatedDistance):
         return True
 
     def _fit(self, t, get_all_sum_stats):
+        from ..sampler.base import DeviceRecords
+
         samples = get_all_sum_stats()
-        # per-sub-distance value of each recorded simulation vs observation
-        vals = np.asarray(
-            [
-                [d(self._unflatten(s), self._x_0, t) for d in self.distances]
-                for s in np.asarray(samples)
-            ]
-        )  # (n, K)
-        scales = np.asarray(
-            [self.scale_function(vals[:, k]) for k in range(vals.shape[1])]
-        )
+        scales = None
+        if isinstance(samples, DeviceRecords) and samples.scale is not None \
+                and self.device_scale_impl() is not None:
+            # the generation kernel already reduced the on-device record
+            # ring to the (K,) scale vector (device_record_reduce) — no
+            # ring fetch, no per-record host distance loop
+            scales = np.asarray(samples.scale, np.float64)
+        if scales is None:
+            # per-sub-distance value of each recorded simulation vs the
+            # observation, reduced on the host
+            vals = np.asarray(
+                [
+                    [d(self._unflatten(s), self._x_0, t)
+                     for d in self.distances]
+                    for s in np.asarray(samples)
+                ]
+            )  # (n, K)
+            scales = np.asarray(
+                [self.scale_function(vals[:, k])
+                 for k in range(vals.shape[1])]
+            )
         w = np.zeros_like(scales)
         pos = scales > 0
         w[pos] = 1.0 / scales[pos]
@@ -142,6 +155,92 @@ class AdaptiveAggregatedDistance(AggregatedDistance):
             if spec is not None:
                 return spec.unflatten(np.asarray(flat))
         return np.asarray(flat)
+
+    # ------------------------------------------------------------- device
+    #: scale functions whose device twins need the observation argument —
+    #: they take (samples, x_0) and cannot be applied to the 1-arg
+    #: per-sub-distance value columns this class reduces over (the host
+    #: _fit would TypeError on them too)
+    _TWO_ARG_SCALES = frozenset({
+        "bias", "root_mean_square_deviation",
+        "median_absolute_deviation_to_observation",
+        "mean_absolute_deviation_to_observation",
+        "combined_median_absolute_deviation",
+        "combined_mean_absolute_deviation",
+        "standard_deviation_to_observation",
+    })
+
+    def device_scale_impl(self):
+        """Traceable twin of ``scale_function`` applied column-wise over
+        the (n_records, K) sub-distance value matrix, or None when only
+        the host can run it (custom function, observation-dependent
+        scale). Mirrors AdaptivePNormDistance.device_scale_impl."""
+        from .scale import SCALE_FUNCTIONS, _device_scale_impls
+
+        if self.scale_function is _span_of_values:
+            return _device_scale_impls().get("span")
+        name = getattr(self.scale_function, "__name__", "")
+        if SCALE_FUNCTIONS.get(name) is not self.scale_function:
+            return None  # custom fn shadowing a builtin name: host path
+        if name in self._TWO_ARG_SCALES:
+            return None
+        return _device_scale_impls().get(name)
+
+    def _subs_device_constant(self) -> bool:
+        """True when every sub-distance is a plain, generation-constant
+        PNormDistance — the only case where the device reduction's
+        ``device_params(None)`` is guaranteed to equal the host's
+        per-generation sub-distance evaluation."""
+        from .pnorm import PNormDistance
+
+        return all(
+            type(d) is PNormDistance and d.sumstat is None
+            and not any(k >= 0 for k in d.weights)
+            for d in self.distances
+        )
+
+    def device_record_reduce(self, spec: SumStatSpec | None = None):
+        """Per-generation scale reduction traced INTO the multigen kernel:
+        evaluate every sub-distance against the observation over the
+        on-device record ring, then scale each value column (the traceable
+        twin of :meth:`_fit` — reference AdaptiveAggregatedDistance
+        semantics, SURVEY.md §2.2 aggregated row)."""
+        import jax
+
+        impl = self.device_scale_impl()
+        if impl is None or not self.adaptive \
+                or not self._subs_device_constant():
+            return None
+        fns = [d.device_fn(spec) for d in self.distances]
+        sub_params = tuple(d.device_params(None) for d in self.distances)
+        n_sub = len(fns)
+
+        def reduce(rec_ss, valid, x0):
+            vals = jnp.stack(
+                [
+                    jax.vmap(lambda s, f=f, p=p: f(s, x0, p))(rec_ss)
+                    for f, p in zip(fns, sub_params)
+                ],
+                axis=1,
+            )  # (n_records, K)
+            return impl(vals, valid, jnp.zeros(n_sub, jnp.float32))
+
+        return reduce
+
+    def device_weight_update(self):
+        """Traceable scale -> aggregated-distance-params post-processing
+        (twin of :meth:`_fit`'s 1/scale weighting; sub-params are
+        chunk-constant under the non-adaptive-subs fused gate)."""
+        factors = jnp.asarray(self.factors, jnp.float32)
+        sub_params = tuple(d.device_params(None) for d in self.distances)
+
+        def post(scales):
+            w = jnp.where(
+                scales > 0, 1.0 / jnp.maximum(scales, 1e-38), 0.0
+            )
+            return (w * factors, sub_params)
+
+        return post
 
 
 def _span_of_values(values: np.ndarray) -> float:

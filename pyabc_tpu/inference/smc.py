@@ -31,6 +31,7 @@ from ..core.random import generation_key, root_key
 from ..core.random_variables import Distribution
 from ..core.sumstat_spec import SumStatSpec
 from ..distance import (
+    AdaptiveAggregatedDistance,
     AdaptivePNormDistance,
     AggregatedDistance,
     Distance,
@@ -896,12 +897,18 @@ class ABCSMC:
             # single default weight vector can
             if any(k >= 0 for k in d.weights):
                 return False
-        elif type(d) is AggregatedDistance:
-            # non-adaptive weighted sum of plain p-norm sub-distances: its
-            # params are chunk-constant (the sub checks imply device
-            # compatibility); AdaptiveAggregatedDistance (per-generation
-            # scale refits) keeps the host loop
-            if any(k >= 0 for k in d.weights):
+        elif type(d) in (AggregatedDistance, AdaptiveAggregatedDistance):
+            # weighted sum of plain p-norm sub-distances. Non-adaptive:
+            # params are chunk-constant. Adaptive: the per-generation
+            # 1/scale reweighting runs IN-KERNEL over the record ring
+            # (device_record_reduce/device_weight_update twins)
+            if type(d) is AdaptiveAggregatedDistance:
+                if not d.adaptive or d.log_file \
+                        or d.device_scale_impl() is None:
+                    return False
+            elif any(k >= 0 for k in d.weights):
+                # per-generation user weight schedules can't ride a
+                # chunk-constant carry
                 return False
             for sub in d.distances:
                 if (type(sub) is not PNormDistance
@@ -1180,8 +1187,12 @@ class ABCSMC:
         tr = self.transitions[0]
         stochastic = type(self.acceptor) is StochasticAcceptor
         eps_quantile = isinstance(self.eps, QuantileEpsilon)
-        adaptive = (isinstance(self.distance_function, AdaptivePNormDistance)
-                    and self.distance_function.adaptive)
+        adaptive = (
+            (isinstance(self.distance_function, AdaptivePNormDistance)
+             and self.distance_function.adaptive)
+            or (type(self.distance_function) is AdaptiveAggregatedDistance
+                and self.distance_function.adaptive)
+        )
         # learned/transformed statistics ride the chunk as constant device
         # params; the predictor refits on the host BETWEEN chunks (next
         # chunk gets a fresh carry), so chunks are dispatched non-
@@ -1543,8 +1554,20 @@ class ABCSMC:
                                 )
                 if adaptive:
                     dwn = fetched["dist_w_next"]
-                    # sumstat-bearing distances carry {"w": ..., "ss": ...}
-                    w_next = dwn["w"][g] if isinstance(dwn, dict) else dwn[g]
+                    if isinstance(dwn, dict):
+                        # sumstat-bearing distances carry {"w":..., "ss":...}
+                        w_next = dwn["w"][g]
+                    elif isinstance(dwn, tuple):
+                        # aggregated distances carry (w*factors, sub_params);
+                        # the host dict stores the factor-free weights
+                        f = np.asarray(self.distance_function.factors,
+                                       np.float64)
+                        comb = np.asarray(dwn[0][g], np.float64)
+                        w_next = np.where(
+                            f != 0, comb / np.where(f != 0, f, 1.0), 0.0
+                        )
+                    else:
+                        w_next = dwn[g]
                     self.distance_function.weights[t + 1] = np.asarray(
                         w_next, np.float64
                     )

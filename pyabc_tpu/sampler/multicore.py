@@ -234,14 +234,16 @@ class _MulticoreBase(Sampler):
         return state
 
     def _pool_get(self, workers, q):
-        """Get from q; a dead pool worker mid-generation is unrecoverable
-        (its DONE will never arrive), so tear down and re-raise — the
-        pool-mode analog of the reference get_if_worker_healthy."""
+        """Get from q; a pool worker that exited ABNORMALLY may have held a
+        dequeued task whose DONE will never arrive, so tear down and raise
+        (reference ``get_if_worker_healthy`` semantics: any non-zero child
+        exitcode is fatal). Idle-and-alive or cleanly-exited workers never
+        trip this."""
         while True:
             try:
                 return q.get(timeout=5.0)
             except queue_mod.Empty:
-                if not all(w.is_alive() for w in workers):
+                if any(w.exitcode not in (0, None) for w in workers):
                     self.stop()
                     raise RuntimeError(
                         "a sampler pool worker died mid-generation"

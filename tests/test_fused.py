@@ -401,14 +401,62 @@ def test_fused_aggregated_distance_matches_pergen_loop():
         df, w = h.get_distribution(0, h.max_t)
         mu = float(np.sum(df["theta"] * w))
         assert mu == pytest.approx(POST_MU, abs=0.3)
-    # adaptive variant stays on the host loop
+    # the adaptive variant with a builtin scale twin ALSO rides chunks
     abc_a = pt.ABCSMC(
         _gauss_model(), pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD)),
         pt.AdaptiveAggregatedDistance([pt.PNormDistance(p=2),
                                        pt.PNormDistance(p=1)]),
         population_size=100, eps=pt.MedianEpsilon(),
     )
-    assert not abc_a._fused_chunk_capable()
+    assert abc_a._fused_chunk_capable()
+    # ... but not with a custom scale function (host-only refits)
+    abc_c = pt.ABCSMC(
+        _gauss_model(), pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD)),
+        pt.AdaptiveAggregatedDistance(
+            [pt.PNormDistance(p=2), pt.PNormDistance(p=1)],
+            scale_function=lambda v: float(np.std(v)),
+        ),
+        population_size=100, eps=pt.MedianEpsilon(),
+    )
+    assert not abc_c._fused_chunk_capable()
+
+
+def test_fused_adaptive_aggregated_matches_pergen_loop():
+    """AdaptiveAggregatedDistance: the per-generation 1/scale sub-distance
+    reweighting runs IN-KERNEL over the record ring. Epsilon trajectory,
+    per-generation weights, and posterior must match the host per-
+    generation loop statistically."""
+    from pyabc_tpu.distance.scale import standard_deviation
+
+    def make_distance(scale_fn=None):
+        kw = {} if scale_fn is None else {"scale_function": scale_fn}
+        return pt.AdaptiveAggregatedDistance(
+            [pt.PNormDistance(p=2), pt.PNormDistance(p=1)], **kw
+        )
+
+    for scale_fn in (None, standard_deviation):  # span default + std twin
+        abc_f, h_f = _run(4, seed=53, pop=300,
+                          distance=make_distance(scale_fn))
+        assert h_f.get_telemetry(2).get("fused_chunk"), "fused path not taken"
+        abc_u, h_u = _run(1, seed=53, pop=300,
+                          distance=make_distance(scale_fn))
+        assert h_f.n_populations == h_u.n_populations
+        eps_f = h_f.get_all_populations().query("t >= 1")["epsilon"].to_numpy()
+        eps_u = h_u.get_all_populations().query("t >= 1")["epsilon"].to_numpy()
+        np.testing.assert_allclose(eps_f, eps_u, rtol=0.25)
+        # the in-kernel reweighting mirrors into the host weights dict
+        w_f = abc_f.distance_function.weights
+        w_u = abc_u.distance_function.weights
+        shared = sorted(set(w_f) & set(w_u) - {-1})
+        assert len(shared) >= 2
+        for t in shared:
+            np.testing.assert_allclose(
+                np.asarray(w_f[t]), np.asarray(w_u[t]), rtol=0.35,
+            )
+        for h in (h_f, h_u):
+            df, w = h.get_distribution(0, h.max_t)
+            mu = float(np.sum(df["theta"] * w))
+            assert mu == pytest.approx(POST_MU, abs=0.3)
 
 
 def test_gridsearch_device_fit_matches_host_winner():
