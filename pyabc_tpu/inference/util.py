@@ -213,11 +213,16 @@ def pad_transition_params(params: dict, n_cap: int, d_max: int) -> dict:
     out = {}
     for k, v in params.items():
         v = np.asarray(v)
-        if k == "thetas":
+        if k in ("thetas", "thetas_c"):
             p = np.zeros((n_cap, d_max), v.dtype)
             p[: v.shape[0], : v.shape[1]] = v
-        elif k == "weights":
+        elif k in ("weights", "quad"):
+            # padded ancestors carry weight 0, so a zero quad term is inert
             p = np.zeros((n_cap,), v.dtype)
+            p[: v.shape[0]] = v
+        elif k == "center":
+            # padded dims center at 0, matching the zero-padded thetas
+            p = np.zeros((d_max,), v.dtype)
             p[: v.shape[0]] = v
         elif k in ("chol", "prec"):
             p = np.zeros((d_max, d_max), v.dtype)

@@ -164,6 +164,10 @@ class GridSearchCV(Transition):
             "weights": full["weights"],
             "chol": full["chol"] * s_best,
             "prec": full["prec"] / (s_best * s_best),
+            "center": full["center"],
+            "thetas_c": full["thetas_c"],
+            # quad scales with prec (see MVN device_fit's cached v^T P v)
+            "quad": full["quad"] / (s_best * s_best),
             "logdet": full["logdet"] + 2.0 * dim * jnp.log(s_best),
             "dim": full["dim"],
         }

@@ -7,14 +7,19 @@ CLI re-hardcoded the generation count by hand).
 
 Sizing rationale lives with the numbers:
 - ``(DEFAULT_GENS + 1)`` must be a multiple of ``DEFAULT_G`` so no stub
-  tail chunk is scheduled; 31 with G=16 gives chunks t=1..16 and 17..32,
-  staying just clear of the deep-schedule acceptance collapse
-  (MedianEpsilon at the noise floor, t >~ 33).
-- G=16 beats G=8 by halving per-generation sync cost over the tunnel
-  (measured round 3: 83k vs 45k pps); G=20+ overruns the floor.
+  tail chunk is scheduled; 31 with G=8 gives chunks t=1..8, 9..16,
+  17..24, 25..32, staying just clear of the deep-schedule acceptance
+  collapse (MedianEpsilon at the noise floor, t >~ 33).
+- Round 3 (synchronous per-chunk fetch): G=16 beat G=8 (83k vs 45k pps)
+  by halving the per-generation share of the ~0.1s tunnel sync. Round 4's
+  THREADED fetch pipeline (ABCSMC fetch_pipeline_depth) hides that
+  latency behind later chunks' compute, flipping the tradeoff: shorter
+  chunks expose less latency per fetch and yield more true steady-state
+  windows per run (measured round 4: G=8 mid-run chunks 0.026-0.028 s =
+  ~290k pps vs ~120k at G=16).
 """
 
 DEFAULT_POP = 1000
 DEFAULT_GENS = 31
-DEFAULT_G = 16
+DEFAULT_G = 8
 DEFAULT_BUDGET_S = 300.0

@@ -538,3 +538,24 @@ def test_gridsearch_degenerate_cv_falls_back():
                                         {"scaling": [0.5, 2.0]}, cv=cv),
         )
         assert not abc._fused_chunk_capable(), cv
+
+
+def test_fetch_pipeline_depths_complete_all_generations():
+    """Every fetch_pipeline_depth (1 = synchronous fetch with the
+    speculative next chunk, >1 = threaded pipelined fetches) must run the
+    FULL schedule — a depth-1 regression once truncated the run silently
+    after the first chunk — and agree with the other depths exactly on
+    the epsilon trajectory (same seed, same kernels)."""
+    eps_by_depth = {}
+    for depth in (1, 2, 3):
+        abc, h = _run(3, seed=71, pop=200,
+                      distance=pt.PNormDistance(p=2), n_gens=9,
+                      fetch_pipeline_depth=depth)
+        assert h.n_populations == 9, (
+            f"depth {depth} truncated the run at {h.n_populations} gens"
+        )
+        eps_by_depth[depth] = (
+            h.get_all_populations().query("t >= 1")["epsilon"].to_numpy()
+        )
+    np.testing.assert_allclose(eps_by_depth[1], eps_by_depth[2])
+    np.testing.assert_allclose(eps_by_depth[1], eps_by_depth[3])

@@ -11,6 +11,7 @@ invariants (finite, non-degenerate effective sample size).
 """
 import jax
 import numpy as np
+import pandas as pd
 import pytest
 
 import pyabc_tpu as pt
@@ -126,3 +127,33 @@ def test_fused_deep_schedule_f32_weights_match_f64_recomputation():
     w64 = scipy_norm.pdf(th_last, 0.0, PRIOR_SD) / q
     w64 = w64 / w64.sum()
     np.testing.assert_allclose(w_last, w64, rtol=5e-4, atol=1e-7)
+
+
+def test_mixture_logpdf_stable_far_from_origin():
+    """The MXU-decomposed KDE mixture density expands the Mahalanobis
+    form around the population MEAN: a posterior concentrated at
+    |mean| >> bandwidth (here 1e3 vs 1e-2) must still match the f64 host
+    KDE — the origin-centered expansion loses ~1e10 of f32 precision
+    here and returns garbage."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    n, d = 256, 2
+    center = np.array([1.0e3, -2.0e3])
+    X = pd.DataFrame(center + rng.normal(0, 1e-2, (n, d)),
+                     columns=["a", "b"])
+    w = rng.uniform(0.5, 1.0, n)
+    w = w / w.sum()
+    tr = pt.MultivariateNormalTransition()
+    tr.fit(X, w)
+    params = {k: jnp.asarray(v) for k, v in tr.device_params().items()}
+    q = (center + rng.normal(0, 1e-2, (64, d))).astype(np.float32)
+    dev = jax.vmap(
+        lambda th: pt.MultivariateNormalTransition.device_logpdf(th, params)
+    )(jnp.asarray(q))
+    host = np.log(np.maximum(
+        np.asarray(tr.pdf(pd.DataFrame(q, columns=["a", "b"])), np.float64),
+        1e-300,
+    ))
+    np.testing.assert_allclose(np.asarray(dev), host, rtol=2e-3, atol=5e-2)
