@@ -12,6 +12,13 @@ outstanding slot ids are abandoned, which is harmless: slots are
 provenance ids for the deterministic sort-by-slot trim, and generation
 completion is driven solely by DELIVERED accepted results. A worker that
 joins mid-generation gets the current generation's payload on hello.
+
+Two opt-in modes TRADE AWAY parts of that contract (as in the reference):
+``wait_for_all`` waits for every handed-out slot's delivery, so a worker
+crashing with slots in flight stalls the generation until the sampler's
+``generation_timeout``; ``mode="static"`` hands out fixed acceptance
+quotas, so a crashed worker's undelivered units stall it likewise. Both
+are bounded by the timeout, not self-healing.
 """
 from __future__ import annotations
 
@@ -78,6 +85,11 @@ class EvalBroker:
         self._all_accepted = False
         self._next_slot = 0
         self._n_acc = 0
+        self._n_delivered = 0
+        self._batch = 1
+        self._wait_for_all = False
+        self._mode = "dynamic"
+        self._draining = False
         self._results: list[tuple[int, bytes, bool]] = []
         self._done = True
         self._done_event = threading.Event()
@@ -99,7 +111,22 @@ class EvalBroker:
     def start_generation(self, t: int, payload: bytes, n_target: int,
                          *, max_eval: float = float("inf"),
                          all_accepted: bool = False,
-                         batch: int = 1) -> None:
+                         batch: int = 1,
+                         wait_for_all: bool = False,
+                         mode: str = "dynamic") -> None:
+        """``mode``: 'dynamic' hands out evaluation slots until n_target
+        acceptances arrive (reference RedisEvalParallelSampler); 'static'
+        hands out exactly n_target ACCEPTANCE quota units, each evaluated
+        until it accepts (reference RedisStaticSampler / MappingSampler
+        semantics — a worker dying with undelivered units stalls the
+        generation until the sampler's timeout, the static scheduler's
+        inherent weakness). ``wait_for_all``: after the acceptance target
+        is met, stop handing out new slots but finish only once every
+        handed-out slot's result has been DELIVERED, so adaptive
+        components see an unbiased, complete record set (reference
+        ``wait_for_all_samples``)."""
+        if mode not in ("dynamic", "static"):
+            raise ValueError(f"unknown scheduling mode {mode!r}")
         with self._lock:
             self._gen += 1
             self._t = t
@@ -108,8 +135,12 @@ class EvalBroker:
             self._max_eval = max_eval
             self._all_accepted = all_accepted
             self._batch = max(int(batch), 1)
+            self._wait_for_all = bool(wait_for_all)
+            self._mode = mode
             self._next_slot = 0
             self._n_acc = 0
+            self._n_delivered = 0
+            self._draining = False
             self._results = []
             self._done = False
             self._done_event.clear()
@@ -165,19 +196,27 @@ class EvalBroker:
                 if self._done or self._payload is None:
                     return ("wait",)
                 return ("work", self._gen, self._t, self._payload,
-                        self._batch)
+                        self._batch, self._mode)
         if kind == "get_slots":
             _, worker_id, gen, k = msg
             with self._lock:
                 self._touch(worker_id)
-                if gen != self._gen or self._done:
+                if gen != self._gen or self._done or self._draining:
                     return ("done",)
-                if self._next_slot >= self._max_eval:
+                cap = self._max_eval
+                if self._mode == "static":
+                    # static quota: exactly n_target acceptance units total
+                    cap = min(cap, self._n_target)
+                if self._next_slot >= cap:
+                    if self._mode == "static":
+                        # every unit handed out; completion is driven by
+                        # their deliveries, not by refusing stragglers
+                        return ("done",)
                     # eval budget exhausted: finish with what was delivered
                     self._finish_locked()
                     return ("done",)
                 start = self._next_slot
-                stop = int(min(start + int(k), self._max_eval))
+                stop = int(min(start + int(k), cap))
                 self._next_slot = stop
                 return ("slots", start, stop)
         if kind == "results":
@@ -192,10 +231,49 @@ class EvalBroker:
                     self._results.append((int(slot), blob, bool(accepted)))
                     if accepted:
                         self._n_acc += 1
-                if self._n_acc >= self._n_target:
+                # dynamic slots yield exactly one triple each; static quota
+                # units yield one ACCEPTED triple each (plus reject records)
+                self._n_delivered += (
+                    sum(1 for *_x, acc in triples if acc)
+                    if self._mode == "static" else len(triples)
+                )
+                if self._mode == "static" \
+                        and len(self._results) >= self._max_eval:
+                    # static eval budget: every static evaluation ships a
+                    # triple (rejects included), so the delivered count IS
+                    # the evaluation count (in-progress units overshoot by
+                    # at most their heartbeat interval). Finish partial —
+                    # the sampler's n_accepted < n then triggers ABCSMC's
+                    # acceptance-budget stop, like the dynamic slot cap.
                     self._finish_locked()
                     return ("done",)
+                # draining implies the target was already met (n_acc is
+                # monotonic), so one branch decides both finalizations
+                if self._n_acc >= self._n_target:
+                    if not self._wait_for_all \
+                            or self._n_delivered >= self._next_slot:
+                        self._finish_locked()
+                        return ("done",)
+                    # target met: stop handing out new slots, keep
+                    # collecting the in-flight ones so adaptive
+                    # components see the complete record set
+                    self._draining = True
                 return ("ok",)
+        if kind == "heartbeat":
+            # static-unit liveness probe: lets a worker abandon a spinning
+            # quota unit the moment the generation is finalized
+            _, worker_id, gen = msg
+            with self._lock:
+                self._touch(worker_id)
+                if gen != self._gen or self._done or self._draining:
+                    return ("done",)
+                return ("ok",)
+        if kind == "bye":
+            # graceful worker shutdown (KillHandler parity): deregister so
+            # manager status doesn't show ghosts
+            with self._lock:
+                self._workers.pop(msg[1], None)
+            return ("ok",)
         if kind == "status":
             return ("status", self.status())
         if kind == "shutdown":

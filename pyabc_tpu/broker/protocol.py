@@ -2,15 +2,23 @@
 
 Reference analog: the Redis sampler's key/queue schema
 (``pyabc/sampler/redis_eps/cmd.py``: START/STOP/GENERATION counters and
-result queues) — collapsed into five request types against one broker:
+result queues) — collapsed into seven request types against one broker:
 
-- ``("hello", worker_id)``  -> ("work", gen, t, payload, batch) | ("wait",)
+- ``("hello", worker_id)``  -> ("work", gen, t, payload, batch, mode)
+                             | ("wait",)   (mode: "dynamic" | "static")
 - ``("get_slots", worker_id, gen, k)``
                             -> ("slots", start, stop) | ("done",)
 - ``("results", worker_id, gen, [(slot, particle_bytes, accepted), ...])``
                             -> ("ok",) | ("done",)
+- ``("heartbeat", worker_id, gen)`` -> ("ok",) | ("done",)
+  (static-unit liveness probe: abandon a spinning quota unit once the
+  generation is finalized)
+- ``("bye", worker_id)``    -> ("ok",)   (graceful deregistration)
 - ``("status",)``           -> ("status", BrokerStatus)
 - ``("shutdown",)``         -> ("ok",)
+
+Broker and worker ship together (same package); the frame format is not a
+cross-version compatibility boundary.
 
 Particles travel pre-pickled (``particle_bytes``) so the broker thread
 never unpickles model-specific payloads while holding its lock.

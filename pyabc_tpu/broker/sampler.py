@@ -35,10 +35,22 @@ class ElasticSampler(Sampler):
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  batch: int = 10,
-                 generation_timeout: float | None = None):
+                 generation_timeout: float | None = None,
+                 wait_for_all_samples: bool = False,
+                 scheduling: str = "dynamic"):
+        """``wait_for_all_samples``: gather every in-flight evaluation
+        before finalizing a generation (adaptive components then see an
+        unbiased, complete record set — reference ``wait_for_all_samples``).
+        ``scheduling``: 'dynamic' (evaluation-parallel slot handout,
+        reference RedisEvalParallelSampler) or 'static' (fixed acceptance
+        quotas per handed-out unit, reference RedisStaticSampler)."""
         super().__init__()
         self.batch = int(batch)
         self.generation_timeout = generation_timeout
+        self.wait_for_all_samples = bool(wait_for_all_samples)
+        if scheduling not in ("dynamic", "static"):
+            raise ValueError(f"unknown scheduling {scheduling!r}")
+        self.scheduling = scheduling
         self.broker = EvalBroker(host, port)
 
     @property
@@ -54,6 +66,8 @@ class ElasticSampler(Sampler):
         self.broker.start_generation(
             t if t is not None else -1, payload, n, max_eval=max_eval,
             all_accepted=all_accepted, batch=self.batch,
+            wait_for_all=self.wait_for_all_samples,
+            mode=self.scheduling,
         )
         triples = self.broker.wait(timeout=self.generation_timeout)
 

@@ -6,14 +6,19 @@ generation, evaluates ``simulate_one`` per handed-out slot, ships results
 back in batches, and loops into the next generation. Death at ANY point is
 safe (abandoned slots are provenance ids only); joining mid-generation is
 the normal case. Per-worker CSV logging mirrors the reference worker's
-runtime bookkeeping.
+runtime bookkeeping. SIGTERM/SIGINT are handled like the reference's
+``KillHandler``: the current batch is finished and shipped, the worker
+deregisters from the broker ("bye"), and the loop exits cleanly (exit 0) —
+a cluster preemption never strands half-evaluated work.
 """
 from __future__ import annotations
 
 import csv
 import os
 import pickle
+import signal
 import socket
+import threading
 import time
 import uuid
 
@@ -50,63 +55,134 @@ def run_worker(host: str, port: int, *, worker_id: str | None = None,
                 ["worker_id", "generation", "t", "n_eval", "n_accepted",
                  "wall_s"])
 
-    while True:
-        if _stop_check is not None and _stop_check():
-            break
-        if t_end and time.time() > t_end:
-            break
-        if gens_served >= max_generations:
-            break
-        try:
-            reply = request(addr, ("hello", wid))
-        except (ConnectionError, OSError):
-            time.sleep(min(poll_s * 4, 2.0))
-            continue
-        if reply[0] != "work":
-            time.sleep(poll_s)
-            continue
-        # NOTE: no served-generation memory on purpose — a transport blip
-        # mid-generation must NOT bench the worker for the rest of that
-        # generation; re-entering a still-running generation just pulls
-        # more slots (a finished generation answers hello with "wait")
-        _, gen, t, payload, batch = reply
-        simulate_one = pickle.loads(payload)
-        t0 = time.time()
-        n_eval = n_acc = 0
+    # reference KillHandler: SIGTERM/SIGINT request a GRACEFUL exit — the
+    # in-progress batch still ships, then the worker deregisters. Handlers
+    # can only be installed from the main thread (tests run workers in
+    # threads; there the _stop_check hook covers shutdown instead).
+    signaled = threading.Event()
+    restore: dict = {}
+    if threading.current_thread() is threading.main_thread():
+        def _on_signal(signum, frame):
+            signaled.set()
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                restore[sig] = signal.signal(sig, _on_signal)
+            except (ValueError, OSError):  # pragma: no cover - env-specific
+                pass
+
+    def stopping() -> bool:
+        if signaled.is_set():
+            return True
+        return _stop_check() if _stop_check is not None else False
+
+    try:
         while True:
+            if stopping():
+                break
+            if t_end and time.time() > t_end:
+                break
+            if gens_served >= max_generations:
+                break
             try:
-                r = request(addr, ("get_slots", wid, gen, batch))
+                reply = request(addr, ("hello", wid))
             except (ConnectionError, OSError):
-                break  # broker gone; outer loop will reconnect
-            if r[0] != "slots":
-                break
-            _, start, stop = r
-            triples = []
-            for slot in range(start, stop):
-                particle = simulate_one()
-                n_eval += 1
-                n_acc += int(bool(particle.accepted))
-                triples.append((
-                    slot,
-                    pickle.dumps(particle, pickle.HIGHEST_PROTOCOL),
-                    bool(particle.accepted),
-                ))
+                time.sleep(min(poll_s * 4, 2.0))
+                continue
+            if reply[0] != "work":
+                time.sleep(poll_s)
+                continue
+            # NOTE: no served-generation memory on purpose — a transport
+            # blip mid-generation must NOT bench the worker for the rest of
+            # that generation; re-entering a still-running generation just
+            # pulls more slots (a finished generation answers hello "wait")
+            _, gen, t, payload, batch, mode = reply
+            simulate_one = pickle.loads(payload)
+            t0 = time.time()
+            n_eval = n_acc = 0
+            while True:
+                try:
+                    r = request(addr, ("get_slots", wid, gen, batch))
+                except (ConnectionError, OSError):
+                    break  # broker gone; outer loop will reconnect
+                if r[0] != "slots":
+                    break
+                _, start, stop = r
+                triples = []
+                aborted = False
+                for slot in range(start, stop):
+                    # dynamic: one evaluation per slot. static: a quota
+                    # unit — evaluate until THIS unit accepts (reference
+                    # RedisStaticSampler / MappingSampler semantics);
+                    # rejects ship as records either way.
+                    unit_evals = 0
+                    while True:
+                        particle = simulate_one()
+                        n_eval += 1
+                        unit_evals += 1
+                        accepted = bool(particle.accepted)
+                        triples.append((
+                            slot,
+                            pickle.dumps(particle, pickle.HIGHEST_PROTOCOL),
+                            accepted,
+                        ))
+                        if accepted:
+                            n_acc += 1
+                        if accepted or mode != "static":
+                            break
+                        if stopping():
+                            # preemption mid-unit: ship what we have and
+                            # exit — delay bounded by ONE simulate_one
+                            aborted = True
+                            break
+                        if unit_evals % 256 == 0:
+                            # liveness probe: a static unit can spin for
+                            # thousands of evaluations at a collapsed
+                            # acceptance rate; abandon it as soon as the
+                            # broker finalized the generation (eval
+                            # budget / another worker finished it)
+                            try:
+                                hb = request(addr,
+                                             ("heartbeat", wid, gen))
+                            except (ConnectionError, OSError):
+                                aborted = True
+                                break
+                            if hb[0] != "ok":
+                                aborted = True
+                                break
+                    if aborted or stopping():
+                        aborted = True
+                        break
+                try:
+                    r2 = request(addr, ("results", wid, gen, triples))
+                except (ConnectionError, OSError):
+                    break
+                if r2[0] != "ok" or aborted or stopping():
+                    # graceful shutdown: the batch above was flushed; stop
+                    # pulling new slots
+                    break
+            if gen != last_counted_gen:
+                gens_served += 1
+                last_counted_gen = gen
+            n_eval_total += n_eval
+            if n_eval == 0 and not stopping():
+                # nothing handed out (generation ending / transport blip):
+                # don't hot-spin on hello
+                time.sleep(poll_s)
+            if log_writer is not None:
+                log_writer.writerow(
+                    [wid, gen, t, n_eval, n_acc,
+                     round(time.time() - t0, 3)])
+                fh.flush()
+    finally:
+        # deregister so manager status doesn't show ghost workers
+        try:
+            request(addr, ("bye", wid))
+        except (ConnectionError, OSError):
+            pass
+        for sig, old in restore.items():
             try:
-                r2 = request(addr, ("results", wid, gen, triples))
-            except (ConnectionError, OSError):
-                break
-            if r2[0] != "ok":
-                break
-        if gen != last_counted_gen:
-            gens_served += 1
-            last_counted_gen = gen
-        n_eval_total += n_eval
-        if n_eval == 0:
-            # nothing handed out (generation ending / transport blip):
-            # don't hot-spin on hello
-            time.sleep(poll_s)
-        if log_writer is not None:
-            log_writer.writerow(
-                [wid, gen, t, n_eval, n_acc, round(time.time() - t0, 3)])
-            fh.flush()
+                signal.signal(sig, old)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
     return n_eval_total

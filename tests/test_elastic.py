@@ -151,3 +151,130 @@ def test_manager_status_roundtrip(sampler):
     assert kind == "status"
     assert status.done
     assert status.n_target == 0
+
+
+def test_wait_for_all_samples_gathers_in_flight():
+    """With wait_for_all, the broker must NOT finalize when the acceptance
+    target is met while other workers still hold handed-out slots — every
+    in-flight evaluation is collected first, so adaptive components see
+    the complete, unbiased record set (reference wait_for_all_samples)."""
+    from pyabc_tpu.broker.broker import EvalBroker
+
+    broker = EvalBroker("127.0.0.1", 0)
+    try:
+        broker.start_generation(0, b"x", 2, batch=5, wait_for_all=True)
+        gen = broker._gen
+        _, a0, a1 = broker._dispatch(("get_slots", "A", gen, 5))
+        _, b0, b1 = broker._dispatch(("get_slots", "B", gen, 5))
+        assert (a1 - a0) == (b1 - b0) == 5
+        # A posts 3 results incl. 2 acceptances: target met, but B's 5
+        # slots are in flight -> the generation must stay open, draining
+        reply = broker._dispatch(("results", "A", gen, [
+            (a0, b"p", True), (a0 + 1, b"p", True), (a0 + 2, b"p", False),
+        ]))
+        assert reply == ("ok",)
+        assert not broker.status().done
+        # draining: no new slots are handed out
+        assert broker._dispatch(("get_slots", "C", gen, 5)) == ("done",)
+        # B delivers its batch -> still 2 of A's slots outstanding
+        reply = broker._dispatch(("results", "B", gen, [
+            (s, b"p", False) for s in range(b0, b1)
+        ]))
+        assert reply == ("ok",)
+        assert not broker.status().done
+        # A delivers the stragglers -> NOW the generation finalizes
+        reply = broker._dispatch(("results", "A", gen, [
+            (a0 + 3, b"p", False), (a0 + 4, b"p", False),
+        ]))
+        assert reply == ("done",)
+        triples = broker.wait(timeout=5.0)
+        assert len(triples) == 10  # every handed-out slot delivered
+    finally:
+        broker.stop()
+
+
+def test_without_wait_for_all_finishes_at_target():
+    from pyabc_tpu.broker.broker import EvalBroker
+
+    broker = EvalBroker("127.0.0.1", 0)
+    try:
+        broker.start_generation(0, b"x", 2, batch=5, wait_for_all=False)
+        gen = broker._gen
+        broker._dispatch(("get_slots", "A", gen, 5))
+        broker._dispatch(("get_slots", "B", gen, 5))
+        reply = broker._dispatch(("results", "A", gen, [
+            (0, b"p", True), (1, b"p", True),
+        ]))
+        assert reply == ("done",)  # finalized with B's slots abandoned
+        assert broker.status().done
+    finally:
+        broker.stop()
+
+
+def test_sigterm_drains_cleanly_and_deregisters(sampler):
+    """kill -TERM mid-generation: the worker ships its current batch,
+    deregisters from the broker (no ghost in manager status), and exits
+    with code 0 — reference KillHandler semantics."""
+    port = sampler.address[1]
+    workers = [_spawn_worker(port) for _ in range(2)]
+    terminated = {}
+
+    def terminator():
+        # wait until both workers have REGISTERED (the signal handler
+        # installs at run_worker entry; a TERM during the slow jax import
+        # would hit the default handler and exit -15)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                _, status = request(("127.0.0.1", port), ("status",))
+                if len(status.workers) >= 2:
+                    break
+            except (ConnectionError, OSError):
+                pass
+            time.sleep(0.2)
+        time.sleep(0.5)  # let a generation batch get going
+        workers[0].send_signal(signal.SIGTERM)
+        terminated["at"] = time.time()
+
+    th = threading.Thread(target=terminator)
+    try:
+        abc = _abc(sampler, delay_s=0.01, pop=60)
+        abc.new("sqlite://", {"x": X_OBS})
+        th.start()
+        h = abc.run(max_nr_populations=2)
+        assert h.n_populations == 2
+        assert "at" in terminated
+        assert workers[0].wait(timeout=30) == 0, "graceful exit code"
+        kind, status = request(("127.0.0.1", port), ("status",))
+        assert kind == "status"
+        assert len(status.workers) == 1, (
+            f"terminated worker must deregister: {status.workers}"
+        )
+    finally:
+        th.join()
+        for p in workers:
+            p.kill()
+
+
+def test_static_scheduling_posterior():
+    """scheduling='static' (fixed acceptance quotas, the reference
+    RedisStaticSampler variant) must recover the same conjugate posterior
+    as the dynamic mode / MappingSampler."""
+    s = pt.ElasticSampler(host="127.0.0.1", port=0, batch=5,
+                          generation_timeout=240.0, scheduling="static")
+    port = s.address[1]
+    workers = [_spawn_worker(port) for _ in range(2)]
+    try:
+        abc = _abc(s, pop=80)
+        abc.new("sqlite://", {"x": X_OBS})
+        h = abc.run(max_nr_populations=3)
+        assert h.n_populations == 3
+        df, w = h.get_distribution(0, h.max_t)
+        mu = float(np.sum(df["theta"] * w))
+        assert mu == pytest.approx(0.8, abs=0.35)
+        # exactly n accepted particles delivered, one per quota unit
+        assert len(df) == 80
+    finally:
+        for p in workers:
+            p.kill()
+        s.stop()
