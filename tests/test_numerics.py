@@ -157,3 +157,84 @@ def test_mixture_logpdf_stable_far_from_origin():
         1e-300,
     ))
     np.testing.assert_allclose(np.asarray(dev), host, rtol=2e-3, atol=5e-2)
+
+
+def test_kernel_logdensity_f32_vs_f64_at_tiny_scales():
+    """Stochastic-kernel log-density SUMS at tiny kernel scales (the
+    T -> 1 regime of Daly/Ess schedules): the f32 device twin must match
+    an f64 oracle both absolutely and — what acceptance actually consumes
+    — in the DIFFERENCES between candidates (SURVEY §7.3.5 silent-bias
+    risk)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    S = 25
+    var = np.full(S, (1e-3) ** 2)  # sd 1e-3 per statistic
+    x0 = rng.normal(0.0, 1.0, S)
+    # candidates spread from "right on top of x0" to a few kernel sds off
+    xs = x0[None, :] + rng.normal(0.0, 3e-3, (64, S))
+
+    kern = pt.IndependentNormalKernel(var=var)
+    kern.initialize(0, x_0={str(i): x0[i] for i in range(S)})
+    fn = kern.device_fn(kern.spec)
+    params = jnp.asarray(kern.device_params(0), jnp.float32)
+    dev = np.asarray([
+        float(fn(jnp.asarray(x, jnp.float32),
+                 jnp.asarray(x0, jnp.float32), params))
+        for x in xs
+    ])
+
+    d64 = (xs - x0[None, :]).astype(np.float64)
+    oracle = -0.5 * np.sum(
+        np.log(2 * np.pi * var)[None, :] + d64 * d64 / var[None, :], axis=1
+    )
+    # magnitudes run to O(100s); absolute agreement to ~1e-3 of that
+    np.testing.assert_allclose(dev, oracle, rtol=1e-5, atol=5e-3)
+    # pairwise differences (what exp((v - c)/T) consumes) stay faithful
+    dd = dev - dev[0]
+    oo = oracle - oracle[0]
+    np.testing.assert_allclose(dd, oo, rtol=1e-4, atol=1e-2)
+
+
+def test_fused_noisy_daly_to_t1_tiny_kernel_matches_analytic():
+    """Daly schedule annealed ALL the way to T=1 with a tiny noise kernel
+    (sd 0.02 on a unit prior): at T=1 stochastic ABC targets the exact
+    conjugate posterior, so any f32 bias in the in-kernel log-density /
+    pdf-norm / temperature recursion shows up as a shifted or inflated
+    posterior."""
+    from pyabc_tpu.epsilon.temperature import DalyScheme
+
+    kernel_sd = 0.02
+    prior_sd = 1.0
+    x_obs = 0.8
+
+    @pt.JaxModel.from_function(["theta"], name="det")
+    def model(key, theta):
+        return {"x": theta[0]}
+
+    prior = pt.Distribution(theta=pt.RV("norm", 0.0, prior_sd))
+    abc = pt.ABCSMC(
+        model, prior,
+        pt.IndependentNormalKernel(var=[kernel_sd**2]),
+        population_size=300,
+        eps=pt.Temperature(schemes=[DalyScheme()]),
+        acceptor=pt.StochasticAcceptor(),
+        seed=29, fused_generations=4,
+    )
+    abc.new("sqlite://", {"x": x_obs})
+    h = abc.run(max_nr_populations=18)
+    # the schedule must actually REACH the exact-posterior temperature
+    final_T = h.get_all_populations().query("t >= 0")["epsilon"].iloc[-1]
+    assert final_T == pytest.approx(1.0, abs=1e-6)
+    post_var = 1.0 / (1 / prior_sd**2 + 1 / kernel_sd**2)
+    post_mu = post_var * x_obs / kernel_sd**2
+    df, w = h.get_distribution(0, h.max_t)
+    w = np.asarray(w, np.float64)
+    assert np.isfinite(w).all() and (w >= 0).all()
+    mu = float(np.sum(df["theta"] * w))
+    sd = float(np.sqrt(np.sum(w * (df["theta"] - mu) ** 2)))
+    assert mu == pytest.approx(post_mu, abs=0.012)
+    assert sd == pytest.approx(np.sqrt(post_var), rel=0.35)
+    # weights must not have collapsed to a handful of particles
+    ess = 1.0 / np.sum(w**2)
+    assert ess > 30
