@@ -839,11 +839,19 @@ class ABCSMC:
         tr = self.transitions[0]
         if type(tr) is LocalTransition:
             # local-covariance KDE refits on device (dense pairwise +
-            # top_k); k is static only when every generation accepts
-            # exactly the (constant) population size of ONE model
-            if self.K != 1 or not isinstance(self.population_strategy,
-                                             ConstantPopulationSize):
+            # top_k) with the host _effective_k rule applied IN-KERNEL to
+            # each model's dynamic accepted count — K>1 rides too. The
+            # static top_k bound comes from the schedule's max n.
+            if not isinstance(self.population_strategy,
+                              (ConstantPopulationSize, ListPopulationSize)):
                 return False
+            for other in self.transitions:
+                # per-model refits share ONE traced device_fit config
+                if (type(other) is not LocalTransition
+                        or other.scaling != tr.scaling
+                        or other.k != tr.k
+                        or other.k_fraction != tr.k_fraction):
+                    return False
         elif type(tr) is MultivariateNormalTransition:
             for other in self.transitions:
                 # per-model refits share ONE traced device_fit configuration
@@ -857,15 +865,26 @@ class ABCSMC:
                 return False
         elif type(tr) is GridSearchCV:
             # in-kernel cross-validated bandwidth selection over the MVN
-            # scaling grid (the reference's canonical GridSearchCV use)
-            if self.K != 1:
-                return False
+            # scaling grid (the reference's canonical GridSearchCV use).
+            # K>1: per-model masked weights restrict each fit/score to one
+            # model's rows; fold membership is row-indexed over the whole
+            # population (declared deviation: the host shuffles folds
+            # within each model's own rows — same statistics, different
+            # fold pattern)
             if not isinstance(self.population_strategy,
                               ConstantPopulationSize):
                 # the in-kernel fold assignment is host-static over the
                 # population size; a varying schedule could shrink below
                 # cv mid-chunk and diverge from host fold semantics
                 return False
+            if self.K != 1:
+                for other in self.transitions:
+                    if (type(other) is not GridSearchCV
+                            or other.param_grid != tr.param_grid
+                            or other.cv != tr.cv
+                            or type(other.estimator)
+                            is not MultivariateNormalTransition):
+                        return False
             if set(tr.param_grid) != {"scaling"} \
                     or not tr.param_grid["scaling"] \
                     or any(s <= 0 for s in tr.param_grid["scaling"]):
@@ -1048,17 +1067,26 @@ class ABCSMC:
     def _transition_fit_statics(self, n: int) -> tuple:
         """Per-model static kwargs for the in-kernel ``device_fit`` refits.
 
-        MVN: (scaling, bandwidth_selector). LocalTransition: (scaling, k) —
-        k from the host ``_effective_k`` rule at the constant population
-        size, which is exactly what the host path would use every
-        generation under ConstantPopulationSize.
+        MVN: (scaling, bandwidth_selector). LocalTransition:
+        (scaling, k_cap, k_fixed, k_fraction) — k_cap is the static top_k
+        bound (the host ``_effective_k`` rule at the schedule's maximum
+        population size); the per-model/per-generation k itself is derived
+        IN-KERNEL from each model's accepted count. GridSearchCV:
+        (scalings, cv, bandwidth_selector, n) with row-indexed folds over
+        the constant population size.
         """
         out = []
         for m, tr in enumerate(self.transitions):
             dim = self.parameter_priors[m].space.dim
             if type(tr) is LocalTransition:
-                out.append((("scaling", tr.scaling),
-                            ("k", tr._effective_k(n, dim))))
+                out.append((
+                    ("scaling", tr.scaling),
+                    # static top_k bound = the rule at the full population;
+                    # the per-model dynamic k is computed in-kernel
+                    ("k_cap", tr._effective_k(n, dim)),
+                    ("k_fixed", int(tr.k) if tr.k is not None else -1),
+                    ("k_fraction", tr.k_fraction),
+                ))
             elif type(tr) is GridSearchCV:
                 out.append((
                     ("scalings", tuple(
@@ -1243,7 +1271,7 @@ class ABCSMC:
             alpha=getattr(self.eps, "alpha", 0.5),
             multiplier=getattr(self.eps, "quantile_multiplier", 1.0),
             trans_cls=type(tr),
-            fit_statics=self._transition_fit_statics(n),
+            fit_statics=self._transition_fit_statics(n_max),
             dims=tuple(p.space.dim for p in self.parameter_priors),
             stochastic=stochastic,
             temp_config=self._temp_config() if stochastic else None,

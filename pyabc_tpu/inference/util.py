@@ -896,25 +896,48 @@ class DeviceContext:
                 counts = jnp.stack([
                     (k_mask & (m_arr == m)).sum() for m in range(K)
                 ])
-                # host rule: a transition fits from ANY non-empty particle
-                # set (store_fit_params only rejects zero particles; the
-                # single-particle degenerate covariance is guarded inside
-                # device_fit like smart_cov) — a stricter mask here would
-                # make model survival depend on chunk boundaries
-                fitted_next = counts > 0
-                log_model_probs_next = jnp.where(
-                    model_probs_next > 0,
-                    jnp.log(jnp.maximum(model_probs_next, 1e-38)), -jnp.inf,
+                # host rule: MVN-style transitions fit from ANY non-empty
+                # particle set (store_fit_params only rejects zero
+                # particles; the single-particle degenerate covariance is
+                # guarded inside device_fit like smart_cov) — a stricter
+                # mask here would make model survival depend on chunk
+                # boundaries. Transitions with a declared refit minimum
+                # (LocalTransition: dim+1, where the host fit raises
+                # NotEnoughParticles and the orchestrator reuses the
+                # previous fit) carry the OLD params forward instead.
+                min_count_of = getattr(
+                    trans_cls, "device_refit_min_count", None
                 )
                 # per-class static fit config (scaling + bandwidth selector
-                # for MVN; scaling + neighbor count k for LocalTransition)
-                trans_next = tuple(
-                    trans_cls.device_fit(
+                # for MVN; scaling + the k_cap/k_fixed/k_fraction neighbor
+                # rule for LocalTransition; the scaling grid + fold spec
+                # for GridSearchCV)
+                trans_next = []
+                refit_ok = []
+                for m in range(K):
+                    fit_m = trans_cls.device_fit(
                         res["theta"],
                         jnp.where(m_arr == m, w_norm, 0.0),
                         dim=dims[m], **dict(fit_statics[m]),
                     )
-                    for m in range(K)
+                    if min_count_of is not None:
+                        ok = counts[m] >= min_count_of(dims[m])
+                        fit_m = jax.tree.map(
+                            lambda new, old: jnp.where(ok, new, old),
+                            fit_m, trans_params[m],
+                        )
+                    else:
+                        ok = counts[m] > 0
+                    refit_ok.append(ok)
+                    trans_next.append(fit_m)
+                trans_next = tuple(trans_next)
+                # a model below its refit minimum keeps proposing from the
+                # stale fit IF it ever had one (host semantics); a model
+                # that was never fitted stays masked out
+                fitted_next = jnp.stack(refit_ok) | (fitted & (counts > 0))
+                log_model_probs_next = jnp.where(
+                    model_probs_next > 0,
+                    jnp.log(jnp.maximum(model_probs_next, 1e-38)), -jnp.inf,
                 )
                 acc_rate = n_acc / jnp.maximum(n_valid, 1)
 

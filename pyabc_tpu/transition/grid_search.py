@@ -141,6 +141,13 @@ class GridSearchCV(Transition):
             )
             q = thetas[test_idx]
             qw = weights[test_idx]
+            # host parity (grid_search.py fit): a fold whose train split
+            # holds < 2 of this model's rows, or whose test split holds
+            # none, is SKIPPED — critical for per-model masked weights in
+            # multimodel fused runs, where a small model's rows may all
+            # land in one row-indexed fold and the zero-weight fit would
+            # otherwise score garbage
+            fold_ok = ((train_w > 0).sum() >= 2) & ((qw > 0).sum() >= 1)
             diff = q[:, None, :] - fit_f["thetas"][None, :, :]
             maha = jnp.einsum("qnd,de,qne->qn", diff, fit_f["prec"], diff)
             for i in range(len(scalings)):
@@ -153,7 +160,9 @@ class GridSearchCV(Transition):
                     log_comp, b=fit_f["weights"][None, :], axis=1
                 )
                 logdens = jnp.maximum(logdens, np.log(1e-300))
-                scores = scores.at[i].add(jnp.sum(qw * logdens))
+                scores = scores.at[i].add(
+                    jnp.where(fold_ok, jnp.sum(qw * logdens), 0.0)
+                )
         s_best = s_arr[jnp.argmax(scores)]
         full = MultivariateNormalTransition.device_fit(
             thetas, weights, dim=dim, scaling=1.0,

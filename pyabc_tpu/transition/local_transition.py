@@ -35,6 +35,14 @@ class LocalTransition(Transition):
 
     EPS = 1e-3
 
+    @staticmethod
+    def device_refit_min_count(dim: int) -> int:
+        """Minimum accepted particles for an in-kernel refit to be valid —
+        below it the host :meth:`fit` raises NotEnoughParticles and the
+        orchestrator reuses the previous generation's fit; the multigen
+        kernel mirrors that by carrying the old params forward."""
+        return dim + 1
+
     def __init__(self, k: int | None = None, k_fraction: float = 0.25,
                  scaling: float = 1.0):
         self.k = k
@@ -116,7 +124,9 @@ class LocalTransition(Transition):
         }
 
     @staticmethod
-    def device_fit(thetas, weights, *, dim: int, scaling: float, k: int):
+    def device_fit(thetas, weights, *, dim: int, scaling: float,
+                   k: int | None = None, k_cap: int | None = None,
+                   k_fixed: int = -1, k_fraction: float = 0.25):
         """Traceable twin of :meth:`fit` for the fused multi-generation run.
 
         ``thetas (n_cap, d_max)`` zero-padded accepted particles,
@@ -125,24 +135,52 @@ class LocalTransition(Transition):
         path (invalid slots are excluded as neighbor CANDIDATES via an inf
         distance; their own rows get finite jittered covariances but carry
         zero weight, so they are never resampled and contribute nothing to
-        the mixture pdf). ``k`` is static: with the fused path's
-        ConstantPopulationSize every successful generation accepts exactly
-        n_cap particles, so host ``_effective_k`` is generation-invariant.
+        the mixture pdf).
+
+        The neighbor count follows the host ``_effective_k`` rule ON
+        DEVICE from the valid-row count c — ``clip(k_fixed or
+        round(k_fraction*c), dim+1, c)`` — so per-model masked refits
+        (multimodel fused chunks, where each model's accepted count varies
+        by generation) match the host's per-model k. ``k_cap`` is the
+        static top_k bound (the rule's value at the full population);
+        ``k`` forces a fixed static count (back-compat shorthand for
+        k_cap=k_fixed=k).
         """
         n_cap, d_max = thetas.shape
+        if k is not None:
+            k_cap, k_fixed = int(k), int(k)
         vmask = (jnp.arange(d_max) < dim).astype(thetas.dtype)
         outer = vmask[:, None] * vmask[None, :]
         w = weights / jnp.maximum(weights.sum(), 1e-38)
         valid = weights > 0
+        c = valid.sum()
+        # EXACT host-rule parity for every possible count: the c -> k map
+        # is precomputed in f64 numpy at trace time (an f32 product like
+        # 0.1 * 25 = 2.5000002 would round differently than the host's
+        # float64 round-half-even at representation boundaries) and
+        # embedded as an HLO literal
+        counts = np.arange(n_cap + 1)
+        base = (np.full(n_cap + 1, k_fixed) if k_fixed > 0
+                else np.round(k_fraction * counts))
+        k_table = np.clip(
+            base, dim + 1, np.maximum(counts, dim + 1)
+        ).astype(np.int32)
+        k_dyn = jnp.minimum(jnp.asarray(k_table)[c], k_cap)
         X = thetas * vmask[None, :]
         diff = X[:, None, :] - X[None, :, :]
         sq = (diff * diff).sum(-1)
         sq = jnp.where(valid[None, :], sq, jnp.inf)
-        _, nn_idx = jax.lax.top_k(-sq, k)  # k smallest distances, self incl.
-        neigh = X[nn_idx]  # (n_cap, k, d_max)
-        centered = neigh - X[:, None, :]
-        cov = jnp.einsum("nkd,nke->nde", centered, centered) / k
-        factor = silverman_rule_of_thumb(k, dim) * scaling
+        _, nn_idx = jax.lax.top_k(-sq, k_cap)  # k_cap smallest, self incl.
+        # dynamic-k mask: positions beyond k_dyn and invalid candidates
+        # (possible when a model's count is below k_cap) contribute nothing
+        pos_ok = (jnp.arange(k_cap)[None, :] < k_dyn) & valid[nn_idx]
+        neigh = X[nn_idx]  # (n_cap, k_cap, d_max)
+        centered = (neigh - X[:, None, :]) * pos_ok[..., None]
+        cov = jnp.einsum("nkd,nke->nde", centered, centered) \
+            / jnp.maximum(k_dyn, 1)
+        factor = silverman_rule_of_thumb(
+            k_dyn.astype(thetas.dtype), dim
+        ) * scaling
         cov = cov * factor**2
         # host regularization: relative jitter on the REAL diagonal; padded
         # dims get a unit diagonal so the factorization is well-posed (they

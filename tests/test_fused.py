@@ -559,3 +559,103 @@ def test_fetch_pipeline_depths_complete_all_generations():
         )
     np.testing.assert_allclose(eps_by_depth[1], eps_by_depth[2])
     np.testing.assert_allclose(eps_by_depth[1], eps_by_depth[3])
+
+
+def test_fused_multimodel_local_transition():
+    """K=2 LocalTransition through the fused chunk loop: the host
+    _effective_k rule runs IN-KERNEL against each model's dynamic
+    accepted count, so per-model masked kNN refits ride chunks. Model
+    posterior must match the analytic marginal-likelihood ratio and the
+    per-generation loop."""
+    from pyabc_tpu.models import model_selection as msel
+
+    models, priors, analytic = msel.tractable_pair()
+    x_obs = 0.7
+
+    def run(fused):
+        abc = pt.ABCSMC(
+            models, priors, pt.PNormDistance(p=2),
+            population_size=500, eps=pt.MedianEpsilon(), seed=8,
+            fused_generations=4 if fused else 1,
+            transitions=[pt.LocalTransition(), pt.LocalTransition()],
+        )
+        if fused:
+            assert abc._fused_chunk_capable()
+        abc.new("sqlite://", {"x": x_obs})
+        return abc.run(max_nr_populations=5)
+
+    h_f, h_p = run(True), run(False)
+    assert h_f.get_telemetry(3).get("fused_chunk"), "fused path not taken"
+    truth = analytic(x_obs)
+    pf = h_f.get_model_probabilities(h_f.max_t)["p"]
+    pp = h_p.get_model_probabilities(h_p.max_t)["p"]
+    assert float(pf.get(0, 0.0)) == pytest.approx(truth[0], abs=0.15)
+    assert float(pf.get(0, 0.0)) == pytest.approx(
+        float(pp.get(0, 0.0)), abs=0.15
+    )
+    eps_f = h_f.get_all_populations().query("t >= 0")["epsilon"].to_numpy()
+    eps_p = h_p.get_all_populations().query("t >= 0")["epsilon"].to_numpy()
+    np.testing.assert_allclose(eps_f, eps_p, rtol=0.25)
+
+
+def test_fused_multimodel_gridsearchcv():
+    """K=2 GridSearchCV (per-model in-kernel CV bandwidth selection over
+    row-indexed folds — declared deviation from the host's per-model
+    shuffled folds) through the fused chunk loop."""
+    from pyabc_tpu.models import model_selection as msel
+
+    models, priors, analytic = msel.tractable_pair()
+    x_obs = 0.7
+
+    def make_tr():
+        return pt.GridSearchCV(pt.MultivariateNormalTransition(),
+                               {"scaling": [0.5, 1.0, 2.0]}, cv=4)
+
+    abc = pt.ABCSMC(
+        models, priors, pt.PNormDistance(p=2),
+        population_size=500, eps=pt.MedianEpsilon(), seed=15,
+        fused_generations=4, transitions=[make_tr(), make_tr()],
+    )
+    assert abc._fused_chunk_capable()
+    abc.new("sqlite://", {"x": x_obs})
+    h = abc.run(max_nr_populations=5)
+    assert h.get_telemetry(3).get("fused_chunk"), "fused path not taken"
+    truth = analytic(x_obs)
+    probs = h.get_model_probabilities(h.max_t)["p"]
+    assert float(probs.get(0, 0.0)) == pytest.approx(truth[0], abs=0.15)
+    # posterior of the winning model still matches the conjugate truth
+    df, w = h.get_distribution(0, h.max_t)
+    post_var = 1.0 / (1 / 1.0**2 + 1 / 0.6**2)
+    mu = float(np.sum(df["theta"] * w))
+    assert mu == pytest.approx(post_var * x_obs / 0.6**2, abs=0.3)
+
+
+def test_local_device_fit_dynamic_k_matches_masked_host():
+    """Per-model masked refit: on lanes where only SOME rows belong to the
+    model (zero weights elsewhere), the in-kernel dynamic-k rule must
+    reproduce the host fit of just that model's rows."""
+    import jax.numpy as jnp
+    import pandas as pd
+
+    rng = np.random.default_rng(2)
+    n_cap, d = 64, 2
+    thetas = rng.normal(size=(n_cap, d)).astype(np.float32)
+    # model owns 20 scattered rows
+    own = np.zeros(n_cap, bool)
+    own[rng.choice(n_cap, 20, replace=False)] = True
+    w = np.where(own, 1.0 / 20, 0.0).astype(np.float32)
+
+    tr = pt.LocalTransition()
+    host_X = pd.DataFrame(thetas[own], columns=["a", "b"])
+    tr.fit(host_X, np.full(20, 1.0 / 20))
+    k_host = tr._effective_k(20, d)
+
+    dev = pt.LocalTransition.device_fit(
+        jnp.asarray(thetas), jnp.asarray(w), dim=d, scaling=1.0,
+        k_cap=32, k_fixed=-1, k_fraction=tr.k_fraction,
+    )
+    # the dynamic k equals the host rule at c=20 (indirectly: per-row
+    # covariances of the model's rows match the host's per-row fit)
+    chols_dev = np.asarray(dev["chols"])[own]
+    np.testing.assert_allclose(chols_dev, tr._chols, rtol=2e-3, atol=2e-4)
+    assert k_host == int(np.clip(round(tr.k_fraction * 20), d + 1, 20))
