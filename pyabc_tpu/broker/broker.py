@@ -90,9 +90,17 @@ class EvalBroker:
         self._wait_for_all = False
         self._mode = "dynamic"
         self._draining = False
+        self._collect_only = False
         self._results: list[tuple[int, bytes, bool]] = []
         self._done = True
         self._done_event = threading.Event()
+        #: look-ahead: a pre-published NEXT generation, auto-started the
+        #: moment the current one finalizes (reference redis look-ahead:
+        #: SSA(t+1) is on the broker BEFORE t ends, so workers roll into
+        #: t+1 with zero idle while the orchestrator persists/adapts)
+        self._pending_next: tuple | None = None
+        self._last_gen = 0
+        self._last_results: list[tuple[int, bytes, bool]] = []
         self._workers: dict[str, dict] = {}
         self._server = _Server((host, port), _Handler)
         self._server.broker = self  # type: ignore[attr-defined]
@@ -128,35 +136,99 @@ class EvalBroker:
         if mode not in ("dynamic", "static"):
             raise ValueError(f"unknown scheduling mode {mode!r}")
         with self._lock:
-            self._gen += 1
-            self._t = t
-            self._payload = payload
-            self._n_target = int(n_target)
-            self._max_eval = max_eval
-            self._all_accepted = all_accepted
-            self._batch = max(int(batch), 1)
-            self._wait_for_all = bool(wait_for_all)
-            self._mode = mode
-            self._next_slot = 0
-            self._n_acc = 0
-            self._n_delivered = 0
-            self._draining = False
-            self._results = []
-            self._done = False
-            self._done_event.clear()
+            self._advance_locked(t, payload, int(n_target), max_eval,
+                                 bool(all_accepted), max(int(batch), 1),
+                                 bool(wait_for_all), mode, False)
+
+    def _advance_locked(self, t, payload, n_target, max_eval, all_accepted,
+                        batch, wait_for_all, mode, collect_only) -> None:
+        self._gen += 1
+        self._t = t
+        self._payload = payload
+        self._n_target = n_target
+        self._max_eval = max_eval
+        self._all_accepted = all_accepted
+        self._batch = batch
+        self._wait_for_all = wait_for_all
+        self._mode = mode
+        self._collect_only = collect_only
+        self._next_slot = 0
+        self._n_acc = 0
+        self._n_delivered = 0
+        self._draining = False
+        self._results = []
+        self._done = False
+        self._done_event.clear()
+
+    def pre_publish(self, t: int, payload: bytes, n_target: int, *,
+                    batch: int = 1,
+                    max_eval: float = float("inf")) -> None:
+        """Queue the NEXT generation's closure; it auto-starts in
+        COLLECT-ONLY mode the instant the current generation finalizes
+        (look-ahead: completion is then driven by the sampler's host-side
+        delayed acceptance via results_snapshot/finish_generation, since
+        workers cannot test acceptance against a not-yet-known epsilon)."""
+        with self._lock:
+            self._pending_next = (
+                t, payload, int(n_target), max_eval, max(int(batch), 1),
+            )
+            if self._done:
+                t_, p_, n_, me_, b_ = self._pending_next
+                self._pending_next = None
+                self._advance_locked(t_, p_, n_, me_, False, b_, False,
+                                     "dynamic", True)
+
+    def cancel_pre_published(self) -> None:
+        """Drop a queued (not yet started) look-ahead generation."""
+        with self._lock:
+            self._pending_next = None
+
+    def results_snapshot(self) -> tuple[list[tuple[int, bytes, bool]],
+                                        bool, int]:
+        """(results-so-far, done, generation id) without blocking."""
+        with self._lock:
+            return list(self._results), self._done, self._gen
+
+    def finish_generation(self) -> None:
+        """Finalize the active generation (look-ahead delayed acceptance:
+        the SAMPLER decides completion from unpickled distances)."""
+        with self._lock:
+            if not self._done:
+                self._finish_locked()
+
+    def last_results(self, gen: int):
+        """The finished results of generation ``gen``, or None if another
+        generation finished since (the finished buffer holds one entry —
+        enough for the look-ahead auto-advance handoff)."""
+        with self._lock:
+            if self._last_gen == gen:
+                return list(self._last_results)
+            return None
 
     def wait(self, poll_s: float = 0.05, timeout: float | None = None
              ) -> list[tuple[int, bytes, bool]]:
         """Block until the generation completes; returns (slot,
-        particle_bytes, accepted) triples of every delivered result."""
+        particle_bytes, accepted) triples of every delivered result.
+        Generation-stamped: if a pre-published look-ahead generation
+        auto-started meanwhile, the FINISHED generation's results are
+        returned from the last-finished buffer."""
         deadline = time.time() + timeout if timeout else None
-        while not self._done_event.wait(poll_s):
+        with self._lock:
+            gen0 = self._gen
+            if self._done and gen0 == self._last_gen:
+                return list(self._last_results)
+        while True:
+            with self._lock:
+                if self._gen != gen0:
+                    return (list(self._last_results)
+                            if self._last_gen == gen0 else [])
+                if self._done:
+                    return list(self._results)
+            time.sleep(poll_s)
             if deadline and time.time() > deadline:
                 raise TimeoutError(
                     f"generation incomplete: {self.status()}"
                 )
-        with self._lock:
-            return list(self._results)
 
     def status(self) -> BrokerStatus:
         with self._lock:
@@ -237,6 +309,10 @@ class EvalBroker:
                     sum(1 for *_x, acc in triples if acc)
                     if self._mode == "static" else len(triples)
                 )
+                if self._collect_only:
+                    # look-ahead generation: completion is the sampler's
+                    # call (delayed acceptance against the final epsilon)
+                    return ("ok",)
                 if self._mode == "static" \
                         and len(self._results) >= self._max_eval:
                     # static eval budget: every static evaluation ships a
@@ -284,4 +360,14 @@ class EvalBroker:
 
     def _finish_locked(self) -> None:
         self._done = True
+        self._last_gen = self._gen
+        self._last_results = list(self._results)
         self._done_event.set()
+        if self._pending_next is not None:
+            # look-ahead auto-advance: workers roll straight into the
+            # pre-published next generation (collect-only — the sampler
+            # applies delayed acceptance host-side)
+            t, payload, n_target, max_eval, batch = self._pending_next
+            self._pending_next = None
+            self._advance_locked(t, payload, n_target, max_eval, False,
+                                 batch, False, "dynamic", True)

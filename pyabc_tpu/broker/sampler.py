@@ -37,13 +37,26 @@ class ElasticSampler(Sampler):
                  batch: int = 10,
                  generation_timeout: float | None = None,
                  wait_for_all_samples: bool = False,
-                 scheduling: str = "dynamic"):
+                 scheduling: str = "dynamic",
+                 look_ahead: bool = False,
+                 look_ahead_frac: float = 0.5):
         """``wait_for_all_samples``: gather every in-flight evaluation
         before finalizing a generation (adaptive components then see an
         unbiased, complete record set — reference ``wait_for_all_samples``).
         ``scheduling``: 'dynamic' (evaluation-parallel slot handout,
         reference RedisEvalParallelSampler) or 'static' (fixed acceptance
-        quotas per handed-out unit, reference RedisStaticSampler)."""
+        quotas per handed-out unit, reference RedisStaticSampler).
+        ``look_ahead``: mid-generation cross-generation pipelining
+        (reference look_ahead_delay_evaluation): once ``look_ahead_frac``
+        of generation t's target is accepted, a PRELIMINARY t+1 proposal
+        (built by the orchestrator from accepted-so-far particles) is
+        pre-published; workers roll into it the instant t finalizes —
+        zero idle during the orchestrator's persist/adapt — and t+1's
+        delayed acceptance/weights are applied host-side against the
+        final epsilon, with importance weights taken wrt the preliminary
+        proposal actually used (no bias). The orchestrator enables this
+        only for generation-invariant distances and plain uniform
+        acceptance (ABCSMC._look_ahead_capable)."""
         super().__init__()
         self.batch = int(batch)
         self.generation_timeout = generation_timeout
@@ -51,6 +64,19 @@ class ElasticSampler(Sampler):
         if scheduling not in ("dynamic", "static"):
             raise ValueError(f"unknown scheduling {scheduling!r}")
         self.scheduling = scheduling
+        self.look_ahead = bool(look_ahead)
+        self.look_ahead_frac = float(look_ahead_frac)
+        #: set by the orchestrator when the config is look-ahead-safe:
+        #: fn(t_next, accepted_particles) -> pickled preliminary closure
+        self.lookahead_builder = None
+        #: set by the orchestrator each generation: fn(particle) -> bool
+        #: (delayed acceptance against the now-known epsilon)
+        self.lookahead_accept = None
+        #: generation index served by the pre-published broker generation
+        self._lookahead_t: int | None = None
+        #: telemetry: per-generation head start (results already delivered
+        #: when the orchestrator arrived) and adopted-generation count
+        self.lookahead_head_starts: list[int] = []
         self.broker = EvalBroker(host, port)
 
     @property
@@ -60,24 +86,44 @@ class ElasticSampler(Sampler):
     def sample_until_n_accepted(self, n, simulate_one, t, *,
                                 max_eval=np.inf, all_accepted=False,
                                 ana_vars=None) -> Sample:
-        if hasattr(simulate_one, "host_simulate_one"):
-            simulate_one = simulate_one.host_simulate_one
-        payload = _closure_pickle.dumps(simulate_one)
-        self.broker.start_generation(
-            t if t is not None else -1, payload, n, max_eval=max_eval,
-            all_accepted=all_accepted, batch=self.batch,
-            wait_for_all=self.wait_for_all_samples,
-            mode=self.scheduling,
+        adopt = (
+            self.look_ahead and self._lookahead_t == t
+            and self.lookahead_accept is not None
         )
-        triples = self.broker.wait(timeout=self.generation_timeout)
+        self._lookahead_t = None
+        if not adopt:
+            self.broker.cancel_pre_published()
+            if hasattr(simulate_one, "host_simulate_one"):
+                simulate_one = simulate_one.host_simulate_one
+            payload = _closure_pickle.dumps(simulate_one)
+            self.broker.start_generation(
+                t if t is not None else -1, payload, n, max_eval=max_eval,
+                all_accepted=all_accepted, batch=self.batch,
+                wait_for_all=self.wait_for_all_samples,
+                mode=self.scheduling,
+            )
+        accept_fn = self.lookahead_accept if adopt else None
+        triples = self._collect(n, t, max_eval, all_accepted, accept_fn,
+                                head_start=adopt)
 
         sample = self.sample_factory()
         accepted, accepted_ids, records = [], [], []
         for slot, blob, acc in sorted(triples, key=lambda x: x[0]):
             particle = pickle.loads(blob)
+            if accept_fn is not None:
+                # delayed acceptance: look-ahead particles were produced
+                # without an accept test (epsilon unknown at simulation
+                # time); the weight already reflects the preliminary
+                # proposal actually used
+                acc = bool(accept_fn(particle))
+                particle.accepted = acc
+                particle.preliminary = False
+                if not acc:
+                    particle.weight = 0.0
             if sample.record_rejected:
                 records.append(particle)
-            if acc or all_accepted or particle.accepted:
+            if acc or all_accepted or (accept_fn is None
+                                       and particle.accepted):
                 accepted.append(particle)
                 accepted_ids.append(slot)
         self.nr_evaluations_ = len(triples)
@@ -90,5 +136,94 @@ class ElasticSampler(Sampler):
             sample.host_all_records = HostRecords.from_particles(records)
         return sample
 
+    def _collect(self, n, t, max_eval, all_accepted, accept_fn, *,
+                 head_start: bool) -> list:
+        """Poll the broker until generation completion, applying delayed
+        acceptance (look-ahead adoption) and/or pre-publishing the NEXT
+        generation's preliminary closure once enough of this one is in.
+        Generation-stamped throughout: a pre-published next generation
+        auto-starts the instant this one finalizes, so completion may
+        surface as a generation-id change rather than a done flag."""
+        import time as _time
+
+        deadline = (_time.time() + self.generation_timeout
+                    if self.generation_timeout else None)
+        cache: dict[int, object] = {}  # slot -> unpickled particle
+        prepublished = False
+        gen0 = None
+        while True:
+            triples, done, gen_now = self.broker.results_snapshot()
+            if gen0 is None:
+                gen0 = gen_now
+                if head_start:
+                    # overlap evidence: work already HANDED OUT (workers
+                    # pull slots within ~ms of the auto-advance) by the
+                    # time the orchestrator finished persist/adapt
+                    self.lookahead_head_starts.append(max(
+                        len(triples), self.broker.status().n_eval_handed
+                    ))
+            if gen_now != gen0:
+                # finished and auto-advanced to the pre-published next gen
+                last = self.broker.last_results(gen0)
+                return last if last is not None else []
+            accepted_parts = []
+            need_particles = accept_fn is not None or (
+                self.look_ahead and not prepublished
+                and self.lookahead_builder is not None
+            )
+            if need_particles:
+                for slot, blob, acc in triples:
+                    if slot not in cache:
+                        cache[slot] = pickle.loads(blob)
+                    p = cache[slot]
+                    ok = (bool(accept_fn(p)) if accept_fn is not None
+                          else bool(acc))
+                    if ok:
+                        accepted_parts.append(p)
+                n_acc = len(accepted_parts)
+            else:
+                n_acc = sum(1 for *_x, acc in triples if acc)
+            if (self.look_ahead and not prepublished
+                    and self.lookahead_builder is not None
+                    and n_acc >= self.look_ahead_frac * n):
+                payload_next = self.lookahead_builder(
+                    t + 1, list(accepted_parts)
+                )
+                if payload_next is not None:
+                    self.broker.pre_publish(
+                        t + 1, payload_next, n, batch=self.batch,
+                        max_eval=max_eval,
+                    )
+                    self._lookahead_t = t + 1
+                prepublished = True  # one attempt per generation
+            if accept_fn is not None and not done \
+                    and (n_acc >= n or len(triples) >= max_eval):
+                # delayed-acceptance completion is the sampler's call
+                self.broker.finish_generation()
+                last = self.broker.last_results(gen0)
+                return last if last is not None else triples
+            if done:
+                return triples
+            _time.sleep(0.02)
+            if deadline and _time.time() > deadline:
+                raise TimeoutError(
+                    f"generation incomplete: {self.broker.status()}"
+                )
+
+    def cancel_look_ahead(self) -> None:
+        """Retire any look-ahead state: drop a queued pre-publish, finalize
+        an auto-started collect-only generation (workers would otherwise
+        simulate the unused preliminary proposal FOREVER — collect-only
+        generations have no self-completion), and clear the orchestrator
+        hooks so a later, differently-configured run cannot adopt a stale
+        proposal with an outdated epsilon."""
+        self.broker.cancel_pre_published()
+        if self._lookahead_t is not None:
+            self.broker.finish_generation()
+        self._lookahead_t = None
+        self.lookahead_builder = None
+        self.lookahead_accept = None
+
     def stop(self) -> None:
+        self.cancel_look_ahead()
         self.broker.stop()

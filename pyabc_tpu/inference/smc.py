@@ -631,8 +631,25 @@ class ABCSMC:
         sims_total = self.history.total_nr_simulations
         distance_changed_at_t = getattr(
             self, "_resumed_distance_changed", False)
+        look_ahead = self._look_ahead_capable()
+        if look_ahead:
+            # mid-generation look-ahead (reference redis look_ahead /
+            # look_ahead_delay_evaluation): the sampler calls back for a
+            # PRELIMINARY t+1 closure once enough of generation t is in
+            self.sampler.lookahead_builder = self._build_lookahead_payload
+        elif hasattr(self.sampler, "cancel_look_ahead"):
+            # a previous run on this sampler may have left a pre-published
+            # proposal / stale acceptance hook; this run's config is not
+            # look-ahead-capable, so it must not adopt them
+            self.sampler.cancel_look_ahead()
         while True:
             current_eps = self.eps(t)
+            if look_ahead:
+                # delayed acceptance for an adopted look-ahead generation:
+                # test the recorded distances against the NOW-known eps
+                self.sampler.lookahead_accept = (
+                    lambda p, _e=float(current_eps): p.distance <= _e
+                )
             if hasattr(self.acceptor, "note_epsilon"):
                 # complete-history acceptance needs the threshold trail
                 self.acceptor.note_epsilon(t, current_eps,
@@ -694,6 +711,11 @@ class ABCSMC:
                                 start_walltime):
                 break
             t += 1
+        if look_ahead:
+            # retire any pre-published next generation: collect-only
+            # look-ahead generations have no self-completion, so workers
+            # would simulate the unused proposal until the broker dies
+            self.sampler.cancel_look_ahead()
         self.history.done()
         return self.history
 
@@ -1744,6 +1766,104 @@ class ABCSMC:
                 t += 1
         return (stop, last_pop, last_sample, last_eps, last_acc_rate, t,
                 sims_total)
+
+    # --------------------------------------------- broker look-ahead path
+    def _look_ahead_capable(self) -> bool:
+        """Mid-generation look-ahead for the broker path (SURVEY §3.3:
+        reference ``look_ahead_delay_evaluation``): gen t+1's proposal is
+        built from PRELIMINARY gen-t particles while t still runs, and
+        t+1's acceptance/weights are applied on the host once the final
+        epsilon is known. Sound when the recorded distance is invariant
+        across generations (plain p-norm, no reweighting/sumstats) and
+        acceptance is the plain uniform d <= eps test — the particle's
+        importance weight then only depends on the proposal it was
+        actually drawn from, which the preliminary closure records."""
+        from ..broker.sampler import ElasticSampler
+
+        if not (isinstance(self.sampler, ElasticSampler)
+                and self.sampler.look_ahead):
+            return False
+        if self.sampler.scheduling != "dynamic" \
+                or self.sampler.wait_for_all_samples:
+            # adopted generations run the dynamic collect-only protocol;
+            # enabling look-ahead would silently override the user's
+            # static quotas / complete-record guarantees
+            return False
+        if type(self.acceptor) is not UniformAcceptor \
+                or self.acceptor.use_complete_history:
+            return False
+        d = self.distance_function
+        if not (type(d) is PNormDistance and d.sumstat is None
+                and not any(k >= 0 for k in d.weights)):
+            return False
+        if self.sampler.sample_factory.record_rejected:
+            return False
+        return True
+
+    def _build_lookahead_payload(self, t_next: int, particles):
+        """Pickled PRELIMINARY ``simulate_one`` for generation ``t_next``,
+        fitted on generation t's accepted-so-far particles. The closure
+        simulates WITHOUT an accept test (``evaluate=False``) and weights
+        each particle against the preliminary proposal it was drawn from —
+        the sampler applies the delayed d <= eps(t_next) test on arrival.
+        Returns None when the preliminary fit fails (the generation then
+        proceeds without look-ahead)."""
+        try:
+            pop = Population.from_particles(
+                list(particles), self._spaces(), self.spec,
+                self.model_names,
+            )
+            probs_arr = pop.model_probabilities_array()
+            prelim_probs = {
+                m: float(probs_arr[m]) for m in pop.get_alive_models()
+            }
+            prelim_transitions = []
+            for m, tr in enumerate(self.transitions):
+                cp = tr.copy_unfitted()
+                if m in prelim_probs:
+                    df, w = pop.get_distribution(m)
+                    cp.fit(df, w)
+                prelim_transitions.append(cp)
+            prior_probs = self.model_prior_probs
+            K = self.K
+
+            def model_prior_rvs() -> int:
+                return int(np.random.choice(K, p=prior_probs))
+
+            def model_prior_pmf(m: int) -> float:
+                return float(prior_probs[m])
+
+            inner = create_simulate_function(
+                t_next,
+                model_probabilities=prelim_probs,
+                model_perturbation_kernel=self.model_perturbation_kernel,
+                transitions=prelim_transitions,
+                model_prior_rvs=model_prior_rvs,
+                model_prior_pmf=model_prior_pmf,
+                parameter_priors=self.parameter_priors,
+                models=self.models,
+                summary_statistics=self.summary_statistics,
+                x_0=self.x_0,
+                distance_function=self.distance_function,
+                eps=self.eps,
+                acceptor=self.acceptor,
+                evaluate=False,
+            )
+
+            def simulate_one_preliminary(_inner=inner):
+                p = _inner()
+                p.preliminary = True
+                return p
+
+            import cloudpickle
+
+            return cloudpickle.dumps(simulate_one_preliminary)
+        except Exception:
+            logger.exception(
+                "look-ahead preliminary build failed; generation %d will "
+                "run without look-ahead", t_next,
+            )
+            return None
 
     # ------------------------------------------------ speculative proposals
     def _speculation_capable(self) -> bool:
