@@ -238,3 +238,37 @@ def test_fused_noisy_daly_to_t1_tiny_kernel_matches_analytic():
     # weights must not have collapsed to a handful of particles
     ess = 1.0 / np.sum(w**2)
     assert ess > 30
+
+
+def test_local_transition_mixture_logpdf_stable_bimodal():
+    """LocalTransition's per-component mixture density must stay faithful
+    to the host f64 KDE in its TARGET regime — fine local bandwidths over
+    a widely spread / multimodal population — where a mean-centered
+    quadratic expansion (fine for the shared-covariance MVN) loses
+    ~(spread/bandwidth)^2 of f32 precision. Guards the deliberate
+    diff-form implementation."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(4)
+    n, d = 128, 2
+    modes = np.array([[500.0, 500.0], [-500.0, -500.0]])
+    which = rng.integers(0, 2, n)
+    X = pd.DataFrame(modes[which] + rng.normal(0, 0.05, (n, d)),
+                     columns=["a", "b"])
+    w = rng.uniform(0.5, 1.0, n)
+    w = w / w.sum()
+    tr = pt.LocalTransition()
+    tr.fit(X, w)
+    params = {k: jnp.asarray(v) for k, v in tr.device_params().items()}
+    # queries AT the modes: maha is O(1) there, so any catastrophic
+    # cancellation in the mixture terms shows up directly
+    qwhich = rng.integers(0, 2, 32)
+    q = (modes[qwhich] + rng.normal(0, 0.05, (32, d))).astype(np.float32)
+    dev = jax.vmap(
+        lambda th: pt.LocalTransition.device_logpdf(th, params)
+    )(jnp.asarray(q))
+    host = np.log(np.maximum(
+        np.asarray(tr.pdf(pd.DataFrame(q, columns=["a", "b"])),
+                   np.float64), 1e-300,
+    ))
+    np.testing.assert_allclose(np.asarray(dev), host, rtol=2e-3, atol=0.1)
