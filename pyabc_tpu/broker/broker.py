@@ -25,6 +25,7 @@ from __future__ import annotations
 import socketserver
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 
 from .protocol import recv_msg, send_msg
@@ -99,8 +100,16 @@ class EvalBroker:
         #: SSA(t+1) is on the broker BEFORE t ends, so workers roll into
         #: t+1 with zero idle while the orchestrator persists/adapts)
         self._pending_next: tuple | None = None
-        self._last_gen = 0
-        self._last_results: list[tuple[int, bytes, bool]] = []
+        #: finished results keyed by generation id, bounded to the last
+        #: few generations — ``wait()``/``last_results()`` must survive a
+        #: pre-published generation auto-starting AND finalizing between
+        #: two 50 ms polls (a single-entry buffer silently dropped the
+        #: awaited generation in that race)
+        self._finished: "OrderedDict[int, list]" = OrderedDict()
+        # the auto-advance race needs at most 2 (awaited gen + one
+        # pending_next that started AND finished between polls); 3 adds
+        # margin without pinning generations of pickled particles
+        self._finished_keep = 3
         self._workers: dict[str, dict] = {}
         self._server = _Server((host, port), _Handler)
         self._server.broker = self  # type: ignore[attr-defined]
@@ -197,13 +206,12 @@ class EvalBroker:
                 self._finish_locked()
 
     def last_results(self, gen: int):
-        """The finished results of generation ``gen``, or None if another
-        generation finished since (the finished buffer holds one entry —
-        enough for the look-ahead auto-advance handoff)."""
+        """The finished results of generation ``gen``, or None if that
+        generation never finished or was evicted (the finished buffer
+        retains the last few generations)."""
         with self._lock:
-            if self._last_gen == gen:
-                return list(self._last_results)
-            return None
+            res = self._finished.get(gen)
+            return list(res) if res is not None else None
 
     def wait(self, poll_s: float = 0.05, timeout: float | None = None
              ) -> list[tuple[int, bytes, bool]]:
@@ -215,13 +223,21 @@ class EvalBroker:
         deadline = time.time() + timeout if timeout else None
         with self._lock:
             gen0 = self._gen
-            if self._done and gen0 == self._last_gen:
-                return list(self._last_results)
+            if self._done and gen0 in self._finished:
+                return list(self._finished[gen0])
         while True:
             with self._lock:
                 if self._gen != gen0:
-                    return (list(self._last_results)
-                            if self._last_gen == gen0 else [])
+                    res = self._finished.get(gen0)
+                    if res is None:
+                        raise RuntimeError(
+                            f"generation {gen0} was superseded without its "
+                            f"results being available: it either never "
+                            f"finalized (start_generation replaced it) or "
+                            f"was evicted from the finished buffer (keeps "
+                            f"{self._finished_keep} generations)"
+                        )
+                    return list(res)
                 if self._done:
                     return list(self._results)
             time.sleep(poll_s)
@@ -360,8 +376,9 @@ class EvalBroker:
 
     def _finish_locked(self) -> None:
         self._done = True
-        self._last_gen = self._gen
-        self._last_results = list(self._results)
+        self._finished[self._gen] = list(self._results)
+        while len(self._finished) > self._finished_keep:
+            self._finished.popitem(last=False)
         self._done_event.set()
         if self._pending_next is not None:
             # look-ahead auto-advance: workers roll straight into the

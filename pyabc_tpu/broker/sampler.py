@@ -148,9 +148,15 @@ class ElasticSampler(Sampler):
 
         deadline = (_time.time() + self.generation_timeout
                     if self.generation_timeout else None)
-        cache: dict[int, object] = {}  # slot -> unpickled particle
         prepublished = False
         gen0 = None
+        # incremental acceptance over the broker's append-only result
+        # list: each delivered triple is unpickled/tested exactly once
+        # (rescanning the whole set per 20 ms poll was O(n) per poll —
+        # quadratic over a generation)
+        n_seen = 0
+        n_acc = 0
+        accepted_parts: list = []
         while True:
             triples, done, gen_now = self.broker.results_snapshot()
             if gen0 is None:
@@ -166,23 +172,22 @@ class ElasticSampler(Sampler):
                 # finished and auto-advanced to the pre-published next gen
                 last = self.broker.last_results(gen0)
                 return last if last is not None else []
-            accepted_parts = []
             need_particles = accept_fn is not None or (
                 self.look_ahead and not prepublished
                 and self.lookahead_builder is not None
             )
-            if need_particles:
-                for slot, blob, acc in triples:
-                    if slot not in cache:
-                        cache[slot] = pickle.loads(blob)
-                    p = cache[slot]
+            for _slot, blob, acc in triples[n_seen:]:
+                if need_particles:
+                    p = pickle.loads(blob)
                     ok = (bool(accept_fn(p)) if accept_fn is not None
                           else bool(acc))
                     if ok:
                         accepted_parts.append(p)
-                n_acc = len(accepted_parts)
-            else:
-                n_acc = sum(1 for *_x, acc in triples if acc)
+                else:
+                    ok = bool(acc)
+                if ok:
+                    n_acc += 1
+            n_seen = len(triples)
             if (self.look_ahead and not prepublished
                     and self.lookahead_builder is not None
                     and n_acc >= self.look_ahead_frac * n):

@@ -642,6 +642,28 @@ class ABCSMC:
             # proposal / stale acceptance hook; this run's config is not
             # look-ahead-capable, so it must not adopt them
             self.sampler.cancel_look_ahead()
+        try:
+            self._serial_generation_loop(
+                t, look_ahead, distance_changed_at_t, sims_total,
+                minimum_epsilon, max_nr_populations, min_acceptance_rate,
+                max_total_nr_simulations, max_walltime, start_walltime,
+            )
+        finally:
+            if look_ahead:
+                # retire any pre-published next generation — ALSO on an
+                # exception mid-loop (generation_timeout, persistence
+                # failure): collect-only look-ahead generations have no
+                # self-completion, so workers would otherwise simulate the
+                # stale proposal until the broker dies
+                self.sampler.cancel_look_ahead()
+        self.history.done()
+        return self.history
+
+    def _serial_generation_loop(self, t, look_ahead, distance_changed_at_t,
+                                sims_total, minimum_epsilon,
+                                max_nr_populations, min_acceptance_rate,
+                                max_total_nr_simulations, max_walltime,
+                                start_walltime) -> None:
         while True:
             current_eps = self.eps(t)
             if look_ahead:
@@ -711,13 +733,6 @@ class ABCSMC:
                                 start_walltime):
                 break
             t += 1
-        if look_ahead:
-            # retire any pre-published next generation: collect-only
-            # look-ahead generations have no self-completion, so workers
-            # would simulate the unused proposal until the broker dies
-            self.sampler.cancel_look_ahead()
-        self.history.done()
-        return self.history
 
     def _adapt_components(self, t, sample, pop, current_eps,
                           acceptance_rate) -> bool:

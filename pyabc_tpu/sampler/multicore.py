@@ -253,32 +253,43 @@ class _MulticoreBase(Sampler):
         """One generation on the persistent pool: reset shared counters,
         enqueue one task per worker slot, collect until every task's DONE.
         Tasks are pulled greedily, so DONE sentinels are counted per TASK
-        (a fast worker may serve two tasks back-to-back)."""
+        (a fast worker may serve two tasks back-to-back).
+
+        Any abort between enqueue and full drain (KeyboardInterrupt, a
+        caller-side exception) tears the pool down: a reused pool would
+        carry live tasks of the aborted generation whose 'eval' loops
+        revive when the next generation resets the shared n_acc counter,
+        mixing stale-closure particles and extra DONE sentinels into the
+        new generation's queues."""
         _, workers, task_q, out_q, rej_q, n_eval, n_acc = self._ensure_pool()
         n_eval.value = 0
         n_acc.value = 0
-        n_tasks = 0
-        for i, arg in enumerate(args):
-            if arg <= 0:
-                continue
-            task_q.put((kind, payload, arg, int(seeds[i]),
-                        sample.record_rejected))
-            n_tasks += 1
-        collected: list[tuple] = []
-        done = 0
-        n_evals = 0
-        while done < n_tasks:
-            item = self._pool_get(workers, out_q)
-            if isinstance(item, str) and item == DONE:
-                done += 1
-            elif isinstance(item, tuple) and item[0] == DONE:
-                n_evals += item[1]
-                done += 1
-            else:
-                collected.append(item)
-        if kind == "eval":
-            n_evals = n_eval.value
-        self._drain_rejected_pool(sample, workers, rej_q, n_tasks)
+        try:
+            n_tasks = 0
+            for i, arg in enumerate(args):
+                if arg <= 0:
+                    continue
+                task_q.put((kind, payload, arg, int(seeds[i]),
+                            sample.record_rejected))
+                n_tasks += 1
+            collected: list[tuple] = []
+            done = 0
+            n_evals = 0
+            while done < n_tasks:
+                item = self._pool_get(workers, out_q)
+                if isinstance(item, str) and item == DONE:
+                    done += 1
+                elif isinstance(item, tuple) and item[0] == DONE:
+                    n_evals += item[1]
+                    done += 1
+                else:
+                    collected.append(item)
+            if kind == "eval":
+                n_evals = n_eval.value
+            self._drain_rejected_pool(sample, workers, rej_q, n_tasks)
+        except BaseException:
+            self.stop()
+            raise
         return collected, n_evals
 
     def _drain_rejected_pool(self, sample: Sample, workers, rej_q,
