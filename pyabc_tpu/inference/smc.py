@@ -203,6 +203,34 @@ class ABCSMC:
         #: chunks. Stop detection lags up to D chunks; over-dispatched
         #: chunks are device-side no-ops via the carried stopped flag.
         self.fetch_pipeline_depth = int(fetch_pipeline_depth)
+        #: fused loop: once the generation schedule is exhausted, hand the
+        #: still-in-flight final fetches to a background drain thread and
+        #: return immediately. The run's LAST chunks' fetch latency (which
+        #: has no successor compute of its own to hide behind) then
+        #: overlaps whatever the caller does next — e.g. a back-to-back
+        #: benchmark run's compute. The History is incomplete until
+        #: :meth:`drain_join` returns; ``run()`` callers that read results
+        #: right away should leave this off (default).
+        self.drain_async = False
+        #: optional callback fired after each fused chunk is processed
+        #: (on whichever thread processed it) with a dict of completion
+        #: telemetry: ts, t_first, gens, n_acc, chunk_index, chunk_s,
+        #: fetch_s, dispatch_s, process_s. Exceptions are logged, never
+        #: propagated into the loop.
+        self.chunk_event_cb = None
+        #: when True, a dedicated single-worker thread calls
+        #: block_until_ready on one tiny output of every dispatched fused
+        #: chunk and records (dispatch_return_ts, device_done_ts) into
+        #: :attr:`probe_events` — the bench derives a measured
+        #: device-busy fraction from consecutive completion times
+        #: (device executes chunks in dispatch order, so
+        #: done_k - max(done_{k-1}, dispatch_k) ~ chunk compute). The
+        #: probe adds one pipelined tunnel round trip per chunk; off by
+        #: default.
+        self.compute_probe = False
+        self.probe_events: list[tuple[float, float]] = []
+        self._drain_thread = None
+        self._drain_error: BaseException | None = None
         self._root_key = root_key(seed)
 
         self._device_capable = self._check_device_capable()
@@ -316,8 +344,16 @@ class ABCSMC:
             raise RuntimeError("this run is not device-capable")
         if self.spec.total_size != ctx.spec.total_size or self.K != ctx.K:
             raise ValueError("incompatible configuration for kernel reuse")
-        x0_new = np.asarray(self.spec.flatten(self.x_0), np.float32)
-        if not np.array_equal(x0_new, np.asarray(ctx.x0)):
+        # flatten_host + a cached host copy of ctx.x0: the jnp flatten /
+        # np.asarray-on-device-array pair costs two synchronous tunnel
+        # round trips (~0.1-0.3 s EACH) that would otherwise tax every
+        # adoption in a back-to-back bench
+        x0_new = np.asarray(self.spec.flatten_host(self.x_0), np.float32)
+        x0_host = getattr(ctx, "_x0_np", None)
+        if x0_host is None:
+            x0_host = np.asarray(ctx.x0)
+            ctx._x0_np = x0_host
+        if not np.array_equal(x0_new, x0_host):
             raise ValueError(
                 "observed data differs: kernels close over x_0; reuse "
                 "requires identical observations"
@@ -585,9 +621,24 @@ class ABCSMC:
             max_total_nr_simulations, max_walltime,
         )
 
+    def drain_join(self) -> None:
+        """Block until a ``drain_async`` background drain (the fused
+        loop's final in-flight fetches + persist) has finished, and
+        re-raise any error it hit. No-op when no drain is running."""
+        th = self._drain_thread
+        if th is not None:
+            th.join()
+            self._drain_thread = None
+        if self._drain_error is not None:
+            err, self._drain_error = self._drain_error, None
+            raise err
+
     def _run_impl(self, minimum_epsilon, max_nr_populations,
                   min_acceptance_rate, max_total_nr_simulations,
                   max_walltime) -> History:
+        # a still-running background drain from a previous drain_async run
+        # on this object must finish (and surface its errors) first
+        self.drain_join()
         if minimum_epsilon is None:
             # reference default: temperature schedules stop at T = 1 (exact
             # posterior); distance thresholds run to the other criteria
@@ -1249,6 +1300,19 @@ class ABCSMC:
             self.history.update_telemetry(
                 0, {"persist_s": round(time.time() - t_persist0, 4)}
             )
+            if self.chunk_event_cb is not None:
+                # generation 0 runs outside the chunk pipeline but its
+                # particles/time belong to the caller's global clock
+                try:
+                    self.chunk_event_cb({
+                        "ts": time.time(), "t_first": 0, "gens": 1,
+                        "n_acc": int(n), "chunk_index": 0,
+                        "chunk_s": float(sample_s),
+                        "fetch_s": 0.0, "dispatch_s": 0.0,
+                        "process_s": float(adapt_s),
+                    })
+                except Exception:
+                    logger.exception("chunk_event_cb failed")
             if self._check_stop(0, current_eps, minimum_epsilon,
                                 max_nr_populations, acceptance_rate,
                                 min_acceptance_rate, sims_total,
@@ -1527,7 +1591,17 @@ class ABCSMC:
             }
             return tree
 
+        probe_pool = (ThreadPoolExecutor(max_workers=1)
+                      if self.compute_probe else None)
+
+        def _probe(out, disp_ts):
+            jax.block_until_ready(out)
+            self.probe_events.append((disp_ts, time.time()))
+
         def _submit(res_i, t_at, g_lim):
+            if probe_pool is not None:
+                probe_pool.submit(_probe, res_i["outs"]["gen_ok"],
+                                  time.time())
             tree = _fetch_tree(res_i, t_at, g_lim)
             if executor is None:
                 return tree  # fetched synchronously at pop time
@@ -1543,6 +1617,87 @@ class ABCSMC:
         # before fetching the current one — both for the old speculative
         # overlap and because the drain check below is `while pending`
         refill_target = max(depth, 2)
+        drained_async = False
+
+        def _process_next(dispatch_s):
+            """Fetch + host-process the oldest pending chunk (shared by
+            the main loop and the drain-async tail thread; only one of
+            them ever runs at a time, so the nonlocal state is safe)."""
+            nonlocal t, sims_total, chunk_index, t_chunk0
+            handle, t_at, g_lim = pending.pop(0)
+            logger.info("t: %d..%d (fused chunk of %d)", t_at,
+                        t_at + g_lim - 1, g_lim)
+            t_fetch0 = time.time()
+            fetched = (handle.result() if executor is not None
+                       else jax.device_get(handle))
+            now = time.time()
+            fetch_s = now - t_fetch0  # EXPOSED wait (latency pipelined)
+            chunk_s = now - t_chunk0  # pipeline period: fetch-to-fetch
+            t_chunk0 = now
+            ss_rows = fetched.pop("__ss_rows__", None)
+            mem_telemetry = self._device_memory_telemetry()
+            chunk_index += 1
+            t_proc0 = time.time()
+            (stop, last_pop, last_sample, last_eps, last_acc_rate, t,
+             sims_total, n_acc_chunk, g_done) = self._process_chunk(
+                fetched, ss_rows, t, g_lim, n_of, adaptive_n,
+                adaptive, stochastic, temp_fixed, eps_quantile,
+                sumstat_refit, chunk_index, chunk_s, dispatch_s,
+                fetch_s, depth, mem_telemetry,
+                sims_total, minimum_epsilon, max_nr_populations,
+                min_acceptance_rate, max_total_nr_simulations,
+                max_walltime, start_walltime,
+            )
+            if self.chunk_event_cb is not None:
+                try:
+                    self.chunk_event_cb({
+                        "ts": time.time(), "t_first": int(t_at),
+                        "gens": int(g_done), "n_acc": int(n_acc_chunk),
+                        "chunk_index": int(chunk_index),
+                        "chunk_s": float(chunk_s),
+                        "fetch_s": float(fetch_s),
+                        "dispatch_s": float(dispatch_s),
+                        "process_s": float(time.time() - t_proc0),
+                    })
+                except Exception:
+                    logger.exception("chunk_event_cb failed")
+            return (stop, last_pop, last_sample, last_eps, last_acc_rate,
+                    t_at, g_lim)
+
+        def _mirror_fit(last_pop):
+            self._model_probs = {
+                m: float(last_pop.model_probabilities_array()[m])
+                for m in last_pop.get_alive_models()
+            }
+            self._fit_transitions(last_pop)
+
+        def _drain_tail():
+            """Background drain of the final in-flight chunks: their
+            fetch latency has no successor compute in THIS run — the
+            drain_async caller overlaps it with its own next work."""
+            try:
+                try:
+                    while pending:
+                        stop, last_pop, *_rest = _process_next(0.0)
+                        if last_pop is not None:
+                            _mirror_fit(last_pop)
+                        if stop:
+                            break
+                finally:
+                    if executor is not None:
+                        executor.shutdown(wait=True, cancel_futures=True)
+                    if probe_pool is not None:
+                        probe_pool.shutdown(wait=True)
+                self.history.done()
+            except BaseException as exc:  # surfaced by drain_join()
+                self._drain_error = exc
+                try:
+                    self.history.flush()
+                except Exception:
+                    logger.exception(
+                        "async history writer also failed while draining"
+                    )
+
         try:
             while pending:
                 # keep the device fed: dispatch + start fetches up to depth
@@ -1557,29 +1712,23 @@ class ABCSMC:
                     pending.append((_submit(nxt, lt + lg, g_next),
                                     lt + lg, g_next))
                 dispatch_s = time.time() - t_disp0
-                handle, t_at, g_limit = pending.pop(0)
-                logger.info("t: %d..%d (fused chunk of %d)", t_at,
-                            t_at + g_limit - 1, g_limit)
-                t_fetch0 = time.time()
-                fetched = (handle.result() if executor is not None
-                           else jax.device_get(handle))
-                now = time.time()
-                fetch_s = now - t_fetch0  # EXPOSED wait (latency pipelined)
-                chunk_s = now - t_chunk0  # pipeline period: fetch-to-fetch
-                t_chunk0 = now
-                ss_rows = fetched.pop("__ss_rows__", None)
-                mem_telemetry = self._device_memory_telemetry()
-                chunk_index += 1
-                stop, last_pop, last_sample, last_eps, last_acc_rate, t, \
-                    sims_total = self._process_chunk(
-                        fetched, ss_rows, t, g_limit, n_of, adaptive_n,
-                        adaptive, stochastic, temp_fixed, eps_quantile,
-                        sumstat_refit, chunk_index, chunk_s, dispatch_s,
-                        fetch_s, depth, mem_telemetry,
-                        sims_total, minimum_epsilon, max_nr_populations,
-                        min_acceptance_rate, max_total_nr_simulations,
-                        max_walltime, start_walltime,
+                if (self.drain_async and not sumstat_refit
+                        and chunk_index >= 1 and pending
+                        and _g_limit(tail[1] + tail[2]) <= 0):
+                    # schedule exhausted: everything left is drain — hand
+                    # it to the background thread and return
+                    import threading as _threading
+
+                    self._drain_error = None
+                    self._drain_thread = _threading.Thread(
+                        target=_drain_tail, daemon=True,
+                        name="pyabc-tpu-drain",
                     )
+                    self._drain_thread.start()
+                    drained_async = True
+                    return self.history
+                stop, last_pop, last_sample, last_eps, last_acc_rate, \
+                    t_at, g_limit = _process_next(dispatch_s)
                 continuing = (not stop and last_pop is not None
                               and (pending
                                    or _g_limit(t_at + g_limit) > 0))
@@ -1587,11 +1736,7 @@ class ABCSMC:
                         and not (continuing and sumstat_refit):
                     # (the sumstat-refit continue path fits these inside
                     # _adapt_components below — don't pay the KDE fit twice)
-                    self._model_probs = {
-                        m: float(last_pop.model_probabilities_array()[m])
-                        for m in last_pop.get_alive_models()
-                    }
-                    self._fit_transitions(last_pop)
+                    _mirror_fit(last_pop)
                 if not continuing:
                     break
                 if sumstat_refit:
@@ -1622,8 +1767,13 @@ class ABCSMC:
                     pending = [(_submit(res, t, g_next), t, g_next)]
                     tail = (res, t, g_next)
         finally:
-            if executor is not None:
-                executor.shutdown(wait=True, cancel_futures=True)
+            # on a drain-async handoff the tail thread owns the executor
+            # and the probe pool
+            if not drained_async:
+                if executor is not None:
+                    executor.shutdown(wait=True, cancel_futures=True)
+                if probe_pool is not None:
+                    probe_pool.shutdown(wait=True)
         self.history.done()
         return self.history
 
@@ -1636,12 +1786,27 @@ class ABCSMC:
                        max_walltime, start_walltime):
         """Persist + host-mirror one fetched chunk's generations. Returns
         (stop, last_pop, last_sample, last_eps, last_acc_rate, t,
-        sims_total)."""
+        sims_total, n_acc_chunk, g_done)."""
         from ..sampler.base import Sample, exp_normalize_log_weights
 
         stop = False
         last_pop = last_sample = None
         last_eps = last_acc_rate = None
+        n_acc_chunk = 0
+        g_done = 0
+        # the last complete generation of the chunk is known upfront from
+        # the gen_ok flags: only ITS Sample/Population is built on this
+        # thread (the cross-chunk transition refit / sumstat boundary
+        # needs the object); earlier generations ship raw arrays + a
+        # builder to the writer thread, so per-generation normalization
+        # and Population construction overlap the next chunk's compute
+        g_last_ok = -1
+        for g in range(g_limit):
+            if bool(fetched["gen_ok"][g]):
+                g_last_ok = g
+            else:
+                break
+        last_deferred = None  # newest deferred gen's (builder, eps, rate)
         for g in range(g_limit):
                 # per-generation target (t advances below); in-kernel
                 # adaptive n is read back from the chunk outputs
@@ -1654,33 +1819,46 @@ class ABCSMC:
                     )
                     stop = True
                     break
-                weights = exp_normalize_log_weights(
-                    fetched["log_weight"][g][:n]
-                )
                 if ss_rows is None:
-                    ss_g = np.asarray(fetched["sumstats"][g][:n], np.float64)
+                    ss_raw = fetched["sumstats"][g][:n]
                 elif g in ss_rows:
-                    ss_g = np.asarray(ss_rows[g][:n], np.float64)
+                    ss_raw = ss_rows[g][:n]
                 else:
-                    ss_g = None
-                sample = Sample()
-                sample.set_accepted(
-                    ms=fetched["m"][g][:n],
-                    thetas=np.asarray(fetched["theta"][g][:n], np.float64),
-                    weights=weights,
-                    distances=np.asarray(fetched["distance"][g][:n],
-                                         np.float64),
-                    sumstats=ss_g,
-                    proposal_ids=fetched["slot"][g][:n],
-                )
-                pop = self._sample_to_population(sample)
+                    ss_raw = None
+
+                def _build(ms=fetched["m"][g][:n],
+                           thetas=fetched["theta"][g][:n],
+                           log_w=fetched["log_weight"][g][:n],
+                           dists=fetched["distance"][g][:n],
+                           ss=ss_raw,
+                           slots=fetched["slot"][g][:n]):
+                    sample = Sample()
+                    sample.set_accepted(
+                        ms=ms,
+                        thetas=np.asarray(thetas, np.float64),
+                        weights=exp_normalize_log_weights(log_w),
+                        distances=np.asarray(dists, np.float64),
+                        sumstats=(np.asarray(ss, np.float64)
+                                  if ss is not None else None),
+                        proposal_ids=slots,
+                    )
+                    return sample, self._sample_to_population(sample)
+
                 current_eps = float(fetched["eps_used"][g])
                 nr_evals = int(fetched["n_valid"][g])
                 self.sampler.nr_evaluations_ = nr_evals
                 sims_total += nr_evals
                 acceptance_rate = n / max(nr_evals, 1)
+                n_acc_chunk += n
+                if g == g_last_ok or sumstat_refit:
+                    last_sample, last_pop = _build()
+                    last_eps, last_acc_rate = current_eps, acceptance_rate
+                    pop_arg = last_pop
+                else:
+                    last_deferred = (_build, current_eps, acceptance_rate)
+                    pop_arg = (lambda b=_build: b()[1])
                 self.history.append_population_async(
-                    t, current_eps, pop, nr_evals, self.model_names,
+                    t, current_eps, pop_arg, nr_evals, self.model_names,
                     telemetry={
                         "fused_chunk": g_limit,
                         "chunk_index": chunk_index,
@@ -1768,9 +1946,7 @@ class ABCSMC:
                     for m, p in enumerate(fetched["model_probs"][g])
                     if p > 0
                 }
-                last_pop = pop
-                last_sample = sample
-                last_eps, last_acc_rate = current_eps, acceptance_rate
+                g_done += 1
                 if self._check_stop(t, current_eps, minimum_epsilon,
                                     max_nr_populations, acceptance_rate,
                                     min_acceptance_rate, sims_total,
@@ -1779,8 +1955,15 @@ class ABCSMC:
                     stop = True
                     break
                 t += 1
+        if last_pop is None and last_deferred is not None:
+            # stopped (via _check_stop) before reaching the chunk's last
+            # complete generation: the newest processed generation was
+            # deferred — build it now, the caller's transition refit
+            # needs the actual Population
+            builder, last_eps, last_acc_rate = last_deferred
+            last_sample, last_pop = builder()
         return (stop, last_pop, last_sample, last_eps, last_acc_rate, t,
-                sims_total)
+                sims_total, n_acc_chunk, g_done)
 
     # --------------------------------------------- broker look-ahead path
     def _look_ahead_capable(self) -> bool:

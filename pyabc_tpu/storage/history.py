@@ -303,6 +303,12 @@ class History:
     def append_population(self, t: int, current_epsilon: float, population,
                           nr_simulations: int, model_names: list[str],
                           telemetry: dict | None = None) -> None:
+        if callable(population):
+            # deferred construction: the fused loop ships raw device-fetched
+            # arrays and a builder; normalization + Population construction
+            # then run HERE — on the async writer thread when one is active —
+            # instead of on the latency-critical chunk-processing thread
+            population = population()
         with self._lock:
             try:
                 self._append_population_locked(

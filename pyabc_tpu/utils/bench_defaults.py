@@ -23,3 +23,7 @@ DEFAULT_POP = 1000
 DEFAULT_GENS = 31
 DEFAULT_G = 8
 DEFAULT_BUDGET_S = 300.0
+# wall-window width for the strict global-clock median (a few chunk
+# periods, so one congested window can't dominate and clustered
+# completions average out)
+DEFAULT_WINDOW_S = 2.0

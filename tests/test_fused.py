@@ -561,6 +561,71 @@ def test_fetch_pipeline_depths_complete_all_generations():
     np.testing.assert_allclose(eps_by_depth[1], eps_by_depth[3])
 
 
+def test_drain_async_matches_sync_run():
+    """drain_async hands the final in-flight fetches to a background
+    thread and returns early; after drain_join the History must be
+    IDENTICAL (same seed, same kernels) to the synchronous run, and the
+    chunk events must account for every persisted generation."""
+    abc_sync, h_sync = _run(3, seed=81, pop=200,
+                            distance=pt.PNormDistance(p=2), n_gens=9)
+    events = []
+    prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+    abc = pt.ABCSMC(_gauss_model(), prior, pt.PNormDistance(p=2),
+                    population_size=200, eps=pt.MedianEpsilon(),
+                    seed=81, fused_generations=3)
+    abc.drain_async = True
+    abc.compute_probe = True
+    abc.chunk_event_cb = events.append
+    abc.new("sqlite://", {"x": X_OBS})
+    h = abc.run(max_nr_populations=9)
+    abc.drain_join()
+    assert abc._drain_thread is None
+    assert h.n_populations == 9
+    eps_sync = h_sync.get_all_populations().query("t >= 0")["epsilon"]
+    eps_async = h.get_all_populations().query("t >= 0")["epsilon"]
+    np.testing.assert_allclose(eps_async.to_numpy(), eps_sync.to_numpy())
+    # events cover all 9 generations (gen 0 + fused chunks) exactly once
+    assert sum(e["gens"] for e in events) == 9
+    assert sum(e["n_acc"] for e in events) == 9 * 200
+    assert all(e["chunk_s"] >= 0 and e["process_s"] >= 0 for e in events)
+    # probe recorded one completion per dispatched chunk, timestamps sane
+    assert len(abc.probe_events) >= len(events) - 1
+    assert all(done >= disp for disp, done in abc.probe_events)
+    # a second run on the same object must not trip over drain state
+    abc2 = pt.ABCSMC(_gauss_model(), prior, pt.PNormDistance(p=2),
+                     population_size=200, eps=pt.MedianEpsilon(),
+                     seed=81, fused_generations=3)
+    abc2.drain_async = True
+    abc2.new("sqlite://", {"x": X_OBS})
+    abc2.adopt_device_context(abc)
+    h2 = abc2.run(max_nr_populations=9)
+    abc2.drain_join()
+    assert h2.n_populations == 9
+
+
+def test_fused_mid_chunk_stop_rebuilds_deferred_population():
+    """A _check_stop stop in the MIDDLE of a chunk (simulation budget)
+    hits the deferred-construction path: the newest processed
+    generation's Population was shipped to the writer as a builder, so
+    the loop must rebuild it for the final transition refit. The run
+    must end cleanly with every persisted generation intact."""
+    prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+    abc = pt.ABCSMC(_gauss_model(), prior, pt.PNormDistance(p=2),
+                    population_size=200, eps=pt.MedianEpsilon(),
+                    seed=82, fused_generations=4)
+    abc.new("sqlite://", {"x": X_OBS})
+    # a budget that runs out mid-chunk: gen 0 alone costs >= 200 sims
+    h = abc.run(max_nr_populations=12, max_total_nr_simulations=1200)
+    assert 1 <= h.n_populations < 12
+    pops = h.get_all_populations().query("t >= 0")
+    assert len(pops) == h.n_populations
+    # the final persisted generation is a full, weighted population
+    df, w = h.get_distribution(m=0, t=h.max_t)
+    assert len(df) == 200 and np.isclose(w.sum(), 1.0)
+    # transitions were refit from the (rebuilt) final population
+    assert abc.transitions[0].X is not None
+
+
 def test_fused_multimodel_local_transition():
     """K=2 LocalTransition through the fused chunk loop: the host
     _effective_k rule runs IN-KERNEL against each model's dynamic
