@@ -10,9 +10,12 @@ sampler in this package honors (SURVEY.md §3.4).
 """
 from __future__ import annotations
 
+import logging
 import pickle
 
 import numpy as np
+
+logger = logging.getLogger("ABC.Sampler")
 
 try:  # closures (simulate_one) need cloudpickle; plain functions don't
     import cloudpickle as _closure_pickle
@@ -21,6 +24,20 @@ except ImportError:  # pragma: no cover - cloudpickle is usually present
 
 from .broker import EvalBroker
 from ..sampler.base import HostRecords, Sample, Sampler
+
+
+def _apply_delayed(p, accept_fn) -> bool:
+    """Delayed acceptance for a look-ahead particle: the preliminary
+    worker produced it without an accept test (epsilon unknown at
+    simulation time); accept_fn may also RE-EVALUATE the distance under
+    the generation's final weights. The weight already reflects the
+    preliminary proposal actually used, so rejection just zeroes it."""
+    acc = bool(accept_fn(p))
+    p.accepted = acc
+    p.preliminary = False
+    if not acc:
+        p.weight = 0.0
+    return acc
 
 
 class ElasticSampler(Sampler):
@@ -77,6 +94,10 @@ class ElasticSampler(Sampler):
         #: telemetry: per-generation head start (results already delivered
         #: when the orchestrator arrived) and adopted-generation count
         self.lookahead_head_starts: list[int] = []
+        #: (slot, error repr) of evaluations a --catch worker converted
+        #: into rejected error records during the LAST generation
+        #: (reference: exceptions surfaced via rejected particles)
+        self.error_records: list[tuple[int, str]] = []
         self.broker = EvalBroker(host, port)
 
     @property
@@ -103,23 +124,28 @@ class ElasticSampler(Sampler):
                 mode=self.scheduling,
             )
         accept_fn = self.lookahead_accept if adopt else None
-        triples = self._collect(n, t, max_eval, all_accepted, accept_fn,
-                                head_start=adopt)
+        triples, tested = self._collect(n, t, max_eval, all_accepted,
+                                        accept_fn, head_start=adopt)
 
         sample = self.sample_factory()
         accepted, accepted_ids, records = [], [], []
+        self.error_records = []
         for slot, blob, acc in sorted(triples, key=lambda x: x[0]):
-            particle = pickle.loads(blob)
-            if accept_fn is not None:
-                # delayed acceptance: look-ahead particles were produced
-                # without an accept test (epsilon unknown at simulation
-                # time); the weight already reflects the preliminary
-                # proposal actually used
-                acc = bool(accept_fn(particle))
-                particle.accepted = acc
-                particle.preliminary = False
-                if not acc:
-                    particle.weight = 0.0
+            if slot in tested:
+                # delayed acceptance already ran in _collect (unpickle +
+                # distance recompute happen exactly once per delivery)
+                particle, acc = tested[slot]
+            else:
+                particle = pickle.loads(blob)
+                if accept_fn is not None and \
+                        getattr(particle, "error", None) is None:
+                    acc = _apply_delayed(particle, accept_fn)
+            if getattr(particle, "error", None) is not None:
+                # a --catch worker converted a raising simulate_one into
+                # this rejected error record; it counts as an evaluation
+                # but carries no usable stats
+                self.error_records.append((slot, particle.error))
+                continue
             if sample.record_rejected:
                 records.append(particle)
             if acc or all_accepted or (accept_fn is None
@@ -127,6 +153,12 @@ class ElasticSampler(Sampler):
                 accepted.append(particle)
                 accepted_ids.append(slot)
         self.nr_evaluations_ = len(triples)
+        if self.error_records:
+            logger.warning(
+                "%d evaluation(s) raised in workers and were recorded as "
+                "rejected error records (first: %s)",
+                len(self.error_records), self.error_records[0][1],
+            )
         # deterministic overshoot trim by eval-slot id
         accepted = accepted[:n]
         accepted_ids = accepted_ids[:n]
@@ -137,13 +169,19 @@ class ElasticSampler(Sampler):
         return sample
 
     def _collect(self, n, t, max_eval, all_accepted, accept_fn, *,
-                 head_start: bool) -> list:
+                 head_start: bool) -> tuple[list, dict]:
         """Poll the broker until generation completion, applying delayed
         acceptance (look-ahead adoption) and/or pre-publishing the NEXT
         generation's preliminary closure once enough of this one is in.
         Generation-stamped throughout: a pre-published next generation
         auto-starts the instant this one finalizes, so completion may
-        surface as a generation-id change rather than a done flag."""
+        surface as a generation-id change rather than a done flag.
+
+        Returns ``(triples, tested)`` where ``tested`` maps slot ->
+        (particle, accepted) for every delivery already unpickled and
+        delayed-accept-tested here — the caller reuses them, so each
+        delivery is unpickled and (possibly expensively) re-distanced
+        exactly once."""
         import time as _time
 
         deadline = (_time.time() + self.generation_timeout
@@ -157,6 +195,7 @@ class ElasticSampler(Sampler):
         n_seen = 0
         n_acc = 0
         accepted_parts: list = []
+        tested: dict[int, tuple] = {}
         while True:
             triples, done, gen_now = self.broker.results_snapshot()
             if gen0 is None:
@@ -171,16 +210,21 @@ class ElasticSampler(Sampler):
             if gen_now != gen0:
                 # finished and auto-advanced to the pre-published next gen
                 last = self.broker.last_results(gen0)
-                return last if last is not None else []
+                return (last if last is not None else []), tested
             need_particles = accept_fn is not None or (
                 self.look_ahead and not prepublished
                 and self.lookahead_builder is not None
             )
-            for _slot, blob, acc in triples[n_seen:]:
+            for slot, blob, acc in triples[n_seen:]:
                 if need_particles:
                     p = pickle.loads(blob)
-                    ok = (bool(accept_fn(p)) if accept_fn is not None
-                          else bool(acc))
+                    if getattr(p, "error", None) is not None:
+                        ok = False  # --catch error record: never accepted
+                    elif accept_fn is not None:
+                        ok = _apply_delayed(p, accept_fn)
+                    else:
+                        ok = bool(acc)
+                    tested[slot] = (p, ok)
                     if ok:
                         accepted_parts.append(p)
                 else:
@@ -206,9 +250,9 @@ class ElasticSampler(Sampler):
                 # delayed-acceptance completion is the sampler's call
                 self.broker.finish_generation()
                 last = self.broker.last_results(gen0)
-                return last if last is not None else triples
+                return (last if last is not None else triples), tested
             if done:
-                return triples
+                return triples, tested
             _time.sleep(0.02)
             if deadline and _time.time() > deadline:
                 raise TimeoutError(

@@ -31,12 +31,19 @@ def run_worker(host: str, port: int, *, worker_id: str | None = None,
                poll_s: float = 0.3, max_generations: float = float("inf"),
                runtime_s: float = float("inf"),
                log_file: str | None = None,
+               catch_exceptions: bool = True,
                _stop_check=None) -> int:
     """Serve generations until the broker goes away / runtime ends.
 
     Returns the number of evaluations performed. Reconnects with backoff
     while the broker is unreachable (a worker may be started BEFORE the
     manager — reference semantics).
+
+    ``catch_exceptions`` (reference ``abc-redis-worker --catch``): a
+    raising ``simulate_one`` ships a rejected error-record particle and
+    the loop continues — a deterministic model bug then surfaces in the
+    orchestrator's error records instead of serially killing every
+    worker in the pool. Disable to make model errors fatal (debugging).
     """
     addr = (host, int(port))
     wid = worker_id or f"{socket.gethostname()}-{os.getpid()}-{uuid.uuid4().hex[:6]}"
@@ -117,7 +124,18 @@ def run_worker(host: str, port: int, *, worker_id: str | None = None,
                     # rejects ship as records either way.
                     unit_evals = 0
                     while True:
-                        particle = simulate_one()
+                        try:
+                            particle = simulate_one()
+                        except Exception as e:
+                            if not catch_exceptions:
+                                raise
+                            from ..core.population import Particle
+
+                            particle = Particle(
+                                m=-1, parameter={}, weight=0.0,
+                                sum_stat={}, distance=float("inf"),
+                                accepted=False, error=repr(e),
+                            )
                         n_eval += 1
                         unit_evals += 1
                         accepted = bool(particle.accepted)
@@ -135,6 +153,22 @@ def run_worker(host: str, port: int, *, worker_id: str | None = None,
                             # exit — delay bounded by ONE simulate_one
                             aborted = True
                             break
+                        if mode == "static" and len(triples) >= 64:
+                            # a spinning static unit (collapsed acceptance
+                            # or a deterministically-raising model under
+                            # --catch) must not hoard its reject/error
+                            # records unboundedly: flush them mid-unit so
+                            # errors surface and memory stays bounded
+                            try:
+                                rf = request(addr,
+                                             ("results", wid, gen, triples))
+                            except (ConnectionError, OSError):
+                                aborted = True
+                                break
+                            triples = []
+                            if rf[0] != "ok":
+                                aborted = True
+                                break
                         if unit_evals % 256 == 0:
                             # liveness probe: a static unit can spin for
                             # thousands of evaluations at a collapsed

@@ -141,15 +141,84 @@ def bench_cmd(pop, gens, budget_s, cpu):
               help="leave the pool after serving this many generations")
 @click.option("--log-file", default=None,
               help="per-worker CSV runtime log (reference parity)")
-def worker_cmd(host, port, worker_id, runtime_s, max_generations, log_file):
+@click.option("--processes", type=int, default=1,
+              help="run N worker processes from this one command "
+              "(reference abc-redis-worker --processes)")
+@click.option("--catch/--no-catch", "catch_exceptions", default=True,
+              help="wrap simulate_one exceptions into rejected error "
+              "records instead of killing the worker loop (reference "
+              "--catch; default on)")
+def worker_cmd(host, port, worker_id, runtime_s, max_generations, log_file,
+               processes, catch_exceptions):
     """Join an ElasticSampler broker at HOST:PORT as an evaluation worker
     (reference parity: the ``abc-redis-worker`` CLI). Workers may join and
     leave at any time, including mid-generation."""
     from .broker import run_worker
 
-    n = run_worker(host, port, worker_id=worker_id, runtime_s=runtime_s,
-                   max_generations=max_generations, log_file=log_file)
+    kwargs = dict(worker_id=worker_id, runtime_s=runtime_s,
+                  max_generations=max_generations, log_file=log_file,
+                  catch_exceptions=catch_exceptions)
+    if processes > 1:
+        # one worker per process (reference --processes): each child gets
+        # its own id suffix and log file so the CSVs don't interleave.
+        # The parent forwards SIGTERM/SIGINT (cluster preemption hits the
+        # parent PID only; orphaned spawn children would otherwise keep
+        # serving forever under the default infinite runtime) and exits
+        # nonzero if any child failed.
+        import multiprocessing as mp
+        import signal as _signal
+
+        ctx = mp.get_context("spawn")
+        procs = []
+        for i in range(processes):
+            kw = dict(kwargs)
+            if worker_id is not None:
+                kw["worker_id"] = f"{worker_id}-{i}"
+            if log_file is not None:
+                kw["log_file"] = f"{log_file}.{i}"
+            procs.append(ctx.Process(
+                target=_run_worker_child, args=(host, port), kwargs=kw,
+            ))
+
+        def _forward(signum, frame):
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+
+        old = {}
+        for sig in (_signal.SIGTERM, _signal.SIGINT):
+            try:
+                old[sig] = _signal.signal(sig, _forward)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+        try:
+            for p in procs:
+                p.start()
+            for p in procs:
+                p.join()
+        finally:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            for sig, handler in old.items():
+                _signal.signal(sig, handler)
+        failed = [i for i, p in enumerate(procs) if p.exitcode not in (0, -15)]
+        if failed:
+            raise click.ClickException(
+                f"worker process(es) {failed} exited abnormally "
+                f"(exitcodes {[procs[i].exitcode for i in failed]})"
+            )
+        click.echo(f"{processes} workers done", err=True)
+        return
+    n = run_worker(host, port, **kwargs)
     click.echo(f"worker done: {n} evaluations", err=True)
+
+
+def _run_worker_child(host, port, **kwargs):
+    """Module-level spawn target for ``abc-worker --processes N``."""
+    from .broker import run_worker
+
+    run_worker(host, port, **kwargs)
 
 
 @click.command("abc-manager")

@@ -39,6 +39,11 @@ class Particle:
     #: AcceptanceRateScheme's record reweighting (reference
     #: transition_pd_prev) — NaN when not recorded
     proposal_pd: float = float("nan")
+    #: repr of a simulate_one exception caught by a worker running with
+    #: exception capture (reference ``abc-redis-worker --catch``): the
+    #: evaluation ships as this rejected error-record instead of killing
+    #: the worker loop; error particles carry no usable sum stats
+    error: str | None = None
 
 
 class Population:

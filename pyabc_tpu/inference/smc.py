@@ -718,11 +718,25 @@ class ABCSMC:
         while True:
             current_eps = self.eps(t)
             if look_ahead:
-                # delayed acceptance for an adopted look-ahead generation:
-                # test the recorded distances against the NOW-known eps
-                self.sampler.lookahead_accept = (
-                    lambda p, _e=float(current_eps): p.distance <= _e
-                )
+                # delayed acceptance for an adopted look-ahead generation.
+                # Generation-dependent distances (AdaptivePNormDistance,
+                # t-scheduled weights) are RE-EVALUATED here from the
+                # shipped sum stats — the preliminary worker recorded a
+                # distance under the stale weights; the generation-t
+                # weights exist now (reference delayed evaluation). The
+                # recomputed distance sticks on the particle, so records
+                # and the persisted population carry the final values.
+                if getattr(self, "_lookahead_recompute", False):
+                    def _accept(p, _e=float(current_eps), _t=t):
+                        p.distance = float(self.distance_function(
+                            p.sum_stat, self.x_0, _t, p.parameter
+                        ))
+                        return p.distance <= _e
+                    self.sampler.lookahead_accept = _accept
+                else:
+                    self.sampler.lookahead_accept = (
+                        lambda p, _e=float(current_eps): p.distance <= _e
+                    )
             if hasattr(self.acceptor, "note_epsilon"):
                 # complete-history acceptance needs the threshold trail
                 self.acceptor.note_epsilon(t, current_eps,
@@ -1971,12 +1985,30 @@ class ABCSMC:
         reference ``look_ahead_delay_evaluation``): gen t+1's proposal is
         built from PRELIMINARY gen-t particles while t still runs, and
         t+1's acceptance/weights are applied on the host once the final
-        epsilon is known. Sound when the recorded distance is invariant
-        across generations (plain p-norm, no reweighting/sumstats) and
-        acceptance is the plain uniform d <= eps test — the particle's
-        importance weight then only depends on the proposal it was
-        actually drawn from, which the preliminary closure records."""
+        epsilon is known.
+
+        Full delayed-evaluation semantics (the reference's
+        ``look_ahead_delay_evaluation=True``): preliminary workers only
+        SIMULATE — each particle ships its summary statistics, and the
+        orchestrator recomputes distance AND acceptance once the updated
+        distance (e.g. AdaptivePNormDistance's generation-t+1 weights)
+        and the final epsilon exist. That is exactly what makes
+        look-ahead legal for adaptive and t-scheduled distances; the
+        particle's importance weight only depends on the proposal it was
+        actually drawn from, which the preliminary closure records, so
+        no weight correction is needed. ``_lookahead_recompute`` is set
+        here: False for generation-invariant distances (recorded
+        distance reused), True when the distance must be re-evaluated
+        host-side at adoption time.
+
+        Still excluded: StochasticAcceptor (probabilistic acceptance
+        with pdf-norm feedback — delayed acceptance would need the full
+        temperature recursion re-run host-side) and learned-sumstat
+        distances (the feature transform refits between generations,
+        so shipped raw statistics would need the new transform AND the
+        scale refit — the fused loop owns that configuration)."""
         from ..broker.sampler import ElasticSampler
+        from ..distance import AdaptivePNormDistance
 
         if not (isinstance(self.sampler, ElasticSampler)
                 and self.sampler.look_ahead):
@@ -1991,10 +2023,12 @@ class ABCSMC:
                 or self.acceptor.use_complete_history:
             return False
         d = self.distance_function
-        if not (type(d) is PNormDistance and d.sumstat is None
-                and not any(k >= 0 for k in d.weights)):
-            return False
-        if self.sampler.sample_factory.record_rejected:
+        if type(d) is AdaptivePNormDistance and d.sumstat is None:
+            self._lookahead_recompute = True
+        elif type(d) is PNormDistance and d.sumstat is None:
+            # t-scheduled user weights also ride delayed evaluation
+            self._lookahead_recompute = any(k >= 0 for k in d.weights)
+        else:
             return False
         return True
 

@@ -323,27 +323,172 @@ def test_look_ahead_posterior_unbiased_and_overlaps():
     assert wall_la < wall_serial * 1.5, (wall_la, wall_serial)
 
 
-def test_look_ahead_gated_off_for_adaptive_distance():
-    """Adaptive distances re-weight between generations, making recorded
-    look-ahead distances incomparable — the orchestrator must not enable
-    the builder (the run itself still works, without look-ahead)."""
+def test_look_ahead_delayed_evaluation_adaptive_distance():
+    """Full delayed-evaluation look-ahead (reference
+    look_ahead_delay_evaluation): with AdaptivePNormDistance +
+    QuantileEpsilon, preliminary workers only simulate — the
+    orchestrator recomputes distance AND acceptance from the shipped sum
+    stats once the generation's new weights and final epsilon exist. The
+    posterior must match the serial path, adopted generations must show
+    a head start, and persisted distances must equal the FINAL-weight
+    distances (not the workers' stale-weight ones)."""
+    results = {}
+    for la in (True, False):
+        s = pt.ElasticSampler(host="127.0.0.1", port=0, batch=5,
+                              generation_timeout=240.0, look_ahead=la,
+                              look_ahead_frac=0.4)
+        port = s.address[1]
+        workers = [_spawn_worker(port) for _ in range(2)]
+        try:
+            prior = pt.Distribution(theta=pt.RV("norm", 0.0, 1.0))
+            dist = pt.AdaptivePNormDistance(p=2)
+            abc = pt.ABCSMC(_host_model(0.002), prior, dist,
+                            population_size=60,
+                            eps=pt.QuantileEpsilon(initial_epsilon=1.5,
+                                                   alpha=0.5),
+                            sampler=s, seed=4)
+            if la:
+                assert abc._look_ahead_capable()
+                assert abc._lookahead_recompute
+            abc.new("sqlite://", {"x": X_OBS})
+            h = abc.run(max_nr_populations=4)
+            assert h.n_populations == 4
+            df, w = h.get_distribution(0, h.max_t)
+            mu = float(np.sum(df["theta"] * w))
+            # persisted distances of the last generation must be the
+            # FINAL-weight distances: recompute from stored sum stats
+            # with the distance's weights for that generation
+            wd = h.get_weighted_distances(h.max_t)
+            _w_ss, stats = h.get_weighted_sum_stats(h.max_t)
+            recomputed = np.array([
+                dist({"x": float(stats[i, 0])}, {"x": X_OBS}, h.max_t)
+                for i in range(len(stats))
+            ])
+            np.testing.assert_allclose(
+                np.sort(wd["distance"].to_numpy()), np.sort(recomputed),
+                rtol=1e-6,
+            )
+            results[la] = (mu, list(s.lookahead_head_starts))
+        finally:
+            for p in workers:
+                p.kill()
+            s.stop()
+    mu_la, head_starts = results[True]
+    mu_serial, _ = results[False]
+    assert mu_la == pytest.approx(0.8, abs=0.35)
+    assert mu_serial == pytest.approx(0.8, abs=0.35)
+    assert mu_la == pytest.approx(mu_serial, abs=0.35)
+    assert head_starts and max(head_starts) > 0, head_starts
+
+
+def test_worker_catch_turns_model_errors_into_records():
+    """Reference ``abc-redis-worker --catch``: a model that raises on a
+    fraction of evaluations must NOT kill the worker loop — the failing
+    evaluations ship as rejected error records, the generation completes
+    from the healthy evaluations, and the errors surface on the sampler."""
     s = pt.ElasticSampler(host="127.0.0.1", port=0, batch=5,
-                          generation_timeout=240.0, look_ahead=True)
+                          generation_timeout=240.0)
     port = s.address[1]
     workers = [_spawn_worker(port) for _ in range(2)]
     try:
+        def flaky(pars):
+            if np.random.random() < 0.2:
+                raise RuntimeError("simulated model blow-up")
+            return {"x": pars["theta"] + NOISE_SD * np.random.normal()}
+
         prior = pt.Distribution(theta=pt.RV("norm", 0.0, 1.0))
-        abc = pt.ABCSMC(_host_model(), prior,
-                        pt.AdaptivePNormDistance(p=2), population_size=60,
+        abc = pt.ABCSMC(pt.SimpleModel(flaky, name="flaky"), prior,
+                        pt.PNormDistance(p=2), population_size=60,
                         eps=pt.QuantileEpsilon(initial_epsilon=1.5,
                                                alpha=0.5),
                         sampler=s, seed=4)
-        assert not abc._look_ahead_capable()
         abc.new("sqlite://", {"x": X_OBS})
-        h = abc.run(max_nr_populations=2)
-        assert h.n_populations == 2
-        assert not s.lookahead_head_starts
+        h = abc.run(max_nr_populations=3)
+        assert h.n_populations == 3
+        df, w = h.get_distribution(0, h.max_t)
+        assert len(df) == 60
+        mu = float(np.sum(df["theta"] * w))
+        assert mu == pytest.approx(0.8, abs=0.4)
+        # ~20% of evaluations raised; the last generation's errors are on
+        # the sampler, each carrying the exception repr
+        assert s.error_records, "no error records surfaced"
+        assert "simulated model blow-up" in s.error_records[0][1]
+        # both workers are still alive (the loop survived the raises)
+        assert all(p.poll() is None for p in workers)
     finally:
         for p in workers:
             p.kill()
+        s.stop()
+
+
+def test_worker_processes_cli_option():
+    """``abc-worker --processes N`` (reference parity) serves a run with N
+    worker processes from one command."""
+    s = pt.ElasticSampler(host="127.0.0.1", port=0, batch=5,
+                          generation_timeout=240.0)
+    port = s.address[1]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    # own session so teardown can kill the WHOLE group — SIGKILLing only
+    # the wrapper parent would orphan the spawned worker grandchildren
+    # for the rest of their runtime
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "from pyabc_tpu.cli import worker_cmd; worker_cmd()",
+         "127.0.0.1", str(port), "--processes", "2", "--runtime-s", "60"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, start_new_session=True,
+    )
+    try:
+        abc = _abc(s, pop=60)
+        abc.new("sqlite://", {"x": X_OBS})
+        seen_workers = set()
+
+        def watch():
+            while proc.poll() is None and len(seen_workers) < 2:
+                try:
+                    seen_workers.update(s.broker.status().workers)
+                except Exception:
+                    pass
+                time.sleep(0.05)
+
+        watcher = threading.Thread(target=watch, daemon=True)
+        watcher.start()
+        h = abc.run(max_nr_populations=2)
+        assert h.n_populations == 2
+        watcher.join(timeout=5)
+        assert len(seen_workers) >= 2, (
+            f"expected 2 worker processes, saw {seen_workers}"
+        )
+    finally:
+        try:
+            os.killpg(proc.pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            pass
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            os.killpg(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=5)
+        s.stop()
+
+
+def test_look_ahead_still_gated_off_for_stochastic_and_sumstat():
+    """Delayed evaluation does NOT extend to probabilistic acceptance
+    (pdf-norm feedback) or learned-sumstat distances; the gate must keep
+    refusing those."""
+    s = pt.ElasticSampler(host="127.0.0.1", port=0, look_ahead=True)
+    try:
+        prior = pt.Distribution(theta=pt.RV("norm", 0.0, 1.0))
+        abc = pt.ABCSMC(
+            _host_model(), prior,
+            pt.IndependentNormalKernel(var=[NOISE_SD ** 2]),
+            population_size=40,
+            eps=pt.Temperature(),
+            acceptor=pt.StochasticAcceptor(),
+            sampler=s, seed=4,
+        )
+        assert not abc._look_ahead_capable()
+    finally:
         s.stop()
