@@ -130,11 +130,17 @@ class ElasticSampler(Sampler):
         sample = self.sample_factory()
         accepted, accepted_ids, records = [], [], []
         self.error_records = []
-        for slot, blob, acc in sorted(triples, key=lambda x: x[0]):
-            if slot in tested:
+        # `tested` is aligned with the broker's append-only delivery list
+        # (every snapshot is a prefix of the final list), keyed by
+        # DELIVERY INDEX — slots are NOT unique: a static-mode quota unit
+        # ships every reject plus its accept under one slot id, so a
+        # slot-keyed cache would shadow them with the last delivery
+        for i, (slot, blob, acc) in sorted(
+                enumerate(triples), key=lambda e: e[1][0]):
+            if i < len(tested) and tested[i] is not None:
                 # delayed acceptance already ran in _collect (unpickle +
                 # distance recompute happen exactly once per delivery)
-                particle, acc = tested[slot]
+                particle, acc = tested[i]
             else:
                 particle = pickle.loads(blob)
                 if accept_fn is not None and \
@@ -177,11 +183,14 @@ class ElasticSampler(Sampler):
         auto-starts the instant this one finalizes, so completion may
         surface as a generation-id change rather than a done flag.
 
-        Returns ``(triples, tested)`` where ``tested`` maps slot ->
-        (particle, accepted) for every delivery already unpickled and
-        delayed-accept-tested here — the caller reuses them, so each
-        delivery is unpickled and (possibly expensively) re-distanced
-        exactly once."""
+        Returns ``(triples, tested)`` where ``tested[i]`` is the
+        (particle, accepted) pair for delivery ``triples[i]`` if it was
+        already unpickled and delayed-accept-tested here (None
+        otherwise) — the caller reuses them, so each delivery is
+        unpickled and (possibly expensively) re-distanced exactly once.
+        Indexing by delivery position is sound because the broker's
+        result list is append-only: every snapshot is a prefix of the
+        final list."""
         import time as _time
 
         deadline = (_time.time() + self.generation_timeout
@@ -195,7 +204,7 @@ class ElasticSampler(Sampler):
         n_seen = 0
         n_acc = 0
         accepted_parts: list = []
-        tested: dict[int, tuple] = {}
+        tested: list = []  # delivery-index aligned with the result list
         while True:
             triples, done, gen_now = self.broker.results_snapshot()
             if gen0 is None:
@@ -224,10 +233,11 @@ class ElasticSampler(Sampler):
                         ok = _apply_delayed(p, accept_fn)
                     else:
                         ok = bool(acc)
-                    tested[slot] = (p, ok)
+                    tested.append((p, ok))
                     if ok:
                         accepted_parts.append(p)
                 else:
+                    tested.append(None)
                     ok = bool(acc)
                 if ok:
                     n_acc += 1

@@ -180,7 +180,10 @@ def worker_cmd(host, port, worker_id, runtime_s, max_generations, log_file,
                 target=_run_worker_child, args=(host, port), kwargs=kw,
             ))
 
+        got_signal = []
+
         def _forward(signum, frame):
+            got_signal.append(signum)
             for p in procs:
                 if p.is_alive():
                     p.terminate()
@@ -202,12 +205,18 @@ def worker_cmd(host, port, worker_id, runtime_s, max_generations, log_file,
                     p.terminate()
             for sig, handler in old.items():
                 _signal.signal(sig, handler)
-        failed = [i for i, p in enumerate(procs) if p.exitcode not in (0, -15)]
-        if failed:
-            raise click.ClickException(
-                f"worker process(es) {failed} exited abnormally "
-                f"(exitcodes {[procs[i].exitcode for i in failed]})"
-            )
+        if not got_signal:
+            # after a forwarded/terminal-group SIGTERM/SIGINT any child
+            # exitcode is a normal shutdown (Ctrl-C delivers SIGINT to
+            # the whole foreground group, so children may die with
+            # KeyboardInterrupt before the parent's forward lands)
+            failed = [i for i, p in enumerate(procs)
+                      if p.exitcode not in (0, -15)]
+            if failed:
+                raise click.ClickException(
+                    f"worker process(es) {failed} exited abnormally "
+                    f"(exitcodes {[procs[i].exitcode for i in failed]})"
+                )
         click.echo(f"{processes} workers done", err=True)
         return
     n = run_worker(host, port, **kwargs)

@@ -940,12 +940,14 @@ class ABCSMC:
             return False
         tr = self.transitions[0]
         if type(tr) is LocalTransition:
-            # local-covariance KDE refits on device (dense pairwise +
+            # local-covariance KDE refits on device (blocked pairwise +
             # top_k) with the host _effective_k rule applied IN-KERNEL to
             # each model's dynamic accepted count — K>1 rides too. The
-            # static top_k bound comes from the schedule's max n.
+            # static top_k bound comes from the schedule's (or adaptive
+            # cap's) max n.
             if not isinstance(self.population_strategy,
-                              (ConstantPopulationSize, ListPopulationSize)):
+                              (ConstantPopulationSize, ListPopulationSize)) \
+                    and not self._fused_adaptive_n_capable():
                 return False
             for other in self.transitions:
                 # per-model refits share ONE traced device_fit config
@@ -973,11 +975,15 @@ class ABCSMC:
             # population (declared deviation: the host shuffles folds
             # within each model's own rows — same statistics, different
             # fold pattern)
-            if not isinstance(self.population_strategy,
-                              ConstantPopulationSize):
-                # the in-kernel fold assignment is host-static over the
-                # population size; a varying schedule could shrink below
-                # cv mid-chunk and diverge from host fold semantics
+            if isinstance(self.population_strategy, ConstantPopulationSize):
+                pass  # host-static fold assignment over the constant n
+            elif isinstance(self.population_strategy, ListPopulationSize):
+                # per-generation fold tables ride the chunk as a (G,
+                # n_cap) argument; every scheduled generation must keep
+                # at least cv rows so fold semantics match the host
+                if min(self.population_strategy.values) < tr.cv:
+                    return False
+            else:
                 return False
             if self.K != 1:
                 for other in self.transitions:
@@ -1023,46 +1029,67 @@ class ABCSMC:
         elif type(d) is PNormDistance:
             if d.sumstat is not None and not d.sumstat.is_device_compatible():
                 return False
-            # per-generation user weight schedules can't ride a chunk-
-            # constant carry (with or without a sumstat transform); a
-            # single default weight vector can
-            if any(k >= 0 for k in d.weights):
-                return False
+            # per-generation user weight schedules ride the chunk as a
+            # host-resolved (G, S) device_params table indexed by the
+            # in-scan generation (weight_sched mode)
         elif type(d) in (AggregatedDistance, AdaptiveAggregatedDistance):
             # weighted sum of plain p-norm sub-distances. Non-adaptive:
-            # params are chunk-constant. Adaptive: the per-generation
-            # 1/scale reweighting runs IN-KERNEL over the record ring
+            # params are chunk-constant, or a per-generation schedule
+            # (top-level and/or sub-weights) rides as a stacked
+            # device_params table. Adaptive: the per-generation 1/scale
+            # reweighting runs IN-KERNEL over the record ring
             # (device_record_reduce/device_weight_update twins)
             if type(d) is AdaptiveAggregatedDistance:
                 if not d.adaptive or d.log_file \
                         or d.device_scale_impl() is None:
                     return False
-            elif any(k >= 0 for k in d.weights):
-                # per-generation user weight schedules can't ride a
-                # chunk-constant carry
-                return False
             for sub in d.distances:
                 if (type(sub) is not PNormDistance
-                        or sub.sumstat is not None
-                        or any(k >= 0 for k in sub.weights)):
+                        or sub.sumstat is not None):
+                    return False
+                if type(d) is AdaptiveAggregatedDistance \
+                        and any(k >= 0 for k in sub.weights):
+                    # adaptive top-level reweighting owns the carry; a
+                    # sub-schedule on top would need both mechanisms
                     return False
         else:
             return False
         return True
 
+    def _weight_schedule_fused(self) -> bool:
+        """True when the (non-adaptive) distance carries per-generation
+        USER weight schedules that must be resolved per chunk generation
+        (PNormDistance ``weights={t: ...}``, AggregatedDistance top-level
+        or sub-distance schedules)."""
+        d = self.distance_function
+        if type(d) is PNormDistance:
+            return any(k >= 0 for k in d.weights)
+        if type(d) is AggregatedDistance:
+            return (any(k >= 0 for k in d.weights)
+                    or any(any(k >= 0 for k in sub.weights)
+                           for sub in d.distances))
+        return False
+
     def _fused_adaptive_n_capable(self) -> bool:
         """AdaptivePopulationSize configs whose bootstrap-CV bisection can
-        run IN-KERNEL (MultivariateNormalTransition.device_required_nr):
-        single model, plain MVN transition (the bandwidth gate runs in the
-        caller), and a finite max_population_size — static shapes are sized
-        to it, so an unbounded adaptive growth target cannot ride a chunk.
-        """
+        run IN-KERNEL (``transition.util.device_mean_cv`` /
+        ``device_required_nr`` generics): plain MVN or LocalTransition
+        per model (K>1 aggregates per-model CVs weighted by model
+        probabilities, reference ``calc_cv``), and a finite
+        max_population_size — static shapes are sized to it, so an
+        unbounded adaptive growth target cannot ride a chunk.
+        GridSearchCV stays on the host path (its host ``mean_cv``
+        delegates to the winning estimator chosen per generation, which
+        has no chunk-constant static config)."""
         from ..populationstrategy import AdaptivePopulationSize
 
         return (
             isinstance(self.population_strategy, AdaptivePopulationSize)
-            and self.K == 1
-            and type(self.transitions[0]) is MultivariateNormalTransition
+            and all(
+                type(tr) in (MultivariateNormalTransition, LocalTransition)
+                for tr in self.transitions
+            )
+            and len({type(tr) for tr in self.transitions}) == 1
             and np.isfinite(self.population_strategy.max_population_size)
         )
 
@@ -1190,16 +1217,24 @@ class ABCSMC:
                     ("k_fraction", tr.k_fraction),
                 ))
             elif type(tr) is GridSearchCV:
-                out.append((
+                statics = [
                     ("scalings", tuple(
                         float(s) for s in tr.param_grid["scaling"])),
                     ("cv", int(tr.cv)),
                     ("bandwidth_selector",
                      tr.estimator.bandwidth_selector),
+                ]
+                if isinstance(self.population_strategy,
+                              ListPopulationSize):
+                    # a varying schedule ships per-generation fold-id
+                    # rows as a dynamic chunk argument instead of the
+                    # static n-derived assignment
+                    pass
+                else:
                     # folds are assigned over the actual population size,
                     # matching the host fit on n accepted rows
-                    ("n", int(n)),
-                ))
+                    statics.append(("n", int(n)))
+                out.append(tuple(statics))
             else:
                 out.append((("scaling", tr.scaling),
                             ("bandwidth_selector", tr.bandwidth_selector)))
@@ -1379,8 +1414,15 @@ class ABCSMC:
             type(self.acceptor) is UniformAcceptor
             and self.acceptor.use_complete_history
         )
+        weight_sched = not adaptive and self._weight_schedule_fused()
+        fold_sched_mode = (
+            type(tr) is GridSearchCV
+            and isinstance(self.population_strategy, ListPopulationSize)
+        )
         kern = ctx.multigen_kernel(
             B, n_cap, rec_cap, max_rounds, G,
+            weight_sched=weight_sched,
+            fold_sched_mode=fold_sched_mode,
             adaptive=adaptive, eps_quantile=eps_quantile,
             eps_weighted=getattr(self.eps, "weighted", True),
             alpha=getattr(self.eps, "alpha", 0.5),
@@ -1425,6 +1467,41 @@ class ABCSMC:
             n_sched = np.full(G, n, np.int32)
             for g in range(g_limit):
                 n_sched[g] = self.population_strategy(t_at + g)
+            dist_sched = None
+            if weight_sched:
+                # resolve the user's per-generation weight schedule into a
+                # stacked device_params table (leading G axis); inactive
+                # tail generations reuse the last active row
+                rows = [
+                    self.distance_function.device_params(
+                        t_at + min(g, max(g_limit - 1, 0))
+                    )
+                    for g in range(G)
+                ]
+                dist_sched = jax.tree.map(
+                    lambda *xs: jnp.stack(
+                        [jnp.asarray(np.asarray(x, np.float32))
+                         for x in xs]
+                    ),
+                    *rows,
+                )
+            fold_sched = None
+            if fold_sched_mode:
+                # per-generation fold-id rows (GridSearchCV x
+                # ListPopulationSize): the shared fixed-seed rule applied
+                # to each generation's scheduled n; inactive tail
+                # generations reuse the last active row
+                from ..transition.grid_search import fold_ids
+
+                table = np.stack([
+                    fold_ids(
+                        min(int(n_sched[min(g, max(g_limit - 1, 0))]),
+                            n_cap),
+                        int(tr.cv), n_cap,
+                    )
+                    for g in range(G)
+                ])
+                fold_sched = jnp.asarray(table)
             return kern(
                 self._root_key, jnp.asarray(t_at, jnp.int32),
                 jnp.asarray(n_sched),
@@ -1433,6 +1510,8 @@ class ABCSMC:
                 jnp.asarray(eps_fixed),
                 jnp.asarray(minimum_epsilon, jnp.float32),
                 jnp.asarray(min_acceptance_rate, jnp.float32),
+                dist_sched,
+                fold_sched,
             )
 
         def _build_chunk_carry(t_at: int):

@@ -663,7 +663,9 @@ class DeviceContext:
                         temp_fixed: bool = False,
                         complete_history: bool = False,
                         sumstat_transform: bool = False,
-                        adaptive_n: tuple | None = None):
+                        adaptive_n: tuple | None = None,
+                        weight_sched: bool = False,
+                        fold_sched_mode: bool = False):
         """One jitted program for G WHOLE GENERATIONS (transition mode).
 
         The TPU-native endgame of the reference's per-generation scatter/
@@ -706,7 +708,8 @@ class DeviceContext:
                      eps_quantile, eps_weighted, alpha, multiplier,
                      trans_cls.__name__, fit_statics, dims,
                      stochastic, temp_config, temp_fixed, complete_history,
-                     sumstat_transform, adaptive_n)
+                     sumstat_transform, adaptive_n, weight_sched,
+                     fold_sched_mode)
         if cache_key in self._kernels:
             return self._kernels[cache_key]
         if stochastic and self.K != 1:
@@ -750,7 +753,8 @@ class DeviceContext:
         K = self.K
 
         def multigen_fn(root, t0, n_sched, g_limit, carry0, mpk_base,
-                        eps_fixed, min_eps, min_acc_rate):
+                        eps_fixed, min_eps, min_acc_rate, dist_sched=None,
+                        fold_sched=None):
             def run_lanes(key, dyn):
                 keys = jax.random.split(key, B)
                 if lane_sharding is not None:
@@ -799,12 +803,23 @@ class DeviceContext:
                     model_factor > 0,
                     jnp.log(jnp.maximum(model_factor, 1e-38)), -jnp.inf,
                 )
+                # per-generation USER weight schedules (PNormDistance
+                # weights={t: ...} / AggregatedDistance sub-weight
+                # schedules, non-adaptive): the host pre-resolves
+                # device_params(t0+g) for every generation of the chunk
+                # and ships them stacked on a leading G axis; the scan
+                # indexes its generation's row. The dist_w carry slot is
+                # untouched (it only matters for adaptive distances).
+                if weight_sched:
+                    dist_w_gen = jax.tree.map(lambda v: v[g], dist_sched)
+                else:
+                    dist_w_gen = dist_w
                 # non-stochastic with use_complete_history: the pdf_norm
                 # carry slot holds the running min of all past epsilons
                 # (UniformAcceptor.device_fn reads it as acc_params)
                 dyn = {
                     "eps": eps_g,
-                    "dist_params": dist_w,
+                    "dist_params": dist_w_gen,
                     "acc_params": (pdf_norm if stochastic or complete_history
                                    else ()),
                     "log_model_probs": log_model_probs,
@@ -914,11 +929,16 @@ class DeviceContext:
                 # for GridSearchCV)
                 trans_next = []
                 refit_ok = []
+                # GridSearchCV x ListPopulationSize: this generation's
+                # host-built fold-id row (the fixed-seed rule over ITS n)
+                fit_extra = (
+                    {"folds": fold_sched[g]} if fold_sched_mode else {}
+                )
                 for m in range(K):
                     fit_m = trans_cls.device_fit(
                         res["theta"],
                         jnp.where(m_arr == m, w_norm, 0.0),
-                        dim=dims[m], **dict(fit_statics[m]),
+                        dim=dims[m], **dict(fit_statics[m]), **fit_extra,
                     )
                     if min_count_of is not None:
                         ok = counts[m] >= min_count_of(dims[m])
@@ -971,24 +991,55 @@ class DeviceContext:
                     **temp_extra,
                 }
                 if adaptive_n is not None:
-                    # in-kernel AdaptivePopulationSize (K=1, MVN): the
-                    # bootstrap-CV bisection runs on the JUST-REFIT kernel —
-                    # exactly where the host's population_strategy.update
-                    # sits in the per-generation loop
+                    # in-kernel AdaptivePopulationSize: the bootstrap-CV
+                    # bisection runs on the JUST-REFIT kernels — exactly
+                    # where the host's population_strategy.update sits in
+                    # the per-generation loop. K>1 aggregates per-model
+                    # CVs weighted by the new model probabilities
+                    # (reference calc_cv: mw-weighted mean over the
+                    # fitted transitions); works for any transition class
+                    # with device_fit/device_logpdf twins (MVN,
+                    # LocalTransition) via the generic helpers.
+                    from ..transition.util import (
+                        device_mean_cv as _cv_generic,
+                        device_required_nr as _nr_generic,
+                    )
+
                     target_cv, min_n, max_n, n_boot = adaptive_n
-                    fit_kw = dict(fit_statics[0])
                     # bootstrap key OUTSIDE the proposal-round key space
                     # [0, max_rounds): fold_in(gen_key, r) seeds round r's
                     # lanes, so a tag below max_rounds would reuse a
                     # proposal stream for the CV resampling
+                    boot_key = jax.random.fold_in(gen_key, max_rounds)
+                    probs_sum = jnp.maximum(model_probs_next.sum(), 1e-38)
+
+                    def cv_at(nn):
+                        tot = jnp.zeros((), jnp.float32)
+                        for m in range(K):
+                            key_m = (boot_key if K == 1
+                                     else jax.random.fold_in(boot_key, m))
+                            cv_m = _cv_generic(
+                                trans_cls, trans_next[m], key_m, nn,
+                                dim=dims[m], n_bootstrap=n_boot,
+                                **dict(fit_statics[m]),
+                            )
+                            # dead models (p=0, possibly never-fitted
+                            # placeholder params whose CV is garbage)
+                            # contribute nothing — reference calc_cv
+                            # weighting zeroes them the same way
+                            tot = tot + jnp.where(
+                                model_probs_next[m] > 0,
+                                model_probs_next[m] / probs_sum * cv_m,
+                                0.0,
+                            )
+                        return tot
+
                     n_next = jax.lax.cond(
                         stopped_next,
                         lambda: n_target,
-                        lambda: trans_cls.device_required_nr(
-                            trans_next[0],
-                            jax.random.fold_in(gen_key, max_rounds),
-                            target_cv=target_cv, min_n=min_n, max_n=max_n,
-                            dim=dims[0], n_bootstrap=n_boot, **fit_kw,
+                        lambda: _nr_generic(
+                            cv_at, target_cv=target_cv, min_n=min_n,
+                            max_n=max_n,
                         ),
                     )
                     out["n_target"] = n_target

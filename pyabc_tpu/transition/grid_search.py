@@ -19,6 +19,21 @@ from .base import Transition
 from .multivariatenormal import _LOG_2PI, MultivariateNormalTransition
 
 
+def fold_ids(n_rows: int, cv: int, n_cap: int) -> np.ndarray:
+    """THE fixed-seed fold-assignment rule, shared by the static
+    device_fit path and the per-generation fold tables the fused
+    ListPopulationSize loop ships (smc.py) — one implementation, so the
+    two can never drift apart: ``arange(n_rows) % min(cv, n_rows)``
+    shuffled by ``default_rng(0)``; rows beyond ``n_rows`` get -1 (no
+    fold: always train with zero weight, never test)."""
+    n_folds = min(int(cv), int(n_rows))
+    out = np.full(int(n_cap), -1, np.int32)
+    head = np.arange(int(n_rows)) % n_folds
+    np.random.default_rng(0).shuffle(head)
+    out[: int(n_rows)] = head
+    return out
+
+
 class GridSearchCV(Transition):
     """Pick the best hyperparameters by K-fold held-out log-likelihood.
 
@@ -104,7 +119,8 @@ class GridSearchCV(Transition):
 
     @staticmethod
     def device_fit(thetas, weights, *, dim: int, scalings: tuple,
-                   cv: int, bandwidth_selector, n: int | None = None):
+                   cv: int, bandwidth_selector, n: int | None = None,
+                   folds=None):
         """Traceable twin of :meth:`fit` for the fused multi-generation
         run: IN-KERNEL cross-validated bandwidth selection.
 
@@ -120,33 +136,45 @@ class GridSearchCV(Transition):
         zero weight and are never test rows.
         """
         n_cap = thetas.shape[0]
-        n_rows = n_cap if n is None else min(int(n), n_cap)
-        n_folds = min(int(cv), n_rows)
-        folds_np = np.full(n_cap, -1)
-        head = np.arange(n_rows) % n_folds
-        np.random.default_rng(0).shuffle(head)
-        folds_np[:n_rows] = head
-        folds = jnp.asarray(folds_np)
         s_arr = jnp.asarray(scalings, jnp.float32)
         log_s = jnp.log(s_arr)
         scores = jnp.zeros(len(scalings), jnp.float32)
+        if folds is None:
+            n_rows = n_cap if n is None else min(int(n), n_cap)
+            folds_np = fold_ids(n_rows, int(cv), n_cap)
+            n_folds = min(int(cv), n_rows)
+            folds_arr = jnp.asarray(folds_np)
+        else:
+            # per-generation DYNAMIC fold assignment (ListPopulationSize
+            # fused runs): membership arrives as a traced (n_cap,) array
+            # built by the host with the same fixed-seed rule per that
+            # generation's n, so test rows are masked, not gathered
+            n_folds = int(cv)
+            folds_np = None
+            folds_arr = folds
         for f in range(n_folds):
-            train_w = jnp.where(folds != f, weights, 0.0)
-            # fold membership is host-side static: gather the test rows so
-            # the per-fold scoring costs ~1/cv of the full maha matrix
-            test_idx = np.where(folds_np == f)[0]
+            train_w = jnp.where(folds_arr != f, weights, 0.0)
             fit_f = MultivariateNormalTransition.device_fit(
                 thetas, train_w, dim=dim, scaling=1.0,
                 bandwidth_selector=bandwidth_selector,
             )
-            q = thetas[test_idx]
-            qw = weights[test_idx]
+            if folds_np is not None:
+                # fold membership is host-side static: gather the test
+                # rows so the per-fold scoring costs ~1/cv of the full
+                # maha matrix
+                test_idx = np.where(folds_np == f)[0]
+                q = thetas[test_idx]
+                qw = weights[test_idx]
+            else:
+                q = thetas
+                qw = jnp.where(folds_arr == f, weights, 0.0)
             # host parity (grid_search.py fit): a fold whose train split
             # holds < 2 of this model's rows, or whose test split holds
             # none, is SKIPPED — critical for per-model masked weights in
             # multimodel fused runs, where a small model's rows may all
             # land in one row-indexed fold and the zero-weight fit would
-            # otherwise score garbage
+            # otherwise score garbage (and for dynamic fold tables where
+            # a small generation uses fewer than cv fold ids)
             fold_ok = ((train_w > 0).sum() >= 2) & ((qw > 0).sum() >= 1)
             diff = q[:, None, :] - fit_f["thetas"][None, :, :]
             maha = jnp.einsum("qnd,de,qne->qn", diff, fit_f["prec"], diff)

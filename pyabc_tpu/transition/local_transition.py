@@ -126,13 +126,14 @@ class LocalTransition(Transition):
     @staticmethod
     def device_fit(thetas, weights, *, dim: int, scaling: float,
                    k: int | None = None, k_cap: int | None = None,
-                   k_fixed: int = -1, k_fraction: float = 0.25):
+                   k_fixed: int = -1, k_fraction: float = 0.25,
+                   block_rows: int | None = None):
         """Traceable twin of :meth:`fit` for the fused multi-generation run.
 
         ``thetas (n_cap, d_max)`` zero-padded accepted particles,
         ``weights (n_cap,)`` normalized with zeros on empty slots. Neighbor
-        search is the same dense pairwise-distance + ``top_k`` as the host
-        path (invalid slots are excluded as neighbor CANDIDATES via an inf
+        search is the same pairwise-distance + ``top_k`` as the host path
+        (invalid slots are excluded as neighbor CANDIDATES via an inf
         distance; their own rows get finite jittered covariances but carry
         zero weight, so they are never resampled and contribute nothing to
         the mixture pdf).
@@ -145,6 +146,15 @@ class LocalTransition(Transition):
         static top_k bound (the rule's value at the full population);
         ``k`` forces a fixed static count (back-compat shorthand for
         k_cap=k_fixed=k).
+
+        SURVEY.md §7.3.4 scale note: beyond n ~ 1e4 the dense
+        (n, n, d) difference tensor is the first thing to fall off a
+        v5e's HBM, so large populations TILE the neighbor search over
+        row blocks (``block_rows``, auto-chosen: dense to 4096, 2048-row
+        tiles above). Each tile computes its (block, n) squared
+        distances via the MXU decomposition |x|^2 - 2 x.y + |y|^2 —
+        peak memory O(block * n) instead of O(n^2 * d) — and only the
+        (n, k_cap) neighbor indices are kept.
         """
         n_cap, d_max = thetas.shape
         if k is not None:
@@ -167,39 +177,89 @@ class LocalTransition(Transition):
         ).astype(np.int32)
         k_dyn = jnp.minimum(jnp.asarray(k_table)[c], k_cap)
         X = thetas * vmask[None, :]
-        diff = X[:, None, :] - X[None, :, :]
-        sq = (diff * diff).sum(-1)
-        sq = jnp.where(valid[None, :], sq, jnp.inf)
-        _, nn_idx = jax.lax.top_k(-sq, k_cap)  # k_cap smallest, self incl.
-        # dynamic-k mask: positions beyond k_dyn and invalid candidates
-        # (possible when a model's count is below k_cap) contribute nothing
-        pos_ok = (jnp.arange(k_cap)[None, :] < k_dyn) & valid[nn_idx]
-        neigh = X[nn_idx]  # (n_cap, k_cap, d_max)
-        centered = (neigh - X[:, None, :]) * pos_ok[..., None]
-        cov = jnp.einsum("nkd,nke->nde", centered, centered) \
-            / jnp.maximum(k_dyn, 1)
         factor = silverman_rule_of_thumb(
             k_dyn.astype(thetas.dtype), dim
         ) * scaling
-        cov = cov * factor**2
-        # host regularization: relative jitter on the REAL diagonal; padded
-        # dims get a unit diagonal so the factorization is well-posed (they
-        # are zeroed out of the outputs below, like pad_transition_params)
-        tr = jnp.trace(cov, axis1=1, axis2=2) / dim
-        jit = jnp.maximum(tr, 1e-10) * LocalTransition.EPS
-        diag_add = jit[:, None] * vmask[None, :] + (1.0 - vmask)[None, :]
-        cov = cov * outer[None] + jax.vmap(jnp.diag)(diag_add)
-        chols = jnp.linalg.cholesky(cov)
-        precs = jnp.linalg.inv(cov) * outer[None]
-        logdets = 2.0 * jnp.sum(
-            vmask[None, :] * jnp.log(jnp.maximum(
-                jnp.diagonal(chols, axis1=1, axis2=2), 1e-38)),
-            axis=1,
-        )
+
+        def _covs_from_idx(rows_X, nn_idx_t):
+            """Per-row covariances -> (chol, prec, logdet) for a block of
+            rows given their neighbor indices (into the FULL X)."""
+            # dynamic-k mask: positions beyond k_dyn and invalid
+            # candidates (possible when a model's count is below k_cap)
+            # contribute nothing
+            pos_ok = (jnp.arange(k_cap)[None, :] < k_dyn) & valid[nn_idx_t]
+            neigh = X[nn_idx_t]  # (rows, k_cap, d_max)
+            centered = (neigh - rows_X[:, None, :]) * pos_ok[..., None]
+            cov = jnp.einsum("nkd,nke->nde", centered, centered) \
+                / jnp.maximum(k_dyn, 1)
+            cov = cov * factor**2
+            # host regularization: relative jitter on the REAL diagonal;
+            # padded dims get a unit diagonal so the factorization is
+            # well-posed (they are zeroed out of the outputs, like
+            # pad_transition_params)
+            tr = jnp.trace(cov, axis1=1, axis2=2) / dim
+            jit = jnp.maximum(tr, 1e-10) * LocalTransition.EPS
+            diag_add = jit[:, None] * vmask[None, :] + (1.0 - vmask)[None, :]
+            cov = cov * outer[None] + jax.vmap(jnp.diag)(diag_add)
+            chols_t = jnp.linalg.cholesky(cov)
+            precs_t = jnp.linalg.inv(cov) * outer[None]
+            logdets_t = 2.0 * jnp.sum(
+                vmask[None, :] * jnp.log(jnp.maximum(
+                    jnp.diagonal(chols_t, axis1=1, axis2=2), 1e-38)),
+                axis=1,
+            )
+            return chols_t * outer[None], precs_t, logdets_t
+
+        if block_rows is None:
+            if n_cap <= 4096:
+                block_rows = n_cap
+            else:
+                # largest divisor of n_cap <= 2048 (pow2 n_cap from the
+                # fused loop hits 2048 exactly); an awkward n_cap with no
+                # decent divisor falls back to the dense path rather than
+                # rejecting a shape the old contract accepted
+                block_rows = next(
+                    (b for b in range(2048, 0, -1) if n_cap % b == 0), 1
+                )
+                if block_rows < 256:
+                    block_rows = n_cap
+        block_rows = min(block_rows, n_cap)
+        if block_rows >= n_cap:
+            diff = X[:, None, :] - X[None, :, :]
+            sq = (diff * diff).sum(-1)
+            sq = jnp.where(valid[None, :], sq, jnp.inf)
+            # k_cap smallest, self included
+            _, nn_idx = jax.lax.top_k(-sq, k_cap)
+            chols, precs, logdets = _covs_from_idx(X, nn_idx)
+        else:
+            if n_cap % block_rows:
+                raise ValueError(
+                    f"block_rows={block_rows} must divide n_cap={n_cap}"
+                )
+            norms = (X * X).sum(-1)
+
+            def _tile(args):
+                Xt, nt = args  # (block, d), (block,)
+                sqt = nt[:, None] + norms[None, :] - 2.0 * (Xt @ X.T)
+                # the decomposition can go slightly negative for
+                # near-duplicate points; clamping keeps self-distance 0
+                sqt = jnp.maximum(sqt, 0.0)
+                sqt = jnp.where(valid[None, :], sqt, jnp.inf)
+                idx_t = jax.lax.top_k(-sqt, k_cap)[1]
+                return _covs_from_idx(Xt, idx_t)
+
+            chols, precs, logdets = jax.lax.map(
+                _tile,
+                (X.reshape(-1, block_rows, d_max),
+                 norms.reshape(-1, block_rows)),
+            )
+            chols = chols.reshape(n_cap, d_max, d_max)
+            precs = precs.reshape(n_cap, d_max, d_max)
+            logdets = logdets.reshape(n_cap)
         return {
             "thetas": X,
             "weights": w,
-            "chols": chols * outer[None],
+            "chols": chols,
             "precs": precs,
             "logdets": logdets,
             "dim": jnp.float32(dim),

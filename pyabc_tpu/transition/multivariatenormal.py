@@ -218,50 +218,27 @@ class MultivariateNormalTransition(Transition):
     @staticmethod
     def device_mean_cv(params, key, n, *, dim: int, scaling: float,
                        bandwidth_selector: Callable, n_bootstrap: int):
-        """Traceable twin of :meth:`Transition.mean_cv` (reference
-        ``pyabc/transition/base.py::Transition.mean_cv``): bootstrap CV of
-        the KDE density at resample size ``n`` (a traced int32), evaluated
-        at the fitted particles and weighted by their weights. Padding
-        lanes carry zero weight and contribute nothing on either side."""
-        thetas, w = params["thetas"], params["weights"]
-        n_cap = thetas.shape[0]
-        logw = jnp.where(w > 0, jnp.log(jnp.maximum(w, 1e-38)), -jnp.inf)
-        # bootstrap sample of size n inside static shapes: draw n_cap
-        # ancestors, weight the first n uniformly, zero the rest
-        boot_w = jnp.where(
-            jnp.arange(n_cap) < n, 1.0 / jnp.maximum(n, 1), 0.0
-        ).astype(thetas.dtype)
+        """Traceable twin of :meth:`Transition.mean_cv` — see the generic
+        ``transition.util.device_mean_cv`` (shared with LocalTransition
+        for the K>1 / non-MVN fused adaptive-n paths)."""
+        from .util import device_mean_cv as _generic
 
-        def one_boot(k):
-            idx = jax.random.categorical(k, logw, shape=(n_cap,))
-            p = MultivariateNormalTransition.device_fit(
-                thetas[idx], boot_w, dim=dim, scaling=scaling,
-                bandwidth_selector=bandwidth_selector,
-            )
-            return jax.vmap(
-                lambda th: MultivariateNormalTransition.device_logpdf(th, p)
-            )(thetas)
-
-        logdens = jax.vmap(one_boot)(jax.random.split(key, n_bootstrap))
-        # CV is scale-invariant: shift by the per-point max log-density so
-        # the f32 exp cannot overflow for concentrated late-generation KDEs
-        # (an inf mean would NaN the CV and pin the bisection at max_n)
-        dens = jnp.exp(logdens - logdens.max(axis=0, keepdims=True))
-        mean = dens.mean(axis=0)
-        std = dens.std(axis=0)
-        cvs = jnp.where(mean > 0, std / mean, 0.0)
-        return jnp.sum(w * cvs) / jnp.maximum(w.sum(), 1e-38)
+        return _generic(
+            MultivariateNormalTransition, params, key, n, dim=dim,
+            n_bootstrap=n_bootstrap, scaling=scaling,
+            bandwidth_selector=bandwidth_selector,
+        )
 
     @staticmethod
     def device_required_nr(params, key, *, target_cv: float, min_n: int,
                            max_n: int, dim: int, scaling: float,
                            bandwidth_selector: Callable, n_bootstrap: int):
         """Traceable twin of ``AdaptivePopulationSize.update``'s bisection
-        (reference ``pyabc/populationstrategy.py``): smallest n in
-        [min_n, max_n] whose bootstrap CV is below ``target_cv``, or max_n
-        when the target is unreachable. One key for every probe — the same
-        common-random-numbers discipline as the host's per-call
-        ``default_rng(0)``, which keeps cv(n) monotone-ish in n."""
+        (reference ``pyabc/populationstrategy.py``). One key for every
+        probe — the same common-random-numbers discipline as the host's
+        per-call ``default_rng(0)``, which keeps cv(n) monotone-ish in
+        n. Generic machinery: ``transition.util.device_required_nr``."""
+        from .util import device_required_nr as _generic_nr
 
         def cv_at(n):
             return MultivariateNormalTransition.device_mean_cv(
@@ -270,27 +247,8 @@ class MultivariateNormalTransition(Transition):
                 n_bootstrap=n_bootstrap,
             )
 
-        cv_hi = cv_at(jnp.asarray(max_n, jnp.int32))
-
-        def body(state):
-            lo, hi = state
-            mid = (lo + hi) // 2
-            ok = cv_at(mid) <= target_cv
-            return (jnp.where(ok, lo, mid + 1), jnp.where(ok, mid, hi))
-
-        def bisect():
-            _, hi = jax.lax.while_loop(
-                lambda s: s[0] < s[1], body,
-                (jnp.asarray(min_n, jnp.int32),
-                 jnp.asarray(max_n, jnp.int32)),
-            )
-            return hi
-
-        # host short-circuit parity: an unreachable target returns max_n
-        # without paying the ~log2(max_n) dead bisection probes
-        return jax.lax.cond(
-            cv_hi > target_cv,
-            lambda: jnp.asarray(max_n, jnp.int32), bisect,
+        return _generic_nr(
+            cv_at, target_cv=target_cv, min_n=min_n, max_n=max_n,
         )
 
     def __repr__(self):

@@ -45,3 +45,74 @@ def silverman_rule_of_thumb(n_samples: float, dimension: int) -> float:
     return (4 / (dimension + 2)) ** (1 / (dimension + 4)) * n_samples ** (
         -1 / (dimension + 4)
     )
+
+
+def device_mean_cv(trans_cls, params, key, n, *, dim: int,
+                   n_bootstrap: int, **fit_kwargs):
+    """Traceable twin of :meth:`Transition.mean_cv` for ANY transition
+    class with ``device_fit``/``device_logpdf`` twins (reference
+    ``pyabc/transition/base.py::Transition.mean_cv`` /
+    ``pyabc/cv/bootstrap.py``): bootstrap CV of the KDE density at
+    resample size ``n`` (a traced int32), evaluated at the fitted
+    particles and weighted by their weights. Padding lanes carry zero
+    weight and contribute nothing on either side."""
+    import jax
+    import jax.numpy as jnp
+
+    thetas, w = params["thetas"], params["weights"]
+    n_cap = thetas.shape[0]
+    logw = jnp.where(w > 0, jnp.log(jnp.maximum(w, 1e-38)), -jnp.inf)
+    # bootstrap sample of size n inside static shapes: draw n_cap
+    # ancestors, weight the first n uniformly, zero the rest
+    boot_w = jnp.where(
+        jnp.arange(n_cap) < n, 1.0 / jnp.maximum(n, 1), 0.0
+    ).astype(thetas.dtype)
+
+    def one_boot(k):
+        idx = jax.random.categorical(k, logw, shape=(n_cap,))
+        p = trans_cls.device_fit(
+            thetas[idx], boot_w, dim=dim, **fit_kwargs,
+        )
+        return jax.vmap(lambda th: trans_cls.device_logpdf(th, p))(thetas)
+
+    logdens = jax.vmap(one_boot)(jax.random.split(key, n_bootstrap))
+    # CV is scale-invariant: shift by the per-point max log-density so
+    # the f32 exp cannot overflow for concentrated late-generation KDEs
+    # (an inf mean would NaN the CV and pin the bisection at max_n)
+    dens = jnp.exp(logdens - logdens.max(axis=0, keepdims=True))
+    mean = dens.mean(axis=0)
+    std = dens.std(axis=0)
+    cvs = jnp.where(mean > 0, std / mean, 0.0)
+    return jnp.sum(w * cvs) / jnp.maximum(w.sum(), 1e-38)
+
+
+def device_required_nr(cv_at, *, target_cv: float, min_n: int, max_n: int):
+    """Traceable bisection twin of ``AdaptivePopulationSize.update``
+    (reference ``pyabc/populationstrategy.py``) over an arbitrary
+    ``cv_at(n)`` — e.g. the model-probability-weighted aggregate CV of
+    K fitted transitions (reference ``calc_cv``). Smallest n in
+    [min_n, max_n] whose CV is below ``target_cv``, or max_n when the
+    target is unreachable."""
+    import jax
+    import jax.numpy as jnp
+
+    cv_hi = cv_at(jnp.asarray(max_n, jnp.int32))
+
+    def body(state):
+        lo, hi = state
+        mid = (lo + hi) // 2
+        ok = cv_at(mid) <= target_cv
+        return (jnp.where(ok, lo, mid + 1), jnp.where(ok, mid, hi))
+
+    def bisect():
+        _, hi = jax.lax.while_loop(
+            lambda s: s[0] < s[1], body,
+            (jnp.asarray(min_n, jnp.int32), jnp.asarray(max_n, jnp.int32)),
+        )
+        return hi
+
+    # host short-circuit parity: an unreachable target returns max_n
+    # without paying the ~log2(max_n) dead bisection probes
+    return jax.lax.cond(
+        cv_hi > target_cv, lambda: jnp.asarray(max_n, jnp.int32), bisect,
+    )

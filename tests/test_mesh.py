@@ -165,3 +165,30 @@ class TestMeshedFusedChunks:
         assert mu_m == pytest.approx(POST_MU, abs=0.25)
         assert mu_m == pytest.approx(mu_s, abs=0.2)
         assert sd_m == pytest.approx(sd_s, abs=0.15)
+
+    def test_fused_chunk_large_population_on_mesh(self):
+        """Round-4 verdict Weak #5: nothing exercised sharded collectives
+        at a realistic population. Pop 2048 with a G=4 fused chunk on the
+        8-device mesh — (B >= 4096, n_cap 2048) sharded shapes, in-kernel
+        adaptive-distance reweighting and transition refit — must agree
+        with the single-device run on the posterior."""
+        prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+        results = {}
+        for name, mesh in (("single", None), ("mesh", _mesh())):
+            abc = pt.ABCSMC(_gauss_model(), prior,
+                            pt.AdaptivePNormDistance(p=2),
+                            population_size=2048, eps=pt.MedianEpsilon(),
+                            seed=29, mesh=mesh, fused_generations=4)
+            assert abc._fused_chunk_capable()
+            abc.new("sqlite://", {"x": X_OBS}, store_sum_stats=False)
+            h = abc.run(max_nr_populations=5)  # gen0 + one G=4 chunk
+            assert h.n_populations == 5
+            assert h.get_telemetry(3).get("fused_chunk") == 4
+            counts = h.get_nr_particles_per_population()
+            assert all(counts[t] == 2048 for t in range(5))
+            results[name] = _moments(h)
+        mu_s, sd_s = results["single"]
+        mu_m, sd_m = results["mesh"]
+        assert mu_m == pytest.approx(POST_MU, abs=0.15)
+        assert mu_m == pytest.approx(mu_s, abs=0.1)
+        assert sd_m == pytest.approx(sd_s, abs=0.08)
