@@ -205,18 +205,19 @@ def worker_cmd(host, port, worker_id, runtime_s, max_generations, log_file,
                     p.terminate()
             for sig, handler in old.items():
                 _signal.signal(sig, handler)
-        if not got_signal:
-            # after a forwarded/terminal-group SIGTERM/SIGINT any child
-            # exitcode is a normal shutdown (Ctrl-C delivers SIGINT to
-            # the whole foreground group, so children may die with
-            # KeyboardInterrupt before the parent's forward lands)
-            failed = [i for i, p in enumerate(procs)
-                      if p.exitcode not in (0, -15)]
-            if failed:
-                raise click.ClickException(
-                    f"worker process(es) {failed} exited abnormally "
-                    f"(exitcodes {[procs[i].exitcode for i in failed]})"
-                )
+        # tolerate signal-driven deaths after a forwarded/terminal-group
+        # SIGTERM/SIGINT (Ctrl-C delivers SIGINT to the whole foreground
+        # group, so children may die with KeyboardInterrupt before the
+        # parent's forward lands) — but a child that crashed for another
+        # reason (OOM kill, segfault) must still surface
+        ok_codes = {0, -15} | ({-2, 1} if got_signal else set())
+        failed = [i for i, p in enumerate(procs)
+                  if p.exitcode not in ok_codes]
+        if failed:
+            raise click.ClickException(
+                f"worker process(es) {failed} exited abnormally "
+                f"(exitcodes {[procs[i].exitcode for i in failed]})"
+            )
         click.echo(f"{processes} workers done", err=True)
         return
     n = run_worker(host, port, **kwargs)

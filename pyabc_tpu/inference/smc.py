@@ -1193,6 +1193,24 @@ class ABCSMC:
         return any(self._distance_may_change(sub)
                    for sub in getattr(d, "distances", ()) or ())
 
+    def _template_transition(self):
+        """A throwaway FITTED transition of the configured class, used
+        only for its ``device_params`` pytree structure (zeroed into the
+        first-chunk carry of a prior-mode fused run)."""
+        import pandas as pd
+
+        cp = self.transitions[0].copy_unfitted()
+        space = self.parameter_priors[0].space
+        dim = space.dim
+        names = list(space.names)
+        rows = max(dim + 2, 4)
+        X = pd.DataFrame(
+            np.random.default_rng(0).normal(size=(rows, dim)),
+            columns=names,
+        )
+        cp.fit(X, np.full(rows, 1.0 / rows))
+        return cp
+
     def _transition_fit_statics(self, n: int) -> tuple:
         """Per-model static kwargs for the in-kernel ``device_fit`` refits.
 
@@ -1311,7 +1329,18 @@ class ABCSMC:
         sims_total = self.history.total_nr_simulations
         n = self.population_strategy(t)
 
-        if t == 0:
+        # learned/transformed statistics ride the chunk as constant device
+        # params with host boundary refits; generation 0 stays on the host
+        # there (the refit machinery owns the t=0 bring-up). Every other
+        # fresh fused run puts generation 0 INSIDE the first chunk
+        # (prior-mode first generation): the whole run becomes one
+        # dispatch chain with no synchronous gen-0 round trip.
+        _sumstat_mode_early = getattr(
+            self.distance_function, "sumstat", None
+        ) is not None
+        first_gen_prior = (t == 0) and not _sumstat_mode_early
+
+        if t == 0 and not first_gen_prior:
             current_eps = self.eps(0)
             if hasattr(self.acceptor, "note_epsilon"):
                 self.acceptor.note_epsilon(0, current_eps, False)
@@ -1423,6 +1452,7 @@ class ABCSMC:
             B, n_cap, rec_cap, max_rounds, G,
             weight_sched=weight_sched,
             fold_sched_mode=fold_sched_mode,
+            first_gen_prior=first_gen_prior,
             adaptive=adaptive, eps_quantile=eps_quantile,
             eps_weighted=getattr(self.eps, "weighted", True),
             alpha=getattr(self.eps, "alpha", 0.5),
@@ -1527,9 +1557,17 @@ class ABCSMC:
                 (x for x in self.transitions if x.X is not None), None
             )
             if ref_fitted is None:
-                raise RuntimeError(
-                    "no fitted transition to start a fused chunk"
-                )
+                if t_at != 0:
+                    raise RuntimeError(
+                        "no fitted transition to start a fused chunk"
+                    )
+                # prior-mode first chunk: nothing is fitted yet. A
+                # throwaway fit on standard-normal dummies provides the
+                # params pytree STRUCTURE; the leaves are zeroed below
+                # (fitted0 stays all-False, generation 0 proposes from
+                # the prior, and the in-kernel refit replaces these
+                # before any transition proposal reads them).
+                ref_fitted = self._template_transition()
             for m, tr_m in enumerate(self.transitions):
                 if tr_m.X is not None:
                     raw = jax.tree.map(np.asarray, tr_m.device_params())
@@ -2428,12 +2466,17 @@ class ABCSMC:
             # one coerced host fetch (row-wise indexing of a device ring
             # would be one RPC per row over a TPU tunnel)
             ss_mat = np.asarray(all_ss(), np.float64)
-            calib_distances = np.asarray([
-                self.distance_function(
-                    self.spec.unflatten(ss_mat[i]), self.x_0, 0
-                )
-                for i in range(ss_mat.shape[0])
-            ])
+            batch = getattr(self.distance_function, "host_batch", None)
+            calib_distances = batch(
+                ss_mat, self.spec.flatten_host(self.x_0), 0
+            ) if batch is not None else None
+            if calib_distances is None:
+                calib_distances = np.asarray([
+                    self.distance_function(
+                        self.spec.unflatten(ss_mat[i]), self.x_0, 0
+                    )
+                    for i in range(ss_mat.shape[0])
+                ])
         else:
             self.distance_function.initialize(0, None, self.x_0)
 

@@ -665,7 +665,8 @@ class DeviceContext:
                         sumstat_transform: bool = False,
                         adaptive_n: tuple | None = None,
                         weight_sched: bool = False,
-                        fold_sched_mode: bool = False):
+                        fold_sched_mode: bool = False,
+                        first_gen_prior: bool = False):
         """One jitted program for G WHOLE GENERATIONS (transition mode).
 
         The TPU-native endgame of the reference's per-generation scatter/
@@ -709,7 +710,7 @@ class DeviceContext:
                      trans_cls.__name__, fit_statics, dims,
                      stochastic, temp_config, temp_fixed, complete_history,
                      sumstat_transform, adaptive_n, weight_sched,
-                     fold_sched_mode)
+                     fold_sched_mode, first_gen_prior)
         if cache_key in self._kernels:
             return self._kernels[cache_key]
         if stochastic and self.K != 1:
@@ -762,6 +763,20 @@ class DeviceContext:
                         keys, lane_sharding
                     )
                 return jax.vmap(lambda k: lane(k, dyn))(keys)
+
+            def run_lanes_prior(key, dyn):
+                # generation 0 inside the chunk (first_gen_prior):
+                # proposals come straight from the prior; both lane
+                # variants return identical output trees, so the
+                # generation chooses per-t via lax.cond below
+                keys = jax.random.split(key, B)
+                if lane_sharding is not None:
+                    keys = jax.lax.with_sharding_constraint(
+                        keys, lane_sharding
+                    )
+                return jax.vmap(
+                    lambda k: self._lane_prior(k, dyn)
+                )(keys)
 
             def gen_step(carry, g):
                 if adaptive_n is not None:
@@ -829,10 +844,23 @@ class DeviceContext:
                 }
 
                 def run_gen(_):
-                    return self._generation_while(
-                        gen_key, dyn, n_target, B=B, n_cap=n_cap,
-                        rec_cap=rec_cap, max_rounds=max_rounds,
-                        run_lanes=run_lanes, record_proposal=stochastic,
+                    def _with(lanes):
+                        return self._generation_while(
+                            gen_key, dyn, n_target, B=B, n_cap=n_cap,
+                            rec_cap=rec_cap, max_rounds=max_rounds,
+                            run_lanes=lanes, record_proposal=stochastic,
+                        )
+
+                    if not first_gen_prior:
+                        return _with(run_lanes)
+                    # a whole run in one dispatch chain: generation 0
+                    # proposes from the PRIOR (the host used to run it
+                    # through the single-generation kernel, paying an
+                    # extra synchronous round trip per run)
+                    return jax.lax.cond(
+                        t == 0,
+                        lambda: _with(run_lanes_prior),
+                        lambda: _with(run_lanes),
                     )
 
                 def skip_gen(_):

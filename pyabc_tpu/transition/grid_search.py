@@ -59,9 +59,7 @@ class GridSearchCV(Transition):
         self.store_fit_params(X, w)
         n = len(X)
         n_folds = min(self.cv, n)
-        folds = np.arange(n) % n_folds
-        rng = np.random.default_rng(0)
-        rng.shuffle(folds)
+        folds = fold_ids(n, self.cv, n)
         best_score, best_params = -np.inf, None
         for params in self._candidates():
             scores = []

@@ -6,10 +6,12 @@ so the two entry points cannot drift apart (round-2 advisor finding: the
 CLI re-hardcoded the generation count by hand).
 
 Sizing rationale lives with the numbers:
-- ``(DEFAULT_GENS + 1)`` must be a multiple of ``DEFAULT_G`` so no stub
-  tail chunk is scheduled; 31 with G=8 gives chunks t=1..8, 9..16,
-  17..24, 25..32, staying just clear of the deep-schedule acceptance
-  collapse (MedianEpsilon at the noise floor, t >~ 33).
+- ``(DEFAULT_GENS + 2)`` must be a multiple of ``DEFAULT_G`` so no stub
+  tail chunk is scheduled: since round 5 generation 0 rides the FIRST
+  chunk (prior-mode first generation), so a run is exactly
+  ``(GENS + 2) / G`` full chunks — 30 with G=8 gives chunks t=0..7,
+  8..15, 16..23, 24..31, staying just clear of the deep-schedule
+  acceptance collapse (MedianEpsilon at the noise floor, t >~ 33).
 - Round 3 (synchronous per-chunk fetch): G=16 beat G=8 (83k vs 45k pps)
   by halving the per-generation share of the ~0.1s tunnel sync. Round 4's
   THREADED fetch pipeline (ABCSMC fetch_pipeline_depth) hides that
@@ -20,7 +22,7 @@ Sizing rationale lives with the numbers:
 """
 
 DEFAULT_POP = 1000
-DEFAULT_GENS = 31
+DEFAULT_GENS = 30
 DEFAULT_G = 8
 DEFAULT_BUDGET_S = 300.0
 # wall-window width for the strict global-clock median (a few chunk

@@ -88,5 +88,6 @@ def test_bench_defaults_single_source():
                     f"{fname}: literal default for {node.args[0].value}; "
                     "use pyabc_tpu.utils.bench_defaults"
                 )
-    # the G-alignment invariant the sizing comment promises
-    assert (bd.DEFAULT_GENS + 1) % bd.DEFAULT_G == 0
+    # the G-alignment invariant the sizing comment promises: gen 0
+    # rides the first chunk (round 5), so a run is (GENS + 2)/G chunks
+    assert (bd.DEFAULT_GENS + 2) % bd.DEFAULT_G == 0

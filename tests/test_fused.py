@@ -110,9 +110,10 @@ def test_fused_multiple_chunks_advance():
     eps = h.get_all_populations().query("t >= 0")["epsilon"].to_numpy()
     # strictly decreasing across chunk boundaries (t=1..6 adaptive)
     assert (np.diff(eps[1:]) < 0).all(), eps
-    # chunk indices advance
-    cis = [h.get_telemetry(t).get("chunk_index") for t in range(1, 7)]
-    assert cis == [1, 1, 2, 2, 3, 3], cis
+    # chunk indices advance; generation 0 rides the FIRST chunk
+    # (prior-mode first generation, round 5)
+    cis = [h.get_telemetry(t).get("chunk_index") for t in range(7)]
+    assert cis == [1, 1, 2, 2, 3, 3, 4], cis
 
 
 def test_fused_fixed_distance_and_list_epsilon():
