@@ -654,7 +654,16 @@ class ABCSMC:
 
         t0 = self.history.max_t + 1
         if t0 == 0:
-            self._initialize_components(max_nr_populations)
+            # the fused loop may own calibration (in-kernel, inside the
+            # first chunk) — then the host round trip is skipped and the
+            # epsilon/weights mirrors arrive with the first chunk's fetch
+            skip_cal = (
+                self._fused_chunk_capable()
+                and getattr(self.distance_function, "sumstat", None) is None
+                and self._fused_calibration_cfg() is not None
+            )
+            self._initialize_components(max_nr_populations,
+                                        skip_calibration=skip_cal)
         else:
             self._restore_state(t0 - 1, max_nr_populations)
 
@@ -1070,6 +1079,72 @@ class ABCSMC:
                            for sub in d.distances))
         return False
 
+    def _fused_calibration_cfg(self) -> tuple | None:
+        """(n_calib, calib_w, calib_eps) when the FIRST fused chunk can
+        run the calibration generation in-kernel (prior round at
+        eps=+inf; adaptive distances take initial 1/scale weights from
+        it, a from-sample quantile epsilon takes eps_0) — removing the
+        host calibration round trip from every fresh run. None = host
+        calibration (reference ABCSMC._initialize_dist_eps_acc path).
+
+        Declared deviation: the in-kernel calibration sample keeps only
+        VALID simulations (NaN/invalid rows are excluded), where the
+        host path accepts every row unconditionally — for a model that
+        can produce non-finite statistics the host median would be
+        poisoned anyway."""
+        from ..epsilon import QuantileEpsilon
+
+        d = self.distance_function
+        if getattr(d, "sumstat", None) is not None:
+            # learned-statistic scales must be fit in the TRANSFORMED
+            # feature space; the in-kernel calibration reduces raw
+            # sumstats, so that configuration stays host-side
+            return None
+        calib_w = bool(d.requires_calibration())
+        calib_eps = bool(self.eps.requires_calibration())
+        if self.acceptor.requires_calibration():
+            return None  # stochastic pdf-norm init stays on the host
+        if not (calib_w or calib_eps):
+            return None
+        if type(self.acceptor) is not UniformAcceptor \
+                or self.acceptor.use_complete_history:
+            return None
+        if calib_w and not (
+            type(d) in (AdaptivePNormDistance, AdaptiveAggregatedDistance)
+            and d.adaptive
+        ):
+            # the in-kernel scale machinery IS the calibration fit; a
+            # calibration-requiring distance without it stays host-side
+            return None
+        if calib_eps and not isinstance(self.eps, QuantileEpsilon):
+            return None
+        n_cal = (self.population_strategy.nr_calibration_particles
+                 or self.population_strategy(0))
+        # the calibration sample must fit the chunk's static shapes
+        if int(n_cal) > self._fused_n_cap():
+            return None
+        return (int(n_cal), calib_w, calib_eps)
+
+    def _fused_n_cap(self) -> int:
+        """The fused chunks' static particle capacity: the pow2 bucket of
+        the schedule's (or adaptive cap's) largest generation. SINGLE
+        source for _loop_fused's reservoir sizing and
+        _fused_calibration_cfg's fit check."""
+        from ..populationstrategy import AdaptivePopulationSize
+        from ..utils import pow2_bucket as _pow2
+
+        n0 = self.population_strategy(0)
+        if isinstance(self.population_strategy, ListPopulationSize):
+            n_max = max(self.population_strategy.values)
+        elif isinstance(self.population_strategy, AdaptivePopulationSize) \
+                and np.isfinite(self.population_strategy.max_population_size):
+            n_max = max(
+                n0, int(self.population_strategy.max_population_size)
+            )
+        else:
+            n_max = n0
+        return _pow2(n_max, 64)
+
     def _fused_adaptive_n_capable(self) -> bool:
         """AdaptivePopulationSize configs whose bootstrap-CV bisection can
         run IN-KERNEL (``transition.util.device_mean_cv`` /
@@ -1428,7 +1503,7 @@ class ABCSMC:
             )
         else:
             n_max = n
-        n_cap = _pow2(n_max, 64)
+        n_cap = self._fused_n_cap()  # == _pow2(n_max, 64), single source
         rec_cap = _pow2(8 * n_cap, 256) if (adaptive or stochastic) else 1
         B = self.sampler._pick_B(n_max)
         max_rounds = self.sampler.max_rounds
@@ -1448,11 +1523,15 @@ class ABCSMC:
             type(tr) is GridSearchCV
             and isinstance(self.population_strategy, ListPopulationSize)
         )
+        fused_cal = (
+            self._fused_calibration_cfg() if first_gen_prior else None
+        )
         kern = ctx.multigen_kernel(
             B, n_cap, rec_cap, max_rounds, G,
             weight_sched=weight_sched,
             fold_sched_mode=fold_sched_mode,
             first_gen_prior=first_gen_prior,
+            fused_calibration=fused_cal,
             adaptive=adaptive, eps_quantile=eps_quantile,
             eps_weighted=getattr(self.eps, "weighted", True),
             alpha=getattr(self.eps, "alpha", 0.5),
@@ -1620,9 +1699,15 @@ class ABCSMC:
                                 if complete_history else 0.0, jnp.float32),
                     jnp.asarray(-1e30, jnp.float32),
                     jnp.zeros((), jnp.float32))
+            if t_at == 0 and fused_cal is not None and fused_cal[2]:
+                # deferred from-sample epsilon: the in-kernel calibration
+                # overwrites this placeholder before generation 0 runs
+                eps0_host = 0.0
+            else:
+                eps0_host = self.eps(t_at)
             base = (tuple(trans0), jnp.asarray(log_probs0, jnp.float32),
                     jnp.asarray(fitted0), dist_w0,
-                    jnp.asarray(self.eps(t_at), jnp.float32),
+                    jnp.asarray(eps0_host, jnp.float32),
                     acc_state0,
                     jnp.asarray(False))
             if adaptive_n:
@@ -1714,12 +1799,17 @@ class ABCSMC:
                 for g in range(g_lim)
             ]
             if all(ss_wanted):
-                return dict(outs)
-            tree = {k: v for k, v in outs.items() if k != "sumstats"}
-            tree["__ss_rows__"] = {
-                g: outs["sumstats"][g]
-                for g in range(g_lim) if ss_wanted[g]
-            }
+                tree = dict(outs)
+            else:
+                tree = {k: v for k, v in outs.items() if k != "sumstats"}
+                tree["__ss_rows__"] = {
+                    g: outs["sumstats"][g]
+                    for g in range(g_lim) if ss_wanted[g]
+                }
+            if "calib" in res_i and t_at == 0:
+                # the run-starting chunk carries the in-kernel
+                # calibration's initial weights / eps_0 for host mirroring
+                tree["__calib__"] = res_i["calib"]
             return tree
 
         probe_pool = (ThreadPoolExecutor(max_workers=1)
@@ -1766,6 +1856,9 @@ class ABCSMC:
             chunk_s = now - t_chunk0  # pipeline period: fetch-to-fetch
             t_chunk0 = now
             ss_rows = fetched.pop("__ss_rows__", None)
+            calib = fetched.pop("__calib__", None)
+            if calib is not None:
+                self._mirror_fused_calibration(calib)
             mem_telemetry = self._device_memory_telemetry()
             chunk_index += 1
             t_proc0 = time.time()
@@ -1908,6 +2001,30 @@ class ABCSMC:
         self.history.done()
         return self.history
 
+    def _device_w_to_host(self, w_struct) -> np.ndarray:
+        """Convert a fetched device weight-params structure into the host
+        ``distance.weights`` dict value. SINGLE authority on the packing
+        of the device params: sumstat-bearing distances ship
+        {"w":..., "ss":...}; aggregated distances ship (w*factors,
+        sub_params) and the host dict stores the factor-free weights."""
+        if isinstance(w_struct, dict):
+            return np.asarray(w_struct["w"], np.float64)
+        if isinstance(w_struct, tuple):
+            f = np.asarray(self.distance_function.factors, np.float64)
+            comb = np.asarray(w_struct[0], np.float64)
+            return np.where(f != 0, comb / np.where(f != 0, f, 1.0), 0.0)
+        return np.asarray(w_struct, np.float64)
+
+    def _mirror_fused_calibration(self, calib) -> None:
+        """Mirror the first chunk's in-kernel calibration into the host
+        components (resume / telemetry / config parity with the host
+        calibration path)."""
+        if self.eps.requires_calibration() and hasattr(self.eps, "_values"):
+            self.eps._values[0] = float(np.asarray(calib["eps0"]))
+        d = self.distance_function
+        if d.requires_calibration():
+            d.weights[0] = self._device_w_to_host(calib["w0"])
+
     def _process_chunk(self, fetched, ss_rows, t, g_limit, n_of, adaptive_n,
                        adaptive, stochastic, temp_fixed, eps_quantile,
                        sumstat_refit, chunk_index, chunk_s, dispatch_s,
@@ -2044,24 +2161,17 @@ class ABCSMC:
                                     fetched["daly_k_next"][g]
                                 )
                 if adaptive:
+                    # slice generation g out of the stacked outputs, then
+                    # unpack through the single packing authority
                     dwn = fetched["dist_w_next"]
                     if isinstance(dwn, dict):
-                        # sumstat-bearing distances carry {"w":..., "ss":...}
-                        w_next = dwn["w"][g]
+                        w_g = {"w": dwn["w"][g]}
                     elif isinstance(dwn, tuple):
-                        # aggregated distances carry (w*factors, sub_params);
-                        # the host dict stores the factor-free weights
-                        f = np.asarray(self.distance_function.factors,
-                                       np.float64)
-                        comb = np.asarray(dwn[0][g], np.float64)
-                        w_next = np.where(
-                            f != 0, comb / np.where(f != 0, f, 1.0), 0.0
-                        )
+                        w_g = (dwn[0][g],)
                     else:
-                        w_next = dwn[g]
-                    self.distance_function.weights[t + 1] = np.asarray(
-                        w_next, np.float64
-                    )
+                        w_g = dwn[g]
+                    self.distance_function.weights[t + 1] = \
+                        self._device_w_to_host(w_g)
                 if adaptive_n:
                     # mirror the in-kernel bootstrap-CV decision into the
                     # host strategy (resume / post-loop host generations)
@@ -2441,9 +2551,36 @@ class ABCSMC:
         return self.history
 
     # -------------------------------------------------------- initialization
-    def _initialize_components(self, max_nr_populations) -> None:
+    def _initialize_components(self, max_nr_populations,
+                               skip_calibration: bool = False) -> None:
         """Calibration generation + initialize(t=0) of all components
-        (reference ABCSMC._initialize_dist_eps_acc)."""
+        (reference ABCSMC._initialize_dist_eps_acc).
+
+        ``skip_calibration``: the fused loop runs calibration IN-KERNEL
+        inside the first chunk (see ``_fused_calibration_cfg``); the
+        components are initialized without a sample here, and the
+        initial weights / eps_0 are mirrored from the chunk outputs."""
+        if skip_calibration:
+            self.distance_function.initialize(0, None, self.x_0)
+            _call_filtered(
+                self.acceptor.initialize,
+                t=0, get_weighted_distances=None,
+                distance_function=self.distance_function, x_0=self.x_0,
+            )
+            # eps.initialize is DEFERRED: a from-sample quantile epsilon
+            # gets _values[0] from the first chunk's calibration output
+            if not self.eps.requires_calibration():
+                _call_filtered(
+                    self.eps.initialize,
+                    t=0, get_weighted_distances=None,
+                    get_all_records=None,
+                    max_nr_populations=(
+                        int(max_nr_populations)
+                        if np.isfinite(max_nr_populations) else None
+                    ),
+                    acceptor_config=self._acceptor_config(0),
+                )
+            return
         needs_calibration = (
             self.distance_function.requires_calibration()
             or self.eps.requires_calibration()

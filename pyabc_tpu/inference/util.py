@@ -666,7 +666,8 @@ class DeviceContext:
                         adaptive_n: tuple | None = None,
                         weight_sched: bool = False,
                         fold_sched_mode: bool = False,
-                        first_gen_prior: bool = False):
+                        first_gen_prior: bool = False,
+                        fused_calibration: tuple | None = None):
         """One jitted program for G WHOLE GENERATIONS (transition mode).
 
         The TPU-native endgame of the reference's per-generation scatter/
@@ -710,7 +711,7 @@ class DeviceContext:
                      trans_cls.__name__, fit_statics, dims,
                      stochastic, temp_config, temp_fixed, complete_history,
                      sumstat_transform, adaptive_n, weight_sched,
-                     fold_sched_mode, first_gen_prior)
+                     fold_sched_mode, first_gen_prior, fused_calibration)
         if cache_key in self._kernels:
             return self._kernels[cache_key]
         if stochastic and self.K != 1:
@@ -1079,13 +1080,69 @@ class DeviceContext:
                         dist_w_next, eps_next, acc_state_next,
                         stopped_next), out
 
-            final_carry, outs = jax.lax.scan(gen_step, carry0, jnp.arange(G))
+            calib_info = None
+            if fused_calibration is not None:
+                # in-kernel CALIBRATION (reference _initialize_dist_eps_acc
+                # semantics): a prior round at eps=+inf supplies the
+                # calibration sample; adaptive distances take their
+                # initial 1/scale weights from it and a from-sample
+                # quantile epsilon takes eps_0 — all before generation 0,
+                # so a fresh run needs NO host calibration round trip.
+                # Runs only when this chunk starts the run (t0 == 0);
+                # later chunks take the identity branch.
+                n_cal, calib_w, calib_eps = fused_calibration
+
+                def _calibrate():
+                    carry = list(carry0)
+                    dist_w0, eps_c0 = carry[3], carry[4]
+                    dyn_cal = {
+                        "eps": jnp.asarray(jnp.inf, jnp.float32),
+                        "dist_params": dist_w0,
+                        "acc_params": (),
+                    }
+                    c_acc, _r, _v, cres, _crec = self._generation_while(
+                        jax.random.fold_in(root, 0), dyn_cal,
+                        jnp.asarray(n_cal, jnp.int32), B=B, n_cap=n_cap,
+                        rec_cap=rec_cap, max_rounds=max_rounds,
+                        run_lanes=run_lanes_prior, record_proposal=False,
+                    )
+                    mask = jnp.arange(n_cap) < jnp.minimum(c_acc, n_cal)
+                    w0 = dist_w0
+                    if calib_w:
+                        scale = scale_reduce(cres["sumstats"], mask, self.x0)
+                        w0 = weight_post(scale)
+                    eps0 = eps_c0
+                    if calib_eps:
+                        d0 = jax.vmap(
+                            lambda s: dist_fn(s, self.x0, w0)
+                        )(cres["sumstats"])
+                        eps0 = weighted_quantile(
+                            jnp.where(mask, d0, jnp.inf),
+                            mask.astype(jnp.float32), alpha,
+                        ) * multiplier
+                    carry[3], carry[4] = w0, eps0
+                    return tuple(carry), {"w0": w0, "eps0": eps0}
+
+                def _skip_calib():
+                    return carry0, {"w0": carry0[3], "eps0": carry0[4]}
+
+                carry_start, calib_info = jax.lax.cond(
+                    t0 == 0, _calibrate, _skip_calib
+                )
+            else:
+                carry_start = carry0
+            final_carry, outs = jax.lax.scan(
+                gen_step, carry_start, jnp.arange(G)
+            )
             # the final carry is returned ON DEVICE so the host can chain
             # the next chunk's dispatch directly off it — chunk k+1 starts
             # computing while chunk k's outputs are still in flight to the
             # host (cross-chunk pipelining; the carried `stopped` flag
             # propagates in-device stops into speculative chunks)
-            return {"outs": outs, "carry": final_carry}
+            ret = {"outs": outs, "carry": final_carry}
+            if calib_info is not None:
+                ret["calib"] = calib_info
+            return ret
 
         if self.mesh is not None and len(
             {d.process_index for d in self.mesh.devices.flat}
@@ -1097,11 +1154,11 @@ class DeviceContext:
             # the carry stays device-resident for chunk chaining
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            fn = jax.jit(
-                multigen_fn,
-                out_shardings={"outs": NamedSharding(self.mesh, P()),
-                               "carry": None},
-            )
+            shardings = {"outs": NamedSharding(self.mesh, P()),
+                         "carry": None}
+            if fused_calibration is not None:
+                shardings["calib"] = NamedSharding(self.mesh, P())
+            fn = jax.jit(multigen_fn, out_shardings=shardings)
         else:
             fn = jax.jit(multigen_fn)
         self._kernels[cache_key] = fn

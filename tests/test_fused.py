@@ -710,6 +710,52 @@ def test_fetch_pipeline_depths_complete_all_generations():
     np.testing.assert_allclose(eps_by_depth[1], eps_by_depth[3])
 
 
+def test_fused_calibration_matches_host_calibration():
+    """The first fused chunk runs calibration IN-KERNEL (round 5): same
+    root key stream as the host calibration round, so the epsilon trail,
+    initial adaptive weights and posterior are IDENTICAL to the host
+    calibration path — and the sampler must see NO calibration call."""
+    prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+    res = {}
+    for label, fg in (("fused", 4), ("host", 1)):
+        dist = pt.AdaptivePNormDistance(p=2)
+        eps = pt.MedianEpsilon()
+        abc = pt.ABCSMC(_gauss_model(), prior, dist, population_size=300,
+                        eps=eps, seed=42, fused_generations=fg)
+        calib_calls = []
+        orig = abc.sampler.sample_until_n_accepted
+
+        def counting(n, spec, t, *a, _orig=orig, _cc=calib_calls, **kw):
+            if t == -1:
+                _cc.append(n)
+            return _orig(n, spec, t, *a, **kw)
+
+        abc.sampler.sample_until_n_accepted = counting
+        if fg > 1:
+            assert abc._fused_calibration_cfg() == (300, True, True)
+        abc.new("sqlite://", {"x": X_OBS})
+        h = abc.run(max_nr_populations=4)
+        df, w = h.get_distribution(0, h.max_t)
+        res[label] = {
+            "mu": float(np.sum(df["theta"] * w)),
+            "eps": {t: float(v) for t, v in eps._values.items()},
+            "w0": np.asarray(dist.weights[0], np.float64),
+            "calib_calls": list(calib_calls),
+        }
+    assert res["fused"]["calib_calls"] == [], (
+        "fused run still paid a host calibration round trip"
+    )
+    assert res["host"]["calib_calls"] == [300]
+    # identical key streams -> identical calibration -> identical run
+    assert res["fused"]["eps"].keys() == res["host"]["eps"].keys()
+    for t in res["host"]["eps"]:
+        assert res["fused"]["eps"][t] == pytest.approx(
+            res["host"]["eps"][t], rel=1e-5), t
+    np.testing.assert_allclose(res["fused"]["w0"], res["host"]["w0"],
+                               rtol=1e-4)
+    assert res["fused"]["mu"] == pytest.approx(res["host"]["mu"], abs=1e-6)
+
+
 def test_drain_async_matches_sync_run():
     """drain_async hands the final in-flight fetches to a background
     thread and returns early; after drain_join the History must be
