@@ -134,7 +134,18 @@ def run_tpu_bench(pop_size: int, n_gens: int, budget_s: float, seed: int,
         except Exception:
             pass
     t0 = time.time()
-    abc.run(max_nr_populations=n_gens + 2, max_walltime=budget_s)
+    try:
+        abc.run(max_nr_populations=n_gens + 2, max_walltime=budget_s)
+    except BaseException:
+        # the caller retries without this abc: settle its background
+        # state so a mid-budget failure doesn't leak the history writer
+        # thread / drain thread for the rest of the bench
+        for cleanup in (abc.drain_join, abc.history.close):
+            try:
+                cleanup()
+            except Exception:
+                pass
+        raise
     return abc, dict(run_s_excl_drain=round(time.time() - t0, 2),
                      adopted_kernels=adopted)
 
@@ -249,6 +260,7 @@ def main():
     prev_abc = None
     pending_join = None  # (abc, info, seed): drain overlaps the NEXT run
     seed = 0
+    errors_in_a_row = 0
     # reserve time for the final drain + emit; spend the rest for real
     reserve = max(12.0, 0.04 * budget)
     spend_until = t_start + budget - reserve
@@ -283,9 +295,22 @@ def main():
                           else remaining), seed=seed,
                 prev_abc=prev_abc, on_event=on_event,
             )
-        except Exception as e:  # keep earlier runs' results on a late crash
+        except Exception as e:  # keep earlier runs' results on a crash
             run_infos.append({"seed": seed, "error": repr(e)[:300]})
-            break
+            errors_in_a_row += 1
+            if errors_in_a_row >= 2 or seed == 0:
+                break  # persistent failure (or no kernels to salvage)
+            # one-off failure (tunnel hiccup): settle the previous run's
+            # drain, drop kernel adoption, try fresh with what's left
+            if pending_join is not None:
+                _finalize_run(*pending_join)
+                pending_join = None
+            prev_abc = None
+            seed += 1
+            # keep the emit-on-signal JSON current through the retry
+            _update_headline(events, run_infos, baseline)
+            continue
+        errors_in_a_row = 0
         # join the PREVIOUS run's drain now — its fetches overlapped this
         # run's compute, so the join is (nearly) free
         if pending_join is not None:
