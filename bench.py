@@ -243,17 +243,9 @@ def main():
     # persistent XLA compile cache: the G-generation program costs ~15-25s
     # to compile; across driver rounds (and across this loop's fresh runs,
     # should kernel adoption ever fail) it deserializes in ~1s instead
-    try:
-        import jax
+    from pyabc_tpu.utils.xla_cache import setup_xla_cache
 
-        cache_dir = os.environ.get(
-            "JAX_COMPILATION_CACHE_DIR", os.path.join(HERE, ".xla_cache")
-        )
-        os.makedirs(cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass
+    setup_xla_cache(os.path.join(HERE, ".xla_cache"))
     events: list[dict] = []   # global completion clock, all runs/threads
     run_infos: list[dict] = []
     probe_events: list[tuple[float, float]] = []

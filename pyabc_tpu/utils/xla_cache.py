@@ -1,0 +1,31 @@
+"""Persistent XLA compilation-cache setup, shared by every entry point.
+
+The fused multi-generation programs cost ~15-25 s of XLA compile each;
+the cache deserializes them in ~1 s. One helper so the policy (default
+directory, min-compile-time threshold, env-var export for subprocess
+inheritance) cannot drift between `bench.py`, `tests/conftest.py` and
+`__graft_entry__.py`.
+"""
+from __future__ import annotations
+
+import os
+
+
+def setup_xla_cache(default_dir: str, *, export_env: bool = False) -> str | None:
+    """Point JAX's persistent compilation cache at ``default_dir`` (the
+    ``JAX_COMPILATION_CACHE_DIR`` env var wins when set). ``export_env``
+    additionally writes the env var so subprocesses inherit the cache.
+    Best-effort: a failure degrades to uncached compilation, never an
+    error. Returns the cache dir in use (None on failure)."""
+    try:
+        import jax
+
+        cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR", default_dir)
+        os.makedirs(cache_dir, exist_ok=True)
+        if export_env:
+            os.environ["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        return cache_dir
+    except Exception:
+        return None

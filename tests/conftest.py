@@ -29,14 +29,12 @@ except RuntimeError:  # pragma: no cover - no cpu platform registered
 # suite pays that tax once per machine, not once per run. Set via the env
 # var (not jax.config) so subprocess-based tests (examples, graft-entry
 # dryrun, multihost workers) inherit it.
-_cache_dir = os.environ.get(
-    "JAX_COMPILATION_CACHE_DIR",
+from pyabc_tpu.utils.xla_cache import setup_xla_cache  # noqa: E402
+
+setup_xla_cache(
     os.path.join(os.path.expanduser("~"), ".cache", "pyabc_tpu_xla_cache"),
+    export_env=True,
 )
-os.makedirs(_cache_dir, exist_ok=True)
-os.environ["JAX_COMPILATION_CACHE_DIR"] = _cache_dir
-jax.config.update("jax_compilation_cache_dir", _cache_dir)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
 @pytest.fixture
