@@ -27,5 +27,14 @@ def setup_xla_cache(default_dir: str, *, export_env: bool = False) -> str | None
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         return cache_dir
-    except Exception:
+    except Exception as e:
+        # best-effort, but VISIBLY so: without the cache every fused
+        # program pays its full 15-25 s compile and the reason would
+        # otherwise be undiscoverable
+        import warnings
+
+        warnings.warn(
+            f"persistent XLA compile cache disabled ({e!r}); "
+            f"fused programs will recompile every run", stacklevel=2,
+        )
         return None

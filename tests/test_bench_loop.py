@@ -1,0 +1,152 @@
+"""Unit tests for bench.py's spend-loop accounting and resilience.
+
+The real bench needs the TPU tunnel; these tests patch
+``run_tpu_bench`` with fakes so the loop logic — overlapped-run
+finalization, the one-off-failure retry, and the dual-basis headline
+computation — is exercised deterministically in milliseconds. This is
+the logic the driver's one capture per round depends on.
+"""
+import importlib.util
+import json
+import os
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def bench(monkeypatch, tmp_path, capsys):
+    import signal as signal_mod
+
+    # bench.py installs SIGTERM/SIGINT handlers (os._exit on fire) and an
+    # atexit emit hook at import — save/restore the handlers so a Ctrl-C
+    # later in the pytest session still reaches pytest, and neuter the
+    # module's emit at teardown so its atexit hook is a no-op
+    old_term = signal_mod.getsignal(signal_mod.SIGTERM)
+    old_int = signal_mod.getsignal(signal_mod.SIGINT)
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    # skip the jax platform probe subprocess and the host-baseline run
+    monkeypatch.setenv("PYABC_TPU_BENCH_CPU", "1")
+    monkeypatch.setattr(mod, "probe_platform", lambda *a, **k: "cpu")
+    monkeypatch.setattr(mod, "run_host_baseline", lambda **k: 800.0)
+    monkeypatch.setattr(
+        mod, "HERE", str(tmp_path)
+    )  # .baseline_pps cache goes to tmp
+    monkeypatch.setenv("PYABC_TPU_BENCH_BUDGET_S", "1000")
+    yield mod
+    mod._emitted = True  # atexit hook becomes a no-op
+    signal_mod.signal(signal_mod.SIGTERM, old_term)
+    signal_mod.signal(signal_mod.SIGINT, old_int)
+
+
+class FakeHistory:
+    def get_all_populations(self):
+        import pandas as pd
+
+        return pd.DataFrame({"t": list(range(-1, 32))})
+
+    def close(self):
+        pass
+
+
+class FakeAbc:
+    def __init__(self):
+        self.history = FakeHistory()
+        self.probe_events = [(0.0, 0.1), (0.1, 0.2)]
+        self.drain_joined = False
+
+    def drain_join(self):
+        self.drain_joined = True
+
+
+def _fake_run_factory(clock, fail_seeds=(), run_wall=0.5, gens=32,
+                      pop=1000):
+    """A run_tpu_bench fake: advances a virtual wall clock and fires
+    chunk events like a real overlapped run would."""
+
+    def fake(pop_size, n_gens, budget_s, seed, prev_abc, on_event):
+        if seed in fail_seeds:
+            raise RuntimeError(f"synthetic failure on seed {seed}")
+        for ci in range(1, 5):
+            clock[0] += run_wall / 4
+            on_event({
+                "ts": clock[0], "t_first": (ci - 1) * 8, "gens": 8,
+                "n_acc": pop * 8, "chunk_index": ci,
+                "chunk_s": run_wall / 4, "fetch_s": 0.002,
+                "dispatch_s": 0.001, "process_s": 0.0005,
+            })
+        return FakeAbc(), {"run_s_excl_drain": run_wall,
+                           "adopted_kernels": seed > 0}
+
+    return fake
+
+
+def _run_main_briefly(bench, monkeypatch, fake, clock, budget=30):
+    """Run main() on a VIRTUAL clock the fake runs advance (each fake
+    run consumes run_wall virtual seconds), so the spend loop
+    terminates deterministically regardless of real wall time."""
+    from types import SimpleNamespace
+
+    monkeypatch.setenv("PYABC_TPU_BENCH_BUDGET_S", str(budget))
+    monkeypatch.setattr(bench, "run_tpu_bench", fake)
+    monkeypatch.setattr(bench, "time",
+                        SimpleNamespace(time=lambda: clock[0]))
+    bench._emitted = False
+    bench.main()
+
+
+def test_headline_both_bases_and_full_coverage(bench, monkeypatch, capsys):
+    clock = [time.time()]
+    _run_main_briefly(bench, monkeypatch, _fake_run_factory(clock), clock)
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    d = json.loads(out)
+    assert d["partial"] is False
+    assert d["value"] > 0
+    assert d["vs_baseline"] == pytest.approx(d["value"] / 800.0, rel=1e-3)
+    assert "wall_clock" in d and d["wall_clock"]["aggregate_pps"] > 0
+    assert "util" in d and "device_busy_frac_upper" in d["util"]
+    # every warm run is finalized with its generation count
+    gens = [r.get("generations_completed") for r in d["runs"]
+            if "error" not in r and "elided_runs" not in r]
+    assert gens and all(g == 32 for g in gens)
+
+
+def test_one_off_failure_retries_and_completes(bench, monkeypatch, capsys):
+    clock = [time.time()]
+    fake = _fake_run_factory(clock, fail_seeds=(1,))
+    _run_main_briefly(bench, monkeypatch, fake, clock)
+    d = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    errors = [r for r in d["runs"] if "error" in r]
+    assert len(errors) == 1 and "seed" in errors[0]
+    # the bench recovered: non-partial with steady runs after the failure
+    assert d["partial"] is False
+    assert d.get("n_steady_runs", 0) >= 1
+
+
+def test_two_consecutive_failures_stop_the_bench(bench, monkeypatch,
+                                                 capsys):
+    clock = [time.time()]
+    fake = _fake_run_factory(clock, fail_seeds=(1, 2))
+    _run_main_briefly(bench, monkeypatch, fake, clock)
+    d = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    errors = [r for r in d["runs"] if "error" in r]
+    assert len(errors) == 2
+    # seed 0's warmup completed, so the emit still carries its info
+    assert any(r.get("generations_completed") == 32 for r in d["runs"]
+               if "error" not in r and "elided_runs" not in r)
+
+
+def test_seed_zero_failure_aborts_cleanly(bench, monkeypatch, capsys):
+    clock = [time.time()]
+    fake = _fake_run_factory(clock, fail_seeds=(0,))
+    _run_main_briefly(bench, monkeypatch, fake, clock)
+    d = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert d["partial"] is True  # nothing measured, honestly labeled
+    assert any("error" in r for r in d["runs"])
