@@ -100,6 +100,16 @@ from .predictor import (
     ModelSelectionPredictor,
     Predictor,
 )
+from .observability import (
+    JsonlTraceExporter,
+    MetricsRegistry,
+    NullTracer,
+    Tracer,
+    VirtualClock,
+    coverage_report,
+    prometheus_text,
+    read_trace,
+)
 from .storage import History, create_sqlite_db_id
 from .sumstat import IdentitySumstat, PredictorSumstat, Sumstat
 from .transition import (

@@ -28,6 +28,7 @@ import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
 
+from ..observability import SYSTEM_CLOCK
 from .protocol import recv_msg, send_msg
 
 
@@ -76,7 +77,11 @@ class EvalBroker:
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 max_eval: float = float("inf")):
+                 max_eval: float = float("inf"), clock=None):
+        # injected monotonic clock (observability subsystem): worker
+        # liveness ages and wait deadlines survive wall-clock steps, and
+        # tests can drive a VirtualClock
+        self.clock = clock if clock is not None else SYSTEM_CLOCK
         self._lock = threading.Lock()
         self._gen = 0               # monotonically increasing generation id
         self._payload: bytes | None = None  # pickled simulate_one closure
@@ -220,7 +225,7 @@ class EvalBroker:
         Generation-stamped: if a pre-published look-ahead generation
         auto-started meanwhile, the FINISHED generation's results are
         returned from the last-finished buffer."""
-        deadline = time.time() + timeout if timeout else None
+        deadline = self.clock.now() + timeout if timeout else None
         with self._lock:
             gen0 = self._gen
             if self._done and gen0 in self._finished:
@@ -241,14 +246,14 @@ class EvalBroker:
                 if self._done:
                     return list(self._results)
             time.sleep(poll_s)
-            if deadline and time.time() > deadline:
+            if deadline and self.clock.now() > deadline:
                 raise TimeoutError(
                     f"generation incomplete: {self.status()}"
                 )
 
     def status(self) -> BrokerStatus:
         with self._lock:
-            now = time.time()
+            now = self.clock.now()
             return BrokerStatus(
                 generation=self._gen, t=self._t, n_target=self._n_target,
                 n_acc=self._n_acc, n_eval_handed=self._next_slot,
@@ -270,9 +275,9 @@ class EvalBroker:
     # ------------------------------------------------------------ dispatch
     def _touch(self, worker_id: str, **updates) -> None:
         info = self._workers.setdefault(
-            worker_id, {"n_results": 0, "joined": time.time()}
+            worker_id, {"n_results": 0, "joined": self.clock.now()}
         )
-        info["last_seen"] = time.time()
+        info["last_seen"] = self.clock.now()
         for k, v in updates.items():
             info[k] = info.get(k, 0) + v
 

@@ -112,6 +112,13 @@ class ElasticSampler(Sampler):
             and self.lookahead_accept is not None
         )
         self._lookahead_t = None
+        with self.tracer.span("broker.generation", t=int(t or 0),
+                              n=int(n), adopted=bool(adopt)) as g_span:
+            return self._sample_impl(n, simulate_one, t, max_eval,
+                                     all_accepted, adopt, g_span)
+
+    def _sample_impl(self, n, simulate_one, t, max_eval, all_accepted,
+                     adopt, g_span) -> Sample:
         if not adopt:
             self.broker.cancel_pre_published()
             if hasattr(simulate_one, "host_simulate_one"):
@@ -126,6 +133,9 @@ class ElasticSampler(Sampler):
         accept_fn = self.lookahead_accept if adopt else None
         triples, tested = self._collect(n, t, max_eval, all_accepted,
                                         accept_fn, head_start=adopt)
+        g_span.set(n_delivered=len(triples))
+        if adopt and self.lookahead_head_starts:
+            g_span.set(head_start=int(self.lookahead_head_starts[-1]))
 
         sample = self.sample_factory()
         accepted, accepted_ids, records = [], [], []
@@ -193,8 +203,17 @@ class ElasticSampler(Sampler):
         final list."""
         import time as _time
 
-        deadline = (_time.time() + self.generation_timeout
+        clock = self.tracer.clock  # injected monotonic timebase
+        deadline = (clock.now() + self.generation_timeout
                     if self.generation_timeout else None)
+        inflight_gauge = self.metrics.gauge(
+            "pyabc_tpu_broker_inflight_slots",
+            "handed-out evaluation slots not yet delivered",
+        )
+        delivered_counter = self.metrics.counter(
+            "pyabc_tpu_broker_results_delivered",
+            "worker results delivered to the sampler",
+        )
         prepublished = False
         gen0 = None
         # incremental acceptance over the broker's append-only result
@@ -241,13 +260,21 @@ class ElasticSampler(Sampler):
                     ok = bool(acc)
                 if ok:
                     n_acc += 1
+            if len(triples) > n_seen:
+                delivered_counter.inc(len(triples) - n_seen)
             n_seen = len(triples)
+            if self.metrics.enabled:
+                inflight_gauge.set(
+                    max(self.broker.status().n_eval_handed - n_seen, 0)
+                )
             if (self.look_ahead and not prepublished
                     and self.lookahead_builder is not None
                     and n_acc >= self.look_ahead_frac * n):
-                payload_next = self.lookahead_builder(
-                    t + 1, list(accepted_parts)
-                )
+                with self.tracer.span("broker.prepublish", t_next=t + 1,
+                                      n_builder=len(accepted_parts)):
+                    payload_next = self.lookahead_builder(
+                        t + 1, list(accepted_parts)
+                    )
                 if payload_next is not None:
                     self.broker.pre_publish(
                         t + 1, payload_next, n, batch=self.batch,
@@ -264,7 +291,7 @@ class ElasticSampler(Sampler):
             if done:
                 return triples, tested
             _time.sleep(0.02)
-            if deadline and _time.time() > deadline:
+            if deadline and clock.now() > deadline:
                 raise TimeoutError(
                     f"generation incomplete: {self.broker.status()}"
                 )

@@ -24,6 +24,7 @@ import uuid
 
 import numpy as np
 
+from ..observability import SYSTEM_CLOCK
 from .protocol import request
 
 
@@ -32,6 +33,7 @@ def run_worker(host: str, port: int, *, worker_id: str | None = None,
                runtime_s: float = float("inf"),
                log_file: str | None = None,
                catch_exceptions: bool = True,
+               seed: int | None = None,
                _stop_check=None) -> int:
     """Serve generations until the broker goes away / runtime ends.
 
@@ -47,9 +49,20 @@ def run_worker(host: str, port: int, *, worker_id: str | None = None,
     """
     addr = (host, int(port))
     wid = worker_id or f"{socket.gethostname()}-{os.getpid()}-{uuid.uuid4().hex[:6]}"
-    # worker-unique numpy seed: host simulate_one draws via np.random
-    np.random.seed((os.getpid() * 1000003 + int(time.time())) % (2**31 - 1))
-    t_end = time.time() + runtime_s if np.isfinite(runtime_s) else None
+    # worker-unique numpy seed: host simulate_one draws via np.random.
+    # ``seed`` (also via PYABC_TPU_WORKER_SEED) pins it for reproducible
+    # tests; the default mixes the pid with os.urandom entropy — stronger
+    # than the old pid+wallclock mix (two workers forked in the same
+    # second shared most seed bits) and free of wall-clock reads
+    env_seed = os.environ.get("PYABC_TPU_WORKER_SEED")
+    if seed is None and env_seed is not None:
+        seed = int(env_seed)
+    if seed is None:
+        seed = (os.getpid() * 1000003
+                + int.from_bytes(os.urandom(4), "little"))
+    np.random.seed(seed % (2**31 - 1))
+    clock = SYSTEM_CLOCK
+    t_end = clock.now() + runtime_s if np.isfinite(runtime_s) else None
     n_eval_total = 0
     gens_served = 0
     last_counted_gen = -1
@@ -87,7 +100,7 @@ def run_worker(host: str, port: int, *, worker_id: str | None = None,
         while True:
             if stopping():
                 break
-            if t_end and time.time() > t_end:
+            if t_end and clock.now() > t_end:
                 break
             if gens_served >= max_generations:
                 break
@@ -105,7 +118,7 @@ def run_worker(host: str, port: int, *, worker_id: str | None = None,
             # pulls more slots (a finished generation answers hello "wait")
             _, gen, t, payload, batch, mode = reply
             simulate_one = pickle.loads(payload)
-            t0 = time.time()
+            t0 = clock.now()
             n_eval = n_acc = 0
             while True:
                 try:
@@ -206,7 +219,7 @@ def run_worker(host: str, port: int, *, worker_id: str | None = None,
             if log_writer is not None:
                 log_writer.writerow(
                     [wid, gen, t, n_eval, n_acc,
-                     round(time.time() - t0, 3)])
+                     round(clock.now() - t0, 3)])
                 fh.flush()
     finally:
         # deregister so manager status doesn't show ghost workers

@@ -20,7 +20,6 @@ from __future__ import annotations
 import datetime
 import json
 import logging
-import time
 from typing import Callable, Sequence
 
 import numpy as np
@@ -41,6 +40,7 @@ from ..distance import (
 )
 from ..epsilon import Epsilon, MedianEpsilon, NoEpsilon
 from ..model import JaxModel, Model, assert_models
+from ..observability import NULL_METRICS, default_tracer
 from ..populationstrategy import (
     ConstantPopulationSize,
     ListPopulationSize,
@@ -78,6 +78,43 @@ def _call_filtered(fn, **kwargs):
     return fn(**{k: v for k, v in kwargs.items() if k in sig.parameters})
 
 
+class DefensivePreliminaryTransition:
+    """Mixture proposal ``alpha * prior + (1 - alpha) * KDE`` for
+    preliminary look-ahead generations (defensive importance sampling).
+
+    The importance ratio prior/proposal is bounded by ``1 / alpha``, so
+    ONE mis-centred preliminary KDE — fit, by construction, on the
+    accepted-so-far subset of a still-running generation — can no longer
+    assign a near-zero proposal density to an accepted particle and
+    collapse the adopted generation's ESS (the round-5 look-ahead flake;
+    weights stay exact wrt this mixture, so the estimator is unbiased).
+    Host-path only: preliminary closures are evaluated by broker workers.
+    """
+
+    def __init__(self, inner, prior, alpha: float):
+        self.inner = inner
+        self.prior = prior
+        self.alpha = float(alpha)
+
+    @property
+    def X(self):
+        return self.inner.X
+
+    def rvs_single(self):
+        import pandas as pd
+
+        if np.random.random() < self.alpha:
+            return pd.Series(dict(self.prior.rvs_host()))
+        return self.inner.rvs_single()
+
+    def pdf(self, x):
+        from ..core.parameters import Parameter
+
+        prior_pd = self.prior.pdf_host(Parameter(dict(x)))
+        return (self.alpha * prior_pd
+                + (1.0 - self.alpha) * float(self.inner.pdf(x)))
+
+
 class GenerationSpec:
     """The unit handed to samplers: scalar closure + device kernel context."""
 
@@ -113,7 +150,9 @@ class ABCSMC:
                  mesh=None,
                  pipeline: bool = True,
                  fused_generations: int = 8,
-                 fetch_pipeline_depth: int = 3):
+                 fetch_pipeline_depth: int = 3,
+                 tracer=None,
+                 metrics=None):
         self.models: list[Model] = assert_models(models)
         if isinstance(parameter_priors, Distribution):
             parameter_priors = [parameter_priors]
@@ -177,6 +216,32 @@ class ABCSMC:
         #: correction is needed — reference redis_eps look_ahead semantics
         #: without the preliminary-weight bias)
         self.pipeline = pipeline
+        #: broker look-ahead variance guards (the round-5 flake's root
+        #: cause, localized with the observability spans: preliminary
+        #: proposals fit on the accepted-so-far SUBSET occasionally sit
+        #: narrow/shifted against the final posterior, the importance
+        #: ratio prior/preliminary-proposal explodes in the tails, and
+        #: the adopted generation's ESS collapses — compounding across
+        #: consecutively adopted generations). Two defenses, both
+        #: bias-free because weights are always computed wrt the
+        #: proposal ACTUALLY used:
+        #: - skip look-ahead when the builder population's ESS is below
+        #:   ``lookahead_min_ess`` (a KDE fit on a degenerate set would
+        #:   propagate the collapse into the next generation);
+        #: - widen the preliminary KDE bandwidth by
+        #:   ``lookahead_proposal_widen`` (a deliberately broader
+        #:   proposal softens the density-ratio tails; the cost — a lower
+        #:   preliminary acceptance rate — only spends worker time that
+        #:   would otherwise be idle);
+        #: - propose from the defensive mixture
+        #:   ``lookahead_defensive_frac * prior + (1-frac) * KDE``
+        #:   (:class:`DefensivePreliminaryTransition`), which HARD-bounds
+        #:   the importance ratio at ``1 / frac`` — the collapse
+        #:   mechanism (near-zero preliminary density under an accepted
+        #:   particle) is eliminated, not just attenuated.
+        self.lookahead_min_ess = 10.0
+        self.lookahead_proposal_widen = 1.5
+        self.lookahead_defensive_frac = 0.2
         #: speculative eps=+inf look-ahead rounds only pay off when the
         #: host's strategy adaptation outweighs one extra device round
         #: trip; measured per generation and gated on this threshold
@@ -232,6 +297,16 @@ class ABCSMC:
         self._drain_thread = None
         self._drain_error: BaseException | None = None
         self._root_key = root_key(seed)
+        #: observability (pyabc_tpu/observability/): host-boundary tracing
+        #: spans + metrics. Defaults are no-op-cheap (NullTracer /
+        #: NullMetrics); pass ``tracer=Tracer(...)`` or set the env var
+        #: PYABC_TPU_TRACE=/path/trace.jsonl to record. Instrumentation
+        #: never enters traced/compiled device code, so fused kernels are
+        #: byte-identical with observability on or off. All host timing in
+        #: this class reads the tracer's injected clock (monotonic).
+        self.tracer = tracer if tracer is not None else default_tracer()
+        self.metrics = metrics if metrics is not None else NULL_METRICS
+        self._clock = self.tracer.clock
 
         self._device_capable = self._check_device_capable()
         if sampler is None:
@@ -303,6 +378,8 @@ class ABCSMC:
         self.spec = SumStatSpec(observed) if observed else None
         self._resumed_distance_changed = False  # only load() sets this
         self.history = History(db, store_sum_stats=store_sum_stats)
+        self.history.tracer = self.tracer
+        self.history.metrics = self.metrics
         options = dict(meta_info or {})
         options["parameter_names"] = {
             m: list(p.space.names)
@@ -320,6 +397,8 @@ class ABCSMC:
              ) -> History:
         """Resume a stored run (reference .load): continue at max_t + 1."""
         self.history = History(db, abc_id)
+        self.history.tracer = self.tracer
+        self.history.metrics = self.metrics
         observed = observed_sum_stat or self.history.get_observed_sum_stat()
         self.x_0 = {k: np.asarray(v) for k, v in observed.items()}
         self.spec = SumStatSpec(self.x_0)
@@ -636,6 +715,15 @@ class ABCSMC:
     def _run_impl(self, minimum_epsilon, max_nr_populations,
                   min_acceptance_rate, max_total_nr_simulations,
                   max_walltime) -> History:
+        with self.tracer.span("run", db=getattr(self.history, "db", None)):
+            return self._run_inner(
+                minimum_epsilon, max_nr_populations, min_acceptance_rate,
+                max_total_nr_simulations, max_walltime,
+            )
+
+    def _run_inner(self, minimum_epsilon, max_nr_populations,
+                   min_acceptance_rate, max_total_nr_simulations,
+                   max_walltime) -> History:
         # a still-running background drain from a previous drain_async run
         # on this object must finish (and surface its errors) first
         self.drain_join()
@@ -648,9 +736,14 @@ class ABCSMC:
                 1.0 if isinstance(self.eps, Temperature) else 0.0
             )
         self.minimum_epsilon = minimum_epsilon
-        start_walltime = time.time()
+        start_walltime = self._clock.now()
         if isinstance(max_walltime, datetime.timedelta):
             max_walltime = max_walltime.total_seconds()
+        # samplers carry span/metric instrumentation of their own (broker
+        # round trips, device dispatch/collect) — share this run's sinks
+        # BEFORE calibration, which already samples through them
+        self.sampler.tracer = self.tracer
+        self.sampler.metrics = self.metrics
 
         t0 = self.history.max_t + 1
         if t0 == 0:
@@ -662,8 +755,9 @@ class ABCSMC:
                 and getattr(self.distance_function, "sumstat", None) is None
                 and self._fused_calibration_cfg() is not None
             )
-            self._initialize_components(max_nr_populations,
-                                        skip_calibration=skip_cal)
+            with self.tracer.span("calibration", in_kernel=bool(skip_cal)):
+                self._initialize_components(max_nr_populations,
+                                            skip_calibration=skip_cal)
         else:
             self._restore_state(t0 - 1, max_nr_populations)
 
@@ -757,48 +851,58 @@ class ABCSMC:
                 if min_acceptance_rate > 0 else np.inf
             )
             logger.info("t: %d, eps: %.8g", t, current_eps)
-            t_gen0 = time.time()
-            gen_spec = self._generation_spec(t)
-            sample = self.sampler.sample_until_n_accepted(
-                n_t, gen_spec, t, max_eval=max_eval
-            )
-            sample_s = time.time() - t_gen0
-            n_acc = sample.n_accepted if sample.ms is not None else len(
-                sample.accepted_particles
-            )
-            if n_acc < n_t:
-                logger.info(
-                    "stopping: only %d/%d accepted within budget", n_acc, n_t
+            clk = self._clock.now
+            with self.tracer.span("generation", t=int(t), n=int(n_t),
+                                  eps=float(current_eps)) as g_span:
+                t_gen0 = clk()
+                with self.tracer.span("sample", t=int(t)):
+                    gen_spec = self._generation_spec(t)
+                    sample = self.sampler.sample_until_n_accepted(
+                        n_t, gen_spec, t, max_eval=max_eval
+                    )
+                sample_s = clk() - t_gen0
+                n_acc = sample.n_accepted if sample.ms is not None else len(
+                    sample.accepted_particles
                 )
-                break
-            pop = self._sample_to_population(sample)
-            nr_evals = self.sampler.nr_evaluations_
-            sims_total += nr_evals
-            acceptance_rate = n_t / nr_evals
-            t_persist0 = time.time()
-            self.history.append_population(
-                t, current_eps, pop, nr_evals, self.model_names,
-                telemetry={"sample_s": round(sample_s, 4),
-                           "n_evaluations": int(nr_evals)},
-            )
-            persist_s = time.time() - t_persist0
-            logger.info(
-                "acceptance rate: %.5f (%d evaluations)", acceptance_rate,
-                nr_evals,
-            )
-            t_adapt0 = time.time()
-            distance_changed_at_t = self._adapt_components(
-                t, sample, pop, current_eps, acceptance_rate
-            )
-            self.history.update_telemetry(t, {
-                "adapt_s": round(time.time() - t_adapt0, 4),
-                "persist_s": round(persist_s, 4),
-                "acceptance_rate": round(acceptance_rate, 6),
-                # "the distance changed AFTER generation t" — the resume
-                # replay reads this to restart the epsilon trail exactly
-                # where the live run did
-                "distance_changed": bool(distance_changed_at_t),
-            })
+                if n_acc < n_t:
+                    logger.info(
+                        "stopping: only %d/%d accepted within budget",
+                        n_acc, n_t,
+                    )
+                    break
+                pop = self._sample_to_population(sample)
+                nr_evals = self.sampler.nr_evaluations_
+                sims_total += nr_evals
+                acceptance_rate = n_t / nr_evals
+                t_persist0 = clk()
+                with self.tracer.span("persist", t=int(t)):
+                    self.history.append_population(
+                        t, current_eps, pop, nr_evals, self.model_names,
+                        telemetry={"sample_s": round(sample_s, 4),
+                                   "n_evaluations": int(nr_evals)},
+                    )
+                persist_s = clk() - t_persist0
+                logger.info(
+                    "acceptance rate: %.5f (%d evaluations)",
+                    acceptance_rate, nr_evals,
+                )
+                t_adapt0 = clk()
+                with self.tracer.span("adapt", t=int(t)):
+                    distance_changed_at_t = self._adapt_components(
+                        t, sample, pop, current_eps, acceptance_rate
+                    )
+                self.history.update_telemetry(t, {
+                    "adapt_s": round(clk() - t_adapt0, 4),
+                    "persist_s": round(persist_s, 4),
+                    "acceptance_rate": round(acceptance_rate, 6),
+                    # "the distance changed AFTER generation t" — the resume
+                    # replay reads this to restart the epsilon trail exactly
+                    # where the live run did
+                    "distance_changed": bool(distance_changed_at_t),
+                })
+                g_span.set(n_accepted=int(n_acc),
+                           n_evaluations=int(nr_evals),
+                           acceptance_rate=round(acceptance_rate, 6))
 
             if self._check_stop(t, current_eps, minimum_epsilon,
                                 max_nr_populations, acceptance_rate,
@@ -882,7 +986,7 @@ class ABCSMC:
             logger.info("stopping: max_total_nr_simulations reached")
             return True
         if (max_walltime is not None
-                and time.time() - start_walltime > max_walltime):
+                and self._clock.now() - start_walltime > max_walltime):
             logger.info("stopping: max_walltime reached")
             return True
         if (self.stop_if_only_single_model_alive
@@ -1420,45 +1524,54 @@ class ABCSMC:
             if hasattr(self.acceptor, "note_epsilon"):
                 self.acceptor.note_epsilon(0, current_eps, False)
             logger.info("t: 0, eps: %.8g", current_eps)
-            t_gen0 = time.time()
-            gen_spec = self._generation_spec(0)
-            sample = self.sampler.sample_until_n_accepted(
-                n, gen_spec, 0,
-                max_eval=(n / min_acceptance_rate
-                          if min_acceptance_rate > 0 else np.inf),
-            )
-            sample_s = time.time() - t_gen0
-            if sample.n_accepted < n:
-                logger.info("stopping: only %d/%d accepted within budget",
-                            sample.n_accepted, n)
-                self.history.done()
-                return self.history
-            pop = self._sample_to_population(sample)
-            nr_evals = self.sampler.nr_evaluations_
-            sims_total += nr_evals
-            acceptance_rate = n / nr_evals
-            db_pop = copy.copy(pop)
-            t_adapt0 = time.time()
-            self._adapt_components(0, sample, pop, current_eps,
-                                   acceptance_rate)
-            adapt_s = time.time() - t_adapt0
-            t_persist0 = time.time()
-            self.history.append_population(
-                0, current_eps, db_pop, nr_evals, self.model_names,
-                telemetry={"sample_s": round(sample_s, 4),
-                           "adapt_s": round(adapt_s, 4),
-                           "n_evaluations": int(nr_evals),
-                           "acceptance_rate": round(acceptance_rate, 6)},
-            )
-            self.history.update_telemetry(
-                0, {"persist_s": round(time.time() - t_persist0, 4)}
-            )
+            clk = self._clock.now
+            with self.tracer.span("generation", t=0, n=int(n),
+                                  eps=float(current_eps)) as g_span:
+                t_gen0 = clk()
+                with self.tracer.span("sample", t=0):
+                    gen_spec = self._generation_spec(0)
+                    sample = self.sampler.sample_until_n_accepted(
+                        n, gen_spec, 0,
+                        max_eval=(n / min_acceptance_rate
+                                  if min_acceptance_rate > 0 else np.inf),
+                    )
+                sample_s = clk() - t_gen0
+                if sample.n_accepted < n:
+                    logger.info(
+                        "stopping: only %d/%d accepted within budget",
+                        sample.n_accepted, n)
+                    self.history.done()
+                    return self.history
+                pop = self._sample_to_population(sample)
+                nr_evals = self.sampler.nr_evaluations_
+                sims_total += nr_evals
+                acceptance_rate = n / nr_evals
+                db_pop = copy.copy(pop)
+                t_adapt0 = clk()
+                with self.tracer.span("adapt", t=0):
+                    self._adapt_components(0, sample, pop, current_eps,
+                                           acceptance_rate)
+                adapt_s = clk() - t_adapt0
+                t_persist0 = clk()
+                with self.tracer.span("persist", t=0):
+                    self.history.append_population(
+                        0, current_eps, db_pop, nr_evals, self.model_names,
+                        telemetry={
+                            "sample_s": round(sample_s, 4),
+                            "adapt_s": round(adapt_s, 4),
+                            "n_evaluations": int(nr_evals),
+                            "acceptance_rate": round(acceptance_rate, 6)},
+                    )
+                self.history.update_telemetry(
+                    0, {"persist_s": round(clk() - t_persist0, 4)}
+                )
+                g_span.set(n_accepted=int(n), n_evaluations=int(nr_evals))
             if self.chunk_event_cb is not None:
                 # generation 0 runs outside the chunk pipeline but its
                 # particles/time belong to the caller's global clock
                 try:
                     self.chunk_event_cb({
-                        "ts": time.time(), "t_first": 0, "gens": 1,
+                        "ts": clk(), "t_first": 0, "gens": 1,
                         "n_acc": int(n), "chunk_index": 0,
                         "chunk_s": float(sample_s),
                         "fetch_s": 0.0, "dispatch_s": 0.0,
@@ -1526,33 +1639,35 @@ class ABCSMC:
         fused_cal = (
             self._fused_calibration_cfg() if first_gen_prior else None
         )
-        kern = ctx.multigen_kernel(
-            B, n_cap, rec_cap, max_rounds, G,
-            weight_sched=weight_sched,
-            fold_sched_mode=fold_sched_mode,
-            first_gen_prior=first_gen_prior,
-            fused_calibration=fused_cal,
-            adaptive=adaptive, eps_quantile=eps_quantile,
-            eps_weighted=getattr(self.eps, "weighted", True),
-            alpha=getattr(self.eps, "alpha", 0.5),
-            multiplier=getattr(self.eps, "quantile_multiplier", 1.0),
-            trans_cls=type(tr),
-            fit_statics=self._transition_fit_statics(n_max),
-            dims=tuple(p.space.dim for p in self.parameter_priors),
-            stochastic=stochastic,
-            temp_config=self._temp_config() if stochastic else None,
-            temp_fixed=temp_fixed,
-            complete_history=complete_history,
-            sumstat_transform=sumstat_mode,
-            adaptive_n=(
-                (float(self.population_strategy.mean_cv),
-                 int(self.population_strategy.min_population_size),
-                 int(min(self.population_strategy.max_population_size,
-                         n_cap)),
-                 int(self.population_strategy.n_bootstrap))
-                if adaptive_n else None
-            ),
-        )
+        with self.tracer.span("kernel.build", G=int(G), B=int(B),
+                              n_cap=int(n_cap)):
+            kern = ctx.multigen_kernel(
+                B, n_cap, rec_cap, max_rounds, G,
+                weight_sched=weight_sched,
+                fold_sched_mode=fold_sched_mode,
+                first_gen_prior=first_gen_prior,
+                fused_calibration=fused_cal,
+                adaptive=adaptive, eps_quantile=eps_quantile,
+                eps_weighted=getattr(self.eps, "weighted", True),
+                alpha=getattr(self.eps, "alpha", 0.5),
+                multiplier=getattr(self.eps, "quantile_multiplier", 1.0),
+                trans_cls=type(tr),
+                fit_statics=self._transition_fit_statics(n_max),
+                dims=tuple(p.space.dim for p in self.parameter_priors),
+                stochastic=stochastic,
+                temp_config=self._temp_config() if stochastic else None,
+                temp_fixed=temp_fixed,
+                complete_history=complete_history,
+                sumstat_transform=sumstat_mode,
+                adaptive_n=(
+                    (float(self.population_strategy.mean_cv),
+                     int(self.population_strategy.min_population_size),
+                     int(min(self.population_strategy.max_population_size,
+                             n_cap)),
+                     int(self.population_strategy.n_bootstrap))
+                    if adaptive_n else None
+                ),
+            )
 
         def _g_limit(t_at: int) -> int:
             g = G
@@ -1814,23 +1929,28 @@ class ABCSMC:
 
         probe_pool = (ThreadPoolExecutor(max_workers=1)
                       if self.compute_probe else None)
+        clk = self._clock.now
 
         def _probe(out, disp_ts):
             jax.block_until_ready(out)
-            self.probe_events.append((disp_ts, time.time()))
+            self.probe_events.append((disp_ts, clk()))
 
         def _submit(res_i, t_at, g_lim):
             if probe_pool is not None:
                 probe_pool.submit(_probe, res_i["outs"]["gen_ok"],
-                                  time.time())
+                                  clk())
             tree = _fetch_tree(res_i, t_at, g_lim)
             if executor is None:
                 return tree  # fetched synchronously at pop time
             return executor.submit(jax.device_get, tree)
 
         chunk_index = 0
-        t_chunk0 = time.time()
-        res = _dispatch_chunk(carry0, t, g_limit)
+        t_chunk0 = clk()
+        # the FIRST dispatch triggers the multigen kernel's trace/compile
+        # (the dominant dark block on fresh runs, per the first coverage
+        # traces) — span it separately so compile time is attributed
+        with self.tracer.span("dispatch", first=True, t_first=int(t)):
+            res = _dispatch_chunk(carry0, t, g_limit)
         #: (fetch handle, t_at, g_lim) in dispatch order
         pending = [(_submit(res, t, g_limit), t, g_limit)]
         tail = (res, t, g_limit)  # newest dispatched chunk (carry chain)
@@ -1848,40 +1968,58 @@ class ABCSMC:
             handle, t_at, g_lim = pending.pop(0)
             logger.info("t: %d..%d (fused chunk of %d)", t_at,
                         t_at + g_lim - 1, g_lim)
-            t_fetch0 = time.time()
-            fetched = (handle.result() if executor is not None
-                       else jax.device_get(handle))
-            now = time.time()
-            fetch_s = now - t_fetch0  # EXPOSED wait (latency pipelined)
-            chunk_s = now - t_chunk0  # pipeline period: fetch-to-fetch
-            t_chunk0 = now
-            ss_rows = fetched.pop("__ss_rows__", None)
-            calib = fetched.pop("__calib__", None)
-            if calib is not None:
-                self._mirror_fused_calibration(calib)
-            mem_telemetry = self._device_memory_telemetry()
-            chunk_index += 1
-            t_proc0 = time.time()
-            (stop, last_pop, last_sample, last_eps, last_acc_rate, t,
-             sims_total, n_acc_chunk, g_done) = self._process_chunk(
-                fetched, ss_rows, t, g_lim, n_of, adaptive_n,
-                adaptive, stochastic, temp_fixed, eps_quantile,
-                sumstat_refit, chunk_index, chunk_s, dispatch_s,
-                fetch_s, depth, mem_telemetry,
-                sims_total, minimum_epsilon, max_nr_populations,
-                min_acceptance_rate, max_total_nr_simulations,
-                max_walltime, start_walltime,
-            )
+            with self.tracer.span("chunk", t_first=int(t_at),
+                                  gens=int(g_lim)) as c_span:
+                t_fetch0 = clk()
+                with self.tracer.span("fetch", t_first=int(t_at)):
+                    fetched = (handle.result() if executor is not None
+                               else jax.device_get(handle))
+                now = clk()
+                fetch_s = now - t_fetch0  # EXPOSED wait (latency pipelined)
+                chunk_s = now - t_chunk0  # pipeline period: fetch-to-fetch
+                t_chunk0 = now
+                ss_rows = fetched.pop("__ss_rows__", None)
+                calib = fetched.pop("__calib__", None)
+                if calib is not None:
+                    self._mirror_fused_calibration(calib)
+                mem_telemetry = self._device_memory_telemetry()
+                chunk_index += 1
+                t_proc0 = clk()
+                with self.tracer.span("process", t_first=int(t_at)):
+                    (stop, last_pop, last_sample, last_eps, last_acc_rate,
+                     t, sims_total, n_acc_chunk, g_done) = \
+                        self._process_chunk(
+                            fetched, ss_rows, t, g_lim, n_of, adaptive_n,
+                            adaptive, stochastic, temp_fixed, eps_quantile,
+                            sumstat_refit, chunk_index, chunk_s, dispatch_s,
+                            fetch_s, depth, mem_telemetry,
+                            sims_total, minimum_epsilon, max_nr_populations,
+                            min_acceptance_rate, max_total_nr_simulations,
+                            max_walltime, start_walltime,
+                        )
+                c_span.set(chunk_index=int(chunk_index),
+                           n_acc=int(n_acc_chunk), g_done=int(g_done),
+                           chunk_s=round(float(chunk_s), 6),
+                           fetch_s=round(float(fetch_s), 6),
+                           dispatch_s=round(float(dispatch_s), 6))
+                self.metrics.histogram(
+                    "pyabc_tpu_chunk_fetch_seconds",
+                    "exposed device->host fetch wait per fused chunk",
+                ).observe(float(fetch_s))
+                self.metrics.counter(
+                    "pyabc_tpu_particles_accepted",
+                    "accepted particles across fused chunks",
+                ).inc(int(n_acc_chunk))
             if self.chunk_event_cb is not None:
                 try:
                     self.chunk_event_cb({
-                        "ts": time.time(), "t_first": int(t_at),
+                        "ts": clk(), "t_first": int(t_at),
                         "gens": int(g_done), "n_acc": int(n_acc_chunk),
                         "chunk_index": int(chunk_index),
                         "chunk_s": float(chunk_s),
                         "fetch_s": float(fetch_s),
                         "dispatch_s": float(dispatch_s),
-                        "process_s": float(time.time() - t_proc0),
+                        "process_s": float(clk() - t_proc0),
                     })
                 except Exception:
                     logger.exception("chunk_event_cb failed")
@@ -1925,17 +2063,18 @@ class ABCSMC:
         try:
             while pending:
                 # keep the device fed: dispatch + start fetches up to depth
-                t_disp0 = time.time()
-                while not sumstat_refit and len(pending) < refill_target:
-                    lr, lt, lg = tail
-                    g_next = _g_limit(lt + lg)
-                    if g_next <= 0:
-                        break
-                    nxt = _dispatch_chunk(lr["carry"], lt + lg, g_next)
-                    tail = (nxt, lt + lg, g_next)
-                    pending.append((_submit(nxt, lt + lg, g_next),
-                                    lt + lg, g_next))
-                dispatch_s = time.time() - t_disp0
+                t_disp0 = clk()
+                with self.tracer.span("dispatch"):
+                    while not sumstat_refit and len(pending) < refill_target:
+                        lr, lt, lg = tail
+                        g_next = _g_limit(lt + lg)
+                        if g_next <= 0:
+                            break
+                        nxt = _dispatch_chunk(lr["carry"], lt + lg, g_next)
+                        tail = (nxt, lt + lg, g_next)
+                        pending.append((_submit(nxt, lt + lg, g_next),
+                                        lt + lg, g_next))
+                dispatch_s = clk() - t_disp0
                 if (self.drain_async and not sumstat_refit
                         and chunk_index >= 1 and pending
                         and _g_limit(tail[1] + tail[2]) <= 0):
@@ -2272,16 +2411,45 @@ class ABCSMC:
                 list(particles), self._spaces(), self.spec,
                 self.model_names,
             )
+            # ESS guard: a preliminary KDE fit on a weight-degenerate
+            # accepted-so-far set produces a proposal whose importance
+            # ratios explode — the next generation's ESS then collapses
+            # too. Running WITHOUT look-ahead is always statistically
+            # sound, so degenerate builders simply skip it.
+            w_all = np.asarray(pop.weights, np.float64)
+            w_all = w_all / max(w_all.sum(), 1e-300)
+            ess = 1.0 / max(float(np.sum(w_all * w_all)), 1e-300)
+            if ess < self.lookahead_min_ess:
+                logger.info(
+                    "look-ahead for generation %d skipped: builder "
+                    "ESS %.1f < %.1f (weight-degenerate preliminary "
+                    "population)", t_next, ess, self.lookahead_min_ess,
+                )
+                return None
             probs_arr = pop.model_probabilities_array()
             prelim_probs = {
                 m: float(probs_arr[m]) for m in pop.get_alive_models()
             }
+            widen = float(self.lookahead_proposal_widen)
+            alpha = float(self.lookahead_defensive_frac)
             prelim_transitions = []
             for m, tr in enumerate(self.transitions):
                 cp = tr.copy_unfitted()
+                # bandwidth widening (variance guard, see __init__):
+                # scaling multiplies the KDE bandwidth on every stock
+                # transition; custom transitions without it fit unwidened
+                if widen != 1.0 and isinstance(
+                        getattr(cp, "scaling", None), float):
+                    cp.scaling = cp.scaling * widen
                 if m in prelim_probs:
                     df, w = pop.get_distribution(m)
                     cp.fit(df, w)
+                    if alpha > 0.0:
+                        # defensive prior mixture: importance ratios of
+                        # the adopted generation are bounded by 1/alpha
+                        cp = DefensivePreliminaryTransition(
+                            cp, self.parameter_priors[m], alpha
+                        )
                 prelim_transitions.append(cp)
             prior_probs = self.model_prior_probs
             K = self.K
@@ -2433,8 +2601,10 @@ class ABCSMC:
             self, "_resumed_distance_changed", False)
         last_strategies_s = 0.0  # first generation never speculates
 
+        clk = self._clock.now
+
         def _dispatch(t_next, speculative=None):
-            t_d0 = time.time()
+            t_d0 = clk()
             current_eps = self.eps(t_next)
             if hasattr(self.acceptor, "note_epsilon"):
                 self.acceptor.note_epsilon(t_next, current_eps,
@@ -2445,14 +2615,15 @@ class ABCSMC:
                 if min_acceptance_rate > 0 else np.inf
             )
             logger.info("t: %d, eps: %.8g", t_next, current_eps)
-            spec = self._generation_spec(t_next)
-            spec_s = time.time() - t_d0
-            handle = self.sampler.dispatch(n_t, spec, t_next,
-                                           max_eval=max_eval,
-                                           speculative=speculative)
+            with self.tracer.span("dispatch", t=int(t_next), n=int(n_t)):
+                spec = self._generation_spec(t_next)
+                spec_s = clk() - t_d0
+                handle = self.sampler.dispatch(n_t, spec, t_next,
+                                               max_eval=max_eval,
+                                               speculative=speculative)
             handle["dispatch_telemetry"] = {
                 "spec_s": round(spec_s, 4),
-                "enqueue_s": round(time.time() - t_d0 - spec_s, 4),
+                "enqueue_s": round(clk() - t_d0 - spec_s, 4),
             }
             if speculative is not None:
                 handle["dispatch_telemetry"]["speculative_accepted"] = (
@@ -2463,9 +2634,10 @@ class ABCSMC:
 
         handle, current_eps, n_t = _dispatch(t)
         while True:
-            t_gen0 = time.time()
-            sample = self.sampler.collect(handle)
-            sample_s = time.time() - t_gen0
+            t_gen0 = clk()
+            with self.tracer.span("collect", t=int(t), n=int(n_t)):
+                sample = self.sampler.collect(handle)
+            sample_s = clk() - t_gen0
             n_acc = sample.n_accepted if sample.ms is not None else len(
                 sample.accepted_particles
             )
@@ -2493,27 +2665,28 @@ class ABCSMC:
             # bisection, epsilon quantiles, acceptor norms) run on the host;
             # its delayed acceptance is applied at dispatch time (reference
             # look-ahead with delayed evaluation, SURVEY.md §2.3)
-            t_adapt0 = time.time()
+            t_adapt0 = clk()
             spec_round = None
-            self._adapt_proposal(pop)
-            # every stop rule is decidable BEFORE the slow strategy updates
-            # (model probs were refreshed by _adapt_proposal above) — don't
-            # burn a speculative round on a generation that will never be
-            # dispatched
-            surely_stopping = self._check_stop(
-                t, current_eps, minimum_epsilon, max_nr_populations,
-                acceptance_rate, min_acceptance_rate, sims_total,
-                max_total_nr_simulations, max_walltime, start_walltime)
-            if (not surely_stopping
-                    and self._speculation_capable()
-                    and last_strategies_s > self.speculation_min_adapt_s):
-                spec_round = self._dispatch_speculative_round(t + 1, n_t)
-            t_strat0 = time.time()
-            distance_changed_at_t = self._adapt_strategies(
-                t, sample, pop, current_eps, acceptance_rate
-            )
-            last_strategies_s = time.time() - t_strat0
-            adapt_s = time.time() - t_adapt0
+            with self.tracer.span("adapt", t=int(t)):
+                self._adapt_proposal(pop)
+                # every stop rule is decidable BEFORE the slow strategy
+                # updates (model probs were refreshed by _adapt_proposal
+                # above) — don't burn a speculative round on a generation
+                # that will never be dispatched
+                surely_stopping = self._check_stop(
+                    t, current_eps, minimum_epsilon, max_nr_populations,
+                    acceptance_rate, min_acceptance_rate, sims_total,
+                    max_total_nr_simulations, max_walltime, start_walltime)
+                if (not surely_stopping
+                        and self._speculation_capable()
+                        and last_strategies_s > self.speculation_min_adapt_s):
+                    spec_round = self._dispatch_speculative_round(t + 1, n_t)
+                t_strat0 = clk()
+                distance_changed_at_t = self._adapt_strategies(
+                    t, sample, pop, current_eps, acceptance_rate
+                )
+                last_strategies_s = clk() - t_strat0
+            adapt_s = clk() - t_adapt0
 
             # re-check AFTER the strategy updates: their duration counts
             # against max_walltime (slow temperature bisections / distance
@@ -2529,19 +2702,21 @@ class ABCSMC:
                     t + 1, speculative=spec_round)
 
             # ... while the host persists generation t
-            t_persist0 = time.time()
-            self.history.append_population(
-                t, current_eps, db_pop, nr_evals, self.model_names,
-                telemetry={"sample_s": round(sample_s, 4),
-                           "adapt_s": round(adapt_s, 4),
-                           "n_evaluations": int(nr_evals),
-                           "acceptance_rate": round(acceptance_rate, 6),
-                           "distance_changed": bool(distance_changed_at_t),
-                           "pipelined": True,
-                           **handle.get("dispatch_telemetry", {})},
-            )
+            t_persist0 = clk()
+            with self.tracer.span("persist", t=int(t)):
+                self.history.append_population(
+                    t, current_eps, db_pop, nr_evals, self.model_names,
+                    telemetry={"sample_s": round(sample_s, 4),
+                               "adapt_s": round(adapt_s, 4),
+                               "n_evaluations": int(nr_evals),
+                               "acceptance_rate": round(acceptance_rate, 6),
+                               "distance_changed":
+                                   bool(distance_changed_at_t),
+                               "pipelined": True,
+                               **handle.get("dispatch_telemetry", {})},
+                )
             self.history.update_telemetry(
-                t, {"persist_s": round(time.time() - t_persist0, 4)}
+                t, {"persist_s": round(clk() - t_persist0, 4)}
             )
             if stop:
                 break

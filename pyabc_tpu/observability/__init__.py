@@ -1,0 +1,107 @@
+"""pyabc_tpu.observability — tracing spans, metrics, wall-clock attribution.
+
+One dependency-free subsystem for every host-side measurement in the
+ABC-SMC pipeline (SURVEY.md §5.1 tracing/profiling row, grown into a
+first-class layer):
+
+- :class:`Tracer` / :class:`NullTracer` — nested, thread-safe spans on
+  a single injected clock (``tracer.span("generation", t=3)``);
+- :class:`MetricsRegistry` — counters, gauges, histogram timers
+  (broker queue depth, chunk latency, DB backlog);
+- exporters — :class:`JsonlTraceExporter` (streamed trace file),
+  :func:`prometheus_text` (metrics dump), plus in-process
+  ``snapshot()`` APIs the visserver dashboard and bench read;
+- :func:`coverage_report` — the coverage accountant: the fraction of a
+  wall-clock window attributed to at least one span, overall and per
+  thread (the round-5 "60% dark time" gap as a number).
+
+Enablement: everything defaults to the no-op :data:`NULL_TRACER` /
+:data:`NULL_METRICS`. Turn tracing on per run via
+``ABCSMC(..., tracer=Tracer(...))`` or process-wide via the env var
+``PYABC_TPU_TRACE=/path/to/trace.jsonl`` (read by
+:func:`default_tracer`). Instrumentation wraps host boundaries only —
+compiled device code is never touched, so fused kernels are
+byte-identical with observability on or off.
+"""
+from .clock import Clock, SystemClock, VirtualClock, SYSTEM_CLOCK
+from .coverage import coverage_report, interval_union, window_throughput
+from .export import JsonlTraceExporter, prometheus_text, read_trace
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    NullMetrics,
+    NULL_METRICS,
+)
+from .tracer import NullTracer, NULL_TRACER, Span, Tracer
+
+import os as _os
+import threading as _threading
+
+__all__ = [
+    "Clock", "SystemClock", "VirtualClock", "SYSTEM_CLOCK",
+    "Span", "Tracer", "NullTracer", "NULL_TRACER",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "NullMetrics",
+    "NULL_METRICS",
+    "JsonlTraceExporter", "prometheus_text", "read_trace",
+    "coverage_report", "interval_union", "window_throughput",
+    "default_tracer", "global_metrics", "global_tracer",
+    "set_global_tracer", "observability_snapshot",
+]
+
+_lock = _threading.Lock()
+_global_tracer = None
+_global_metrics: MetricsRegistry | None = None
+
+
+def default_tracer():
+    """The tracer a fresh ABCSMC uses when none is passed: a JSONL-
+    exporting tracer if ``PYABC_TPU_TRACE`` names a path (shared
+    process-wide so back-to-back runs append to one trace), else
+    :data:`NULL_TRACER`."""
+    path = _os.environ.get("PYABC_TPU_TRACE")
+    if not path:
+        return NULL_TRACER
+    global _global_tracer
+    with _lock:
+        if _global_tracer is None or getattr(
+                getattr(_global_tracer, "_exporter", None), "path", None
+        ) != path:
+            _global_tracer = Tracer(exporter=JsonlTraceExporter(path))
+        return _global_tracer
+
+
+def global_tracer():
+    """The process-wide tracer, if any was installed (via
+    ``PYABC_TPU_TRACE`` or :func:`set_global_tracer`); else the null
+    tracer. The visserver's ``/api/observability`` endpoint reads it."""
+    with _lock:
+        return _global_tracer if _global_tracer is not None else NULL_TRACER
+
+
+def set_global_tracer(tracer) -> None:
+    global _global_tracer
+    with _lock:
+        _global_tracer = tracer
+
+
+def global_metrics() -> MetricsRegistry:
+    """Process-wide metrics registry (created on first use). Real (not
+    null): bare counters/gauges are cheap enough to always collect, and
+    a dashboard scraping a process that never configured observability
+    should still see the broker/writer instruments."""
+    global _global_metrics
+    with _lock:
+        if _global_metrics is None:
+            _global_metrics = MetricsRegistry()
+        return _global_metrics
+
+
+def observability_snapshot() -> dict:
+    """One JSON-ready dict of the process's tracer + metrics state —
+    the in-process snapshot API (dashboard endpoint, bench block)."""
+    return {
+        "tracer": global_tracer().snapshot(),
+        "metrics": global_metrics().snapshot(),
+    }

@@ -1,0 +1,65 @@
+"""The single injected clock behind every host-side measurement.
+
+Every instrumented module (orchestrator loops, broker, async DB writer,
+bench) reads time through ONE of these objects instead of calling
+``time.time()`` ad hoc:
+
+- spans and deadlines become immune to wall-clock steps (NTP slews,
+  suspended VMs) because the default timebase is ``time.monotonic()``;
+- tests drive a :class:`VirtualClock` to make timing logic deterministic
+  (the bench spend loop and broker deadlines are tested this way).
+
+``now()`` is the measurement timebase (monotonic seconds; arbitrary
+epoch — only differences are meaningful). ``wall()`` is the civil
+timestamp for DATA that leaves the process (log lines, db rows); never
+subtract two ``wall()`` readings to measure a duration.
+
+A repo lint (``tests/test_observability_lint.py``) fails when an
+instrumented module calls ``time.time()`` directly.
+"""
+from __future__ import annotations
+
+import time as _time
+
+
+class Clock:
+    """Interface: ``now()`` (monotonic) + ``wall()`` (civil)."""
+
+    def now(self) -> float:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def wall(self) -> float:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class SystemClock(Clock):
+    """The production clock: monotonic timebase, wall timestamps."""
+
+    def now(self) -> float:
+        return _time.monotonic()
+
+    def wall(self) -> float:
+        return _time.time()
+
+
+class VirtualClock(Clock):
+    """A test clock advanced explicitly; ``wall()`` tracks ``now()``."""
+
+    def __init__(self, start: float = 0.0):
+        self._t = float(start)
+
+    def now(self) -> float:
+        return self._t
+
+    def wall(self) -> float:
+        return self._t
+
+    def advance(self, dt: float) -> float:
+        self._t += float(dt)
+        return self._t
+
+
+#: process-wide default — share ONE instance so timestamps from
+#: different subsystems (tracer spans, bench events, broker deadlines)
+#: live on the same timebase and can be compared directly
+SYSTEM_CLOCK = SystemClock()

@@ -1,0 +1,100 @@
+"""Exporters: JSONL trace files and Prometheus text exposition.
+
+JSONL is the durable trace format (one span per line, written at span
+end): it survives crashes mid-run, streams without buffering a whole
+trace in memory, and round-trips through :func:`read_trace` into the
+coverage accountant. The Prometheus dump is the scrape-friendly view of
+a :class:`~pyabc_tpu.observability.metrics.MetricsRegistry`.
+"""
+from __future__ import annotations
+
+import json
+import threading
+
+from .metrics import Counter, Gauge, Histogram
+
+
+class JsonlTraceExporter:
+    """Append spans to ``path`` as JSON lines; thread-safe.
+
+    Opened lazily on the first span so merely CONSTRUCTING a tracer
+    config never creates files. ``close()`` is optional (the handle
+    flushes per line; an abandoned exporter leaks one fd at worst).
+    """
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self._lock = threading.Lock()
+        self._fh = None
+
+    def export(self, span) -> None:
+        line = json.dumps(span.to_dict() if hasattr(span, "to_dict")
+                          else dict(span))
+        with self._lock:
+            if self._fh is None:
+                self._fh = open(self.path, "a")
+            self._fh.write(line + "\n")
+            self._fh.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+def read_trace(path: str) -> list[dict]:
+    """Parse a JSONL trace back into span dicts (coverage-accountant
+    ready). Tolerates a truncated final line (crash mid-write)."""
+    spans: list[dict] = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                spans.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return spans
+
+
+def _prom_name(name: str) -> str:
+    out = "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+    return out if not out[:1].isdigit() else "_" + out
+
+
+def prometheus_text(registry) -> str:
+    """Prometheus text-format dump of every instrument in ``registry``.
+
+    Histograms render cumulative ``_bucket`` series plus ``_count`` /
+    ``_sum``, counters get a ``_total`` suffix, gauges render as-is.
+    """
+    lines: list[str] = []
+    for inst in registry.instruments():
+        name = _prom_name(inst.name)
+        if isinstance(inst, Counter):
+            if inst.help:
+                lines.append(f"# HELP {name}_total {inst.help}")
+            lines.append(f"# TYPE {name}_total counter")
+            lines.append(f"{name}_total {inst.value:g}")
+        elif isinstance(inst, Gauge):
+            if inst.help:
+                lines.append(f"# HELP {name} {inst.help}")
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {inst.value:g}")
+        elif isinstance(inst, Histogram):
+            if inst.help:
+                lines.append(f"# HELP {name} {inst.help}")
+            lines.append(f"# TYPE {name} histogram")
+            cum = 0
+            with inst._lock:
+                buckets = list(inst._buckets)
+                count, total = inst.count, inst.sum
+            for edge, n in zip(inst.bucket_bounds(), buckets[:-1]):
+                cum += n
+                lines.append(f'{name}_bucket{{le="{edge:g}"}} {cum}')
+            lines.append(f'{name}_bucket{{le="+Inf"}} {count}')
+            lines.append(f"{name}_count {count}")
+            lines.append(f"{name}_sum {total:g}")
+    return "\n".join(lines) + ("\n" if lines else "")

@@ -1,0 +1,256 @@
+"""Counters, gauges and histogram timers over the injected clock.
+
+The registry is the numeric side of the observability subsystem (spans
+are the temporal side): broker queue depth, device-chunk latency, DB
+writer backlog, accepted-particles/s all live here as named instruments.
+
+Same design rules as the tracer: stdlib-only, host-side, and no-op
+cheap when disabled (:data:`NULL_METRICS` is the default everywhere).
+Exports: :meth:`MetricsRegistry.snapshot` (in-process dict for the
+dashboard / bench) and :func:`~pyabc_tpu.observability.export.
+prometheus_text` (Prometheus text exposition).
+"""
+from __future__ import annotations
+
+import threading
+
+from .clock import Clock, SYSTEM_CLOCK
+
+
+class Counter:
+    """Monotonically increasing count (events, particles, bytes)."""
+
+    __slots__ = ("name", "help", "_lock", "_value")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    """A value that goes up and down (queue depth, backlog, in-flight)."""
+
+    __slots__ = ("name", "help", "_lock", "_value")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value -= amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class _HistogramTimer:
+    __slots__ = ("_hist", "_t0")
+
+    def __init__(self, hist: "Histogram"):
+        self._hist = hist
+        self._t0 = 0.0
+
+    def __enter__(self) -> "_HistogramTimer":
+        self._t0 = self._hist._clock.now()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self._hist.observe(self._hist._clock.now() - self._t0)
+        return False
+
+
+class Histogram:
+    """Fixed log2-bucket histogram + running count/sum/min/max.
+
+    Buckets are powers of two over ``[base, base * 2**n_buckets)`` —
+    latency-shaped without configuration. ``time()`` returns a
+    contextmanager observing elapsed seconds on the registry's clock.
+    """
+
+    __slots__ = ("name", "help", "_clock", "_lock", "_base", "_buckets",
+                 "count", "sum", "min", "max")
+
+    def __init__(self, name: str, help: str = "",
+                 clock: Clock | None = None,
+                 base: float = 1e-4, n_buckets: int = 28):
+        self.name = name
+        self.help = help
+        self._clock = clock if clock is not None else SYSTEM_CLOCK
+        self._lock = threading.Lock()
+        self._base = float(base)
+        self._buckets = [0] * (int(n_buckets) + 1)  # +1: overflow bucket
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        i = 0
+        edge = self._base
+        while v >= edge and i < len(self._buckets) - 1:
+            edge *= 2.0
+            i += 1
+        with self._lock:
+            self._buckets[i] += 1
+            self.count += 1
+            self.sum += v
+            if v < self.min:
+                self.min = v
+            if v > self.max:
+                self.max = v
+
+    def time(self) -> _HistogramTimer:
+        return _HistogramTimer(self)
+
+    def bucket_bounds(self) -> list[float]:
+        out, edge = [], self._base
+        for _ in range(len(self._buckets) - 1):
+            out.append(edge)
+            edge *= 2.0
+        return out
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {
+                "count": self.count, "sum": round(self.sum, 9),
+                "min": self.min if self.count else None,
+                "max": self.max if self.count else None,
+                "mean": (self.sum / self.count) if self.count else None,
+            }
+
+
+class MetricsRegistry:
+    """Named instruments; get-or-create semantics, thread-safe."""
+
+    enabled = True
+
+    def __init__(self, clock: Clock | None = None):
+        self.clock = clock if clock is not None else SYSTEM_CLOCK
+        self._lock = threading.Lock()
+        self._instruments: dict[str, Counter | Gauge | Histogram] = {}
+
+    def _get(self, name: str, cls, help: str, **kwargs):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = cls(name, help, **kwargs)
+                self._instruments[name] = inst
+            elif type(inst) is not cls:
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(inst).__name__}, not {cls.__name__}"
+                )
+            return inst
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(name, Counter, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(name, Gauge, help)
+
+    def histogram(self, name: str, help: str = "") -> Histogram:
+        return self._get(name, Histogram, help, clock=self.clock)
+
+    def instruments(self) -> list:
+        with self._lock:
+            return list(self._instruments.values())
+
+    def snapshot(self) -> dict:
+        """{name: value-or-summary} — the in-process read API."""
+        out = {}
+        for inst in self.instruments():
+            if isinstance(inst, Histogram):
+                out[inst.name] = inst.summary()
+            else:
+                out[inst.name] = inst.value
+        return out
+
+
+class _NullInstrument:
+    """Shared inert counter/gauge/histogram-timer hybrid."""
+
+    __slots__ = ()
+    name = ""
+    help = ""
+    value = 0.0
+    count = 0
+    sum = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        pass
+
+    def dec(self, amount: float = 1.0) -> None:
+        pass
+
+    def set(self, value: float) -> None:
+        pass
+
+    def observe(self, value: float) -> None:
+        pass
+
+    def time(self) -> "_NullInstrument":
+        return self
+
+    def __enter__(self) -> "_NullInstrument":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+    def summary(self) -> dict:
+        return {"count": 0, "sum": 0.0, "min": None, "max": None,
+                "mean": None}
+
+
+_NULL_INSTRUMENT = _NullInstrument()
+
+
+class NullMetrics:
+    """Disabled registry: every instrument is the shared inert object."""
+
+    enabled = False
+
+    def __init__(self, clock: Clock | None = None):
+        self.clock = clock if clock is not None else SYSTEM_CLOCK
+
+    def counter(self, name: str, help: str = "") -> _NullInstrument:
+        return _NULL_INSTRUMENT
+
+    def gauge(self, name: str, help: str = "") -> _NullInstrument:
+        return _NULL_INSTRUMENT
+
+    def histogram(self, name: str, help: str = "") -> _NullInstrument:
+        return _NULL_INSTRUMENT
+
+    def instruments(self) -> list:
+        return []
+
+    def snapshot(self) -> dict:
+        return {}
+
+
+#: process-wide default disabled registry
+NULL_METRICS = NullMetrics()

@@ -1,0 +1,249 @@
+"""Nested, thread-safe tracing spans over one injected clock.
+
+The unit is the :class:`Span`: a named ``[start, end]`` interval on the
+tracer's clock, carrying key-value attributes (generation index, chunk
+size, n_accepted, ...), an explicit parent link, and the name of the
+thread that ran it. Spans nest per thread via a contextmanager API::
+
+    with tracer.span("generation", t=3, n=1000) as sp:
+        with tracer.span("sample"):
+            ...
+        sp.set(n_evaluations=n_eval)
+
+Design rules (the whole subsystem follows them):
+
+- **dependency-free**: stdlib only — importable from worker processes,
+  the bench, and tests without dragging jax/pandas along;
+- **host-side only**: spans wrap host boundaries (dispatch, fetch,
+  persist, adapt); nothing here may touch traced/compiled device code,
+  so fused kernels stay byte-identical with tracing on or off;
+- **no-op-cheap when disabled**: :data:`NULL_TRACER` (the default
+  everywhere) allocates nothing per span — instrumentation can stay in
+  hot paths unconditionally.
+
+Thread safety: the parent stack is thread-local; finished spans append
+to one lock-guarded list (and stream to an exporter if configured), so
+concurrent fetch threads, the async DB writer and the drain thread can
+all record spans into the same tracer.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+
+from .clock import Clock, SYSTEM_CLOCK
+
+
+class Span:
+    """One named interval on the tracer's clock; ``attrs`` is open."""
+
+    __slots__ = ("name", "span_id", "parent_id", "thread", "start", "end",
+                 "attrs")
+
+    def __init__(self, name: str, span_id: int, parent_id: int | None,
+                 thread: str, start: float, attrs: dict):
+        self.name = name
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.thread = thread
+        self.start = start
+        self.end: float | None = None
+        self.attrs = attrs
+
+    def set(self, **attrs) -> "Span":
+        """Attach/overwrite attributes while the span is open."""
+        self.attrs.update(attrs)
+        return self
+
+    @property
+    def duration(self) -> float:
+        return (self.end if self.end is not None else self.start) - self.start
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name, "span_id": self.span_id,
+            "parent_id": self.parent_id, "thread": self.thread,
+            "start": self.start, "end": self.end, "attrs": dict(self.attrs),
+        }
+
+
+class _SpanContext:
+    """The contextmanager handed out by :meth:`Tracer.span`.
+
+    A dedicated class instead of ``@contextmanager``: entering a
+    generator-based contextmanager costs ~3x more, and span() sits on
+    per-chunk/per-generation paths.
+    """
+
+    __slots__ = ("_tracer", "_span")
+
+    def __init__(self, tracer: "Tracer", span: Span):
+        self._tracer = tracer
+        self._span = span
+
+    def __enter__(self) -> Span:
+        return self._span
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is not None:
+            self._span.attrs.setdefault("error", repr(exc)[:200])
+        self._tracer._finish(self._span)
+        return False
+
+
+class Tracer:
+    """Collects finished spans; bounded memory; optional streaming export.
+
+    ``exporter``: an object with ``export(span)`` (e.g.
+    :class:`~pyabc_tpu.observability.export.JsonlTraceExporter`) called
+    at each span end, on the ending thread. ``max_spans`` bounds the
+    in-memory buffer — beyond it the OLDEST spans are dropped (counted
+    in ``n_dropped``; a streaming exporter still saw them all).
+    """
+
+    enabled = True
+
+    def __init__(self, clock: Clock | None = None, exporter=None,
+                 max_spans: int = 200_000):
+        self.clock = clock if clock is not None else SYSTEM_CLOCK
+        self._exporter = exporter
+        self._max_spans = int(max_spans)
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._finished: list[Span] = []
+        self._ids = itertools.count(1)
+        self.n_dropped = 0
+
+    # ------------------------------------------------------------------ api
+    def span(self, name: str, **attrs) -> _SpanContext:
+        """Open a span as a context manager; nests under the thread's
+        current open span."""
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        parent_id = stack[-1].span_id if stack else None
+        sp = Span(name, next(self._ids), parent_id,
+                  threading.current_thread().name, self.clock.now(), attrs)
+        stack.append(sp)
+        return _SpanContext(self, sp)
+
+    def current_span(self) -> Span | None:
+        stack = getattr(self._local, "stack", None)
+        return stack[-1] if stack else None
+
+    def spans(self) -> list[Span]:
+        """Snapshot of finished spans (chronological by end time)."""
+        with self._lock:
+            return list(self._finished)
+
+    def snapshot(self) -> dict:
+        """In-process summary the dashboard / bench read without touching
+        span objects: per-name counts and total seconds."""
+        with self._lock:
+            per_name: dict[str, dict] = {}
+            for sp in self._finished:
+                agg = per_name.setdefault(
+                    sp.name, {"count": 0, "total_s": 0.0})
+                agg["count"] += 1
+                agg["total_s"] += sp.duration
+            for agg in per_name.values():
+                agg["total_s"] = round(agg["total_s"], 6)
+            return {
+                "n_spans": len(self._finished),
+                "n_dropped": self.n_dropped,
+                "spans_by_name": per_name,
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._finished.clear()
+            self.n_dropped = 0
+
+    # ------------------------------------------------------------ internals
+    def _finish(self, sp: Span) -> None:
+        sp.end = self.clock.now()
+        stack = getattr(self._local, "stack", None)
+        # unwind to (and including) sp — tolerant of a caller leaking an
+        # inner contextmanager across threads or exiting out of order
+        if stack:
+            while stack:
+                top = stack.pop()
+                if top is sp:
+                    break
+        with self._lock:
+            self._finished.append(sp)
+            if len(self._finished) > self._max_spans:
+                drop = len(self._finished) - self._max_spans
+                del self._finished[:drop]
+                self.n_dropped += drop
+        if self._exporter is not None:
+            try:
+                self._exporter.export(sp)
+            except Exception:  # noqa: BLE001 - tracing must never kill work
+                pass
+
+
+class _NullSpan:
+    """Shared inert span: ``set()`` no-ops, fields read as empty."""
+
+    __slots__ = ()
+    name = ""
+    span_id = 0
+    parent_id = None
+    thread = ""
+    start = 0.0
+    end = 0.0
+    attrs: dict = {}
+    duration = 0.0
+
+    def set(self, **attrs) -> "_NullSpan":
+        return self
+
+
+class _NullSpanContext:
+    __slots__ = ()
+
+    def __enter__(self) -> _NullSpan:
+        return _NULL_SPAN
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+_NULL_SPAN_CONTEXT = _NullSpanContext()
+
+
+class NullTracer:
+    """The default tracer: every call returns a shared inert object.
+
+    ``span()`` allocates nothing (the kwargs dict an instrumented call
+    site builds is the entire cost), so instrumentation is safe to
+    leave on hot paths unconditionally — guarded by the overhead test
+    in ``tests/test_observability.py``.
+    """
+
+    enabled = False
+
+    def __init__(self, clock: Clock | None = None):
+        self.clock = clock if clock is not None else SYSTEM_CLOCK
+        self.n_dropped = 0
+
+    def span(self, name: str, **attrs) -> _NullSpanContext:
+        return _NULL_SPAN_CONTEXT
+
+    def current_span(self) -> None:
+        return None
+
+    def spans(self) -> list:
+        return []
+
+    def snapshot(self) -> dict:
+        return {"n_spans": 0, "n_dropped": 0, "spans_by_name": {}}
+
+    def clear(self) -> None:
+        pass
+
+
+#: process-wide default null tracer (shares the system clock)
+NULL_TRACER = NullTracer()

@@ -254,10 +254,17 @@ class Sampler:
     """
 
     def __init__(self):
+        from ..observability import NULL_METRICS, NULL_TRACER
+
         self.nr_evaluations_: int = 0
         self.sample_factory = SampleFactory()
         self.show_progress = False
         self.analysis_id: str | None = None
+        #: observability sinks (pyabc_tpu/observability/): ABCSMC rebinds
+        #: these to the run's tracer/registry at run() time; the no-op
+        #: defaults keep standalone sampler use free of overhead
+        self.tracer = NULL_TRACER
+        self.metrics = NULL_METRICS
 
     def set_analysis_id(self, analysis_id: str):
         self.analysis_id = analysis_id

@@ -196,12 +196,13 @@ class BatchedSampler(Sampler):
         max_rounds = self.max_rounds
         if self.check_max_eval and np.isfinite(max_eval):
             max_rounds = max(1, min(max_rounds, int(max_eval) // B))
-        out = ctx.dispatch_generation(
-            generation_spec.gen_key, B, mode, dyn, n_cap=n_cap,
-            rec_cap=rec_cap, max_rounds=max_rounds, n_target=n_target,
-            record_proposal=(sample.record_rejected
-                             and sample.record_proposal_info),
-        )
+        with self.tracer.span("device.dispatch", n=int(n), B=int(B)):
+            out = ctx.dispatch_generation(
+                generation_spec.gen_key, B, mode, dyn, n_cap=n_cap,
+                rec_cap=rec_cap, max_rounds=max_rounds, n_target=n_target,
+                record_proposal=(sample.record_rejected
+                                 and sample.record_proposal_info),
+            )
         return {"out": out, "sample": sample, "n": n, "n_cap": n_cap,
                 "spec": spec_block}
 
@@ -215,9 +216,10 @@ class BatchedSampler(Sampler):
         import jax
 
         out = handle["out"]
-        host = jax.device_get(
-            {k: v for k, v in out.items() if k != "rec_sumstats"}
-        )
+        with self.tracer.span("device.collect", n=int(handle["n"])):
+            host = jax.device_get(
+                {k: v for k, v in out.items() if k != "rec_sumstats"}
+            )
         host["rec_sumstats_dev"] = out.get("rec_sumstats")
         host["rec_valid_dev"] = out.get("rec_valid")
         return self._finalize_fused(host, handle["sample"], handle["n"],

@@ -23,6 +23,7 @@ from pathlib import Path
 import numpy as np
 import pandas as pd
 
+from ..observability import NULL_METRICS, NULL_TRACER
 from .bytes_storage import np_from_bytes, np_to_bytes
 
 PRE_TIME = -1
@@ -121,12 +122,21 @@ class _AsyncWriter:
     so a failed persist cannot pass silently.
     """
 
-    def __init__(self):
+    def __init__(self, tracer=None, metrics=None):
         import queue
         import threading
 
         self._queue: "queue.Queue" = queue.Queue()
         self._error: BaseException | None = None
+        # observability: spans attribute the writer thread's wall clock
+        # (db.write per queued append); the backlog gauge exposes how far
+        # persistence trails the compute that produced the populations
+        self._tracer = tracer if tracer is not None else NULL_TRACER
+        self._metrics = metrics if metrics is not None else NULL_METRICS
+        self._backlog_gauge = self._metrics.gauge(
+            "pyabc_tpu_db_writer_backlog",
+            "queued population appends awaiting the writer thread",
+        )
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -141,11 +151,14 @@ class _AsyncWriter:
                 # after a failure, drain without executing: later appends
                 # must not commit on top of a possibly broken db state
                 if self._error is None:
-                    fn(*args, **kwargs)
+                    with self._tracer.span("db.write",
+                                           backlog=self._queue.qsize()):
+                        fn(*args, **kwargs)
             except BaseException as exc:  # noqa: BLE001 - surfaced later
                 self._error = exc
             finally:
                 self._queue.task_done()
+                self._backlog_gauge.set(self._queue.qsize())
 
     def _check(self):
         # the error stays STICKY: once a persist failed, the writer is dead
@@ -158,6 +171,7 @@ class _AsyncWriter:
     def submit(self, fn, *args, **kwargs):
         self._check()
         self._queue.put((fn, args, kwargs))
+        self._backlog_gauge.set(self._queue.qsize())
 
     def flush(self):
         """Block until everything queued so far is written."""
@@ -201,6 +215,10 @@ class History:
         self._conn, self._dialect = open_database(db, _db_path)
         self._lock = threading.RLock()
         self._writer: _AsyncWriter | None = None
+        #: observability sinks; ABCSMC rebinds these to the run's
+        #: tracer/registry (no-op defaults keep standalone use free)
+        self.tracer = NULL_TRACER
+        self.metrics = NULL_METRICS
         self._conn.executescript(_SCHEMA)
         # schema migration for dbs created before the telemetry column
         cols = self._dialect.table_columns(self._conn, "populations")
@@ -214,7 +232,7 @@ class History:
     # ------------------------------------------------------- async writing
     def start_async_writer(self) -> "_AsyncWriter":
         if self._writer is None:
-            self._writer = _AsyncWriter()
+            self._writer = _AsyncWriter(self.tracer, self.metrics)
         return self._writer
 
     def append_population_async(self, *args, **kwargs) -> None:

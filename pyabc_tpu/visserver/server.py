@@ -169,6 +169,15 @@ class AbcDashboard:
         h = self._history(run_id)
         return _render_png(lambda: plot_kde_matrix_highlevel(h, m=m, t=t))
 
+    def observability_json(self) -> str:
+        """This PROCESS's tracer/metrics snapshot (span counts + totals
+        per name, instrument values) — live when the dashboard is
+        embedded next to a running inference (``serve(block=False)``);
+        an out-of-process dashboard reports its own (empty) state."""
+        from ..observability import observability_snapshot
+
+        return json.dumps(observability_snapshot())
+
     def populations_json(self, run_id: int) -> str:
         h = self._history(run_id)
         pops = h.get_all_populations()
@@ -190,6 +199,7 @@ _ROUTES = [
     (re.compile(r"^/abc/(\d+)/kde/(\d+)/([^/]+)\.png$"), "kde"),
     (re.compile(r"^/abc/(\d+)/kde_matrix/(\d+)\.png$"), "kde_matrix"),
     (re.compile(r"^/api/(\d+)/populations$"), "api_populations"),
+    (re.compile(r"^/api/observability$"), "api_observability"),
 ]
 
 
@@ -239,6 +249,10 @@ def _make_handler(dash: AbcDashboard):
                         return self._send(
                             200, "application/json",
                             dash.populations_json(int(g[0])).encode())
+                    if kind == "api_observability":
+                        return self._send(
+                            200, "application/json",
+                            dash.observability_json().encode())
                 self._send(404, "text/plain", b"not found")
             except Exception as exc:  # surface errors as 500s, keep serving
                 self._send(500, "text/plain",

@@ -88,16 +88,29 @@ def _fake_run_factory(clock, fail_seeds=(), run_wall=0.5, gens=32,
     return fake
 
 
+class _ListClock:
+    """Observability-clock adapter over the tests' mutable [t] cell."""
+
+    def __init__(self, cell):
+        self._cell = cell
+
+    def now(self):
+        return self._cell[0]
+
+    def wall(self):
+        return self._cell[0]
+
+
 def _run_main_briefly(bench, monkeypatch, fake, clock, budget=30):
     """Run main() on a VIRTUAL clock the fake runs advance (each fake
     run consumes run_wall virtual seconds), so the spend loop
-    terminates deterministically regardless of real wall time."""
-    from types import SimpleNamespace
-
+    terminates deterministically regardless of real wall time. The
+    clock rides the observability subsystem's injection seam
+    (bench.CLOCK) — bench code never calls time.time() directly."""
     monkeypatch.setenv("PYABC_TPU_BENCH_BUDGET_S", str(budget))
     monkeypatch.setattr(bench, "run_tpu_bench", fake)
-    monkeypatch.setattr(bench, "time",
-                        SimpleNamespace(time=lambda: clock[0]))
+    monkeypatch.setattr(bench, "CLOCK", _ListClock(clock))
+    monkeypatch.setattr(bench, "TRACER", None)  # main() rebuilds on CLOCK
     bench._emitted = False
     bench.main()
 
@@ -112,6 +125,16 @@ def test_headline_both_bases_and_full_coverage(bench, monkeypatch, capsys):
     assert d["vs_baseline"] == pytest.approx(d["value"] / 800.0, rel=1e-3)
     assert "wall_clock" in d and d["wall_clock"]["aggregate_pps"] > 0
     assert "util" in d and "device_busy_frac_upper" in d["util"]
+    # the BENCH observability block: coverage-accountant output is always
+    # present (fake runs record no spans, so the fraction is just 0)
+    obs = d["observability"]
+    assert obs["n_spans"] == 0
+    assert obs["steady_attributed_frac"] == 0.0
+    assert [r["run"] for r in obs["per_warm_run"]] == sorted(
+        r["run"] for r in obs["per_warm_run"]
+    )
+    assert all(0.0 <= r["attributed_frac"] <= 1.0
+               for r in obs["per_warm_run"])
     # every warm run is finalized with its generation count
     gens = [r.get("generations_completed") for r in d["runs"]
             if "error" not in r and "elided_runs" not in r]

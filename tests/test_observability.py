@@ -1,0 +1,390 @@
+"""The observability subsystem: spans, metrics, exporters, coverage.
+
+Unit-level: span nesting and thread-safety, JSONL round trip into the
+coverage accountant, the null-tracer overhead guard, registry/Prometheus
+exports, and the window-throughput math the bench now delegates here.
+Integration-level: a real fused SMC run on CPU writes a parseable JSONL
+trace with nested calibration -> generation/chunk -> fetch/process spans
+and a persist/db.write trail, and the coverage accountant attributes a
+positive fraction of its wall clock.
+"""
+import json
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+import pyabc_tpu as pt
+from pyabc_tpu.observability import (
+    MetricsRegistry,
+    NULL_TRACER,
+    NullTracer,
+    Tracer,
+    VirtualClock,
+    coverage_report,
+    interval_union,
+    JsonlTraceExporter,
+    prometheus_text,
+    read_trace,
+    window_throughput,
+)
+
+
+# ---------------------------------------------------------------- tracer
+
+def test_span_nesting_and_attributes():
+    vc = VirtualClock()
+    tr = Tracer(clock=vc)
+    with tr.span("run") as root:
+        vc.advance(1.0)
+        with tr.span("generation", t=0) as gen:
+            vc.advance(2.0)
+            gen.set(n_accepted=100)
+        vc.advance(0.5)
+    spans = {s.name: s for s in tr.spans()}
+    assert spans["generation"].parent_id == spans["run"].span_id
+    assert spans["run"].parent_id is None
+    assert spans["generation"].attrs == {"t": 0, "n_accepted": 100}
+    assert spans["generation"].duration == pytest.approx(2.0)
+    assert spans["run"].duration == pytest.approx(3.5)
+    assert root.end is not None
+
+
+def test_span_error_is_recorded_and_stack_unwound():
+    tr = Tracer(clock=VirtualClock())
+    with pytest.raises(ValueError):
+        with tr.span("outer"):
+            with tr.span("inner"):
+                raise ValueError("boom")
+    spans = {s.name: s for s in tr.spans()}
+    assert "boom" in spans["inner"].attrs["error"]
+    assert "boom" in spans["outer"].attrs["error"]
+    assert tr.current_span() is None  # stack fully unwound
+
+
+def test_tracer_thread_safety_under_thread_pool():
+    tr = Tracer()  # real clock: exercises the actual lock paths
+    n_threads, n_spans = 8, 50
+
+    def work(i):
+        for k in range(n_spans):
+            with tr.span("outer", worker=i):
+                with tr.span("inner", k=k):
+                    pass
+
+    with ThreadPoolExecutor(max_workers=n_threads) as ex:
+        list(ex.map(work, range(n_threads)))
+    spans = tr.spans()
+    assert len(spans) == n_threads * n_spans * 2
+    by_id = {s.span_id: s for s in spans}
+    inners = [s for s in spans if s.name == "inner"]
+    assert len(inners) == n_threads * n_spans
+    for s in inners:
+        parent = by_id[s.parent_id]
+        # parent linkage never crosses threads
+        assert parent.name == "outer" and parent.thread == s.thread
+
+
+def test_tracer_bounded_memory():
+    tr = Tracer(clock=VirtualClock(), max_spans=10)
+    for i in range(25):
+        with tr.span(f"s{i}"):
+            pass
+    assert len(tr.spans()) == 10
+    assert tr.n_dropped == 15
+    assert tr.snapshot()["n_dropped"] == 15
+
+
+def test_null_tracer_is_inert_and_cheap():
+    nt = NullTracer()
+    with nt.span("anything", t=1) as sp:
+        sp.set(foo=2)
+    assert nt.spans() == [] and nt.snapshot()["n_spans"] == 0
+    # overhead guard: the disabled path must stay no-op-cheap enough to
+    # live on per-chunk/per-generation hot paths unconditionally
+    n = 100_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with nt.span("hot", t=3):
+            pass
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call < 10e-6, f"null span costs {per_call * 1e6:.2f}us"
+
+
+# --------------------------------------------------------------- metrics
+
+def test_metrics_registry_counters_gauges_histograms():
+    vc = VirtualClock()
+    reg = MetricsRegistry(clock=vc)
+    reg.counter("acc").inc(3)
+    reg.counter("acc").inc(2)  # get-or-create returns the same instrument
+    reg.gauge("depth").set(7)
+    reg.gauge("depth").dec(2)
+    h = reg.histogram("lat")
+    with h.time():
+        vc.advance(0.25)
+    h.observe(0.75)
+    snap = reg.snapshot()
+    assert snap["acc"] == 5.0
+    assert snap["depth"] == 5.0
+    assert snap["lat"]["count"] == 2
+    assert snap["lat"]["sum"] == pytest.approx(1.0)
+    assert snap["lat"]["max"] == 0.75
+    with pytest.raises(TypeError):
+        reg.gauge("acc")  # type clash must not silently alias
+
+
+def test_prometheus_text_round_trip():
+    reg = MetricsRegistry(clock=VirtualClock())
+    reg.counter("particles", "accepted particles").inc(42)
+    reg.gauge("backlog").set(3)
+    reg.histogram("fetch_s").observe(0.01)
+    text = prometheus_text(reg)
+    assert "# TYPE particles_total counter" in text
+    assert "particles_total 42" in text
+    assert "backlog 3" in text
+    assert 'fetch_s_bucket{le="+Inf"} 1' in text
+    assert "fetch_s_count 1" in text
+
+
+# -------------------------------------------------- coverage accountant
+
+def test_interval_union_merges_overlaps():
+    assert interval_union([(0, 1), (0.5, 2), (3, 4)]) == pytest.approx(3.0)
+    assert interval_union([]) == 0.0
+
+
+def test_coverage_report_per_thread_and_overall():
+    spans = [
+        {"name": "a", "thread": "T1", "start": 0.0, "end": 4.0},
+        {"name": "b", "thread": "T1", "start": 1.0, "end": 2.0},  # nested
+        {"name": "c", "thread": "T2", "start": 6.0, "end": 8.0},
+    ]
+    rep = coverage_report(spans, t0=0.0, t1=10.0)
+    assert rep["window_s"] == 10.0
+    assert rep["attributed_s"] == pytest.approx(6.0)  # [0,4] + [6,8]
+    assert rep["attributed_frac"] == pytest.approx(0.6)
+    assert rep["dark_s"] == pytest.approx(4.0)
+    assert rep["per_thread"]["T1"]["attributed_frac"] == pytest.approx(0.4)
+    assert rep["per_thread"]["T2"]["attributed_frac"] == pytest.approx(0.2)
+    # clipping: a span half outside the window counts half
+    rep2 = coverage_report(spans, t0=2.0, t1=6.0)
+    assert rep2["attributed_s"] == pytest.approx(2.0)
+    # exclude_names: a blanket root span must not hide the gaps
+    spans_with_root = spans + [
+        {"name": "run", "thread": "T1", "start": 0.0, "end": 10.0}
+    ]
+    assert coverage_report(spans_with_root, 0.0, 10.0)[
+        "attributed_frac"] == pytest.approx(1.0)
+    assert coverage_report(spans_with_root, 0.0, 10.0,
+                           exclude_names=("run",))[
+        "attributed_frac"] == pytest.approx(0.6)
+
+
+def test_jsonl_export_round_trip_into_coverage(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    vc = VirtualClock()
+    tr = Tracer(clock=vc, exporter=JsonlTraceExporter(path))
+    with tr.span("run", db="x"):
+        vc.advance(2.0)
+        with tr.span("generation", t=0):
+            vc.advance(3.0)
+    parsed = read_trace(path)
+    assert [p["name"] for p in parsed] == ["generation", "run"]  # end order
+    assert parsed[0]["attrs"] == {"t": 0}
+    assert parsed[0]["parent_id"] == parsed[1]["span_id"]
+    rep = coverage_report(parsed)
+    assert rep["attributed_frac"] == pytest.approx(1.0)
+    assert rep["window_s"] == pytest.approx(5.0)
+
+
+def test_read_trace_tolerates_truncated_tail(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"name": "ok", "thread": "T",
+                             "start": 0.0, "end": 1.0}) + "\n")
+        fh.write('{"name": "crash-mid-wri')  # no newline, cut off
+    assert [s["name"] for s in read_trace(path)] == ["ok"]
+
+
+def test_window_throughput_matches_bench_semantics():
+    # 4 windows of 1s over [0, 4); two off-boundary events per window
+    events = [(0.25 + 0.5 * k, 10) for k in range(8)]
+    wt = window_throughput(events, 0.0, 4.0, 1.0)
+    assert wt["n_windows"] == 4
+    assert wt["per_window"] == [20.0, 20.0, 20.0, 20.0]
+    assert wt["aggregate_per_s"] == pytest.approx(20.0)
+    # boundary semantics (identical to the round-5 bench): an event ON a
+    # window edge belongs to the NEXT window, except the span's end edge
+    # clamps into the last window; an event AT t0 is excluded
+    wtb = window_throughput([(0.0, 1), (1.0, 1), (4.0, 1)], 0.0, 4.0, 1.0)
+    assert wtb["per_window"] == [0.0, 1.0, 0.0, 1.0]
+    # events outside the span are excluded; span truncates to whole windows
+    wt2 = window_throughput([(0.1, 5), (3.9, 5), (10.0, 99)], 0.0, 3.5, 1.0)
+    assert wt2["n_windows"] == 3
+    assert wt2["n_items"] == 5  # only the 0.1s event lands in [0, 3]
+
+
+# ------------------------------------------------------------ integration
+
+NOISE_SD = 0.5
+X_OBS = 1.0
+
+
+def _gauss_model():
+    import jax
+
+    @pt.JaxModel.from_function(["theta"], name="gauss")
+    def model(key, theta):
+        return {"x": theta[0] + NOISE_SD * jax.random.normal(key)}
+
+    return model
+
+
+def test_fused_run_writes_nested_jsonl_trace(tmp_path):
+    """A small fused run on CPU must produce a parseable JSONL trace
+    whose spans cover calibration -> chunk -> fetch/process and a
+    db.write trail on the writer thread, and the coverage accountant
+    must attribute >0 generations' worth of wall clock."""
+    path = str(tmp_path / "trace.jsonl")
+    tracer = Tracer(exporter=JsonlTraceExporter(path))
+    prior = pt.Distribution(theta=pt.RV("norm", 0.0, 1.0))
+    abc = pt.ABCSMC(_gauss_model(), prior, pt.AdaptivePNormDistance(p=2),
+                    population_size=64, eps=pt.MedianEpsilon(),
+                    seed=7, fused_generations=4, tracer=tracer)
+    assert abc._fused_chunk_capable()
+    abc.new("sqlite://", {"x": X_OBS})
+    h = abc.run(max_nr_populations=4)
+    assert h.n_populations == 4
+
+    parsed = read_trace(path)
+    names = {p["name"] for p in parsed}
+    assert {"run", "calibration", "chunk", "fetch", "process",
+            "dispatch", "db.write"} <= names
+    by_id = {p["span_id"]: p for p in parsed}
+    chunks = [p for p in parsed if p["name"] == "chunk"]
+    # nested links: fetch/process children point at their chunk
+    for p in parsed:
+        if p["name"] in ("fetch", "process"):
+            assert by_id[p["parent_id"]]["name"] == "chunk"
+    # chunk attrs carry the pipeline accounting
+    assert sum(c["attrs"]["g_done"] for c in chunks) >= 4
+    assert all("n_acc" in c["attrs"] and "chunk_s" in c["attrs"]
+               for c in chunks)
+    # the async writer's spans live on ITS thread, one per generation
+    writes = [p for p in parsed if p["name"] == "db.write"]
+    assert len(writes) >= 4
+    assert {p["thread"] for p in writes}.isdisjoint(
+        {c["thread"] for c in chunks}
+    ) or len({p["thread"] for p in parsed}) == 1
+    # coverage accountant: attributed fraction over the run window is
+    # meaningfully positive, and >0 generations are attributed
+    run_span = next(p for p in parsed if p["name"] == "run")
+    rep = coverage_report(parsed, run_span["start"], run_span["end"])
+    assert rep["attributed_frac"] > 0.5
+    assert rep["n_spans"] >= len(parsed) - 1
+    assert rep["per_thread"]  # at least the orchestrator thread appears
+
+
+def test_serial_run_generation_spans_and_null_default():
+    """The host (serial) loop nests sample/persist/adapt under each
+    generation span; with no tracer configured nothing is recorded and
+    the run still works (null-path guard)."""
+    tracer = Tracer()
+    prior = pt.Distribution(theta=pt.RV("norm", 0.0, 1.0))
+
+    def sim(pars):
+        return {"x": pars["theta"] + NOISE_SD * np.random.normal()}
+
+    abc = pt.ABCSMC(pt.SimpleModel(sim, name="g"), prior,
+                    pt.PNormDistance(p=2), population_size=50,
+                    eps=pt.QuantileEpsilon(initial_epsilon=1.5, alpha=0.5),
+                    sampler=pt.SingleCoreSampler(), seed=3, tracer=tracer)
+    abc.new("sqlite://", {"x": X_OBS})
+    abc.run(max_nr_populations=2)
+    spans = tracer.spans()
+    gens = [s for s in spans if s.name == "generation"]
+    assert [s.attrs["t"] for s in gens] == [0, 1]
+    assert all(s.attrs["n_accepted"] == 50 for s in gens)
+    by_id = {s.span_id: s for s in spans}
+    for name in ("sample", "persist", "adapt"):
+        children = [s for s in spans if s.name == name]
+        assert len(children) == 2
+        assert all(by_id[c.parent_id].name == "generation"
+                   for c in children)
+
+    # default path: no tracer passed and no env var -> NULL_TRACER
+    abc2 = pt.ABCSMC(pt.SimpleModel(sim, name="g"), prior,
+                     pt.PNormDistance(p=2), population_size=20,
+                     eps=pt.QuantileEpsilon(initial_epsilon=1.5, alpha=0.5),
+                     sampler=pt.SingleCoreSampler(), seed=3)
+    assert abc2.tracer is NULL_TRACER or not abc2.tracer.enabled
+    abc2.new("sqlite://", {"x": X_OBS})
+    abc2.run(max_nr_populations=1)  # runs clean with tracing disabled
+
+
+def test_env_var_enables_default_tracer(tmp_path, monkeypatch):
+    path = str(tmp_path / "env_trace.jsonl")
+    monkeypatch.setenv("PYABC_TPU_TRACE", path)
+    from pyabc_tpu.observability import default_tracer
+
+    tr = default_tracer()
+    assert tr.enabled
+    assert default_tracer() is tr  # shared process-wide
+    with tr.span("probe"):
+        pass
+    assert any(s["name"] == "probe" for s in read_trace(path))
+
+
+def test_visserver_observability_endpoint():
+    from urllib.request import urlopen
+
+    from pyabc_tpu.observability import global_metrics, set_global_tracer
+    from pyabc_tpu.visserver.server import serve
+
+    tracer = Tracer()
+    set_global_tracer(tracer)
+    try:
+        with tracer.span("probe_span"):
+            pass
+        global_metrics().counter("probe_counter").inc(2)
+        httpd = serve("sqlite://", port=0, block=False)
+        try:
+            port = httpd.server_port
+            with urlopen(f"http://127.0.0.1:{port}/api/observability",
+                         timeout=10) as resp:
+                payload = json.loads(resp.read())
+            assert payload["tracer"]["spans_by_name"]["probe_span"][
+                "count"] == 1
+            assert payload["metrics"]["probe_counter"] == 2.0
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+    finally:
+        set_global_tracer(None)
+
+
+def test_history_writer_backlog_gauge():
+    """The async writer exposes its backlog through the registry and
+    attributes its work with db.write spans."""
+    from pyabc_tpu.storage.history import History
+
+    reg = MetricsRegistry()
+    tr = Tracer()
+    h = History("sqlite://")
+    h.tracer, h.metrics = tr, reg
+    h.store_initial_data(None, {}, {"x": np.asarray([1.0])}, {}, ["m0"],
+                         "{}", "{}", "{}")
+    h.start_async_writer()
+    barrier = threading.Event()
+    h._writer.submit(barrier.wait)  # block the writer thread
+    h._writer.submit(lambda: None)
+    assert reg.snapshot()["pyabc_tpu_db_writer_backlog"] >= 1
+    barrier.set()
+    h.flush()
+    assert reg.snapshot()["pyabc_tpu_db_writer_backlog"] == 0
+    assert any(s.name == "db.write" for s in tr.spans())
+    h.close()

@@ -1,0 +1,80 @@
+"""Repo lint: telemetry stays in the observability subsystem.
+
+Two rules, enforced on source text at collection time:
+
+1. Instrumented modules must not call ``time.time()`` directly — all
+   host timing goes through the injected clock
+   (``pyabc_tpu.observability.clock``), so spans and deadlines are
+   immune to wall-clock steps and tests can drive a VirtualClock.
+2. No new ``phase_timings``-style ad-hoc telemetry containers outside
+   ``pyabc_tpu/observability/`` — named span/metric instruments replace
+   scatter-shot timing dicts, so every measurement has one schema, one
+   clock, and one exporter.
+"""
+import re
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+#: modules wired into the observability subsystem; the clock rule holds
+#: for each of them (extend this list when instrumenting a new module)
+INSTRUMENTED = [
+    "bench.py",
+    "pyabc_tpu/inference/smc.py",
+    "pyabc_tpu/sampler/batched.py",
+    "pyabc_tpu/broker/broker.py",
+    "pyabc_tpu/broker/sampler.py",
+    "pyabc_tpu/broker/worker.py",
+    "pyabc_tpu/storage/history.py",
+    "pyabc_tpu/cli.py",
+]
+
+_TIME_TIME = re.compile(r"\btime\.time\(")
+_AD_HOC = re.compile(
+    r"\b(?:phase|stage|step)_timings?\b|\bspan_math\b|\btelemetry_clock\b"
+)
+
+
+def _code_lines(path: Path):
+    """(lineno, line) pairs with comments stripped (string-literal
+    timing text, e.g. generated subprocess code, still counts — that
+    code RUNS)."""
+    for i, raw in enumerate(path.read_text().splitlines(), 1):
+        line = raw.split("#", 1)[0]
+        if line.strip():
+            yield i, line
+
+
+def test_instrumented_modules_use_injected_clock():
+    offenders = []
+    for rel in INSTRUMENTED:
+        path = REPO / rel
+        assert path.exists(), f"instrumented module moved: {rel}"
+        for lineno, line in _code_lines(path):
+            if _TIME_TIME.search(line):
+                offenders.append(f"{rel}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "direct time.time() calls in instrumented modules (use the "
+        "observability clock — pyabc_tpu.observability.SYSTEM_CLOCK or "
+        "the tracer's injected clock):\n" + "\n".join(offenders)
+    )
+
+
+def test_no_ad_hoc_telemetry_outside_observability():
+    offenders = []
+    for path in sorted((REPO / "pyabc_tpu").rglob("*.py")):
+        if "observability" in path.parts:
+            continue
+        rel = path.relative_to(REPO)
+        for lineno, line in _code_lines(path):
+            if _AD_HOC.search(line):
+                offenders.append(f"{rel}:{lineno}: {line.strip()}")
+    for rel in ("bench.py", "profile_gen.py"):
+        for lineno, line in _code_lines(REPO / rel):
+            if _AD_HOC.search(line):
+                offenders.append(f"{rel}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "ad-hoc telemetry containers outside pyabc_tpu/observability/ "
+        "(add a named span or metric instrument instead):\n"
+        + "\n".join(offenders)
+    )
