@@ -295,6 +295,27 @@ class DeviceContext:
         self._kernels: dict = {}
 
     # ------------------------------------------------------------------ build
+    @staticmethod
+    def _shard_lane_keys(keys, lane_sharding):
+        """Lane-shard a vector of TYPED prng keys.
+
+        Typed key arrays hide a trailing key-data dim (u32[B, 2] under a
+        visible shape (B,)); newer jax/XLA versions validate sharding
+        specs against the UNDERLYING rank, so a rank-1 spec on the typed
+        array fails GSPMD validation ("tile assignment dimensions ...
+        different than the input rank"). Constrain the raw key data with
+        a rank-matched spec and re-wrap instead."""
+        if lane_sharding is None:
+            return keys
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        data = jax.random.key_data(keys)
+        spec = P(lane_sharding.spec[0], *([None] * (data.ndim - 1)))
+        data = jax.lax.with_sharding_constraint(
+            data, NamedSharding(lane_sharding.mesh, spec)
+        )
+        return jax.random.wrap_key_data(data)
+
     def _lane_prior(self, key, dyn):
         """One lane, generation 0: proposal from the prior."""
         km, kt, ksim, kacc = jax.random.split(key, 4)
@@ -439,7 +460,7 @@ class DeviceContext:
 
             def round_fn(key, dyn):
                 keys = jax.random.split(key, B)
-                keys = jax.lax.with_sharding_constraint(keys, lane_sharding)
+                keys = self._shard_lane_keys(keys, lane_sharding)
                 out = jax.vmap(lambda k: lane(k, dyn))(keys)
                 return jax.tree.map(
                     lambda x: jax.lax.with_sharding_constraint(
@@ -587,8 +608,7 @@ class DeviceContext:
 
         def run_lanes(key, dyn):
             keys = jax.random.split(key, B)
-            if lane_sharding is not None:
-                keys = jax.lax.with_sharding_constraint(keys, lane_sharding)
+            keys = self._shard_lane_keys(keys, lane_sharding)
             return jax.vmap(lambda k: lane(k, dyn))(keys)
 
         def generation_fn(key, dyn, n_target):
@@ -651,6 +671,50 @@ class DeviceContext:
             B, mode, n_cap, rec_cap, max_rounds,
             record_proposal=record_proposal,
         )(key, dyn, jnp.asarray(min(n_target, n_cap), jnp.int32))
+
+    # ----------------------------------------------------- fetch compaction
+    def fetch_pack_kernel(self, *, n_keep: int, dtype_name: str,
+                          keep_m: bool, ss_gens, g_keep: int | None = None):
+        """Jitted device-side compaction of a multigen ``outs`` tree
+        before the host fetch (``ops/pack.py`` holds the math): theta /
+        distance / log_weight collapse into ONE narrowed-dtype row
+        buffer, ``slot`` is elided (the reservoir is slot-ordered by
+        construction), ``m`` ships as int8 only for K > 1, and sum stats
+        ship only for the generations History persists. Over the TPU
+        tunnel this cuts the per-chunk payload ~2.7x (32 -> 12 B per
+        accepted row at d=4) AND collapses five transfers into one —
+        both matter on a latency-floored link (BASELINE.md round 6).
+
+        ``ss_gens``: static tuple of chunk-relative generations whose
+        sum-stat rows to include, or ``"all"``.
+        """
+        ss_key = "all" if ss_gens == "all" else tuple(int(g) for g in ss_gens)
+        cache_key = ("fetch_pack", n_keep, dtype_name, keep_m, ss_key,
+                     g_keep)
+        if cache_key in self._kernels:
+            return self._kernels[cache_key]
+
+        from ..ops.pack import fetch_dtype_of, pack_outs
+
+        dt = fetch_dtype_of(dtype_name)
+        m_dtype = jnp.int8 if self.K <= 127 else jnp.int32
+
+        def pack_fn(outs):
+            return pack_outs(outs, n_keep=n_keep, dtype=dt, keep_m=keep_m,
+                             ss_gens=ss_key, m_dtype=m_dtype, g_keep=g_keep)
+
+        if self.mesh is not None and len(
+            {d.process_index for d in self.mesh.devices.flat}
+        ) > 1:
+            # multi-host: keep the packed tree replicated like the outs it
+            # compacts, so every host can device_get it
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            fn = jax.jit(pack_fn, out_shardings=NamedSharding(self.mesh, P()))
+        else:
+            fn = jax.jit(pack_fn)
+        self._kernels[cache_key] = fn
+        return fn
 
     # ------------------------------------------- multi-generation device run
     def multigen_kernel(self, B: int, n_cap: int, rec_cap: int,
@@ -759,10 +823,7 @@ class DeviceContext:
                         fold_sched=None):
             def run_lanes(key, dyn):
                 keys = jax.random.split(key, B)
-                if lane_sharding is not None:
-                    keys = jax.lax.with_sharding_constraint(
-                        keys, lane_sharding
-                    )
+                keys = self._shard_lane_keys(keys, lane_sharding)
                 return jax.vmap(lambda k: lane(k, dyn))(keys)
 
             def run_lanes_prior(key, dyn):
@@ -771,10 +832,7 @@ class DeviceContext:
                 # variants return identical output trees, so the
                 # generation chooses per-t via lax.cond below
                 keys = jax.random.split(key, B)
-                if lane_sharding is not None:
-                    keys = jax.lax.with_sharding_constraint(
-                        keys, lane_sharding
-                    )
+                keys = self._shard_lane_keys(keys, lane_sharding)
                 return jax.vmap(
                     lambda k: self._lane_prior(k, dyn)
                 )(keys)

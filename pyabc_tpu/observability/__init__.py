@@ -13,7 +13,11 @@ first-class layer):
   ``snapshot()`` APIs the visserver dashboard and bench read;
 - :func:`coverage_report` — the coverage accountant: the fraction of a
   wall-clock window attributed to at least one span, overall and per
-  thread (the round-5 "60% dark time" gap as a number).
+  thread (the round-5 "60% dark time" gap as a number);
+- :class:`SyncLedger` — device-sync accounting: every blocking
+  host<->device round trip is recorded, so the bench can attribute the
+  residual wall-clock gap to the measured tunnel latency floor
+  (``syncs x ~102 ms``) instead of assuming it.
 
 Enablement: everything defaults to the no-op :data:`NULL_TRACER` /
 :data:`NULL_METRICS`. Turn tracing on per run via
@@ -34,6 +38,12 @@ from .metrics import (
     NullMetrics,
     NULL_METRICS,
 )
+from .sync import (
+    DEFAULT_SYNC_FLOOR_S,
+    NullSyncLedger,
+    NULL_SYNC_LEDGER,
+    SyncLedger,
+)
 from .tracer import NullTracer, NULL_TRACER, Span, Tracer
 
 import os as _os
@@ -46,6 +56,8 @@ __all__ = [
     "NULL_METRICS",
     "JsonlTraceExporter", "prometheus_text", "read_trace",
     "coverage_report", "interval_union", "window_throughput",
+    "SyncLedger", "NullSyncLedger", "NULL_SYNC_LEDGER",
+    "DEFAULT_SYNC_FLOOR_S",
     "default_tracer", "global_metrics", "global_tracer",
     "set_global_tracer", "observability_snapshot",
 ]

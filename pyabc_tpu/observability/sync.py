@@ -1,0 +1,120 @@
+"""Device-sync accounting: how many host<->device round trips did a run pay?
+
+The round-5 bench established that the strict wall-clock basis is
+latency-bound, not compute-bound: every synchronous device round trip over
+the TPU tunnel costs a measured ~102 ms FLOOR regardless of payload, so
+the residual gap between the pipeline-full and wall-clock bases is, to
+first order, ``n_syncs x sync_floor``. This module turns that model into
+bookkeeping: every call site that blocks on the device (``device_get`` of
+a chunk, ``block_until_ready`` probes, per-generation collects) records
+one event into a :class:`SyncLedger`, and the bench multiplies the count
+by the measured floor to ATTRIBUTE the residual wall-clock gap instead of
+assuming it (VERDICT r5 Next #1c).
+
+Design rules follow the subsystem's: stdlib-only, injected clock,
+thread-safe (fetch threads, the probe thread and the drain thread all
+record into one ledger), and cheap enough to leave on unconditionally —
+recording is one lock + tuple append.
+"""
+from __future__ import annotations
+
+import threading
+
+from .clock import Clock, SYSTEM_CLOCK
+
+#: the measured tiny-fetch sync latency floor over the axon TPU tunnel
+#: (BASELINE.md, round-5 session measurement). A co-located host runs
+#: ~1 ms; benches may override via PYABC_TPU_SYNC_FLOOR_S.
+DEFAULT_SYNC_FLOOR_S = 0.102
+
+
+class SyncLedger:
+    """Counts synchronous host<->device round trips and their payloads.
+
+    ``record(kind, nbytes)`` is called AT the blocking call site (chunk
+    fetch, compute probe, generation collect, ...). ``summary()`` returns
+    the per-kind counts/bytes plus the floor-model attribution the bench
+    reports as ``syncs_per_run`` / ``tunnel_floor_s``.
+    """
+
+    def __init__(self, clock: Clock | None = None):
+        self.clock = clock if clock is not None else SYSTEM_CLOCK
+        self._lock = threading.Lock()
+        #: (ts, kind, nbytes) per sync, in record order
+        self.events: list[tuple[float, str, int]] = []
+
+    def record(self, kind: str, nbytes: int = 0) -> None:
+        with self._lock:
+            self.events.append((self.clock.now(), str(kind), int(nbytes)))
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return len(self.events)
+
+    def by_kind(self) -> dict[str, int]:
+        with self._lock:
+            out: dict[str, int] = {}
+            for _ts, kind, _b in self.events:
+                out[kind] = out.get(kind, 0) + 1
+            return out
+
+    def total_bytes(self) -> int:
+        with self._lock:
+            return sum(b for _ts, _k, b in self.events)
+
+    def floor_s(self, sync_floor_s: float = DEFAULT_SYNC_FLOOR_S) -> float:
+        """Wall clock the floor model attributes to this ledger's syncs."""
+        return self.count * float(sync_floor_s)
+
+    def summary(self, sync_floor_s: float = DEFAULT_SYNC_FLOOR_S) -> dict:
+        with self._lock:
+            n = len(self.events)
+            by_kind: dict[str, int] = {}
+            bytes_by_kind: dict[str, int] = {}
+            for _ts, kind, b in self.events:
+                by_kind[kind] = by_kind.get(kind, 0) + 1
+                bytes_by_kind[kind] = bytes_by_kind.get(kind, 0) + b
+        return {
+            "syncs": n,
+            "by_kind": by_kind,
+            "bytes_by_kind": bytes_by_kind,
+            "total_bytes": sum(bytes_by_kind.values()),
+            "sync_floor_s": float(sync_floor_s),
+            "tunnel_floor_s": round(n * float(sync_floor_s), 6),
+        }
+
+    def clear(self) -> None:
+        with self._lock:
+            self.events.clear()
+
+
+class NullSyncLedger:
+    """Inert ledger for components run without an orchestrator."""
+
+    events: list = []
+    count = 0
+
+    def record(self, kind: str, nbytes: int = 0) -> None:
+        pass
+
+    def by_kind(self) -> dict:
+        return {}
+
+    def total_bytes(self) -> int:
+        return 0
+
+    def floor_s(self, sync_floor_s: float = DEFAULT_SYNC_FLOOR_S) -> float:
+        return 0.0
+
+    def summary(self, sync_floor_s: float = DEFAULT_SYNC_FLOOR_S) -> dict:
+        return {"syncs": 0, "by_kind": {}, "bytes_by_kind": {},
+                "total_bytes": 0, "sync_floor_s": float(sync_floor_s),
+                "tunnel_floor_s": 0.0}
+
+    def clear(self) -> None:
+        pass
+
+
+#: shared inert ledger (the default on samplers outside a run)
+NULL_SYNC_LEDGER = NullSyncLedger()

@@ -1,3 +1,3 @@
-from . import stats
+from . import pack, stats
 
-__all__ = ["stats"]
+__all__ = ["pack", "stats"]

@@ -1,0 +1,127 @@
+"""Device-side fetch compaction: pack accepted rows before the host fetch.
+
+Round 5 measured the TPU tunnel at ~12 MB/s under concurrent streams with
+a ~102 ms per-transfer latency floor; the fused loop's per-chunk fetch of
+the full f32 reservoirs (m, theta, distance, log_weight, slot — 32 B per
+accepted row at d=4, ~2 MB/chunk at pop 8192) made throughput INVERT with
+population size (BASELINE.md round-5 notes). This module builds the
+jit-able packing function that runs ON DEVICE right after the multigen
+kernel, so only a dense, minimal payload crosses the tunnel:
+
+- ``theta``, ``distance`` and ``log_weight`` are gathered into ONE
+  contiguous ``(G, n_keep, d_max + 2)`` buffer in a narrowed dtype
+  (float16 by default — see the precision audit in
+  ``tests/test_fetch_precision.py`` and the dtype notes below); one buffer
+  means one transfer instead of five per chunk, which matters as much as
+  the bytes on a latency-floored link;
+- rows are sliced to ``n_keep`` (the chunk's largest scheduled population)
+  instead of the pow2-padded ring capacity;
+- ``m`` ships as int8 only for multi-model runs (K = 1 reconstructs zeros
+  host-side) and ``slot`` is elided entirely — the reservoir is written in
+  slot order by construction (``_generation_while``'s compaction), so
+  ``argsort(slot)`` is the identity and the host substitutes ``arange``;
+- per-particle sum stats ship only for the generations History will
+  persist (``History.wants_sum_stats``), cast to the same narrowed dtype.
+
+Dtype notes (the documented precision audit): the packed values feed ONLY
+the host-side History persist and component mirrors — the device carry
+chain stays f32, so the inference trajectory (acceptances, epsilon trail,
+refits) is bit-identical for every fetch dtype. float16 keeps a 10-bit
+mantissa (~5e-4 relative), far inside History's round-trip tolerance for
+posterior estimates (weighted mean/var parity asserted on the conjugate
+Gaussian); bfloat16 (8-bit mantissa, ~4e-3) is offered for range-extreme
+sum stats. Scalars (epsilons, pdf norms, model probabilities, adaptive
+weights) always pass through untouched at f32.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+#: reservoir leaves replaced by the packed row buffer (or elided)
+ROW_KEYS = ("theta", "distance", "log_weight", "m", "slot", "sumstats")
+
+_DTYPES = {
+    "float32": jnp.float32,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+}
+
+
+def fetch_dtype_of(name: str):
+    """Resolve a fetch-dtype name; raises on unsupported names so a typo
+    fails at configuration time, not after a 20 s kernel compile."""
+    try:
+        return _DTYPES[str(name)]
+    except KeyError:
+        raise ValueError(
+            f"unsupported fetch_dtype {name!r}: one of {sorted(_DTYPES)}"
+        ) from None
+
+
+def _cast_monotone_down(x, dtype):
+    """Cast toward zero-ward ULPs: the result never EXCEEDS ``x`` in
+    magnitude-signed order. Accepted distances carry the invariant
+    ``d <= eps_used``; a round-to-nearest narrowing can push a stored
+    distance half a ULP ABOVE the stored threshold (observed: 0.6001 vs
+    eps 0.6 at f16), so the distance column rounds down instead —
+    portable (multiply + cast only), error bounded by ~1.5 ULP."""
+    if dtype == jnp.float32:
+        return x.astype(dtype)
+    step = 2.0 ** -10 if dtype == jnp.float16 else 2.0 ** -7
+    down = x * jnp.where(x >= 0, 1.0 - step, 1.0 + step)
+    cast = x.astype(dtype)
+    over = cast.astype(x.dtype) > x
+    return jnp.where(over, down.astype(dtype), cast)
+
+
+def pack_outs(outs: dict, *, n_keep: int, dtype, keep_m: bool,
+              ss_gens: tuple[int, ...] | str, m_dtype=jnp.int8,
+              g_keep: int | None = None) -> dict:
+    """Traceable compaction of a multigen ``outs`` tree (leading G axis).
+
+    Returns the fetch tree: every non-row leaf passes through (sliced to
+    the chunk's ``g_keep`` ACTIVE generations — a short tail/drain chunk
+    must not ship the scan's inactive garbage rows); the row leaves
+    collapse into ``rows`` (+ optional ``m`` / ``__ss_rows__``).
+    ``ss_gens`` is the static tuple of chunk-relative generations whose
+    sum stats the host wants, or ``"all"`` (an empty tuple ships NO sum
+    stats — the host reconstructs the empty map).
+    """
+    if g_keep is not None:
+        # every leaf of the scan's ys carries the leading G axis,
+        # including structured distance params (dicts/tuples)
+        outs = jax.tree.map(lambda v: v[:g_keep], outs)
+    packed = {k: v for k, v in outs.items() if k not in ROW_KEYS}
+    packed["rows"] = jnp.concatenate(
+        [
+            outs["theta"][:, :n_keep, :].astype(dtype),
+            _cast_monotone_down(
+                outs["distance"][:, :n_keep, None], dtype),
+            outs["log_weight"][:, :n_keep, None].astype(dtype),
+        ],
+        axis=-1,
+    )
+    if keep_m:
+        packed["m"] = outs["m"][:, :n_keep].astype(m_dtype)
+    if ss_gens == "all":
+        packed["sumstats"] = outs["sumstats"][:, :n_keep].astype(dtype)
+    elif ss_gens:
+        packed["__ss_rows__"] = {
+            int(g): outs["sumstats"][int(g), :n_keep].astype(dtype)
+            for g in ss_gens
+        }
+    return packed
+
+
+def unpack_rows(rows, d_max: int):
+    """Host-side split of the packed row buffer -> (theta, distance,
+    log_weight), upcast to f32/f64 (History and the component mirrors
+    compute in f64; the narrowing lives on the wire only)."""
+    import numpy as np
+
+    rows = np.asarray(rows)
+    theta = rows[..., :d_max].astype(np.float32)
+    distance = rows[..., d_max].astype(np.float64)
+    log_weight = rows[..., d_max + 1].astype(np.float64)
+    return theta, distance, log_weight

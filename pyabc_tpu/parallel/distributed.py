@@ -59,7 +59,19 @@ def initialize(coordinator_address: str | None = None,
     if platform is not None:
         jax.config.update("jax_platforms", platform)
     if num_cpu_devices is not None:
-        jax.config.update("jax_num_cpu_devices", int(num_cpu_devices))
+        try:
+            jax.config.update("jax_num_cpu_devices", int(num_cpu_devices))
+        except AttributeError:
+            # older jax (< 0.5) has no jax_num_cpu_devices config; the
+            # XLA flag is the portable spelling of the same knob and must
+            # land BEFORE the backend initializes (we're pre-initialize
+            # by contract here)
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    f"{flags} --xla_force_host_platform_device_count="
+                    f"{int(num_cpu_devices)}"
+                ).strip()
     if platform == "cpu" or num_cpu_devices is not None:
         jax.config.update(
             "jax_cpu_collectives_implementation", cpu_collectives

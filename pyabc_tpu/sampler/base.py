@@ -254,7 +254,11 @@ class Sampler:
     """
 
     def __init__(self):
-        from ..observability import NULL_METRICS, NULL_TRACER
+        from ..observability import (
+            NULL_METRICS,
+            NULL_SYNC_LEDGER,
+            NULL_TRACER,
+        )
 
         self.nr_evaluations_: int = 0
         self.sample_factory = SampleFactory()
@@ -265,6 +269,10 @@ class Sampler:
         #: defaults keep standalone sampler use free of overhead
         self.tracer = NULL_TRACER
         self.metrics = NULL_METRICS
+        #: device-sync accounting: device-backed samplers record every
+        #: blocking host<->device round trip here (ABCSMC rebinds this to
+        #: the run's ledger, feeding the bench's tunnel-floor attribution)
+        self.sync_ledger = NULL_SYNC_LEDGER
 
     def set_analysis_id(self, analysis_id: str):
         self.analysis_id = analysis_id

@@ -154,6 +154,10 @@ class BatchedSampler(Sampler):
             import jax
 
             fetched = jax.device_get(speculative["out"])
+            self.sync_ledger.record(
+                "speculative_fetch",
+                sum(np.asarray(v).nbytes for v in fetched.values()),
+            )
             accept, extra_lw = speculative["accept"](
                 speculative["t"], fetched
             )
@@ -220,6 +224,10 @@ class BatchedSampler(Sampler):
             host = jax.device_get(
                 {k: v for k, v in out.items() if k != "rec_sumstats"}
             )
+        self.sync_ledger.record(
+            "generation_collect",
+            sum(np.asarray(v).nbytes for v in host.values()),
+        )
         host["rec_sumstats_dev"] = out.get("rec_sumstats")
         host["rec_valid_dev"] = out.get("rec_valid")
         return self._finalize_fused(host, handle["sample"], handle["n"],
@@ -289,6 +297,9 @@ class BatchedSampler(Sampler):
                 ss = out.get("rec_sumstats")
                 if ss is None:
                     ss = jax.device_get(rec_dev)
+                    self.sync_ledger.record(
+                        "record_ring_fetch", np.asarray(ss).nbytes
+                    )
                 sample.set_all_records(
                     sumstats=np.asarray(ss, np.float64)[valid],
                     distances=np.asarray(

@@ -195,7 +195,8 @@ class History:
     """
 
     def __init__(self, db: str, _id: int | None = None,
-                 store_sum_stats: bool | int = True):
+                 store_sum_stats: bool | int = True, *,
+                 tracer=None, metrics=None):
         import threading
 
         self.db = db
@@ -212,22 +213,25 @@ class History:
         # Non-sqlite urls go through the backend seam (storage/backend.py)
         from .backend import open_database
 
-        self._conn, self._dialect = open_database(db, _db_path)
+        #: observability sinks; pass them at construction so the schema
+        #: DDL below is attributed (per-run host setup is part of the
+        #: wall clock between back-to-back runs — round 6); ABCSMC also
+        #: rebinds these to the run's tracer/registry after load()
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self.metrics = metrics if metrics is not None else NULL_METRICS
         self._lock = threading.RLock()
         self._writer: _AsyncWriter | None = None
-        #: observability sinks; ABCSMC rebinds these to the run's
-        #: tracer/registry (no-op defaults keep standalone use free)
-        self.tracer = NULL_TRACER
-        self.metrics = NULL_METRICS
-        self._conn.executescript(_SCHEMA)
-        # schema migration for dbs created before the telemetry column
-        cols = self._dialect.table_columns(self._conn, "populations")
-        if "telemetry" not in cols:
-            self._conn.execute(
-                "ALTER TABLE populations ADD COLUMN telemetry TEXT"
-            )
-        self._conn.commit()
-        self.id = _id if _id is not None else self._latest_id()
+        with self.tracer.span("db.setup", db=db):
+            self._conn, self._dialect = open_database(db, _db_path)
+            self._conn.executescript(_SCHEMA)
+            # schema migration for dbs created before the telemetry column
+            cols = self._dialect.table_columns(self._conn, "populations")
+            if "telemetry" not in cols:
+                self._conn.execute(
+                    "ALTER TABLE populations ADD COLUMN telemetry TEXT"
+                )
+            self._conn.commit()
+            self.id = _id if _id is not None else self._latest_id()
 
     # ------------------------------------------------------- async writing
     def start_async_writer(self) -> "_AsyncWriter":

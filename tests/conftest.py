@@ -40,3 +40,54 @@ setup_xla_cache(
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True)
+def _close_matplotlib_figures():
+    """Close every figure a test leaves open.
+
+    The plot helpers (``visualization/util.py::get_figure``) create
+    figures on demand; tests that don't close them accumulate until
+    matplotlib's >20-open-figures RuntimeWarning fires mid-suite (the
+    round-5 figure-leak warning). Teardown-only and guarded on the
+    module already being imported, so non-plot tests pay nothing."""
+    yield
+    import sys
+
+    close = getattr(sys.modules.get("matplotlib.pyplot"), "close", None)
+    if close is not None:
+        close("all")
+
+
+@pytest.fixture(autouse=True)
+def _cpu_burner():
+    """CI-style background load: PYABC_TPU_TEST_CPU_BURN=<n> spawns n
+    busy-loop subprocesses for the duration of each test.
+
+    Used to reproduce full-suite-load conditions for timing-sensitive
+    concurrency tests in isolation (the round-5
+    ``test_look_ahead_delayed_evaluation_adaptive_distance`` flake was
+    load-dependent; BASELINE.md records the 20x verification under this
+    fixture). Off by default — the fixture is a no-op unless the env
+    var is set."""
+    import subprocess
+    import sys as _sys
+
+    n = int(os.environ.get("PYABC_TPU_TEST_CPU_BURN", "0") or 0)
+    if not n:
+        yield
+        return
+    procs = [
+        subprocess.Popen(
+            [_sys.executable, "-c", "while True:\n    sum(range(10000))"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        for _ in range(n)
+    ]
+    try:
+        yield
+    finally:
+        for p in procs:
+            p.kill()
+        for p in procs:
+            p.wait(timeout=10)

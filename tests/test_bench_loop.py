@@ -56,11 +56,26 @@ class FakeHistory:
         pass
 
 
+class FakeSyncLedger:
+    """Shape-compatible stand-in for observability.SyncLedger."""
+
+    def summary(self, sync_floor_s):
+        return {
+            "syncs": 7,
+            "by_kind": {"chunk_fetch": 4, "compute_probe": 3},
+            "bytes_by_kind": {"chunk_fetch": 4 * 96_000},
+            "total_bytes": 4 * 96_000,
+            "sync_floor_s": sync_floor_s,
+            "tunnel_floor_s": round(7 * sync_floor_s, 6),
+        }
+
+
 class FakeAbc:
     def __init__(self):
         self.history = FakeHistory()
         self.probe_events = [(0.0, 0.1), (0.1, 0.2)]
         self.drain_joined = False
+        self.sync_ledger = FakeSyncLedger()
 
     def drain_join(self):
         self.drain_joined = True
@@ -71,7 +86,8 @@ def _fake_run_factory(clock, fail_seeds=(), run_wall=0.5, gens=32,
     """A run_tpu_bench fake: advances a virtual wall clock and fires
     chunk events like a real overlapped run would."""
 
-    def fake(pop_size, n_gens, budget_s, seed, prev_abc, on_event):
+    def fake(pop_size, n_gens, budget_s, seed, prev_abc, on_event,
+             prebuilt=None):
         if seed in fail_seeds:
             raise RuntimeError(f"synthetic failure on seed {seed}")
         for ci in range(1, 5):
@@ -80,6 +96,9 @@ def _fake_run_factory(clock, fail_seeds=(), run_wall=0.5, gens=32,
                 "ts": clock[0], "t_first": (ci - 1) * 8, "gens": 8,
                 "n_acc": pop * 8, "chunk_index": ci,
                 "chunk_s": run_wall / 4, "fetch_s": 0.002,
+                # post-compaction wire bytes vs the r5 full-f32-ring
+                # equivalent (12 vs 32 B/row at d=4, pop 1000, G=8)
+                "fetch_bytes": 96_000, "fetch_bytes_full_f32": 256_000,
                 "dispatch_s": 0.001, "process_s": 0.0005,
             })
         return FakeAbc(), {"run_s_excl_drain": run_wall,
@@ -109,6 +128,12 @@ def _run_main_briefly(bench, monkeypatch, fake, clock, budget=30):
     (bench.CLOCK) — bench code never calls time.time() directly."""
     monkeypatch.setenv("PYABC_TPU_BENCH_BUDGET_S", str(budget))
     monkeypatch.setattr(bench, "run_tpu_bench", fake)
+    # the spend loop pre-builds run k+1's host objects on a setup thread;
+    # the real builder constructs a full ABCSMC — fake it out
+    monkeypatch.setattr(
+        bench, "build_bench_run",
+        lambda pop, seed, prev_abc: (FakeAbc(), prev_abc is not None),
+    )
     monkeypatch.setattr(bench, "CLOCK", _ListClock(clock))
     monkeypatch.setattr(bench, "TRACER", None)  # main() rebuilds on CLOCK
     bench._emitted = False
@@ -125,6 +150,21 @@ def test_headline_both_bases_and_full_coverage(bench, monkeypatch, capsys):
     assert d["vs_baseline"] == pytest.approx(d["value"] / 800.0, rel=1e-3)
     assert "wall_clock" in d and d["wall_clock"]["aggregate_pps"] > 0
     assert "util" in d and "device_busy_frac_upper" in d["util"]
+    # round-6 payload + sync telemetry: compaction ratio and sync counts
+    # are regression-guarded metrics in the bench JSON
+    assert d["util"]["fetch_bytes_per_chunk"] == 96_000
+    assert d["util"]["fetch_bytes_per_chunk_r5_equiv"] == 256_000
+    assert d["util"]["fetch_payload_reduction_x"] == pytest.approx(
+        256_000 / 96_000, abs=0.01)
+    assert d["util"]["syncs_per_run"] == 7
+    assert d["util"]["tunnel_floor_s_per_run"] == pytest.approx(
+        7 * d["util"]["sync_floor_s"], abs=1e-6)
+    # the residual-gap attribution block: warm-run syncs x floor vs the
+    # steady span's dark time (fake runs record no spans -> dark 0 ->
+    # the model explains everything)
+    gap = d["gap_attribution"]
+    assert gap["warm_run_syncs_total"] >= 7
+    assert 0.0 <= gap["dark_explained_by_sync_floor_frac"] <= 1.0
     # the BENCH observability block: coverage-accountant output is always
     # present (fake runs record no spans, so the fraction is just 0)
     obs = d["observability"]

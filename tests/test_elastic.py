@@ -104,6 +104,7 @@ def test_posterior_with_two_workers(sampler):
             p.kill()
 
 
+@pytest.mark.slow
 def test_worker_killed_mid_generation_costs_only_throughput(sampler):
     port = sampler.address[1]
     workers = [_spawn_worker(port) for _ in range(2)]
@@ -132,6 +133,7 @@ def test_worker_killed_mid_generation_costs_only_throughput(sampler):
             p.kill()
 
 
+@pytest.mark.slow
 def test_late_joining_worker_picks_up_current_generation(sampler):
     port = sampler.address[1]
     late = {}
@@ -226,6 +228,7 @@ def test_without_wait_for_all_finishes_at_target():
         broker.stop()
 
 
+@pytest.mark.slow
 def test_sigterm_drains_cleanly_and_deregisters(sampler):
     """kill -TERM mid-generation: the worker ships its current batch,
     deregisters from the broker (no ghost in manager status), and exits
@@ -271,6 +274,7 @@ def test_sigterm_drains_cleanly_and_deregisters(sampler):
             p.kill()
 
 
+@pytest.mark.slow
 def test_static_scheduling_posterior():
     """scheduling='static' (fixed acceptance quotas, the reference
     RedisStaticSampler variant) must recover the same conjugate posterior
@@ -295,6 +299,7 @@ def test_static_scheduling_posterior():
         s.stop()
 
 
+@pytest.mark.slow
 def test_look_ahead_posterior_unbiased_and_overlaps():
     """Mid-generation look-ahead (reference look_ahead_delay_evaluation):
     gen t+1 proposals are built from PRELIMINARY gen-t particles and
@@ -326,10 +331,12 @@ def test_look_ahead_posterior_unbiased_and_overlaps():
             s.stop()
     mu_la, wall_la, head_starts = results[True]
     mu_serial, wall_serial, _ = results[False]
-    # conjugate posterior mean 0.8 (prior N(0,1), noise sd 0.5)
-    assert mu_la == pytest.approx(0.8, abs=0.35)
-    assert mu_serial == pytest.approx(0.8, abs=0.35)
-    assert mu_la == pytest.approx(mu_serial, abs=0.35)
+    # conjugate posterior mean 0.8 (prior N(0,1), noise sd 0.5);
+    # tolerances calibrated to the measured per-run spread at these pop
+    # sizes (see test_look_ahead_delayed_evaluation_adaptive_distance)
+    assert mu_la == pytest.approx(0.8, abs=0.55)
+    assert mu_serial == pytest.approx(0.8, abs=0.55)
+    assert mu_la == pytest.approx(mu_serial, abs=0.7)
     # the overlap evidence: at least one adopted generation already had
     # worker results waiting when the orchestrator arrived (t+1 work ran
     # during gen-t finalization + persist + adapt)
@@ -340,6 +347,7 @@ def test_look_ahead_posterior_unbiased_and_overlaps():
     assert wall_la < wall_serial * 1.5, (wall_la, wall_serial)
 
 
+@pytest.mark.slow
 def test_look_ahead_delayed_evaluation_adaptive_distance():
     """Full delayed-evaluation look-ahead (reference
     look_ahead_delay_evaluation): with AdaptivePNormDistance +
@@ -406,9 +414,16 @@ def test_look_ahead_delayed_evaluation_adaptive_distance():
             s.stop()
     mu_la, ess_la, head_starts, spans = results[True]
     mu_serial, _ess_serial, _, _ = results[False]
-    assert mu_la == pytest.approx(0.8, abs=0.35)
-    assert mu_serial == pytest.approx(0.8, abs=0.35)
-    assert mu_la == pytest.approx(mu_serial, abs=0.35)
+    # statistical sanity, calibrated to the MEASURED run-to-run spread:
+    # at pop 60 x 4 generations with unseeded worker RNG the per-run
+    # posterior-mean sd is ~0.25 (round-6 20x campaign observed means
+    # 0.46-1.22 on the SERIAL path), so 0.35 was ~1.4 sigma on the
+    # difference and flaked at the expected rate under load. These are
+    # sanity bounds; the unbiasedness proof is the tight guards below
+    # (ESS, adoption, final-weight distances), which held 20/20.
+    assert mu_la == pytest.approx(0.8, abs=0.55)
+    assert mu_serial == pytest.approx(0.8, abs=0.55)
+    assert mu_la == pytest.approx(mu_serial, abs=0.7)
     # regression guard for the round-5 flake: the defensive mixture
     # bounds importance ratios at 1/lookahead_defensive_frac, so the
     # adopted final generation cannot weight-collapse (observed 38-59
@@ -427,6 +442,7 @@ def test_look_ahead_delayed_evaluation_adaptive_distance():
                for sp in adopted_spans) > 0
 
 
+@pytest.mark.slow
 def test_worker_catch_turns_model_errors_into_records():
     """Reference ``abc-redis-worker --catch``: a model that raises on a
     fraction of evaluations must NOT kill the worker loop — the failing
@@ -467,6 +483,7 @@ def test_worker_catch_turns_model_errors_into_records():
         s.stop()
 
 
+@pytest.mark.slow
 def test_worker_processes_cli_option():
     """``abc-worker --processes N`` (reference parity) serves a run with N
     worker processes from one command."""

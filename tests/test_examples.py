@@ -11,9 +11,18 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 EXAMPLES = os.path.join(os.path.dirname(HERE), "examples")
 
 
-@pytest.mark.parametrize("script", sorted(
-    f for f in os.listdir(EXAMPLES) if f.endswith(".py")
-))
+#: examples whose shrunk smoke runs still spawn subprocess farms or big
+#: compiles — full-lane only (tier-1 runs the rest)
+SLOW_EXAMPLES = {
+    "05_external_model.py", "07_elastic_workers.py",
+    "08_temperature_schemes.py",
+}
+
+
+@pytest.mark.parametrize("script", [
+    pytest.param(f, marks=pytest.mark.slow) if f in SLOW_EXAMPLES else f
+    for f in sorted(f for f in os.listdir(EXAMPLES) if f.endswith(".py"))
+])
 def test_example_runs(script, monkeypatch):
     monkeypatch.setenv("EX_POP", "150")
     monkeypatch.setenv("EX_GENS", "3")

@@ -1,0 +1,141 @@
+"""Precision audit for the narrowed fetch payload (round-6 compaction).
+
+The fused loop ships accepted rows (theta / distance / log_weight, plus
+retained sum stats) over the device->host link in a narrowed dtype
+(``ABCSMC(fetch_dtype=...)``, float16 default — ops/pack.py). The device
+carry chain stays f32, so the inference TRAJECTORY — which particles are
+accepted, the epsilon trail, the in-kernel refits — is bit-identical
+across fetch dtypes; only the History-persisted row values round through
+the wire format. These tests are the documented audit that the rounding
+can never silently corrupt History:
+
+- row-wise parity against the f32 wire on the SAME trajectory (same
+  seed + adopted kernels) within the dtype's relative ULP;
+- posterior parity: weighted mean / variance of every generation within
+  tolerances far tighter than statistical error;
+- the acceptance invariant ``stored distance <= stored epsilon``
+  survives narrowing (the distance column rounds toward zero — a
+  round-to-nearest cast can push a stored distance half a ULP above the
+  stored threshold);
+- the conjugate-Gaussian posterior itself stays correct end to end.
+"""
+import numpy as np
+import pytest
+
+import pyabc_tpu as pt
+
+PRIOR_SD = 1.0
+NOISE_SD = 0.5
+X_OBS = 1.0
+POST_VAR = 1.0 / (1 / PRIOR_SD**2 + 1 / NOISE_SD**2)
+POST_MU = POST_VAR * (X_OBS / NOISE_SD**2)
+
+#: relative ULP of the narrowed formats (10 / 8 mantissa bits); the
+#: monotone-down distance cast may consume up to ~1.5 ULP extra
+REL_TOL = {"float16": 2.0 ** -10, "bfloat16": 2.0 ** -7}
+
+N_GENS = 5
+POP = 400
+
+
+def _gauss_model():
+    import jax
+
+    @pt.JaxModel.from_function(["theta"], name="gauss")
+    def model(key, theta):
+        return {"x": theta[0] + NOISE_SD * jax.random.normal(key)}
+
+    return model
+
+
+def _run(fetch_dtype, *, adopt_from=None, store_ss=True):
+    prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+    abc = pt.ABCSMC(
+        _gauss_model(), prior, pt.AdaptivePNormDistance(p=2),
+        population_size=POP, eps=pt.MedianEpsilon(), seed=7,
+        fused_generations=4, fetch_dtype=fetch_dtype,
+    )
+    abc.new("sqlite://", {"x": X_OBS}, store_sum_stats=store_ss)
+    if adopt_from is not None:
+        abc.adopt_device_context(adopt_from)
+    h = abc.run(max_nr_populations=N_GENS)
+    assert h.n_populations == N_GENS
+    return abc, h
+
+
+@pytest.fixture(scope="module")
+def f32_run():
+    return _run("float32")
+
+
+@pytest.mark.parametrize("dtype", ["float16", "bfloat16"])
+def test_narrowed_fetch_posterior_parity(dtype, f32_run):
+    """Same seed + adopted kernels => same trajectory; every generation's
+    weighted mean/variance must match the f32 wire within the narrowed
+    dtype's precision — far inside any statistically meaningful shift."""
+    abc32, h32 = f32_run
+    _abc, h = _run(dtype, adopt_from=abc32)
+    rel = REL_TOL[dtype]
+    # identical trajectory: the epsilon trail is computed on device in
+    # f32 and fetched as f32 scalars regardless of the row wire format
+    eps32 = h32.get_all_populations().query("t >= 0")["epsilon"].to_numpy()
+    eps = h.get_all_populations().query("t >= 0")["epsilon"].to_numpy()
+    np.testing.assert_array_equal(eps, eps32)
+    for t in range(N_GENS):
+        df32, w32 = h32.get_distribution(0, t)
+        df, w = h.get_distribution(0, t)
+        assert len(df) == len(df32) == POP
+        th32 = df32["theta"].to_numpy()
+        th = df["theta"].to_numpy()
+        # row-wise wire rounding only (same particles, same order)
+        np.testing.assert_allclose(th, th32, rtol=rel, atol=rel)
+        # posterior estimates: rounding noise averages DOWN across rows,
+        # so the weighted moments sit well inside one ULP
+        mu32 = float(np.sum(th32 * w32))
+        mu = float(np.sum(th * w))
+        var32 = float(np.sum(w32 * (th32 - mu32) ** 2))
+        var = float(np.sum(w * (th - mu) ** 2))
+        assert mu == pytest.approx(mu32, abs=2 * rel * max(1.0, abs(mu32)))
+        assert var == pytest.approx(var32, rel=4 * rel, abs=4 * rel * var32
+                                    + 1e-12)
+        # weights themselves round through the wire (log-space cast)
+        np.testing.assert_allclose(np.sort(w), np.sort(w32),
+                                   rtol=8 * rel, atol=8 * rel / POP)
+
+
+@pytest.mark.parametrize("dtype", ["float16", "bfloat16"])
+def test_narrowed_fetch_acceptance_invariant(dtype):
+    """Stored accepted distances must never exceed the stored epsilon of
+    a QUANTILE schedule's next generation use — i.e. the in-generation
+    invariant d <= eps_used survives the wire (monotone-down cast)."""
+    _abc, h = _run(dtype)
+    pops = h.get_all_populations().query("t >= 0")
+    for t, eps_used in zip(pops["t"], pops["epsilon"]):
+        if not np.isfinite(eps_used):
+            continue  # generation 0 accepts at +inf
+        d = h.get_weighted_distances(int(t))["distance"].to_numpy()
+        assert float(d.max()) <= float(eps_used) + 1e-12, (
+            f"t={t}: stored distance {d.max()} exceeds stored epsilon "
+            f"{eps_used} after {dtype} narrowing"
+        )
+
+
+@pytest.mark.parametrize("dtype", ["float16", "bfloat16", "float32"])
+def test_narrowed_fetch_conjugate_posterior(dtype):
+    """End-to-end statistical correctness on the conjugate Gaussian: the
+    analytic posterior is recovered identically well for every wire
+    format (History round-trip tolerance, SURVEY §6 parity bar)."""
+    _abc, h = _run(dtype)
+    df, w = h.get_distribution(0, h.max_t)
+    mu = float(np.sum(df["theta"].to_numpy() * w))
+    assert mu == pytest.approx(POST_MU, abs=0.25)
+    # sum stats round-trip the db in the narrowed dtype's precision
+    _w_ss, stats = h.get_weighted_sum_stats(h.max_t)
+    assert np.isfinite(stats).all()
+
+
+def test_fetch_dtype_validated_at_construction():
+    prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+    with pytest.raises(ValueError, match="fetch_dtype"):
+        pt.ABCSMC(_gauss_model(), prior, pt.PNormDistance(p=2),
+                  population_size=10, fetch_dtype="float8")

@@ -298,9 +298,14 @@ def test_fused_pnorm_weight_schedule():
         dist = pt.PNormDistance(p=2, weights={
             t: dict(w) for t, w in sched.items()
         })
+        # f32 wire format: the schedule check recomputes distances from
+        # the persisted sumstats at rtol 2e-3 — the default f16 fetch
+        # narrowing (audited separately in test_fetch_precision.py) sits
+        # exactly at that edge and would blur WHICH weights were used
         abc = pt.ABCSMC(_two_stat_model(), prior, dist,
                         population_size=300, eps=pt.MedianEpsilon(),
-                        seed=13, fused_generations=fused)
+                        seed=13, fused_generations=fused,
+                        fetch_dtype="float32")
         abc.new("sqlite://", obs)
         h = abc.run(max_nr_populations=6)
         assert h.n_populations == 6
@@ -329,8 +334,11 @@ def test_fused_aggregated_weight_schedule():
          pt.PNormDistance(p=1)],
         weights={0: [1.0, 1.0], 2: [4.0, 0.1]},
     )
+    # f32 wire: the schedule check recomputes distances from persisted
+    # sumstats at tight rtol (see test_fused_pnorm_weight_schedule)
     abc = pt.ABCSMC(_two_stat_model(), prior, dist, population_size=300,
-                    eps=pt.MedianEpsilon(), seed=17, fused_generations=3)
+                    eps=pt.MedianEpsilon(), seed=17, fused_generations=3,
+                    fetch_dtype="float32")
     abc.new("sqlite://", obs)
     h = abc.run(max_nr_populations=6)
     assert h.n_populations == 6
@@ -392,6 +400,7 @@ def test_local_transition_blocked_knn_matches_dense():
     )
 
 
+@pytest.mark.slow
 def test_fused_local_transition_large_population():
     """A fused run with LocalTransition at a population large enough to
     trigger the blocked kNN path (n_cap > 4096) completes and recovers
@@ -570,6 +579,7 @@ def test_fused_aggregated_distance_matches_pergen_loop():
     assert not abc_c._fused_chunk_capable()
 
 
+@pytest.mark.slow
 def test_fused_adaptive_aggregated_matches_pergen_loop():
     """AdaptiveAggregatedDistance: the per-generation 1/scale sub-distance
     reweighting runs IN-KERNEL over the record ring. Epsilon trajectory,
@@ -720,8 +730,13 @@ def test_fused_calibration_matches_host_calibration():
     for label, fg in (("fused", 4), ("host", 1)):
         dist = pt.AdaptivePNormDistance(p=2)
         eps = pt.MedianEpsilon()
+        # f32 wire format: this test asserts EXACT key-stream parity of
+        # the in-kernel calibration against the host path; the default
+        # f16 fetch narrowing (audited in test_fetch_precision.py) would
+        # round the persisted rows at ~5e-4 and blur the 1e-6 claim
         abc = pt.ABCSMC(_gauss_model(), prior, dist, population_size=300,
-                        eps=eps, seed=42, fused_generations=fg)
+                        eps=eps, seed=42, fused_generations=fg,
+                        fetch_dtype="float32")
         calib_calls = []
         orig = abc.sampler.sample_until_n_accepted
 
@@ -821,6 +836,7 @@ def test_fused_mid_chunk_stop_rebuilds_deferred_population():
     assert abc.transitions[0].X is not None
 
 
+@pytest.mark.slow
 def test_fused_multimodel_local_transition():
     """K=2 LocalTransition through the fused chunk loop: the host
     _effective_k rule runs IN-KERNEL against each model's dynamic
@@ -858,6 +874,7 @@ def test_fused_multimodel_local_transition():
     np.testing.assert_allclose(eps_f, eps_p, rtol=0.25)
 
 
+@pytest.mark.slow
 def test_fused_multimodel_gridsearchcv():
     """K=2 GridSearchCV (per-model in-kernel CV bandwidth selection over
     row-indexed folds — declared deviation from the host's per-model

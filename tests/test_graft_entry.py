@@ -13,6 +13,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 CHILD = (
@@ -22,6 +24,7 @@ CHILD = (
 )
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_as_driver():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"

@@ -159,6 +159,7 @@ class TestStoppingRules:
 class TestTelemetrySurface:
     """Round-1 verdict telemetry asks: jax.profiler hook + storage views."""
 
+    @pytest.mark.slow
     def test_profile_dir_produces_trace(self, tmp_path):
         import os
 

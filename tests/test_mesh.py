@@ -166,6 +166,7 @@ class TestMeshedFusedChunks:
         assert mu_m == pytest.approx(mu_s, abs=0.2)
         assert sd_m == pytest.approx(sd_s, abs=0.15)
 
+    @pytest.mark.slow
     def test_fused_chunk_large_population_on_mesh(self):
         """Round-4 verdict Weak #5: nothing exercised sharded collectives
         at a realistic population. Pop 2048 with a G=4 fused chunk on the

@@ -61,6 +61,7 @@ def test_f32_device_weights_healthy_at_small_eps(fused):
     assert sd == pytest.approx(np.sqrt(POST_VAR), abs=0.12)
 
 
+@pytest.mark.slow
 def test_f32_device_matches_f64_host_oracle_at_small_eps():
     """Device f32 kernel vs the scalar float64 host closure (the oracle
     path) at an identical tight schedule: posterior moments must agree
@@ -101,9 +102,12 @@ def test_fused_deep_schedule_f32_weights_match_f64_recomputation():
     from scipy.stats import norm as scipy_norm
 
     prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+    # f32 wire: this test isolates f32 DEVICE math vs a f64 oracle over
+    # the persisted rows; the default f16 fetch narrowing (audited in
+    # test_fetch_precision.py) would alias into the 5e-4 comparison
     abc = pt.ABCSMC(_gauss_model(), prior, pt.AdaptivePNormDistance(p=2),
                     population_size=300, eps=pt.MedianEpsilon(), seed=44,
-                    fused_generations=6)
+                    fused_generations=6, fetch_dtype="float32")
     abc.new("sqlite://", {"x": X_OBS})
     h = abc.run(max_nr_populations=12)
     # the run may legitimately stop short when a deep generation misses its

@@ -388,3 +388,74 @@ def test_history_writer_backlog_gauge():
     assert reg.snapshot()["pyabc_tpu_db_writer_backlog"] == 0
     assert any(s.name == "db.write" for s in tr.spans())
     h.close()
+
+
+# ------------------------------------------------------------ sync ledger
+
+def test_sync_ledger_counts_kinds_bytes_and_floor():
+    """SyncLedger (round 6): device round trips recorded per kind with
+    payload bytes; the floor model turns the count into attributed wall
+    clock for the bench's gap_attribution block."""
+    from pyabc_tpu.observability import NULL_SYNC_LEDGER, SyncLedger
+
+    vc = VirtualClock()
+    led = SyncLedger(clock=vc)
+    assert led.count == 0 and led.summary()["tunnel_floor_s"] == 0.0
+    led.record("chunk_fetch", 96_000)
+    vc.advance(0.5)
+    led.record("chunk_fetch", 96_000)
+    led.record("compute_probe")
+    assert led.count == 3
+    assert led.by_kind() == {"chunk_fetch": 2, "compute_probe": 1}
+    assert led.total_bytes() == 192_000
+    s = led.summary(sync_floor_s=0.1)
+    assert s["syncs"] == 3
+    assert s["tunnel_floor_s"] == pytest.approx(0.3)
+    assert s["bytes_by_kind"]["chunk_fetch"] == 192_000
+    # events carry the injected clock's timestamps
+    assert led.events[0][0] == 0.0 and led.events[1][0] == 0.5
+    led.clear()
+    assert led.count == 0
+    # the shared inert ledger records nothing
+    NULL_SYNC_LEDGER.record("chunk_fetch", 1)
+    assert NULL_SYNC_LEDGER.count == 0
+    assert NULL_SYNC_LEDGER.summary()["syncs"] == 0
+
+
+def test_sync_ledger_thread_safety():
+    from pyabc_tpu.observability import SyncLedger
+
+    led = SyncLedger()
+    with ThreadPoolExecutor(max_workers=8) as pool:
+        for f in [pool.submit(lambda: [led.record("k", 8)
+                                       for _ in range(100)])
+                  for _ in range(8)]:
+            f.result()
+    assert led.count == 800
+    assert led.total_bytes() == 6400
+
+
+def test_fused_run_records_chunk_fetch_syncs():
+    """A fused CPU run books one chunk_fetch sync per fetched chunk,
+    with the measured post-compaction payload bytes attached."""
+    import jax
+
+    @pt.JaxModel.from_function(["theta"], name="gauss")
+    def model(key, theta):
+        return {"x": theta[0] + 0.5 * jax.random.normal(key)}
+
+    prior = pt.Distribution(theta=pt.RV("norm", 0.0, 1.0))
+    abc = pt.ABCSMC(model, prior, pt.PNormDistance(p=2),
+                    population_size=100, eps=pt.MedianEpsilon(), seed=3,
+                    fused_generations=3)
+    abc.new("sqlite://", {"x": 1.0}, store_sum_stats=False)
+    abc.run(max_nr_populations=6)
+    kinds = abc.sync_ledger.by_kind()
+    assert kinds.get("chunk_fetch", 0) >= 2  # 6 gens / G=3 chunks
+    fetch_events = [e for e in abc.sync_ledger.events
+                    if e[1] == "chunk_fetch"]
+    assert all(b > 0 for _ts, _k, b in fetch_events)
+    # the summary feeds the bench's run_infos["syncs"] block verbatim
+    s = abc.sync_ledger.summary(0.102)
+    assert s["syncs"] == abc.sync_ledger.count
+    assert s["tunnel_floor_s"] == pytest.approx(s["syncs"] * 0.102)

@@ -273,6 +273,7 @@ class TestAdaptiveNFused:
         )
         assert not abc_g._fused_chunk_capable()
 
+    @pytest.mark.slow
     def test_fused_cv_drives_n(self):
         """The fused chunk runs the bootstrap-CV bisection in-kernel; n
         must move off the start size and stay inside the bounds, with the
@@ -295,6 +296,7 @@ class TestAdaptiveNFused:
         mu, _sd = _posterior_moments(h)
         assert mu == pytest.approx(POST_MU, abs=0.35)
 
+    @pytest.mark.slow
     def test_fused_matches_unfused_direction(self):
         """Fused (in-kernel CV) and unfused (host CV) runs of the same
         config agree on the adaptation direction and the posterior."""
@@ -328,6 +330,7 @@ class TestAdaptiveNFusedWidened:
             min_population_size=20, max_population_size=600, n_bootstrap=5,
         )
 
+    @pytest.mark.slow
     def test_fused_adaptive_n_local_transition(self):
         prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
         aps = self._aps()
@@ -346,6 +349,7 @@ class TestAdaptiveNFusedWidened:
         mu, _sd = _posterior_moments(h)
         assert mu == pytest.approx(POST_MU, abs=0.35)
 
+    @pytest.mark.slow
     def test_fused_adaptive_n_multimodel(self):
         """K=2 adaptive-n fused: the in-kernel CV aggregates the two
         models' bootstrap CVs by their current probabilities (reference
@@ -376,6 +380,7 @@ class TestAdaptiveNFusedWidened:
         assert float(probs.get(0, 0.0)) == pytest.approx(expect[0],
                                                          abs=0.3)
 
+    @pytest.mark.slow
     def test_fused_gridsearch_list_population(self):
         """GridSearchCV x ListPopulationSize rides fused chunks with
         per-generation fold tables; particle counts follow the schedule
@@ -434,6 +439,7 @@ class TestAdaptiveNEndToEnd:
         mu, _sd = _posterior_moments(h)
         assert mu == pytest.approx(POST_MU, abs=0.35)
 
+    @pytest.mark.slow
     def test_device_unfused_path_cv_drives_n(self):
         """Same criterion on the batched device path (per-generation loop:
         AdaptivePopulationSize's host bisection runs between kernels)."""

@@ -42,7 +42,13 @@ def _sampler_factories():
     }
 
 
-@pytest.mark.parametrize("name", sorted(_sampler_factories()))
+@pytest.mark.parametrize("name", [
+    # the multi-process samplers fork real worker pools — integration
+    # weight that belongs to the full lane, not the tier-1 fast lane
+    pytest.param(n, marks=pytest.mark.slow)
+    if n.startswith("multicore") else n
+    for n in sorted(_sampler_factories())
+])
 def test_sampler_posterior_equivalence(name):
     """Same Gaussian-conjugate posterior from every host execution strategy."""
     sampler = _sampler_factories()[name]()
@@ -82,6 +88,7 @@ def test_batched_device_sampler_equivalence():
     assert mu == pytest.approx(POST_MU, abs=0.25)
 
 
+@pytest.mark.slow
 def test_multicore_eval_adaptive_distance_records():
     """record_rejected plumbing through forked workers: the adaptive distance
     must receive all-simulation records and refit per-statistic weights."""
@@ -101,6 +108,7 @@ def test_multicore_eval_adaptive_distance_records():
     assert any(t >= 1 for t in dist.weights)
 
 
+@pytest.mark.slow
 def test_multicore_worker_exception_propagates():
     """get_if_worker_healthy re-raises child failures instead of hanging."""
 

@@ -67,6 +67,7 @@ def test_sge_unavailable_raises(monkeypatch, tmp_path):
         SGE()
 
 
+@pytest.mark.slow
 def test_sge_map(fake_sge):
     assert sge_available()
     sge = SGE(chunk_size=2, poll_interval_s=0.05)

@@ -35,6 +35,7 @@ def _abc(seed=7, fused_generations=3, pop=200):
     )
 
 
+@pytest.mark.slow
 def test_store_sum_stats_false_identical_posterior():
     abc_full = _abc()
     abc_full.new("sqlite://", {"x": X_OBS})

@@ -109,6 +109,7 @@ def _run_fp(distance, seed):
 
 
 class TestFearnheadPrangleIntegration:
+    @pytest.mark.slow
     def test_learned_stats_beat_identity(self):
         # true posterior concentrates near theta = 1 (2 obs of mean theta)
         post_mu = 1.0 * (2 / NOISE_SD**2) / (1.0 + 2 / NOISE_SD**2)
