@@ -2,13 +2,131 @@
 
 Not part of the package — a diagnostic harness for BASELINE.md's gap
 analysis. Run on the real chip (default) or CPU (JAX_PLATFORMS=cpu).
+
+Round 7: the harness is parameterized (``--pop``, ``--transition``,
+``--generations``) so the scale-path refit kernel can be reproduced
+standalone — the round-5 pop-16384 LocalTransition case was previously
+unreachable here — and ``--profile-refit`` compiles the refit variants
+WITHOUT running SMC and prints XLA cost-analysis FLOP counts plus
+compiled sort-op counts:
+
+    JAX_PLATFORMS=cpu python profile_gen.py --profile-refit --pop 16384
+
+This is the CPU proxy for the scale-lane acceptance: per-generation
+refit cost with the cadence engine (drift statistic every generation +
+one threshold-selection refit every m generations) vs the old
+unconditional top_k refit, measured on the compiled programs.
 """
+import argparse
+import json
 import time
 
 import numpy as np
 
 
-def main():
+def _cost(compiled) -> dict:
+    """Normalize compiled.cost_analysis() across jax versions (dict, or
+    one dict per device)."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return dict(ca or {})
+
+
+def _sort_ops(compiled) -> int:
+    """Count sort / top-k ops in the compiled HLO — the data-dependent
+    permutation work the threshold selection exists to eliminate (lowered
+    as ``sort`` on TPU, a ``TopK`` custom call on CPU)."""
+    import re
+
+    try:
+        txt = compiled.as_text()
+    except Exception:
+        return -1
+    return len(re.findall(r"= sort\(|sort\.[0-9]+ = |TopK", txt))
+
+
+def profile_refit(pop: int, dim: int, k_fraction: float, refit_every: int,
+                  time_execs: bool) -> dict:
+    """Compile (and optionally run) the LocalTransition refit variants at
+    the requested population and print the amortization table."""
+    import jax
+    import jax.numpy as jnp
+
+    import pyabc_tpu as pt
+    from pyabc_tpu.transition.util import device_proposal_drift
+
+    tr = pt.LocalTransition(k_fraction=k_fraction)
+    k_cap = tr._effective_k(pop, dim)
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(rng.normal(size=(pop, dim)), jnp.float32)
+    w = jnp.full((pop,), 1.0 / pop, jnp.float32)
+    vmask = jnp.ones((dim,), jnp.float32)
+
+    def fit(selection):
+        return lambda X_, w_: pt.LocalTransition.device_fit(
+            X_, w_, dim=dim, scaling=1.0, k_cap=k_cap,
+            k_fraction=k_fraction, selection=selection,
+        )
+
+    def drift_fn(X_, w_):
+        return device_proposal_drift(X_, w_, X_ + 0.1, w_, vmask)
+
+    variants = {
+        "refit_topk": fit("topk"),
+        "refit_threshold": fit("threshold"),
+        "drift_stat": drift_fn,
+    }
+    report = {"pop": pop, "dim": dim, "k_cap": k_cap,
+              "refit_every": refit_every, "variants": {}}
+    for name, fn in variants.items():
+        compiled = jax.jit(fn).lower(X, w).compile()
+        ca = _cost(compiled)
+        entry = {
+            "flops": float(ca.get("flops", 0.0)),
+            "transcendentals": float(ca.get("transcendentals", 0.0)),
+            "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
+            "sort_ops": _sort_ops(compiled),
+        }
+        if time_execs:
+            out = compiled(X, w)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            jax.block_until_ready(compiled(X, w))
+            entry["exec_s"] = round(time.perf_counter() - t0, 4)
+        report["variants"][name] = entry
+        print(f"{name:>18}: flops={entry['flops']:.3e} "
+              f"sort_ops={entry['sort_ops']} "
+              f"bytes={entry['bytes_accessed']:.3e}"
+              + (f" exec_s={entry['exec_s']}" if time_execs else ""))
+
+    f_topk = report["variants"]["refit_topk"]["flops"]
+    f_thr = report["variants"]["refit_threshold"]["flops"]
+    f_drift = report["variants"]["drift_stat"]["flops"]
+    # per-generation refit cost: baseline = one top_k refit EVERY
+    # generation; cadence = the drift statistic every generation plus one
+    # threshold refit amortized over refit_every generations
+    amortized = f_drift + f_thr / max(refit_every, 1)
+    report["per_gen_flops_every_gen_topk"] = f_topk
+    report["per_gen_flops_cadence"] = amortized
+    report["refit_cost_reduction_x"] = round(f_topk / max(amortized, 1.0), 2)
+    report["sort_ops_eliminated"] = (
+        report["variants"]["refit_topk"]["sort_ops"]
+        - report["variants"]["refit_threshold"]["sort_ops"]
+    )
+    print(
+        f"per-generation refit cost: every-gen top_k {f_topk:.3e} flops "
+        f"vs cadence(m={refit_every}) {amortized:.3e} flops "
+        f"-> {report['refit_cost_reduction_x']}x reduction; "
+        f"sort ops {report['variants']['refit_topk']['sort_ops']} -> "
+        f"{report['variants']['refit_threshold']['sort_ops']}"
+    )
+    print("PROFILE_REFIT " + json.dumps(report))
+    return report
+
+
+def main(pop: int = 1000, transition: str = "mvn", generations: int = 3,
+         k_fraction: float = 0.25, refit_every: int | None = None):
     import jax
 
     import pyabc_tpu as pt
@@ -18,22 +136,31 @@ def main():
     prior = lv.default_prior()
     obs = lv.observed_data(seed=123)
 
+    trans = (pt.LocalTransition(k_fraction=k_fraction)
+             if transition == "local" else None)
     abc = pt.ABCSMC(
         model, prior, pt.AdaptivePNormDistance(p=2),
-        population_size=1000, eps=pt.MedianEpsilon(), seed=0,
+        population_size=pop, eps=pt.MedianEpsilon(), seed=0,
+        **({"transitions": trans} if trans is not None else {}),
+        **({"refit_every": refit_every} if refit_every is not None else {}),
     )
     abc.new("sqlite://", obs)
     print("platform:", jax.devices()[0].platform)
 
-    # run 3 generations to reach steady state (transition kernel compiled)
-    h = abc.run(max_nr_populations=3)
+    # run `generations` generations to reach steady state (kernel compiled)
+    h = abc.run(max_nr_populations=generations)
     for t in range(h.max_t + 1):
         print(f"t={t} telemetry:", h.get_telemetry(t))
+    if abc.refit_events:
+        refits = sum(1 for _t, r, _d, _c in abc.refit_events if r)
+        print(f"refit events: {refits}/{len(abc.refit_events)} "
+              f"generations refit; last drift "
+              f"{abc.refit_events[-1][2]:.4f}")
 
     # now profile one more generation by hand, split into stages
     t = h.max_t + 1
     sampler = abc.sampler
-    n_t = 1000
+    n_t = pop
     for rep in range(3):
         t0 = time.perf_counter()
         spec = abc._generation_spec(t)
@@ -47,9 +174,9 @@ def main():
         t_kernel = time.perf_counter()
         sample = sampler.collect(handle)
         t_fetch = time.perf_counter()
-        pop = abc._sample_to_population(sample)
+        pop_obj = abc._sample_to_population(sample)
         nr_evals = sampler.nr_evaluations_
-        abc._adapt_components(t, sample, pop, abc.eps(t), n_t / nr_evals)
+        abc._adapt_components(t, sample, pop_obj, abc.eps(t), n_t / nr_evals)
         t_adapt = time.perf_counter()
         print(
             f"rep{rep}: spec={t_spec-t0:.4f}s dispatch={t_dispatch-t_spec:.4f}s "
@@ -61,4 +188,27 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--pop", type=int, default=1000,
+                    help="population size (16384 reproduces the r5 scale "
+                         "case)")
+    ap.add_argument("--transition", choices=("mvn", "local"), default="mvn")
+    ap.add_argument("--generations", type=int, default=3)
+    ap.add_argument("--k-fraction", type=float, default=0.25)
+    ap.add_argument("--refit-every", type=int, default=None,
+                    help="LocalTransition refit cadence (None = auto)")
+    ap.add_argument("--profile-refit", action="store_true",
+                    help="compile-and-count the refit variants only "
+                         "(no SMC run): the CPU FLOP/op proxy")
+    ap.add_argument("--dim", type=int, default=4,
+                    help="parameter dim for --profile-refit")
+    ap.add_argument("--time", action="store_true",
+                    help="also execute + wall-time the compiled variants")
+    args = ap.parse_args()
+    if args.profile_refit:
+        profile_refit(args.pop, args.dim, args.k_fraction,
+                      args.refit_every or 16, args.time)
+    else:
+        main(pop=args.pop, transition=args.transition,
+             generations=args.generations, k_fraction=args.k_fraction,
+             refit_every=args.refit_every)

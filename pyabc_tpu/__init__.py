@@ -107,6 +107,8 @@ from .observability import (
     Tracer,
     VirtualClock,
     coverage_report,
+    device_busy_spans,
+    interval_intersection,
     prometheus_text,
     read_trace,
 )

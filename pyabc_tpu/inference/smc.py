@@ -152,6 +152,8 @@ class ABCSMC:
                  fused_generations: int = 8,
                  fetch_pipeline_depth: int = 3,
                  fetch_dtype: str = "float16",
+                 refit_every: int | None = None,
+                 refit_drift_threshold: float = 0.3,
                  tracer=None,
                  metrics=None):
         self.models: list[Model] = assert_models(models)
@@ -287,6 +289,28 @@ class ABCSMC:
                 f"got {fetch_dtype!r}"
             )
         self.fetch_dtype = str(fetch_dtype)
+        #: amortized scale-path proposal engine (LocalTransition on the
+        #: fused loop): refit the in-kernel k-NN local-covariance
+        #: proposal only every ``refit_every`` generations OR when the
+        #: acceptance-weighted mean/cov drift of the accepted population
+        #: vs the fitted one crosses ``refit_drift_threshold`` — at pop
+        #: 16384 the unconditional per-generation refit (blocked 16k-row
+        #: kNN + 16k 4x4 Choleskys + a near-full-row-sort top_k at
+        #: k=4096) was the dominant device cost and inverted
+        #: throughput-vs-population scaling (BASELINE.md r5: 0.8-4k pps
+        #: vs the 143.7k headline). Sampling from a stale fit is
+        #: statistically exact — importance weights always use the
+        #: proposal params actually sampled from — so cadence trades
+        #: only proposal freshness, and the drift guard bounds that.
+        #: None = auto: 16 for LocalTransition at populations >= 16384,
+        #: else 1 (refit every generation, the pre-cadence behavior).
+        self.refit_every = (int(refit_every) if refit_every is not None
+                            else None)
+        self.refit_drift_threshold = float(refit_drift_threshold)
+        #: (t, refit?, drift, rows_changed) per fused generation — the
+        #: host mirror of the in-kernel refit events (bench `scale` lane
+        #: reads refits_per_run off it; metrics get the same events)
+        self.refit_events: list[tuple] = []
         #: fused loop: once the generation schedule is exhausted, hand the
         #: still-in-flight final fetches to a background drain thread and
         #: return immediately. The run's LAST chunks' fetch latency (which
@@ -688,7 +712,13 @@ class ABCSMC:
         for m in pop.get_alive_models():
             df, w = pop.get_distribution(m)
             try:
-                self.transitions[m].fit(df, w)
+                # a WORK span: host-side proposal refits (per-generation
+                # loops, fused chunk-boundary mirrors) show up in the
+                # trace next to the sample/persist spans, so refit-vs-
+                # sample timing is measurable wherever refits run on the
+                # host
+                with self.tracer.span("refit", model=int(m), n=len(df)):
+                    self.transitions[m].fit(df, w)
             except NotEnoughParticles:
                 logger.warning(
                     "not enough particles to fit transition for model %d", m
@@ -1102,7 +1132,9 @@ class ABCSMC:
                 if (type(other) is not LocalTransition
                         or other.scaling != tr.scaling
                         or other.k != tr.k
-                        or other.k_fraction != tr.k_fraction):
+                        or other.k_fraction != tr.k_fraction
+                        or other.k_max != tr.k_max
+                        or other.selection != tr.selection):
                     return False
         elif type(tr) is MultivariateNormalTransition:
             for other in self.transitions:
@@ -1447,6 +1479,11 @@ class ABCSMC:
                     ("k_cap", tr._effective_k(n, dim)),
                     ("k_fixed", int(tr.k) if tr.k is not None else -1),
                     ("k_fraction", tr.k_fraction),
+                    ("k_max", tr.k_max),
+                    # neighbor selection: exact top_k below the cutoff,
+                    # threshold (radius bisection + masked gather,
+                    # ops/select.py) above — the sub-sort scale path
+                    ("selection", tr.selection),
                 ))
             elif type(tr) is GridSearchCV:
                 statics = [
@@ -1471,6 +1508,24 @@ class ABCSMC:
                 out.append((("scaling", tr.scaling),
                             ("bandwidth_selector", tr.bandwidth_selector)))
         return tuple(out)
+
+    def _refit_cadence_cfg(self, n_cap: int) -> tuple | None:
+        """(refit_every, drift_threshold) for the multigen kernel's
+        amortized proposal engine, or None — refit every generation, the
+        pre-cadence program kept BYTE-IDENTICAL for every configuration
+        that doesn't opt in. Scope: LocalTransition (the only transition
+        whose refit cost ever dominated a lane — BASELINE.md r5 pop-16k;
+        MVN refits are one weighted covariance and not worth a stale
+        proposal). Auto (refit_every=None): 16 at populations >= 16384
+        — the scale lane — else 1."""
+        if type(self.transitions[0]) is not LocalTransition:
+            return None
+        every = self.refit_every
+        if every is None:
+            every = 16 if n_cap >= 16384 else 1
+        if every <= 1:
+            return None
+        return (int(every), float(self.refit_drift_threshold))
 
     def _temp_config(self) -> tuple:
         """Static scheme descriptor tuple for the device temperature twin."""
@@ -1678,6 +1733,7 @@ class ABCSMC:
         fused_cal = (
             self._fused_calibration_cfg() if first_gen_prior else None
         )
+        refit_cadence = self._refit_cadence_cfg(n_cap)
         with self.tracer.span("kernel.build", G=int(G), B=int(B),
                               n_cap=int(n_cap)):
             kern = ctx.multigen_kernel(
@@ -1706,6 +1762,7 @@ class ABCSMC:
                      int(self.population_strategy.n_bootstrap))
                     if adaptive_n else None
                 ),
+                refit_cadence=refit_cadence,
             )
 
         def _g_limit(t_at: int) -> int:
@@ -1869,6 +1926,11 @@ class ABCSMC:
                 # current decision (gen 0 / resume adapt on the host)
                 base = base + (jnp.asarray(
                     min(self.population_strategy(t_at), n_cap), jnp.int32),)
+            if refit_cadence is not None:
+                # generations-since-refit counter: the carry's params are
+                # a fresh host fit (or the forced first in-kernel refit
+                # handles the prior-mode chunk), so the cadence starts at 0
+                base = base + (jnp.zeros((), jnp.int32),)
             return base
 
         carry0 = _build_chunk_carry(t)
@@ -2128,7 +2190,7 @@ class ABCSMC:
                 ).inc(int(n_acc_chunk))
             if self.chunk_event_cb is not None:
                 try:
-                    self.chunk_event_cb({
+                    ev = {
                         "ts": clk(), "t_first": int(t_at),
                         "gens": int(g_done), "n_acc": int(n_acc_chunk),
                         "chunk_index": int(chunk_index),
@@ -2138,7 +2200,16 @@ class ABCSMC:
                         "fetch_bytes_full_f32": int(r5_bytes),
                         "dispatch_s": float(dispatch_s),
                         "process_s": float(clk() - t_proc0),
-                    })
+                    }
+                    if "refit" in fetched and g_done > 0:
+                        # refit-cadence telemetry rides the chunk events
+                        # so the bench's scale lane can report
+                        # refits_per_run without touching the History
+                        ev["refits"] = int(
+                            np.asarray(fetched["refit"])[:g_done].sum())
+                        ev["drift_last"] = float(
+                            np.asarray(fetched["drift"])[g_done - 1])
+                    self.chunk_event_cb(ev)
                 except Exception:
                     logger.exception("chunk_event_cb failed")
             return (stop, last_pop, last_sample, last_eps, last_acc_rate,
@@ -2355,6 +2426,35 @@ class ABCSMC:
                 sims_total += nr_evals
                 acceptance_rate = n / max(nr_evals, 1)
                 n_acc_chunk += n
+                refit_tel = {}
+                if "refit" in fetched:
+                    # mirror the in-kernel refit-cadence events into the
+                    # observability subsystem + History telemetry: refit
+                    # count, drift statistic and incremental-factorization
+                    # occupancy are REPORTED quantities (bench `scale`
+                    # lane: util.refits_per_run), not assumptions
+                    refit_g = bool(fetched["refit"][g])
+                    drift_g = float(fetched["drift"][g])
+                    rows_g = int(fetched["rows_changed"][g])
+                    self.refit_events.append((t, refit_g, drift_g, rows_g))
+                    if refit_g:
+                        self.metrics.counter(
+                            "pyabc_tpu_refits_total",
+                            "in-kernel proposal refits across fused "
+                            "generations (cadence/drift/forced)",
+                        ).inc()
+                        self.metrics.counter(
+                            "pyabc_tpu_refit_rows_changed_total",
+                            "rows re-factorized by incremental refits",
+                        ).inc(rows_g)
+                    self.metrics.histogram(
+                        "pyabc_tpu_refit_drift",
+                        "acceptance-weighted proposal drift statistic "
+                        "per fused generation",
+                    ).observe(drift_g)
+                    refit_tel = {"refit": refit_g,
+                                 "drift": round(drift_g, 5),
+                                 "refit_rows_changed": rows_g}
                 if g == g_last_ok or sumstat_refit:
                     last_sample, last_pop = _build()
                     last_eps, last_acc_rate = current_eps, acceptance_rate
@@ -2380,6 +2480,7 @@ class ABCSMC:
                         # chunk edge, where no refit happens and a resume
                         # must not restart the epsilon trail)
                         "distance_changed": bool(adaptive),
+                        **refit_tel,
                         **(mem_telemetry if g == 0 else {}),
                     },
                 )

@@ -731,7 +731,8 @@ class DeviceContext:
                         weight_sched: bool = False,
                         fold_sched_mode: bool = False,
                         first_gen_prior: bool = False,
-                        fused_calibration: tuple | None = None):
+                        fused_calibration: tuple | None = None,
+                        refit_cadence: tuple | None = None):
         """One jitted program for G WHOLE GENERATIONS (transition mode).
 
         The TPU-native endgame of the reference's per-generation scatter/
@@ -769,13 +770,32 @@ class DeviceContext:
         ExpDecay/PolynomialDecay/FrielPettitt ladders), with monotone decay
         and the final-generation T=1 override (reference
         ``pyabc/epsilon/temperature.py::Temperature._set`` semantics).
+
+        Refit cadence (``refit_cadence=(refit_every, drift_threshold)``,
+        the amortized scale-path proposal engine): the per-generation
+        transition refit — at pop 16384 a blocked 16k-row kNN plus 16k
+        small Choleskys, the dominant device cost of the scale lane —
+        runs only every ``refit_every`` generations OR when the
+        acceptance-weighted mean/cov drift of the accepted population vs
+        the FITTED one (``transition.util.device_proposal_drift``)
+        crosses ``drift_threshold``. In between, generations sample and
+        weigh against the carried factors directly — statistically exact
+        (importance weights always use the proposal params actually
+        sampled from), only proposal freshness is traded. A refit is
+        FORCED when any model with accepted particles has no usable fit
+        yet (first chunk after the in-kernel prior generation, model
+        revival). The carry gains a generations-since-refit counter; the
+        per-generation outputs gain ``refit``/``drift``/``rows_changed``
+        so the host can mirror refit events into the observability
+        subsystem — the amortization is measured, not assumed.
         """
         cache_key = ("multigen", B, n_cap, rec_cap, max_rounds, G, adaptive,
                      eps_quantile, eps_weighted, alpha, multiplier,
                      trans_cls.__name__, fit_statics, dims,
                      stochastic, temp_config, temp_fixed, complete_history,
                      sumstat_transform, adaptive_n, weight_sched,
-                     fold_sched_mode, first_gen_prior, fused_calibration)
+                     fold_sched_mode, first_gen_prior, fused_calibration,
+                     refit_cadence)
         if cache_key in self._kernels:
             return self._kernels[cache_key]
         if stochastic and self.K != 1:
@@ -838,13 +858,13 @@ class DeviceContext:
                 )(keys)
 
             def gen_step(carry, g):
-                if adaptive_n is not None:
-                    (trans_params, log_model_probs, fitted, dist_w,
-                     eps_carry, acc_state, stopped, n_carry) = carry
-                else:
-                    (trans_params, log_model_probs, fitted, dist_w,
-                     eps_carry, acc_state, stopped) = carry
-                    n_carry = None
+                carry_l = list(carry)
+                (trans_params, log_model_probs, fitted, dist_w,
+                 eps_carry, acc_state, stopped) = carry_l[:7]
+                tail = carry_l[7:]
+                n_carry = tail.pop(0) if adaptive_n is not None else None
+                gens_since = (tail.pop(0) if refit_cadence is not None
+                              else None)
                 pdf_norm, max_found, daly_k = acc_state
                 # g_limit (dynamic) caps the active generations so the LAST
                 # chunk of a run reuses the same compiled G-kernel instead
@@ -1010,38 +1030,106 @@ class DeviceContext:
                 min_count_of = getattr(
                     trans_cls, "device_refit_min_count", None
                 )
-                # per-class static fit config (scaling + bandwidth selector
-                # for MVN; scaling + the k_cap/k_fixed/k_fraction neighbor
-                # rule for LocalTransition; the scaling grid + fold spec
-                # for GridSearchCV)
-                trans_next = []
-                refit_ok = []
                 # GridSearchCV x ListPopulationSize: this generation's
                 # host-built fold-id row (the fixed-seed rule over ITS n)
                 fit_extra = (
                     {"folds": fold_sched[g]} if fold_sched_mode else {}
                 )
-                for m in range(K):
-                    fit_m = trans_cls.device_fit(
-                        res["theta"],
-                        jnp.where(m_arr == m, w_norm, 0.0),
-                        dim=dims[m], **dict(fit_statics[m]), **fit_extra,
-                    )
-                    if min_count_of is not None:
-                        ok = counts[m] >= min_count_of(dims[m])
-                        fit_m = jax.tree.map(
-                            lambda new, old: jnp.where(ok, new, old),
-                            fit_m, trans_params[m],
+                incremental = (
+                    refit_cadence is not None
+                    and hasattr(trans_cls, "device_fit_update")
+                )
+
+                def _refit_models(_):
+                    """Per-model refits: per-class static fit config
+                    (scaling + bandwidth selector for MVN; scaling + the
+                    k_cap/k_fixed/k_fraction/selection neighbor rule for
+                    LocalTransition; the scaling grid + fold spec for
+                    GridSearchCV). Under cadence, transitions with an
+                    incremental twin factorize only changed rows."""
+                    trans_new = []
+                    refit_ok = []
+                    rows_changed = jnp.zeros((), jnp.int32)
+                    for m in range(K):
+                        w_m = jnp.where(m_arr == m, w_norm, 0.0)
+                        if incremental:
+                            fit_m, nch = trans_cls.device_fit_update(
+                                res["theta"], w_m, trans_params[m],
+                                dim=dims[m], **dict(fit_statics[m]),
+                                **fit_extra,
+                            )
+                            rows_changed = rows_changed + nch
+                        else:
+                            fit_m = trans_cls.device_fit(
+                                res["theta"], w_m,
+                                dim=dims[m], **dict(fit_statics[m]),
+                                **fit_extra,
+                            )
+                        if min_count_of is not None:
+                            ok = counts[m] >= min_count_of(dims[m])
+                            fit_m = jax.tree.map(
+                                lambda new, old: jnp.where(ok, new, old),
+                                fit_m, trans_params[m],
+                            )
+                        else:
+                            ok = counts[m] > 0
+                        refit_ok.append(ok)
+                        trans_new.append(fit_m)
+                    # a model below its refit minimum keeps proposing from
+                    # the stale fit IF it ever had one (host semantics); a
+                    # model that was never fitted stays masked out
+                    fitted_new = jnp.stack(refit_ok) | (fitted
+                                                        & (counts > 0))
+                    return tuple(trans_new), fitted_new, rows_changed
+
+                if refit_cadence is None:
+                    trans_next, fitted_next, rows_changed = \
+                        _refit_models(None)
+                    drift = jnp.zeros((), jnp.float32)
+                    refit_now = jnp.asarray(True)
+                    gens_since_next = None
+                else:
+                    from ..transition.util import device_proposal_drift
+
+                    refit_every_s, drift_thr = refit_cadence
+                    # drift of the accepted population vs the population
+                    # each alive model's carried proposal was FITTED on
+                    drifts = []
+                    for m in range(K):
+                        vmask_m = (jnp.arange(self.d_max)
+                                   < dims[m]).astype(jnp.float32)
+                        w_m = jnp.where((m_arr == m) & k_mask, w_norm, 0.0)
+                        d_m = device_proposal_drift(
+                            trans_params[m]["thetas"],
+                            trans_params[m]["weights"],
+                            res["theta"], w_m, vmask_m,
                         )
-                    else:
-                        ok = counts[m] > 0
-                    refit_ok.append(ok)
-                    trans_next.append(fit_m)
-                trans_next = tuple(trans_next)
-                # a model below its refit minimum keeps proposing from the
-                # stale fit IF it ever had one (host semantics); a model
-                # that was never fitted stays masked out
-                fitted_next = jnp.stack(refit_ok) | (fitted & (counts > 0))
+                        drifts.append(jnp.where(
+                            fitted[m] & (counts[m] > 0), d_m, 0.0))
+                    drift = jnp.max(jnp.stack(drifts))
+                    tick = gens_since + 1
+                    refit_now = (
+                        (tick >= refit_every_s)
+                        | (drift > drift_thr)
+                        # forced: a model with accepted particles but no
+                        # usable fit (first chunk after the in-kernel
+                        # prior generation, model revival) cannot wait
+                        | jnp.any(~fitted & (counts > 0))
+                        | ~jnp.any(fitted)
+                    ) & ~stopped
+
+                    def _skip_refit(_):
+                        # stale params carried forward verbatim; a model
+                        # that died this generation still unfits (same
+                        # rule the refit branch applies)
+                        return (trans_params, fitted & (counts > 0),
+                                jnp.zeros((), jnp.int32))
+
+                    trans_next, fitted_next, rows_changed = jax.lax.cond(
+                        refit_now, _refit_models, _skip_refit, None
+                    )
+                    gens_since_next = jnp.where(
+                        refit_now, 0, tick).astype(jnp.int32)
                 log_model_probs_next = jnp.where(
                     model_probs_next > 0,
                     jnp.log(jnp.maximum(model_probs_next, 1e-38)), -jnp.inf,
@@ -1077,6 +1165,14 @@ class DeviceContext:
                     "model_probs": model_probs_next,
                     **temp_extra,
                 }
+                if refit_cadence is not None:
+                    # refit events + drift + incremental-factorization
+                    # occupancy ship with every generation: the host
+                    # mirrors them into metrics/telemetry so the
+                    # amortization is measured, not assumed
+                    out["refit"] = refit_now
+                    out["drift"] = drift
+                    out["rows_changed"] = rows_changed
                 if adaptive_n is not None:
                     # in-kernel AdaptivePopulationSize: the bootstrap-CV
                     # bisection runs on the JUST-REFIT kernels — exactly
@@ -1131,12 +1227,14 @@ class DeviceContext:
                     )
                     out["n_target"] = n_target
                     out["n_next"] = n_next
-                    return (trans_next, log_model_probs_next, fitted_next,
-                            dist_w_next, eps_next, acc_state_next,
-                            stopped_next, n_next), out
-                return (trans_next, log_model_probs_next, fitted_next,
-                        dist_w_next, eps_next, acc_state_next,
-                        stopped_next), out
+                new_carry = [trans_next, log_model_probs_next, fitted_next,
+                             dist_w_next, eps_next, acc_state_next,
+                             stopped_next]
+                if adaptive_n is not None:
+                    new_carry.append(n_next)
+                if refit_cadence is not None:
+                    new_carry.append(gens_since_next)
+                return tuple(new_carry), out
 
             calib_info = None
             if fused_calibration is not None:

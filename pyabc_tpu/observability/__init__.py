@@ -28,7 +28,13 @@ compiled device code is never touched, so fused kernels are
 byte-identical with observability on or off.
 """
 from .clock import Clock, SystemClock, VirtualClock, SYSTEM_CLOCK
-from .coverage import coverage_report, interval_union, window_throughput
+from .coverage import (
+    coverage_report,
+    device_busy_spans,
+    interval_intersection,
+    interval_union,
+    window_throughput,
+)
 from .export import JsonlTraceExporter, prometheus_text, read_trace
 from .metrics import (
     Counter,
@@ -55,7 +61,8 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "NullMetrics",
     "NULL_METRICS",
     "JsonlTraceExporter", "prometheus_text", "read_trace",
-    "coverage_report", "interval_union", "window_throughput",
+    "coverage_report", "device_busy_spans", "interval_intersection",
+    "interval_union", "window_throughput",
     "SyncLedger", "NullSyncLedger", "NULL_SYNC_LEDGER",
     "DEFAULT_SYNC_FLOOR_S",
     "default_tracer", "global_metrics", "global_tracer",

@@ -108,6 +108,57 @@ def coverage_report(spans, t0: float | None = None,
     }
 
 
+def interval_intersection(ivs_a, ivs_b) -> float:
+    """Total length of the intersection of two interval sets (each an
+    iterable of (start, end)). Used to decompose a span set against the
+    device-busy pseudo-thread: e.g. fetch-wait seconds that overlap
+    device compute vs the exposed tunnel wait."""
+    a = sorted((float(x), float(y)) for x, y in ivs_a if y > x)
+    b = sorted((float(x), float(y)) for x, y in ivs_b if y > x)
+    i = j = 0
+    total = 0.0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            total += hi - lo
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def device_busy_spans(probe_events, thread: str = "device") -> list[dict]:
+    """Derive a measured DEVICE-BUSY span stream from consecutive
+    ``compute_probe`` completions (ROADMAP "device-busy correlation"
+    item): ``probe_events`` is the orchestrator's list of
+    ``(dispatch_return_ts, device_done_ts)`` pairs, one per fused chunk,
+    on the tracer's clock. The device executes chunks in dispatch order,
+    so chunk k's compute occupies ``[max(done_{k-1}, dispatch_k),
+    done_k]`` — an UPPER bound (each probe itself pays one pipelined
+    tunnel round trip, so short chunks read as floor-length).
+
+    Returns span DICTS (the ``Span.to_dict`` shape) on a pseudo-thread,
+    ready to append to a trace before :func:`coverage_report` — the
+    accountant then separates "device computing" from "host waiting on
+    tunnel" inside chunk-fetch waits via ``per_thread`` and
+    :func:`interval_intersection`.
+    """
+    spans = []
+    prev_done = None
+    for disp, done in sorted(probe_events, key=lambda p: p[1]):
+        start = disp if prev_done is None else max(prev_done, disp)
+        if done > start:
+            spans.append({
+                "name": "device.busy", "span_id": None, "parent_id": None,
+                "thread": thread, "start": float(start),
+                "end": float(done), "attrs": {"derived": "compute_probe"},
+            })
+        prev_done = done
+    return spans
+
+
 def window_throughput(events, t0: float, t_end: float,
                       window_s: float) -> dict:
     """Strict global-completion-clock throughput over ``[t0, t_end]``.

@@ -1,3 +1,3 @@
-from . import pack, stats
+from . import pack, select, stats
 
-__all__ = ["pack", "stats"]
+__all__ = ["pack", "select", "stats"]

@@ -1,0 +1,199 @@
+"""Threshold-based neighbor selection — the sub-sort-complexity
+replacement for ``lax.top_k`` at large k (ISSUE 3 tentpole #2).
+
+Motivation (BASELINE.md rounds 5-6, SURVEY §7.3.4): the LocalTransition
+in-kernel refit ran ``lax.top_k(-sq, k)`` per row block with
+``k = k_fraction * n`` — at pop 16384 / k 4096 that is close to a full
+row sort EVERY generation, and XLA's sort lowers to serial-ish vector
+code on TPU while the rest of the refit is MXU matmuls. A kth-nearest-
+neighbor set, however, is fully described by a per-row RADIUS: the
+smallest r with ``|{j : d_ij <= r}| >= k``. Radius search needs only
+comparisons and sums (VPU-friendly, no data-dependent permutation):
+
+1. :func:`radius_bisect` — fixed-iteration bisection on r per row over
+   a (rows, n) squared-distance tile. Monotone counts make every
+   iteration a masked sum; the returned upper bound always satisfies
+   the count constraint. A static ``stride`` bisects on a strided
+   candidate subsample (count target scaled accordingly) so the
+   O(iters * rows * n) count work shrinks by the stride — the radius
+   picks up a small sampling error (documented below).
+2. :func:`compact_within_radius` — the masked gather: candidates with
+   ``sq <= r`` compact left into a static ``(rows, k_cap)`` index
+   buffer via a per-row cumsum rank (no sort), plus the per-row count.
+
+Documented deviations from exact top-k (all bounded, tested in
+``tests/test_select.py``; exact parity holds below the top_k-fallback
+cutoff where the caller keeps the sort):
+
+- **ties / bisection resolution**: candidates exactly at the radius are
+  all included (top_k breaks ties by index); with ``n_iters`` ~ the f32
+  mantissa width the radius is tight to ~1 ulp, so this only differs on
+  genuinely duplicated distances.
+- **candidate stride** (``stride > 1``): the whole selection — radius
+  bisection, mask, compaction, returned indices — runs on a
+  ``[::stride]`` candidate subsample with count target
+  ``ceil(k / stride)``: the downstream covariance becomes an unbiased
+  mean over ~k/stride uniformly-subsampled within-radius neighbors
+  (relative second-moment noise ~``sqrt(stride / k)``, ~6% at k 4096 /
+  stride 4). The caller divides by the REALIZED count, so only the
+  neighborhood sample, not the estimator's normalization, is perturbed
+  — and every post-distance cost scales by 1/stride.
+- **capacity clip**: at most ``k_cap`` indices are kept (lowest
+  candidate index first); rows whose realized count exceeds the static
+  buffer lose the tail — the same truncation top_k's static k applies.
+
+Also here: :func:`apply_rowwise_blocked`, the dynamic-occupancy blocked
+map used by the incremental Cholesky path (tentpole #3) — run an
+expensive per-row function ONLY over rows flagged changed, in fixed-size
+blocks with a data-dependent trip count, scattering results into the
+carried previous outputs.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+#: below this static k bound, ``lax.top_k`` is cheap and EXACT — callers
+#: (LocalTransition.device_fit selection="auto") keep the sort there
+DEFAULT_TOPK_CUTOFF = 1024
+#: bisection iterations: ~f32 mantissa width, so the radius is resolved
+#: to ~1 ulp of the distance scale
+DEFAULT_BISECT_ITERS = 26
+
+
+def default_stride(n: int) -> int:
+    """Candidate-subsample stride for the bisection count sums: 1 (exact
+    counts) up to moderate n, 4 beyond — the count noise is ~sqrt(stride/k)
+    relative, negligible exactly where k is large."""
+    return 4 if n >= 8192 else 1
+
+
+def radius_bisect(sq, k, *, n_iters: int = DEFAULT_BISECT_ITERS):
+    """Per-row neighbor radius via fixed-iteration bisection.
+
+    ``sq``: (rows, n) squared distances; excluded candidates carry +inf.
+    ``k``: scalar (traced ok) target neighbor count per row.
+
+    Returns ``r`` (rows,) such that ``count(sq <= r) >= k``: the
+    bisection keeps the count-feasible upper bound, whose initial value
+    (the row max over finite entries) is always feasible when the row
+    has >= k finite candidates — the caller's k rule guarantees that.
+    """
+    sub = sq
+    k_t = jnp.asarray(k, sq.dtype)
+    finite = jnp.isfinite(sub)
+    hi0 = jnp.max(jnp.where(finite, sub, -jnp.inf), axis=1)
+    hi0 = jnp.where(jnp.isfinite(hi0), hi0, 0.0)
+    lo0 = jnp.zeros_like(hi0)
+
+    def body(_, lohi):
+        lo, hi = lohi
+        mid = 0.5 * (lo + hi)
+        cnt = jnp.sum(sub <= mid[:, None], axis=1).astype(sq.dtype)
+        ok = cnt >= k_t
+        return (jnp.where(ok, lo, mid), jnp.where(ok, mid, hi))
+
+    _, r = jax.lax.fori_loop(0, n_iters, body, (lo0, hi0))
+    return r
+
+
+def compact_within_radius(sq, r, k_cap: int):
+    """The masked gather: left-compact candidate indices with
+    ``sq <= r`` into a static ``(rows, k_cap)`` buffer, in candidate
+    order, via a per-row cumsum rank (no sort, no top_k).
+
+    Returns ``(idx, cnt)``: ``idx[i, p]`` is the p-th selected candidate
+    of row i (0-filled past ``cnt[i]`` — callers mask positions with
+    ``arange(k_cap) < cnt``), ``cnt`` the per-row selected count CLIPPED
+    to the buffer capacity.
+    """
+    rows, n = sq.shape
+    mask = sq <= r[:, None]
+    rank = jnp.cumsum(mask.astype(jnp.int32), axis=1) - 1
+    pos = jnp.where(mask & (rank < k_cap), rank, k_cap)
+    idx = jnp.zeros((rows, k_cap), jnp.int32).at[
+        jnp.arange(rows, dtype=jnp.int32)[:, None], pos
+    ].set(jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[None, :],
+                           (rows, n)), mode="drop")
+    cnt = jnp.minimum(jnp.sum(mask, axis=1), k_cap)
+    return idx, cnt
+
+
+def threshold_neighbors(sq, k, k_cap: int, *,
+                        n_iters: int = DEFAULT_BISECT_ITERS,
+                        stride: int = 1):
+    """Bisection + masked gather in one call: the drop-in replacement for
+    ``lax.top_k(-sq, k_cap)`` on a (rows, n) distance tile.
+
+    With ``stride > 1`` the WHOLE selection — bisection counts, mask,
+    rank compaction, returned indices — runs on the ``[::stride]``
+    candidate subsample with the count target ``ceil(k / stride)`` and a
+    ``ceil(k_cap / stride)`` buffer: the caller's covariance then
+    averages over a uniform subsample of the within-radius neighborhood
+    (an unbiased second-moment estimate from ~k/stride points; the
+    documented large-k tolerance). Every post-distance cost scales by
+    1/stride. Returns ``(idx, cnt, r)`` with ``idx`` mapped back to
+    full-resolution candidate indices."""
+    if stride > 1:
+        sub = sq[:, ::stride]
+        k_sub = (k + stride - 1) // stride
+        k_cap_sub = -(-k_cap // stride)
+    else:
+        sub = sq
+        k_sub = k
+        k_cap_sub = k_cap
+    r = radius_bisect(sub, k_sub, n_iters=n_iters)
+    idx, cnt = compact_within_radius(sub, r, k_cap_sub)
+    if stride > 1:
+        idx = idx * stride
+    return idx, cnt, r
+
+
+def apply_rowwise_blocked(fn, changed, prev_outs, *row_inputs,
+                          block: int = 1024):
+    """Run ``fn`` only over rows flagged ``changed``, in fixed-size
+    blocks with a DATA-DEPENDENT trip count (``lax.while_loop``), and
+    scatter its outputs over ``prev_outs`` — unchanged rows keep their
+    previous values and, crucially, their blocks never execute.
+
+    ``fn(*blocks) -> tuple_of_blocks``: takes each of ``row_inputs``
+    gathered to ``(block, ...)`` and returns a tuple matching
+    ``prev_outs`` leading dims ``(block, ...)``. ``changed`` is (n,)
+    bool. Returns ``(outs, n_changed)``.
+
+    This is the execution shape XLA cannot reach from a plain
+    ``jnp.where`` gate (both sides of a select are computed): the
+    while_loop body runs ``ceil(n_changed / block)`` times at runtime,
+    so a mostly-unchanged refit costs O(changed) rather than O(n).
+    """
+    n = changed.shape[0]
+    block = min(block, n)
+    rank = jnp.cumsum(changed.astype(jnp.int32)) - 1
+    pos = jnp.where(changed, rank, n)
+    buf = jnp.zeros((n,), jnp.int32).at[pos].set(
+        jnp.arange(n, dtype=jnp.int32), mode="drop"
+    )
+    n_changed = jnp.sum(changed.astype(jnp.int32))
+    n_blocks = (n_changed + block - 1) // block
+    outs0 = tuple(prev_outs)
+
+    def cond(state):
+        b = state[0]
+        return b < n_blocks
+
+    def body(state):
+        b, outs = state
+        start = b * block
+        ids = jax.lax.dynamic_slice(buf, (start,), (block,))
+        res = fn(*(x[ids] for x in row_inputs))
+        in_range = (start + jnp.arange(block, dtype=jnp.int32)) < n_changed
+        w = jnp.where(in_range, ids, n)
+        outs = tuple(
+            o.at[w].set(r, mode="drop") for o, r in zip(outs, res)
+        )
+        return (b + 1, outs)
+
+    _, outs = jax.lax.while_loop(
+        cond, body, (jnp.zeros((), jnp.int32), outs0)
+    )
+    return outs, n_changed

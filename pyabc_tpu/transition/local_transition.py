@@ -44,10 +44,25 @@ class LocalTransition(Transition):
         return dim + 1
 
     def __init__(self, k: int | None = None, k_fraction: float = 0.25,
-                 scaling: float = 1.0):
+                 scaling: float = 1.0, k_max: int | None = None,
+                 selection: str = "auto"):
         self.k = k
         self.k_fraction = float(k_fraction)
         self.scaling = float(scaling)
+        #: optional cap on the effective neighbor count — a DECLARED
+        #: deviation from the reference's pure ``k_fraction * n`` rule for
+        #: very large populations, where k grows into the thousands and
+        #: the extra neighbors stop changing the local covariance while
+        #: still paying O(n * k) per refit. None keeps exact parity.
+        self.k_max = int(k_max) if k_max is not None else None
+        #: in-kernel neighbor selection: "topk" (exact sort), "threshold"
+        #: (radius bisection + masked gather, ops/select.py), or "auto"
+        #: (threshold above ops.select.DEFAULT_TOPK_CUTOFF)
+        if selection not in ("auto", "topk", "threshold"):
+            raise ValueError(
+                f"selection must be auto/topk/threshold, got {selection!r}"
+            )
+        self.selection = str(selection)
         self._chols: np.ndarray | None = None
         self._precs: np.ndarray | None = None
         self._logdets: np.ndarray | None = None
@@ -57,6 +72,8 @@ class LocalTransition(Transition):
             k = self.k
         else:
             k = int(round(self.k_fraction * n))
+        if self.k_max is not None:
+            k = min(k, self.k_max)
         return int(np.clip(k, dim + 1, n))
 
     def fit(self, X: pd.DataFrame, w: np.ndarray) -> None:
@@ -124,11 +141,23 @@ class LocalTransition(Transition):
         }
 
     @staticmethod
-    def device_fit(thetas, weights, *, dim: int, scaling: float,
-                   k: int | None = None, k_cap: int | None = None,
-                   k_fixed: int = -1, k_fraction: float = 0.25,
-                   block_rows: int | None = None):
-        """Traceable twin of :meth:`fit` for the fused multi-generation run.
+    def _device_cov_field(thetas, weights, *, dim: int, scaling: float,
+                          k: int | None = None, k_cap: int | None = None,
+                          k_fixed: int = -1, k_fraction: float = 0.25,
+                          k_max: int | None = None,
+                          block_rows: int | None = None,
+                          selection: str = "auto",
+                          topk_cutoff: int | None = None,
+                          bisect_stride: int | None = None):
+        """The selection + covariance half of :meth:`device_fit`: per-row
+        jittered covariances (padded dims unit-diagonal, ready for
+        factorization) from either exact ``top_k`` neighbor sets or the
+        threshold (radius-bisection) selection of ``ops/select.py``.
+
+        Returns ``(covs, X, w, vmask, outer)``. See :meth:`device_fit`
+        for the contract; the split exists so the incremental refit
+        (:meth:`device_fit_update`) can reuse the covariance field and
+        factorize only changed rows.
 
         ``thetas (n_cap, d_max)`` zero-padded accepted particles,
         ``weights (n_cap,)`` normalized with zeros on empty slots. Neighbor
@@ -156,6 +185,8 @@ class LocalTransition(Transition):
         peak memory O(block * n) instead of O(n^2 * d) — and only the
         (n, k_cap) neighbor indices are kept.
         """
+        from ..ops import select as sel_ops
+
         n_cap, d_max = thetas.shape
         if k is not None:
             k_cap, k_fixed = int(k), int(k)
@@ -172,6 +203,8 @@ class LocalTransition(Transition):
         counts = np.arange(n_cap + 1)
         base = (np.full(n_cap + 1, k_fixed) if k_fixed > 0
                 else np.round(k_fraction * counts))
+        if k_max is not None:
+            base = np.minimum(base, k_max)
         k_table = np.clip(
             base, dim + 1, np.maximum(counts, dim + 1)
         ).astype(np.int32)
@@ -180,18 +213,27 @@ class LocalTransition(Transition):
         factor = silverman_rule_of_thumb(
             k_dyn.astype(thetas.dtype), dim
         ) * scaling
+        cutoff = (sel_ops.DEFAULT_TOPK_CUTOFF if topk_cutoff is None
+                  else int(topk_cutoff))
+        if selection == "auto":
+            selection = "threshold" if k_cap >= cutoff else "topk"
+        stride = (sel_ops.default_stride(n_cap) if bisect_stride is None
+                  else int(bisect_stride))
 
-        def _covs_from_idx(rows_X, nn_idx_t):
-            """Per-row covariances -> (chol, prec, logdet) for a block of
-            rows given their neighbor indices (into the FULL X)."""
-            # dynamic-k mask: positions beyond k_dyn and invalid
-            # candidates (possible when a model's count is below k_cap)
-            # contribute nothing
-            pos_ok = (jnp.arange(k_cap)[None, :] < k_dyn) & valid[nn_idx_t]
+        def _covs_from_idx(rows_X, nn_idx_t, cnt_t):
+            """Per-row jittered covariances for a block of rows given
+            their neighbor indices (into the FULL X) and the per-row
+            used neighbor count ``cnt_t``."""
+            # dynamic-count mask: positions beyond the row's count and
+            # invalid candidates (possible when a model's count is below
+            # k_cap) contribute nothing; the buffer width is k_cap for
+            # top_k and ceil(k_cap / stride) for threshold selection
+            pos_ok = (jnp.arange(nn_idx_t.shape[1])[None, :]
+                      < cnt_t[:, None]) & valid[nn_idx_t]
             neigh = X[nn_idx_t]  # (rows, k_cap, d_max)
             centered = (neigh - rows_X[:, None, :]) * pos_ok[..., None]
             cov = jnp.einsum("nkd,nke->nde", centered, centered) \
-                / jnp.maximum(k_dyn, 1)
+                / jnp.maximum(cnt_t, 1)[:, None, None]
             cov = cov * factor**2
             # host regularization: relative jitter on the REAL diagonal;
             # padded dims get a unit diagonal so the factorization is
@@ -200,15 +242,26 @@ class LocalTransition(Transition):
             tr = jnp.trace(cov, axis1=1, axis2=2) / dim
             jit = jnp.maximum(tr, 1e-10) * LocalTransition.EPS
             diag_add = jit[:, None] * vmask[None, :] + (1.0 - vmask)[None, :]
-            cov = cov * outer[None] + jax.vmap(jnp.diag)(diag_add)
-            chols_t = jnp.linalg.cholesky(cov)
-            precs_t = jnp.linalg.inv(cov) * outer[None]
-            logdets_t = 2.0 * jnp.sum(
-                vmask[None, :] * jnp.log(jnp.maximum(
-                    jnp.diagonal(chols_t, axis1=1, axis2=2), 1e-38)),
-                axis=1,
-            )
-            return chols_t * outer[None], precs_t, logdets_t
+            return cov * outer[None] + jax.vmap(jnp.diag)(diag_add)
+
+        def _select_tile(sqt, rows_X):
+            """Neighbor selection on one (rows, n) distance tile ->
+            jittered covariances. "topk": exact sort (host parity).
+            "threshold": radius bisection + masked gather — no sort; the
+            estimator divides by the REALIZED within-radius count."""
+            if selection == "threshold":
+                # the realized count can deviate from k_dyn by the
+                # documented few percent (radius resolution, candidate
+                # stride); the estimator divides by the realized count,
+                # and the diagonal jitter keeps even a degenerate row
+                # factorizable
+                idx_t, cnt_t, _r = sel_ops.threshold_neighbors(
+                    sqt, k_dyn, k_cap, stride=stride,
+                )
+                return _covs_from_idx(rows_X, idx_t, cnt_t)
+            idx_t = jax.lax.top_k(-sqt, k_cap)[1]
+            cnt_t = jnp.broadcast_to(k_dyn, (sqt.shape[0],))
+            return _covs_from_idx(rows_X, idx_t, cnt_t)
 
         if block_rows is None:
             if n_cap <= 4096:
@@ -228,9 +281,7 @@ class LocalTransition(Transition):
             diff = X[:, None, :] - X[None, :, :]
             sq = (diff * diff).sum(-1)
             sq = jnp.where(valid[None, :], sq, jnp.inf)
-            # k_cap smallest, self included
-            _, nn_idx = jax.lax.top_k(-sq, k_cap)
-            chols, precs, logdets = _covs_from_idx(X, nn_idx)
+            covs = _select_tile(sq, X)
         else:
             if n_cap % block_rows:
                 raise ValueError(
@@ -245,17 +296,50 @@ class LocalTransition(Transition):
                 # near-duplicate points; clamping keeps self-distance 0
                 sqt = jnp.maximum(sqt, 0.0)
                 sqt = jnp.where(valid[None, :], sqt, jnp.inf)
-                idx_t = jax.lax.top_k(-sqt, k_cap)[1]
-                return _covs_from_idx(Xt, idx_t)
+                return _select_tile(sqt, Xt)
 
-            chols, precs, logdets = jax.lax.map(
+            covs = jax.lax.map(
                 _tile,
                 (X.reshape(-1, block_rows, d_max),
                  norms.reshape(-1, block_rows)),
-            )
-            chols = chols.reshape(n_cap, d_max, d_max)
-            precs = precs.reshape(n_cap, d_max, d_max)
-            logdets = logdets.reshape(n_cap)
+            ).reshape(n_cap, d_max, d_max)
+        return covs, X, w, vmask, outer
+
+    @staticmethod
+    def _device_factorize(cov, vmask, outer):
+        """(chols, precs, logdets) from a batch of jittered covariances —
+        the factorization half of the refit, split out so the incremental
+        path can run it on changed rows only."""
+        chols = jnp.linalg.cholesky(cov)
+        precs = jnp.linalg.inv(cov) * outer[None]
+        logdets = 2.0 * jnp.sum(
+            vmask[None, :] * jnp.log(jnp.maximum(
+                jnp.diagonal(chols, axis1=1, axis2=2), 1e-38)),
+            axis=1,
+        )
+        return chols * outer[None], precs, logdets
+
+    @staticmethod
+    def device_fit(thetas, weights, *, dim: int, scaling: float,
+                   k: int | None = None, k_cap: int | None = None,
+                   k_fixed: int = -1, k_fraction: float = 0.25,
+                   k_max: int | None = None,
+                   block_rows: int | None = None,
+                   selection: str = "auto",
+                   topk_cutoff: int | None = None,
+                   bisect_stride: int | None = None):
+        """Traceable twin of :meth:`fit` for the fused multi-generation
+        run — full documentation on :meth:`_device_cov_field` (selection
+        + covariances) and :meth:`_device_factorize`."""
+        covs, X, w, vmask, outer = LocalTransition._device_cov_field(
+            thetas, weights, dim=dim, scaling=scaling, k=k, k_cap=k_cap,
+            k_fixed=k_fixed, k_fraction=k_fraction, k_max=k_max,
+            block_rows=block_rows, selection=selection,
+            topk_cutoff=topk_cutoff, bisect_stride=bisect_stride,
+        )
+        chols, precs, logdets = LocalTransition._device_factorize(
+            covs, vmask, outer
+        )
         return {
             "thetas": X,
             "weights": w,
@@ -264,6 +348,79 @@ class LocalTransition(Transition):
             "logdets": logdets,
             "dim": jnp.float32(dim),
         }
+
+    #: incremental-refit row-reuse tolerance: a row whose recomputed
+    #: covariance differs from the carried factors' cov by less than
+    #: this (relative to the row's covariance scale) keeps its previous
+    #: Cholesky/precision/logdet — well above the ~1e-7 f32
+    #: reconstruction noise of chol @ chol.T, well below any change a
+    #: moved particle or neighbor produces
+    REUSE_RTOL = 1e-5
+
+    @staticmethod
+    def device_fit_update(thetas, weights, prev: dict, *, dim: int,
+                          scaling: float, k: int | None = None,
+                          k_cap: int | None = None, k_fixed: int = -1,
+                          k_fraction: float = 0.25,
+                          k_max: int | None = None,
+                          block_rows: int | None = None,
+                          selection: str = "auto",
+                          topk_cutoff: int | None = None,
+                          bisect_stride: int | None = None,
+                          fact_block: int = 1024):
+        """Incremental refit (tentpole #3): recompute the covariance
+        field, then factorize ONLY rows whose covariance actually
+        changed vs the carried ``prev`` params — the changed-row mask
+        compares each new covariance against ``chol_prev @ chol_prev.T``
+        (a strictly sharper criterion than comparing neighbor index
+        sets: an unchanged neighbor set with unchanged thetas gives an
+        identical covariance, and a changed set that lands on the same
+        covariance needs no new factors either). Unchanged rows copy the
+        previous factors; changed rows run through
+        :func:`~pyabc_tpu.ops.select.apply_rowwise_blocked`, whose
+        while-loop trip count is the runtime ``ceil(n_changed / block)``
+        — a mostly-unchanged population refit pays O(changed), not O(n),
+        in Cholesky/inverse work.
+
+        Returns ``(params, n_changed)``.
+        """
+        covs, X, w, vmask, outer = LocalTransition._device_cov_field(
+            thetas, weights, dim=dim, scaling=scaling, k=k, k_cap=k_cap,
+            k_fixed=k_fixed, k_fraction=k_fraction, k_max=k_max,
+            block_rows=block_rows, selection=selection,
+            topk_cutoff=topk_cutoff, bisect_stride=bisect_stride,
+        )
+        prev_ch = prev["chols"]
+        cov_old = jnp.einsum("nij,nkj->nik", prev_ch, prev_ch)
+        # stored chols are outer-masked (padded dims zero), so compare on
+        # the valid block only; scale by the row's real-diagonal trace
+        diff = jnp.abs((covs - cov_old) * outer[None]).max(axis=(1, 2))
+        scale = jnp.maximum(
+            jnp.sum(jnp.diagonal(covs, axis1=1, axis2=2)
+                    * vmask[None, :], axis=1) / dim,
+            1e-30,
+        )
+        changed = diff > LocalTransition.REUSE_RTOL * scale
+
+        from ..ops.select import apply_rowwise_blocked
+
+        def _fact(cov_b):
+            return LocalTransition._device_factorize(cov_b, vmask, outer)
+
+        (chols, precs, logdets), n_changed = apply_rowwise_blocked(
+            _fact, changed,
+            (prev["chols"], prev["precs"], prev["logdets"]),
+            covs, block=fact_block,
+        )
+        params = {
+            "thetas": X,
+            "weights": w,
+            "chols": chols,
+            "precs": precs,
+            "logdets": logdets,
+            "dim": jnp.float32(dim),
+        }
+        return params, n_changed
 
     @staticmethod
     def device_rvs(key, params):

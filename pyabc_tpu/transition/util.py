@@ -47,6 +47,47 @@ def silverman_rule_of_thumb(n_samples: float, dimension: int) -> float:
     )
 
 
+def device_proposal_drift(fit_thetas, fit_w, new_thetas, new_w, vmask):
+    """Traceable acceptance-weighted drift of a population vs the fitted
+    proposal (the refit-cadence guard statistic, ISSUE 3 tentpole #1).
+
+    Compares the weighted per-dimension mean and variance of the NEW
+    accepted population against those of the population the carried
+    proposal was FITTED on (both live inside the carried transition
+    params as ``thetas`` / ``weights``):
+
+    - mean shift, standardized by the fitted std;
+    - relative variance change.
+
+    Returns the max over valid dimensions of both terms — ``0`` means
+    the accepted population still looks like the one the proposal was
+    fitted on (sampling from the stale fit stays efficient); large
+    values mean the population moved and the local covariances are
+    stale. A zero-mass side (never-fitted placeholder, empty model)
+    returns 0 — the kernel's forced-refit conditions own those cases.
+    O(n * d): cheap enough to run EVERY generation so non-refit
+    generations still measure how stale they are.
+    """
+    import jax.numpy as jnp
+
+    sf = jnp.sum(fit_w)
+    sn = jnp.sum(new_w)
+    wf = fit_w / jnp.maximum(sf, 1e-38)
+    wn = new_w / jnp.maximum(sn, 1e-38)
+    mu_f = wf @ fit_thetas
+    mu_n = wn @ new_thetas
+    var_f = jnp.maximum(wf @ (fit_thetas**2) - mu_f**2, 0.0)
+    var_n = jnp.maximum(wn @ (new_thetas**2) - mu_n**2, 0.0)
+    # absolute floor + a relative one: a near-point-mass fitted dimension
+    # must not turn a tiny absolute shift into an infinite drift
+    denom = var_f + 1e-12 + 1e-8 * mu_f**2
+    mean_shift = jnp.abs(mu_n - mu_f) / jnp.sqrt(denom)
+    var_shift = jnp.abs(var_n - var_f) / denom
+    per_dim = jnp.maximum(mean_shift, var_shift) * vmask
+    drift = jnp.max(per_dim)
+    return jnp.where((sf > 0) & (sn > 0), drift, 0.0)
+
+
 def device_mean_cv(trans_cls, params, key, n, *, dim: int,
                    n_bootstrap: int, **fit_kwargs):
     """Traceable twin of :meth:`Transition.mean_cv` for ANY transition

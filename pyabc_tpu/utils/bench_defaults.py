@@ -29,3 +29,10 @@ DEFAULT_BUDGET_S = 300.0
 # periods, so one congested window can't dominate and clustered
 # completions average out)
 DEFAULT_WINDOW_S = 2.0
+# scale lane (round 7): the r5 measured scale gap was pop-16384 fused LV
+# with LocalTransition(k_fraction=0.25) at 800-4000 pps; the lane
+# reproduces exactly that statistical config. 12 generations = one
+# 16k-row calibration + enough post-fill chunks for a span basis while
+# leaving most of the lane budget to steady generations.
+DEFAULT_SCALE_POP = 16384
+DEFAULT_SCALE_GENS = 12

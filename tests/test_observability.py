@@ -459,3 +459,56 @@ def test_fused_run_records_chunk_fetch_syncs():
     s = abc.sync_ledger.summary(0.102)
     assert s["syncs"] == abc.sync_ledger.count
     assert s["tunnel_floor_s"] == pytest.approx(s["syncs"] * 0.102)
+
+
+def test_interval_intersection():
+    from pyabc_tpu.observability import interval_intersection
+
+    a = [(0.0, 2.0), (3.0, 5.0)]
+    b = [(1.0, 4.0)]
+    assert interval_intersection(a, b) == pytest.approx(2.0)
+    assert interval_intersection(a, []) == 0.0
+    assert interval_intersection([(0, 1)], [(2, 3)]) == 0.0
+    # identical sets intersect to their union length
+    assert interval_intersection(a, a) == pytest.approx(4.0)
+
+
+def test_device_busy_spans_from_probe_events():
+    """The device-busy pseudo-thread (ROADMAP device-busy correlation):
+    consecutive compute-probe completions become device.busy spans —
+    chunk k's compute runs from max(done_{k-1}, dispatch_k) to done_k —
+    and feed the SAME coverage accountant on a synthetic thread."""
+    from pyabc_tpu.observability import coverage_report, device_busy_spans
+
+    # (dispatch_ts, done_ts): chunk 1 dispatched at 0 done at 2; chunk 2
+    # dispatched at 0.5 (while 1 runs) done at 3.5; chunk 3 dispatched
+    # at 5 (idle gap) done at 6
+    probes = [(0.0, 2.0), (0.5, 3.5), (5.0, 6.0)]
+    spans = device_busy_spans(probes)
+    ivs = [(s["start"], s["end"]) for s in spans]
+    assert ivs == [(0.0, 2.0), (2.0, 3.5), (5.0, 6.0)]
+    assert all(s["thread"] == "device" and s["name"] == "device.busy"
+               for s in spans)
+    rep = coverage_report(spans, 0.0, 6.0)
+    per = rep["per_thread"]["device"]
+    # busy 0..3.5 and 5..6 of a 6s window
+    assert per["attributed_frac"] == pytest.approx(4.5 / 6.0)
+
+
+def test_device_busy_separates_fetch_wait_from_tunnel():
+    """Inside a chunk-fetch wait, the accountant can now separate
+    "device still computing" from "host waiting on the tunnel" — the
+    fetch span intersected with the device.busy pseudo-spans."""
+    from pyabc_tpu.observability import (
+        device_busy_spans,
+        interval_intersection,
+    )
+
+    # device busy 0..3; the host's fetch span waits 2..5 — 1s of that
+    # wait overlaps device compute, 2s is exposed tunnel wait
+    busy = device_busy_spans([(0.0, 3.0)])
+    fetch_ivs = [(2.0, 5.0)]
+    busy_ivs = [(s["start"], s["end"]) for s in busy]
+    overlap = interval_intersection(fetch_ivs, busy_ivs)
+    assert overlap == pytest.approx(1.0)
+    assert (5.0 - 2.0) - overlap == pytest.approx(2.0)

@@ -1,0 +1,215 @@
+"""Refit-cadence proposal engine (ISSUE 3 tentpole): drift guard,
+amortized refits, posterior parity, observability wiring.
+
+Statistical backdrop: sampling generation t+1 from a STALE LocalTransition
+fit is exact — importance weights always use the proposal params actually
+sampled from — so cadence trades only proposal freshness (acceptance
+rate), never correctness. These tests pin that: the posterior must hold
+even when refits are withheld entirely, and the drift guard must restore
+refits exactly when the population moves.
+"""
+import jax
+import numpy as np
+import pytest
+
+import pyabc_tpu as pt
+from pyabc_tpu.observability import MetricsRegistry, Tracer
+from pyabc_tpu.transition.util import device_proposal_drift
+
+PRIOR_SD = 1.0
+NOISE_SD = 0.5
+X_OBS = 1.0
+POST_VAR = 1.0 / (1 / PRIOR_SD**2 + 1 / NOISE_SD**2)
+POST_MU = POST_VAR * (X_OBS / NOISE_SD**2)
+
+
+def _gauss_model():
+    @pt.JaxModel.from_function(["theta"], name="gauss")
+    def model(key, theta):
+        return {"x": theta[0] + NOISE_SD * jax.random.normal(key)}
+
+    return model
+
+
+def _run(refit_every, thr, *, seed=11, eps=None, gens=6, pop=300,
+         metrics=None, tracer=None, distance=None):
+    prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+    abc = pt.ABCSMC(
+        _gauss_model(), prior,
+        distance if distance is not None
+        else (pt.PNormDistance(p=2) if eps is not None
+              else pt.AdaptivePNormDistance(p=2)),
+        population_size=pop,
+        eps=eps if eps is not None else pt.MedianEpsilon(),
+        seed=seed, fused_generations=8,
+        transitions=pt.LocalTransition(k_fraction=0.3),
+        refit_every=refit_every, refit_drift_threshold=thr,
+        metrics=metrics if metrics is not None else None,
+        tracer=tracer,
+    )
+    abc.new("sqlite://", {"x": X_OBS})
+    h = abc.run(max_nr_populations=gens)
+    df, w = h.get_distribution(0, h.max_t)
+    mu = float(np.sum(df["theta"] * w))
+    return abc, h, mu
+
+
+# ----------------------------------------------------- drift statistic
+def test_drift_zero_on_identical_population():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(rng.normal(size=(100, 3)), jnp.float32)
+    w = jnp.full((100,), 0.01, jnp.float32)
+    vmask = jnp.ones((3,), jnp.float32)
+    d = float(device_proposal_drift(X, w, X, w, vmask))
+    assert d == pytest.approx(0.0, abs=1e-4)
+
+
+def test_drift_detects_mean_and_var_shift():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(1)
+    X = jnp.asarray(rng.normal(size=(200, 2)), jnp.float32)
+    w = jnp.full((200,), 1.0 / 200, jnp.float32)
+    vmask = jnp.ones((2,), jnp.float32)
+    # one-std mean shift -> drift ~ 1
+    shifted = float(device_proposal_drift(X, w, X + 1.0, w, vmask))
+    assert shifted == pytest.approx(1.0, abs=0.15)
+    # variance halving -> |var_n - var_f| / var_f ~ 0.75
+    contracted = float(device_proposal_drift(X, w, X * 0.5, w, vmask))
+    assert contracted > 0.5
+    # padded dims never contribute
+    vmask0 = jnp.asarray([1.0, 0.0], jnp.float32)
+    X2 = X.at[:, 1].add(100.0)
+    assert float(device_proposal_drift(X, w, X2, w, vmask0)) \
+        == pytest.approx(0.0, abs=1e-4)
+
+
+def test_drift_zero_mass_returns_zero():
+    import jax.numpy as jnp
+
+    X = jnp.zeros((10, 2), jnp.float32)
+    w0 = jnp.zeros((10,), jnp.float32)
+    w1 = jnp.full((10,), 0.1, jnp.float32)
+    vmask = jnp.ones((2,), jnp.float32)
+    assert float(device_proposal_drift(X, w0, X, w1, vmask)) == 0.0
+    assert float(device_proposal_drift(X, w1, X, w0, vmask)) == 0.0
+
+
+# ------------------------------------------------------- cadence config
+def test_refit_cadence_cfg_rules():
+    prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+
+    def abc_with(**kw):
+        return pt.ABCSMC(_gauss_model(), prior, pt.PNormDistance(p=2),
+                         population_size=100, eps=pt.MedianEpsilon(), **kw)
+
+    local = abc_with(transitions=pt.LocalTransition())
+    # auto: off below the scale population, on at >= 16384
+    assert local._refit_cadence_cfg(8192) is None
+    assert local._refit_cadence_cfg(16384) == (16, 0.3)
+    # explicit cadence applies at any population
+    local2 = abc_with(transitions=pt.LocalTransition(), refit_every=4,
+                      refit_drift_threshold=0.7)
+    assert local2._refit_cadence_cfg(512) == (4, 0.7)
+    # refit_every=1 IS the pre-cadence program
+    local3 = abc_with(transitions=pt.LocalTransition(), refit_every=1)
+    assert local3._refit_cadence_cfg(16384) is None
+    # MVN never opts in (its refit is one weighted covariance)
+    mvn = abc_with(refit_every=4)
+    assert mvn._refit_cadence_cfg(16384) is None
+
+
+# ----------------------------------------------- cadence + drift guard
+def test_cadence_tick_refits_and_posterior_parity():
+    """refit_every=4 with the drift guard disabled: refits exactly at
+    the forced first generation and every 4th after, posterior parity
+    with the every-generation run."""
+    reg = MetricsRegistry()
+    abc, h, mu = _run(4, 1e9, metrics=reg)
+    assert h.n_populations == 6
+    flags = [r for (_t, r, _d, _c) in abc.refit_events]
+    assert flags == [True, False, False, False, True, False]
+    assert reg.snapshot()["pyabc_tpu_refits_total"] == 2.0
+    # drift is still MEASURED on every generation (histogram count == 6)
+    assert reg.snapshot()["pyabc_tpu_refit_drift"]["count"] == 6
+    _abc1, _h1, mu_every = _run(1, 1e9)
+    assert mu == pytest.approx(POST_MU, abs=0.3)
+    assert mu == pytest.approx(mu_every, abs=0.3)
+
+
+def test_no_refit_at_all_posterior_still_exact():
+    """The strongest parity statement: with refits withheld entirely
+    (beyond the forced first fit) the proposal is maximally stale, yet
+    the importance weights keep the posterior exact."""
+    abc, h, mu = _run(1000, 1e9)
+    assert h.n_populations == 6
+    flags = [r for (_t, r, _d, _c) in abc.refit_events]
+    assert flags[0] is True and not any(flags[1:])
+    assert mu == pytest.approx(POST_MU, abs=0.3)
+
+
+def test_drift_guard_fires_on_mid_chunk_shift():
+    """A sharp epsilon drop mid-chunk contracts the accepted population;
+    the drift statistic must cross the threshold EXACTLY there, trigger
+    a refit, and posterior parity must hold (the ISSUE acceptance
+    criterion)."""
+    eps = pt.ListEpsilon([2.0, 1.6, 1.4, 0.35, 0.3])
+    abc, h, mu = _run(1000, 0.6, eps=eps, gens=5)
+    assert h.n_populations == 5
+    events = abc.refit_events
+    assert len(events) == 5
+    # forced first fit, then quiet until the t=3 contraction
+    assert events[0][1] is True
+    assert events[1][1] is False and events[2][1] is False
+    t3 = events[3]
+    assert t3[1] is True and t3[2] > 0.6, events
+    # drift values below the threshold on the no-trigger generations
+    assert events[1][2] < 0.6 and events[2][2] < 0.6
+    assert mu == pytest.approx(POST_MU, abs=0.3)
+
+
+def test_refit_telemetry_and_metrics_visible():
+    """Refit count, drift statistic and refit spans are visible in the
+    observability metrics and History telemetry (ISSUE acceptance)."""
+    reg = MetricsRegistry()
+    tracer = Tracer()
+    abc, h, _mu = _run(4, 1e9, metrics=reg, tracer=tracer)
+    tel = h.get_telemetry(2)
+    assert tel["refit"] is False
+    assert "drift" in tel and tel["drift"] >= 0.0
+    assert tel["refit_rows_changed"] == 0
+    tel4 = h.get_telemetry(4)
+    assert tel4["refit"] is True and tel4["refit_rows_changed"] > 0
+    snap = reg.snapshot()
+    assert snap["pyabc_tpu_refits_total"] == 2.0
+    assert snap["pyabc_tpu_refit_rows_changed_total"] > 0
+    assert snap["pyabc_tpu_refit_drift"]["count"] == 6
+    # host-side mirror refits record "refit" WORK spans in the trace
+    names = {s.name for s in tracer.spans()}
+    assert "refit" in names
+
+
+def test_cadence_chunk_events_carry_refit_counts():
+    events = []
+    prior = pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD))
+    abc = pt.ABCSMC(_gauss_model(), prior, pt.AdaptivePNormDistance(p=2),
+                    population_size=300, eps=pt.MedianEpsilon(), seed=7,
+                    fused_generations=4,
+                    transitions=pt.LocalTransition(k_fraction=0.3),
+                    refit_every=4, refit_drift_threshold=1e9)
+    abc.chunk_event_cb = events.append
+    abc.new("sqlite://", {"x": X_OBS})
+    abc.run(max_nr_populations=6)
+    assert events and all("refits" in e for e in events if e["gens"])
+    assert sum(e.get("refits", 0) for e in events) == 2
+    assert any("drift_last" in e for e in events)
+
+
+def test_cadence_off_keeps_legacy_outputs():
+    """refit_every=1 (and every non-LocalTransition config): no refit
+    keys in telemetry, no refit events — the pre-cadence program."""
+    abc, h, _mu = _run(1, 1e9)
+    assert abc.refit_events == []
+    assert "refit" not in h.get_telemetry(2)
