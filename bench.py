@@ -376,6 +376,170 @@ def run_scale_lane(budget_s: float) -> dict:
     return out
 
 
+# -- elastic lane -------------------------------------------------------------
+
+#: orchestrator spans that BLANKET their whole window (a root or a
+#: sampling wait); excluded from attribution math, same rationale as the
+#: fused path's exclude_names=("run",) — a blanket span would report
+#: 100% attributed and hide every gap
+ELASTIC_BLANKET_SPANS = ("run", "setup", "generation", "sample",
+                        "broker.generation")
+
+
+def elastic_lane_skip_reason() -> str | None:
+    """The `elastic` lane measures worker-tracing ATTRIBUTION on the
+    broker path (round 8): host-model evaluations farmed to real worker
+    subprocesses, dark time decomposed into worker compute /
+    serialization / broker RTT / queue wait / orchestrator poll. It is
+    CPU-cheap (host model, no accelerator involved), so it runs on every
+    probe unless PYABC_TPU_BENCH_ELASTIC=0 disables it."""
+    if os.environ.get("PYABC_TPU_BENCH_ELASTIC") == "0":
+        return "disabled via PYABC_TPU_BENCH_ELASTIC=0"
+    return None
+
+
+def run_elastic_lane(budget_s: float) -> dict:
+    """Elastic-worker attribution lane: >=2 worker subprocesses against
+    the in-process broker, the PR-8 worker spans merged onto the bench
+    tracer via the per-worker clock-offset estimates, and each warm
+    run's wall clock decomposed by the elastic gap accountant with a
+    regression guard on the attributed fraction (>= 0.9)."""
+    import numpy as np
+
+    import pyabc_tpu as pt
+    from pyabc_tpu.observability import (
+        elastic_gap_attribution,
+        worker_trace_spans,
+        write_trace,
+    )
+    from pyabc_tpu.utils.bench_defaults import (
+        DEFAULT_ELASTIC_GENS,
+        DEFAULT_ELASTIC_POP,
+        DEFAULT_ELASTIC_RUNS,
+        DEFAULT_ELASTIC_SIM_DELAY_S,
+        DEFAULT_ELASTIC_WORKERS,
+        ELASTIC_ATTRIBUTED_FRAC_MIN,
+    )
+
+    pop = int(os.environ.get("PYABC_TPU_BENCH_ELASTIC_POP",
+                             DEFAULT_ELASTIC_POP))
+    gens = int(os.environ.get("PYABC_TPU_BENCH_ELASTIC_GENS",
+                              DEFAULT_ELASTIC_GENS))
+    n_workers = int(os.environ.get("PYABC_TPU_BENCH_ELASTIC_WORKERS",
+                                   DEFAULT_ELASTIC_WORKERS))
+    delay_s = DEFAULT_ELASTIC_SIM_DELAY_S
+    t_lane0 = CLOCK.now()
+
+    def sim(pars):
+        import time as _t
+
+        _t.sleep(delay_s)  # worker compute made visible on the CPU probe
+        return {"x": pars["theta"] + 0.5 * np.random.normal()}
+
+    sampler = pt.ElasticSampler(host="127.0.0.1", port=0, batch=10,
+                                generation_timeout=120.0)
+    port = sampler.address[1]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = HERE + os.pathsep + env.get("PYTHONPATH", "")
+    worker_code = ("from pyabc_tpu.broker import run_worker; import sys; "
+                   "run_worker('127.0.0.1', int(sys.argv[1]))")
+    workers = [
+        subprocess.Popen([sys.executable, "-c", worker_code, str(port)],
+                         env=env, stdout=subprocess.DEVNULL,
+                         stderr=subprocess.DEVNULL)
+        for _ in range(n_workers)
+    ]
+    runs = []
+    try:
+        for i in range(DEFAULT_ELASTIC_RUNS):
+            if i > 0 and CLOCK.now() - t_lane0 > budget_s * 0.8:
+                break  # keep the lane inside its share
+            prior = pt.Distribution(theta=pt.RV("norm", 0.0, 1.0))
+            abc = pt.ABCSMC(
+                pt.SimpleModel(sim, name="gauss_elastic"), prior,
+                pt.PNormDistance(p=2), population_size=pop,
+                eps=pt.QuantileEpsilon(initial_epsilon=1.5, alpha=0.5),
+                sampler=sampler, seed=100 + i, tracer=TRACER,
+            )
+            abc.new("sqlite://", {"x": 1.0})
+            t0 = CLOCK.now()
+            h = abc.run(max_nr_populations=gens)
+            runs.append({"run": i, "t0": t0, "t1": CLOCK.now(),
+                         "generations": int(h.n_populations)})
+    finally:
+        for p in workers:
+            p.kill()
+        offsets = sampler.broker.worker_offsets()
+        sampler.stop()
+
+    sdicts = [sp.to_dict() for sp in TRACER.spans()]
+    work = [d for d in sdicts if d["name"] not in ELASTIC_BLANKET_SPANS]
+    per_run = []
+    # run 0 is warm-up (worker subprocess startup: jax/numpy imports
+    # dominate its window); runs >= 1 carry the regression guard
+    for r in runs:
+        rep = elastic_gap_attribution(work, r["t0"], r["t1"])
+        per_run.append({
+            "run": r["run"], "warm": r["run"] >= 1,
+            "window_s": rep["window_s"],
+            "steady_attributed_frac": rep["attributed_frac"],
+            "dark_s": rep["dark_s"],
+            "worker_compute_frac":
+                rep["categories"]["worker_compute"]["frac"],
+            "serialization_frac":
+                rep["categories"]["serialization"]["frac"],
+            "broker_rtt_frac": rep["categories"]["broker_rtt"]["frac"],
+            "queue_wait_frac": rep["categories"]["queue_wait"]["frac"],
+            "orchestrator_poll_frac":
+                rep["categories"]["orchestrator_poll"]["frac"],
+        })
+    warm = [r for r in per_run if r["warm"]]
+    # per-run worker trace JSONL export (merged spans, offset-mapped)
+    trace_path = os.path.join(HERE, ".elastic_worker_trace.jsonl")
+    try:
+        if os.path.exists(trace_path):
+            os.remove(trace_path)
+        n_exported = write_trace(trace_path, worker_trace_spans(sdicts))
+    except OSError:
+        trace_path, n_exported = None, 0
+    out = {
+        "metric": "elastic_steady_attributed_frac",
+        "n_workers": n_workers, "pop_size": pop,
+        "lane_s": round(CLOCK.now() - t_lane0, 2),
+        "per_run": per_run,
+        "gap_attribution": {
+            "basis": (
+                "elastic_gap_attribution over each run window: union of "
+                "offset-mapped worker phase spans (per-worker pseudo-"
+                "threads) + orchestrator work spans, blanket spans "
+                "excluded; categories overlap so fracs need not sum to 1"
+            ),
+            "blanket_spans_excluded": list(ELASTIC_BLANKET_SPANS),
+        },
+        "workers": {
+            "clock_offsets": offsets,
+            "merge_uncertainty_max_s": max(
+                (v["uncertainty_s"] for v in offsets.values()
+                 if v.get("uncertainty_s") is not None), default=None,
+            ),
+        },
+        "worker_trace_jsonl": {"path": trace_path, "n_spans": n_exported},
+    }
+    if warm:
+        vals = [r["steady_attributed_frac"] for r in warm]
+        out["value"] = min(vals)
+        out["regression_guard"] = {
+            "attributed_frac_min": ELASTIC_ATTRIBUTED_FRAC_MIN,
+            "warm_run_fracs": vals,
+            "pass_attributed": bool(
+                min(vals) >= ELASTIC_ATTRIBUTED_FRAC_MIN),
+        }
+    else:
+        out["value"] = 0.0
+        out["regression_guard"] = {"error": "no warm run completed"}
+    return out
+
+
 def main():
     from pyabc_tpu.utils.bench_defaults import (
         DEFAULT_BUDGET_S,
@@ -451,7 +615,10 @@ def main():
     reserve = max(12.0, 0.04 * budget)
     scale_skip = scale_lane_skip_reason(platform)
     scale_share = 0.0 if scale_skip else 0.35
-    spend_until = t_start + (budget - reserve) * (1.0 - scale_share)
+    elastic_skip = elastic_lane_skip_reason()
+    elastic_share = 0.0 if elastic_skip else 0.12
+    spend_until = t_start + (budget - reserve) * (
+        1.0 - scale_share - elastic_share)
     # per-run host setup (ABCSMC construction, History/sqlite DDL, kernel
     # adoption) runs on this thread OVERLAPPED with the previous run's
     # device chunks — round 5 measured it as dark inter-run wall clock
@@ -556,9 +723,22 @@ def main():
         _state["phase"] = "scale"
         try:
             _state["scale"] = run_scale_lane(
-                t_start + budget - reserve - CLOCK.now())
+                t_start + budget - reserve - CLOCK.now()
+                - (budget - reserve) * elastic_share)
         except Exception as e:
             _state["scale"] = {"error": repr(e)[:300]}
+
+    # -- elastic lane: worker-tracing attribution on the broker path
+    # (round 8; CPU-capable — or its recorded skip reason, never silent)
+    if elastic_skip:
+        _state["elastic"] = {"skipped": elastic_skip}
+    else:
+        _state["phase"] = "elastic"
+        try:
+            _state["elastic"] = run_elastic_lane(
+                max(t_start + budget - reserve - CLOCK.now(), 20.0))
+        except Exception as e:
+            _state["elastic"] = {"error": repr(e)[:300]}
 
     _state["budget_used_s"] = round(CLOCK.now() - t_start, 1)
     _state["pop_size"] = pop

@@ -45,10 +45,14 @@ def main():
         for _ in range(2)
     ]
     try:
+        # distributed tracing (round 8): workers piggyback their phase
+        # spans on result messages; the broker offset-maps them onto this
+        # tracer's timeline as worker:<id> pseudo-threads
+        tracer = pt.Tracer()
         abc = pt.ABCSMC(
             model, prior, pt.PNormDistance(p=2), population_size=POP,
             eps=pt.QuantileEpsilon(initial_epsilon=1.5, alpha=0.5),
-            sampler=sampler, seed=7,
+            sampler=sampler, seed=7, tracer=tracer,
         )
         abc.new("sqlite://", {"x": 1.0})
         history = abc.run(max_nr_populations=GENS)
@@ -56,6 +60,22 @@ def main():
         mu = float(np.sum(df["theta"] * w))
         print(f"posterior mean {mu:.3f} (conjugate exact 0.8)")
         assert abs(mu - 0.8) < 0.4
+        # elastic dark-time decomposition from the merged trace
+        spans = [sp.to_dict() for sp in tracer.spans()]
+        gens = [d for d in spans if d["name"] == "broker.generation"]
+        rep = pt.elastic_gap_attribution(
+            [d for d in spans if d["name"] not in
+             ("run", "setup", "generation", "sample",
+              "broker.generation")],
+            min(g["start"] for g in gens), max(g["end"] for g in gens),
+        )
+        print("attributed fraction:", rep["attributed_frac"])
+        for cat, v in rep["categories"].items():
+            print(f"  {cat}: {v['frac']:.3f}")
+        for wid, off in sampler.broker.worker_offsets().items():
+            print(f"  worker {wid}: clock offset "
+                  f"{off['offset_s'] * 1e3:.3f} ms "
+                  f"(±{off['uncertainty_s'] * 1e3:.3f} ms)")
         return history
     finally:
         sampler.stop()

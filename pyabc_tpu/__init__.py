@@ -101,6 +101,7 @@ from .predictor import (
     Predictor,
 )
 from .observability import (
+    ClockOffsetEstimator,
     JsonlTraceExporter,
     MetricsRegistry,
     NullTracer,
@@ -108,9 +109,11 @@ from .observability import (
     VirtualClock,
     coverage_report,
     device_busy_spans,
+    elastic_gap_attribution,
     interval_intersection,
     prometheus_text,
     read_trace,
+    worker_trace_spans,
 )
 from .storage import History, create_sqlite_db_id
 from .sumstat import IdentitySumstat, PredictorSumstat, Sumstat

@@ -260,6 +260,39 @@ class StochasticAcceptor(Acceptor):
             weight = 1.0
         return AcceptorResult(distance=v, accept=accept, weight=weight)
 
+    def delayed_accept_fn(self, t: int, temperature: float):
+        """Host-side delayed stochastic acceptance for adopted look-ahead
+        generations (fixed-schedule configs — ListTemperature +
+        ``pdf_norm_from_kernel`` — where nothing in the acceptance rule
+        depends on the adopted generation's own records).
+
+        A preliminary worker only simulated: its particle carries the
+        kernel value as ``distance`` (generation-invariant: stochastic
+        kernels never re-weight between generations) and the
+        prior/proposal importance ratio as ``weight``. This applies the
+        SAME rule as :meth:`__call__` — accept with probability
+        ``min(1, exp((v - pdf_norm)/T))``, folding the above-norm excess
+        into the importance weight — so the adopted generation is
+        distributed exactly as a serially-sampled one."""
+        pdf_norm = self.pdf_norms[t]
+        lin = self._kernel is not None and self._kernel.ret_scale == SCALE_LIN
+        apply_iw = self.apply_importance_weighting
+        temp = float(temperature)
+
+        def accept(p) -> bool:
+            logv = (
+                float(np.log(max(p.distance, 1e-300))) if lin
+                else float(p.distance)
+            )
+            log_ratio = (logv - pdf_norm) / temp
+            if log_ratio >= 0:
+                if apply_iw:
+                    p.weight *= float(np.exp(log_ratio))
+                return True
+            return bool(np.random.uniform() < np.exp(log_ratio))
+
+        return accept
+
     # ------------------------------------------------------------- device
     def is_device_compatible(self) -> bool:
         return self._kernel is not None and self._kernel.is_device_compatible()

@@ -19,6 +19,18 @@ crashing with slots in flight stalls the generation until the sampler's
 ``generation_timeout``; ``mode="static"`` hands out fixed acceptance
 quotas, so a crashed worker's undelivered units stall it likewise. Both
 are bounded by the timeout, not self-healing.
+
+Distributed tracing (round 8): trace-capable workers append a worker-clock
+send time to their requests; the broker answers those with its own
+monotonic clock appended, turning every exchange into a clock-offset
+sample the WORKER evaluates (protocol.py documents the shapes). Result
+messages piggyback per-batch phase-span summaries; the broker ingests
+them into a bounded per-process buffer, offset-maps each span onto ITS
+clock (the orchestrator timeline — broker and sampler share one process
+clock), and :meth:`EvalBroker.drain_worker_spans` hands them to the
+sampler's tracer as per-worker pseudo-threads. Per-worker clock offsets,
+RTT uncertainty, last errors and departure reasons live in the worker
+table and surface through :meth:`status` / :meth:`worker_snapshot`.
 """
 from __future__ import annotations
 
@@ -28,8 +40,17 @@ import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
 
-from ..observability import SYSTEM_CLOCK
+from ..observability import SYSTEM_CLOCK, register_worker_source
 from .protocol import recv_msg, send_msg
+
+#: a worker not heard from for this long while a generation is OPEN is
+#: flagged presumed_dead in status() — the "wait() stalls dark when a
+#: worker dies mid-batch" diagnosis, as data instead of a mystery
+DEFAULT_LIVENESS_S = 5.0
+
+#: bound on the ingested worker-span buffer (drained every generation by
+#: the sampler; the bound only matters for broker use without one)
+MAX_WORKER_SPANS = 100_000
 
 
 @dataclass
@@ -42,6 +63,10 @@ class BrokerStatus:
     n_results: int
     workers: dict = field(default_factory=dict)
     done: bool = True
+    #: workers that deregistered ("bye"), keyed by worker id:
+    #: {"reason", "last_seen", "n_results"} — a terminated worker leaves
+    #: a tombstone instead of vanishing from the books
+    departed: dict = field(default_factory=dict)
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -77,11 +102,13 @@ class EvalBroker:
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 max_eval: float = float("inf"), clock=None):
+                 max_eval: float = float("inf"), clock=None,
+                 liveness_s: float = DEFAULT_LIVENESS_S):
         # injected monotonic clock (observability subsystem): worker
         # liveness ages and wait deadlines survive wall-clock steps, and
         # tests can drive a VirtualClock
         self.clock = clock if clock is not None else SYSTEM_CLOCK
+        self.liveness_s = float(liveness_s)
         self._lock = threading.Lock()
         self._gen = 0               # monotonically increasing generation id
         self._payload: bytes | None = None  # pickled simulate_one closure
@@ -115,7 +142,16 @@ class EvalBroker:
         # pending_next that started AND finished between polls); 3 adds
         # margin without pinning generations of pickled particles
         self._finished_keep = 3
+        #: broker-clock finalization instant per finished generation —
+        #: the sampler subtracts it from its own observation time to
+        #: measure the ORCHESTRATOR POLL LATENCY slice of dark time
+        self._finished_at: "OrderedDict[int, float]" = OrderedDict()
         self._workers: dict[str, dict] = {}
+        #: bye tombstones: {wid: {"reason", "last_seen", "n_results"}}
+        self._departed: dict[str, dict] = {}
+        #: ingested worker spans, already offset-mapped onto THIS clock
+        self._worker_spans: list[dict] = []
+        self._worker_spans_dropped = 0
         self._server = _Server((host, port), _Handler)
         self._server.broker = self  # type: ignore[attr-defined]
         self._thread = threading.Thread(
@@ -123,6 +159,9 @@ class EvalBroker:
             name="pyabc-tpu-broker",
         )
         self._thread.start()
+        # the dashboard's /api/observability worker section (weakref —
+        # a dropped broker silently leaves the snapshot)
+        register_worker_source(self)
 
     # ------------------------------------------------------------------ api
     @property
@@ -218,6 +257,13 @@ class EvalBroker:
             res = self._finished.get(gen)
             return list(res) if res is not None else None
 
+    def finished_at(self, gen: int) -> float | None:
+        """Broker-clock instant generation ``gen`` finalized (None if it
+        never finished or was evicted) — the sampler's poll-latency
+        anchor."""
+        with self._lock:
+            return self._finished_at.get(gen)
+
     def wait(self, poll_s: float = 0.05, timeout: float | None = None
              ) -> list[tuple[int, bytes, bool]]:
         """Block until the generation completes; returns (slot,
@@ -254,16 +300,75 @@ class EvalBroker:
     def status(self) -> BrokerStatus:
         with self._lock:
             now = self.clock.now()
+            gen_open = not self._done
+            workers = {}
+            for w, info in self._workers.items():
+                idle = now - info["last_seen"]
+                view = dict(info)
+                view["idle_s"] = round(idle, 1)
+                # the wait()-stalls-dark diagnosis: a silent worker while
+                # the generation is open is flagged, with its last error
+                # (if its --catch loop reported one) right next to it
+                view["presumed_dead"] = bool(
+                    gen_open and idle > self.liveness_s
+                )
+                workers[w] = view
             return BrokerStatus(
                 generation=self._gen, t=self._t, n_target=self._n_target,
                 n_acc=self._n_acc, n_eval_handed=self._next_slot,
                 n_results=len(self._results),
-                workers={
-                    w: dict(info, idle_s=round(now - info["last_seen"], 1))
-                    for w, info in self._workers.items()
-                },
+                workers=workers,
                 done=self._done,
+                departed=dict(self._departed),
             )
+
+    def worker_snapshot(self) -> dict:
+        """JSON-ready per-worker view for ``observability_snapshot()`` /
+        the dashboard's ``/api/observability``: liveness, clock offset +
+        uncertainty, throughput counters, last error, departures."""
+        st = self.status()
+        out = {}
+        for w, info in st.workers.items():
+            out[w] = {
+                "last_seen_idle_s": info.get("idle_s"),
+                "presumed_dead": info.get("presumed_dead", False),
+                "n_results": info.get("n_results", 0),
+                "n_eval": info.get("n_eval", 0),
+                "n_acc": info.get("n_acc", 0),
+                "clock_offset_s": info.get("clock_offset_s"),
+                "clock_offset_unc_s": info.get("clock_offset_unc_s"),
+                "clock_rtt_s": info.get("clock_rtt_s"),
+                "last_error": info.get("last_error"),
+                "trace": bool(info.get("trace", False)),
+            }
+        for w, info in st.departed.items():
+            out.setdefault(w, {})["departed"] = info
+        return out
+
+    def worker_offsets(self) -> dict:
+        """{worker_id: {"offset_s", "uncertainty_s", "rtt_s"}} for every
+        trace-reporting worker (tests + the bench's merge-uncertainty
+        guard)."""
+        with self._lock:
+            return {
+                w: {
+                    "offset_s": info.get("clock_offset_s"),
+                    "uncertainty_s": info.get("clock_offset_unc_s"),
+                    "rtt_s": info.get("clock_rtt_s"),
+                }
+                for w, info in self._workers.items()
+                if info.get("clock_offset_s") is not None
+            }
+
+    def drain_worker_spans(self) -> list[dict]:
+        """Take (and clear) the ingested worker spans: ``Span.to_dict``-
+        shaped dicts already offset-mapped onto this broker's clock, on
+        per-worker pseudo-threads (``worker:<id>``), each carrying the
+        offset estimate + RTT uncertainty it was mapped with. The sampler
+        records them onto the run tracer after every generation."""
+        with self._lock:
+            spans, self._worker_spans = self._worker_spans, []
+            return spans
 
     def stop(self) -> None:
         with self._lock:
@@ -271,6 +376,9 @@ class EvalBroker:
         self._done_event.set()
         self._server.shutdown()
         self._server.server_close()
+        from ..observability import unregister_worker_source
+
+        unregister_worker_source(self)
 
     # ------------------------------------------------------------ dispatch
     def _touch(self, worker_id: str, **updates) -> None:
@@ -281,21 +389,77 @@ class EvalBroker:
         for k, v in updates.items():
             info[k] = info.get(k, 0) + v
 
+    def _ingest_trace_locked(self, worker_id: str, trace: dict) -> None:
+        """Store a piggybacked trace summary: update the worker's offset/
+        error fields and offset-map its phase spans onto this clock."""
+        if not isinstance(trace, dict) or trace.get("v") != 1:
+            return
+        info = self._workers.setdefault(
+            worker_id, {"n_results": 0, "joined": self.clock.now()}
+        )
+        info["trace"] = True
+        offset = trace.get("offset")
+        if offset is not None:
+            info["clock_offset_s"] = float(offset)
+            info["clock_offset_unc_s"] = trace.get("offset_unc")
+            info["clock_rtt_s"] = trace.get("rtt")
+        if trace.get("last_error"):
+            info["last_error"] = str(trace["last_error"])[:300]
+        for k in ("n_eval", "n_acc"):
+            if isinstance(trace.get(k), int):
+                info[k] = trace[k]
+        if offset is None:
+            # spans on an uncalibrated clock cannot be merged; count them
+            self._worker_spans_dropped += len(trace.get("spans") or ())
+            return
+        unc = trace.get("offset_unc")
+        for sp in trace.get("spans") or ():
+            try:
+                start = float(sp["start"]) + float(offset)
+                end = float(sp["end"]) + float(offset)
+            except (KeyError, TypeError, ValueError):
+                continue
+            attrs = dict(sp.get("attrs") or {})
+            attrs.update({
+                "worker_id": worker_id,
+                "clock_offset_s": float(offset),
+                "clock_offset_unc_s": unc,
+                "worker_clock_start": sp["start"],
+            })
+            self._worker_spans.append({
+                "name": str(sp.get("name", "worker.phase")),
+                "span_id": None, "parent_id": None,
+                "thread": f"worker:{worker_id}",
+                "start": start, "end": end, "attrs": attrs,
+            })
+        if len(self._worker_spans) > MAX_WORKER_SPANS:
+            drop = len(self._worker_spans) - MAX_WORKER_SPANS
+            del self._worker_spans[:drop]
+            self._worker_spans_dropped += drop
+
     def _dispatch(self, msg):
         kind = msg[0]
+        # trace-capable requests carry a worker-clock send time (or a
+        # trace dict for results/bye); stamping the reply with THIS clock
+        # completes the NTP-style exchange the worker's offset estimator
+        # consumes — same round trip, no extra messages
+        t_broker = float(self.clock.now())
         if kind == "hello":
+            traced = len(msg) >= 3
             with self._lock:
                 self._touch(msg[1])
                 if self._done or self._payload is None:
-                    return ("wait",)
-                return ("work", self._gen, self._t, self._payload,
-                        self._batch, self._mode)
+                    return ("wait", t_broker) if traced else ("wait",)
+                reply = ("work", self._gen, self._t, self._payload,
+                         self._batch, self._mode)
+                return reply + (t_broker,) if traced else reply
         if kind == "get_slots":
-            _, worker_id, gen, k = msg
+            worker_id, gen, k = msg[1], msg[2], msg[3]
+            traced = len(msg) >= 5
             with self._lock:
                 self._touch(worker_id)
                 if gen != self._gen or self._done or self._draining:
-                    return ("done",)
+                    return ("done", t_broker) if traced else ("done",)
                 cap = self._max_eval
                 if self._mode == "static":
                     # static quota: exactly n_target acceptance units total
@@ -304,22 +468,32 @@ class EvalBroker:
                     if self._mode == "static":
                         # every unit handed out; completion is driven by
                         # their deliveries, not by refusing stragglers
-                        return ("done",)
+                        return ("done", t_broker) if traced else ("done",)
                     # eval budget exhausted: finish with what was delivered
                     self._finish_locked()
-                    return ("done",)
+                    return ("done", t_broker) if traced else ("done",)
                 start = self._next_slot
                 stop = int(min(start + int(k), cap))
                 self._next_slot = stop
+                if traced:
+                    return ("slots", start, stop, t_broker)
                 return ("slots", start, stop)
         if kind == "results":
-            _, worker_id, gen, triples = msg
+            worker_id, gen, triples = msg[1], msg[2], msg[3]
+            trace = msg[4] if len(msg) >= 5 else None
+            traced = trace is not None
+
+            def _reply(tag: str):
+                return (tag, t_broker) if traced else (tag,)
+
             with self._lock:
                 self._touch(worker_id, n_results=len(triples))
+                if traced:
+                    self._ingest_trace_locked(worker_id, trace)
                 if gen != self._gen:
-                    return ("done",)
+                    return _reply("done")
                 if self._done:
-                    return ("done",)
+                    return _reply("done")
                 for slot, blob, accepted in triples:
                     self._results.append((int(slot), blob, bool(accepted)))
                     if accepted:
@@ -333,7 +507,7 @@ class EvalBroker:
                 if self._collect_only:
                     # look-ahead generation: completion is the sampler's
                     # call (delayed acceptance against the final epsilon)
-                    return ("ok",)
+                    return _reply("ok")
                 if self._mode == "static" \
                         and len(self._results) >= self._max_eval:
                     # static eval budget: every static evaluation ships a
@@ -343,33 +517,44 @@ class EvalBroker:
                     # the sampler's n_accepted < n then triggers ABCSMC's
                     # acceptance-budget stop, like the dynamic slot cap.
                     self._finish_locked()
-                    return ("done",)
+                    return _reply("done")
                 # draining implies the target was already met (n_acc is
                 # monotonic), so one branch decides both finalizations
                 if self._n_acc >= self._n_target:
                     if not self._wait_for_all \
                             or self._n_delivered >= self._next_slot:
                         self._finish_locked()
-                        return ("done",)
+                        return _reply("done")
                     # target met: stop handing out new slots, keep
                     # collecting the in-flight ones so adaptive
                     # components see the complete record set
                     self._draining = True
-                return ("ok",)
+                return _reply("ok")
         if kind == "heartbeat":
             # static-unit liveness probe: lets a worker abandon a spinning
             # quota unit the moment the generation is finalized
-            _, worker_id, gen = msg
+            worker_id, gen = msg[1], msg[2]
+            traced = len(msg) >= 4
             with self._lock:
                 self._touch(worker_id)
                 if gen != self._gen or self._done or self._draining:
-                    return ("done",)
-                return ("ok",)
+                    return ("done", t_broker) if traced else ("done",)
+                return ("ok", t_broker) if traced else ("ok",)
         if kind == "bye":
             # graceful worker shutdown (KillHandler parity): deregister so
-            # manager status doesn't show ghosts
+            # manager status doesn't show ghosts; trace-capable workers
+            # attach a reason + their final span flush, leaving a
+            # tombstone instead of a blank
             with self._lock:
-                self._workers.pop(msg[1], None)
+                if len(msg) >= 4:
+                    self._ingest_trace_locked(msg[1], msg[3])
+                info = self._workers.pop(msg[1], None)
+                self._departed[msg[1]] = {
+                    "reason": msg[2] if len(msg) >= 3 else "bye",
+                    "last_seen": (info or {}).get("last_seen"),
+                    "n_results": (info or {}).get("n_results", 0),
+                    "last_error": (info or {}).get("last_error"),
+                }
             return ("ok",)
         if kind == "status":
             return ("status", self.status())
@@ -382,8 +567,11 @@ class EvalBroker:
     def _finish_locked(self) -> None:
         self._done = True
         self._finished[self._gen] = list(self._results)
+        self._finished_at[self._gen] = self.clock.now()
         while len(self._finished) > self._finished_keep:
             self._finished.popitem(last=False)
+        while len(self._finished_at) > self._finished_keep:
+            self._finished_at.popitem(last=False)
         self._done_event.set()
         if self._pending_next is not None:
             # look-ahead auto-advance: workers roll straight into the

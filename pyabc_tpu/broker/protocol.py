@@ -17,6 +17,28 @@ result queues) — collapsed into seven request types against one broker:
 - ``("status",)``           -> ("status", BrokerStatus)
 - ``("shutdown",)``         -> ("ok",)
 
+Distributed-tracing extension (round 8) — trace-capable workers append
+OPTIONAL trailing elements to the same request kinds; the broker answers
+those (and only those) with its own monotonic clock appended, so every
+exchange doubles as an NTP-style clock-offset sample with NO extra round
+trips:
+
+- ``("hello", worker_id, t1)``          -> work/wait reply + ``t_broker``
+- ``("get_slots", wid, gen, k, t1)``    -> ("slots", start, stop, t_broker)
+- ``("results", wid, gen, triples, trace)`` -> ("ok"|"done", t_broker)
+  where ``trace`` is the piggybacked per-batch timing summary
+  (:meth:`~pyabc_tpu.broker.worker.WorkerSpanRecorder.trace_payload`):
+  worker-clock phase spans, the worker's current offset estimate +
+  RTT-derived uncertainty, eval counters and its last error repr.
+- ``("heartbeat", wid, gen, t1)``       -> ("ok"|"done", t_broker)
+- ``("bye", worker_id, reason, trace)`` -> ("ok",)
+  (``reason`` feeds BrokerStatus departed-worker bookkeeping; the final
+  trace flushes ship spans that would otherwise be lost)
+
+A PRE-TRACING worker omits the trailing elements and receives the exact
+pre-round-8 reply shapes — old workers interoperate with the new broker
+unchanged (asserted by ``tests/test_worker_tracing.py``).
+
 Broker and worker ship together (same package); the frame format is not a
 cross-version compatibility boundary.
 

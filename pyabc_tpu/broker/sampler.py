@@ -114,8 +114,17 @@ class ElasticSampler(Sampler):
         self._lookahead_t = None
         with self.tracer.span("broker.generation", t=int(t or 0),
                               n=int(n), adopted=bool(adopt)) as g_span:
-            return self._sample_impl(n, simulate_one, t, max_eval,
-                                     all_accepted, adopt, g_span)
+            try:
+                return self._sample_impl(n, simulate_one, t, max_eval,
+                                         all_accepted, adopt, g_span)
+            finally:
+                # merge the generation's worker-side spans (shipped
+                # piggybacked on result messages, offset-mapped by the
+                # broker) onto the run tracer as per-worker
+                # pseudo-threads — the elastic path's dark time then
+                # decomposes in the same coverage accountant as the
+                # fused path's
+                self._merge_worker_spans()
 
     def _sample_impl(self, n, simulate_one, t, max_eval, all_accepted,
                      adopt, g_span) -> Sample:
@@ -132,7 +141,8 @@ class ElasticSampler(Sampler):
             )
         accept_fn = self.lookahead_accept if adopt else None
         triples, tested = self._collect(n, t, max_eval, all_accepted,
-                                        accept_fn, head_start=adopt)
+                                        accept_fn, head_start=adopt,
+                                        g_span=g_span)
         g_span.set(n_delivered=len(triples))
         if adopt and self.lookahead_head_starts:
             g_span.set(head_start=int(self.lookahead_head_starts[-1]))
@@ -184,8 +194,92 @@ class ElasticSampler(Sampler):
             sample.host_all_records = HostRecords.from_particles(records)
         return sample
 
+    def _merge_worker_spans(self) -> None:
+        """Drain the broker's ingested worker spans onto the run tracer.
+
+        Each span arrives offset-mapped onto the broker's clock — which
+        IS the orchestrator tracer's timebase (broker and sampler share
+        one process; both default to the observability SYSTEM_CLOCK) —
+        on a ``worker:<id>`` pseudo-thread, carrying the clock-offset
+        estimate and RTT-derived uncertainty it was merged with. With a
+        NullTracer the drain still runs (the broker buffer stays
+        bounded) but records nothing."""
+        spans = self.broker.drain_worker_spans()
+        if not spans or not self.tracer.enabled:
+            return
+        for sp in spans:
+            attrs = {k: v for k, v in sp.get("attrs", {}).items()
+                     if k not in ("name", "start", "end", "thread")}
+            self.tracer.record_span(
+                sp["name"], sp["start"], sp["end"],
+                thread=sp.get("thread"), **attrs,
+            )
+
+    def _note_poll_latency(self, gen: int, g_span) -> None:
+        """Record how long the finished generation sat on the broker
+        before this poll loop observed it — the orchestrator-poll slice
+        of elastic dark time (the sampler sleeps 20 ms between
+        snapshots; the broker finalizes asynchronously on a worker's
+        results message)."""
+        finished_at = self.broker.finished_at(gen)
+        if finished_at is None:
+            return
+        now = self.tracer.clock.now()
+        if now > finished_at:
+            self.tracer.record_span("broker.poll_latency", finished_at,
+                                    now, gen=int(gen))
+            if g_span is not None:
+                g_span.set(poll_latency_s=round(now - finished_at, 6))
+
+    def _update_worker_gauges(self, status) -> None:
+        """Broker queue-depth / worker-liveness / per-worker-throughput
+        gauges (the ``pyabc_tpu_worker_*`` family, observability/
+        metrics.py names)."""
+        from ..observability.metrics import (
+            per_worker_metric,
+            WORKER_ALIVE_GAUGE,
+            WORKER_CLOCK_OFFSET_GAUGE,
+            WORKER_CLOCK_UNC_GAUGE,
+            WORKER_KNOWN_GAUGE,
+            WORKER_QUEUE_DEPTH_GAUGE,
+        )
+
+        m = self.metrics
+        m.gauge(WORKER_KNOWN_GAUGE,
+                "workers the broker has heard from").set(
+            len(status.workers))
+        m.gauge(WORKER_ALIVE_GAUGE,
+                "workers heard from within the liveness window").set(
+            sum(1 for w in status.workers.values()
+                if not w.get("presumed_dead")))
+        m.gauge(WORKER_QUEUE_DEPTH_GAUGE,
+                "handed-out evaluation slots not yet delivered").set(
+            max(status.n_eval_handed - status.n_results, 0))
+        offsets = [w.get("clock_offset_s") for w in status.workers.values()
+                   if w.get("clock_offset_s") is not None]
+        uncs = [w.get("clock_offset_unc_s")
+                for w in status.workers.values()
+                if w.get("clock_offset_unc_s") is not None]
+        if offsets:
+            m.gauge(WORKER_CLOCK_OFFSET_GAUGE,
+                    "largest |worker clock offset| vs the broker").set(
+                max(abs(o) for o in offsets))
+        if uncs:
+            m.gauge(WORKER_CLOCK_UNC_GAUGE,
+                    "largest worker clock-offset uncertainty").set(
+                max(uncs))
+        for wid, w in status.workers.items():
+            joined = w.get("joined")
+            age = (self.tracer.clock.now() - joined) if joined else 0.0
+            if age > 0:
+                m.gauge(
+                    per_worker_metric("pyabc_tpu_worker_results_per_s",
+                                      wid),
+                    "delivered results per second since join",
+                ).set(w.get("n_results", 0) / age)
+
     def _collect(self, n, t, max_eval, all_accepted, accept_fn, *,
-                 head_start: bool) -> tuple[list, dict]:
+                 head_start: bool, g_span=None) -> tuple[list, dict]:
         """Poll the broker until generation completion, applying delayed
         acceptance (look-ahead adoption) and/or pre-publishing the NEXT
         generation's preliminary closure once enough of this one is in.
@@ -237,6 +331,7 @@ class ElasticSampler(Sampler):
                     ))
             if gen_now != gen0:
                 # finished and auto-advanced to the pre-published next gen
+                self._note_poll_latency(gen0, g_span)
                 last = self.broker.last_results(gen0)
                 return (last if last is not None else []), tested
             need_particles = accept_fn is not None or (
@@ -264,9 +359,11 @@ class ElasticSampler(Sampler):
                 delivered_counter.inc(len(triples) - n_seen)
             n_seen = len(triples)
             if self.metrics.enabled:
+                status = self.broker.status()
                 inflight_gauge.set(
-                    max(self.broker.status().n_eval_handed - n_seen, 0)
+                    max(status.n_eval_handed - n_seen, 0)
                 )
+                self._update_worker_gauges(status)
             if (self.look_ahead and not prepublished
                     and self.lookahead_builder is not None
                     and n_acc >= self.look_ahead_frac * n):
@@ -289,6 +386,7 @@ class ElasticSampler(Sampler):
                 last = self.broker.last_results(gen0)
                 return (last if last is not None else triples), tested
             if done:
+                self._note_poll_latency(gen0, g_span)
                 return triples, tested
             _time.sleep(0.02)
             if deadline and clock.now() > deadline:

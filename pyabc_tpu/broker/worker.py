@@ -10,6 +10,19 @@ runtime bookkeeping. SIGTERM/SIGINT are handled like the reference's
 ``KillHandler``: the current batch is finished and shipped, the worker
 deregisters from the broker ("bye"), and the loop exits cleanly (exit 0) —
 a cluster preemption never strands half-evaluated work.
+
+Distributed tracing (round 8): the worker records its own phase spans —
+connect / wait-for-work / deserialize / simulate-batch / serialize /
+ship — on its injected monotonic clock via :class:`WorkerSpanRecorder`,
+and PIGGYBACKS per-batch timing summaries on the existing result
+messages (no extra round trips). Because the worker's monotonic clock
+shares no epoch with the orchestrator's, every stamped request/response
+exchange doubles as an NTP-style clock-offset sample
+(:class:`~pyabc_tpu.observability.clock.ClockOffsetEstimator`); the
+worker ships its current offset estimate + RTT-derived uncertainty with
+each summary so the broker can merge the spans onto the orchestrator
+timeline. ``trace=False`` reproduces the pre-round-8 wire behavior
+exactly (degraded mode / protocol back-compat tests).
 """
 from __future__ import annotations
 
@@ -25,7 +38,134 @@ import uuid
 import numpy as np
 
 from ..observability import SYSTEM_CLOCK
+from ..observability.clock import ClockOffsetEstimator
 from .protocol import request
+
+
+class WorkerSpanRecorder:
+    """Worker-side span recorder on one injected clock.
+
+    Phase spans accumulate in a bounded pending buffer (worker-clock
+    ``{"name", "start", "end", "attrs"}`` dicts) until
+    :meth:`trace_payload` drains them into the next result message.
+    Spans record via explicit ``begin``/``end`` tokens rather than a
+    contextmanager because the simulate phase is REOPENED around
+    mid-batch network ops (static-mode flushes, liveness heartbeats) —
+    those round trips must not masquerade as compute time.
+    """
+
+    enabled = True
+
+    def __init__(self, worker_id: str, clock=None, max_pending: int = 2048):
+        self.worker_id = str(worker_id)
+        self.clock = clock if clock is not None else SYSTEM_CLOCK
+        self.offset = ClockOffsetEstimator()
+        self._max_pending = int(max_pending)
+        self._pending: list[dict] = []
+        self.n_dropped = 0
+        #: repr of the last simulate_one exception a --catch loop turned
+        #: into an error record (surfaces in BrokerStatus.last_error)
+        self.last_error: str | None = None
+        self.n_eval = 0
+        self.n_acc = 0
+
+    def begin(self, name: str) -> tuple[str, float]:
+        return (name, self.clock.now())
+
+    def end(self, token: tuple[str, float] | None, **attrs) -> None:
+        if token is None:
+            return
+        name, start = token
+        end = self.clock.now()
+        if end <= start:
+            return
+        if len(self._pending) >= self._max_pending:
+            # drop oldest: recent batches matter most for live dashboards
+            del self._pending[0]
+            self.n_dropped += 1
+        self._pending.append(
+            {"name": name, "start": float(start), "end": float(end),
+             "attrs": attrs}
+        )
+
+    def observe_exchange(self, t1: float, t2_broker, t4: float) -> None:
+        """Feed one stamped request/response round trip into the offset
+        estimator (t1/t4 worker clock, t2 the broker's stamp)."""
+        if t2_broker is None:
+            return
+        self.offset.add_sample(t1, float(t2_broker), t4)
+
+    def trace_payload(self, limit: int = 512) -> dict:
+        """Drain pending spans into the piggyback summary dict."""
+        spans = self._pending[:limit]
+        del self._pending[: len(spans)]
+        return {
+            "v": 1,
+            "spans": spans,
+            "offset": self.offset.offset,
+            "offset_unc": self.offset.uncertainty_s,
+            "rtt": self.offset.rtt_s,
+            "n_offset_samples": self.offset.n_samples,
+            "n_eval": self.n_eval,
+            "n_acc": self.n_acc,
+            "n_dropped": self.n_dropped,
+            "last_error": self.last_error,
+        }
+
+
+class _NullRecorder:
+    """Inert recorder: ``trace=False`` workers speak the pre-tracing
+    protocol byte-for-byte (and pay zero bookkeeping)."""
+
+    enabled = False
+    last_error = None
+    n_eval = 0
+    n_acc = 0
+
+    def begin(self, name):
+        return None
+
+    def end(self, token, **attrs):
+        pass
+
+    def observe_exchange(self, t1, t2, t4):
+        pass
+
+    def trace_payload(self, limit=512):
+        return {}
+
+
+def _broker_stamp(reply):
+    """The broker-clock timestamp a trace-aware reply carries (trailing
+    float), or None for pre-tracing reply shapes — every positional
+    element of the legacy shapes is an int/str/bytes, so a float tail is
+    unambiguous."""
+    if isinstance(reply, tuple) and reply and isinstance(reply[-1], float):
+        return reply[-1]
+    return None
+
+
+def _traced_request(addr, msg, rec, span_name: str | None = None,
+                    append_t1: bool = False):
+    """One broker round trip + one clock-offset sample (+ an optional
+    round-trip span). ``append_t1`` marks the request trace-capable by
+    appending the worker-clock send time — the broker then stamps its
+    reply."""
+    if not rec.enabled:
+        return request(addr, msg)
+    t1 = rec.clock.now()
+    if append_t1:
+        msg = msg + (t1,)
+    token = (span_name, t1) if span_name else None
+    try:
+        reply = request(addr, msg)
+    except Exception:
+        rec.end(token, kind=msg[0], error=True)
+        raise
+    t4 = rec.clock.now()
+    rec.observe_exchange(t1, _broker_stamp(reply), t4)
+    rec.end(token, kind=msg[0])
+    return reply
 
 
 def run_worker(host: str, port: int, *, worker_id: str | None = None,
@@ -34,6 +174,8 @@ def run_worker(host: str, port: int, *, worker_id: str | None = None,
                log_file: str | None = None,
                catch_exceptions: bool = True,
                seed: int | None = None,
+               trace: bool = True,
+               clock=None,
                _stop_check=None) -> int:
     """Serve generations until the broker goes away / runtime ends.
 
@@ -46,6 +188,12 @@ def run_worker(host: str, port: int, *, worker_id: str | None = None,
     the loop continues — a deterministic model bug then surfaces in the
     orchestrator's error records instead of serially killing every
     worker in the pool. Disable to make model errors fatal (debugging).
+
+    ``trace``: record worker-side phase spans and piggyback them (plus
+    NTP-style clock-offset samples) on result messages; ``False`` speaks
+    the pre-tracing protocol exactly. ``clock``: injected monotonic
+    clock (tests drive skewed VirtualClocks); defaults to the shared
+    SYSTEM_CLOCK.
     """
     addr = (host, int(port))
     wid = worker_id or f"{socket.gethostname()}-{os.getpid()}-{uuid.uuid4().hex[:6]}"
@@ -61,11 +209,13 @@ def run_worker(host: str, port: int, *, worker_id: str | None = None,
         seed = (os.getpid() * 1000003
                 + int.from_bytes(os.urandom(4), "little"))
     np.random.seed(seed % (2**31 - 1))
-    clock = SYSTEM_CLOCK
+    clock = clock if clock is not None else SYSTEM_CLOCK
+    rec = WorkerSpanRecorder(wid, clock) if trace else _NullRecorder()
     t_end = clock.now() + runtime_s if np.isfinite(runtime_s) else None
     n_eval_total = 0
     gens_served = 0
     last_counted_gen = -1
+    bye_reason = "exit"
     log_writer = None
     if log_file:
         fh = open(log_file, "a", newline="")
@@ -96,40 +246,78 @@ def run_worker(host: str, port: int, *, worker_id: str | None = None,
             return True
         return _stop_check() if _stop_check is not None else False
 
+    def ship(gen: int, parts: list) -> tuple:
+        """Serialize + ship one batch of (slot, particle, accepted)
+        triples; the serialize and ship phases get their own spans, and
+        the trace summary rides the SAME message (no extra round trip)."""
+        ser_tok = rec.begin("worker.serialize")
+        triples = [
+            (slot, pickle.dumps(p, pickle.HIGHEST_PROTOCOL), acc)
+            for slot, p, acc in parts
+        ]
+        rec.end(ser_tok, n=len(triples),
+                nbytes=sum(len(b) for _s, b, _a in triples))
+        msg = ("results", wid, gen, triples)
+        if rec.enabled:
+            msg = msg + (rec.trace_payload(),)
+        return _traced_request(addr, msg, rec, span_name="worker.ship")
+
+    connect_tok = rec.begin("worker.connect")
+    wait_tok = None
     try:
         while True:
             if stopping():
+                bye_reason = "signal"
                 break
             if t_end and clock.now() > t_end:
+                bye_reason = "runtime_end"
                 break
             if gens_served >= max_generations:
+                bye_reason = "max_generations"
                 break
+            if connect_tok is None and wait_tok is None:
+                wait_tok = rec.begin("worker.wait")
             try:
-                reply = request(addr, ("hello", wid))
+                reply = _traced_request(addr, ("hello", wid), rec,
+                                        append_t1=True)
             except (ConnectionError, OSError):
                 time.sleep(min(poll_s * 4, 2.0))
                 continue
+            if connect_tok is not None:
+                # first successful broker contact (covers pre-manager
+                # startup backoff — reference "worker before manager")
+                rec.end(connect_tok)
+                connect_tok = None
             if reply[0] != "work":
                 time.sleep(poll_s)
                 continue
+            if wait_tok is not None:
+                rec.end(wait_tok)
+                wait_tok = None
             # NOTE: no served-generation memory on purpose — a transport
             # blip mid-generation must NOT bench the worker for the rest of
             # that generation; re-entering a still-running generation just
             # pulls more slots (a finished generation answers hello "wait")
-            _, gen, t, payload, batch, mode = reply
+            gen, t, payload, batch, mode = reply[1:6]
+            de_tok = rec.begin("worker.deserialize")
             simulate_one = pickle.loads(payload)
+            rec.end(de_tok, nbytes=len(payload), gen=gen)
             t0 = clock.now()
             n_eval = n_acc = 0
             while True:
                 try:
-                    r = request(addr, ("get_slots", wid, gen, batch))
+                    r = _traced_request(
+                        addr, ("get_slots", wid, gen, batch), rec,
+                        span_name="worker.slots", append_t1=True,
+                    )
                 except (ConnectionError, OSError):
                     break  # broker gone; outer loop will reconnect
                 if r[0] != "slots":
                     break
-                _, start, stop = r
-                triples = []
+                start, stop = r[1], r[2]
+                parts = []  # (slot, particle, accepted) — serialized at ship
                 aborted = False
+                sim_tok = rec.begin("worker.simulate")
                 for slot in range(start, stop):
                     # dynamic: one evaluation per slot. static: a quota
                     # unit — evaluate until THIS unit accepts (reference
@@ -144,6 +332,7 @@ def run_worker(host: str, port: int, *, worker_id: str | None = None,
                                 raise
                             from ..core.population import Particle
 
+                            rec.last_error = repr(e)[:300]
                             particle = Particle(
                                 m=-1, parameter={}, weight=0.0,
                                 sum_stat={}, distance=float("inf"),
@@ -151,14 +340,12 @@ def run_worker(host: str, port: int, *, worker_id: str | None = None,
                             )
                         n_eval += 1
                         unit_evals += 1
+                        rec.n_eval += 1
                         accepted = bool(particle.accepted)
-                        triples.append((
-                            slot,
-                            pickle.dumps(particle, pickle.HIGHEST_PROTOCOL),
-                            accepted,
-                        ))
+                        parts.append((slot, particle, accepted))
                         if accepted:
                             n_acc += 1
+                            rec.n_acc += 1
                         if accepted or mode != "static":
                             break
                         if stopping():
@@ -166,19 +353,22 @@ def run_worker(host: str, port: int, *, worker_id: str | None = None,
                             # exit — delay bounded by ONE simulate_one
                             aborted = True
                             break
-                        if mode == "static" and len(triples) >= 64:
+                        if mode == "static" and len(parts) >= 64:
                             # a spinning static unit (collapsed acceptance
                             # or a deterministically-raising model under
                             # --catch) must not hoard its reject/error
                             # records unboundedly: flush them mid-unit so
                             # errors surface and memory stays bounded
+                            rec.end(sim_tok, n_eval=len(parts))
                             try:
-                                rf = request(addr,
-                                             ("results", wid, gen, triples))
+                                rf = ship(gen, parts)
                             except (ConnectionError, OSError):
                                 aborted = True
+                                parts = []
+                                sim_tok = None
                                 break
-                            triples = []
+                            parts = []
+                            sim_tok = rec.begin("worker.simulate")
                             if rf[0] != "ok":
                                 aborted = True
                                 break
@@ -188,20 +378,27 @@ def run_worker(host: str, port: int, *, worker_id: str | None = None,
                             # acceptance rate; abandon it as soon as the
                             # broker finalized the generation (eval
                             # budget / another worker finished it)
+                            rec.end(sim_tok, n_eval=len(parts))
+                            sim_tok = None
                             try:
-                                hb = request(addr,
-                                             ("heartbeat", wid, gen))
+                                hb = _traced_request(
+                                    addr, ("heartbeat", wid, gen), rec,
+                                    span_name="worker.slots",
+                                    append_t1=True,
+                                )
                             except (ConnectionError, OSError):
                                 aborted = True
                                 break
                             if hb[0] != "ok":
                                 aborted = True
                                 break
+                            sim_tok = rec.begin("worker.simulate")
                     if aborted or stopping():
                         aborted = True
                         break
+                rec.end(sim_tok, n_eval=len(parts))
                 try:
-                    r2 = request(addr, ("results", wid, gen, triples))
+                    r2 = ship(gen, parts)
                 except (ConnectionError, OSError):
                     break
                 if r2[0] != "ok" or aborted or stopping():
@@ -222,9 +419,16 @@ def run_worker(host: str, port: int, *, worker_id: str | None = None,
                      round(clock.now() - t0, 3)])
                 fh.flush()
     finally:
-        # deregister so manager status doesn't show ghost workers
+        if stopping():
+            bye_reason = "signal"
+        # deregister so manager status doesn't show ghost workers; the
+        # final trace flushes ship spans the last results reply couldn't
+        # carry (their end time postdates that message)
         try:
-            request(addr, ("bye", wid))
+            if rec.enabled:
+                request(addr, ("bye", wid, bye_reason, rec.trace_payload()))
+            else:
+                request(addr, ("bye", wid))
         except (ConnectionError, OSError):
             pass
         for sig, old in restore.items():

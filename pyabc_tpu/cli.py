@@ -147,8 +147,12 @@ def bench_cmd(pop, gens, budget_s, cpu):
               help="wrap simulate_one exceptions into rejected error "
               "records instead of killing the worker loop (reference "
               "--catch; default on)")
+@click.option("--trace/--no-trace", "trace", default=True,
+              help="record worker-side phase spans + clock-offset samples "
+              "and piggyback them on result messages (default on; "
+              "--no-trace speaks the pre-tracing protocol exactly)")
 def worker_cmd(host, port, worker_id, runtime_s, max_generations, log_file,
-               processes, catch_exceptions):
+               processes, catch_exceptions, trace):
     """Join an ElasticSampler broker at HOST:PORT as an evaluation worker
     (reference parity: the ``abc-redis-worker`` CLI). Workers may join and
     leave at any time, including mid-generation."""
@@ -156,7 +160,7 @@ def worker_cmd(host, port, worker_id, runtime_s, max_generations, log_file,
 
     kwargs = dict(worker_id=worker_id, runtime_s=runtime_s,
                   max_generations=max_generations, log_file=log_file,
-                  catch_exceptions=catch_exceptions)
+                  catch_exceptions=catch_exceptions, trace=trace)
     if processes > 1:
         # one worker per process (reference --processes): each child gets
         # its own id suffix and log file so the CSVs don't interleave.
@@ -251,9 +255,24 @@ def manager_cmd(host, port, watch):
             f"done={status.done}"
         )
         for wid, info in sorted(status.workers.items()):
-            click.echo(
+            line = (
                 f"  worker {wid}: results={info.get('n_results', 0)} "
                 f"idle={info.get('idle_s', '?')}s"
+            )
+            if info.get("clock_offset_s") is not None:
+                line += (
+                    f" clock_offset={info['clock_offset_s'] * 1e3:.2f}ms"
+                    f"(±{(info.get('clock_offset_unc_s') or 0) * 1e3:.2f})"
+                )
+            if info.get("presumed_dead"):
+                line += " PRESUMED-DEAD"
+            if info.get("last_error"):
+                line += f" last_error={info['last_error']}"
+            click.echo(line)
+        for wid, info in sorted(status.departed.items()):
+            click.echo(
+                f"  departed {wid}: reason={info.get('reason')} "
+                f"results={info.get('n_results', 0)}"
             )
         if not watch:
             break

@@ -894,7 +894,16 @@ class ABCSMC:
                 # weights exist now (reference delayed evaluation). The
                 # recomputed distance sticks on the particle, so records
                 # and the persisted population carry the final values.
-                if getattr(self, "_lookahead_recompute", False):
+                if getattr(self, "_lookahead_stochastic", False):
+                    # fixed-schedule noisy path: the exact stochastic
+                    # acceptance rule (temperature from the fixed ladder,
+                    # analytic pdf norm) applied host-side; above-norm
+                    # excess folds into the importance weight
+                    self.sampler.lookahead_accept = (
+                        self.acceptor.delayed_accept_fn(
+                            t, float(current_eps))
+                    )
+                elif getattr(self, "_lookahead_recompute", False):
                     def _accept(p, _e=float(current_eps), _t=t):
                         p.distance = float(self.distance_function(
                             p.sum_stat, self.x_0, _t, p.parameter
@@ -2586,15 +2595,34 @@ class ABCSMC:
         distance reused), True when the distance must be re-evaluated
         host-side at adoption time.
 
-        Still excluded: StochasticAcceptor (probabilistic acceptance
-        with pdf-norm feedback — delayed acceptance would need the full
-        temperature recursion re-run host-side) and learned-sumstat
-        distances (the feature transform refits between generations,
-        so shipped raw statistics would need the new transform AND the
-        scale refit — the fused loop owns that configuration)."""
+        FIXED-SCHEDULE noisy path (round 8, VERDICT r5 #3): a
+        StochasticAcceptor ALSO rides look-ahead when nothing in its
+        acceptance rule depends on the adopted generation's own records —
+        temperature ladder fixed ahead of time (``ListTemperature``) and
+        analytic pdf normalization (``pdf_norm_from_kernel``), with a
+        static stochastic kernel (kernels never re-weight between
+        generations). Delayed acceptance then applies the exact
+        stochastic rule host-side via
+        :meth:`StochasticAcceptor.delayed_accept_fn`, and the preliminary
+        proposals ride the SAME variance guards as the uniform path
+        (defensive prior mixture, builder-ESS floor, bandwidth widening —
+        ``_build_lookahead_payload`` is acceptor-agnostic).
+
+        Still excluded: ADAPTIVE StochasticAcceptor configs (pdf-norm
+        feedback from records / Temperature schemes — delayed acceptance
+        would need the full temperature recursion re-run host-side) and
+        learned-sumstat distances (the feature transform refits between
+        generations, so shipped raw statistics would need the new
+        transform AND the scale refit — the fused loop owns that
+        configuration)."""
+        from ..acceptor import StochasticAcceptor
+        from ..acceptor.pdf_norm import pdf_norm_from_kernel
         from ..broker.sampler import ElasticSampler
         from ..distance import AdaptivePNormDistance
+        from ..distance.kernel import StochasticKernel
+        from ..epsilon import ListTemperature
 
+        self._lookahead_stochastic = False
         if not (isinstance(self.sampler, ElasticSampler)
                 and self.sampler.look_ahead):
             return False
@@ -2604,10 +2632,22 @@ class ABCSMC:
             # enabling look-ahead would silently override the user's
             # static quotas / complete-record guarantees
             return False
+        d = self.distance_function
+        if type(self.acceptor) is StochasticAcceptor:
+            if not isinstance(self.eps, ListTemperature):
+                return False
+            if self.acceptor.pdf_norm_method is not pdf_norm_from_kernel:
+                return False
+            if not isinstance(d, StochasticKernel):
+                return False
+            # kernel value recorded at simulation time is reusable
+            # (static kernel), so no host-side distance recompute
+            self._lookahead_recompute = False
+            self._lookahead_stochastic = True
+            return True
         if type(self.acceptor) is not UniformAcceptor \
                 or self.acceptor.use_complete_history:
             return False
-        d = self.distance_function
         if type(d) is AdaptivePNormDistance and d.sumstat is None:
             self._lookahead_recompute = True
         elif type(d) is PNormDistance and d.sumstat is None:

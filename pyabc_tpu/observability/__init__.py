@@ -27,15 +27,28 @@ Enablement: everything defaults to the no-op :data:`NULL_TRACER` /
 compiled device code is never touched, so fused kernels are
 byte-identical with observability on or off.
 """
-from .clock import Clock, SystemClock, VirtualClock, SYSTEM_CLOCK
+from .clock import (
+    Clock,
+    ClockOffsetEstimator,
+    SystemClock,
+    VirtualClock,
+    SYSTEM_CLOCK,
+)
 from .coverage import (
     coverage_report,
     device_busy_spans,
+    elastic_gap_attribution,
     interval_intersection,
     interval_union,
     window_throughput,
 )
-from .export import JsonlTraceExporter, prometheus_text, read_trace
+from .export import (
+    JsonlTraceExporter,
+    prometheus_text,
+    read_trace,
+    worker_trace_spans,
+    write_trace,
+)
 from .metrics import (
     Counter,
     Gauge,
@@ -56,17 +69,20 @@ import os as _os
 import threading as _threading
 
 __all__ = [
-    "Clock", "SystemClock", "VirtualClock", "SYSTEM_CLOCK",
+    "Clock", "ClockOffsetEstimator", "SystemClock", "VirtualClock",
+    "SYSTEM_CLOCK",
     "Span", "Tracer", "NullTracer", "NULL_TRACER",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "NullMetrics",
     "NULL_METRICS",
     "JsonlTraceExporter", "prometheus_text", "read_trace",
-    "coverage_report", "device_busy_spans", "interval_intersection",
-    "interval_union", "window_throughput",
+    "worker_trace_spans", "write_trace",
+    "coverage_report", "device_busy_spans", "elastic_gap_attribution",
+    "interval_intersection", "interval_union", "window_throughput",
     "SyncLedger", "NullSyncLedger", "NULL_SYNC_LEDGER",
     "DEFAULT_SYNC_FLOOR_S",
     "default_tracer", "global_metrics", "global_tracer",
     "set_global_tracer", "observability_snapshot",
+    "register_worker_source", "unregister_worker_source",
 ]
 
 _lock = _threading.Lock()
@@ -117,10 +133,57 @@ def global_metrics() -> MetricsRegistry:
         return _global_metrics
 
 
+#: weakly-referenced providers of elastic-worker state: each entry is a
+#: weakref to an object with ``worker_snapshot() -> dict`` (the
+#: EvalBroker registers itself on construction). Dead refs are pruned on
+#: read, so a broker that was garbage-collected silently drops out.
+_worker_sources: list = []
+
+
+def register_worker_source(source) -> None:
+    """Register an object exposing ``worker_snapshot()`` (per-worker
+    liveness / clock offsets / last errors) with the process-wide
+    snapshot, via weakref — the dashboard's ``/api/observability`` then
+    shows the elastic pool without the broker leaking through module
+    state."""
+    import weakref
+
+    with _lock:
+        _worker_sources.append(weakref.ref(source))
+
+
+def unregister_worker_source(source) -> None:
+    with _lock:
+        _worker_sources[:] = [
+            r for r in _worker_sources
+            if r() is not None and r() is not source
+        ]
+
+
+def _workers_snapshot() -> dict:
+    out: dict = {}
+    with _lock:
+        refs = list(_worker_sources)
+    for r in refs:
+        src = r()
+        if src is None:
+            continue
+        try:
+            out.update(src.worker_snapshot())
+        except Exception:  # snapshotting must never kill the dashboard
+            pass
+    with _lock:
+        _worker_sources[:] = [r for r in _worker_sources if r() is not None]
+    return out
+
+
 def observability_snapshot() -> dict:
     """One JSON-ready dict of the process's tracer + metrics state —
-    the in-process snapshot API (dashboard endpoint, bench block)."""
+    the in-process snapshot API (dashboard endpoint, bench block).
+    ``workers`` carries the elastic pool's per-worker liveness, clock
+    offsets and last errors when a broker is live in this process."""
     return {
         "tracer": global_tracer().snapshot(),
         "metrics": global_metrics().snapshot(),
+        "workers": _workers_snapshot(),
     }

@@ -59,6 +59,75 @@ class VirtualClock(Clock):
         return self._t
 
 
+class ClockOffsetEstimator:
+    """NTP-style offset of a REMOTE clock against a local one.
+
+    Elastic workers are separate processes (possibly separate machines),
+    so their monotonic clocks share no epoch with the orchestrator's.
+    Every request/response exchange yields one offset sample: the local
+    peer sends at ``t1``, the remote stamps its clock at ``t2`` while
+    handling, and the reply lands locally at ``t4``. Assuming the
+    transport is symmetric, the remote handled the request at the RTT
+    midpoint, so
+
+        offset = t2 - (t1 + t4) / 2      (remote = local + offset)
+
+    with worst-case error bounded by half the round trip,
+
+        uncertainty = (t4 - t1) / 2
+
+    (the classic NTP bound: the true offset lies in
+    ``[t2 - t4, t2 - t1]`` whatever the asymmetry). ``add_sample`` keeps
+    the MINIMUM-RTT sample of a sliding window — the exchange least
+    delayed by queueing is the one whose midpoint assumption is
+    tightest — so a single congested round trip can't poison the
+    estimate. Pure float bookkeeping, no locks: each worker thread owns
+    its estimator.
+    """
+
+    __slots__ = ("window", "_samples", "offset", "uncertainty_s", "rtt_s",
+                 "n_samples")
+
+    def __init__(self, window: int = 64):
+        self.window = int(window)
+        self._samples: list[tuple[float, float]] = []  # (rtt, offset)
+        self.offset: float | None = None
+        self.uncertainty_s: float | None = None
+        self.rtt_s: float | None = None
+        self.n_samples = 0
+
+    def add_sample(self, t1: float, t2_remote: float, t4: float) -> None:
+        rtt = float(t4) - float(t1)
+        if rtt < 0:  # a stepped/broken local clock; drop the sample
+            return
+        self.n_samples += 1
+        self._samples.append(
+            (rtt, float(t2_remote) - (float(t1) + float(t4)) / 2.0)
+        )
+        if len(self._samples) > self.window:
+            del self._samples[: len(self._samples) - self.window]
+        best_rtt, best_off = min(self._samples)
+        self.offset = best_off
+        self.rtt_s = best_rtt
+        self.uncertainty_s = best_rtt / 2.0
+
+    def to_remote(self, t_local: float) -> float:
+        """Map a local-clock instant onto the remote timebase."""
+        return float(t_local) + (self.offset or 0.0)
+
+    def to_local(self, t_remote: float) -> float:
+        """Map a remote-clock instant onto the local timebase."""
+        return float(t_remote) - (self.offset or 0.0)
+
+    def summary(self) -> dict:
+        return {
+            "offset_s": self.offset,
+            "uncertainty_s": self.uncertainty_s,
+            "rtt_s": self.rtt_s,
+            "n_samples": self.n_samples,
+        }
+
+
 #: process-wide default — share ONE instance so timestamps from
 #: different subsystems (tracer spans, bench events, broker deadlines)
 #: live on the same timebase and can be compared directly

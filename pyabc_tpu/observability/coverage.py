@@ -159,6 +159,84 @@ def device_busy_spans(probe_events, thread: str = "device") -> list[dict]:
     return spans
 
 
+#: worker/orchestrator span names -> elastic dark-time category. The
+#: categories mirror the fused path's gap_attribution: where the fused
+#: decomposition splits dark time into device-busy vs tunnel floor, the
+#: elastic one splits it into what the WORKERS were doing (their spans
+#: arrive offset-mapped onto the orchestrator timeline) plus the
+#: orchestrator's own polling exposure.
+ELASTIC_CATEGORIES = {
+    "worker.simulate": "worker_compute",
+    "worker.deserialize": "serialization",
+    "worker.serialize": "serialization",
+    "worker.slots": "broker_rtt",
+    "worker.ship": "broker_rtt",
+    "worker.connect": "queue_wait",
+    "worker.wait": "queue_wait",
+    "broker.poll_latency": "orchestrator_poll",
+}
+
+
+def elastic_gap_attribution(spans, t0: float | None = None,
+                            t1: float | None = None) -> dict:
+    """Decompose an elastic-path window into worker compute /
+    serialization / broker RTT / queue wait / orchestrator poll.
+
+    ``spans``: a merged trace — orchestrator spans plus worker spans
+    already offset-mapped onto the orchestrator clock (``Span`` objects
+    or dicts). Category seconds are interval UNIONS within the category
+    clipped to ``[t0, t1]``: two workers simulating concurrently count
+    the covered wall clock once, like the coverage accountant's
+    per-thread math. Categories overlap each other (worker A can
+    simulate while worker B waits), so the fractions need not sum to 1;
+    ``attributed_frac`` is the union over every span (the elastic
+    analog of ``steady_attributed_frac``).
+    """
+    ivs_by_cat: dict[str, list] = {}
+    all_ivs: list[tuple[float, float]] = []
+    named = []
+    for sp in spans:
+        name = sp.get("name") if isinstance(sp, dict) else sp.name
+        iv = _as_interval(sp)
+        if iv is None:
+            continue
+        named.append((name, iv))
+        all_ivs.append((iv[0], iv[1]))
+    if not all_ivs:
+        return {"window_s": 0.0, "attributed_frac": 0.0, "dark_s": 0.0,
+                "categories": {}, "n_spans": 0}
+    lo = min(a for a, _b in all_ivs) if t0 is None else float(t0)
+    hi = max(b for _a, b in all_ivs) if t1 is None else float(t1)
+    window = max(hi - lo, 0.0)
+    for name, (a, b, _thread) in named:
+        cat = ELASTIC_CATEGORIES.get(name)
+        if cat is None:
+            continue
+        a2, b2 = max(a, lo), min(b, hi)
+        if b2 > a2:
+            ivs_by_cat.setdefault(cat, []).append((a2, b2))
+    clipped_all = [(max(a, lo), min(b, hi)) for a, b in all_ivs
+                   if min(b, hi) > max(a, lo)]
+    attributed = interval_union(clipped_all)
+    categories = {}
+    for cat in ("worker_compute", "serialization", "broker_rtt",
+                "queue_wait", "orchestrator_poll"):
+        sec = interval_union(ivs_by_cat.get(cat, []))
+        categories[cat] = {
+            "s": round(sec, 6),
+            "frac": round(sec / window, 6) if window > 0 else 0.0,
+        }
+    return {
+        "t0": lo, "t1": hi, "window_s": round(window, 6),
+        "attributed_s": round(attributed, 6),
+        "attributed_frac": round(attributed / window, 6)
+        if window > 0 else 0.0,
+        "dark_s": round(window - attributed, 6),
+        "categories": categories,
+        "n_spans": len(named),
+    }
+
+
 def window_throughput(events, t0: float, t_end: float,
                       window_s: float) -> dict:
     """Strict global-completion-clock throughput over ``[t0, t_end]``.

@@ -59,6 +59,35 @@ def read_trace(path: str) -> list[dict]:
     return spans
 
 
+def worker_trace_spans(spans) -> list[dict]:
+    """The worker-side subset of a merged trace: spans accounted on a
+    ``worker:<id>`` pseudo-thread (the broker's offset-mapped merge) or
+    named ``worker.*``/``broker.poll_latency``. Accepts Span objects or
+    dicts; returns dicts, coverage-accountant ready."""
+    out = []
+    for sp in spans:
+        d = sp.to_dict() if hasattr(sp, "to_dict") else dict(sp)
+        if (str(d.get("thread", "")).startswith("worker:")
+                or str(d.get("name", "")).startswith("worker.")
+                or d.get("name") == "broker.poll_latency"):
+            out.append(d)
+    return out
+
+
+def write_trace(path: str, spans) -> int:
+    """Bulk-dump spans (objects or dicts) to a JSONL trace file; returns
+    the span count. Complements the streaming :class:`JsonlTraceExporter`
+    for after-the-fact exports (e.g. the bench's per-run worker trace)."""
+    n = 0
+    with open(path, "a") as fh:
+        for sp in spans:
+            fh.write(json.dumps(
+                sp.to_dict() if hasattr(sp, "to_dict") else dict(sp)
+            ) + "\n")
+            n += 1
+    return n
+
+
 def _prom_name(name: str) -> str:
     out = "".join(c if c.isalnum() or c == "_" else "_" for c in name)
     return out if not out[:1].isdigit() else "_" + out

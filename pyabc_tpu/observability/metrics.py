@@ -142,6 +142,35 @@ class Histogram:
             }
 
 
+# -- elastic-worker instrument names (the pyabc_tpu_worker_* family) ---------
+#
+# One canonical place for the Prometheus names the broker path exports so
+# the sampler, bench and dashboard agree (ElasticSampler sets them, the
+# text exposition renders them):
+#:  number of workers the broker currently knows (heard from at all)
+WORKER_KNOWN_GAUGE = "pyabc_tpu_worker_known"
+#:  workers heard from within the liveness window (default 5 s)
+WORKER_ALIVE_GAUGE = "pyabc_tpu_worker_alive"
+#:  handed-out evaluation slots not yet delivered (broker queue depth)
+WORKER_QUEUE_DEPTH_GAUGE = "pyabc_tpu_worker_queue_depth"
+#:  worker evaluations reported via trace summaries (all workers)
+WORKER_EVALS_COUNTER = "pyabc_tpu_worker_evals"
+#:  per-worker delivered-results throughput; suffixed per worker id
+WORKER_THROUGHPUT_GAUGE = "pyabc_tpu_worker_results_per_s"
+#:  largest |clock offset| / offset uncertainty over reporting workers
+WORKER_CLOCK_OFFSET_GAUGE = "pyabc_tpu_worker_clock_offset_max_abs_s"
+WORKER_CLOCK_UNC_GAUGE = "pyabc_tpu_worker_clock_uncertainty_max_s"
+
+
+def per_worker_metric(base: str, worker_id: str) -> str:
+    """A per-worker instrument name: ``base`` suffixed with the worker id
+    sanitized to Prometheus charset (worker ids carry hostnames/uuids).
+    Cardinality is bounded by the pool size, which is small by design."""
+    wid = "".join(c if c.isalnum() or c == "_" else "_"
+                  for c in str(worker_id))
+    return f"{base}_{wid}"
+
+
 class MetricsRegistry:
     """Named instruments; get-or-create semantics, thread-safe."""
 

@@ -131,6 +131,27 @@ class Tracer:
         stack = getattr(self._local, "stack", None)
         return stack[-1] if stack else None
 
+    def record_span(self, name: str, start: float, end: float, *,
+                    thread: str | None = None, **attrs) -> Span:
+        """Record an externally-timed, already-finished span.
+
+        The merge seam for measurements taken OUTSIDE this process on a
+        foreign clock (elastic worker phases, device-busy streams): the
+        caller maps the interval onto this tracer's timebase first (e.g.
+        via the broker's per-worker clock-offset estimate) and hands over
+        plain ``[start, end]`` floats. ``thread`` names the pseudo-thread
+        the span is accounted under in :func:`~pyabc_tpu.observability.
+        coverage.coverage_report` (e.g. ``worker:<id>``); it defaults to
+        the calling thread. The span does not touch the per-thread
+        nesting stack — it never becomes anyone's parent."""
+        sp = Span(name, next(self._ids), None,
+                  thread if thread is not None
+                  else threading.current_thread().name,
+                  float(start), attrs)
+        sp.end = float(end)
+        self._store(sp)
+        return sp
+
     def spans(self) -> list[Span]:
         """Snapshot of finished spans (chronological by end time)."""
         with self._lock:
@@ -170,6 +191,9 @@ class Tracer:
                 top = stack.pop()
                 if top is sp:
                     break
+        self._store(sp)
+
+    def _store(self, sp: Span) -> None:
         with self._lock:
             self._finished.append(sp)
             if len(self._finished) > self._max_spans:
@@ -231,6 +255,10 @@ class NullTracer:
 
     def span(self, name: str, **attrs) -> _NullSpanContext:
         return _NULL_SPAN_CONTEXT
+
+    def record_span(self, name: str, start: float, end: float, *,
+                    thread: str | None = None, **attrs) -> _NullSpan:
+        return _NULL_SPAN
 
     def current_span(self) -> None:
         return None

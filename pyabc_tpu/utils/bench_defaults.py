@@ -36,3 +36,18 @@ DEFAULT_WINDOW_S = 2.0
 # leaving most of the lane budget to steady generations.
 DEFAULT_SCALE_POP = 16384
 DEFAULT_SCALE_GENS = 12
+# elastic lane (round 8): worker-tracing attribution on the broker path.
+# A host-model gauss config — the lane measures ATTRIBUTION (worker
+# compute / serialization / broker RTT / queue wait / orchestrator poll
+# fracs and the >=0.9 attributed-fraction guard), not throughput, so it
+# runs fine on the CPU probe. 2 workers x 3 generations x pop 120 with a
+# 2 ms simulate keeps a warm run a few seconds; run 0 is warm-up (worker
+# process startup + first connects), runs >= 1 are the guarded ones.
+DEFAULT_ELASTIC_POP = 120
+DEFAULT_ELASTIC_GENS = 3
+DEFAULT_ELASTIC_WORKERS = 2
+DEFAULT_ELASTIC_RUNS = 3
+DEFAULT_ELASTIC_SIM_DELAY_S = 0.002
+#: regression guard: minimum attributed fraction of a warm elastic run's
+#: wall clock (ISSUE round 8 acceptance: >= 0.9 on the CPU probe)
+ELASTIC_ATTRIBUTED_FRAC_MIN = 0.9

@@ -173,10 +173,17 @@ class AbcDashboard:
         """This PROCESS's tracer/metrics snapshot (span counts + totals
         per name, instrument values) — live when the dashboard is
         embedded next to a running inference (``serve(block=False)``);
-        an out-of-process dashboard reports its own (empty) state."""
+        an out-of-process dashboard reports its own (empty) state.
+
+        Round 8: the snapshot's ``workers`` section surfaces the elastic
+        pool when a broker is live in-process — per-worker liveness
+        (idle age, presumed_dead), clock offset + RTT uncertainty,
+        throughput counters, last error and departure tombstones — so a
+        stalled ``broker.wait()`` diagnoses from the dashboard instead
+        of a dark poll loop."""
         from ..observability import observability_snapshot
 
-        return json.dumps(observability_snapshot())
+        return json.dumps(observability_snapshot(), default=str)
 
     def populations_json(self, run_id: int) -> str:
         h = self._history(run_id)

@@ -360,6 +360,9 @@ def test_visserver_observability_endpoint():
             assert payload["tracer"]["spans_by_name"]["probe_span"][
                 "count"] == 1
             assert payload["metrics"]["probe_counter"] == 2.0
+            # round 8: the elastic-pool section is always present
+            # (empty unless a broker is live in-process)
+            assert isinstance(payload["workers"], dict)
         finally:
             httpd.shutdown()
             httpd.server_close()

@@ -2,10 +2,15 @@
 
 Two rules, enforced on source text at collection time:
 
-1. Instrumented modules must not call ``time.time()`` directly — all
-   host timing goes through the injected clock
-   (``pyabc_tpu.observability.clock``), so spans and deadlines are
-   immune to wall-clock steps and tests can drive a VirtualClock.
+1. Instrumented modules must not call ``time.time()`` (or
+   ``time.perf_counter()``) directly — all host timing goes through the
+   injected clock (``pyabc_tpu.observability.clock``), so spans and
+   deadlines are immune to wall-clock steps and tests can drive a
+   VirtualClock. Round 8 hardened this for the newly instrumented
+   elastic path: the broker trio (broker/worker/sampler + the wire
+   protocol) is PINNED in the list below — worker-side spans and the
+   NTP-style offset samples are only mergeable because every timestamp
+   on both sides of the wire comes from an injected clock.
 2. No new ``phase_timings``-style ad-hoc telemetry containers outside
    ``pyabc_tpu/observability/`` — named span/metric instruments replace
    scatter-shot timing dicts, so every measurement has one schema, one
@@ -23,13 +28,24 @@ INSTRUMENTED = [
     "pyabc_tpu/inference/smc.py",
     "pyabc_tpu/sampler/batched.py",
     "pyabc_tpu/broker/broker.py",
+    "pyabc_tpu/broker/protocol.py",
     "pyabc_tpu/broker/sampler.py",
     "pyabc_tpu/broker/worker.py",
     "pyabc_tpu/storage/history.py",
     "pyabc_tpu/cli.py",
 ]
 
-_TIME_TIME = re.compile(r"\btime\.time\(")
+#: the distributed-tracing path: dropping any of these from INSTRUMENTED
+#: would let raw-clock regressions silently corrupt the worker-span
+#: merge (offsets are estimated between INJECTED clocks only)
+TRACING_CRITICAL = {
+    "pyabc_tpu/broker/broker.py",
+    "pyabc_tpu/broker/protocol.py",
+    "pyabc_tpu/broker/sampler.py",
+    "pyabc_tpu/broker/worker.py",
+}
+
+_TIME_TIME = re.compile(r"\btime\.(?:time|perf_counter)\(")
 _AD_HOC = re.compile(
     r"\b(?:phase|stage|step)_timings?\b|\bspan_math\b|\btelemetry_clock\b"
 )
@@ -54,9 +70,22 @@ def test_instrumented_modules_use_injected_clock():
             if _TIME_TIME.search(line):
                 offenders.append(f"{rel}:{lineno}: {line.strip()}")
     assert not offenders, (
-        "direct time.time() calls in instrumented modules (use the "
-        "observability clock — pyabc_tpu.observability.SYSTEM_CLOCK or "
-        "the tracer's injected clock):\n" + "\n".join(offenders)
+        "direct time.time()/time.perf_counter() calls in instrumented "
+        "modules (use the observability clock — pyabc_tpu.observability."
+        "SYSTEM_CLOCK or the tracer's injected clock):\n"
+        + "\n".join(offenders)
+    )
+
+
+def test_tracing_critical_modules_stay_pinned():
+    """The elastic-path tracing modules cannot be dropped from the
+    enforced list: worker spans are merged onto the orchestrator
+    timeline via clock offsets estimated between INJECTED clocks, so a
+    single raw time.time() on either side of the wire would skew every
+    merged span."""
+    missing = TRACING_CRITICAL - set(INSTRUMENTED)
+    assert not missing, (
+        f"tracing-critical modules missing from INSTRUMENTED: {missing}"
     )
 
 

@@ -1,0 +1,525 @@
+"""Distributed tracing across the broker/worker boundary (round 8).
+
+Worker processes record their own phase spans (connect / wait /
+deserialize / simulate / serialize / ship) on an injected clock,
+piggyback the summaries on existing result messages, and estimate their
+clock offset against the broker NTP-style from stamped request/response
+exchanges. The broker ingests, offset-maps and hands the spans to the
+sampler as per-worker pseudo-threads; the elastic gap accountant then
+decomposes broker-path dark time. Tested here: the offset math under
+deliberate clock skew (merged spans must land within the RTT-derived
+uncertainty), protocol backward compatibility (pre-tracing workers),
+and the end-to-end merge with real worker subprocesses.
+"""
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import pyabc_tpu as pt
+from pyabc_tpu.broker.broker import EvalBroker
+from pyabc_tpu.broker.worker import (
+    WorkerSpanRecorder,
+    _broker_stamp,
+    run_worker,
+)
+from pyabc_tpu.observability import (
+    ClockOffsetEstimator,
+    Tracer,
+    VirtualClock,
+    elastic_gap_attribution,
+    worker_trace_spans,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+WORKER_CODE = (
+    "from pyabc_tpu.broker import run_worker; "
+    "import sys; run_worker('127.0.0.1', int(sys.argv[1]))"
+)
+
+
+def _spawn_worker(port):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.Popen(
+        [sys.executable, "-c", WORKER_CODE, str(port)], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+
+
+# ---------------------------------------------------------------- offsets
+class _OffsetClock(VirtualClock):
+    """A worker clock: the broker's virtual clock plus a fixed skew
+    (separate monotonic epochs)."""
+
+    def __init__(self, base: VirtualClock, skew: float):
+        self._base = base
+        self._skew = float(skew)
+
+    def now(self):
+        return self._base.now() + self._skew
+
+    def wall(self):
+        return self.now()
+
+
+def test_offset_estimator_symmetric_exchange_is_exact():
+    base = VirtualClock(100.0)
+    wclock = _OffsetClock(base, 1000.0)
+    est = ClockOffsetEstimator()
+    t1 = wclock.now()
+    base.advance(0.005)            # request wire latency
+    t2 = base.now()                # broker stamps its clock
+    base.advance(0.005)            # reply wire latency
+    t4 = wclock.now()
+    est.add_sample(t1, t2, t4)
+    # symmetric latencies: the midpoint assumption is exact
+    assert est.offset == pytest.approx(-1000.0, abs=1e-12)
+    assert est.uncertainty_s == pytest.approx(0.005)
+    assert est.rtt_s == pytest.approx(0.01)
+    # mapping round-trips
+    assert est.to_local(est.to_remote(42.0)) == pytest.approx(42.0)
+
+
+def test_offset_estimator_error_bounded_by_uncertainty_under_asymmetry():
+    base = VirtualClock(0.0)
+    wclock = _OffsetClock(base, -37.5)
+    est = ClockOffsetEstimator()
+    # pathologically asymmetric exchange: 9 ms out, 1 ms back
+    t1 = wclock.now()
+    base.advance(0.009)
+    t2 = base.now()
+    base.advance(0.001)
+    t4 = wclock.now()
+    est.add_sample(t1, t2, t4)
+    true_offset = 37.5  # broker = worker + 37.5
+    assert abs(est.offset - true_offset) <= est.uncertainty_s + 1e-12
+
+
+def test_offset_estimator_prefers_min_rtt_sample():
+    est = ClockOffsetEstimator()
+    # congested exchange: huge RTT, poor estimate
+    est.add_sample(0.0, 100.0, 2.0)
+    congested = est.offset
+    assert est.uncertainty_s == pytest.approx(1.0)
+    # clean exchange afterwards: tiny RTT wins regardless of order
+    est.add_sample(10.0, 110.0005, 10.001)
+    assert est.uncertainty_s == pytest.approx(0.0005)
+    assert est.offset != congested
+    assert est.offset == pytest.approx(100.0, abs=1e-6)
+    assert est.n_samples == 2
+
+
+def test_offset_estimator_drops_negative_rtt():
+    est = ClockOffsetEstimator()
+    est.add_sample(5.0, 100.0, 4.0)  # local clock stepped backwards
+    assert est.offset is None and est.n_samples == 0
+
+
+def test_broker_stamp_distinguishes_reply_shapes():
+    assert _broker_stamp(("ok",)) is None
+    assert _broker_stamp(("slots", 0, 5)) is None
+    assert _broker_stamp(("work", 1, 0, b"p", 5, "dynamic")) is None
+    assert _broker_stamp(("error", "boom")) is None
+    assert _broker_stamp(("ok", 12.5)) == 12.5
+    assert _broker_stamp(("slots", 0, 5, 3.25)) == 3.25
+
+
+# ----------------------------------------------------------- recorder
+def test_worker_span_recorder_phases_and_drain():
+    clock = VirtualClock(50.0)
+    rec = WorkerSpanRecorder("w0", clock)
+    tok = rec.begin("worker.simulate")
+    clock.advance(0.25)
+    rec.end(tok, n_eval=7)
+    tok = rec.begin("worker.serialize")
+    clock.advance(0.01)
+    rec.end(tok, nbytes=123)
+    rec.offset.add_sample(0.0, 100.0, 0.002)
+    payload = rec.trace_payload()
+    assert payload["v"] == 1
+    assert [s["name"] for s in payload["spans"]] == [
+        "worker.simulate", "worker.serialize"]
+    sim = payload["spans"][0]
+    assert sim["start"] == pytest.approx(50.0)
+    assert sim["end"] == pytest.approx(50.25)
+    assert sim["attrs"]["n_eval"] == 7
+    assert payload["offset"] == pytest.approx(100.0 - 0.001)
+    # drained: the next payload ships only NEW spans
+    assert rec.trace_payload()["spans"] == []
+
+
+def test_worker_span_recorder_bounded_pending():
+    clock = VirtualClock()
+    rec = WorkerSpanRecorder("w0", clock, max_pending=10)
+    for _ in range(25):
+        tok = rec.begin("worker.simulate")
+        clock.advance(0.001)
+        rec.end(tok)
+    assert len(rec.trace_payload(limit=100)["spans"]) == 10
+    assert rec.n_dropped == 15
+
+
+def test_record_span_lands_on_pseudo_thread_and_exporter():
+    class Sink:
+        def __init__(self):
+            self.spans = []
+
+        def export(self, sp):
+            self.spans.append(sp)
+
+    sink = Sink()
+    tracer = Tracer(exporter=sink)
+    sp = tracer.record_span("worker.simulate", 10.0, 11.5,
+                            thread="worker:abc", worker_id="abc")
+    assert sp.thread == "worker:abc"
+    assert sp.duration == pytest.approx(1.5)
+    assert tracer.spans()[-1] is sp
+    assert sink.spans == [sp]
+    # the null tracer records nothing, cheaply
+    null = pt.NullTracer()
+    assert null.record_span("x", 0.0, 1.0).duration == 0.0
+    assert null.spans() == []
+
+
+# ------------------------------------------------- broker-side ingestion
+def _exchange(broker, base, wclock, rec, msg, latency=0.001):
+    """One simulated stamped round trip over skewed virtual clocks."""
+    t1 = wclock.now()
+    base.advance(latency)
+    reply = broker._dispatch(msg + (t1,))
+    base.advance(latency)
+    rec.observe_exchange(t1, _broker_stamp(reply), wclock.now())
+    return reply
+
+
+def test_skewed_worker_spans_merge_within_uncertainty():
+    """Inject a worker clock 1000 s ahead of the broker's; after
+    offset calibration from stamped exchanges, merged spans must land on
+    the broker timeline within the RTT-derived uncertainty window."""
+    base = VirtualClock(10.0)
+    broker = EvalBroker("127.0.0.1", 0, clock=base)
+    try:
+        broker.start_generation(0, b"payload", 4, batch=4)
+        gen = broker._gen
+        skew = 1000.0
+        wclock = _OffsetClock(base, skew)
+        rec = WorkerSpanRecorder("skewed", wclock)
+        _exchange(broker, base, wclock, rec, ("hello", "skewed"))
+        _exchange(broker, base, wclock, rec,
+                  ("get_slots", "skewed", gen, 4))
+        assert rec.offset.offset == pytest.approx(-skew, abs=1e-9)
+        # a simulate span on the worker clock; remember its TRUE broker-
+        # clock interval for the merge assertion
+        tok = rec.begin("worker.simulate")
+        true_start = base.now()
+        base.advance(0.5)
+        rec.end(tok, n_eval=4)
+        true_end = base.now()
+        trace = rec.trace_payload()
+        reply = broker._dispatch(
+            ("results", "skewed", gen,
+             [(i, b"p", True) for i in range(4)], trace)
+        )
+        assert reply[0] == "done"  # 4 acceptances met the target
+        spans = broker.drain_worker_spans()
+        sim = [s for s in spans if s["name"] == "worker.simulate"]
+        assert len(sim) == 1
+        unc = trace["offset_unc"]
+        assert unc is not None and unc > 0
+        assert abs(sim[0]["start"] - true_start) <= unc + 1e-9
+        assert abs(sim[0]["end"] - true_end) <= unc + 1e-9
+        assert sim[0]["thread"] == "worker:skewed"
+        assert sim[0]["attrs"]["clock_offset_unc_s"] == unc
+        # per-worker offset surfaced for the bench / dashboard
+        offs = broker.worker_offsets()
+        assert offs["skewed"]["offset_s"] == pytest.approx(-skew,
+                                                           abs=1e-9)
+        # drain is a take: second call returns nothing
+        assert broker.drain_worker_spans() == []
+    finally:
+        broker.stop()
+
+
+def test_pre_tracing_worker_interoperates_with_new_broker():
+    """Old-style messages (no trailing elements) get the exact legacy
+    reply shapes — no stamps, no trace expectations — and the broker
+    keeps full bookkeeping for them (protocol back-compat)."""
+    broker = EvalBroker("127.0.0.1", 0)
+    try:
+        broker.start_generation(0, b"payload", 2, batch=5)
+        gen = broker._gen
+        reply = broker._dispatch(("hello", "legacy"))
+        assert reply == ("work", gen, 0, b"payload", 5, "dynamic")
+        reply = broker._dispatch(("get_slots", "legacy", gen, 5))
+        assert reply == ("slots", 0, 5)
+        reply = broker._dispatch(("heartbeat", "legacy", gen))
+        assert reply == ("ok",)
+        reply = broker._dispatch(
+            ("results", "legacy", gen, [(0, b"p", True)]))
+        assert reply == ("ok",)
+        # degraded-mode attribution: no spans, no offsets — gracefully
+        assert broker.drain_worker_spans() == []
+        assert broker.worker_offsets() == {}
+        st = broker.status()
+        assert st.workers["legacy"]["n_results"] == 1
+        assert not st.workers["legacy"].get("trace", False)
+        assert broker._dispatch(("bye", "legacy")) == ("ok",)
+        assert broker.status().departed["legacy"]["reason"] == "bye"
+    finally:
+        broker.stop()
+
+
+def test_run_worker_no_trace_speaks_legacy_protocol():
+    """run_worker(trace=False) against the new broker: the run completes
+    and the broker ingests zero spans (degraded mode end to end). The
+    worker runs in a thread via the _stop_check seam."""
+    import cloudpickle
+
+    from pyabc_tpu.core.population import Particle
+
+    broker = EvalBroker("127.0.0.1", 0)
+    stop = threading.Event()
+    try:
+        def simulate_one():
+            return Particle(m=0, parameter={"x": 1.0}, weight=1.0,
+                            sum_stat={}, distance=0.1, accepted=True)
+
+        broker.start_generation(
+            0, cloudpickle.dumps(simulate_one), 6, batch=3)
+        th = threading.Thread(
+            target=run_worker,
+            args=("127.0.0.1", broker.address[1]),
+            kwargs=dict(worker_id="legacy-w", trace=False, poll_s=0.05,
+                        _stop_check=stop.is_set),
+        )
+        th.start()
+        triples = broker.wait(timeout=30.0)
+        assert len(triples) >= 6
+        assert broker.drain_worker_spans() == []
+        st = broker.status()
+        assert st.workers["legacy-w"]["n_results"] >= 6
+        assert "clock_offset_s" not in st.workers["legacy-w"]
+    finally:
+        stop.set()
+        th.join(timeout=10)
+        broker.stop()
+
+
+def test_status_surfaces_last_error_and_presumed_dead():
+    clock = VirtualClock(0.0)
+    broker = EvalBroker("127.0.0.1", 0, clock=clock, liveness_s=5.0)
+    try:
+        broker.start_generation(0, b"payload", 100, batch=5)
+        gen = broker._gen
+        broker._dispatch(("hello", "w1", clock.now()))
+        trace = {"v": 1, "spans": [], "offset": 0.0, "offset_unc": 1e-4,
+                 "rtt": 2e-4, "last_error": "RuntimeError('model blew up')",
+                 "n_eval": 10, "n_acc": 0}
+        broker._dispatch(("results", "w1", gen, [], trace))
+        st = broker.status()
+        assert st.workers["w1"]["last_error"] == (
+            "RuntimeError('model blew up')")
+        assert not st.workers["w1"]["presumed_dead"]
+        # the worker goes silent mid-generation: flagged after the
+        # liveness window (the wait()-stalls-dark diagnosis)
+        clock.advance(6.0)
+        st = broker.status()
+        assert st.workers["w1"]["presumed_dead"]
+        assert st.workers["w1"]["idle_s"] >= 5.0
+        # worker_snapshot (the /api/observability section) carries it too
+        snap = broker.worker_snapshot()
+        assert snap["w1"]["presumed_dead"]
+        assert snap["w1"]["last_error"]
+        # a graceful bye leaves a tombstone with reason + error
+        broker._dispatch(("bye", "w1", "signal",
+                          {"v": 1, "spans": [], "offset": 0.0}))
+        st = broker.status()
+        assert "w1" not in st.workers
+        assert st.departed["w1"]["reason"] == "signal"
+        assert st.departed["w1"]["last_error"]
+    finally:
+        broker.stop()
+
+
+def test_observability_snapshot_includes_registered_broker_workers():
+    from pyabc_tpu.observability import observability_snapshot
+
+    broker = EvalBroker("127.0.0.1", 0)
+    try:
+        broker._dispatch(("hello", "snap-w", 0.0))
+        snap = observability_snapshot()
+        assert "snap-w" in snap["workers"]
+    finally:
+        broker.stop()
+    # stop() unregisters: a fresh snapshot no longer reports the pool
+    assert "snap-w" not in observability_snapshot()["workers"]
+
+
+# --------------------------------------------------- gap attribution math
+def test_elastic_gap_attribution_categories_and_union():
+    spans = [
+        # two workers computing concurrently: union, not sum
+        {"name": "worker.simulate", "thread": "worker:a",
+         "start": 0.0, "end": 4.0, "attrs": {}},
+        {"name": "worker.simulate", "thread": "worker:b",
+         "start": 2.0, "end": 6.0, "attrs": {}},
+        {"name": "worker.serialize", "thread": "worker:a",
+         "start": 6.0, "end": 6.5, "attrs": {}},
+        {"name": "worker.ship", "thread": "worker:a",
+         "start": 6.5, "end": 7.0, "attrs": {}},
+        {"name": "worker.wait", "thread": "worker:b",
+         "start": 6.0, "end": 8.0, "attrs": {}},
+        {"name": "broker.poll_latency", "thread": "MainThread",
+         "start": 8.0, "end": 8.5, "attrs": {}},
+        # uncategorized orchestrator work still counts as attributed
+        {"name": "persist", "thread": "MainThread",
+         "start": 8.5, "end": 9.0, "attrs": {}},
+    ]
+    rep = elastic_gap_attribution(spans, 0.0, 10.0)
+    assert rep["window_s"] == pytest.approx(10.0)
+    cats = rep["categories"]
+    assert cats["worker_compute"]["s"] == pytest.approx(6.0)  # union 0-6
+    assert cats["serialization"]["s"] == pytest.approx(0.5)
+    assert cats["broker_rtt"]["s"] == pytest.approx(0.5)
+    assert cats["queue_wait"]["s"] == pytest.approx(2.0)
+    assert cats["orchestrator_poll"]["s"] == pytest.approx(0.5)
+    assert rep["attributed_s"] == pytest.approx(9.0)
+    assert rep["dark_s"] == pytest.approx(1.0)
+    assert rep["attributed_frac"] == pytest.approx(0.9)
+
+
+def test_elastic_gap_attribution_clips_to_window():
+    spans = [{"name": "worker.simulate", "thread": "worker:a",
+              "start": -5.0, "end": 5.0, "attrs": {}}]
+    rep = elastic_gap_attribution(spans, 0.0, 10.0)
+    assert rep["categories"]["worker_compute"]["s"] == pytest.approx(5.0)
+    assert rep["attributed_frac"] == pytest.approx(0.5)
+
+
+def test_worker_trace_spans_filter():
+    spans = [
+        {"name": "worker.simulate", "thread": "worker:a", "start": 0,
+         "end": 1},
+        {"name": "broker.poll_latency", "thread": "MainThread",
+         "start": 1, "end": 2},
+        {"name": "persist", "thread": "MainThread", "start": 2, "end": 3},
+    ]
+    out = worker_trace_spans(spans)
+    assert [d["name"] for d in out] == ["worker.simulate",
+                                       "broker.poll_latency"]
+
+
+# ------------------------------------------------------- end-to-end merge
+def test_end_to_end_worker_spans_merge_and_decompose():
+    """Real worker subprocesses against a traced run: worker phase spans
+    arrive on per-worker pseudo-threads of the run tracer (piggybacked
+    on result messages — the worker makes no extra request kinds), the
+    poll-latency spans anchor on broker finalization, and the elastic
+    accountant decomposes the run with every category populated."""
+    tracer = Tracer()
+    s = pt.ElasticSampler(host="127.0.0.1", port=0, batch=5,
+                          generation_timeout=240.0)
+    port = s.address[1]
+    workers = [_spawn_worker(port) for _ in range(2)]
+    try:
+        def sim(pars):
+            time.sleep(0.002)
+            return {"x": pars["theta"] + 0.5 * np.random.normal()}
+
+        prior = pt.Distribution(theta=pt.RV("norm", 0.0, 1.0))
+        abc = pt.ABCSMC(pt.SimpleModel(sim, name="gauss_host"), prior,
+                        pt.PNormDistance(p=2), population_size=60,
+                        eps=pt.QuantileEpsilon(initial_epsilon=1.5,
+                                               alpha=0.5),
+                        sampler=s, seed=4, tracer=tracer)
+        abc.new("sqlite://", {"x": 1.0})
+        h = abc.run(max_nr_populations=2)
+        assert h.n_populations == 2
+
+        spans = [sp.to_dict() for sp in tracer.spans()]
+        wthreads = {d["thread"] for d in spans
+                    if d["thread"].startswith("worker:")}
+        assert len(wthreads) == 2, f"worker pseudo-threads: {wthreads}"
+        names = {d["name"] for d in spans}
+        for phase in ("worker.connect", "worker.deserialize",
+                      "worker.simulate", "worker.serialize",
+                      "worker.ship", "worker.slots",
+                      "broker.poll_latency"):
+            assert phase in names, f"missing {phase} in {sorted(names)}"
+        # every merged span carries its offset calibration
+        wspans = [d for d in spans if d["thread"].startswith("worker:")]
+        assert all("clock_offset_s" in d["attrs"]
+                   and d["attrs"]["clock_offset_unc_s"] is not None
+                   for d in wspans)
+        # same-host monotonic clocks: offsets are sub-second, and the
+        # uncertainty (half the best RTT over loopback) is tiny
+        offs = s.broker.worker_offsets()
+        assert len(offs) == 2
+        assert all(abs(v["offset_s"]) < 1.0 for v in offs.values())
+        assert all(0 < v["uncertainty_s"] < 0.1 for v in offs.values())
+        # the decomposition over the LAST generation's window (the first
+        # generation's window includes worker-subprocess startup — heavy
+        # imports before run_worker() even starts, dark by definition):
+        # compute dominates this 2 ms-model config, every category
+        # populated
+        gens = sorted((d for d in spans
+                       if d["name"] == "broker.generation"),
+                      key=lambda d: d["start"])
+        rep = elastic_gap_attribution(
+            [d for d in spans
+             if d["name"] not in ("run", "setup", "generation", "sample",
+                                  "broker.generation")],
+            gens[-1]["start"], gens[-1]["end"],
+        )
+        cats = rep["categories"]
+        assert cats["worker_compute"]["s"] > 0
+        assert cats["serialization"]["s"] > 0
+        assert cats["broker_rtt"]["s"] > 0
+        assert rep["attributed_frac"] > 0.6
+    finally:
+        for p in workers:
+            p.kill()
+        s.stop()
+
+
+@pytest.mark.slow
+def test_bench_elastic_lane_reports_attribution(monkeypatch):
+    """The bench's elastic lane end to end (reduced size): warm runs
+    report the five decomposition fracs and the >=0.9 attributed-frac
+    regression guard against real worker subprocesses."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_elastic_test", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    bench._emitted = True  # neuter the atexit emit
+    from pyabc_tpu.observability import SYSTEM_CLOCK
+
+    bench.CLOCK = SYSTEM_CLOCK
+    bench.TRACER = Tracer(clock=SYSTEM_CLOCK)
+    monkeypatch.setenv("PYABC_TPU_BENCH_ELASTIC_POP", "60")
+    monkeypatch.setenv("PYABC_TPU_BENCH_ELASTIC_GENS", "2")
+    out = bench.run_elastic_lane(120.0)
+    warm = [r for r in out["per_run"] if r["warm"]]
+    assert warm, out
+    for r in warm:
+        for key in ("worker_compute_frac", "serialization_frac",
+                    "broker_rtt_frac", "queue_wait_frac",
+                    "orchestrator_poll_frac"):
+            assert 0.0 <= r[key] <= 1.0
+        assert r["worker_compute_frac"] > 0
+    assert out["regression_guard"]["pass_attributed"], out
+    assert out["workers"]["merge_uncertainty_max_s"] < 0.1
+    assert out["worker_trace_jsonl"]["n_spans"] > 0
+    path = out["worker_trace_jsonl"]["path"]
+    if path and os.path.exists(path):
+        os.remove(path)
