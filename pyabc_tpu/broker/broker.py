@@ -13,12 +13,21 @@ provenance ids for the deterministic sort-by-slot trim, and generation
 completion is driven solely by DELIVERED accepted results. A worker that
 joins mid-generation gets the current generation's payload on hello.
 
-Two opt-in modes TRADE AWAY parts of that contract (as in the reference):
-``wait_for_all`` waits for every handed-out slot's delivery, so a worker
-crashing with slots in flight stalls the generation until the sampler's
-``generation_timeout``; ``mode="static"`` hands out fixed acceptance
-quotas, so a crashed worker's undelivered units stall it likewise. Both
-are bounded by the timeout, not self-healing.
+Self-healing (round 9): every slot handout is a LEASE — ``(worker,
+range, deadline)`` on the injected clock (``resilience/lease.py``). A
+lease whose owner goes silent past the liveness window, or undelivered
+past ``lease_timeout_s``, requeues its undelivered slots; the next
+``get_slots`` from a live worker redispatches them (counted in
+``pyabc_tpu_batches_redispatched_total``, with the orphaned window
+recorded as a ``recovery.redispatch`` span for gap attribution).
+Slot-level dedup drops a late duplicate delivery exactly-once, so the
+original owner limping back cannot double-count a batch. This closes
+the two stalls the pre-round-9 contract conceded: ``wait_for_all``
+(waits for every handed-out slot's delivery) and ``mode="static"``
+(fixed acceptance quotas) previously stalled until the sampler's
+``generation_timeout`` when a worker crashed with work in flight —
+now the work is re-handed to the survivors and the generation
+completes.
 
 Distributed tracing (round 8): trace-capable workers append a worker-clock
 send time to their requests; the broker answers those with its own
@@ -40,7 +49,14 @@ import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
 
-from ..observability import SYSTEM_CLOCK, register_worker_source
+from ..observability import SYSTEM_CLOCK, global_metrics, \
+    register_worker_source
+from ..observability.metrics import (
+    BATCHES_REDISPATCHED_TOTAL,
+    DUPLICATES_DROPPED_TOTAL,
+    LEASES_EXPIRED_TOTAL,
+)
+from ..resilience.lease import LeaseTable
 from .protocol import recv_msg, send_msg
 
 #: a worker not heard from for this long while a generation is OPEN is
@@ -48,9 +64,19 @@ from .protocol import recv_msg, send_msg
 #: worker dies mid-batch" diagnosis, as data instead of a mystery
 DEFAULT_LIVENESS_S = 5.0
 
+#: slot batches are LEASES (round 9): a handed-out range not delivered
+#: within this window — and not refreshed by ANY contact from its owner
+#: (any message extends the owner's leases) — requeues to live workers.
+#: The presumed-dead rule usually fires first (liveness_s = 5 s); this
+#: is the backstop for a worker that keeps polling but never delivers.
+DEFAULT_LEASE_TIMEOUT_S = 15.0
+
 #: bound on the ingested worker-span buffer (drained every generation by
 #: the sampler; the bound only matters for broker use without one)
 MAX_WORKER_SPANS = 100_000
+
+#: bound on the recovery-action log surfaced via status()/abc-manager
+MAX_RECOVERY_LOG = 200
 
 
 @dataclass
@@ -67,6 +93,15 @@ class BrokerStatus:
     #: {"reason", "last_seen", "n_results"} — a terminated worker leaves
     #: a tombstone instead of vanishing from the books
     departed: dict = field(default_factory=dict)
+    #: lease bookkeeping (round 9): outstanding/requeued slot counts plus
+    #: the run-lifetime redispatch / dedup / expiry counters
+    leases: dict = field(default_factory=dict)
+    #: tail of the recovery-action log ({"action", "wid", "ts", ...}) —
+    #: what the self-healing machinery DID, surfaced in abc-manager
+    recovery: list = field(default_factory=list)
+    #: broker round trips the workers reported retrying (summed from the
+    #: per-worker trace summaries; per-worker counts in ``workers``)
+    n_request_retries: int = 0
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -103,12 +138,26 @@ class EvalBroker:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  max_eval: float = float("inf"), clock=None,
-                 liveness_s: float = DEFAULT_LIVENESS_S):
+                 liveness_s: float = DEFAULT_LIVENESS_S,
+                 lease_timeout_s: float = DEFAULT_LEASE_TIMEOUT_S,
+                 metrics=None):
         # injected monotonic clock (observability subsystem): worker
         # liveness ages and wait deadlines survive wall-clock steps, and
         # tests can drive a VirtualClock
         self.clock = clock if clock is not None else SYSTEM_CLOCK
         self.liveness_s = float(liveness_s)
+        # recovery counters always collect (global registry default):
+        # a dashboard scraping an unconfigured process still sees them
+        self.metrics = metrics if metrics is not None else global_metrics()
+        #: self-healing lease table (round 9): every slot handout is a
+        #: lease; expired / presumed-dead work requeues to live workers
+        #: with slot-level dedup for the late duplicates
+        self._leases = LeaseTable(self.clock, timeout_s=lease_timeout_s)
+        #: recovery actions taken (requeue/redispatch), newest last
+        self._recovery_log: list[dict] = []
+        #: recovery spans ready for the sampler's tracer (same drain
+        #: pattern as the worker spans): orphaned->redispatched windows
+        self._recovery_spans: list[dict] = []
         self._lock = threading.Lock()
         self._gen = 0               # monotonically increasing generation id
         self._payload: bytes | None = None  # pickled simulate_one closure
@@ -212,6 +261,7 @@ class EvalBroker:
         self._results = []
         self._done = False
         self._done_event.clear()
+        self._leases.reset()
 
     def pre_publish(self, t: int, payload: bytes, n_target: int, *,
                     batch: int = 1,
@@ -299,6 +349,11 @@ class EvalBroker:
 
     def status(self) -> BrokerStatus:
         with self._lock:
+            # status observation doubles as a reap point: even with no
+            # worker polling get_slots, abc-manager / the sampler's
+            # metrics poll keeps the lease table honest
+            if not self._done:
+                self._reap_leases_locked()
             now = self.clock.now()
             gen_open = not self._done
             workers = {}
@@ -320,6 +375,12 @@ class EvalBroker:
                 workers=workers,
                 done=self._done,
                 departed=dict(self._departed),
+                leases=self._leases.stats(),
+                recovery=list(self._recovery_log[-20:]),
+                n_request_retries=sum(
+                    int(info.get("n_retries", 0) or 0)
+                    for info in self._workers.values()
+                ),
             )
 
     def worker_snapshot(self) -> dict:
@@ -335,12 +396,16 @@ class EvalBroker:
                 "n_results": info.get("n_results", 0),
                 "n_eval": info.get("n_eval", 0),
                 "n_acc": info.get("n_acc", 0),
+                "n_retries": info.get("n_retries", 0),
                 "clock_offset_s": info.get("clock_offset_s"),
                 "clock_offset_unc_s": info.get("clock_offset_unc_s"),
                 "clock_rtt_s": info.get("clock_rtt_s"),
                 "last_error": info.get("last_error"),
+                "last_recovery": info.get("last_recovery"),
                 "trace": bool(info.get("trace", False)),
             }
+        out["__leases__"] = st.leases
+        out["__recovery__"] = st.recovery
         for w, info in st.departed.items():
             out.setdefault(w, {})["departed"] = info
         return out
@@ -370,6 +435,16 @@ class EvalBroker:
             spans, self._worker_spans = self._worker_spans, []
             return spans
 
+    def drain_recovery_spans(self) -> list[dict]:
+        """Take (and clear) the recovery spans (``recovery.redispatch``:
+        the orphaned->redispatched window of each healed batch, on this
+        broker's clock, ``recovery`` pseudo-thread). The sampler records
+        them on the run tracer so ``elastic_gap_attribution`` reports the
+        recovery-time slice of dark time."""
+        with self._lock:
+            spans, self._recovery_spans = self._recovery_spans, []
+            return spans
+
     def stop(self) -> None:
         with self._lock:
             self._done = True
@@ -388,6 +463,71 @@ class EvalBroker:
         info["last_seen"] = self.clock.now()
         for k, v in updates.items():
             info[k] = info.get(k, 0) + v
+        # any contact proves life: a slow-but-alive worker keeps its
+        # leased batches (the lease timeout targets SILENT owners)
+        self._leases.touch_worker(worker_id)
+
+    def _reap_leases_locked(self) -> None:
+        """Requeue leases whose owners are presumed dead or timed out."""
+        now = self.clock.now()
+        dead = [
+            w for w, info in self._workers.items()
+            if not self._done and now - info["last_seen"] > self.liveness_s
+        ]
+        events = self._leases.reap(now, dead)
+        for ev in events:
+            self.metrics.counter(
+                LEASES_EXPIRED_TOTAL,
+                "batch leases reaped (expired or owner presumed dead) "
+                "and requeued",
+            ).inc()
+            self._log_recovery_locked({
+                "action": "requeue", "wid": ev["wid"], "ts": now,
+                "n_slots": ev["n_slots"], "reason": ev["reason"],
+                "gen": self._gen,
+            })
+
+    def _log_recovery_locked(self, entry: dict) -> None:
+        self._recovery_log.append(entry)
+        del self._recovery_log[:-MAX_RECOVERY_LOG]
+        # remember the last recovery action against the involved worker
+        info = self._workers.get(entry.get("wid"))
+        if info is not None:
+            info["last_recovery"] = (
+                f"{entry['action']}:{entry.get('reason', '')}"
+                f"@{round(entry['ts'], 1)}"
+            )
+
+    def _serve_requeued_locked(self, worker_id: str, k: int):
+        """Hand a requeued range to ``worker_id`` if any is waiting.
+
+        Returns ``(start, stop)`` or None. Emits the redispatch counter
+        and a ``recovery.redispatch`` span covering the orphaned window
+        (requeued-at -> now) so gap attribution sees recovery time."""
+        taken = self._leases.take_requeued(worker_id, k)
+        if taken is None:
+            return None
+        start, stop, orphaned_at = taken
+        now = self.clock.now()
+        self.metrics.counter(
+            BATCHES_REDISPATCHED_TOTAL,
+            "requeued batches re-handed to live workers",
+        ).inc()
+        self._log_recovery_locked({
+            "action": "redispatch", "wid": worker_id, "ts": now,
+            "n_slots": stop - start, "gen": self._gen,
+            "orphaned_s": round(now - orphaned_at, 6),
+        })
+        if now > orphaned_at:
+            self._recovery_spans.append({
+                "name": "recovery.redispatch", "span_id": None,
+                "parent_id": None, "thread": "recovery",
+                "start": float(orphaned_at), "end": float(now),
+                "attrs": {"worker_id": worker_id, "gen": self._gen,
+                          "n_slots": int(stop - start)},
+            })
+            del self._recovery_spans[:-MAX_RECOVERY_LOG]
+        return (start, stop)
 
     def _ingest_trace_locked(self, worker_id: str, trace: dict) -> None:
         """Store a piggybacked trace summary: update the worker's offset/
@@ -405,7 +545,7 @@ class EvalBroker:
             info["clock_rtt_s"] = trace.get("rtt")
         if trace.get("last_error"):
             info["last_error"] = str(trace["last_error"])[:300]
-        for k in ("n_eval", "n_acc"):
+        for k in ("n_eval", "n_acc", "n_retries"):
             if isinstance(trace.get(k), int):
                 info[k] = trace[k]
         if offset is None:
@@ -458,7 +598,20 @@ class EvalBroker:
             traced = len(msg) >= 5
             with self._lock:
                 self._touch(worker_id)
-                if gen != self._gen or self._done or self._draining:
+                if gen != self._gen or self._done:
+                    return ("done", t_broker) if traced else ("done",)
+                # self-healing: requeue expired / presumed-dead leases,
+                # then serve orphaned work FIRST — also while draining,
+                # which is exactly when an abandoned batch would
+                # otherwise stall the generation until the timeout
+                self._reap_leases_locked()
+                requeued = self._serve_requeued_locked(worker_id, int(k))
+                if requeued is not None:
+                    start, stop = requeued
+                    if traced:
+                        return ("slots", start, stop, t_broker)
+                    return ("slots", start, stop)
+                if self._draining:
                     return ("done", t_broker) if traced else ("done",)
                 cap = self._max_eval
                 if self._mode == "static":
@@ -475,6 +628,7 @@ class EvalBroker:
                 start = self._next_slot
                 stop = int(min(start + int(k), cap))
                 self._next_slot = stop
+                self._leases.grant(worker_id, start, stop)
                 if traced:
                     return ("slots", start, stop, t_broker)
                 return ("slots", start, stop)
@@ -494,15 +648,41 @@ class EvalBroker:
                     return _reply("done")
                 if self._done:
                     return _reply("done")
+                n_dup = 0
+                n_admitted = 0
+                n_admitted_acc = 0
                 for slot, blob, accepted in triples:
-                    self._results.append((int(slot), blob, bool(accepted)))
+                    slot = int(slot)
+                    accepted = bool(accepted)
+                    # release the slot from its lease (whoever held it),
+                    # then exactly-once dedup: a redispatched batch's
+                    # LATE original delivery — or a RetryPolicy-resent
+                    # results frame whose first reply was lost — must
+                    # not double-count
+                    self._leases.note_delivery(slot)
+                    if not self._leases.admit(slot, accepted, self._mode):
+                        n_dup += 1
+                        continue
+                    self._results.append((slot, blob, accepted))
+                    n_admitted += 1
                     if accepted:
                         self._n_acc += 1
+                        n_admitted_acc += 1
+                if n_dup:
+                    self.metrics.counter(
+                        DUPLICATES_DROPPED_TOTAL,
+                        "late duplicate deliveries dropped by slot-level "
+                        "dedup",
+                    ).inc(n_dup)
+                    self._log_recovery_locked({
+                        "action": "dedup_drop", "wid": worker_id,
+                        "ts": self.clock.now(), "n_slots": n_dup,
+                        "gen": self._gen,
+                    })
                 # dynamic slots yield exactly one triple each; static quota
                 # units yield one ACCEPTED triple each (plus reject records)
                 self._n_delivered += (
-                    sum(1 for *_x, acc in triples if acc)
-                    if self._mode == "static" else len(triples)
+                    n_admitted_acc if self._mode == "static" else n_admitted
                 )
                 if self._collect_only:
                     # look-ahead generation: completion is the sampler's

@@ -44,15 +44,38 @@ cross-version compatibility boundary.
 
 Particles travel pre-pickled (``particle_bytes``) so the broker thread
 never unpickles model-specific payloads while holding its lock.
+
+Resilience (round 9): :func:`request` runs under the shared
+:class:`~pyabc_tpu.resilience.retry.RetryPolicy` — a connection drop or
+broker restart costs a jittered backoff-and-retry instead of
+propagating, with retries counted into
+``pyabc_tpu_request_retries_total`` and reported per caller via
+``on_retry``. Retrying a ``results`` message whose reply was lost is
+SAFE because the broker's slot-level dedup (lease.py) drops the
+duplicate delivery exactly-once. The ``protocol.request`` fault-plan
+site sits INSIDE the retry loop, so injected drops exercise the same
+path a real blip would.
 """
 from __future__ import annotations
 
 import pickle
+import random
 import socket
 import struct
 
+from ..observability import global_metrics
+from ..observability.metrics import REQUEST_RETRIES_TOTAL
+from ..resilience.faults import maybe_fault
+from ..resilience.retry import DEFAULT_RETRY_POLICY, RetryPolicy
+
 _LEN = struct.Struct("!Q")
 MAX_FRAME = 1 << 31  # 2 GiB sanity bound
+
+#: process-shared jitter source for request backoff (workers seed their
+#: numpy RNG for SIMULATION reproducibility; transport jitter staying
+#: uncorrelated across a pool is the point, so it is deliberately NOT
+#: derived from the worker seed)
+_JITTER_RNG = random.Random()
 
 
 def send_msg(sock: socket.socket, obj) -> None:
@@ -77,10 +100,37 @@ def recv_msg(sock: socket.socket):
     return pickle.loads(_recv_exact(sock, n))
 
 
-def request(addr: tuple[str, int], obj, timeout: float = 30.0):
-    """One connect-send-receive round trip (workers keep it simple and
-    stateless: any broker restart or network blip costs one retry, not a
-    corrupted session)."""
-    with socket.create_connection(addr, timeout=timeout) as sock:
-        send_msg(sock, obj)
-        return recv_msg(sock)
+def request(addr: tuple[str, int], obj, timeout: float = 30.0,
+            retry: RetryPolicy | None = None, on_retry=None):
+    """One connect-send-receive round trip under the shared RetryPolicy.
+
+    Workers stay simple and stateless: a broker restart or network blip
+    costs a capped, jittered backoff-and-retry, not a corrupted session.
+    ``retry=None`` uses :data:`~pyabc_tpu.resilience.retry.
+    DEFAULT_RETRY_POLICY`; pass ``RetryPolicy(attempts=1)`` to disable.
+    ``on_retry(retry_index, exc)`` lets callers count their own retries
+    (the worker surfaces them in its trace summary -> BrokerStatus).
+    """
+    policy = retry if retry is not None else DEFAULT_RETRY_POLICY
+
+    def _once():
+        # fault-plan site INSIDE the retry loop: an injected drop is
+        # retried exactly like a real one
+        maybe_fault(
+            "protocol.request",
+            msg_kind=obj[0] if isinstance(obj, tuple) and obj else "",
+        )
+        with socket.create_connection(addr, timeout=timeout) as sock:
+            send_msg(sock, obj)
+            return recv_msg(sock)
+
+    def _count(i, exc):
+        global_metrics().counter(
+            REQUEST_RETRIES_TOTAL,
+            "broker round trips retried by the shared RetryPolicy",
+        ).inc()
+        if on_retry is not None:
+            on_retry(i, exc)
+
+    return policy.call(_once, retry_on=(ConnectionError, OSError),
+                       rng=_JITTER_RNG, on_retry=_count)

@@ -56,7 +56,8 @@ class ElasticSampler(Sampler):
                  wait_for_all_samples: bool = False,
                  scheduling: str = "dynamic",
                  look_ahead: bool = False,
-                 look_ahead_frac: float = 0.5):
+                 look_ahead_frac: float = 0.5,
+                 lease_timeout_s: float | None = None):
         """``wait_for_all_samples``: gather every in-flight evaluation
         before finalizing a generation (adaptive components then see an
         unbiased, complete record set — reference ``wait_for_all_samples``).
@@ -73,7 +74,15 @@ class ElasticSampler(Sampler):
         final epsilon, with importance weights taken wrt the preliminary
         proposal actually used (no bias). The orchestrator enables this
         only for generation-invariant distances and plain uniform
-        acceptance (ABCSMC._look_ahead_capable)."""
+        acceptance (ABCSMC._look_ahead_capable).
+        ``lease_timeout_s`` (round 9): broker batch-lease deadline —
+        handed-out work not delivered (and not refreshed by any contact
+        from its owner) within this window requeues to live workers
+        (None = the broker default). ``generation_timeout`` is now a
+        LIVENESS deadline, not a hard stop: while at least one worker is
+        alive the sampler extends it and lets the lease/redispatch
+        machinery finish the generation on the survivors; TimeoutError
+        is raised only once NO live worker remains."""
         super().__init__()
         self.batch = int(batch)
         self.generation_timeout = generation_timeout
@@ -98,7 +107,10 @@ class ElasticSampler(Sampler):
         #: into rejected error records during the LAST generation
         #: (reference: exceptions surfaced via rejected particles)
         self.error_records: list[tuple[int, str]] = []
-        self.broker = EvalBroker(host, port)
+        broker_kwargs = {}
+        if lease_timeout_s is not None:
+            broker_kwargs["lease_timeout_s"] = float(lease_timeout_s)
+        self.broker = EvalBroker(host, port, **broker_kwargs)
 
     @property
     def address(self) -> tuple[str, int]:
@@ -203,8 +215,13 @@ class ElasticSampler(Sampler):
         on a ``worker:<id>`` pseudo-thread, carrying the clock-offset
         estimate and RTT-derived uncertainty it was merged with. With a
         NullTracer the drain still runs (the broker buffer stays
-        bounded) but records nothing."""
-        spans = self.broker.drain_worker_spans()
+        bounded) but records nothing. Recovery spans ride along: the
+        broker's ``recovery.redispatch`` windows (orphaned work waiting
+        for a live worker) land on a ``recovery`` pseudo-thread so the
+        gap accountant attributes self-healing time instead of
+        reporting it dark."""
+        spans = (self.broker.drain_worker_spans()
+                 + self.broker.drain_recovery_spans())
         if not spans or not self.tracer.enabled:
             return
         for sp in spans:
@@ -390,9 +407,41 @@ class ElasticSampler(Sampler):
                 return triples, tested
             _time.sleep(0.02)
             if deadline and clock.now() > deadline:
-                raise TimeoutError(
-                    f"generation incomplete: {self.broker.status()}"
+                # graceful degradation (round 9): while ANY worker is
+                # alive, the lease/redispatch machinery will finish the
+                # generation on the survivors — extend the deadline and
+                # keep collecting instead of killing the run. Only a
+                # fully dead pool raises.
+                status = self.broker.status()
+                alive = [w for w, info in status.workers.items()
+                         if not info.get("presumed_dead")]
+                if not alive:
+                    raise TimeoutError(
+                        f"generation incomplete and no live workers "
+                        f"remain: {status}"
+                    )
+                now = clock.now()
+                self.tracer.record_span(
+                    "recovery.timeout_extended", deadline, now,
+                    thread="recovery", t=int(t or 0),
+                    n_alive=len(alive),
                 )
+                from ..observability.metrics import (
+                    TIMEOUT_EXTENSIONS_TOTAL,
+                )
+
+                self.metrics.counter(
+                    TIMEOUT_EXTENSIONS_TOTAL,
+                    "generation deadlines extended because live workers "
+                    "remain",
+                ).inc()
+                logger.warning(
+                    "generation %s exceeded generation_timeout=%.1fs; "
+                    "%d live worker(s) remain — extending instead of "
+                    "raising (leases requeue the dead workers' batches)",
+                    t, self.generation_timeout, len(alive),
+                )
+                deadline = now + self.generation_timeout
 
     def cancel_look_ahead(self) -> None:
         """Retire any look-ahead state: drop a queued pre-publish, finalize

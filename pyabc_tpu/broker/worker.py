@@ -39,6 +39,13 @@ import numpy as np
 
 from ..observability import SYSTEM_CLOCK
 from ..observability.clock import ClockOffsetEstimator
+from ..resilience.faults import (
+    FaultPlan,
+    InjectedKill,
+    install_fault_plan,
+    maybe_fault,
+)
+from ..resilience.retry import RetryPolicy
 from .protocol import request
 
 
@@ -68,6 +75,12 @@ class WorkerSpanRecorder:
         self.last_error: str | None = None
         self.n_eval = 0
         self.n_acc = 0
+        #: broker round trips this worker retried (RetryPolicy backoff);
+        #: ships with the trace summary -> BrokerStatus retry counts
+        self.n_retries = 0
+
+    def note_retry(self, _i=None, _exc=None) -> None:
+        self.n_retries += 1
 
     def begin(self, name: str) -> tuple[str, float]:
         return (name, self.clock.now())
@@ -109,6 +122,7 @@ class WorkerSpanRecorder:
             "n_eval": self.n_eval,
             "n_acc": self.n_acc,
             "n_dropped": self.n_dropped,
+            "n_retries": self.n_retries,
             "last_error": self.last_error,
         }
 
@@ -121,6 +135,10 @@ class _NullRecorder:
     last_error = None
     n_eval = 0
     n_acc = 0
+    n_retries = 0
+
+    def note_retry(self, _i=None, _exc=None):
+        pass
 
     def begin(self, name):
         return None
@@ -158,7 +176,7 @@ def _traced_request(addr, msg, rec, span_name: str | None = None,
         msg = msg + (t1,)
     token = (span_name, t1) if span_name else None
     try:
-        reply = request(addr, msg)
+        reply = request(addr, msg, on_retry=rec.note_retry)
     except Exception:
         rec.end(token, kind=msg[0], error=True)
         raise
@@ -176,6 +194,9 @@ def run_worker(host: str, port: int, *, worker_id: str | None = None,
                seed: int | None = None,
                trace: bool = True,
                clock=None,
+               reconnect_base_s: float = 0.2,
+               reconnect_max_s: float = 2.0,
+               fault_plan: "FaultPlan | str | None" = None,
                _stop_check=None) -> int:
     """Serve generations until the broker goes away / runtime ends.
 
@@ -194,8 +215,30 @@ def run_worker(host: str, port: int, *, worker_id: str | None = None,
     the pre-tracing protocol exactly. ``clock``: injected monotonic
     clock (tests drive skewed VirtualClocks); defaults to the shared
     SYSTEM_CLOCK.
+
+    ``reconnect_base_s`` / ``reconnect_max_s`` (round 9, ``abc-worker
+    --reconnect-*``): capped exponential backoff while the broker is
+    unreachable — the worker reconnects through broker restarts instead
+    of dying on a blip (per-round-trip blips are already healed inside
+    ``protocol.request`` by the shared RetryPolicy; this loop is the
+    broker-DOWN layer on top, and it resets on any successful contact).
+    ``fault_plan``: a :class:`~pyabc_tpu.resilience.faults.FaultPlan`
+    (or parseable spec string) installed process-wide before serving —
+    the ``worker.batch`` site then kills/hangs/slows THIS worker
+    mid-batch deterministically. An injected KILL is a hard death:
+    in-flight work is abandoned, no bye is sent, and the broker must
+    discover the loss through lease expiry (exactly the production
+    failure being rehearsed).
     """
     addr = (host, int(port))
+    if fault_plan is not None:
+        if isinstance(fault_plan, str):
+            fault_plan = FaultPlan.parse(fault_plan)
+        install_fault_plan(fault_plan)
+    reconnect = RetryPolicy(attempts=1 << 30,
+                            base_s=float(reconnect_base_s),
+                            max_s=float(reconnect_max_s))
+    conn_fails = 0
     wid = worker_id or f"{socket.gethostname()}-{os.getpid()}-{uuid.uuid4().hex[:6]}"
     # worker-unique numpy seed: host simulate_one draws via np.random.
     # ``seed`` (also via PYABC_TPU_WORKER_SEED) pins it for reproducible
@@ -264,6 +307,7 @@ def run_worker(host: str, port: int, *, worker_id: str | None = None,
 
     connect_tok = rec.begin("worker.connect")
     wait_tok = None
+    hard_killed = False
     try:
         while True:
             if stopping():
@@ -281,8 +325,12 @@ def run_worker(host: str, port: int, *, worker_id: str | None = None,
                 reply = _traced_request(addr, ("hello", wid), rec,
                                         append_t1=True)
             except (ConnectionError, OSError):
-                time.sleep(min(poll_s * 4, 2.0))
+                # broker unreachable: capped exponential reconnect
+                # backoff (resets on the next successful contact)
+                time.sleep(reconnect.delay_s(min(conn_fails, 16)))
+                conn_fails += 1
                 continue
+            conn_fails = 0
             if connect_tok is not None:
                 # first successful broker contact (covers pre-manager
                 # startup backoff — reference "worker before manager")
@@ -315,6 +363,12 @@ def run_worker(host: str, port: int, *, worker_id: str | None = None,
                 if r[0] != "slots":
                     break
                 start, stop = r[1], r[2]
+                # fault-plan site: the handed-out slots are LEASED to this
+                # worker now — an injected kill here abandons them
+                # mid-batch, exactly the self-healing scenario the
+                # broker's lease requeue must absorb
+                maybe_fault("worker.batch", worker_id=wid, gen=gen,
+                            start=start, stop=stop)
                 parts = []  # (slot, particle, accepted) — serialized at ship
                 aborted = False
                 sim_tok = rec.begin("worker.simulate")
@@ -418,6 +472,11 @@ def run_worker(host: str, port: int, *, worker_id: str | None = None,
                     [wid, gen, t, n_eval, n_acc,
                      round(clock.now() - t0, 3)])
                 fh.flush()
+    except InjectedKill:
+        # simulated SIGKILL (fault plan): die HARD — no batch flush, no
+        # bye, no final trace. The broker's lease table must discover
+        # the abandoned slots and requeue them to a live worker.
+        hard_killed = True
     finally:
         if stopping():
             bye_reason = "signal"
@@ -425,7 +484,9 @@ def run_worker(host: str, port: int, *, worker_id: str | None = None,
         # final trace flushes ship spans the last results reply couldn't
         # carry (their end time postdates that message)
         try:
-            if rec.enabled:
+            if hard_killed:
+                pass
+            elif rec.enabled:
                 request(addr, ("bye", wid, bye_reason, rec.trace_payload()))
             else:
                 request(addr, ("bye", wid))

@@ -151,8 +151,20 @@ def bench_cmd(pop, gens, budget_s, cpu):
               help="record worker-side phase spans + clock-offset samples "
               "and piggyback them on result messages (default on; "
               "--no-trace speaks the pre-tracing protocol exactly)")
+@click.option("--reconnect-base-s", type=float, default=0.2,
+              help="initial reconnect backoff while the broker is "
+              "unreachable (doubles per failure, with jitter)")
+@click.option("--reconnect-max-s", type=float, default=2.0,
+              help="reconnect backoff cap")
+@click.option("--fault-plan", "fault_plan", default=None,
+              envvar="PYABC_TPU_FAULT_PLAN",
+              help="install a deterministic fault plan in this worker "
+              "(resilience subsystem), e.g. 'worker.batch:kill:after=2' — "
+              "an injected kill dies HARD (no bye; the broker's lease "
+              "requeue must heal it). Also read from PYABC_TPU_FAULT_PLAN.")
 def worker_cmd(host, port, worker_id, runtime_s, max_generations, log_file,
-               processes, catch_exceptions, trace):
+               processes, catch_exceptions, trace, reconnect_base_s,
+               reconnect_max_s, fault_plan):
     """Join an ElasticSampler broker at HOST:PORT as an evaluation worker
     (reference parity: the ``abc-redis-worker`` CLI). Workers may join and
     leave at any time, including mid-generation."""
@@ -160,7 +172,10 @@ def worker_cmd(host, port, worker_id, runtime_s, max_generations, log_file,
 
     kwargs = dict(worker_id=worker_id, runtime_s=runtime_s,
                   max_generations=max_generations, log_file=log_file,
-                  catch_exceptions=catch_exceptions, trace=trace)
+                  catch_exceptions=catch_exceptions, trace=trace,
+                  reconnect_base_s=reconnect_base_s,
+                  reconnect_max_s=reconnect_max_s,
+                  fault_plan=fault_plan)
     if processes > 1:
         # one worker per process (reference --processes): each child gets
         # its own id suffix and log file so the CSVs don't interleave.
@@ -254,11 +269,26 @@ def manager_cmd(host, port, watch):
             f"handed={status.n_eval_handed} results={status.n_results} "
             f"done={status.done}"
         )
+        leases = getattr(status, "leases", None) or {}
+        if leases:
+            # liveness -> ACTION: what the self-healing machinery holds
+            # and what it already did (resilience subsystem, round 9)
+            click.echo(
+                f"  leases: outstanding={leases.get('outstanding_leases', 0)}"
+                f" ({leases.get('outstanding_slots', 0)} slots) "
+                f"requeued={leases.get('requeued_slots', 0)} "
+                f"redispatched={leases.get('redispatched_total', 0)} "
+                f"dup_dropped={leases.get('duplicates_dropped', 0)} "
+                f"expired={leases.get('leases_expired', 0)} "
+                f"retries={getattr(status, 'n_request_retries', 0)}"
+            )
         for wid, info in sorted(status.workers.items()):
             line = (
                 f"  worker {wid}: results={info.get('n_results', 0)} "
                 f"idle={info.get('idle_s', '?')}s"
             )
+            if info.get("n_retries"):
+                line += f" retries={info['n_retries']}"
             if info.get("clock_offset_s") is not None:
                 line += (
                     f" clock_offset={info['clock_offset_s'] * 1e3:.2f}ms"
@@ -266,9 +296,19 @@ def manager_cmd(host, port, watch):
                 )
             if info.get("presumed_dead"):
                 line += " PRESUMED-DEAD"
+            if info.get("last_recovery"):
+                line += f" last_recovery={info['last_recovery']}"
             if info.get("last_error"):
                 line += f" last_error={info['last_error']}"
             click.echo(line)
+        for ev in getattr(status, "recovery", None) or []:
+            click.echo(
+                f"  recovery: {ev.get('action')} wid={ev.get('wid')} "
+                f"slots={ev.get('n_slots')} gen={ev.get('gen')}"
+                + (f" reason={ev['reason']}" if ev.get("reason") else "")
+                + (f" orphaned={ev['orphaned_s']:.3f}s"
+                   if ev.get("orphaned_s") is not None else "")
+            )
         for wid, info in sorted(status.departed.items()):
             click.echo(
                 f"  departed {wid}: reason={info.get('reason')} "

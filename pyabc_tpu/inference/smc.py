@@ -155,7 +155,9 @@ class ABCSMC:
                  refit_every: int | None = None,
                  refit_drift_threshold: float = 0.3,
                  tracer=None,
-                 metrics=None):
+                 metrics=None,
+                 checkpoint_path: str | None = None,
+                 checkpoint_every: int = 1):
         self.models: list[Model] = assert_models(models)
         if isinstance(parameter_priors, Distribution):
             parameter_priors = [parameter_priors]
@@ -356,6 +358,30 @@ class ABCSMC:
         #: multiplies the count by the measured ~102 ms tunnel floor to
         #: ATTRIBUTE the residual wall-clock gap (VERDICT r5 Next #1c)
         self.sync_ledger = SyncLedger(clock=self._clock)
+        #: mid-chunk device checkpointing (resilience subsystem, round 9):
+        #: with a ``checkpoint_path``, the fused loop persists the chunk
+        #: chain's on-device carry (RNG key data, fitted-proposal state,
+        #: epsilon / pdf-norm trail, refit-cadence counter) every
+        #: ``checkpoint_every`` processed chunks — atomically, after a
+        #: History flush, so a killed orchestrator resumes MID-CHUNK from
+        #: the exact carry (bit-identical trajectory) instead of
+        #: replaying a host transition fit off the last History
+        #: generation. A cleanly finished run deletes its checkpoint.
+        self.checkpoint_every = max(int(checkpoint_every), 1)
+        if checkpoint_path is not None:
+            from ..resilience.checkpoint import CheckpointManager
+
+            self._checkpoint = CheckpointManager(
+                checkpoint_path, clock=self._clock, tracer=self.tracer,
+                metrics=self.metrics,
+            )
+        else:
+            self._checkpoint = None
+        #: decoded checkpoint carry awaiting adoption by the fused loop
+        self._resume_carry = None
+        #: generation the last run resumed at via the checkpoint (None =
+        #: fresh / generation-granularity resume) — tests assert on it
+        self.resumed_from_checkpoint_t: int | None = None
 
         self._device_capable = self._check_device_capable()
         if sampler is None:
@@ -511,6 +537,23 @@ class ABCSMC:
     def _build_device_ctx(self) -> DeviceContext | None:
         if not self._device_capable or self.spec is None:
             return None
+        # resilience fault site: a (simulated) device-context reset — TPU
+        # preemption, tunnel restart — drops the compiled kernels; the
+        # self-heal is a rebuild (device state is reconstructible from
+        # host state by design: kernels close over x_0 only, and all
+        # per-generation state travels as array arguments)
+        from ..resilience.faults import InjectedDeviceReset, maybe_fault
+
+        reset_t0 = None
+        try:
+            maybe_fault("device.context")
+        except InjectedDeviceReset:
+            reset_t0 = self._clock.now()
+            self._device_ctx = None
+            logger.warning(
+                "device context reset injected: dropping compiled "
+                "kernels and rebuilding"
+            )
         if self._device_ctx is None:
             with np.errstate(divide="ignore"):
                 logits = np.log(self.model_prior_probs)
@@ -525,6 +568,17 @@ class ABCSMC:
                 transition_classes=[type(tr) for tr in self.transitions],
                 mesh=self.mesh,
             )
+        if reset_t0 is not None:
+            from ..observability.metrics import DEVICE_RESETS_TOTAL
+
+            self.tracer.record_span(
+                "recovery.device_reset", reset_t0, self._clock.now(),
+                thread="recovery",
+            )
+            self.metrics.counter(
+                DEVICE_RESETS_TOTAL,
+                "device contexts dropped and rebuilt after a reset",
+            ).inc()
         return self._device_ctx
 
     def _model_prior_rvs(self) -> int:
@@ -776,6 +830,125 @@ class ABCSMC:
             err, self._drain_error = self._drain_error, None
             raise err
 
+    # ----------------------------------------------- mid-chunk checkpointing
+    def _checkpoint_fingerprint(self) -> str:
+        """Config identity a checkpoint must match to be adopted: the
+        carry pytree's shape is a function of these (models/priors fix
+        the dims, the seed fixes the RNG stream the carry position is
+        meaningful for)."""
+        return json.dumps({
+            "models": self.model_names,
+            "K": self.K,
+            "seed": int(self.seed),
+            "fused_generations": int(self.fused_generations),
+        }, sort_keys=True)
+
+    def _maybe_adopt_checkpoint(self, t0: int) -> int:
+        """Adopt a mid-chunk checkpoint if one matches this run.
+
+        Returns the (possibly moved) resume generation. On adoption the
+        History is pruned back to the checkpoint's generation (rows past
+        it were persisted between the save and the kill; the checkpoint
+        is canonical), the root PRNG key is restored from the saved key
+        data, and the decoded carry is staged for the fused loop."""
+        self._resume_carry = None
+        self.resumed_from_checkpoint_t = None
+        if self._checkpoint is None or t0 <= 0 \
+                or not self._fused_chunk_capable():
+            return t0
+        ck = self._checkpoint.load()
+        if ck is None or ck.get("kind") != "fused_carry":
+            return t0
+        if ck.get("abc_id") != int(self.history.id) \
+                or ck.get("fingerprint") != self._checkpoint_fingerprint():
+            logger.warning(
+                "ignoring checkpoint %s: it belongs to a different "
+                "run/config", self._checkpoint.path,
+            )
+            return t0
+        t_ck = int(ck["t"])
+        if t_ck < 1 or t_ck > t0:
+            # flush-before-save guarantees the db is at-or-ahead of the
+            # checkpoint; a checkpoint ahead of the db means the file
+            # was paired with a different db copy — don't trust it
+            logger.warning(
+                "ignoring checkpoint %s: t=%d inconsistent with the "
+                "History (resumable t=%d)", self._checkpoint.path,
+                t_ck, t0,
+            )
+            return t0
+        import jax
+
+        if t_ck < t0:
+            n = self.history.prune_from(t_ck)
+            logger.info(
+                "pruned %d generation(s) persisted past the checkpoint "
+                "(t >= %d): the checkpoint carry is canonical", n, t_ck,
+            )
+        self._root_key = jax.random.wrap_key_data(
+            np.asarray(ck["root_key_data"], np.uint32)
+        )
+        self._resume_carry = ck["carry"]
+        self.resumed_from_checkpoint_t = t_ck
+        logger.info(
+            "resuming fused run MID-CHUNK from checkpoint %s at t=%d "
+            "(chunk %s) — carry restored bit-exact, no host refit replay",
+            self._checkpoint.path, t_ck, ck.get("chunk_index"),
+        )
+        return t_ck
+
+    def _validate_resume_carry(self, decoded, build_carry, t):
+        """Structure/shape/dtype-check the decoded carry against a
+        freshly host-built one; returns the decoded carry (as-is: numpy
+        leaves feed the kernel directly) or None to fall back."""
+        import jax
+
+        try:
+            ref = build_carry(t)
+        except Exception:
+            logger.exception(
+                "could not build a reference carry to validate the "
+                "checkpoint against; falling back to host-built state"
+            )
+            return None
+        ref_leaves, ref_td = jax.tree.flatten(ref)
+        dec_leaves, dec_td = jax.tree.flatten(decoded)
+        ok = ref_td == dec_td and len(ref_leaves) == len(dec_leaves) \
+            and all(
+                np.asarray(a).shape == np.asarray(b).shape
+                and np.asarray(a).dtype == np.asarray(b).dtype
+                for a, b in zip(ref_leaves, dec_leaves)
+            )
+        if not ok:
+            logger.warning(
+                "checkpoint carry does not match this config's carry "
+                "structure; falling back to host-built state"
+            )
+            return None
+        return decoded
+
+    def _save_fused_checkpoint(self, carry_ref, t_next: int,
+                               sims_total: int, chunk_index: int) -> None:
+        """Flush History, fetch the chunk's final device carry, persist
+        atomically. The flush ordering is the no-gap invariant: the db
+        always holds every generation below the checkpoint's t."""
+        import jax
+
+        self.history.flush()
+        host_carry = jax.device_get(carry_ref)
+        self.sync_ledger.record("checkpoint_fetch")
+        self._checkpoint.save({
+            "kind": "fused_carry",
+            "abc_id": int(self.history.id),
+            "fingerprint": self._checkpoint_fingerprint(),
+            "t": int(t_next),
+            "sims_total": int(sims_total),
+            "chunk_index": int(chunk_index),
+            "root_key_data": np.asarray(
+                jax.random.key_data(self._root_key)),
+            "carry": host_carry,
+        })
+
     def _run_impl(self, minimum_epsilon, max_nr_populations,
                   min_acceptance_rate, max_total_nr_simulations,
                   max_walltime) -> History:
@@ -811,6 +984,10 @@ class ABCSMC:
         self.sampler.sync_ledger = self.sync_ledger
 
         t0 = self.history.max_t + 1
+        # mid-chunk checkpoint adoption (resilience subsystem): a killed
+        # orchestrator resumes from the exact device carry it
+        # checkpointed — possibly pruning History rows persisted past it
+        t0 = self._maybe_adopt_checkpoint(t0)
         if t0 == 0:
             # the fused loop may own calibration (in-kernel, inside the
             # first chunk) — then the host round trip is skipped and the
@@ -1942,7 +2119,20 @@ class ABCSMC:
                 base = base + (jnp.zeros((), jnp.int32),)
             return base
 
-        carry0 = _build_chunk_carry(t)
+        carry0 = None
+        if self._resume_carry is not None \
+                and t == self.resumed_from_checkpoint_t:
+            # checkpoint resume: the decoded carry IS the state — no
+            # host refit replay, no RNG restart; validated against the
+            # config's carry structure first (numpy leaves feed the
+            # kernel directly)
+            with self.tracer.span("checkpoint.restore", t=int(t)):
+                carry0 = self._validate_resume_carry(
+                    self._resume_carry, _build_chunk_carry, t
+                )
+            self._resume_carry = None
+        if carry0 is None:
+            carry0 = _build_chunk_carry(t)
 
         g_limit = _g_limit(t)
         if g_limit <= 0:
@@ -2116,8 +2306,10 @@ class ABCSMC:
         # traces) — span it separately so compile time is attributed
         with self.tracer.span("dispatch", first=True, t_first=int(t)):
             res = _dispatch_chunk(carry0, t, g_limit)
-        #: (fetch handle, t_at, g_lim) in dispatch order
-        pending = [(_submit(res, t, g_limit), t, g_limit)]
+        #: (fetch handle, t_at, g_lim, final-carry ref) in dispatch order
+        #: — the carry ref is what a checkpoint persists after the chunk
+        #: is processed (the state every following chunk derives from)
+        pending = [(_submit(res, t, g_limit), t, g_limit, res["carry"])]
         tail = (res, t, g_limit)  # newest dispatched chunk (carry chain)
         # even at depth 1 (sync fetch) the NEXT chunk must be dispatched
         # before fetching the current one — both for the old speculative
@@ -2130,7 +2322,15 @@ class ABCSMC:
             the main loop and the drain-async tail thread; only one of
             them ever runs at a time, so the nonlocal state is safe)."""
             nonlocal t, sims_total, chunk_index, t_chunk0
-            (handle, r5_bytes), t_at, g_lim = pending.pop(0)
+            # resilience fault site: an injected orchestrator kill lands
+            # HERE — after dispatch, before the chunk's results are
+            # processed/persisted — the worst spot for generation-
+            # granularity resume and exactly what the mid-chunk
+            # checkpoint heals
+            from ..resilience.faults import maybe_fault as _maybe_fault
+
+            _maybe_fault("orchestrator.chunk", chunk_index=chunk_index)
+            (handle, r5_bytes), t_at, g_lim, carry_ref = pending.pop(0)
             logger.info("t: %d..%d (fused chunk of %d)", t_at,
                         t_at + g_lim - 1, g_lim)
             with self.tracer.span("chunk", t_first=int(t_at),
@@ -2197,6 +2397,24 @@ class ABCSMC:
                     "pyabc_tpu_particles_accepted",
                     "accepted particles across fused chunks",
                 ).inc(int(n_acc_chunk))
+            if (self._checkpoint is not None and not sumstat_refit
+                    and not stop and g_done == g_lim
+                    and chunk_index % self.checkpoint_every == 0):
+                # persist the chunk's final device carry (flush-first: the
+                # db stays at-or-ahead of the checkpoint). sumstat-refit
+                # mode is excluded — its carry is rebuilt host-side at
+                # every chunk boundary, so the device carry is not the
+                # resume state there (README documents the deviation).
+                try:
+                    self._save_fused_checkpoint(
+                        carry_ref, t, sims_total, chunk_index
+                    )
+                except Exception:
+                    # a failed checkpoint degrades durability, never the
+                    # run itself
+                    logger.exception(
+                        "fused checkpoint save failed (run continues)"
+                    )
             if self.chunk_event_cb is not None:
                 try:
                     ev = {
@@ -2249,6 +2467,10 @@ class ABCSMC:
                     if probe_pool is not None:
                         probe_pool.shutdown(wait=True)
                 self.history.done()
+                if self._checkpoint is not None:
+                    # clean completion: the History holds everything; a
+                    # stale checkpoint must not shadow a future run
+                    self._checkpoint.clear()
             except BaseException as exc:  # surfaced by drain_join()
                 self._drain_error = exc
                 try:
@@ -2271,7 +2493,7 @@ class ABCSMC:
                         nxt = _dispatch_chunk(lr["carry"], lt + lg, g_next)
                         tail = (nxt, lt + lg, g_next)
                         pending.append((_submit(nxt, lt + lg, g_next),
-                                        lt + lg, g_next))
+                                        lt + lg, g_next, nxt["carry"]))
                 dispatch_s = clk() - t_disp0
                 if (self.drain_async and not sumstat_refit
                         and chunk_index >= 1 and pending
@@ -2325,7 +2547,8 @@ class ABCSMC:
                     )
                     g_next = _g_limit(t)
                     res = _dispatch_chunk(rebuild_carry(t), t, g_next)
-                    pending = [(_submit(res, t, g_next), t, g_next)]
+                    pending = [(_submit(res, t, g_next), t, g_next,
+                                res["carry"])]
                     tail = (res, t, g_next)
         finally:
             # on a drain-async handoff the tail thread owns the executor
@@ -2336,6 +2559,10 @@ class ABCSMC:
                 if probe_pool is not None:
                     probe_pool.shutdown(wait=True)
         self.history.done()
+        if self._checkpoint is not None:
+            # clean completion: the History holds everything; a stale
+            # checkpoint must not shadow a future run
+            self._checkpoint.clear()
         return self.history
 
     def _device_w_to_host(self, w_struct) -> np.ndarray:

@@ -174,6 +174,13 @@ ELASTIC_CATEGORIES = {
     "worker.connect": "queue_wait",
     "worker.wait": "queue_wait",
     "broker.poll_latency": "orchestrator_poll",
+    # round 9 (resilience): time work sat orphaned between a dead
+    # worker's lease expiring and a live worker picking it back up, plus
+    # the other recovery actions — the recovery-time slice of dark time
+    "recovery.redispatch": "recovery",
+    "recovery.timeout_extended": "recovery",
+    "recovery.persist_retry": "recovery",
+    "recovery.device_reset": "recovery",
 }
 
 
@@ -220,7 +227,7 @@ def elastic_gap_attribution(spans, t0: float | None = None,
     attributed = interval_union(clipped_all)
     categories = {}
     for cat in ("worker_compute", "serialization", "broker_rtt",
-                "queue_wait", "orchestrator_poll"):
+                "queue_wait", "orchestrator_poll", "recovery"):
         sec = interval_union(ivs_by_cat.get(cat, []))
         categories[cat] = {
             "s": round(sec, 6),

@@ -161,6 +161,31 @@ WORKER_THROUGHPUT_GAUGE = "pyabc_tpu_worker_results_per_s"
 WORKER_CLOCK_OFFSET_GAUGE = "pyabc_tpu_worker_clock_offset_max_abs_s"
 WORKER_CLOCK_UNC_GAUGE = "pyabc_tpu_worker_clock_uncertainty_max_s"
 
+# -- resilience instrument names (round 9) -----------------------------------
+#
+# The fault-tolerance subsystem's counters; one canonical place so the
+# broker, worker, writer, fused loop, bench lane and dashboard agree:
+#:  faults fired by the active FaultPlan (tests/bench assert injection)
+FAULTS_INJECTED_TOTAL = "pyabc_tpu_faults_injected_total"
+#:  expired / presumed-dead batch leases requeued and handed to a live
+#:  worker (the self-healing redispatch the acceptance criteria guard)
+BATCHES_REDISPATCHED_TOTAL = "pyabc_tpu_batches_redispatched_total"
+#:  late duplicate deliveries dropped by slot-level dedup (exactly-once)
+DUPLICATES_DROPPED_TOTAL = "pyabc_tpu_duplicate_results_dropped_total"
+#:  batch leases reaped (expired or owner presumed dead) and requeued
+LEASES_EXPIRED_TOTAL = "pyabc_tpu_leases_expired_total"
+#:  broker round trips retried by the shared RetryPolicy (all callers)
+REQUEST_RETRIES_TOTAL = "pyabc_tpu_request_retries_total"
+#:  transient History persist failures retried before sticky latching
+PERSIST_RETRIES_TOTAL = "pyabc_tpu_persist_retries_total"
+#:  fused-loop carry checkpoints written (mid-chunk restore points)
+CHECKPOINTS_WRITTEN_TOTAL = "pyabc_tpu_checkpoints_written_total"
+#:  generation deadlines extended because live workers remain (the
+#:  graceful-degradation path that replaces TimeoutError)
+TIMEOUT_EXTENSIONS_TOTAL = "pyabc_tpu_generation_timeout_extensions_total"
+#:  device contexts dropped + rebuilt after a (simulated) reset
+DEVICE_RESETS_TOTAL = "pyabc_tpu_device_context_resets_total"
+
 
 def per_worker_metric(base: str, worker_id: str) -> str:
     """A per-worker instrument name: ``base`` suffixed with the worker id

@@ -1,0 +1,70 @@
+"""pyabc_tpu.resilience — fault injection, self-healing, checkpoint/restore.
+
+The fault-tolerance subsystem (round 9). Three coordinated layers keep
+an ABC-SMC run alive through the failures the elastic path merely
+OBSERVED before (PRs 1-4 built the observability to see dark time; this
+package acts on it):
+
+- :mod:`.faults` — deterministic fault injection: a seeded,
+  clock-injected :class:`FaultPlan` kills/hangs/slows workers mid-batch,
+  drops broker connections, fails History persists and simulates device
+  resets — from tests, ``abc-worker --fault-plan``, and the bench
+  ``resilience`` lane, so self-healing is proven on every CPU CI run.
+- :mod:`.retry` + :mod:`.lease` — self-healing elastic sampling: one
+  shared :class:`RetryPolicy` behind ``protocol.request`` and the
+  worker reconnect loop; broker batch handouts become LEASES
+  (:class:`LeaseTable`) with deadlines on the injected clock, expired /
+  presumed-dead work requeues to live workers, and slot-level dedup
+  drops late duplicates exactly-once.
+- :mod:`.checkpoint` — mid-chunk device checkpointing: the fused
+  multigen loop's carry (RNG key data, fitted-proposal state, epsilon /
+  pdf-norm trail, refit cadence counter) round-trips bit-exact through
+  :class:`CheckpointManager` with atomic rename, so a killed
+  orchestrator resumes mid-chunk instead of replaying from the last
+  History generation.
+
+Every recovery action emits spans/metrics through the PR 1 observability
+spine (``pyabc_tpu_faults_injected_total``,
+``pyabc_tpu_batches_redispatched_total``, ``recovery.*`` spans feed
+``elastic_gap_attribution``); all deadlines live on the injected clock
+(enforced by ``tests/test_observability_lint.py``).
+"""
+from .checkpoint import (
+    CHECKPOINT_VERSION,
+    CheckpointManager,
+    decode_tree,
+    encode_tree,
+    tree_bit_equal,
+)
+from .faults import (
+    FaultPlan,
+    FaultRule,
+    InjectedConnectionError,
+    InjectedDeviceReset,
+    InjectedFault,
+    InjectedKill,
+    InjectedPersistError,
+    InjectedTransientError,
+    active_fault_plan,
+    install_fault_plan,
+    maybe_fault,
+    uninstall_fault_plan,
+)
+from .lease import LeaseTable
+from .retry import (
+    DEFAULT_PERSIST_RETRY_POLICY,
+    DEFAULT_RETRY_POLICY,
+    RetryPolicy,
+)
+
+__all__ = [
+    "CHECKPOINT_VERSION", "CheckpointManager", "decode_tree", "encode_tree",
+    "tree_bit_equal",
+    "FaultPlan", "FaultRule", "InjectedFault", "InjectedKill",
+    "InjectedConnectionError", "InjectedTransientError",
+    "InjectedPersistError", "InjectedDeviceReset",
+    "active_fault_plan", "install_fault_plan", "maybe_fault",
+    "uninstall_fault_plan",
+    "LeaseTable",
+    "RetryPolicy", "DEFAULT_RETRY_POLICY", "DEFAULT_PERSIST_RETRY_POLICY",
+]

@@ -1,0 +1,170 @@
+"""Mid-chunk device checkpointing for the fused multigen loop.
+
+``ABCSMC.load`` resumes at GENERATION granularity from the History db —
+it replays a HOST transition fit on the last stored population and
+restarts the chunk chain from a host-built carry. That loses everything
+that only lives in the device carry chain: the in-kernel fitted-proposal
+params (PR 3's refit cadence means they are NOT a fresh fit of the last
+population), the generations-since-refit counter, the stochastic
+pdf-norm / Daly-contraction state, and the epsilon running-minimum. This
+module checkpoints the ACTUAL carry: after a processed chunk the loop
+fetches the chunk's final on-device carry, serializes every array leaf
+through :mod:`pyabc_tpu.storage.bytes_storage` (``np.save`` bytes —
+dtype + shape preserved, bit-exact for f32/int32/bool and raw PRNG key
+data), and atomically renames it into place. A killed orchestrator
+resumes by decoding the carry and dispatching the next chunk from it —
+the resumed trajectory is BIT-IDENTICAL to the uninterrupted run (the
+kernel is deterministic in (root_key, t, carry)).
+
+What a checkpoint does NOT capture (documented deviation, mirrors the
+reference's §5.4 adaptive-state caveats): host-side sumstat-predictor
+state (sumstat-refit mode rebuilds its carry at chunk boundaries anyway
+and is excluded from checkpointing), and the History rows themselves —
+the loop flushes the async writer BEFORE each save, so the db is always
+at-or-ahead-of the checkpoint's generation and resume never leaves a
+History gap (``History.prune_from`` trims any rows past the checkpoint
+so re-run generations are not double-persisted).
+
+Atomicity: write to ``<path>.tmp`` + fsync + ``os.replace`` — a crash
+mid-save leaves the previous checkpoint intact, never a torn file.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..observability import NULL_METRICS, NULL_TRACER, SYSTEM_CLOCK
+from ..observability.metrics import CHECKPOINTS_WRITTEN_TOTAL
+from ..storage.bytes_storage import np_from_bytes, np_to_bytes
+
+#: bumped when the on-disk layout changes; loaders ignore other versions
+CHECKPOINT_VERSION = 1
+
+_ND = "__nd__"
+
+
+def encode_tree(obj):
+    """Recursively encode a pytree of containers + array leaves.
+
+    Arrays (numpy or jax — anything ``np.asarray`` accepts) become
+    ``{"__nd__": np.save-bytes}``; tuples/lists/dicts keep their
+    structure (tuples tagged, so decode restores tuple-vs-list exactly —
+    jax carry pytrees are tuple-shaped and lax.scan is strict about it);
+    scalars/str/bytes/None pass through. No pickle of user objects:
+    the skeleton is plain containers, the leaves are np.save blobs.
+    """
+    if obj is None or isinstance(obj, (bool, int, float, str, bytes)):
+        return obj
+    if isinstance(obj, dict):
+        return {"__dict__": {k: encode_tree(v) for k, v in obj.items()}}
+    if isinstance(obj, tuple):
+        return {"__tuple__": [encode_tree(v) for v in obj]}
+    if isinstance(obj, list):
+        return {"__list__": [encode_tree(v) for v in obj]}
+    return {_ND: np_to_bytes(np.asarray(obj))}
+
+
+def decode_tree(obj):
+    """Inverse of :func:`encode_tree`; array leaves come back as numpy
+    (bit-exact) — jax consumes numpy leaves directly on dispatch."""
+    if obj is None or isinstance(obj, (bool, int, float, str, bytes)):
+        return obj
+    if isinstance(obj, dict):
+        if _ND in obj:
+            return np_from_bytes(obj[_ND])
+        if "__tuple__" in obj:
+            return tuple(decode_tree(v) for v in obj["__tuple__"])
+        if "__list__" in obj:
+            return [decode_tree(v) for v in obj["__list__"]]
+        if "__dict__" in obj:
+            return {k: decode_tree(v) for k, v in obj["__dict__"].items()}
+    raise ValueError(f"unrecognized checkpoint node: {type(obj)!r}")
+
+
+class CheckpointManager:
+    """Atomic save/load of one checkpoint file at ``path``."""
+
+    def __init__(self, path: str, clock=None, tracer=None, metrics=None):
+        self.path = str(path)
+        self.clock = clock if clock is not None else SYSTEM_CLOCK
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self.metrics = metrics if metrics is not None else NULL_METRICS
+
+    def save(self, state: dict) -> int:
+        """Encode + atomically persist ``state``; returns bytes written.
+
+        ``state`` is a plain dict whose values may contain arrays at any
+        nesting depth (see :func:`encode_tree`); ``version`` and a wall
+        timestamp are stamped in here.
+        """
+        payload = dict(state)
+        payload["version"] = CHECKPOINT_VERSION
+        payload["saved_wall"] = self.clock.wall()
+        blob = pickle.dumps(encode_tree(payload),
+                            protocol=pickle.HIGHEST_PROTOCOL)
+        tmp = self.path + ".tmp"
+        with self.tracer.span("checkpoint.save", path=self.path,
+                              nbytes=len(blob), t=state.get("t")):
+            with open(tmp, "wb") as fh:
+                fh.write(blob)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, self.path)
+        self.metrics.counter(
+            CHECKPOINTS_WRITTEN_TOTAL,
+            "fused-loop carry checkpoints written",
+        ).inc()
+        return len(blob)
+
+    def load(self) -> dict | None:
+        """The decoded checkpoint, or None (missing / unreadable / other
+        version). Unreadable never raises: a corrupt checkpoint must
+        degrade to generation-granularity resume, not block it."""
+        if not os.path.exists(self.path):
+            return None
+        try:
+            with self.tracer.span("checkpoint.load", path=self.path):
+                with open(self.path, "rb") as fh:
+                    payload = decode_tree(pickle.load(fh))
+        except Exception:
+            return None
+        if not isinstance(payload, dict) \
+                or payload.get("version") != CHECKPOINT_VERSION:
+            return None
+        return payload
+
+    def clear(self) -> None:
+        """Remove the checkpoint (a cleanly finished run needs none)."""
+        for p in (self.path, self.path + ".tmp"):
+            try:
+                os.remove(p)
+            except FileNotFoundError:
+                pass
+
+
+def tree_bit_equal(a, b) -> bool:
+    """Structural + bitwise equality of two encoded/decoded pytrees
+    (test helper: the round-trip guarantee is BIT-exactness, not
+    allclose)."""
+    if type(a) is not type(b):
+        # bool/int and numpy scalar mixes are NOT tolerated: resume must
+        # rebuild exactly what was saved
+        return False
+    if isinstance(a, dict):
+        return a.keys() == b.keys() and all(
+            tree_bit_equal(a[k], b[k]) for k in a
+        )
+    if isinstance(a, (tuple, list)):
+        return len(a) == len(b) and all(
+            tree_bit_equal(x, y) for x, y in zip(a, b)
+        )
+    if isinstance(a, np.ndarray):
+        return (a.dtype == b.dtype and a.shape == b.shape
+                and np.array_equal(
+                    a.view(np.uint8) if a.dtype.kind == "V" else a,
+                    b.view(np.uint8) if b.dtype.kind == "V" else b,
+                    equal_nan=(a.dtype.kind == "f"),
+                ))
+    return a == b

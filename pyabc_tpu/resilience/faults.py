@@ -1,0 +1,256 @@
+"""Deterministic fault injection — kill the system on purpose, on CPU CI.
+
+The reference's elastic path (chrhck/pyABC's Redis sampler) assumes
+workers die; this module makes them die ON SCHEDULE so the self-healing
+machinery is exercised by every test run instead of only by production
+incidents. A :class:`FaultPlan` is a list of :class:`FaultRule`\\ s probed
+at named SITES inside the instrumented modules:
+
+==================== =======================================================
+site                 probed where
+==================== =======================================================
+``worker.batch``     elastic worker, mid-batch (before results ship)
+``protocol.request`` every broker round trip (inside the retry loop)
+``history.persist``  async History writer, before each queued append
+``orchestrator.chunk`` fused loop, before processing each fetched chunk
+``device.context``   DeviceContext build/reuse (simulated device reset)
+==================== =======================================================
+
+Rule kinds map to actions: ``kill`` raises :class:`InjectedKill` (hard
+worker/orchestrator death — no goodbye, no flush), ``drop`` raises
+:class:`InjectedConnectionError` (a ConnectionError subclass, so retry
+policies and reconnect loops handle it like a real network blip),
+``transient`` / ``error`` raise :class:`InjectedTransientError` /
+:class:`InjectedPersistError` (the History writer's two failure
+classes), ``reset`` raises :class:`InjectedDeviceReset`, and ``hang`` /
+``slow`` / ``delay`` sleep for ``delay_s``.
+
+Determinism: probabilistic rules draw from a ``random.Random(seed)``
+owned by the plan, and counting rules (``after`` / ``every`` /
+``max_fires``) run on per-rule probe counters — the same plan replays
+the same fault sequence in every run, which is what lets the fault
+matrix assert posterior parity against a seed-matched fault-free run.
+
+Install a plan process-wide with :func:`install_fault_plan`; every
+instrumented site calls :func:`maybe_fault`, which is a near-free no-op
+when no plan is active (production pays one module-global read).
+``abc-worker --fault-plan "worker.batch:kill:after=2"`` installs a
+parsed plan in a worker process; the bench ``resilience`` lane does the
+same in its mortal-worker subprocesses.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..observability import SYSTEM_CLOCK, global_metrics
+from ..observability.metrics import FAULTS_INJECTED_TOTAL
+
+
+class InjectedFault(Exception):
+    """Base for every injected failure; carries its site and kind."""
+
+    def __init__(self, kind: str, site: str, **ctx):
+        super().__init__(f"injected fault {kind!r} at {site!r} ({ctx})")
+        self.kind = kind
+        self.site = site
+        self.ctx = ctx
+
+
+class InjectedKill(InjectedFault):
+    """Hard death: the victim stops mid-work, ships nothing, says no bye."""
+
+
+class InjectedConnectionError(InjectedFault, ConnectionError):
+    """A dropped connection — caught wherever real ConnectionErrors are.
+
+    (``InjectedFault`` first in the MRO so its ``__init__`` runs;
+    ``ConnectionError`` in the bases so every existing ``except
+    ConnectionError`` — retry policies, reconnect loops — handles it
+    like a real network blip.)"""
+
+
+class InjectedTransientError(InjectedFault):
+    """A persist failure the writer should retry (db-locked-shaped)."""
+
+
+class InjectedPersistError(InjectedFault):
+    """A persist failure that must latch the writer sticky-dead."""
+
+
+class InjectedDeviceReset(InjectedFault):
+    """A lost device context (TPU preemption / tunnel reset simulation)."""
+
+
+_KIND_EXC = {
+    "kill": InjectedKill,
+    "drop": InjectedConnectionError,
+    "transient": InjectedTransientError,
+    "error": InjectedPersistError,
+    "reset": InjectedDeviceReset,
+}
+_KIND_SLEEP = {"hang": 30.0, "slow": 0.05, "delay": 0.05}
+KINDS = tuple(_KIND_EXC) + tuple(_KIND_SLEEP)
+
+
+@dataclass
+class FaultRule:
+    """One deterministic fault: fire ``kind`` at ``site``.
+
+    ``after``: skip the first N matching probes. ``every``: of the
+    probes past ``after``, fire on every k-th (1 = each). ``p``:
+    additionally gate each candidate firing on a seeded coin flip.
+    ``max_fires``: stop after N firings (None = unbounded). ``match``:
+    substring that must appear in the probe's ``worker_id`` context (so
+    one process-global plan can kill only the "mortal" worker).
+    ``delay_s``: sleep duration for hang/slow/delay kinds.
+    """
+
+    site: str
+    kind: str
+    after: int = 0
+    every: int = 1
+    p: float = 1.0
+    max_fires: int | None = 1
+    match: str = ""
+    delay_s: float | None = None
+    #: probe / fire counters (mutated by the owning plan, under its lock)
+    n_probes: int = field(default=0, compare=False)
+    n_fires: int = field(default=0, compare=False)
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r} (one of {KINDS})"
+            )
+        if self.every < 1:
+            raise ValueError("every must be >= 1")
+
+
+class FaultPlan:
+    """A seeded, clock-injected set of fault rules probed at named sites."""
+
+    def __init__(self, rules, seed: int = 0, clock=None, sleep=time.sleep,
+                 metrics=None):
+        self.rules = list(rules)
+        self.clock = clock if clock is not None else SYSTEM_CLOCK
+        self._sleep = sleep
+        self._rng = random.Random(seed)
+        self._metrics = metrics if metrics is not None else global_metrics()
+        self._lock = threading.Lock()
+        #: fired-fault event log: {"site", "kind", "ts", **ctx} — tests
+        #: and the bench lane read it to assert the planned faults
+        #: actually landed
+        self.events: list[dict] = []
+
+    @classmethod
+    def parse(cls, spec: str, **kwargs) -> "FaultPlan":
+        """Parse ``"site:kind[:key=val,...][;site:kind...]"``.
+
+        Example: ``"worker.batch:kill:after=2,match=mortal;``
+        ``protocol.request:drop:max_fires=1"``. Numeric values are
+        int/float-coerced; ``max_fires=none`` lifts the one-shot default.
+        """
+        rules = []
+        for part in str(spec).split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            bits = part.split(":", 2)
+            if len(bits) < 2:
+                raise ValueError(
+                    f"fault spec {part!r}: want site:kind[:k=v,...]"
+                )
+            site, kind = bits[0].strip(), bits[1].strip()
+            opts: dict = {}
+            if len(bits) == 3 and bits[2].strip():
+                for kv in bits[2].split(","):
+                    k, _, v = kv.partition("=")
+                    k, v = k.strip(), v.strip()
+                    if k in ("after", "every"):
+                        opts[k] = int(v)
+                    elif k == "max_fires":
+                        opts[k] = None if v.lower() == "none" else int(v)
+                    elif k in ("p", "delay_s"):
+                        opts[k] = float(v)
+                    elif k == "match":
+                        opts[k] = v
+                    else:
+                        raise ValueError(f"unknown fault option {k!r}")
+            rules.append(FaultRule(site=site, kind=kind, **opts))
+        if not rules:
+            raise ValueError(f"empty fault spec {spec!r}")
+        return cls(rules, **kwargs)
+
+    def probe(self, site: str, **ctx) -> None:
+        """Evaluate every rule for ``site``; raise/sleep if one fires."""
+        fired: FaultRule | None = None
+        with self._lock:
+            for rule in self.rules:
+                if rule.site != site:
+                    continue
+                if rule.match and rule.match not in str(
+                        ctx.get("worker_id", "")):
+                    continue
+                rule.n_probes += 1
+                if rule.n_probes <= rule.after:
+                    continue
+                if (rule.n_probes - rule.after - 1) % rule.every != 0:
+                    continue
+                if rule.max_fires is not None \
+                        and rule.n_fires >= rule.max_fires:
+                    continue
+                if rule.p < 1.0 and self._rng.random() >= rule.p:
+                    continue
+                rule.n_fires += 1
+                fired = rule
+                self.events.append({
+                    "site": site, "kind": rule.kind,
+                    "ts": self.clock.now(), **ctx,
+                })
+                break  # one fault per probe
+        if fired is None:
+            return
+        self._metrics.counter(
+            FAULTS_INJECTED_TOTAL,
+            "faults fired by the active FaultPlan",
+        ).inc()
+        if fired.kind in _KIND_SLEEP:
+            self._sleep(fired.delay_s if fired.delay_s is not None
+                        else _KIND_SLEEP[fired.kind])
+            return
+        raise _KIND_EXC[fired.kind](fired.kind, site, **ctx)
+
+    def n_fired(self, site: str | None = None) -> int:
+        with self._lock:
+            return sum(r.n_fires for r in self.rules
+                       if site is None or r.site == site)
+
+
+#: the process-global active plan; ``maybe_fault`` reads it lock-free
+#: (assignment is atomic) so un-faulted production pays one global read
+_ACTIVE: FaultPlan | None = None
+
+
+def install_fault_plan(plan: FaultPlan) -> FaultPlan:
+    global _ACTIVE
+    _ACTIVE = plan
+    return plan
+
+
+def uninstall_fault_plan() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def active_fault_plan() -> FaultPlan | None:
+    return _ACTIVE
+
+
+def maybe_fault(site: str, **ctx) -> None:
+    """Probe the active plan, if any (the instrumented sites call this)."""
+    plan = _ACTIVE
+    if plan is not None:
+        plan.probe(site, **ctx)

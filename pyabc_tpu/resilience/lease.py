@@ -1,0 +1,201 @@
+"""Batch leases: handed-out work the broker can take back.
+
+Pre-round-9, a broker slot range was fire-and-forget: a worker that died
+with slots in flight simply never delivered them, which was harmless in
+pure dynamic mode (completion is acceptance-driven) but STALLED
+``wait_for_all`` and static-quota generations until the sampler's
+``generation_timeout`` — "bounded by the timeout, not self-healing"
+(broker.py's own docstring). This module turns every handout into a
+LEASE: ``(worker, slot range, deadline)`` on the injected clock. Expired
+or presumed-dead leases requeue their undelivered slots, the next
+``get_slots`` from a live worker redispatches them, and slot-level dedup
+drops a late duplicate delivery exactly-once (so a slow-but-alive worker
+whose lease was reassigned cannot double-count a batch — which also
+makes retried ``results`` messages safe when the first attempt's reply
+was lost on the wire).
+
+Dedup semantics per scheduling mode: dynamic slots yield exactly one
+result each, so ANY second delivery of a slot is dropped; static quota
+units legitimately ship many reject records under one slot id, so only
+a second ACCEPTED delivery per slot is dropped (acceptance is the unit
+of accounting there).
+
+Pure bookkeeping on one injected clock — no threads, no sockets; the
+owning :class:`~pyabc_tpu.broker.broker.EvalBroker` calls it under its
+own lock.
+"""
+from __future__ import annotations
+
+from collections import deque
+
+
+class LeaseTable:
+    """Outstanding slot leases + requeue + dedup for ONE generation.
+
+    Cumulative counters (``redispatched_total``, ``duplicates_dropped``,
+    ``leases_expired``) survive :meth:`reset` — they are run-lifetime
+    observability, not generation state.
+    """
+
+    def __init__(self, clock, timeout_s: float = 30.0):
+        self.clock = clock
+        self.timeout_s = float(timeout_s)
+        #: lease_id -> {"wid", "slots": set, "deadline", "start", "stop"}
+        self._leases: dict[int, dict] = {}
+        self._next_id = 0
+        #: slot -> lease_id for every undelivered outstanding slot
+        self._slot_owner: dict[int, int] = {}
+        #: requeued (slot_start, slot_stop, requeued_at) ranges, FIFO
+        self._requeue: deque = deque()
+        #: slots delivered at least once (dynamic dedup)
+        self._delivered: set[int] = set()
+        #: slots with an ACCEPTED delivery (static dedup)
+        self._accepted: set[int] = set()
+        # run-lifetime counters
+        self.redispatched_total = 0
+        self.duplicates_dropped = 0
+        self.leases_expired = 0
+
+    # ----------------------------------------------------------- lifecycle
+    def reset(self) -> None:
+        """New generation: drop per-generation state, keep counters."""
+        self._leases.clear()
+        self._slot_owner.clear()
+        self._requeue.clear()
+        self._delivered.clear()
+        self._accepted.clear()
+
+    # ------------------------------------------------------------- granting
+    def grant(self, wid: str, start: int, stop: int) -> int:
+        """Lease ``[start, stop)`` to ``wid``; returns the lease id."""
+        lease_id = self._next_id
+        self._next_id += 1
+        slots = set(range(int(start), int(stop)))
+        self._leases[lease_id] = {
+            "wid": str(wid), "slots": slots,
+            "deadline": self.clock.now() + self.timeout_s,
+            "start": int(start), "stop": int(stop),
+        }
+        for s in slots:
+            self._slot_owner[s] = lease_id
+        return lease_id
+
+    def take_requeued(self, wid: str, k: int) -> tuple[int, int, float] | None:
+        """Redispatch up to ``k`` requeued slots to ``wid``.
+
+        Returns ``(start, stop, orphaned_at)`` — the range to hand out
+        (re-leased to ``wid``) and the instant the work was orphaned
+        (its dead owner's last contact; the broker turns the
+        orphaned->redispatched interval into a ``recovery.redispatch``
+        span). None when nothing is queued.
+        """
+        if not self._requeue:
+            return None
+        start, stop, ts = self._requeue.popleft()
+        k = max(int(k), 1)
+        if stop - start > k:
+            # split: serve the head, requeue the tail (same orphan time)
+            self._requeue.appendleft((start + k, stop, ts))
+            stop = start + k
+        self.grant(wid, start, stop)
+        self.redispatched_total += 1
+        return (start, stop, ts)
+
+    # ------------------------------------------------------------ delivery
+    def touch_worker(self, wid: str) -> None:
+        """Any contact from ``wid`` extends its leases: a slow-but-alive
+        worker mid-long-batch must not lose its work to the timeout."""
+        deadline = self.clock.now() + self.timeout_s
+        for lease in self._leases.values():
+            if lease["wid"] == wid:
+                lease["deadline"] = deadline
+
+    def admit(self, slot: int, accepted: bool, mode: str) -> bool:
+        """Should this delivered triple be counted? (exactly-once dedup)"""
+        slot = int(slot)
+        if mode == "static":
+            # quota units ship every reject + one accept per slot; only
+            # the ACCEPT is unit-of-account and must land exactly once
+            if accepted:
+                if slot in self._accepted:
+                    self.duplicates_dropped += 1
+                    return False
+                self._accepted.add(slot)
+            return True
+        if slot in self._delivered:
+            self.duplicates_dropped += 1
+            return False
+        self._delivered.add(slot)
+        return True
+
+    def note_delivery(self, slot: int) -> None:
+        """Mark ``slot`` delivered: release it from its owning lease."""
+        lease_id = self._slot_owner.pop(int(slot), None)
+        if lease_id is None:
+            return
+        lease = self._leases.get(lease_id)
+        if lease is None:
+            return
+        lease["slots"].discard(int(slot))
+        if not lease["slots"]:
+            del self._leases[lease_id]
+
+    # -------------------------------------------------------------- reaping
+    def reap(self, now: float, dead_wids=()) -> list[dict]:
+        """Requeue every lease that expired or belongs to a dead worker.
+
+        Returns one event per reaped lease:
+        ``{"wid", "n_slots", "ranges", "reason"}``.
+        """
+        dead = set(dead_wids)
+        events = []
+        for lease_id in list(self._leases):
+            lease = self._leases[lease_id]
+            expired = now > lease["deadline"]
+            is_dead = lease["wid"] in dead
+            if not (expired or is_dead):
+                continue
+            ranges = _runs(sorted(lease["slots"]))
+            # the work was ORPHANED at the owner's last contact (deadline
+            # minus the timeout = the last grant/touch instant), not at
+            # reap time — the recovery span must cover the whole stall
+            # the dead worker caused, or gap attribution under-reports
+            # recovery time by exactly the detection latency
+            orphaned_at = min(lease["deadline"] - self.timeout_s, now)
+            for a, b in ranges:
+                self._requeue.append((a, b, orphaned_at))
+            for s in lease["slots"]:
+                self._slot_owner.pop(s, None)
+            del self._leases[lease_id]
+            self.leases_expired += 1
+            events.append({
+                "wid": lease["wid"],
+                "n_slots": sum(b - a for a, b in ranges),
+                "ranges": ranges,
+                "reason": "presumed_dead" if is_dead else "lease_expired",
+            })
+        return events
+
+    # ---------------------------------------------------------------- views
+    def stats(self) -> dict:
+        return {
+            "outstanding_leases": len(self._leases),
+            "outstanding_slots": len(self._slot_owner),
+            "requeued_slots": sum(b - a for a, b, _t in self._requeue),
+            "redispatched_total": self.redispatched_total,
+            "duplicates_dropped": self.duplicates_dropped,
+            "leases_expired": self.leases_expired,
+            "timeout_s": self.timeout_s,
+        }
+
+
+def _runs(sorted_slots) -> list[tuple[int, int]]:
+    """Contiguous [start, stop) runs of a sorted slot list — requeued
+    work still travels the wire in the ("slots", start, stop) shape."""
+    runs: list[tuple[int, int]] = []
+    for s in sorted_slots:
+        if runs and s == runs[-1][1]:
+            runs[-1] = (runs[-1][0], s + 1)
+        else:
+            runs.append((s, s + 1))
+    return runs

@@ -1,0 +1,98 @@
+"""RetryPolicy — the one backoff schedule every transient-failure site uses.
+
+Before this subsystem each site hand-rolled its own retry behavior:
+``protocol.request`` gave up after one connect attempt, the elastic
+worker's reconnect loop slept a fixed ``min(poll_s * 4, 2.0)``, and the
+async History writer latched sticky-dead on the FIRST persist failure.
+One policy object replaces all three: capped exponential backoff with
+seeded jitter, deadlines computed on the injected observability clock
+(never a raw wall-clock read — the repo lint enforces it), and an
+``on_retry`` hook so each site can count its retries into the metrics
+registry.
+
+Determinism: jitter draws from a caller-seeded ``random.Random``, so a
+test (or a FaultPlan-driven CI lane) replays the exact same backoff
+sequence every run.
+"""
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass
+
+from ..observability import SYSTEM_CLOCK
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Capped exponential backoff with jitter.
+
+    ``attempts``: total tries (1 = no retry). ``base_s`` doubles (times
+    ``multiplier``) per retry up to ``max_s``; ``jitter`` is the fraction
+    of each delay randomized uniformly in ``[1 - jitter, 1 + jitter]``
+    (full delays synchronize retry storms across a worker pool — the
+    classic thundering-herd failure of jitter-free backoff).
+    """
+
+    attempts: int = 3
+    base_s: float = 0.05
+    max_s: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.5
+
+    def __post_init__(self):
+        if self.attempts < 1:
+            raise ValueError(f"attempts must be >= 1, got {self.attempts}")
+
+    def delay_s(self, retry_index: int, rng: random.Random | None = None
+                ) -> float:
+        """Backoff before retry ``retry_index`` (0-based)."""
+        d = min(self.base_s * (self.multiplier ** retry_index), self.max_s)
+        if self.jitter > 0 and rng is not None:
+            d *= 1.0 + self.jitter * (2.0 * rng.random() - 1.0)
+        return max(d, 0.0)
+
+    def delays(self, rng: random.Random | None = None):
+        """The ``attempts - 1`` backoff delays, in order."""
+        return [self.delay_s(i, rng) for i in range(self.attempts - 1)]
+
+    def call(self, fn, *, retry_on=(ConnectionError, OSError),
+             rng: random.Random | None = None,
+             sleep=time.sleep, clock=None, deadline_s: float | None = None,
+             on_retry=None):
+        """Run ``fn()`` under this policy.
+
+        Retries on ``retry_on`` exceptions only; everything else
+        propagates immediately (a genuine bug must not be retried into
+        an n-times-repeated bug). ``deadline_s`` bounds the TOTAL time
+        across attempts on the injected ``clock`` (default: the shared
+        SYSTEM_CLOCK); ``on_retry(retry_index, exc)`` fires before each
+        backoff sleep. The last failure re-raises unchanged.
+        """
+        clock = clock if clock is not None else SYSTEM_CLOCK
+        t_end = (clock.now() + float(deadline_s)
+                 if deadline_s is not None else None)
+        for i in range(self.attempts):
+            try:
+                return fn()
+            except retry_on as exc:
+                if i >= self.attempts - 1:
+                    raise
+                if t_end is not None and clock.now() >= t_end:
+                    raise
+                if on_retry is not None:
+                    on_retry(i, exc)
+                sleep(self.delay_s(i, rng))
+
+
+#: the shared default: 3 tries with 50 ms -> 100 ms backoff. Sized for a
+#: broker blip (process restart, transient accept-queue overflow), not a
+#: broker death — callers with their own liveness loop (the worker's
+#: hello poll) layer reconnect backoff on top.
+DEFAULT_RETRY_POLICY = RetryPolicy(attempts=3, base_s=0.05, max_s=0.5)
+
+#: persist-side default: sqlite "database is locked" contention clears in
+#: tens of milliseconds; three spaced tries before the writer latches
+#: sticky keeps the sticky semantics for genuinely broken db state
+DEFAULT_PERSIST_RETRY_POLICY = RetryPolicy(attempts=3, base_s=0.05,
+                                           max_s=1.0)

@@ -120,11 +120,26 @@ class _AsyncWriter:
     shared connection safe; History additionally locks multi-statement
     transactions. Worker exceptions are re-raised on the next submit/flush
     so a failed persist cannot pass silently.
+
+    Transient-failure retry (round 9): a persist failing with a
+    TRANSIENT error (``transient_types`` — the dialect's
+    OperationalError, e.g. sqlite "database is locked", plus the fault
+    plan's injected transient) retries under a bounded
+    :class:`~pyabc_tpu.resilience.retry.RetryPolicy` before anything
+    latches. The append_population path rolls back before re-raising,
+    so each retry starts from a clean transaction. Only exhausted
+    retries or a NON-transient error (genuinely broken db state) latch
+    the writer sticky-dead — from then on queued work drains without
+    executing and every submit/flush/close re-raises, exactly the old
+    semantics.
     """
 
-    def __init__(self, tracer=None, metrics=None):
+    def __init__(self, tracer=None, metrics=None,
+                 transient_types: tuple = (), retry=None, clock=None):
         import queue
         import threading
+
+        from ..resilience.retry import DEFAULT_PERSIST_RETRY_POLICY
 
         self._queue: "queue.Queue" = queue.Queue()
         self._error: BaseException | None = None
@@ -133,12 +148,46 @@ class _AsyncWriter:
         # persistence trails the compute that produced the populations
         self._tracer = tracer if tracer is not None else NULL_TRACER
         self._metrics = metrics if metrics is not None else NULL_METRICS
+        self._transient_types = tuple(transient_types)
+        self._retry = (retry if retry is not None
+                       else DEFAULT_PERSIST_RETRY_POLICY)
+        self._clock = clock if clock is not None else self._tracer.clock
         self._backlog_gauge = self._metrics.gauge(
             "pyabc_tpu_db_writer_backlog",
             "queued population appends awaiting the writer thread",
         )
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
+
+    def _write_with_retry(self, fn, args, kwargs):
+        import time as _time
+
+        from ..observability.metrics import PERSIST_RETRIES_TOTAL
+        from ..resilience.faults import maybe_fault
+
+        for attempt in range(self._retry.attempts):
+            try:
+                maybe_fault("history.persist", attempt=attempt)
+                with self._tracer.span("db.write",
+                                       backlog=self._queue.qsize(),
+                                       attempt=attempt):
+                    fn(*args, **kwargs)
+                return
+            except self._transient_types:
+                if attempt >= self._retry.attempts - 1:
+                    raise
+                delay = self._retry.delay_s(attempt)
+                self._metrics.counter(
+                    PERSIST_RETRIES_TOTAL,
+                    "transient History persist failures retried before "
+                    "sticky latching",
+                ).inc()
+                t0 = self._clock.now()
+                _time.sleep(delay)
+                self._tracer.record_span(
+                    "recovery.persist_retry", t0, self._clock.now(),
+                    thread="recovery", attempt=attempt,
+                )
 
     def _run(self):
         while True:
@@ -151,9 +200,7 @@ class _AsyncWriter:
                 # after a failure, drain without executing: later appends
                 # must not commit on top of a possibly broken db state
                 if self._error is None:
-                    with self._tracer.span("db.write",
-                                           backlog=self._queue.qsize()):
-                        fn(*args, **kwargs)
+                    self._write_with_retry(fn, args, kwargs)
             except BaseException as exc:  # noqa: BLE001 - surfaced later
                 self._error = exc
             finally:
@@ -161,10 +208,11 @@ class _AsyncWriter:
                 self._backlog_gauge.set(self._queue.qsize())
 
     def _check(self):
-        # the error stays STICKY: once a persist failed, the writer is dead
-        # (queued work drains without executing) and every later
-        # submit/flush/close re-raises — a caller that swallows one raise
-        # cannot accidentally resume committing on a broken db state
+        # the error stays STICKY: once a persist failed beyond the
+        # transient-retry budget, the writer is dead (queued work drains
+        # without executing) and every later submit/flush/close
+        # re-raises — a caller that swallows one raise cannot
+        # accidentally resume committing on a broken db state
         if self._error is not None:
             raise self._error
 
@@ -236,7 +284,17 @@ class History:
     # ------------------------------------------------------- async writing
     def start_async_writer(self) -> "_AsyncWriter":
         if self._writer is None:
-            self._writer = _AsyncWriter(self.tracer, self.metrics)
+            from ..resilience.faults import InjectedTransientError
+
+            # transient = the dialect's OperationalError family (sqlite
+            # "database is locked"/"busy", a dropped pg connection that
+            # reconnects) + the fault plan's injected transient; schema /
+            # integrity / programming errors stay immediately sticky
+            self._writer = _AsyncWriter(
+                self.tracer, self.metrics,
+                transient_types=(self._dialect.OperationalError,
+                                 InjectedTransientError),
+            )
         return self._writer
 
     def append_population_async(self, *args, **kwargs) -> None:
@@ -411,6 +469,48 @@ class History:
                      for pid, i in zip(pids, idxs)],
                 )
         self._conn.commit()
+
+    @_locked
+    def prune_from(self, t: int) -> int:
+        """Delete this run's populations with generation >= ``t`` (and
+        their models/particles/parameters/samples). Returns the number
+        of populations removed.
+
+        Resume seam for the mid-chunk checkpoint (resilience subsystem):
+        an orchestrator killed between a checkpoint save and its death
+        may have persisted generations PAST the checkpoint's resume
+        point; re-running them from the restored carry would otherwise
+        append duplicate population rows for the same ``t``. The
+        checkpoint is the canonical state — rows past it are trimmed
+        before the re-run."""
+        cur = self._conn.cursor()
+        pop_ids = [r[0] for r in cur.execute(
+            "SELECT id FROM populations WHERE abc_smc_id=? AND t>=?",
+            (self.id, int(t)),
+        ).fetchall()]
+        if not pop_ids:
+            return 0
+        ph = ",".join("?" * len(pop_ids))
+        cur.execute(
+            f"DELETE FROM samples WHERE particle_id IN ("
+            f"SELECT particles.id FROM particles JOIN models "
+            f"ON particles.model_id = models.id "
+            f"WHERE models.population_id IN ({ph}))", pop_ids)
+        cur.execute(
+            f"DELETE FROM parameters WHERE particle_id IN ("
+            f"SELECT particles.id FROM particles JOIN models "
+            f"ON particles.model_id = models.id "
+            f"WHERE models.population_id IN ({ph}))", pop_ids)
+        cur.execute(
+            f"DELETE FROM particles WHERE model_id IN ("
+            f"SELECT id FROM models WHERE population_id IN ({ph}))",
+            pop_ids)
+        cur.execute(
+            f"DELETE FROM models WHERE population_id IN ({ph})", pop_ids)
+        cur.execute(
+            f"DELETE FROM populations WHERE id IN ({ph})", pop_ids)
+        self._conn.commit()
+        return len(pop_ids)
 
     def update_telemetry(self, t: int, telemetry: dict) -> None:
         """Merge keys into the telemetry json of generation t (adaptation

@@ -51,3 +51,22 @@ DEFAULT_ELASTIC_SIM_DELAY_S = 0.002
 #: regression guard: minimum attributed fraction of a warm elastic run's
 #: wall clock (ISSUE round 8 acceptance: >= 0.9 on the CPU probe)
 ELASTIC_ATTRIBUTED_FRAC_MIN = 0.9
+# resilience lane (round 9): self-healing under injected faults. Same
+# CPU-capable gauss config as the elastic lane, but one of the two
+# workers is MORTAL — its fault plan kills it hard after a few batches
+# every life and a babysitter respawns it — so every generation sees at
+# least one mid-batch death, a lease requeue and a redispatch to the
+# surviving worker. The lane guards (a) run completion WITHOUT
+# TimeoutError, (b) >= 1 redispatched batch, (c) the warm-run attributed
+# fraction >= 0.9 with recovery windows counted via the gap accountant's
+# `recovery` category. Lease timeout is tightened so recovery latency
+# (not the 15 s production backstop) fits a CI-scale run.
+DEFAULT_RESILIENCE_POP = 100
+DEFAULT_RESILIENCE_GENS = 3
+DEFAULT_RESILIENCE_RUNS = 2
+DEFAULT_RESILIENCE_SIM_DELAY_S = 0.002
+DEFAULT_RESILIENCE_KILL_AFTER_BATCHES = 3
+DEFAULT_RESILIENCE_LEASE_TIMEOUT_S = 1.0
+#: regression guard: minimum attributed fraction of a warm resilience
+#: run's wall clock (round 9 acceptance: >= 0.9 under injected faults)
+RESILIENCE_ATTRIBUTED_FRAC_MIN = 0.9

@@ -33,10 +33,12 @@ def bench(monkeypatch, tmp_path, capsys):
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     # skip the jax platform probe subprocess and the host-baseline run,
-    # and the elastic lane (it spawns REAL worker subprocesses — the
-    # loop tests drive a virtual clock; the lane has its own unit test)
+    # and the elastic + resilience lanes (they spawn REAL worker
+    # subprocesses — the loop tests drive a virtual clock; the lanes
+    # have their own unit tests)
     monkeypatch.setenv("PYABC_TPU_BENCH_CPU", "1")
     monkeypatch.setenv("PYABC_TPU_BENCH_ELASTIC", "0")
+    monkeypatch.setenv("PYABC_TPU_BENCH_RESILIENCE", "0")
     monkeypatch.setattr(mod, "probe_platform", lambda *a, **k: "cpu")
     monkeypatch.setattr(mod, "run_host_baseline", lambda **k: 800.0)
     monkeypatch.setattr(
@@ -182,9 +184,10 @@ def test_headline_both_bases_and_full_coverage(bench, monkeypatch, capsys):
     gens = [r.get("generations_completed") for r in d["runs"]
             if "error" not in r and "elided_runs" not in r]
     assert gens and all(g == 32 for g in gens)
-    # lanes are never silent: the fixture disables the elastic lane, so
-    # its recorded skip reason must appear in the JSON
+    # lanes are never silent: the fixture disables the elastic and
+    # resilience lanes, so their recorded skip reasons must appear
     assert d["elastic"]["skipped"].startswith("disabled")
+    assert d["resilience"]["skipped"].startswith("disabled")
 
 
 def test_one_off_failure_retries_and_completes(bench, monkeypatch, capsys):

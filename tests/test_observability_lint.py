@@ -22,7 +22,8 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 
 #: modules wired into the observability subsystem; the clock rule holds
-#: for each of them (extend this list when instrumenting a new module)
+#: for each of them (extend this list when instrumenting a new module).
+#: Entries ending in "/" pin every .py file under that directory.
 INSTRUMENTED = [
     "bench.py",
     "pyabc_tpu/inference/smc.py",
@@ -33,6 +34,7 @@ INSTRUMENTED = [
     "pyabc_tpu/broker/worker.py",
     "pyabc_tpu/storage/history.py",
     "pyabc_tpu/cli.py",
+    "pyabc_tpu/resilience/",
 ]
 
 #: the distributed-tracing path: dropping any of these from INSTRUMENTED
@@ -44,6 +46,23 @@ TRACING_CRITICAL = {
     "pyabc_tpu/broker/sampler.py",
     "pyabc_tpu/broker/worker.py",
 }
+
+#: the resilience subsystem (round 9) is pinned as a DIRECTORY: every
+#: lease deadline, retry backoff, fault schedule and checkpoint
+#: timestamp must live on the injected clock, or fault plans stop being
+#: deterministic and recovery spans stop merging onto the run timeline
+RESILIENCE_PIN = "pyabc_tpu/resilience/"
+
+
+def _instrumented_files():
+    for rel in INSTRUMENTED:
+        if rel.endswith("/"):
+            root = REPO / rel
+            assert root.is_dir(), f"instrumented directory moved: {rel}"
+            for path in sorted(root.rglob("*.py")):
+                yield str(path.relative_to(REPO)), path
+        else:
+            yield rel, REPO / rel
 
 _TIME_TIME = re.compile(r"\btime\.(?:time|perf_counter)\(")
 _AD_HOC = re.compile(
@@ -63,8 +82,7 @@ def _code_lines(path: Path):
 
 def test_instrumented_modules_use_injected_clock():
     offenders = []
-    for rel in INSTRUMENTED:
-        path = REPO / rel
+    for rel, path in _instrumented_files():
         assert path.exists(), f"instrumented module moved: {rel}"
         for lineno, line in _code_lines(path):
             if _TIME_TIME.search(line):
@@ -87,6 +105,23 @@ def test_tracing_critical_modules_stay_pinned():
     assert not missing, (
         f"tracing-critical modules missing from INSTRUMENTED: {missing}"
     )
+
+
+def test_resilience_package_stays_pinned():
+    """The resilience package cannot be dropped from the enforced list:
+    fault plans replay deterministically and lease/retry deadlines merge
+    onto the run timeline only because every timestamp in the subsystem
+    comes from an injected clock."""
+    assert RESILIENCE_PIN in INSTRUMENTED, (
+        f"{RESILIENCE_PIN} missing from INSTRUMENTED"
+    )
+    # and the directory expansion actually finds its modules
+    pinned = [rel for rel, _p in _instrumented_files()
+              if rel.startswith("pyabc_tpu/resilience/")]
+    assert {"pyabc_tpu/resilience/faults.py",
+            "pyabc_tpu/resilience/retry.py",
+            "pyabc_tpu/resilience/lease.py",
+            "pyabc_tpu/resilience/checkpoint.py"} <= set(pinned), pinned
 
 
 def test_no_ad_hoc_telemetry_outside_observability():
