@@ -19,7 +19,8 @@ Robustness contract (a bench that can die silently is not a bench):
 Env knobs: PYABC_TPU_BENCH_POP (default 1000), PYABC_TPU_BENCH_GENS (31),
 PYABC_TPU_BENCH_G (fused generations per chunk, 16),
 PYABC_TPU_BENCH_BUDGET_S (300), PYABC_TPU_BENCH_CPU=1 (force CPU platform),
-PYABC_TPU_BENCH_STORE_SS=1 (store per-particle sum stats in the db).
+PYABC_TPU_BENCH_STORE_SS=1 (store per-particle sum stats in the db),
+PYABC_TPU_BENCH_ELASTIC/RESILIENCE/HEALTH=0 (disable those lanes).
 """
 import atexit
 import json
@@ -694,6 +695,43 @@ def run_resilience_lane(budget_s: float) -> dict:
         })
     warm = [r for r in per_run if r["warm"]]
     leases = status.leases or {}
+    # round-10 satellite: ZERO unattributed recovery time. The broker's
+    # own recovery log is ground truth for the injected stall windows
+    # (each redispatch entry carries its redispatch instant and the
+    # orphaned duration); the TRACER's recovery spans — what the gap
+    # accountant actually credits — must cover those windows within
+    # tolerance, or the lane fails: a regression in recovery-span
+    # recording would otherwise just read as slightly darker dark time.
+    from pyabc_tpu.observability import interval_intersection, \
+        interval_union
+    from pyabc_tpu.utils.bench_defaults import (
+        RESILIENCE_RECOVERY_UNATTRIBUTED_ABS_S,
+        RESILIENCE_RECOVERY_UNATTRIBUTED_FRAC_MAX,
+    )
+
+    stall_ivs = [
+        (ev["ts"] - ev["orphaned_s"], ev["ts"])
+        for ev in (status.recovery or [])
+        if ev.get("action") == "redispatch" and ev.get("orphaned_s")
+    ]
+    recovery_ivs = [
+        (d["start"], d["end"]) for d in sdicts
+        if str(d["name"]).startswith(("recovery.", "health."))
+        and d.get("end") is not None
+    ]
+    stall_s = interval_union(stall_ivs)
+    covered_s = interval_intersection(stall_ivs, recovery_ivs)
+    unattributed_s = max(stall_s - covered_s, 0.0)
+    recovery_accounting = {
+        "stall_windows": len(stall_ivs),
+        "stall_s": round(stall_s, 6),
+        "covered_by_recovery_spans_s": round(covered_s, 6),
+        "unattributed_s": round(unattributed_s, 6),
+        "basis": (
+            "broker recovery-log redispatch entries (ts - orphaned_s ->"
+            " ts) vs the union of recovery.*/health.* tracer spans"
+        ),
+    }
     out = {
         "metric": "resilience_steady_attributed_frac",
         "pop_size": pop, "kill_after_batches": kill_after,
@@ -701,6 +739,7 @@ def run_resilience_lane(budget_s: float) -> dict:
         "per_run": per_run,
         "worker_kills_observed": respawns["n"],
         "leases": leases,
+        "recovery_accounting": recovery_accounting,
         "recovery_log_tail": list(status.recovery or [])[-10:],
         "recovery_decomposition": {
             "basis": (
@@ -731,6 +770,155 @@ def run_resilience_lane(budget_s: float) -> dict:
         "pass_no_double_count": True,  # dedup counters below are the
         # evidence: every duplicate was DROPPED, none admitted twice
         "duplicates_dropped": leases.get("duplicates_dropped", 0),
+        # round-10 satellite: recovery time is ACCOUNTED, not dark —
+        # the recovery spans must cover the broker-logged stall windows
+        "pass_recovery_accounted": bool(
+            unattributed_s <= max(
+                RESILIENCE_RECOVERY_UNATTRIBUTED_FRAC_MAX * stall_s,
+                RESILIENCE_RECOVERY_UNATTRIBUTED_ABS_S)),
+        "recovery_unattributed_s": round(unattributed_s, 6),
+    }
+    return out
+
+
+# -- health lane --------------------------------------------------------------
+
+
+def health_lane_skip_reason() -> str | None:
+    """The `health` lane proves the round-10 numerical guards end to
+    end on every probe: a fused run with an injected mid-chunk
+    ``nan_poison`` carry corruption must recover to posterior parity
+    with <= 1 rolled-back chunk, and health detection must add ZERO
+    blocking syncs. CPU-cheap (small fused gauss config);
+    PYABC_TPU_BENCH_HEALTH=0 disables it."""
+    if os.environ.get("PYABC_TPU_BENCH_HEALTH") == "0":
+        return "disabled via PYABC_TPU_BENCH_HEALTH=0"
+    return None
+
+
+def run_health_lane(budget_s: float) -> dict:
+    """NaN-poison recovery lane: seed-matched fault-free vs poisoned
+    fused runs. Guards: (a) the poisoned run completes, (b) exactly one
+    chunk was rolled back, (c) posterior parity — BIT-identical epsilon
+    trail and final-generation weighted posterior moments, because the
+    rollback target is exactly the carry the clean run chained from —
+    and (d) zero extra blocking syncs from the health word itself
+    (SyncLedger counts equal across the two runs; the recovery
+    redispatch's own fetch is reported separately)."""
+    import jax
+    import numpy as np
+
+    import pyabc_tpu as pt
+    from pyabc_tpu.observability import MetricsRegistry
+    from pyabc_tpu.resilience import (
+        FaultPlan,
+        FaultRule,
+        install_fault_plan,
+        uninstall_fault_plan,
+    )
+    from pyabc_tpu.utils.bench_defaults import (
+        DEFAULT_HEALTH_G,
+        DEFAULT_HEALTH_GENS,
+        DEFAULT_HEALTH_POP,
+        HEALTH_MAX_ROLLBACKS,
+    )
+
+    pop = int(os.environ.get("PYABC_TPU_BENCH_HEALTH_POP",
+                             DEFAULT_HEALTH_POP))
+    gens = int(os.environ.get("PYABC_TPU_BENCH_HEALTH_GENS",
+                              DEFAULT_HEALTH_GENS))
+    G = int(os.environ.get("PYABC_TPU_BENCH_HEALTH_G", DEFAULT_HEALTH_G))
+    t_lane0 = CLOCK.now()
+
+    @pt.JaxModel.from_function(["theta"], name="gauss_health")
+    def model(key, theta):
+        return {"x": theta[0] + 0.5 * jax.random.normal(key)}
+
+    def make(reg):
+        prior = pt.Distribution(theta=pt.RV("norm", 0.0, 1.0))
+        abc = pt.ABCSMC(
+            model, prior, pt.PNormDistance(p=2), population_size=pop,
+            eps=pt.MedianEpsilon(), seed=300, fused_generations=G,
+            tracer=TRACER, metrics=reg,
+        )
+        abc.new("sqlite://", {"x": 1.0})
+        return abc
+
+    reg_ref = MetricsRegistry(clock=CLOCK)
+    ref = make(reg_ref)
+    h_ref = ref.run(max_nr_populations=gens)
+    syncs_ref = ref.sync_ledger.summary(0.0)["syncs"]
+
+    reg = MetricsRegistry(clock=CLOCK)
+    abc = make(reg)
+    install_fault_plan(FaultPlan([
+        FaultRule(site="device.carry", kind="nan_poison", after=1,
+                  max_fires=1),
+    ]))
+    error = None
+    try:
+        h = abc.run(max_nr_populations=gens)
+    except Exception as e:  # completion IS the guard — record, not hide
+        h, error = None, repr(e)[:300]
+    finally:
+        uninstall_fault_plan()
+
+    out = {
+        "metric": "health_nan_poison_recovery",
+        "pop_size": pop, "generations": gens,
+        "lane_s": round(CLOCK.now() - t_lane0, 2),
+        "trail": list(abc.health_supervisor.trail),
+        "rollbacks": int(abc.health_supervisor.rollbacks),
+        "metrics": {
+            k: v for k, v in reg.snapshot().items()
+            if k.startswith("pyabc_tpu_health")
+            or k.startswith("pyabc_tpu_degenerate")
+        },
+    }
+    if error is not None:
+        out["error"] = error
+    completed = error is None and h is not None \
+        and int(h.n_populations) >= gens
+    parity = False
+    moment_err = None
+    if completed:
+        eps_ref = h_ref.get_all_populations().query(
+            "t >= 0")["epsilon"].to_numpy()
+        eps_fix = h.get_all_populations().query(
+            "t >= 0")["epsilon"].to_numpy()
+        df_r, w_r = h_ref.get_distribution(0, gens - 1)
+        df_f, w_f = h.get_distribution(0, gens - 1)
+        mu_r = float(np.average(df_r["theta"], weights=w_r))
+        mu_f = float(np.average(df_f["theta"], weights=w_f))
+        moment_err = abs(mu_r - mu_f)
+        parity = bool(np.array_equal(eps_ref, eps_fix)
+                      and moment_err == 0.0)
+        out["posterior_mean_ref"] = round(mu_r, 6)
+        out["posterior_mean_poisoned"] = round(mu_f, 6)
+    # the recovery redispatch adds exactly one extra chunk fetch; the
+    # DETECTION itself must add zero syncs — compare against the clean
+    # run plus the rolled-back chunks
+    syncs_poisoned = abc.sync_ledger.summary(0.0)["syncs"]
+    out["syncs"] = {
+        "reference_run": int(syncs_ref),
+        "poisoned_run": int(syncs_poisoned),
+        "extra": int(syncs_poisoned - syncs_ref),
+        "rolled_back_chunks": int(abc.health_supervisor.rollbacks),
+    }
+    out["value"] = 1.0 if (completed and parity) else 0.0
+    out["regression_guard"] = {
+        "pass_completed": bool(completed),
+        "pass_rollback_count": bool(
+            1 <= abc.health_supervisor.rollbacks
+            <= HEALTH_MAX_ROLLBACKS),
+        "pass_posterior_parity": bool(parity),
+        "posterior_mean_abs_err": moment_err,
+        # detection syncs: the only extra round trips allowed are the
+        # rolled-back chunks' redispatched fetches themselves
+        "pass_zero_detection_syncs": bool(
+            syncs_poisoned - syncs_ref
+            <= abc.health_supervisor.rollbacks),
+        "max_rollbacks": HEALTH_MAX_ROLLBACKS,
     }
     return out
 
@@ -814,8 +1002,11 @@ def main():
     elastic_share = 0.0 if elastic_skip else 0.12
     resilience_skip = resilience_lane_skip_reason()
     resilience_share = 0.0 if resilience_skip else 0.10
+    health_skip = health_lane_skip_reason()
+    health_share = 0.0 if health_skip else 0.06
     spend_until = t_start + (budget - reserve) * (
-        1.0 - scale_share - elastic_share - resilience_share)
+        1.0 - scale_share - elastic_share - resilience_share
+        - health_share)
     # per-run host setup (ABCSMC construction, History/sqlite DDL, kernel
     # adoption) runs on this thread OVERLAPPED with the previous run's
     # device chunks — round 5 measured it as dark inter-run wall clock
@@ -921,7 +1112,8 @@ def main():
         try:
             _state["scale"] = run_scale_lane(
                 t_start + budget - reserve - CLOCK.now()
-                - (budget - reserve) * (elastic_share + resilience_share))
+                - (budget - reserve) * (elastic_share + resilience_share
+                                        + health_share))
         except Exception as e:
             _state["scale"] = {"error": repr(e)[:300]}
 
@@ -934,7 +1126,8 @@ def main():
         try:
             _state["elastic"] = run_elastic_lane(
                 max(t_start + budget - reserve - CLOCK.now()
-                    - (budget - reserve) * resilience_share, 20.0))
+                    - (budget - reserve)
+                    * (resilience_share + health_share), 20.0))
         except Exception as e:
             _state["elastic"] = {"error": repr(e)[:300]}
 
@@ -946,9 +1139,22 @@ def main():
         _state["phase"] = "resilience"
         try:
             _state["resilience"] = run_resilience_lane(
-                max(t_start + budget - reserve - CLOCK.now(), 20.0))
+                max(t_start + budget - reserve - CLOCK.now()
+                    - (budget - reserve) * health_share, 20.0))
         except Exception as e:
             _state["resilience"] = {"error": repr(e)[:300]}
+
+    # -- health lane: in-kernel health guards + rollback recovery
+    # (round 10; CPU-capable — or its recorded skip reason, never silent)
+    if health_skip:
+        _state["health"] = {"skipped": health_skip}
+    else:
+        _state["phase"] = "health"
+        try:
+            _state["health"] = run_health_lane(
+                max(t_start + budget - reserve - CLOCK.now(), 15.0))
+        except Exception as e:
+            _state["health"] = {"error": repr(e)[:300]}
 
     _state["budget_used_s"] = round(CLOCK.now() - t_start, 1)
     _state["pop_size"] = pop

@@ -116,10 +116,13 @@ from .observability import (
     worker_trace_spans,
 )
 from .resilience import (
+    CheckpointCorruptError,
     CheckpointManager,
+    DegenerateRunError,
     FaultPlan,
     FaultRule,
     RetryPolicy,
+    RunSupervisor,
     install_fault_plan,
     uninstall_fault_plan,
 )

@@ -161,7 +161,10 @@ def bench_cmd(pop, gens, budget_s, cpu):
               help="install a deterministic fault plan in this worker "
               "(resilience subsystem), e.g. 'worker.batch:kill:after=2' — "
               "an injected kill dies HARD (no bye; the broker's lease "
-              "requeue must heal it). Also read from PYABC_TPU_FAULT_PLAN.")
+              "requeue must heal it). Numeric-corruption kinds "
+              "(nan_poison/cov_corrupt/weight_zero at the orchestrator's "
+              "device.carry site) exercise the in-kernel health guards "
+              "instead. Also read from PYABC_TPU_FAULT_PLAN.")
 def worker_cmd(host, port, worker_id, runtime_s, max_generations, log_file,
                processes, catch_exceptions, trace, reconnect_base_s,
                reconnect_max_s, fault_plan):

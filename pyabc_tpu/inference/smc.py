@@ -17,6 +17,7 @@ exactly where the reference centralizes it (SURVEY.md §3.2, §7.1).
 """
 from __future__ import annotations
 
+import contextlib
 import datetime
 import json
 import logging
@@ -131,6 +132,19 @@ class GenerationSpec:
         return self.host_simulate_one()
 
 
+class GracefulShutdown(BaseException):
+    """SIGTERM/SIGINT received while a run was active, converted to a
+    raisable so the orchestrator can flush the async History writer and
+    write a final checkpoint before exiting — an EXTERNAL kill becomes
+    exactly as recoverable as an injected ``orchestrator.chunk`` one.
+    A ``BaseException`` (like KeyboardInterrupt) so ordinary ``except
+    Exception`` recovery code never swallows a termination request."""
+
+    def __init__(self, signum: int):
+        super().__init__(f"terminated by signal {signum}")
+        self.signum = int(signum)
+
+
 class ABCSMC:
     """ABC-SMC with multi-model selection and adaptive components."""
 
@@ -157,7 +171,14 @@ class ABCSMC:
                  tracer=None,
                  metrics=None,
                  checkpoint_path: str | None = None,
-                 checkpoint_every: int = 1):
+                 checkpoint_every: int = 1,
+                 health_checks: bool = True,
+                 ess_floor: float = 0.0,
+                 health_acc_floor: float = 0.0,
+                 eps_stall_window: int = 16,
+                 eps_stall_rtol: float = 1e-6,
+                 max_health_rollbacks: int = 2,
+                 health_widen_factor: float = 1.5):
         self.models: list[Model] = assert_models(models)
         if isinstance(parameter_priors, Distribution):
             parameter_priors = [parameter_priors]
@@ -341,6 +362,9 @@ class ABCSMC:
         self.probe_events: list[tuple[float, float]] = []
         self._drain_thread = None
         self._drain_error: BaseException | None = None
+        #: (carry_ref, t, sims, chunk_index) of the newest healthy chunk
+        #: boundary — the graceful-shutdown final-checkpoint state
+        self._final_ck_state = None
         self._root_key = root_key(seed)
         #: observability (pyabc_tpu/observability/): host-boundary tracing
         #: spans + metrics. Defaults are no-op-cheap (NullTracer /
@@ -377,6 +401,35 @@ class ABCSMC:
             )
         else:
             self._checkpoint = None
+        #: numerical & statistical health guards (round 10): the fused
+        #: multigen kernel computes a per-generation in-kernel health
+        #: word (ops/health.py — NaN/Inf in theta/weights/distances,
+        #: zero total weight, ESS below ``ess_floor * n_target``,
+        #: acceptance below ``health_acc_floor``, an epsilon-progress
+        #: stall over ``eps_stall_window`` generations at relative
+        #: improvement < ``eps_stall_rtol``, and non-finite/zero-mass
+        #: proposal params after the Cholesky jitter-escalation ladder)
+        #: that rides the packed fetch at zero extra syncs; the host
+        #: RunSupervisor (resilience/health.py) maps nonzero words to
+        #: recovery — abort-chunk-and-rollback to the checkpoint / last
+        #: healthy carry, forced host refit on PSD failure, proposal
+        #: widening (x ``health_widen_factor``) on ESS collapse — under
+        #: a ``max_health_rollbacks`` budget, past which (or on a stall)
+        #: the run terminates with a typed DegenerateRunError carrying
+        #: the per-generation health trail. ``ess_floor``/
+        #: ``health_acc_floor`` default to 0 (the NaN/PSD/stall guards
+        #: are always armed; the statistical floors are opt-in — tune
+        #: them to the workload, see README "Numerical health").
+        self.health_checks = bool(health_checks)
+        self.ess_floor = float(ess_floor)
+        self.health_acc_floor = float(health_acc_floor)
+        self.eps_stall_window = int(eps_stall_window)
+        self.eps_stall_rtol = float(eps_stall_rtol)
+        self.max_health_rollbacks = int(max_health_rollbacks)
+        self.health_widen_factor = float(health_widen_factor)
+        #: the current run's RunSupervisor (fresh per run; tests read
+        #: its trail / rollback count after a run)
+        self.health_supervisor = None
         #: decoded checkpoint carry awaiting adoption by the fused loop
         self._resume_carry = None
         #: generation the last run resumed at via the checkpoint (None =
@@ -856,7 +909,20 @@ class ABCSMC:
         if self._checkpoint is None or t0 <= 0 \
                 or not self._fused_chunk_capable():
             return t0
-        ck = self._checkpoint.load()
+        from ..resilience.checkpoint import CheckpointCorruptError
+
+        try:
+            ck = self._checkpoint.load()
+        except CheckpointCorruptError as exc:
+            # integrity failure (truncation, bit flip, schema mismatch):
+            # typed + loud, then degrade to the History epsilon-trail
+            # replay path — corruption costs durability, not correctness
+            logger.warning(
+                "checkpoint failed integrity verification (%s); "
+                "resuming at generation granularity from the History",
+                exc,
+            )
+            ck = None
         if ck is None or ck.get("kind") != "fused_carry":
             return t0
         if ck.get("abc_id") != int(self.history.id) \
@@ -949,14 +1015,137 @@ class ABCSMC:
             "carry": host_carry,
         })
 
+    # ------------------------------------------------- health recovery
+    def _health_recovery_carry(self, action: str, t_fail: int,
+                               good_carry, rebuild_carry):
+        """The carry to redispatch from after a health failure at
+        ``t_fail``; returns ``(carry, source)``.
+
+        ``rollback`` prefers durable, known-clean state: the PR 5
+        checkpoint when one covers exactly ``t_fail`` (validated like a
+        resume), else the retained last-healthy chunk-boundary carry
+        (same state, still on device), else a host rebuild from the
+        mirrored fit of the last healthy population. ``refit`` FORCES
+        the host rebuild — a PSD/Cholesky failure means the in-kernel
+        factors are not trusted, so a fresh host factorization replaces
+        them. ``widen`` is the host rebuild from proposals refit with
+        the bandwidth inflated by ``health_widen_factor`` (importance
+        weights are always computed against the proposal actually
+        sampled from, so widening is statistically exact — it trades
+        acceptance rate for tail coverage)."""
+        if action == "widen":
+            from ..observability.metrics import PROPOSAL_WIDENINGS_TOTAL
+
+            self._widen_transitions(self.health_widen_factor)
+            self.metrics.counter(
+                PROPOSAL_WIDENINGS_TOTAL,
+                "proposal-bandwidth widenings on ESS/acceptance collapse",
+            ).inc()
+            return rebuild_carry(t_fail), "host_rebuild_widened"
+        if action == "refit":
+            return rebuild_carry(t_fail), "host_rebuild"
+        if self._checkpoint is not None:
+            from ..resilience.checkpoint import CheckpointCorruptError
+
+            try:
+                ck = self._checkpoint.load()
+            except CheckpointCorruptError as exc:
+                logger.warning(
+                    "rollback checkpoint failed integrity (%s); using "
+                    "in-memory state", exc)
+                ck = None
+            if (ck is not None and ck.get("kind") == "fused_carry"
+                    and int(ck.get("t", -1)) == int(t_fail)
+                    and ck.get("abc_id") == int(self.history.id)
+                    and ck.get("fingerprint")
+                    == self._checkpoint_fingerprint()):
+                decoded = self._validate_resume_carry(
+                    ck["carry"], rebuild_carry, t_fail)
+                if decoded is not None:
+                    return decoded, "checkpoint"
+        g_t, g_carry = good_carry
+        if g_t == t_fail and g_carry is not None:
+            return g_carry, "last_good_carry"
+        return rebuild_carry(t_fail), "host_rebuild"
+
+    def _widen_transitions(self, factor: float) -> None:
+        """Refit every fitted host transition with its bandwidth scaling
+        inflated by ``factor`` (restored afterwards, so only THIS
+        rebuild's carry params are widened — the next in-kernel refit
+        returns to the configured bandwidth)."""
+        for m, tr in enumerate(self.transitions):
+            if tr.X is None or not isinstance(
+                    getattr(tr, "scaling", None), float):
+                continue
+            orig = tr.scaling
+            tr.scaling = orig * float(factor)
+            try:
+                with self.tracer.span("refit", model=int(m),
+                                      widened=float(factor)):
+                    tr.fit(tr.X, tr.w)
+            finally:
+                tr.scaling = orig
+
+    def _save_final_checkpoint(self) -> None:
+        """Graceful-shutdown durability: persist the newest healthy
+        chunk-boundary carry so an external SIGTERM/SIGINT is exactly as
+        recoverable as an injected orchestrator kill. Best-effort — a
+        failed save degrades durability, never the shutdown itself."""
+        state = getattr(self, "_final_ck_state", None)
+        if self._checkpoint is None or state is None:
+            return
+        carry_ref, t_next, sims, chunk_index = state
+        try:
+            self._save_fused_checkpoint(carry_ref, t_next, sims,
+                                        chunk_index)
+            logger.info(
+                "graceful shutdown: final checkpoint written at t=%d",
+                t_next,
+            )
+        except Exception:
+            logger.exception("graceful-shutdown checkpoint save failed")
+
     def _run_impl(self, minimum_epsilon, max_nr_populations,
                   min_acceptance_rate, max_total_nr_simulations,
                   max_walltime) -> History:
         with self.tracer.span("run", db=getattr(self.history, "db", None)):
-            return self._run_inner(
-                minimum_epsilon, max_nr_populations, min_acceptance_rate,
-                max_total_nr_simulations, max_walltime,
-            )
+            with self._graceful_signals():
+                return self._run_inner(
+                    minimum_epsilon, max_nr_populations,
+                    min_acceptance_rate, max_total_nr_simulations,
+                    max_walltime,
+                )
+
+    @contextlib.contextmanager
+    def _graceful_signals(self):
+        """Convert SIGTERM/SIGINT into :class:`GracefulShutdown` for the
+        duration of a run, so an external kill flushes the History
+        writer and writes a final checkpoint (the fused loop's
+        BaseException path) instead of dying with queued generations and
+        a stale checkpoint. Main-thread only (signal handlers cannot be
+        installed elsewhere); previous handlers are restored on exit."""
+        import signal
+        import threading
+
+        if threading.current_thread() is not threading.main_thread():
+            yield
+            return
+
+        def _handler(signum, frame):
+            raise GracefulShutdown(signum)
+
+        prev = {}
+        try:
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                prev[sig] = signal.signal(sig, _handler)
+        except (ValueError, OSError) as exc:
+            # embedded interpreters may refuse; run unprotected
+            logger.debug("not installing signal handlers: %r", exc)
+        try:
+            yield
+        finally:
+            for sig, h in prev.items():
+                signal.signal(sig, h)
 
     def _run_inner(self, minimum_epsilon, max_nr_populations,
                    min_acceptance_rate, max_total_nr_simulations,
@@ -982,6 +1171,15 @@ class ABCSMC:
         self.sampler.tracer = self.tracer
         self.sampler.metrics = self.metrics
         self.sampler.sync_ledger = self.sync_ledger
+        # fresh health supervision per run: the trail and the rollback
+        # budget are run state (resilience/health.py)
+        from ..resilience.health import RunSupervisor
+
+        self.health_supervisor = RunSupervisor(
+            max_rollbacks=self.max_health_rollbacks,
+            widen_factor=self.health_widen_factor,
+            clock=self._clock, tracer=self.tracer, metrics=self.metrics,
+        )
 
         t0 = self.history.max_t + 1
         # mid-chunk checkpoint adoption (resilience subsystem): a killed
@@ -1713,6 +1911,27 @@ class ABCSMC:
             return None
         return (int(every), float(self.refit_drift_threshold))
 
+    def _health_cfg(self) -> tuple | None:
+        """(ess_floor, acc_floor, eps_stall_window, eps_stall_rtol) for
+        the multigen kernel's in-kernel health word, or None when health
+        checks are disabled. The epsilon-stall window only arms for
+        schedules that ADAPT epsilon from the data (quantile thresholds,
+        temperature schemes) — a fixed List/Constant schedule never
+        "improves" and must not read as stalled."""
+        from ..epsilon import QuantileEpsilon, Temperature
+
+        if not self.health_checks:
+            return None
+        stall_w = int(self.eps_stall_window)
+        eps_adaptive = isinstance(self.eps, QuantileEpsilon) or (
+            type(self.acceptor) is StochasticAcceptor
+            and type(self.eps) is Temperature
+        )
+        if not eps_adaptive:
+            stall_w = 0
+        return (float(self.ess_floor), float(self.health_acc_floor),
+                stall_w, float(self.eps_stall_rtol))
+
     def _temp_config(self) -> tuple:
         """Static scheme descriptor tuple for the device temperature twin."""
         from ..distance.kernel import SCALE_LIN
@@ -1920,6 +2139,7 @@ class ABCSMC:
             self._fused_calibration_cfg() if first_gen_prior else None
         )
         refit_cadence = self._refit_cadence_cfg(n_cap)
+        health_cfg = self._health_cfg()
         with self.tracer.span("kernel.build", G=int(G), B=int(B),
                               n_cap=int(n_cap)):
             kern = ctx.multigen_kernel(
@@ -1949,6 +2169,7 @@ class ABCSMC:
                     if adaptive_n else None
                 ),
                 refit_cadence=refit_cadence,
+                health_config=health_cfg,
             )
 
         def _g_limit(t_at: int) -> int:
@@ -1966,6 +2187,22 @@ class ABCSMC:
             initial carry or the PREVIOUS chunk's on-device final carry —
             chaining device-to-device lets chunk k+1 compute while chunk
             k's outputs are still being fetched/persisted."""
+            # resilience fault site (round 10): numeric CORRUPTION of the
+            # dispatched chunk's input carry — silent NaN/cov/weight
+            # poison that never raises, exactly what the in-kernel health
+            # word exists to catch. The clean carry ref stays untouched
+            # (rollback reuses it); the poison is traceable jnp ops
+            # riding the normal dispatch, no sync.
+            from ..resilience.faults import maybe_corrupt
+
+            kind = maybe_corrupt("device.carry", t=int(t_at))
+            if kind is not None:
+                from ..ops.health import poison_carry
+
+                logger.warning(
+                    "injected carry corruption %r at t=%d", kind, t_at
+                )
+                carry = poison_carry(carry, kind)
             eps_fixed = np.zeros(G, np.float32)
             if (not eps_quantile and not stochastic) or temp_fixed:
                 for g in range(g_limit):
@@ -2117,6 +2354,19 @@ class ABCSMC:
                 # a fresh host fit (or the forced first in-kernel refit
                 # handles the prior-mode chunk), so the cadence starts at 0
                 base = base + (jnp.zeros((), jnp.int32),)
+            if health_cfg is not None:
+                # epsilon-stall recursion seed (eps_prev, stall_count):
+                # the previous generation's epsilon when known, else inf
+                # (= "no previous", counted as full improvement)
+                try:
+                    eps_prev0 = (float(self.eps(t_at - 1)) if t_at > 0
+                                 else float("inf"))
+                except (KeyError, IndexError, ValueError):
+                    eps_prev0 = float("inf")
+                if not np.isfinite(eps_prev0):
+                    eps_prev0 = float("inf")
+                base = base + ((jnp.asarray(eps_prev0, jnp.float32),
+                                jnp.zeros((), jnp.int32)),)
             return base
 
         carry0 = None
@@ -2155,7 +2405,7 @@ class ABCSMC:
                 adaptive_n=adaptive_n,
                 n_keep=n_keep,
             )
-        except BaseException:
+        except BaseException as exc:
             # drain queued generations before propagating — a mid-loop
             # failure (device error, interrupt) must not silently abandon
             # populations already handed to the writer
@@ -2168,6 +2418,12 @@ class ABCSMC:
                 logger.exception(
                     "async history writer also failed while draining"
                 )
+            if isinstance(exc, GracefulShutdown):
+                # an EXTERNAL kill (SIGTERM/SIGINT) is made exactly as
+                # recoverable as an injected one: the History is flushed
+                # (above) and the newest healthy carry becomes a final
+                # checkpoint before the signal propagates
+                self._save_final_checkpoint()
             raise
 
     def _fused_chunk_loop(self, t, g_limit, n_of, carry0, _g_limit,
@@ -2316,12 +2572,20 @@ class ABCSMC:
         # overlap and because the drain check below is `while pending`
         refill_target = max(depth, 2)
         drained_async = False
+        #: (t, carry) of the newest KNOWN-HEALTHY chunk boundary — the
+        #: health supervisor's rollback target when no checkpoint covers
+        #: the failed generation (the host-built carry0 counts: a
+        #: corruption of the very first chunk rolls back to it)
+        good_carry = (t, carry0)
+        #: (carry_ref, t_next, sims, chunk_index) for the graceful-
+        #: shutdown final checkpoint (SIGTERM/SIGINT mid-run)
+        self._final_ck_state = None
 
         def _process_next(dispatch_s):
             """Fetch + host-process the oldest pending chunk (shared by
             the main loop and the drain-async tail thread; only one of
             them ever runs at a time, so the nonlocal state is safe)."""
-            nonlocal t, sims_total, chunk_index, t_chunk0
+            nonlocal t, sims_total, chunk_index, t_chunk0, good_carry
             # resilience fault site: an injected orchestrator kill lands
             # HERE — after dispatch, before the chunk's results are
             # processed/persisted — the worst spot for generation-
@@ -2369,7 +2633,7 @@ class ABCSMC:
                 t_proc0 = clk()
                 with self.tracer.span("process", t_first=int(t_at)):
                     (stop, last_pop, last_sample, last_eps, last_acc_rate,
-                     t, sims_total, n_acc_chunk, g_done) = \
+                     t, sims_total, n_acc_chunk, g_done, health_fail) = \
                         self._process_chunk(
                             fetched, ss_rows, t, g_lim, n_of, adaptive_n,
                             adaptive, stochastic, temp_fixed, eps_quantile,
@@ -2397,7 +2661,16 @@ class ABCSMC:
                     "pyabc_tpu_particles_accepted",
                     "accepted particles across fused chunks",
                 ).inc(int(n_acc_chunk))
+            if health_fail is None and not stop and g_done == g_lim:
+                # the chunk boundary is known-healthy: it becomes the
+                # supervisor's rollback target and the graceful-shutdown
+                # final-checkpoint state
+                good_carry = (t, carry_ref)
+                if not sumstat_refit:
+                    self._final_ck_state = (carry_ref, t, sims_total,
+                                            chunk_index)
             if (self._checkpoint is not None and not sumstat_refit
+                    and health_fail is None
                     and not stop and g_done == g_lim
                     and chunk_index % self.checkpoint_every == 0):
                 # persist the chunk's final device carry (flush-first: the
@@ -2440,7 +2713,7 @@ class ABCSMC:
                 except Exception:
                     logger.exception("chunk_event_cb failed")
             return (stop, last_pop, last_sample, last_eps, last_acc_rate,
-                    t_at, g_lim)
+                    t_at, g_lim, health_fail)
 
         def _mirror_fit(last_pop):
             self._model_probs = {
@@ -2456,9 +2729,32 @@ class ABCSMC:
             try:
                 try:
                     while pending:
-                        stop, last_pop, *_rest = _process_next(0.0)
+                        stop, last_pop, *_rest, health_fail = \
+                            _process_next(0.0)
                         if last_pop is not None:
                             _mirror_fit(last_pop)
+                        if health_fail is not None:
+                            # the generation schedule already ended: no
+                            # redispatch can recover this — record the
+                            # event and surface a typed failure through
+                            # drain_join() instead of a silent partial db
+                            from ..resilience.health import (
+                                DegenerateRunError,
+                            )
+
+                            self.health_supervisor.on_failure(
+                                health_fail["t"], health_fail["word"],
+                                ess=health_fail.get("ess"),
+                                acc_rate=health_fail.get("acc_rate"),
+                                eps=health_fail.get("eps"),
+                            )
+                            raise DegenerateRunError(
+                                f"in-kernel health failure at "
+                                f"t={health_fail['t']} during the async "
+                                f"drain (schedule exhausted, no "
+                                f"redispatch possible)",
+                                self.health_supervisor.trail,
+                            )
                         if stop:
                             break
                 finally:
@@ -2510,8 +2806,50 @@ class ABCSMC:
                     self._drain_thread.start()
                     drained_async = True
                     return self.history
-                stop, last_pop, last_sample, last_eps, last_acc_rate, \
-                    t_at, g_limit = _process_next(dispatch_s)
+                (stop, last_pop, last_sample, last_eps, last_acc_rate,
+                 t_at, g_limit, health_fail) = _process_next(dispatch_s)
+                if health_fail is not None:
+                    # in-kernel health failure: abort the chunk (nothing
+                    # at/past the failed generation was persisted), let
+                    # the supervisor decide — it raises a typed
+                    # DegenerateRunError for terminal conditions — then
+                    # roll the carry back and redispatch from the failed
+                    # generation. Speculative chunks dispatched off the
+                    # degraded carry are discarded with it.
+                    t_fail = health_fail["t"]
+                    t_detect = clk()
+                    if last_pop is not None:
+                        # host proposal state now reflects t_fail - 1 —
+                        # the state a host carry rebuild fits from
+                        _mirror_fit(last_pop)
+                    action = self.health_supervisor.on_failure(
+                        t_fail, health_fail["word"],
+                        ess=health_fail.get("ess"),
+                        acc_rate=health_fail.get("acc_rate"),
+                        eps=health_fail.get("eps"),
+                        chunk_index=chunk_index,
+                    )
+                    pending.clear()
+                    carry_rb, source = self._health_recovery_carry(
+                        action, t_fail, good_carry, rebuild_carry,
+                    )
+                    g_next = _g_limit(t_fail)
+                    if g_next <= 0:
+                        break
+                    logger.warning(
+                        "health recovery at t=%d: %s from %s "
+                        "(kinds=%s)", t_fail, action, source,
+                        self.health_supervisor.trail[-1]["kinds"],
+                    )
+                    with self.tracer.span("dispatch", recovery=True,
+                                          t_first=int(t_fail)):
+                        res = _dispatch_chunk(carry_rb, t_fail, g_next)
+                    pending[:] = [(_submit(res, t_fail, g_next), t_fail,
+                                   g_next, res["carry"])]
+                    tail = (res, t_fail, g_next)
+                    self.health_supervisor.note_recovered(
+                        t_fail, action, source, t_detect)
+                    continue
                 continuing = (not stop and last_pop is not None
                               and (pending
                                    or _g_limit(t_at + g_limit) > 0))
@@ -2598,7 +2936,13 @@ class ABCSMC:
                        max_walltime, start_walltime):
         """Persist + host-mirror one fetched chunk's generations. Returns
         (stop, last_pop, last_sample, last_eps, last_acc_rate, t,
-        sims_total, n_acc_chunk, g_done)."""
+        sims_total, n_acc_chunk, g_done, health_fail).
+
+        ``health_fail`` is None for a healthy chunk, else the FIRST
+        generation whose in-kernel health word came back nonzero —
+        nothing at or past that generation is persisted or mirrored
+        (the caller rolls back and redispatches; a degraded population
+        must never reach the History or the host component state)."""
         from ..sampler.base import Sample, exp_normalize_log_weights
 
         stop = False
@@ -2606,6 +2950,7 @@ class ABCSMC:
         last_eps = last_acc_rate = None
         n_acc_chunk = 0
         g_done = 0
+        health_fail = None
         # the last complete generation of the chunk is known upfront from
         # the gen_ok flags: only ITS Sample/Population is built on this
         # thread (the cross-chunk transition refit / sumstat boundary
@@ -2620,6 +2965,25 @@ class ABCSMC:
                 break
         last_deferred = None  # newest deferred gen's (builder, eps, rate)
         for g in range(g_limit):
+                # health gate FIRST — before gen_ok, before any persist:
+                # a poisoned generation can look "complete" (acceptance
+                # does not read the importance weights) or "incomplete"
+                # (corrupt proposals never accept); either way the
+                # recovery path owns it, not the stopping rules
+                if "health" in fetched:
+                    word = int(np.asarray(fetched["health"][g]))
+                    if word != 0:
+                        health_fail = {
+                            "t": int(t), "g": int(g), "word": word,
+                            "ess": float(np.asarray(fetched["ess"][g])),
+                            "eps": float(fetched["eps_used"][g]),
+                            "n_acc": int(fetched["n_acc"][g]),
+                            "acc_rate": (
+                                int(fetched["n_acc"][g])
+                                / max(int(fetched["n_valid"][g]), 1)
+                            ),
+                        }
+                        break
                 # per-generation target (t advances below); in-kernel
                 # adaptive n is read back from the chunk outputs
                 n = (int(fetched["n_target"][g]) if adaptive_n
@@ -2791,14 +3155,14 @@ class ABCSMC:
                     break
                 t += 1
         if last_pop is None and last_deferred is not None:
-            # stopped (via _check_stop) before reaching the chunk's last
-            # complete generation: the newest processed generation was
-            # deferred — build it now, the caller's transition refit
-            # needs the actual Population
+            # stopped (via _check_stop or a health failure) before
+            # reaching the chunk's last complete generation: the newest
+            # processed generation was deferred — build it now, the
+            # caller's transition refit needs the actual Population
             builder, last_eps, last_acc_rate = last_deferred
             last_sample, last_pop = builder()
         return (stop, last_pop, last_sample, last_eps, last_acc_rate, t,
-                sims_total, n_acc_chunk, g_done)
+                sims_total, n_acc_chunk, g_done, health_fail)
 
     # --------------------------------------------- broker look-ahead path
     def _look_ahead_capable(self) -> bool:

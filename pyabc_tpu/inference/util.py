@@ -732,7 +732,8 @@ class DeviceContext:
                         fold_sched_mode: bool = False,
                         first_gen_prior: bool = False,
                         fused_calibration: tuple | None = None,
-                        refit_cadence: tuple | None = None):
+                        refit_cadence: tuple | None = None,
+                        health_config: tuple | None = None):
         """One jitted program for G WHOLE GENERATIONS (transition mode).
 
         The TPU-native endgame of the reference's per-generation scatter/
@@ -788,6 +789,19 @@ class DeviceContext:
         per-generation outputs gain ``refit``/``drift``/``rows_changed``
         so the host can mirror refit events into the observability
         subsystem — the amortization is measured, not assumed.
+
+        Health guards (``health_config = (ess_floor, acc_floor,
+        eps_stall_window, eps_stall_rtol)``, round 10): every generation
+        computes an in-kernel health word (:mod:`pyabc_tpu.ops.health`)
+        over values the step already holds — NaN/Inf in accepted
+        theta/weights/distances, zero total weight, ESS below the floor,
+        acceptance collapse, an epsilon-progress stall (carried
+        ``(eps_prev, stall_count)`` recursion), and non-finite / zero-
+        mass proposal params on BOTH the carry-input and just-refit side
+        (a Cholesky that survived the jitter-escalation ladder
+        non-finite). The word ships as one int32 per generation on the
+        existing packed fetch — zero extra blocking syncs — and the host
+        ``RunSupervisor`` maps nonzero words to recovery actions.
         """
         cache_key = ("multigen", B, n_cap, rec_cap, max_rounds, G, adaptive,
                      eps_quantile, eps_weighted, alpha, multiplier,
@@ -795,7 +809,7 @@ class DeviceContext:
                      stochastic, temp_config, temp_fixed, complete_history,
                      sumstat_transform, adaptive_n, weight_sched,
                      fold_sched_mode, first_gen_prior, fused_calibration,
-                     refit_cadence)
+                     refit_cadence, health_config)
         if cache_key in self._kernels:
             return self._kernels[cache_key]
         if stochastic and self.K != 1:
@@ -865,6 +879,9 @@ class DeviceContext:
                 n_carry = tail.pop(0) if adaptive_n is not None else None
                 gens_since = (tail.pop(0) if refit_cadence is not None
                               else None)
+                # (eps_prev, stall_count): the epsilon-stall recursion
+                health_state = (tail.pop(0) if health_config is not None
+                                else None)
                 pdf_norm, max_found, daly_k = acc_state
                 # g_limit (dynamic) caps the active generations so the LAST
                 # chunk of a run reuses the same compiled G-kernel instead
@@ -1157,6 +1174,32 @@ class DeviceContext:
                     stopped | ~gen_ok | (eps_g <= min_eps)
                     | (acc_rate < min_acc_rate)
                 )
+                if health_config is not None:
+                    from ..ops.health import generation_health
+
+                    ess_floor, acc_floor, stall_w, stall_rtol = \
+                        health_config
+                    eps_prev_c, stall_count_c = health_state
+                    word, ess, eps_prev_n, stall_n = generation_health(
+                        res=res, k_mask=k_mask, w_norm=w_norm,
+                        d_new=d_new, n_acc=n_acc, n_target=n_target,
+                        acc_rate=acc_rate, trans_params=trans_params,
+                        trans_next=trans_next, fitted=fitted,
+                        fitted_next=fitted_next, eps_g=eps_g,
+                        eps_next=eps_next, eps_prev=eps_prev_c,
+                        stall_count=stall_count_c, ess_floor=ess_floor,
+                        acc_floor=acc_floor, stall_window=stall_w,
+                        stall_rtol=stall_rtol,
+                    )
+                    # skipped generations are not evidence of anything:
+                    # word 0, stall recursion frozen
+                    word = jnp.where(stopped, jnp.int32(0), word)
+                    health_state_next = (
+                        jnp.where(stopped, eps_prev_c, eps_prev_n),
+                        jnp.where(stopped, stall_count_c, stall_n),
+                    )
+                else:
+                    word = ess = health_state_next = None
                 out = {
                     **res,
                     "eps_used": eps_g, "eps_next": eps_next,
@@ -1173,6 +1216,11 @@ class DeviceContext:
                     out["refit"] = refit_now
                     out["drift"] = drift
                     out["rows_changed"] = rows_changed
+                if health_config is not None:
+                    # one int32 + one f32 per generation on the existing
+                    # packed fetch: health detection costs zero syncs
+                    out["health"] = word
+                    out["ess"] = ess
                 if adaptive_n is not None:
                     # in-kernel AdaptivePopulationSize: the bootstrap-CV
                     # bisection runs on the JUST-REFIT kernels — exactly
@@ -1234,6 +1282,8 @@ class DeviceContext:
                     new_carry.append(n_next)
                 if refit_cadence is not None:
                     new_carry.append(gens_since_next)
+                if health_config is not None:
+                    new_carry.append(health_state_next)
                 return tuple(new_carry), out
 
             calib_info = None

@@ -170,8 +170,9 @@ def _workers_snapshot() -> dict:
             continue
         try:
             out.update(src.worker_snapshot())
-        except Exception:  # snapshotting must never kill the dashboard
-            pass
+        except Exception as exc:  # snapshotting must never kill the
+            # dashboard — but the broken source is named, not swallowed
+            out.setdefault("__errors__", []).append(repr(exc)[:200])
     with _lock:
         _worker_sources[:] = [r for r in _worker_sources if r() is not None]
     return out

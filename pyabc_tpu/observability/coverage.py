@@ -181,6 +181,12 @@ ELASTIC_CATEGORIES = {
     "recovery.timeout_extended": "recovery",
     "recovery.persist_retry": "recovery",
     "recovery.device_reset": "recovery",
+    # round 10 (numerical health): detection->redispatch windows of the
+    # RunSupervisor's recovery actions, recorded on the `health`
+    # pseudo-thread by resilience/health.py
+    "health.rollback": "recovery",
+    "health.refit": "recovery",
+    "health.widen": "recovery",
 }
 
 

@@ -103,10 +103,15 @@ def prometheus_text(registry) -> str:
     for inst in registry.instruments():
         name = _prom_name(inst.name)
         if isinstance(inst, Counter):
+            # the Prometheus counter convention is ONE trailing `_total`:
+            # instruments already named `*_total` (the resilience/health
+            # families) must not render doubled as `*_total_total`
+            if not name.endswith("_total"):
+                name = f"{name}_total"
             if inst.help:
-                lines.append(f"# HELP {name}_total {inst.help}")
-            lines.append(f"# TYPE {name}_total counter")
-            lines.append(f"{name}_total {inst.value:g}")
+                lines.append(f"# HELP {name} {inst.help}")
+            lines.append(f"# TYPE {name} counter")
+            lines.append(f"{name} {inst.value:g}")
         elif isinstance(inst, Gauge):
             if inst.help:
                 lines.append(f"# HELP {name} {inst.help}")

@@ -186,6 +186,28 @@ TIMEOUT_EXTENSIONS_TOTAL = "pyabc_tpu_generation_timeout_extensions_total"
 #:  device contexts dropped + rebuilt after a (simulated) reset
 DEVICE_RESETS_TOTAL = "pyabc_tpu_device_context_resets_total"
 
+# -- numerical/statistical health instrument names (round 10) -----------------
+#
+# The in-kernel health word's host-side counters (resilience/health.py
+# RunSupervisor emits them; per-kind series via health_event_metric):
+#:  nonzero in-kernel health words the supervisor acted on (all kinds)
+HEALTH_EVENTS_TOTAL = "pyabc_tpu_health_events_total"
+#:  fused chunks aborted + rolled back (checkpoint / last-good carry /
+#:  host rebuild) by the health supervisor
+CHUNK_ROLLBACKS_TOTAL = "pyabc_tpu_health_chunk_rollbacks_total"
+#:  proposal-bandwidth widenings applied on ESS/acceptance collapse
+PROPOSAL_WIDENINGS_TOTAL = "pyabc_tpu_health_proposal_widenings_total"
+#:  runs terminated with a typed DegenerateRunError (health trail attached)
+DEGENERATE_RUNS_TOTAL = "pyabc_tpu_degenerate_runs_total"
+
+
+def health_event_metric(kind: str) -> str:
+    """Per-kind health-event counter name — the registry's stand-in for
+    ``pyabc_tpu_health_events_total{kind=...}`` (the text exposition has
+    no label support; cardinality is bounded by the fixed bit set)."""
+    k = "".join(c if c.isalnum() or c == "_" else "_" for c in str(kind))
+    return f"{HEALTH_EVENTS_TOTAL}_{k}"
+
 
 def per_worker_metric(base: str, worker_id: str) -> str:
     """A per-worker instrument name: ``base`` suffixed with the worker id

@@ -203,8 +203,11 @@ class Tracer:
         if self._exporter is not None:
             try:
                 self._exporter.export(sp)
-            except Exception:  # noqa: BLE001 - tracing must never kill work
-                pass
+            except Exception:
+                # tracing must never kill work — but a dying exporter
+                # must not die SILENTLY either (the round-10 lint bans
+                # swallowed errors): count the drop so snapshot() shows it
+                self.n_dropped += 1
 
 
 class _NullSpan:

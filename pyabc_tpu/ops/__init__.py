@@ -1,3 +1,3 @@
-from . import pack, select, stats
+from . import health, pack, select, stats
 
-__all__ = ["pack", "select", "stats"]
+__all__ = ["health", "pack", "select", "stats"]

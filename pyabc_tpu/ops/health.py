@@ -1,0 +1,215 @@
+"""Device-side numerical/statistical health word (ISSUE 6 tentpole).
+
+The fused multigen loop runs whole generations inside a ``lax.scan`` —
+the host sees nothing until a chunk's packed fetch lands, so a NaN in
+the carry, an ESS-collapsed population, or a non-PSD proposal covariance
+silently degrades EVERY following generation of the chunk (PAPER.md's
+reference design does these checks host-side per generation; the
+device-resident architecture bypassed them). This module restores the
+checks DEVICE-NATIVELY: a per-generation int32 bitmask ("health word")
+computed from values the kernel already holds, shipped as one extra
+scalar per generation on the existing packed fetch — ZERO additional
+blocking syncs (acceptance criterion: ``SyncLedger`` counts unchanged).
+
+Bit layout (host decode + recovery mapping live in
+:mod:`pyabc_tpu.resilience.health`):
+
+====================== ======================================================
+bit                    condition
+====================== ======================================================
+``BIT_NAN_THETA``      non-finite accepted theta rows
+``BIT_NAN_WEIGHT``     non-finite normalized importance weights
+``BIT_NAN_DISTANCE``   non-finite accepted distances
+``BIT_WEIGHT_ZERO``    accepted rows exist but carry zero total weight
+                       (all log-weights -inf: the population is unusable)
+``BIT_ESS_FLOOR``      ESS of the accepted weights below
+                       ``ess_floor * n_target`` (NaN ESS also trips it:
+                       the comparison is ``~(ess >= floor)``)
+``BIT_ACC_COLLAPSE``   acceptance rate below the configured floor
+``BIT_EPS_STALL``      relative epsilon improvement below ``rtol`` for
+                       ``window`` consecutive generations (carried counter)
+``BIT_PSD_FAIL``       non-finite / zero-mass fitted proposal params —
+                       the carry-INPUT params actually proposed from this
+                       generation, or the just-refit ones (a Cholesky that
+                       stayed non-finite through the jitter escalation of
+                       ``transition.util.device_chol_guarded``)
+``BIT_EPS_NONFINITE``  non-finite epsilon used or produced
+====================== ======================================================
+
+Everything here is traceable jnp math on data already resident in the
+generation step — reductions of O(n_cap * d) bools, noise next to the
+refit's matmuls.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+HEALTH_OK = 0
+BIT_NAN_THETA = 1 << 0
+BIT_NAN_WEIGHT = 1 << 1
+BIT_NAN_DISTANCE = 1 << 2
+BIT_WEIGHT_ZERO = 1 << 3
+BIT_ESS_FLOOR = 1 << 4
+BIT_ACC_COLLAPSE = 1 << 5
+BIT_EPS_STALL = 1 << 6
+BIT_PSD_FAIL = 1 << 7
+BIT_EPS_NONFINITE = 1 << 8
+
+#: host-readable names, in bit order (resilience.health re-exports)
+BIT_NAMES = (
+    "nan_theta", "nan_weight", "nan_distance", "weight_zero",
+    "ess_floor", "acc_collapse", "eps_stall", "psd_fail",
+    "eps_nonfinite",
+)
+
+
+def _bit(cond, bit: int):
+    return jnp.where(cond, jnp.int32(bit), jnp.int32(0))
+
+
+def ess_of(w_norm, k_mask):
+    """Effective sample size of normalized weights over the accepted
+    mask (Kish ESS; weights are already normalized to sum 1 over the
+    mask, so ESS = 1 / sum w^2). NaN weights yield NaN — callers detect
+    that with ``~(ess >= floor)``."""
+    w = jnp.where(k_mask, w_norm, 0.0)
+    return 1.0 / jnp.maximum(jnp.sum(w * w), 1e-38)
+
+
+def params_unhealthy(trans_params, fitted):
+    """True when any FITTED model's proposal params contain non-finite
+    values or an all-zero resampling weight vector — the in-kernel
+    surface of a corrupted carry (``nan_poison`` / ``cov_corrupt``) or a
+    Cholesky that survived jitter escalation non-finite. Never-fitted
+    placeholder params are zeros by construction and are excluded."""
+    bad = jnp.asarray(False)
+    for m, params in enumerate(trans_params):
+        finite = jnp.asarray(True)
+        for leaf in jax.tree.leaves(params):
+            finite = finite & jnp.all(jnp.isfinite(leaf))
+        w = params.get("weights")
+        zero_w = (jnp.sum(w) <= 0.0) if w is not None else False
+        bad = bad | (fitted[m] & (~finite | zero_w))
+    return bad
+
+
+def population_bits(res, k_mask, w_norm, d_new, n_acc, *,
+                    ess_floor: float, n_target, acc_rate,
+                    acc_floor: float):
+    """Health bits derived from one generation's accepted population."""
+    theta_bad = ~jnp.all(jnp.isfinite(
+        jnp.where(k_mask[:, None], res["theta"], 0.0)))
+    w_masked = jnp.where(k_mask, w_norm, 0.0)
+    w_bad = ~jnp.all(jnp.isfinite(w_masked))
+    d_bad = ~jnp.all(jnp.isfinite(jnp.where(k_mask, d_new, 0.0)))
+    # normalize_log_weights maps an all(-inf) row to all-zeros: accepted
+    # rows with zero total mass are a degenerate population, not a NaN
+    w_zero = (n_acc > 0) & (jnp.sum(w_masked) <= 0.0)
+    ess = ess_of(w_norm, k_mask)
+    # ~(>=) instead of (<): a NaN ESS must trip the floor too
+    ess_bad = ~(ess >= ess_floor * jnp.maximum(
+        n_target, 1).astype(jnp.float32))
+    acc_bad = (acc_floor > 0.0) & (acc_rate < acc_floor)
+    word = (
+        _bit(theta_bad, BIT_NAN_THETA)
+        | _bit(w_bad, BIT_NAN_WEIGHT)
+        | _bit(d_bad, BIT_NAN_DISTANCE)
+        | _bit(w_zero, BIT_WEIGHT_ZERO)
+        | _bit(ess_bad, BIT_ESS_FLOOR)
+        | _bit(acc_bad, BIT_ACC_COLLAPSE)
+    )
+    return word, ess
+
+
+def eps_stall_update(eps_prev, eps_g, stall_count, *, window: int,
+                     rtol: float):
+    """Carried epsilon-stall recursion: relative improvement of this
+    generation's epsilon vs the previous one; ``window`` consecutive
+    sub-``rtol`` improvements set the bit. ``window <= 0`` disables
+    (fixed epsilon schedules legitimately never improve). A non-finite
+    previous epsilon (fresh run seed) counts as full improvement."""
+    if window <= 0:
+        return jnp.int32(0), jnp.zeros((), jnp.int32)
+    impr = jnp.where(
+        jnp.isfinite(eps_prev),
+        (eps_prev - eps_g) / jnp.maximum(jnp.abs(eps_prev), 1e-30),
+        1.0,
+    )
+    stalled = impr < rtol
+    count_next = jnp.where(stalled, stall_count + 1, 0).astype(jnp.int32)
+    return _bit(count_next >= window, BIT_EPS_STALL), count_next
+
+
+def generation_health(*, res, k_mask, w_norm, d_new, n_acc, n_target,
+                      acc_rate, trans_params, trans_next, fitted,
+                      fitted_next, eps_g, eps_next, eps_prev, stall_count,
+                      ess_floor: float, acc_floor: float,
+                      stall_window: int, stall_rtol: float):
+    """The full per-generation health word + updated stall state.
+
+    ``trans_params``/``fitted`` are the carry-INPUT proposal params the
+    generation actually sampled from (a poisoned carry is detected the
+    FIRST generation it is used, not after a refit launders it);
+    ``trans_next``/``fitted_next`` are the just-refit ones (a refit that
+    produced non-finite factors is detected before the next generation
+    proposes from it). Returns ``(word, ess, eps_prev_next,
+    stall_count_next)``.
+    """
+    word, ess = population_bits(
+        res, k_mask, w_norm, d_new, n_acc, ess_floor=ess_floor,
+        n_target=n_target, acc_rate=acc_rate, acc_floor=acc_floor,
+    )
+    psd_bad = params_unhealthy(trans_params, fitted) \
+        | params_unhealthy(trans_next, fitted_next)
+    word = word | _bit(psd_bad, BIT_PSD_FAIL)
+    eps_bad = ~jnp.isfinite(eps_g) | ~jnp.isfinite(eps_next)
+    word = word | _bit(eps_bad, BIT_EPS_NONFINITE)
+    stall_bit, stall_next = eps_stall_update(
+        eps_prev, eps_g, stall_count, window=stall_window,
+        rtol=stall_rtol,
+    )
+    word = word | stall_bit
+    return word, ess, eps_g, stall_next
+
+
+# --------------------------------------------------------- fault injection
+
+#: numeric-corruption fault kinds (resilience.faults ``device.carry``
+#: site): each corrupts the dispatched chunk's input carry so a specific
+#: guard is exercised deterministically on CPU
+POISON_KINDS = ("nan_poison", "cov_corrupt", "weight_zero")
+
+
+def poison_carry(carry, kind: str):
+    """Corrupt a fused-chunk carry IN PLACE OF the clean one (the clean
+    ref stays valid for rollback). Traceable jnp ops only — the poison
+    rides the normal dispatch, adding no sync.
+
+    - ``nan_poison``: NaN into the first fitted ancestor theta — the
+      proposal logpdf mixes it into EVERY lane's importance weight, the
+      chunk-wide silent-NaN propagation the tentpole exists to catch;
+    - ``cov_corrupt``: NaN into the Cholesky factor(s) — every proposal
+      draw goes non-finite (the non-PSD / corrupted-covariance shape);
+    - ``weight_zero``: zero the ancestor resampling weights — a
+      weight-degenerate carry (the ESS-collapse shape at its limit).
+    """
+    if kind not in POISON_KINDS:
+        raise ValueError(f"unknown poison kind {kind!r} ({POISON_KINDS})")
+    carry = list(carry)
+    trans = list(carry[0])
+    params = dict(trans[0])
+    if kind == "nan_poison":
+        params["thetas"] = jnp.asarray(params["thetas"]).at[0, 0].set(
+            jnp.nan)
+        if "thetas_c" in params:
+            params["thetas_c"] = jnp.asarray(
+                params["thetas_c"]).at[0, 0].set(jnp.nan)
+    elif kind == "cov_corrupt":
+        key = "chols" if "chols" in params else "chol"
+        params[key] = jnp.full_like(jnp.asarray(params[key]), jnp.nan)
+    else:  # weight_zero
+        params["weights"] = jnp.zeros_like(jnp.asarray(params["weights"]))
+    trans[0] = params
+    carry[0] = tuple(trans)
+    return tuple(carry)

@@ -19,9 +19,17 @@ package acts on it):
 - :mod:`.checkpoint` — mid-chunk device checkpointing: the fused
   multigen loop's carry (RNG key data, fitted-proposal state, epsilon /
   pdf-norm trail, refit cadence counter) round-trips bit-exact through
-  :class:`CheckpointManager` with atomic rename, so a killed
-  orchestrator resumes mid-chunk instead of replaying from the last
-  History generation.
+  :class:`CheckpointManager` with atomic rename + a CRC32/schema-version
+  header (a corrupt or truncated file raises a typed
+  :class:`CheckpointCorruptError` and resume falls back to History
+  replay), so a killed orchestrator resumes mid-chunk instead of
+  replaying from the last History generation.
+- :mod:`.health` — numerical/statistical health supervision (round 10):
+  the host half of the in-kernel per-generation health word
+  (:mod:`pyabc_tpu.ops.health`) — :class:`RunSupervisor` maps NaN/Inf,
+  ESS collapse, PSD failure and epsilon stalls to budgeted recovery
+  actions (rollback / forced refit / proposal widening) or a typed
+  :class:`DegenerateRunError` carrying the per-generation health trail.
 
 Every recovery action emits spans/metrics through the PR 1 observability
 spine (``pyabc_tpu_faults_injected_total``,
@@ -31,6 +39,7 @@ spine (``pyabc_tpu_faults_injected_total``,
 """
 from .checkpoint import (
     CHECKPOINT_VERSION,
+    CheckpointCorruptError,
     CheckpointManager,
     decode_tree,
     encode_tree,
@@ -47,9 +56,11 @@ from .faults import (
     InjectedTransientError,
     active_fault_plan,
     install_fault_plan,
+    maybe_corrupt,
     maybe_fault,
     uninstall_fault_plan,
 )
+from .health import DegenerateRunError, RunSupervisor, decode_health
 from .lease import LeaseTable
 from .retry import (
     DEFAULT_PERSIST_RETRY_POLICY,
@@ -58,13 +69,14 @@ from .retry import (
 )
 
 __all__ = [
-    "CHECKPOINT_VERSION", "CheckpointManager", "decode_tree", "encode_tree",
-    "tree_bit_equal",
+    "CHECKPOINT_VERSION", "CheckpointCorruptError", "CheckpointManager",
+    "decode_tree", "encode_tree", "tree_bit_equal",
     "FaultPlan", "FaultRule", "InjectedFault", "InjectedKill",
     "InjectedConnectionError", "InjectedTransientError",
     "InjectedPersistError", "InjectedDeviceReset",
     "active_fault_plan", "install_fault_plan", "maybe_fault",
-    "uninstall_fault_plan",
+    "maybe_corrupt", "uninstall_fault_plan",
+    "DegenerateRunError", "RunSupervisor", "decode_health",
     "LeaseTable",
     "RetryPolicy", "DEFAULT_RETRY_POLICY", "DEFAULT_PERSIST_RETRY_POLICY",
 ]

@@ -27,11 +27,22 @@ so re-run generations are not double-persisted).
 
 Atomicity: write to ``<path>.tmp`` + fsync + ``os.replace`` — a crash
 mid-save leaves the previous checkpoint intact, never a torn file.
+
+Integrity (round 10): every payload is framed by a fixed header —
+``magic | schema version | payload CRC32 | payload length`` — verified
+BEFORE unpickling. A truncated file, a flipped bit, or a
+schema-version mismatch raises a typed :class:`CheckpointCorruptError`
+naming what failed, instead of an opaque ``pickle``/``np.load`` crash
+deep in deserialization (or, worse, a silently wrong carry). Resume
+catches it and falls back to generation-granularity History replay —
+corruption degrades durability, never correctness.
 """
 from __future__ import annotations
 
 import os
 import pickle
+import struct
+import zlib
 
 import numpy as np
 
@@ -39,10 +50,30 @@ from ..observability import NULL_METRICS, NULL_TRACER, SYSTEM_CLOCK
 from ..observability.metrics import CHECKPOINTS_WRITTEN_TOTAL
 from ..storage.bytes_storage import np_from_bytes, np_to_bytes
 
-#: bumped when the on-disk layout changes; loaders ignore other versions
-CHECKPOINT_VERSION = 1
+#: bumped when the on-disk layout changes; loaders reject other versions
+#: with CheckpointCorruptError (v2: CRC/length header added, fused carry
+#: gained the health-guard stall state)
+CHECKPOINT_VERSION = 2
+
+#: file magic: identifies a framed pyabc_tpu checkpoint before any parse
+CHECKPOINT_MAGIC = b"PTCK"
+#: header layout: magic (4s) | schema version (u32) | payload crc32
+#: (u32) | payload length (u64) — little-endian, fixed 20 bytes
+_HEADER = struct.Struct("<4sIIQ")
 
 _ND = "__nd__"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint file failed integrity verification (bad magic,
+    schema-version mismatch, truncation, or CRC mismatch). Carries the
+    path and the reason; resume handles it by falling back to the
+    History epsilon-trail replay path."""
+
+    def __init__(self, path: str, reason: str):
+        super().__init__(f"corrupt checkpoint {path!r}: {reason}")
+        self.path = str(path)
+        self.reason = str(reason)
 
 
 def encode_tree(obj):
@@ -104,10 +135,13 @@ class CheckpointManager:
         payload["saved_wall"] = self.clock.wall()
         blob = pickle.dumps(encode_tree(payload),
                             protocol=pickle.HIGHEST_PROTOCOL)
+        header = _HEADER.pack(CHECKPOINT_MAGIC, CHECKPOINT_VERSION,
+                              zlib.crc32(blob), len(blob))
         tmp = self.path + ".tmp"
         with self.tracer.span("checkpoint.save", path=self.path,
                               nbytes=len(blob), t=state.get("t")):
             with open(tmp, "wb") as fh:
+                fh.write(header)
                 fh.write(blob)
                 fh.flush()
                 os.fsync(fh.fileno())
@@ -119,20 +153,53 @@ class CheckpointManager:
         return len(blob)
 
     def load(self) -> dict | None:
-        """The decoded checkpoint, or None (missing / unreadable / other
-        version). Unreadable never raises: a corrupt checkpoint must
-        degrade to generation-granularity resume, not block it."""
+        """The decoded checkpoint, or None when no file exists.
+
+        Integrity failures raise :class:`CheckpointCorruptError` BEFORE
+        any unpickling happens (magic -> schema version -> length ->
+        CRC32, in that order, so the reason names the outermost
+        failure): a truncated or bit-flipped file is diagnosed loudly,
+        and the caller (``ABCSMC._maybe_adopt_checkpoint``) falls back
+        to the History epsilon-trail replay path."""
         if not os.path.exists(self.path):
             return None
-        try:
-            with self.tracer.span("checkpoint.load", path=self.path):
-                with open(self.path, "rb") as fh:
-                    payload = decode_tree(pickle.load(fh))
-        except Exception:
-            return None
+        with self.tracer.span("checkpoint.load", path=self.path):
+            with open(self.path, "rb") as fh:
+                raw = fh.read()
+            if len(raw) < _HEADER.size:
+                raise CheckpointCorruptError(
+                    self.path, f"file too short for the header "
+                    f"({len(raw)} < {_HEADER.size} bytes)")
+            magic, version, crc, length = _HEADER.unpack(
+                raw[:_HEADER.size])
+            if magic != CHECKPOINT_MAGIC:
+                raise CheckpointCorruptError(
+                    self.path, f"bad magic {magic!r} (not a pyabc_tpu "
+                    f"checkpoint, or a pre-header legacy file)")
+            if version != CHECKPOINT_VERSION:
+                raise CheckpointCorruptError(
+                    self.path, f"schema version {version} != supported "
+                    f"{CHECKPOINT_VERSION}")
+            blob = raw[_HEADER.size:]
+            if len(blob) != length:
+                raise CheckpointCorruptError(
+                    self.path, f"truncated payload: {len(blob)} of "
+                    f"{length} bytes")
+            if zlib.crc32(blob) != crc:
+                raise CheckpointCorruptError(
+                    self.path, "payload CRC32 mismatch (bit corruption)")
+            try:
+                payload = decode_tree(pickle.loads(blob))
+            except Exception as exc:
+                # CRC passed but the payload does not decode: a writer
+                # bug, not wire/disk corruption — still typed, not opaque
+                raise CheckpointCorruptError(
+                    self.path, f"payload failed to decode: {exc!r}"
+                ) from exc
         if not isinstance(payload, dict) \
                 or payload.get("version") != CHECKPOINT_VERSION:
-            return None
+            raise CheckpointCorruptError(
+                self.path, "decoded payload missing/mismatched version")
         return payload
 
     def clear(self) -> None:

@@ -14,6 +14,8 @@ site                 probed where
 ``history.persist``  async History writer, before each queued append
 ``orchestrator.chunk`` fused loop, before processing each fetched chunk
 ``device.context``   DeviceContext build/reuse (simulated device reset)
+``device.carry``     fused loop, each chunk's input carry (numeric
+                     corruption — polled, not raised; see below)
 ==================== =======================================================
 
 Rule kinds map to actions: ``kill`` raises :class:`InjectedKill` (hard
@@ -24,6 +26,16 @@ policies and reconnect loops handle it like a real network blip),
 :class:`InjectedPersistError` (the History writer's two failure
 classes), ``reset`` raises :class:`InjectedDeviceReset`, and ``hang`` /
 ``slow`` / ``delay`` sleep for ``delay_s``.
+
+Numeric-corruption kinds (round 10 health guards): ``nan_poison``,
+``cov_corrupt`` and ``weight_zero`` do not raise — silent numerical
+failure is exactly the failure mode that never raises. The instrumented
+site POLLS for them (:func:`maybe_corrupt`) and applies the corruption
+itself (``ops.health.poison_carry`` on the fused chunk carry at
+``device.carry``), so every in-kernel health guard is exercised
+deterministically on CPU. :func:`maybe_fault` ignores corruption rules;
+:func:`maybe_corrupt` ignores raise/sleep rules — one plan can carry
+both.
 
 Determinism: probabilistic rules draw from a ``random.Random(seed)``
 owned by the plan, and counting rules (``after`` / ``every`` /
@@ -92,7 +104,10 @@ _KIND_EXC = {
     "reset": InjectedDeviceReset,
 }
 _KIND_SLEEP = {"hang": 30.0, "slow": 0.05, "delay": 0.05}
-KINDS = tuple(_KIND_EXC) + tuple(_KIND_SLEEP)
+#: numeric-corruption kinds: POLLED by the site (maybe_corrupt), which
+#: applies the corruption itself instead of receiving an exception
+_KIND_CORRUPT = ("nan_poison", "cov_corrupt", "weight_zero")
+KINDS = tuple(_KIND_EXC) + tuple(_KIND_SLEEP) + _KIND_CORRUPT
 
 
 @dataclass
@@ -184,12 +199,16 @@ class FaultPlan:
             raise ValueError(f"empty fault spec {spec!r}")
         return cls(rules, **kwargs)
 
-    def probe(self, site: str, **ctx) -> None:
-        """Evaluate every rule for ``site``; raise/sleep if one fires."""
-        fired: FaultRule | None = None
+    def _fire_locked(self, site: str, corrupt: bool,
+                     ctx: dict) -> FaultRule | None:
+        """Evaluate the matching rules for one probe/poll; rule counters
+        only advance for rules of the REQUESTED class (raise/sleep vs
+        corruption), so mixed plans stay deterministic per site."""
         with self._lock:
             for rule in self.rules:
                 if rule.site != site:
+                    continue
+                if (rule.kind in _KIND_CORRUPT) is not corrupt:
                     continue
                 if rule.match and rule.match not in str(
                         ctx.get("worker_id", "")):
@@ -205,12 +224,17 @@ class FaultPlan:
                 if rule.p < 1.0 and self._rng.random() >= rule.p:
                     continue
                 rule.n_fires += 1
-                fired = rule
                 self.events.append({
                     "site": site, "kind": rule.kind,
                     "ts": self.clock.now(), **ctx,
                 })
-                break  # one fault per probe
+                return rule  # one fault per probe
+        return None
+
+    def probe(self, site: str, **ctx) -> None:
+        """Evaluate every raise/sleep rule for ``site``; raise/sleep if
+        one fires (corruption rules are polled, not probed)."""
+        fired = self._fire_locked(site, False, ctx)
         if fired is None:
             return
         self._metrics.counter(
@@ -222,6 +246,18 @@ class FaultPlan:
                         else _KIND_SLEEP[fired.kind])
             return
         raise _KIND_EXC[fired.kind](fired.kind, site, **ctx)
+
+    def poll(self, site: str, **ctx) -> str | None:
+        """Evaluate the CORRUPTION rules for ``site``; returns the fired
+        kind (the caller applies the corruption) or None."""
+        fired = self._fire_locked(site, True, ctx)
+        if fired is None:
+            return None
+        self._metrics.counter(
+            FAULTS_INJECTED_TOTAL,
+            "faults fired by the active FaultPlan",
+        ).inc()
+        return fired.kind
 
     def n_fired(self, site: str | None = None) -> int:
         with self._lock:
@@ -254,3 +290,12 @@ def maybe_fault(site: str, **ctx) -> None:
     plan = _ACTIVE
     if plan is not None:
         plan.probe(site, **ctx)
+
+
+def maybe_corrupt(site: str, **ctx) -> str | None:
+    """Poll the active plan for a numeric-corruption kind at ``site``;
+    the caller applies the returned corruption (None = stay clean)."""
+    plan = _ACTIVE
+    if plan is None:
+        return None
+    return plan.poll(site, **ctx)

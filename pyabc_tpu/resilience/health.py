@@ -1,0 +1,188 @@
+"""Host-side numerical/statistical health supervision (ISSUE 6 tentpole).
+
+The device half (:mod:`pyabc_tpu.ops.health`) computes a per-generation
+health word inside the fused multigen kernel and ships it on the packed
+fetch; this module is the host half: decode the word, keep the
+per-generation health TRAIL, and map detected conditions to recovery
+actions the fused loop executes:
+
+==================== =======================================================
+condition            action
+==================== =======================================================
+NaN/Inf in theta /   ``rollback`` — abort the chunk (nothing at or past the
+weights / distances, failed generation is persisted), roll the carry back to
+non-finite epsilon,  the PR 5 checkpoint / the last healthy chunk-boundary
+zero total weight    carry, and redispatch; with deterministic one-shot
+                     corruption the recovered trajectory is BIT-identical
+PSD / Cholesky       ``refit`` — rebuild the carry from a forced fresh HOST
+failure (survived    transition fit on the last healthy population (the
+the jitter ladder)   in-kernel factors are not trusted)
+ESS below the floor, ``widen`` — same host rebuild, with the proposal
+acceptance collapse  bandwidth inflated by ``widen_factor`` (weights are
+                     always computed against the proposal actually sampled
+                     from, so widening is statistically exact)
+epsilon stall        ``terminate`` — the run is burning device time without
+                     progress: graceful :class:`DegenerateRunError`
+==================== =======================================================
+
+Recovery is BUDGETED: more than ``max_rollbacks`` recovery attempts per
+run means the degeneracy is persistent (e.g. a re-poisoned carry, a
+model that genuinely cannot reach the floor) and the run terminates with
+a typed :class:`DegenerateRunError` carrying the full health trail —
+"silently wrong" becomes "loudly diagnosed".
+
+Every decision lands on the observability spine: a ``health.<action>``
+span on the ``health`` pseudo-thread (clipped to the detection->redispatch
+window, so gap attribution sees recovery time) and
+``pyabc_tpu_health_events_total`` counters per condition kind.
+"""
+from __future__ import annotations
+
+from ..observability import NULL_METRICS, NULL_TRACER, SYSTEM_CLOCK
+from ..observability.metrics import (
+    CHUNK_ROLLBACKS_TOTAL,
+    DEGENERATE_RUNS_TOTAL,
+    HEALTH_EVENTS_TOTAL,
+    health_event_metric,
+)
+from ..ops.health import (  # noqa: F401  (re-exported host vocabulary)
+    BIT_ACC_COLLAPSE,
+    BIT_EPS_NONFINITE,
+    BIT_EPS_STALL,
+    BIT_ESS_FLOOR,
+    BIT_NAMES,
+    BIT_NAN_DISTANCE,
+    BIT_NAN_THETA,
+    BIT_NAN_WEIGHT,
+    BIT_PSD_FAIL,
+    BIT_WEIGHT_ZERO,
+    POISON_KINDS,
+)
+
+#: bits whose only sound recovery is rolling the carry back to a known-
+#: good state: the population itself is numerically corrupt
+_ROLLBACK_BITS = (BIT_NAN_THETA | BIT_NAN_WEIGHT | BIT_NAN_DISTANCE
+                  | BIT_WEIGHT_ZERO | BIT_EPS_NONFINITE)
+#: statistical (not numerical) degeneracy: the proposal is too narrow /
+#: mis-centred — widen its bandwidth on the rebuild
+_WIDEN_BITS = BIT_ESS_FLOOR | BIT_ACC_COLLAPSE
+
+
+def decode_health(word: int) -> list[str]:
+    """Kind names set in a health word, in bit order."""
+    word = int(word)
+    return [name for i, name in enumerate(BIT_NAMES) if word & (1 << i)]
+
+
+class DegenerateRunError(RuntimeError):
+    """A run terminated by the health supervisor.
+
+    ``trail`` is the per-generation health record list (every nonzero
+    health word observed, with the action taken) — the diagnosis ships
+    WITH the failure instead of being reconstructed from logs.
+    """
+
+    def __init__(self, message: str, trail: list[dict]):
+        super().__init__(message)
+        self.trail = list(trail)
+
+
+class RunSupervisor:
+    """Maps in-kernel health words to recovery actions, under a budget.
+
+    One instance per run (the trail and the rollback budget are run
+    state). The fused loop calls :meth:`on_failure` with each first
+    nonzero health word of a fetched chunk; the supervisor records the
+    event, emits metrics/spans, and returns the action — or raises
+    :class:`DegenerateRunError` when the condition is terminal or the
+    recovery budget is exhausted.
+    """
+
+    def __init__(self, *, max_rollbacks: int = 2, widen_factor: float = 1.5,
+                 clock=None, tracer=None, metrics=None):
+        self.max_rollbacks = int(max_rollbacks)
+        self.widen_factor = float(widen_factor)
+        self.clock = clock if clock is not None else SYSTEM_CLOCK
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self.metrics = metrics if metrics is not None else NULL_METRICS
+        #: every nonzero health word observed, in detection order
+        self.trail: list[dict] = []
+        self.rollbacks = 0
+
+    @staticmethod
+    def action_for(word: int) -> str:
+        """The recovery action for a health word (precedence: a stall is
+        terminal; numerical corruption outranks a bad factorization
+        outranks statistical degeneracy)."""
+        word = int(word)
+        if word & BIT_EPS_STALL:
+            return "terminate"
+        if word & _ROLLBACK_BITS:
+            return "rollback"
+        if word & BIT_PSD_FAIL:
+            return "refit"
+        if word & _WIDEN_BITS:
+            return "widen"
+        return "rollback"  # unknown future bits: the conservative action
+
+    def on_failure(self, t: int, word: int, **info) -> str:
+        """Record one detected failure and decide; raises
+        :class:`DegenerateRunError` for terminal conditions."""
+        kinds = decode_health(word)
+        action = self.action_for(word)
+        entry = {"t": int(t), "word": int(word), "kinds": kinds,
+                 "action": action, "ts": self.clock.now(),
+                 **{k: v for k, v in info.items() if v is not None}}
+        self.trail.append(entry)
+        self.metrics.counter(
+            HEALTH_EVENTS_TOTAL,
+            "nonzero in-kernel health words acted on",
+        ).inc()
+        for kind in kinds:
+            self.metrics.counter(
+                health_event_metric(kind),
+                f"health events of kind {kind}",
+            ).inc()
+        if action == "terminate":
+            self._degenerate(
+                f"epsilon progress stalled at t={t} "
+                f"(kinds={kinds}): terminating a run that is burning "
+                f"device time without converging"
+            )
+        if self.rollbacks >= self.max_rollbacks:
+            self._degenerate(
+                f"health recovery budget exhausted "
+                f"({self.rollbacks}/{self.max_rollbacks} rollbacks) at "
+                f"t={t} (kinds={kinds}): the degeneracy is persistent"
+            )
+        self.rollbacks += 1
+        self.metrics.counter(
+            CHUNK_ROLLBACKS_TOTAL,
+            "fused chunks aborted and rolled back by the health "
+            "supervisor",
+        ).inc()
+        return action
+
+    def note_recovered(self, t: int, action: str, source: str,
+                       t_detect: float) -> None:
+        """Close the recovery window: one ``health.<action>`` span on the
+        ``health`` pseudo-thread covering detection -> redispatch, so
+        coverage/gap accounting attributes recovery time instead of
+        reporting it dark."""
+        now = self.clock.now()
+        if self.trail:
+            self.trail[-1]["recovery_source"] = source
+            self.trail[-1]["recovery_s"] = round(now - t_detect, 6)
+        self.tracer.record_span(
+            f"health.{action}", t_detect, now, thread="health",
+            t=int(t), source=source,
+        )
+
+    def _degenerate(self, message: str) -> None:
+        self.metrics.counter(
+            DEGENERATE_RUNS_TOTAL,
+            "runs terminated with DegenerateRunError",
+        ).inc()
+        if self.trail:
+            self.trail[-1]["action"] = "terminate"
+        raise DegenerateRunError(message, self.trail)

@@ -13,10 +13,14 @@ use-case (farming out non-JAX host simulators) and activates only when
 """
 from __future__ import annotations
 
+import logging
+
 import numpy as np
 
 from .base import Sampler
 from .mapping import ConcurrentFutureSampler
+
+logger = logging.getLogger("ABC.Sampler")
 
 
 def _require_distributed():
@@ -75,4 +79,5 @@ class DaskDistributedSampler(Sampler):
         try:
             self.client.close()
         except Exception:
-            pass
+            logger.info("dask client close failed (already down?)",
+                        exc_info=True)

@@ -309,8 +309,14 @@ class LocalTransition(Transition):
     def _device_factorize(cov, vmask, outer):
         """(chols, precs, logdets) from a batch of jittered covariances —
         the factorization half of the refit, split out so the incremental
-        path can run it on changed rows only."""
-        chols = jnp.linalg.cholesky(cov)
+        path can run it on changed rows only. Rows whose factorization
+        fails escalate through the relative-jitter ladder
+        (``transition.util.device_chol_guarded_batched``) instead of
+        keeping silent NaN factors; a row still non-finite past the
+        ladder is surfaced by the health word's psd_fail bit."""
+        from .util import device_chol_guarded_batched
+
+        chols, cov, _bad = device_chol_guarded_batched(cov)
         precs = jnp.linalg.inv(cov) * outer[None]
         logdets = 2.0 * jnp.sum(
             vmask[None, :] * jnp.log(jnp.maximum(

@@ -155,11 +155,14 @@ class MultivariateNormalTransition(Transition):
         ess = 1.0 / jnp.maximum(jnp.sum(w * w), 1e-38)
         factor = bandwidth_selector(ess, dim)
         cov = cov * (scaling * factor) ** 2
-        chol = jnp.linalg.cholesky(cov)
-        # host path retries with a jittered diagonal on factorization failure
-        bad = ~jnp.all(jnp.isfinite(chol))
-        cov = jnp.where(bad, cov + jnp.eye(cov.shape[0]) * 1e-10, cov)
-        chol = jnp.where(bad, jnp.linalg.cholesky(cov), chol)
+        # jitter-escalation retry (transition.util.CHOL_JITTER_LADDER):
+        # the host path's single 1e-10 retry, escalated — a covariance
+        # that stays non-finite is surfaced via the health word's
+        # psd_fail bit (ops/health.params_unhealthy), never silently
+        # propagated as NaN factors
+        from .util import device_chol_guarded
+
+        chol, cov, _chol_bad = device_chol_guarded(cov)
         prec = jnp.linalg.inv(cov)
         # logdet over the REAL dims only (padded block is block-diagonal,
         # so the leading diag of chol equals the submatrix factorization)

@@ -47,6 +47,65 @@ def silverman_rule_of_thumb(n_samples: float, dimension: int) -> float:
     )
 
 
+#: escalating relative diagonal-jitter ladder for in-kernel Cholesky
+#: retries (round 10 health guards): the host path's single 1e-10 retry
+#: was matched in-kernel by ONE jittered re-factorization, which still
+#: yields silent NaN factors for a covariance that needs more
+#: regularization — and a NaN Cholesky poisons every proposal draw of
+#: the rest of the chunk. Three rungs span numerically-marginal to
+#: badly-conditioned; a matrix that stays non-finite past the ladder is
+#: genuinely corrupt (NaN input) and is SURFACED through the health
+#: word's psd_fail bit instead of swallowed.
+CHOL_JITTER_LADDER = (1e-10, 1e-7, 1e-4)
+
+
+def device_chol_guarded(cov):
+    """Traceable Cholesky with jitter-escalation retry (single matrix).
+
+    Returns ``(chol, cov_used, psd_failed)``: the factor from the first
+    rung of ``CHOL_JITTER_LADDER`` (scaled by the mean diagonal) that
+    came back finite, the (possibly jittered) covariance it factorizes —
+    so the caller's precision/logdet stay consistent with the factor —
+    and whether even the last rung failed: the caller feeds that flag to
+    the health word rather than propagating NaN factors silently. All
+    rungs are computed unconditionally (lax ``where`` semantics); the
+    matrices are (d, d) with d small, so the retries are noise next to
+    the surrounding refit."""
+    import jax.numpy as jnp
+
+    d = cov.shape[-1]
+    chol = jnp.linalg.cholesky(cov)
+    cov_used = cov
+    tr = jnp.maximum(jnp.trace(cov) / d, 1e-30)
+    for jit in CHOL_JITTER_LADDER:
+        bad = ~jnp.all(jnp.isfinite(chol))
+        cov_j = cov + jnp.eye(d, dtype=cov.dtype) * (jit * tr)
+        chol = jnp.where(bad, jnp.linalg.cholesky(cov_j), chol)
+        cov_used = jnp.where(bad, cov_j, cov_used)
+    return chol, cov_used, ~jnp.all(jnp.isfinite(chol))
+
+
+def device_chol_guarded_batched(covs):
+    """Batched :func:`device_chol_guarded` for an (n, d, d) covariance
+    field (LocalTransition's per-row factors). Each row escalates
+    independently; returns ``(chols, psd_failed_any)``."""
+    import jax.numpy as jnp
+
+    d = covs.shape[-1]
+    chols = jnp.linalg.cholesky(covs)
+    covs_used = covs
+    tr = jnp.maximum(
+        jnp.trace(covs, axis1=-2, axis2=-1) / d, 1e-30
+    )[..., None, None]
+    for jit in CHOL_JITTER_LADDER:
+        bad = ~jnp.all(jnp.isfinite(chols), axis=(-2, -1),
+                       keepdims=True)
+        covs_j = covs + jnp.eye(d, dtype=covs.dtype)[None] * (jit * tr)
+        chols = jnp.where(bad, jnp.linalg.cholesky(covs_j), chols)
+        covs_used = jnp.where(bad, covs_j, covs_used)
+    return chols, covs_used, ~jnp.all(jnp.isfinite(chols))
+
+
 def device_proposal_drift(fit_thetas, fit_w, new_thetas, new_w, vmask):
     """Traceable acceptance-weighted drift of a population vs the fitted
     proposal (the refit-cadence guard statistic, ISSUE 3 tentpole #1).

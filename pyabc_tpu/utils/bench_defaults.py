@@ -70,3 +70,22 @@ DEFAULT_RESILIENCE_LEASE_TIMEOUT_S = 1.0
 #: regression guard: minimum attributed fraction of a warm resilience
 #: run's wall clock (round 9 acceptance: >= 0.9 under injected faults)
 RESILIENCE_ATTRIBUTED_FRAC_MIN = 0.9
+#: round-10 satellite guard: fraction of the broker-logged
+#: orphaned->redispatch stall wall clock that may remain UNCOVERED by
+#: recovery spans (plus a small absolute floor for clock granularity) —
+#: recovery-accounting regressions fail the lane instead of passing
+#: silently as slightly-darker dark time
+RESILIENCE_RECOVERY_UNATTRIBUTED_FRAC_MAX = 0.1
+RESILIENCE_RECOVERY_UNATTRIBUTED_ABS_S = 0.1
+# health lane (round 10): in-kernel health guards + RunSupervisor
+# recovery, measured end-to-end on a CPU-capable fused gauss config. A
+# seed-matched fault-free reference run and a NaN-poisoned run (one
+# `device.carry:nan_poison` injection on the second chunk's carry) must
+# produce BIT-IDENTICAL trajectories — the rollback target is exactly
+# the state the clean run chained from — with at most
+# HEALTH_MAX_ROLLBACKS rolled-back chunks, and the health detection must
+# add zero blocking syncs (SyncLedger counts equal between the runs).
+DEFAULT_HEALTH_POP = 100
+DEFAULT_HEALTH_GENS = 8
+DEFAULT_HEALTH_G = 4
+HEALTH_MAX_ROLLBACKS = 1

@@ -1,0 +1,539 @@
+"""Numerical & statistical health guards (round 10): the fused loop
+detects silent degradation IN-KERNEL and recovers or fails loudly.
+
+The acceptance criteria end-to-end, all deterministic on CPU and `not
+slow`: a fused run with an injected mid-chunk ``nan_poison`` carry
+corruption completes with posterior parity vs the seed-matched
+fault-free run (rollback to the last healthy carry + redispatch — the
+recovered trajectory is BIT-identical, the strongest form of parity,
+with exactly one rolled-back chunk); a run with an unrecoverable
+injected degeneracy terminates with a typed ``DegenerateRunError``
+carrying the per-generation health trail; and health detection adds
+ZERO blocking syncs (``SyncLedger`` counts identical with the guards on
+and off). Plus unit coverage of the health-word bits, the stall
+recursion, the Cholesky jitter-escalation ladder, the corruption fault
+kinds, and the graceful SIGTERM path (external kill == injected kill).
+"""
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import pyabc_tpu as pt
+from pyabc_tpu.observability import MetricsRegistry, Tracer
+from pyabc_tpu.resilience import (
+    DegenerateRunError,
+    FaultPlan,
+    FaultRule,
+    decode_health,
+    install_fault_plan,
+    maybe_corrupt,
+    maybe_fault,
+    uninstall_fault_plan,
+)
+from pyabc_tpu.resilience.health import RunSupervisor
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+NOISE_SD = 0.5
+X_OBS = 1.0
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_fault_plan():
+    uninstall_fault_plan()
+    yield
+    uninstall_fault_plan()
+
+
+# ------------------------------------------------------- health-word units
+def test_health_word_bits_and_decode():
+    import jax.numpy as jnp
+
+    from pyabc_tpu.ops import health as H
+
+    n = 8
+    res = {"theta": jnp.ones((n, 2))}
+    k_mask = jnp.arange(n) < 4
+    w = jnp.full((n,), 0.25)
+    d = jnp.linspace(0.1, 0.4, n)
+    word, ess = H.population_bits(
+        res, k_mask, w, d, jnp.asarray(4), ess_floor=0.0,
+        n_target=jnp.asarray(4), acc_rate=jnp.asarray(0.5),
+        acc_floor=0.0,
+    )
+    assert int(word) == H.HEALTH_OK
+    assert float(ess) == pytest.approx(4.0)
+
+    # NaN theta in an accepted row
+    res_bad = {"theta": jnp.asarray(res["theta"]).at[1, 0].set(jnp.nan)}
+    word, _ = H.population_bits(
+        res_bad, k_mask, w, d, jnp.asarray(4), ess_floor=0.0,
+        n_target=jnp.asarray(4), acc_rate=jnp.asarray(0.5), acc_floor=0.0,
+    )
+    assert int(word) & H.BIT_NAN_THETA
+    assert "nan_theta" in decode_health(int(word))
+    # ...but a NaN in a MASKED row is not evidence
+    res_pad = {"theta": jnp.asarray(res["theta"]).at[6, 0].set(jnp.nan)}
+    word, _ = H.population_bits(
+        res_pad, k_mask, w, d, jnp.asarray(4), ess_floor=0.0,
+        n_target=jnp.asarray(4), acc_rate=jnp.asarray(0.5), acc_floor=0.0,
+    )
+    assert int(word) == H.HEALTH_OK
+
+    # zero total weight with accepted rows; ESS floor; acceptance floor
+    w0 = jnp.zeros((n,))
+    word, _ = H.population_bits(
+        res, k_mask, w0, d, jnp.asarray(4), ess_floor=0.0,
+        n_target=jnp.asarray(4), acc_rate=jnp.asarray(0.5), acc_floor=0.0,
+    )
+    assert int(word) & H.BIT_WEIGHT_ZERO
+    w_skew = jnp.where(jnp.arange(n) == 0, 1.0, 0.0)
+    word, ess = H.population_bits(
+        res, k_mask, w_skew, d, jnp.asarray(4), ess_floor=0.5,
+        n_target=jnp.asarray(4), acc_rate=jnp.asarray(0.5), acc_floor=0.0,
+    )
+    assert float(ess) == pytest.approx(1.0)
+    assert int(word) & H.BIT_ESS_FLOOR
+    word, _ = H.population_bits(
+        res, k_mask, w, d, jnp.asarray(4), ess_floor=0.0,
+        n_target=jnp.asarray(4), acc_rate=jnp.asarray(1e-6),
+        acc_floor=1e-3,
+    )
+    assert int(word) & H.BIT_ACC_COLLAPSE
+
+    assert decode_health(0) == []
+    assert set(decode_health(H.BIT_PSD_FAIL | H.BIT_EPS_STALL)) == {
+        "psd_fail", "eps_stall"}
+
+
+def test_eps_stall_recursion():
+    import jax.numpy as jnp
+
+    from pyabc_tpu.ops import health as H
+
+    # window 2, rtol 1e-3: two consecutive sub-rtol improvements fire
+    eps_prev = jnp.asarray(jnp.inf)
+    bit, cnt = H.eps_stall_update(eps_prev, jnp.asarray(1.0),
+                                  jnp.asarray(0, jnp.int32),
+                                  window=2, rtol=1e-3)
+    # inf seed counts as full improvement: no stall
+    assert int(bit) == 0 and int(cnt) == 0
+    bit, cnt = H.eps_stall_update(jnp.asarray(1.0), jnp.asarray(0.9999),
+                                  cnt, window=2, rtol=1e-3)
+    assert int(bit) == 0 and int(cnt) == 1
+    bit, cnt = H.eps_stall_update(jnp.asarray(0.9999),
+                                  jnp.asarray(0.99985), cnt,
+                                  window=2, rtol=1e-3)
+    assert int(bit) == H.BIT_EPS_STALL and int(cnt) == 2
+    # a real improvement resets the counter
+    bit, cnt = H.eps_stall_update(jnp.asarray(0.99985), jnp.asarray(0.5),
+                                  cnt, window=2, rtol=1e-3)
+    assert int(bit) == 0 and int(cnt) == 0
+    # window 0 = disabled (fixed schedules)
+    bit, cnt = H.eps_stall_update(jnp.asarray(1.0), jnp.asarray(1.0),
+                                  jnp.asarray(5, jnp.int32),
+                                  window=0, rtol=1e-3)
+    assert int(bit) == 0
+
+
+def test_params_unhealthy_and_poison_kinds():
+    import jax.numpy as jnp
+
+    from pyabc_tpu.ops import health as H
+
+    params = {"thetas": jnp.ones((4, 2)),
+              "weights": jnp.full((4,), 0.25),
+              "chol": jnp.eye(2)}
+    fitted = jnp.asarray([True])
+    assert not bool(H.params_unhealthy((params,), fitted))
+
+    carry = ((params,), jnp.zeros(()), fitted)
+    for kind, leaf in [("nan_poison", "thetas"), ("cov_corrupt", "chol"),
+                      ("weight_zero", "weights")]:
+        poisoned = H.poison_carry(carry, kind)
+        assert bool(H.params_unhealthy(poisoned[0], fitted)), kind
+        # the CLEAN carry is untouched (rollback depends on it)
+        assert bool(jnp.all(jnp.isfinite(carry[0][0][leaf])))
+        assert not bool(H.params_unhealthy(carry[0], fitted))
+    # an UNFITTED model's placeholder params are not evidence
+    assert not bool(H.params_unhealthy(
+        (H.poison_carry(carry, "nan_poison")[0][0],),
+        jnp.asarray([False])))
+    with pytest.raises(ValueError):
+        H.poison_carry(carry, "bogus")
+
+
+def test_chol_jitter_escalation():
+    import jax.numpy as jnp
+
+    from pyabc_tpu.transition.util import (
+        device_chol_guarded,
+        device_chol_guarded_batched,
+    )
+
+    # a healthy SPD matrix: factor finite, no failure, cov unchanged
+    cov = jnp.asarray([[2.0, 0.5], [0.5, 1.0]])
+    chol, cov_used, bad = device_chol_guarded(cov)
+    assert not bool(bad)
+    assert np.allclose(np.asarray(chol @ chol.T), np.asarray(cov),
+                       atol=1e-6)
+    # an indefinite matrix: the ladder must rescue it (the old single
+    # 1e-10 retry could not — the needed jitter exceeds 1e-10 * trace)
+    cov_bad = jnp.asarray([[1.0, 1.0000505], [1.0000505, 1.0]])
+    chol, cov_used, bad = device_chol_guarded(cov_bad)
+    assert not bool(bad)
+    assert bool(jnp.all(jnp.isfinite(chol)))
+    # NaN input cannot be rescued — surfaced, not swallowed
+    _, _, bad = device_chol_guarded(jnp.full((2, 2), jnp.nan))
+    assert bool(bad)
+
+    covs = jnp.stack([cov, cov_bad, jnp.eye(2)])
+    chols, _covs, bad = device_chol_guarded_batched(covs)
+    assert not bool(bad)
+    assert bool(jnp.all(jnp.isfinite(chols)))
+
+
+def test_supervisor_action_mapping_and_budget():
+    from pyabc_tpu.ops import health as H
+
+    assert RunSupervisor.action_for(H.BIT_NAN_WEIGHT) == "rollback"
+    assert RunSupervisor.action_for(H.BIT_WEIGHT_ZERO
+                                    | H.BIT_PSD_FAIL) == "rollback"
+    assert RunSupervisor.action_for(H.BIT_PSD_FAIL) == "refit"
+    assert RunSupervisor.action_for(H.BIT_ESS_FLOOR) == "widen"
+    assert RunSupervisor.action_for(H.BIT_ACC_COLLAPSE) == "widen"
+    assert RunSupervisor.action_for(H.BIT_EPS_STALL
+                                    | H.BIT_NAN_THETA) == "terminate"
+
+    sup = RunSupervisor(max_rollbacks=2)
+    assert sup.on_failure(3, H.BIT_NAN_THETA, ess=1.0) == "rollback"
+    assert sup.on_failure(3, H.BIT_NAN_THETA) == "rollback"
+    with pytest.raises(DegenerateRunError) as ei:
+        sup.on_failure(3, H.BIT_NAN_THETA)
+    assert len(ei.value.trail) == 3
+    assert ei.value.trail[0]["kinds"] == ["nan_theta"]
+    # a stall is terminal regardless of remaining budget
+    sup2 = RunSupervisor(max_rollbacks=5)
+    with pytest.raises(DegenerateRunError):
+        sup2.on_failure(1, H.BIT_EPS_STALL)
+
+
+def test_corruption_kinds_are_polled_not_probed():
+    plan = FaultPlan.parse("device.carry:nan_poison:after=1")
+    install_fault_plan(plan)
+    # probe() ignores corruption rules entirely (no raise, no counting)
+    maybe_fault("device.carry")
+    maybe_fault("device.carry")
+    assert plan.n_fired() == 0
+    # poll(): after=1 skips the first poll, fires the second, one-shot
+    assert maybe_corrupt("device.carry") is None
+    assert maybe_corrupt("device.carry") == "nan_poison"
+    assert maybe_corrupt("device.carry") is None
+    assert plan.n_fired("device.carry") == 1
+    # without a plan, polling is a no-op
+    uninstall_fault_plan()
+    assert maybe_corrupt("device.carry") is None
+
+
+# ----------------------------------------------------- fused end-to-end
+def _gauss_jax_model():
+    import jax
+
+    @pt.JaxModel.from_function(["theta"], name="gauss")
+    def model(key, theta):
+        return {"x": theta[0] + NOISE_SD * jax.random.normal(key)}
+
+    return model
+
+
+def _fused_abc(seed=7, pop=100, G=4, **kwargs):
+    prior = pt.Distribution(theta=pt.RV("norm", 0.0, 1.0))
+    abc = pt.ABCSMC(_gauss_jax_model(), prior, pt.PNormDistance(p=2),
+                    population_size=pop, eps=pt.MedianEpsilon(),
+                    seed=seed, fused_generations=G, **kwargs)
+    abc.new("sqlite://", {"x": X_OBS})
+    return abc
+
+
+def test_nan_poison_recovers_to_bit_identical_posterior():
+    """Acceptance criterion #1: an injected mid-chunk ``nan_poison``
+    carry corruption is detected by the in-kernel health word, the chunk
+    is aborted and rolled back to the last healthy carry, and the run
+    completes with POSTERIOR PARITY vs the seed-matched fault-free run —
+    bit-identical here, because the rollback target IS the state the
+    fault-free run chained from — with exactly one rolled-back chunk."""
+    gens = 8
+    abc_ref = _fused_abc()
+    h_ref = abc_ref.run(max_nr_populations=gens)
+    assert h_ref.n_populations == gens
+    assert abc_ref.health_supervisor.trail == []  # healthy run: silent
+
+    reg = MetricsRegistry()
+    tracer = Tracer()
+    abc = _fused_abc(tracer=tracer, metrics=reg)
+    install_fault_plan(FaultPlan([
+        FaultRule(site="device.carry", kind="nan_poison", after=1,
+                  max_fires=1),
+    ]))
+    try:
+        h = abc.run(max_nr_populations=gens)
+    finally:
+        uninstall_fault_plan()
+    assert h.n_populations == gens
+
+    # exactly one rolled-back chunk, with the diagnosis on the trail
+    sup = abc.health_supervisor
+    assert sup.rollbacks == 1
+    assert len(sup.trail) == 1
+    ev = sup.trail[0]
+    assert ev["action"] == "rollback"
+    assert set(ev["kinds"]) & {"nan_theta", "nan_weight", "weight_zero",
+                               "psd_fail"}
+    assert ev["recovery_source"] in ("last_good_carry", "checkpoint")
+
+    # bit-identical trajectory vs the fault-free run
+    eps_ref = h_ref.get_all_populations().query("t >= 0")["epsilon"]
+    eps_fix = h.get_all_populations().query("t >= 0")["epsilon"]
+    assert np.array_equal(eps_ref.to_numpy(), eps_fix.to_numpy())
+    for t in range(gens):
+        df_r, w_r = h_ref.get_distribution(0, t)
+        df_f, w_f = h.get_distribution(0, t)
+        assert np.array_equal(np.sort(df_r["theta"].to_numpy()),
+                              np.sort(df_f["theta"].to_numpy())), t
+        assert np.array_equal(np.sort(w_r), np.sort(w_f)), t
+    # every generation persisted exactly once (the aborted chunk's
+    # degraded generations never reached the History)
+    ts = h.get_all_populations().query("t >= 0")["t"].to_list()
+    assert sorted(ts) == sorted(set(ts)) == list(range(gens))
+
+    # observability: counters + a recovery span on the health thread
+    snap = reg.snapshot()
+    assert snap.get("pyabc_tpu_health_events_total", 0) == 1
+    assert snap.get("pyabc_tpu_health_chunk_rollbacks_total", 0) == 1
+    assert any(k.startswith("pyabc_tpu_health_events_total_")
+               for k in snap)
+    spans = [s.to_dict() for s in tracer.spans()]
+    rb = [s for s in spans if s["name"] == "health.rollback"]
+    assert len(rb) == 1 and rb[0]["thread"] == "health"
+
+
+def test_nan_poison_recovers_lv_fused():
+    """The acceptance criterion's exact workload: a fused LOTKA-VOLTERRA
+    run (the bench headline config, shrunk to CPU scale) with an
+    injected mid-chunk nan_poison completes with posterior parity vs the
+    seed-matched fault-free run — bit-identical via the rollback path,
+    with exactly one rolled-back chunk."""
+    from pyabc_tpu.models import lotka_volterra as lv
+
+    gens = 8
+
+    def make():
+        abc = pt.ABCSMC(
+            lv.make_lv_model(), lv.default_prior(),
+            pt.AdaptivePNormDistance(p=2), population_size=60,
+            eps=pt.MedianEpsilon(), seed=17, fused_generations=4,
+        )
+        abc.new("sqlite://", lv.observed_data(seed=123),
+                store_sum_stats=False)
+        return abc
+
+    ref = make()
+    h_ref = ref.run(max_nr_populations=gens)
+    assert h_ref.n_populations == gens
+    assert ref.health_supervisor.trail == []
+
+    abc = make()
+    install_fault_plan(FaultPlan([
+        FaultRule(site="device.carry", kind="nan_poison", after=1,
+                  max_fires=1),
+    ]))
+    try:
+        h = abc.run(max_nr_populations=gens)
+    finally:
+        uninstall_fault_plan()
+    assert h.n_populations == gens
+    assert abc.health_supervisor.rollbacks == 1
+    eps_ref = h_ref.get_all_populations().query("t >= 0")["epsilon"]
+    eps_fix = h.get_all_populations().query("t >= 0")["epsilon"]
+    assert np.array_equal(eps_ref.to_numpy(), eps_fix.to_numpy())
+    for t in (0, gens - 1):
+        df_r, w_r = h_ref.get_distribution(0, t)
+        df_f, w_f = h.get_distribution(0, t)
+        for col in df_r.columns:
+            assert np.array_equal(np.sort(df_r[col].to_numpy()),
+                                  np.sort(df_f[col].to_numpy())), (t, col)
+        assert np.array_equal(np.sort(w_r), np.sort(w_f)), t
+
+
+def test_unrecoverable_poison_terminates_with_trail():
+    """Acceptance criterion #2: a degeneracy that survives every
+    recovery attempt (the carry is re-poisoned on every dispatch)
+    terminates the run with a typed DegenerateRunError carrying the
+    per-generation health trail — and the History keeps every healthy
+    generation persisted before the failure."""
+    abc = _fused_abc(max_health_rollbacks=2)
+    install_fault_plan(FaultPlan([
+        FaultRule(site="device.carry", kind="nan_poison", after=1,
+                  every=1, max_fires=None),
+    ]))
+    try:
+        with pytest.raises(DegenerateRunError) as ei:
+            abc.run(max_nr_populations=8)
+    finally:
+        uninstall_fault_plan()
+    trail = ei.value.trail
+    assert len(trail) == 3  # 2 budgeted recoveries + the terminal event
+    assert all(e["t"] == trail[0]["t"] for e in trail)
+    assert trail[-1]["action"] == "terminate"
+    # the healthy generations before the failure are flushed + readable
+    pops = abc.history.get_all_populations().query("t >= 0")
+    assert len(pops) == trail[0]["t"]
+
+
+def test_cov_corrupt_detected_and_recovered():
+    """A corrupted covariance (non-finite Cholesky factors, the PSD
+    failure shape) is detected via the psd_fail bit and the run
+    completes after one recovery. The injected corruption cascades into
+    a non-finite epsilon as well (no lane can accept), so the stronger
+    rollback action outranks the pure-PSD forced refit — the
+    psd_fail-only -> refit mapping is covered at the unit level in
+    test_supervisor_action_mapping_and_budget."""
+    abc = _fused_abc()
+    install_fault_plan(FaultPlan([
+        FaultRule(site="device.carry", kind="cov_corrupt", after=1,
+                  max_fires=1),
+    ]))
+    try:
+        h = abc.run(max_nr_populations=8)
+    finally:
+        uninstall_fault_plan()
+    assert h.n_populations == 8
+    sup = abc.health_supervisor
+    assert len(sup.trail) == 1
+    assert "psd_fail" in sup.trail[0]["kinds"]
+    assert sup.trail[0]["action"] in ("refit", "rollback")
+    assert "recovery_source" in sup.trail[0]
+
+
+def test_ess_floor_triggers_widening_then_terminates():
+    """An impossible ESS floor exercises the proposal-widening action
+    (bandwidth inflation on the host rebuild, counted in metrics), and —
+    since widening cannot fix an impossible floor — the budgeted
+    recovery ends in a typed DegenerateRunError whose trail carries the
+    ess_floor diagnosis."""
+    # no fault plan: this is a REAL statistical floor violation,
+    # detected without any injection
+    reg = MetricsRegistry()
+    abc = _fused_abc(ess_floor=0.99, max_health_rollbacks=2, metrics=reg)
+    with pytest.raises(DegenerateRunError) as ei:
+        abc.run(max_nr_populations=8)
+    trail = ei.value.trail
+    assert any("ess_floor" in e["kinds"] for e in trail)
+    assert any(e["action"] == "widen" for e in trail)
+    assert reg.snapshot().get(
+        "pyabc_tpu_health_proposal_widenings_total", 0) >= 1
+
+
+def test_eps_stall_terminates_gracefully():
+    """An epsilon-progress stall (here: an absurd rtol that declares any
+    improvement a stall) terminates the run with DegenerateRunError
+    instead of burning device time forever."""
+    abc = _fused_abc(eps_stall_window=3, eps_stall_rtol=10.0)
+    with pytest.raises(DegenerateRunError) as ei:
+        abc.run(max_nr_populations=8)
+    assert any("eps_stall" in e["kinds"] for e in ei.value.trail)
+    assert ei.value.trail[-1]["action"] == "terminate"
+
+
+def test_health_detection_adds_zero_blocking_syncs():
+    """Acceptance criterion #3: the health word rides the existing
+    packed fetch — SyncLedger-verified sync counts are IDENTICAL with
+    the guards on and off, and so is the sampled trajectory."""
+    abc_on = _fused_abc(health_checks=True)
+    h_on = abc_on.run(max_nr_populations=8)
+    abc_off = _fused_abc(health_checks=False)
+    h_off = abc_off.run(max_nr_populations=8)
+    s_on = abc_on.sync_ledger.summary(0.1)
+    s_off = abc_off.sync_ledger.summary(0.1)
+    assert s_on["syncs"] == s_off["syncs"]
+    assert s_on["by_kind"] == s_off["by_kind"]
+    eps_on = h_on.get_all_populations().query("t >= 0")["epsilon"]
+    eps_off = h_off.get_all_populations().query("t >= 0")["epsilon"]
+    assert np.array_equal(eps_on.to_numpy(), eps_off.to_numpy())
+
+
+# ------------------------------------------------- graceful SIGTERM/SIGINT
+_SIGTERM_CHILD = """
+import sys
+import jax
+import pyabc_tpu as pt
+from pyabc_tpu.epsilon import ConstantEpsilon
+from pyabc_tpu.inference.smc import GracefulShutdown
+
+@pt.JaxModel.from_function(["theta"], name="gauss")
+def model(key, theta):
+    return {"x": theta[0] + 0.5 * jax.random.normal(key)}
+
+db, ck = sys.argv[1], sys.argv[2]
+prior = pt.Distribution(theta=pt.RV("norm", 0.0, 1.0))
+abc = pt.ABCSMC(model, prior, pt.PNormDistance(p=2), population_size=100,
+                eps=ConstantEpsilon(2.0), seed=5, fused_generations=4,
+                checkpoint_path=ck, checkpoint_every=1)
+abc.new(db, {"x": 1.0})
+try:
+    abc.run(max_nr_populations=100000)
+    print("DONE", flush=True)
+except GracefulShutdown:
+    print("GRACEFUL", flush=True)
+"""
+
+
+def test_sigterm_flushes_and_checkpoints(tmp_path):
+    """Satellite: an EXTERNAL SIGTERM mid-run is as recoverable as an
+    injected orchestrator kill — the handler converts it to
+    GracefulShutdown, the fused loop flushes the async History writer
+    and writes a final checkpoint, and a fresh orchestrator resumes
+    mid-chunk from it."""
+    db = f"sqlite:///{tmp_path}/run.db"
+    ck = str(tmp_path / "carry.ck")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _SIGTERM_CHILD, db, ck], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        deadline = time.monotonic() + 180.0
+        while not os.path.exists(ck):
+            assert proc.poll() is None, proc.communicate()[1][-2000:]
+            assert time.monotonic() < deadline, "no checkpoint appeared"
+            time.sleep(0.1)
+        # at least one chunk is durable: deliver the external kill
+        proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=120)
+    finally:
+        proc.kill()
+    assert "GRACEFUL" in out, (out, err[-2000:])
+    assert proc.returncode == 0
+    assert os.path.exists(ck), "final checkpoint missing after SIGTERM"
+
+    from pyabc_tpu.epsilon import ConstantEpsilon
+    from pyabc_tpu.resilience import CheckpointManager
+
+    t_ck = int(CheckpointManager(ck).load()["t"])
+    assert t_ck >= 4  # at least one full chunk
+    prior = pt.Distribution(theta=pt.RV("norm", 0.0, 1.0))
+    abc2 = pt.ABCSMC(_gauss_jax_model(), prior, pt.PNormDistance(p=2),
+                     population_size=100, eps=ConstantEpsilon(2.0),
+                     seed=5, fused_generations=4, checkpoint_path=ck,
+                     checkpoint_every=1)
+    abc2.load(db, 1)
+    h2 = abc2.run(max_nr_populations=t_ck + 4)
+    assert abc2.resumed_from_checkpoint_t == t_ck
+    assert h2.n_populations == t_ck + 4

@@ -35,6 +35,10 @@ INSTRUMENTED = [
     "pyabc_tpu/storage/history.py",
     "pyabc_tpu/cli.py",
     "pyabc_tpu/resilience/",
+    # round 10: the health-guard pair — the device word and its host
+    # supervisor share the run's injected clock (detection->redispatch
+    # recovery spans merge onto the run timeline)
+    "pyabc_tpu/ops/health.py",
 ]
 
 #: the distributed-tracing path: dropping any of these from INSTRUMENTED
@@ -121,7 +125,50 @@ def test_resilience_package_stays_pinned():
     assert {"pyabc_tpu/resilience/faults.py",
             "pyabc_tpu/resilience/retry.py",
             "pyabc_tpu/resilience/lease.py",
-            "pyabc_tpu/resilience/checkpoint.py"} <= set(pinned), pinned
+            "pyabc_tpu/resilience/checkpoint.py",
+            "pyabc_tpu/resilience/health.py"} <= set(pinned), pinned
+
+
+def test_health_modules_stay_pinned():
+    """The round-10 health pair cannot be dropped: the RunSupervisor's
+    recovery spans and the fault plan's corruption schedule are only
+    deterministic/mergeable on the injected clock (resilience/health.py
+    rides the directory pin; ops/health.py is pinned explicitly)."""
+    assert "pyabc_tpu/ops/health.py" in INSTRUMENTED
+    pinned = {rel for rel, _p in _instrumented_files()}
+    assert "pyabc_tpu/resilience/health.py" in pinned
+
+
+#: a broad handler whose entire body is `pass`: `except:`,
+#: `except Exception:`, `except BaseException:` (with or without `as e`)
+_BARE_EXCEPT = re.compile(
+    r"^\s*except\s*(?:\(?\s*(?:Exception|BaseException)\s*\)?"
+    r"(?:\s+as\s+\w+)?)?\s*:\s*$"
+)
+
+
+def test_no_swallowed_broad_exceptions():
+    """Repo-wide lint (round 10): no `except Exception: pass` (or bare
+    `except:` / `except BaseException:` with a pass-only body) anywhere
+    in pyabc_tpu/. Silently swallowed errors are exactly the failure
+    mode the health-guard PR exists to eliminate — a broad handler must
+    log, count, re-raise, or otherwise leave a trace. Narrow handlers
+    (`except FileNotFoundError: pass`) stay legal: suppressing a SPECIFIC
+    expected condition is a statement, suppressing everything is a hole."""
+    offenders = []
+    for path in sorted((REPO / "pyabc_tpu").rglob("*.py")):
+        lines = list(_code_lines(path))
+        rel = path.relative_to(REPO)
+        for i, (lineno, line) in enumerate(lines):
+            if not _BARE_EXCEPT.match(line):
+                continue
+            if i + 1 < len(lines) and lines[i + 1][1].strip() == "pass":
+                offenders.append(f"{rel}:{lineno}: {line.strip()} pass")
+    assert not offenders, (
+        "broad exception handlers with a pass-only body (log/count/"
+        "re-raise instead — swallowed errors are invisible failures):\n"
+        + "\n".join(offenders)
+    )
 
 
 def test_no_ad_hoc_telemetry_outside_observability():

@@ -26,6 +26,7 @@ from pyabc_tpu.broker.protocol import request
 from pyabc_tpu.broker.worker import run_worker
 from pyabc_tpu.observability import Tracer, VirtualClock
 from pyabc_tpu.resilience import (
+    CheckpointCorruptError,
     CheckpointManager,
     FaultPlan,
     FaultRule,
@@ -596,10 +597,87 @@ def tree_like(tree):
     return np.asarray(tree)
 
 
-def test_checkpoint_load_tolerates_corruption(tmp_path):
+def _saved_checkpoint(tmp_path):
+    """A real saved checkpoint + its manager (integrity-test fixture)."""
+    path = str(tmp_path / "ck.bin")
+    mgr = CheckpointManager(path)
+    mgr.save({"kind": "fused_carry", "t": 3, "abc_id": 1,
+              "carry": ({"thetas":
+                         np.arange(8, dtype=np.float32).reshape(2, 4)},)})
+    return mgr, path
+
+
+def test_checkpoint_corruption_raises_typed_error(tmp_path):
+    """A non-checkpoint file raises CheckpointCorruptError naming the
+    failure (bad magic), never an opaque unpickling crash."""
     path = tmp_path / "ck.bin"
-    path.write_bytes(b"not a checkpoint")
-    assert CheckpointManager(str(path)).load() is None
+    path.write_bytes(b"not a checkpoint at all, but long enough........")
+    with pytest.raises(CheckpointCorruptError, match="bad magic"):
+        CheckpointManager(str(path)).load()
+    # missing file is NOT corruption: plain None (fresh run)
+    assert CheckpointManager(str(tmp_path / "absent.bin")).load() is None
+
+
+def test_checkpoint_bit_flip_detected(tmp_path):
+    """Flipping ONE payload bit of a real checkpoint fails the CRC."""
+    mgr, path = _saved_checkpoint(tmp_path)
+    assert mgr.load()["t"] == 3  # sanity: intact file loads
+    raw = bytearray(open(path, "rb").read())
+    raw[len(raw) // 2] ^= 0x10  # flip a bit mid-payload
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(CheckpointCorruptError, match="CRC32"):
+        mgr.load()
+
+
+def test_checkpoint_truncation_detected(tmp_path):
+    """A truncated checkpoint (torn copy, full disk) is length-checked
+    before any parse; truncating into the header is also typed."""
+    mgr, path = _saved_checkpoint(tmp_path)
+    raw = open(path, "rb").read()
+    open(path, "wb").write(raw[: len(raw) - 7])
+    with pytest.raises(CheckpointCorruptError, match="truncated"):
+        mgr.load()
+    open(path, "wb").write(raw[:10])  # shorter than the header itself
+    with pytest.raises(CheckpointCorruptError, match="too short"):
+        mgr.load()
+
+
+def test_checkpoint_version_mismatch_detected(tmp_path):
+    """A future/past schema version is rejected loudly (the header is
+    checked before the payload is trusted)."""
+    import struct
+
+    mgr, path = _saved_checkpoint(tmp_path)
+    raw = bytearray(open(path, "rb").read())
+    raw[4:8] = struct.pack("<I", 9999)
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(CheckpointCorruptError, match="schema version"):
+        mgr.load()
+
+
+def test_corrupt_checkpoint_falls_back_to_history_resume(tmp_path):
+    """End-to-end: a bit-flipped checkpoint does not block resume — the
+    run falls back to generation-granularity History replay (the
+    epsilon-trail path) and completes."""
+    db = f"sqlite:///{tmp_path}/run.db"
+    ck = str(tmp_path / "carry.ck")
+    abc1 = _fused_abc(ck)
+    abc1.new(db, {"x": X_OBS})
+    install_fault_plan(FaultPlan([
+        FaultRule(site="orchestrator.chunk", kind="kill", after=1,
+                  max_fires=1),
+    ]))
+    with pytest.raises(InjectedKill):
+        abc1.run(max_nr_populations=8)
+    uninstall_fault_plan()
+    raw = bytearray(open(ck, "rb").read())
+    raw[-5] ^= 0x01
+    open(ck, "wb").write(bytes(raw))
+    abc2 = _fused_abc(ck)
+    abc2.load(db, abc1.history.id)
+    h2 = abc2.run(max_nr_populations=8)
+    assert abc2.resumed_from_checkpoint_t is None  # fell back
+    assert h2.n_populations == 8
 
 
 # -------------------------- orchestrator kill + mid-chunk resume (fused)
@@ -726,3 +804,105 @@ def test_device_reset_self_heals(tmp_path):
     finally:
         uninstall_fault_plan()
     assert h.n_populations == 4
+
+
+# ------------------------------------- lease state machine (property-style)
+def _lease_invariants(table, granted, delivered, requeued_expect=None):
+    """The two invariants the broker's healing rests on:
+
+    - EXACTLY-ONCE: a dynamic slot is admitted at most once, ever;
+    - NO LOST SLOT: every granted-but-undelivered slot is either still
+      owned by an outstanding lease or waiting in the requeue — nothing
+      falls on the floor, no matter the interleaving.
+    """
+    st = table.stats()
+    outstanding = set(table._slot_owner)
+    queued = set()
+    for a, b, _ts in table._requeue:
+        queued.update(range(a, b))
+    # a slot can never be both owned and requeued
+    assert not (outstanding & queued)
+    lost = granted - delivered - outstanding - queued
+    assert not lost, f"slots lost by the lease table: {sorted(lost)[:10]}"
+    assert st["outstanding_slots"] == len(outstanding)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4, 5, 6, 7])
+def test_lease_table_randomized_event_sequences(seed):
+    """Property-style: drive the LeaseTable through a long seeded random
+    sequence of grant / deliver / duplicate-deliver / worker-touch /
+    clock-advance / reap / dead-worker-reap / redispatch events and
+    assert the exactly-once and no-lost-slot invariants after EVERY
+    event. Each seed is a different interleaving; the rng is seeded so a
+    failure replays deterministically."""
+    import random as _random
+
+    from pyabc_tpu.resilience.lease import LeaseTable
+
+    rng = _random.Random(seed)
+    clk = VirtualClock(0.0)
+    table = LeaseTable(clk, timeout_s=5.0)
+    workers = [f"w{i}" for i in range(4)]
+    next_slot = 0
+    granted: set[int] = set()
+    delivered: set[int] = set()
+    admitted: list[int] = []
+
+    for _step in range(400):
+        op = rng.choices(
+            ["grant", "deliver", "dup", "touch", "advance", "reap",
+             "dead", "redispatch"],
+            weights=[4, 6, 2, 2, 3, 2, 1, 3],
+        )[0]
+        if op == "grant":
+            k = rng.randint(1, 8)
+            table.grant(rng.choice(workers), next_slot, next_slot + k)
+            granted.update(range(next_slot, next_slot + k))
+            next_slot += k
+        elif op == "deliver" and table._slot_owner:
+            slot = rng.choice(list(table._slot_owner))
+            wid = table._leases[table._slot_owner[slot]]["wid"]
+            table.touch_worker(wid)
+            if table.admit(slot, accepted=True, mode="dynamic"):
+                admitted.append(slot)
+                delivered.add(slot)
+            table.note_delivery(slot)
+        elif op == "dup" and delivered:
+            # a late duplicate of an ALREADY-delivered slot must drop
+            slot = rng.choice(sorted(delivered))
+            assert not table.admit(slot, accepted=rng.random() < 0.5,
+                                   mode="dynamic")
+        elif op == "touch":
+            table.touch_worker(rng.choice(workers))
+        elif op == "advance":
+            clk.advance(rng.uniform(0.0, 4.0))
+        elif op == "reap":
+            table.reap(clk.now())
+        elif op == "dead":
+            table.reap(clk.now(), dead_wids=[rng.choice(workers)])
+        elif op == "redispatch":
+            taken = table.take_requeued(rng.choice(workers),
+                                        rng.randint(1, 6))
+            if taken is not None:
+                a, b, ts = taken
+                assert a < b and ts <= clk.now()
+        _lease_invariants(table, granted, delivered)
+
+    # exactly-once held across the whole history
+    assert len(admitted) == len(set(admitted))
+    # drain everything still outstanding/requeued through deliveries and
+    # redispatches: the table must converge to empty with every granted
+    # slot delivered exactly once
+    for _drain in range(10000):
+        if table._slot_owner:
+            slot = rng.choice(list(table._slot_owner))
+            if table.admit(slot, accepted=True, mode="dynamic"):
+                delivered.add(slot)
+            table.note_delivery(slot)
+        elif table._requeue:
+            table.take_requeued(rng.choice(workers), 8)
+        else:
+            break
+        _lease_invariants(table, granted, delivered)
+    assert granted == delivered
+    assert not table._slot_owner and not table._requeue
