@@ -28,8 +28,13 @@ import os
 import signal
 import subprocess
 import sys
+import tempfile
 
 HERE = os.path.dirname(os.path.abspath(__file__))
+
+#: where JSONL trace artifacts land — NEVER the repo root (a 381 KB
+#: worker trace once rode a commit in); override with PYABC_TPU_TRACE_DIR
+TRACE_DIR = os.environ.get("PYABC_TPU_TRACE_DIR", tempfile.gettempdir())
 
 #: the bench's single clock (pyabc_tpu.observability.SYSTEM_CLOCK unless
 #: a test installed a VirtualClock first) and the span tracer every run's
@@ -187,8 +192,11 @@ def run_tpu_bench(pop_size: int, n_gens: int, budget_s: float, seed: int,
         for cleanup in (abc.drain_join, abc.history.close):
             try:
                 cleanup()
-            except Exception:
-                pass
+            except Exception as ce:
+                # best-effort teardown, but never silent (EXC001): the
+                # primary failure re-raises below, so just leave a trace
+                print(f"bench: cleanup {cleanup.__name__} failed: "
+                      f"{ce!r}", file=sys.stderr)
         raise
     return abc, dict(run_s_excl_drain=round(CLOCK.now() - t0, 2),
                      adopted_kernels=adopted)
@@ -496,7 +504,7 @@ def run_elastic_lane(budget_s: float) -> dict:
         })
     warm = [r for r in per_run if r["warm"]]
     # per-run worker trace JSONL export (merged spans, offset-mapped)
-    trace_path = os.path.join(HERE, ".elastic_worker_trace.jsonl")
+    trace_path = os.path.join(TRACE_DIR, ".elastic_worker_trace.jsonl")
     try:
         if os.path.exists(trace_path):
             os.remove(trace_path)
@@ -1030,8 +1038,10 @@ def main():
         # trips (consumed by the gap_attribution block)
         try:
             info["syncs"] = abc.sync_ledger.summary(sync_floor)
-        except Exception:
-            pass
+        except Exception as se:
+            # a run without sync accounting is reportable, but the gap
+            # attribution must say WHY the block is missing (EXC001)
+            info["syncs_error"] = repr(se)[:200]
         run_infos.append({"seed": run_seed, **info})
 
     while True:

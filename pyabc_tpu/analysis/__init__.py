@@ -1,0 +1,34 @@
+"""abc-lint: static enforcement of the repo's discipline contracts.
+
+Public surface:
+
+- :func:`run_analysis` + :func:`iter_python_files` — run rules over files
+- :class:`Rule`, :class:`Finding`, :class:`FileContext`,
+  :class:`AnalysisResult` — the plugin framework
+- :mod:`~pyabc_tpu.analysis.baseline` — grandfathered-finding handling
+- :func:`~pyabc_tpu.analysis.rules.all_rules` — the production rule set
+  (SYNC001, CLOCK001, RNG001, EXC001, LOCK001, TELEM001)
+- :func:`~pyabc_tpu.analysis.cli.main` — the ``abc-lint`` console script
+
+Stdlib-only by design: importable at test collection time and in CI
+without touching JAX.
+"""
+from . import baseline
+from .cli import DEFAULT_TARGETS, find_repo_root, main
+from .engine import (
+    AnalysisResult,
+    FileContext,
+    Finding,
+    Rule,
+    Suppression,
+    iter_python_files,
+    run_analysis,
+)
+from .rules import RULE_CLASSES, all_rules, rule_ids
+
+__all__ = [
+    "AnalysisResult", "FileContext", "Finding", "Rule", "Suppression",
+    "run_analysis", "iter_python_files", "baseline", "all_rules",
+    "rule_ids", "RULE_CLASSES", "main", "find_repo_root",
+    "DEFAULT_TARGETS",
+]

@@ -1,0 +1,86 @@
+"""Committed baseline of grandfathered abc-lint findings.
+
+The baseline exists so the engine could land with zero tolerance for NEW
+violations while the handful of pre-existing, deliberate sites were
+recorded rather than churned. Contract:
+
+- every entry carries a non-empty ``reason`` (same bar as inline
+  suppressions);
+- an entry matches findings by ``(rule, path, code)`` — the stripped
+  source text of the offending line — NOT by line number, so unrelated
+  edits don't invalidate it but touching the offending line re-opens it;
+- **the baseline only shrinks**: an entry that matches no live finding
+  is STALE and fails the lint, so a fixed violation must be deleted from
+  the file (grandfathering can't silently accumulate).
+"""
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from .engine import AnalysisResult, Finding
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE_NAME = ".abc-lint-baseline.json"
+
+
+class BaselineError(ValueError):
+    """Malformed baseline file (bad schema or entry without a reason)."""
+
+
+def load(path: Path) -> list[dict]:
+    data = json.loads(Path(path).read_text())
+    if not isinstance(data, dict) or data.get("version") != BASELINE_VERSION:
+        raise BaselineError(
+            f"{path}: expected a baseline object with version="
+            f"{BASELINE_VERSION}")
+    entries = data.get("entries", [])
+    for i, e in enumerate(entries):
+        missing = {"rule", "path", "code", "reason"} - set(e)
+        if missing:
+            raise BaselineError(f"{path}: entry {i} missing {sorted(missing)}")
+        if not str(e["reason"]).strip():
+            raise BaselineError(
+                f"{path}: entry {i} ({e['rule']} {e['path']}) has an empty "
+                "reason — every baselined finding must say why it stays")
+    return entries
+
+
+def apply(result: AnalysisResult, entries: list[dict]) -> None:
+    """Mark open findings matched by ``entries`` as baselined; record
+    stale entries (zero matches) on the result. One entry covers every
+    finding with the same (rule, path, code) triple — identical
+    offending lines in one file share a single entry by design."""
+    stale: list[dict] = []
+    for e in entries:
+        key = (e["rule"], e["path"], e["code"])
+        matched = False
+        for f in result.findings:
+            if f.status == "open" and f.key() == key:
+                f.status = "baselined"
+                f.reason = e["reason"]
+                matched = True
+        if not matched:
+            stale.append(dict(e))
+    result.stale_baseline = stale
+
+
+def write(findings: list[Finding], path: Path,
+          default_reason: str = "grandfathered at abc-lint adoption "
+                                "(round 11) — review before relying on") \
+        -> int:
+    """Serialize ``findings`` (typically ``result.open``) as a baseline.
+
+    Intended for the initial adoption only; the committed file's reasons
+    should then be hand-edited per entry. Deduplicates by entry key."""
+    seen: set[tuple[str, str, str]] = set()
+    entries = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        if f.key() in seen:
+            continue
+        seen.add(f.key())
+        entries.append({"rule": f.rule, "path": f.path, "code": f.code,
+                        "reason": f.reason or default_reason})
+    Path(path).write_text(json.dumps(
+        {"version": BASELINE_VERSION, "entries": entries}, indent=1) + "\n")
+    return len(entries)

@@ -1,0 +1,117 @@
+"""``abc-lint`` console entry point.
+
+Drops into any CI step as-is: exit 0 when the tree is clean (zero
+unbaselined findings, no stale baseline entries, no reasonless
+suppressions), exit 1 otherwise, exit 2 on usage errors.
+
+    abc-lint                          # whole repo, default rules+baseline
+    abc-lint pyabc_tpu/broker/        # just one subtree
+    abc-lint --format json            # machine-readable
+    abc-lint --select SYNC001,LOCK001 # only these rules
+    abc-lint --ignore TELEM001        # all but this rule
+    abc-lint --no-baseline            # pretend the baseline is empty
+    abc-lint --write-baseline         # (re)grandfather current findings
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from . import baseline as baseline_mod
+from .engine import iter_python_files, run_analysis
+from .reporters import format_json, format_text
+from .rules import all_rules, rule_ids
+
+#: default scan set, relative to the repo root
+DEFAULT_TARGETS = ("pyabc_tpu", "bench.py", "profile_gen.py")
+
+
+def find_repo_root(start: Path | None = None) -> Path:
+    """Nearest ancestor with a pyproject.toml; falls back to the package
+    checkout this module lives in."""
+    for cand in [start or Path.cwd(), *(start or Path.cwd()).parents]:
+        if (cand / "pyproject.toml").exists():
+            return cand
+    return Path(__file__).resolve().parents[2]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="abc-lint",
+        description="AST lint for the pyabc_tpu discipline contracts "
+                    f"(rules: {', '.join(rule_ids())})")
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to scan (default: "
+                        f"{' '.join(DEFAULT_TARGETS)} under the repo root)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", metavar="PATH", default=None,
+                   help="baseline file (default: <root>/"
+                        f"{baseline_mod.DEFAULT_BASELINE_NAME} if present)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline file")
+    p.add_argument("--select", metavar="RULES", default=None,
+                   help="comma-separated rule ids to run (only these)")
+    p.add_argument("--ignore", metavar="RULES", default=None,
+                   help="comma-separated rule ids to skip")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write current unbaselined findings to the "
+                        "baseline file and exit 0 (initial adoption; "
+                        "hand-edit the reasons afterwards)")
+    p.add_argument("--verbose", action="store_true",
+                   help="also list suppressed/baselined findings (text)")
+    return p
+
+
+def _parse_rule_set(spec: str | None, known: list[str],
+                    parser: argparse.ArgumentParser) -> set[str] | None:
+    if spec is None:
+        return None
+    rules = {r.strip() for r in spec.split(",") if r.strip()}
+    unknown = rules - set(known)
+    if unknown:
+        parser.error(f"unknown rule id(s): {', '.join(sorted(unknown))} "
+                     f"(known: {', '.join(known)})")
+    return rules
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    root = find_repo_root()
+    targets = ([Path(p) for p in args.paths] if args.paths
+               else [root / t for t in DEFAULT_TARGETS])
+    targets = [t for t in targets if t.exists()]
+    if not targets:
+        parser.error("no existing paths to scan")
+
+    known = rule_ids()
+    select = _parse_rule_set(args.select, known, parser)
+    ignore = _parse_rule_set(args.ignore, known, parser)
+
+    files = iter_python_files(targets)
+    result = run_analysis(root, files, all_rules(),
+                          select=select, ignore=ignore)
+
+    baseline_path = Path(args.baseline) if args.baseline else \
+        root / baseline_mod.DEFAULT_BASELINE_NAME
+    if args.write_baseline:
+        n = baseline_mod.write(result.open, baseline_path)
+        print(f"abc-lint: wrote {n} baseline entr(y/ies) to "
+              f"{baseline_path} — edit the reasons before committing")
+        return 0
+    if not args.no_baseline and baseline_path.exists():
+        try:
+            entries = baseline_mod.load(baseline_path)
+        except baseline_mod.BaselineError as err:
+            print(f"abc-lint: {err}", file=sys.stderr)
+            return 2
+        baseline_mod.apply(result, entries)
+
+    print(format_text(result, verbose=args.verbose)
+          if args.format == "text" else format_json(result))
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

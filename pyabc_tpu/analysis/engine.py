@@ -1,0 +1,346 @@
+"""abc-lint core: AST analysis over the repo's discipline contracts.
+
+PRs 1-6 established hard invariants — every blocking device round trip
+recorded into a :class:`~pyabc_tpu.observability.sync.SyncLedger`, every
+host timestamp on the injected clock, no silently swallowed broad
+exceptions, PRNG keys never consumed twice, shared mutable state touched
+only under its lock. Until round 11 those were guarded by a
+hand-maintained regex lint with a pinned module list, so a violation in
+an unpinned module regressed silently. This engine makes the invariants
+*statically checked, repo-wide*:
+
+- a :class:`FileContext` per file: parsed AST, tokenize-accurate
+  comment-stripped code lines, import-alias resolution (``import time as
+  _time`` still resolves to ``time.monotonic``), and abc-lint directives;
+- plugin :class:`Rule` objects produce :class:`Finding` s with
+  ``file:line``, a message, and a fix hint;
+- inline suppressions ``# abc-lint: disable=RULE[,RULE] <reason>`` that
+  REQUIRE a reason (a reasonless suppression is itself a finding);
+- contract directives ``# abc-lint: guarded-by=<lock>`` (field-level,
+  consumed by LOCK001) and ``# abc-lint: holds=<lock>`` (method-level:
+  the caller provides the lock);
+- a committed JSON baseline for grandfathered findings (see
+  :mod:`.baseline`) that may only shrink.
+
+The engine is stdlib-only (``ast`` + ``tokenize``) so it can run at test
+collection time and as a console script in any CI step.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+
+#: directive grammar: everything after the "abc-lint:" marker
+_DIRECTIVE_RE = re.compile(r"#\s*abc-lint:\s*(?P<body>.*)$")
+_DISABLE_RE = re.compile(r"^disable=(?P<rules>[A-Z]+\d+(?:\s*,\s*[A-Z]+\d+)*)"
+                         r"(?P<reason>.*)$")
+_GUARDED_RE = re.compile(r"^guarded-by=(?P<lock>[\w.]+)\s*$")
+_HOLDS_RE = re.compile(r"^holds=(?P<lock>[\w.]+)\s*$")
+
+#: engine-level meta findings
+META_BAD_DIRECTIVE = "LINT001"   # malformed / reasonless directive
+META_PARSE_ERROR = "LINT002"     # file failed to parse
+
+
+@dataclass
+class Suppression:
+    """One ``disable=`` directive, resolved to the code line it covers."""
+
+    target_line: int
+    rules: tuple[str, ...]
+    reason: str
+    comment_line: int
+    used: bool = False
+
+
+@dataclass
+class Finding:
+    """One rule violation (or engine meta-finding) at a source location."""
+
+    rule: str
+    path: str          # repo-relative posix path
+    line: int
+    col: int
+    message: str
+    hint: str = ""
+    code: str = ""     # stripped source text of `line` (baseline identity)
+    status: str = "open"   # open | suppressed | baselined
+    reason: str = ""       # why suppressed / baselined
+
+    def key(self) -> tuple[str, str, str]:
+        """Line-number-free identity used for baseline matching: a
+        baselined finding survives unrelated edits shifting it up or
+        down, but changing the offending line itself re-opens it."""
+        return (self.rule, self.path, self.code)
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule, "path": self.path, "line": self.line,
+            "col": self.col, "message": self.message, "hint": self.hint,
+            "code": self.code, "status": self.status, "reason": self.reason,
+        }
+
+
+class FileContext:
+    """Everything a rule needs to know about one source file."""
+
+    def __init__(self, path: Path, rel: str, source: str | None = None):
+        self.path = Path(path)
+        self.rel = rel
+        self.source = (self.path.read_text() if source is None else source)
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=str(path))
+        #: local name -> canonical dotted module path, from every
+        #: Import/ImportFrom anywhere in the file (scope-insensitive —
+        #: good enough for lint, and it catches function-local imports)
+        self.import_aliases = self._collect_import_aliases(self.tree)
+        self.suppressions: list[Suppression] = []
+        #: lineno -> lock name for `guarded-by=` field declarations
+        self.guarded: dict[int, str] = {}
+        #: lineno -> lock name for `holds=` method contracts
+        self.holds: dict[int, str] = {}
+        self.meta_findings: list[Finding] = []
+        #: comment-stripped source lines (1-based access via code_line())
+        self.code_lines: list[str] = list(self.lines)
+        self._parse_comments()
+
+    # ------------------------------------------------------------ helpers
+    def code_line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.code_lines):
+            return self.code_lines[lineno - 1].strip()
+        return ""
+
+    def raw_line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def dotted_name(self, node: ast.AST) -> str | None:
+        """Canonical dotted path of a Name/Attribute chain with import
+        aliases resolved (``_time.monotonic`` -> ``time.monotonic``,
+        ``datetime.now`` after ``from datetime import datetime`` ->
+        ``datetime.datetime.now``)."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(self.import_aliases.get(node.id, node.id))
+        return ".".join(reversed(parts))
+
+    def find_suppression(self, rule: str, lineno: int) -> Suppression | None:
+        for sup in self.suppressions:
+            if sup.target_line == lineno and rule in sup.rules:
+                sup.used = True
+                return sup
+        return None
+
+    # ------------------------------------------------------- construction
+    @staticmethod
+    def _collect_import_aliases(tree: ast.AST) -> dict[str, str]:
+        aliases: dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                if node.level:      # relative import: not an stdlib alias
+                    continue
+                for a in node.names:
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        return aliases
+
+    def _parse_comments(self) -> None:
+        comments: list[tuple[int, int, str]] = []   # (row, col, text)
+        code_rows: set[int] = set()
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(self.source).readline):
+                if tok.type == tokenize.COMMENT:
+                    comments.append((tok.start[0], tok.start[1], tok.string))
+                    # strip the comment out of the code-line view
+                    row = tok.start[0] - 1
+                    if 0 <= row < len(self.code_lines):
+                        self.code_lines[row] = self.lines[row][: tok.start[1]]
+                elif tok.type not in (tokenize.NL, tokenize.NEWLINE,
+                                      tokenize.INDENT, tokenize.DEDENT,
+                                      tokenize.ENDMARKER):
+                    for row in range(tok.start[0], tok.end[0] + 1):
+                        code_rows.add(row)
+        except tokenize.TokenError:
+            # fall back: treat every line as code, parse comments naively
+            for i, line in enumerate(self.lines, 1):
+                code_rows.add(i)
+                if "#" in line:
+                    col = line.index("#")
+                    comments.append((i, col, line[col:]))
+
+        for row, col, text in comments:
+            m = _DIRECTIVE_RE.search(text)
+            if not m:
+                continue
+            body = m.group("body").strip()
+            target = row if row in code_rows else self._next_code_row(
+                row, code_rows)
+            dm = _DISABLE_RE.match(body)
+            if dm:
+                rules = tuple(r.strip()
+                              for r in dm.group("rules").split(","))
+                reason = dm.group("reason").strip()
+                if not reason:
+                    self.meta_findings.append(Finding(
+                        rule=META_BAD_DIRECTIVE, path=self.rel, line=row,
+                        col=col,
+                        message=(f"suppression of {', '.join(rules)} has no "
+                                 "reason — `# abc-lint: disable=RULE "
+                                 "<why this site is exempt>`"),
+                        hint="every suppression must say why",
+                        code=self.raw_line(row),
+                    ))
+                    continue
+                self.suppressions.append(Suppression(
+                    target_line=target, rules=rules, reason=reason,
+                    comment_line=row,
+                ))
+                continue
+            gm = _GUARDED_RE.match(body)
+            if gm:
+                self.guarded[target] = gm.group("lock").removeprefix("self.")
+                continue
+            hm = _HOLDS_RE.match(body)
+            if hm:
+                self.holds[target] = hm.group("lock").removeprefix("self.")
+                continue
+            self.meta_findings.append(Finding(
+                rule=META_BAD_DIRECTIVE, path=self.rel, line=row, col=col,
+                message=f"unrecognized abc-lint directive: {body!r}",
+                hint="known: disable=RULE <reason> | guarded-by=<lock> | "
+                     "holds=<lock>",
+                code=self.raw_line(row),
+            ))
+
+    @staticmethod
+    def _next_code_row(row: int, code_rows: set[int]) -> int:
+        later = [r for r in code_rows if r > row]
+        return min(later) if later else row
+
+
+class Rule:
+    """Base class for abc-lint rules (subclass per rule id)."""
+
+    name = "RULE000"
+    summary = ""
+    hint = ""
+
+    def applies_to(self, rel: str) -> bool:
+        return True
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        raise NotImplementedError
+
+    # convenience for subclasses
+    def finding(self, ctx: FileContext, node: ast.AST | int, message: str,
+                hint: str | None = None) -> Finding:
+        if isinstance(node, int):
+            line, col = node, 0
+        else:
+            line, col = node.lineno, getattr(node, "col_offset", 0)
+        return Finding(
+            rule=self.name, path=ctx.rel, line=line, col=col,
+            message=message, hint=self.hint if hint is None else hint,
+            code=ctx.raw_line(line),
+        )
+
+
+@dataclass
+class AnalysisResult:
+    """All findings from one run, pre- and post-suppression/baseline."""
+
+    findings: list[Finding] = field(default_factory=list)
+    #: baseline entries that matched no live finding (the baseline may
+    #: only shrink: a fixed finding must leave the baseline file)
+    stale_baseline: list[dict] = field(default_factory=list)
+    files_scanned: int = 0
+
+    @property
+    def open(self) -> list[Finding]:
+        return [f for f in self.findings if f.status == "open"]
+
+    @property
+    def suppressed(self) -> list[Finding]:
+        return [f for f in self.findings if f.status == "suppressed"]
+
+    @property
+    def baselined(self) -> list[Finding]:
+        return [f for f in self.findings if f.status == "baselined"]
+
+    def by_rule(self, status: str | None = None) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for f in self.findings:
+            if status is None or f.status == status:
+                out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+    @property
+    def ok(self) -> bool:
+        return not self.open and not self.stale_baseline
+
+
+def iter_python_files(targets: list[Path]) -> list[Path]:
+    out: list[Path] = []
+    for t in targets:
+        if t.is_dir():
+            out.extend(p for p in sorted(t.rglob("*.py"))
+                       if "__pycache__" not in p.parts)
+        elif t.suffix == ".py":
+            out.append(t)
+    return out
+
+
+def run_analysis(root: Path, files: list[Path], rules: list[Rule],
+                 select: set[str] | None = None,
+                 ignore: set[str] | None = None) -> AnalysisResult:
+    """Run ``rules`` over ``files``; apply inline suppressions.
+
+    Baseline application is a separate step (:func:`.baseline.apply`)
+    so callers can decide whether a baseline participates.
+    """
+    result = AnalysisResult()
+    active = [r for r in rules
+              if (select is None or r.name in select)
+              and (ignore is None or r.name not in ignore)]
+    for path in files:
+        try:
+            rel = path.resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:
+            rel = path.as_posix()
+        try:
+            ctx = FileContext(path, rel)
+        except (SyntaxError, UnicodeDecodeError) as err:
+            result.findings.append(Finding(
+                rule=META_PARSE_ERROR, path=rel,
+                line=getattr(err, "lineno", 1) or 1, col=0,
+                message=f"file failed to parse: {err}",
+            ))
+            continue
+        result.files_scanned += 1
+        # reasonless/malformed directives are findings in their own right
+        # and can NOT be suppressed (a suppression can't excuse itself)
+        result.findings.extend(ctx.meta_findings)
+        for rule in active:
+            if not rule.applies_to(rel):
+                continue
+            for f in rule.check(ctx):
+                sup = ctx.find_suppression(f.rule, f.line)
+                if sup is not None:
+                    f.status = "suppressed"
+                    f.reason = sup.reason
+                result.findings.append(f)
+    return result

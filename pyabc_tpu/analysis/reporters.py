@@ -1,0 +1,50 @@
+"""abc-lint output: text for humans, JSON for CI and tooling."""
+from __future__ import annotations
+
+import json
+
+from .engine import AnalysisResult
+
+
+def format_text(result: AnalysisResult, verbose: bool = False) -> str:
+    lines: list[str] = []
+    for f in sorted(result.open, key=lambda f: (f.path, f.line, f.rule)):
+        lines.append(f"{f.path}:{f.line}:{f.col}: {f.rule} {f.message}")
+        if f.hint:
+            lines.append(f"    hint: {f.hint}")
+    for e in result.stale_baseline:
+        lines.append(
+            f"{e['path']}: STALE baseline entry for {e['rule']} "
+            f"({e['code'][:60]!r}) no longer fires — delete it (the "
+            "baseline only shrinks)")
+    if verbose:
+        for f in sorted(result.suppressed + result.baselined,
+                        key=lambda f: (f.path, f.line)):
+            lines.append(f"{f.path}:{f.line}:{f.col}: {f.rule} "
+                         f"[{f.status}: {f.reason}] {f.message}")
+    counts = result.by_rule("open")
+    summary = ", ".join(f"{k}={v}" for k, v in sorted(counts.items())) \
+        or "none"
+    lines.append(
+        f"abc-lint: {result.files_scanned} files, "
+        f"{len(result.open)} unbaselined finding(s) [{summary}], "
+        f"{len(result.suppressed)} suppressed, "
+        f"{len(result.baselined)} baselined, "
+        f"{len(result.stale_baseline)} stale baseline entr(y/ies)")
+    return "\n".join(lines)
+
+
+def format_json(result: AnalysisResult) -> str:
+    return json.dumps({
+        "files_scanned": result.files_scanned,
+        "open": [f.to_dict() for f in result.open],
+        "suppressed": [f.to_dict() for f in result.suppressed],
+        "baselined": [f.to_dict() for f in result.baselined],
+        "stale_baseline": result.stale_baseline,
+        "counts": {
+            "open_by_rule": result.by_rule("open"),
+            "suppressed_by_rule": result.by_rule("suppressed"),
+            "baselined_by_rule": result.by_rule("baselined"),
+        },
+        "ok": result.ok,
+    }, indent=1)

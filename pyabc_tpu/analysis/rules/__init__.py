@@ -1,0 +1,26 @@
+"""abc-lint rule registry.
+
+Each rule is a plugin over the engine's visitor framework; ``all_rules``
+returns one fresh instance per rule class, in stable id order. To add a
+rule: subclass :class:`pyabc_tpu.analysis.engine.Rule` in a module here,
+give it a unique ``NAMEnnn`` id, and append it to :data:`RULE_CLASSES`
+(README "Static analysis" documents the workflow).
+"""
+from __future__ import annotations
+
+from .clock import Clock001
+from .exceptions import Exc001
+from .locks import Lock001
+from .rng import Rng001
+from .sync import Sync001
+from .telemetry import Telem001
+
+RULE_CLASSES = [Sync001, Clock001, Rng001, Exc001, Lock001, Telem001]
+
+
+def all_rules():
+    return [cls() for cls in RULE_CLASSES]
+
+
+def rule_ids():
+    return [cls.name for cls in RULE_CLASSES]

@@ -1,0 +1,59 @@
+"""CLOCK001 — raw wall-clock reads are banned; use the injected clock.
+
+Every host-side timestamp in the package rides ONE injected clock
+(:mod:`pyabc_tpu.observability.clock`): spans and deadlines survive
+wall-clock steps, worker clock-offset calibration stays meaningful, and
+tests can drive a VirtualClock. Until round 11 this held only for a
+pinned allowlist of instrumented modules; the allowlist now INVERTS —
+the ban is repo-wide and the legal raw reads (the SystemClock
+implementation itself) carry explicit per-site suppressions.
+
+``time.sleep`` stays legal (a delay, not a measurement), as do
+``datetime`` *constructors* and parsing — only reads of "now" are
+clock sources.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileContext, Finding, Rule
+
+#: canonical dotted call paths that read a clock
+BANNED = {
+    "time.time", "time.time_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+    "time.monotonic", "time.monotonic_ns",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today",
+}
+
+
+class Clock001(Rule):
+    name = "CLOCK001"
+    summary = "raw wall-clock read outside the injected-clock discipline"
+    hint = ("route through pyabc_tpu.observability (SYSTEM_CLOCK or the "
+            "component's injected clock): .now() for durations/deadlines, "
+            ".wall() for civil timestamps")
+
+    def applies_to(self, rel: str) -> bool:
+        # repo-wide over the package + the bench harness; profile_gen.py
+        # (offline single-process profiling of its own wall clock) and
+        # the analysis engine itself (names the banned calls as data)
+        # are out of scope
+        if rel.startswith("pyabc_tpu/analysis/"):
+            return False
+        return rel.startswith("pyabc_tpu/") or rel == "bench.py"
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = ctx.dotted_name(node.func)
+            if dotted in BANNED:
+                out.append(self.finding(
+                    ctx, node,
+                    f"raw clock read `{dotted}()` — host time must come "
+                    "from the injected clock",
+                ))
+        return out

@@ -1,0 +1,69 @@
+"""EXC001 — broad exception handlers must not swallow silently.
+
+Round 10's regex lint banned single-line ``except Exception: pass``;
+this AST generalization also catches the multi-line equivalents the
+regex missed: a broad handler (bare ``except:``, ``except Exception``,
+``except BaseException``, or a tuple containing either) whose entire
+body is pass-equivalent — ``pass``, ``...``, a docstring/constant, a
+bare ``return`` (or ``return None``), or ``continue``. A broad handler
+must log, count, re-raise, or otherwise leave a trace; narrow handlers
+(``except FileNotFoundError: pass``) stay legal because suppressing a
+SPECIFIC expected condition is a statement, suppressing everything is a
+hole.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileContext, Finding, Rule
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(type_node: ast.expr | None) -> bool:
+    if type_node is None:
+        return True
+    elts = type_node.elts if isinstance(type_node, ast.Tuple) \
+        else [type_node]
+    return any(isinstance(e, ast.Name) and e.id in _BROAD for e in elts)
+
+
+def _swallows(body: list[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(stmt, ast.Return) and (
+                stmt.value is None
+                or (isinstance(stmt.value, ast.Constant)
+                    and stmt.value.value is None)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                     ast.Constant):
+            continue        # docstring / Ellipsis
+        return False
+    return True
+
+
+class Exc001(Rule):
+    name = "EXC001"
+    summary = "broad exception handler with a pass-equivalent body"
+    hint = ("log, count (metrics.counter(...).inc()), re-raise, or narrow "
+            "the exception type to the specific expected condition")
+
+    def applies_to(self, rel: str) -> bool:
+        return not rel.startswith("pyabc_tpu/analysis/")
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if _is_broad(node.type) and _swallows(node.body):
+                caught = ("bare except" if node.type is None
+                          else ast.unparse(node.type))
+                out.append(self.finding(
+                    ctx, node,
+                    f"broad handler ({caught}) swallows silently — its "
+                    "whole body is pass-equivalent",
+                ))
+        return out

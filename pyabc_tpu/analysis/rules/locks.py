@@ -1,0 +1,148 @@
+"""LOCK001 — guarded-by lock discipline for the threaded seams.
+
+The broker trio, History's async writer, the MetricsRegistry and the
+resilience lease machinery are the repo's race surface (PRs 5/6). This
+rule turns their locking convention into a checked contract: a field
+declared
+
+    self._results = []   # abc-lint: guarded-by=_lock
+
+may only be touched (read OR written) inside a ``with self._lock:``
+block in that class. Exemptions, matching the repo's idiom:
+
+- the declaring method (normally ``__init__`` — construction happens
+  before the object is shared);
+- methods whose name ends in ``_locked`` and methods decorated with
+  ``@_locked`` — the established callers-hold-the-lock conventions
+  (History's decorator, the broker's suffix);
+- methods carrying an explicit ``# abc-lint: holds=<lock>`` directive on
+  their ``def`` line.
+
+Conversely, CALLING a ``self.<...>_locked(...)`` helper outside the lock
+is itself a finding in any class that declares guarded fields — the
+suffix is a contract, not a naming accident. The check is class-internal
+and lexical (aliasing the lock or the object defeats it); it is a lint,
+not a proof, but it catches the realistic regression: a new method
+touching shared state without taking the lock.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileContext, Finding, Rule
+
+
+class Lock001(Rule):
+    name = "LOCK001"
+    summary = "guarded field touched outside its declared lock"
+    hint = ("wrap the access in `with self.<lock>:`, rename the method "
+            "`*_locked` / mark it `# abc-lint: holds=<lock>` if every "
+            "caller already holds it")
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                self._check_class(ctx, node, out)
+        return out
+
+    # ------------------------------------------------------------ internals
+    def _check_class(self, ctx: FileContext, cls: ast.ClassDef,
+                     out: list[Finding]) -> None:
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        # guarded declarations: self-attribute assignments whose line
+        # carries a guarded-by directive
+        guarded: dict[str, str] = {}
+        declared_in: dict[str, str] = {}
+        for meth in methods:
+            for sub in ast.walk(meth):
+                if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                    continue
+                lock = ctx.guarded.get(sub.lineno)
+                if lock is None:
+                    continue
+                targets = (sub.targets if isinstance(sub, ast.Assign)
+                           else [sub.target])
+                for t in targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        guarded[t.attr] = lock
+                        declared_in[t.attr] = meth.name
+        if not guarded:
+            return
+        locks = set(guarded.values())
+        for meth in methods:
+            held: set[str] = set()
+            if meth.name.endswith("_locked"):
+                held |= locks
+            for dec in meth.decorator_list:
+                if isinstance(dec, ast.Name) and dec.id.endswith("_locked"):
+                    held |= locks
+            holds = ctx.holds.get(meth.lineno)
+            if holds:
+                held.add(holds)
+            self._walk(ctx, meth.body, meth, guarded, declared_in, locks,
+                       held, out)
+
+    def _walk(self, ctx: FileContext, stmts: list[ast.stmt],
+              meth: ast.AST, guarded: dict[str, str],
+              declared_in: dict[str, str], locks: set[str],
+              held: set[str], out: list[Finding]) -> None:
+        for stmt in stmts:
+            self._walk_node(ctx, stmt, meth, guarded, declared_in, locks,
+                            held, out)
+
+    def _walk_node(self, ctx: FileContext, node: ast.AST, meth,
+                   guarded, declared_in, locks, held: set[str],
+                   out: list[Finding]) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = set()
+            for item in node.items:
+                try:
+                    text = ast.unparse(item.context_expr)
+                except Exception:  # pragma: no cover
+                    text = ""
+                for lock in locks:
+                    if text == f"self.{lock}" or text == lock \
+                            or text.endswith(f".{lock}"):
+                        acquired.add(lock)
+            inner = held | acquired
+            for item in node.items:
+                self._walk_node(ctx, item.context_expr, meth, guarded,
+                                declared_in, locks, held, out)
+            self._walk(ctx, node.body, meth, guarded, declared_in, locks,
+                       inner, out)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not meth:
+            # nested function: inherits the lexical lock context
+            self._walk(ctx, node.body, meth, guarded, declared_in, locks,
+                       held, out)
+            return
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and node.value.id == "self":
+            attr = node.attr
+            lock = guarded.get(attr)
+            if lock is not None and lock not in held \
+                    and meth.name != declared_in.get(attr):
+                out.append(self.finding(
+                    ctx, node,
+                    f"`self.{attr}` is declared guarded-by={lock} but is "
+                    f"touched in `{meth.name}` outside `with self.{lock}:`",
+                ))
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)\
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == "self" \
+                and node.func.attr.endswith("_locked") \
+                and not (locks & held) \
+                and meth.name != "__init__":
+            out.append(self.finding(
+                ctx, node,
+                f"`self.{node.func.attr}(...)` called from `{meth.name}` "
+                "without the lock its `_locked` suffix promises",
+            ))
+        for child in ast.iter_child_nodes(node):
+            self._walk_node(ctx, child, meth, guarded, declared_in, locks,
+                            held, out)

@@ -1,0 +1,220 @@
+"""RNG001 — a JAX PRNG key must not be consumed by two sampling calls.
+
+JAX keys are pure values: passing the same key to two sampling calls
+yields CORRELATED draws (often identical), the bug class the typed-key
+work in ``DeviceContext._shard_lane_keys`` brushed against. The
+discipline: derive (``jax.random.split`` / ``fold_in``) before every
+additional consumption.
+
+The rule runs a small order-aware dataflow over each function body:
+
+- a *consuming* call is ``jax.random.<sampler>(key, ...)`` whose first
+  positional argument is a plain name, for any sampler other than the
+  derivation/constructor set (``split``, ``fold_in``, ``key``,
+  ``PRNGKey``, ``key_data``, ``wrap_key_data``, ``clone``);
+- any assignment to the name (``key = jax.random.fold_in(key, i)``,
+  tuple unpacking from ``split``, a loop target...) resets it;
+- a second consumption of the same (name, version) is a finding at the
+  second site.
+
+Control flow is handled conservatively: ``if``/``try`` branches are
+walked on state copies and merged keeping the *most-consumed* state
+(a consume on either path arms the check), except that a branch which
+always leaves the scope (guard ``return``/``raise``) contributes
+nothing to the fall-through; loop and comprehension
+bodies are walked twice so a loop that consumes a key it never re-derives
+is caught as cross-iteration reuse. Nested functions are fresh scopes.
+Keys threaded through subscripts/attributes (``keys[i]``,
+``self.key``) are out of scope — the convention is local names.
+"""
+from __future__ import annotations
+
+import ast
+import copy
+
+from ..engine import FileContext, Finding, Rule
+
+#: jax.random members that derive/construct rather than consume
+NONCONSUMING = {"split", "fold_in", "key", "PRNGKey", "key_data",
+                "wrap_key_data", "key_impl", "clone"}
+
+#: state: name -> list of ast.Call nodes that consumed the current
+#: "version" of the name (reset on every assignment)
+_State = dict
+
+
+class Rng001(Rule):
+    name = "RNG001"
+    summary = "PRNG key consumed twice without an intervening split/fold_in"
+    hint = ("derive per-use subkeys: `k1, k2 = jax.random.split(key)` or "
+            "`key = jax.random.fold_in(key, i)` before reusing")
+
+    def applies_to(self, rel: str) -> bool:
+        return not rel.startswith("pyabc_tpu/analysis/")
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        self._ctx = ctx
+        self._findings: list[Finding] = []
+        self._seen: set[tuple[int, int, str]] = set()
+        state: _State = {}
+        self._walk_stmts(ctx.tree.body, state)
+        return self._findings
+
+    # --------------------------------------------------------- statements
+    def _walk_stmts(self, stmts: list[ast.stmt], state: _State) -> None:
+        for stmt in stmts:
+            self._walk_stmt(stmt, state)
+
+    def _walk_stmt(self, stmt: ast.stmt, state: _State) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in stmt.decorator_list:
+                self._walk_expr(d, state)
+            self._walk_stmts(stmt.body, {})     # fresh scope
+            return
+        if isinstance(stmt, ast.ClassDef):
+            self._walk_stmts(stmt.body, {})
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            if getattr(stmt, "value", None) is not None:
+                self._walk_expr(stmt.value, state)
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            for t in targets:
+                self._reset_target(t, state)
+            return
+        if isinstance(stmt, ast.If):
+            self._walk_expr(stmt.test, state)
+            a = copy.deepcopy(state)
+            self._walk_stmts(stmt.body, a)
+            b = copy.deepcopy(state)
+            self._walk_stmts(stmt.orelse, b)
+            # a branch that always leaves the scope (guard return/raise)
+            # contributes nothing to the fall-through state
+            branches = []
+            if not self._terminates(stmt.body):
+                branches.append(a)
+            if not self._terminates(stmt.orelse):
+                branches.append(b)
+            merged = (branches[0] if len(branches) == 1
+                      else self._merge(*branches) if branches
+                      else copy.deepcopy(state))
+            state.clear()
+            state.update(merged)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._walk_expr(stmt.iter, state)
+            for _pass in range(2):       # second pass = next iteration
+                self._reset_target(stmt.target, state)
+                self._walk_stmts(stmt.body, state)
+            self._walk_stmts(stmt.orelse, state)
+            return
+        if isinstance(stmt, ast.While):
+            for _pass in range(2):
+                self._walk_expr(stmt.test, state)
+                self._walk_stmts(stmt.body, state)
+            self._walk_stmts(stmt.orelse, state)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._walk_expr(item.context_expr, state)
+                if item.optional_vars is not None:
+                    self._reset_target(item.optional_vars, state)
+            self._walk_stmts(stmt.body, state)
+            return
+        if isinstance(stmt, ast.Try):
+            self._walk_stmts(stmt.body, state)
+            merged = copy.deepcopy(state)
+            for handler in stmt.handlers:
+                h = copy.deepcopy(state)
+                if handler.name:
+                    h[handler.name] = []
+                self._walk_stmts(handler.body, h)
+                merged = self._merge(merged, h)
+            state.clear()
+            state.update(merged)
+            self._walk_stmts(stmt.orelse, state)
+            self._walk_stmts(stmt.finalbody, state)
+            return
+        # default: evaluate child expressions, then apply any stores
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._walk_expr(child, state)
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+                state[node.id] = []
+
+    # -------------------------------------------------------- expressions
+    def _walk_expr(self, expr: ast.expr, state: _State) -> None:
+        if isinstance(expr, ast.Lambda):
+            return                        # deferred execution, fresh scope
+        if isinstance(expr, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            for gen in expr.generators:
+                self._walk_expr(gen.iter, state)
+                self._reset_target(gen.target, state)
+            for _pass in range(2):        # body may run once per element
+                for gen in expr.generators:
+                    for cond in gen.ifs:
+                        self._walk_expr(cond, state)
+                if isinstance(expr, ast.DictComp):
+                    self._walk_expr(expr.key, state)
+                    self._walk_expr(expr.value, state)
+                else:
+                    self._walk_expr(expr.elt, state)
+            return
+        if isinstance(expr, ast.NamedExpr):
+            self._walk_expr(expr.value, state)
+            self._reset_target(expr.target, state)
+            return
+        if isinstance(expr, ast.Call):
+            for child in ast.iter_child_nodes(expr):
+                if isinstance(child, ast.expr):
+                    self._walk_expr(child, state)
+            self._maybe_consume(expr, state)
+            return
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, ast.expr):
+                self._walk_expr(child, state)
+
+    def _maybe_consume(self, call: ast.Call, state: _State) -> None:
+        dotted = self._ctx.dotted_name(call.func)
+        if not dotted or not dotted.startswith("jax.random."):
+            return
+        if dotted.rsplit(".", 1)[-1] in NONCONSUMING:
+            return
+        if not call.args or not isinstance(call.args[0], ast.Name):
+            return
+        name = call.args[0].id
+        uses = state.setdefault(name, [])
+        if uses:
+            first = uses[0]
+            key = (call.lineno, call.col_offset, name)
+            if key not in self._seen:
+                self._seen.add(key)
+                self._findings.append(self.finding(
+                    self._ctx, call,
+                    f"PRNG key `{name}` already consumed by a sampling "
+                    f"call at line {first.lineno} — reusing it yields "
+                    "correlated draws",
+                ))
+        uses.append(call)
+
+    # ------------------------------------------------------------ helpers
+    @staticmethod
+    def _terminates(stmts: list[ast.stmt]) -> bool:
+        """True when the block always exits the enclosing scope/flow."""
+        return bool(stmts) and isinstance(
+            stmts[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+    def _reset_target(self, target: ast.expr, state: _State) -> None:
+        for node in ast.walk(target):
+            if isinstance(node, ast.Name):
+                state[node.id] = []
+
+    @staticmethod
+    def _merge(a: _State, b: _State) -> _State:
+        out: _State = {}
+        for name in set(a) | set(b):
+            ua, ub = a.get(name, []), b.get(name, [])
+            out[name] = ua if len(ua) >= len(ub) else ub
+        return out

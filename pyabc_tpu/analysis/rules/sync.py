@@ -1,0 +1,137 @@
+"""SYNC001 — blocking device transfers must record into a SyncLedger.
+
+The round-5 measurement that justifies the whole dual-basis accounting
+is ``residual gap ~= n_syncs x ~102 ms tunnel floor`` — which is only an
+*attribution* (not an assumption) if ``syncs_per_run`` is COMPLETE.
+PR 2 wired :class:`~pyabc_tpu.observability.sync.SyncLedger` recording
+into every known blocking call site by hand; this rule makes the
+completeness static: a blocking transfer in a scope with no ledger
+recording is a finding.
+
+Detection:
+
+- always-blocking APIs: ``jax.device_get(...)``,
+  ``jax.block_until_ready(...)``, ``<x>.block_until_ready()``,
+  ``jax.debug.callback(...)`` (host callback = device round trip);
+- host materialization of device-marked values: ``np.asarray(x)`` /
+  ``np.array(x)`` / ``float(x)`` / ``x.item()`` where the argument's
+  source text names a device value (contains ``device`` or a ``_dev``
+  suffix — the repo's naming convention for device-resident handles).
+  Materializing host arrays stays legal.
+
+Ledger evidence is scoped to the nearest enclosing function: some call
+whose form is ``<...ledger...>.record(...)`` (``self.sync_ledger.record``,
+``ledger.record``, ...). Evidence in an OUTER function does not excuse a
+nested closure — thread targets and executor callables fetch on their
+own and must record on their own. Passing ``jax.device_get`` uncalled
+(e.g. ``executor.submit(jax.device_get, tree)``) is not flagged; the
+submitting scope is expected to record, and the fetch-thread sites in
+``inference/smc.py`` do.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..engine import FileContext, Finding, Rule
+
+#: canonical dotted calls that always block on the device
+BLOCKING_CALLS = {"jax.device_get", "jax.block_until_ready",
+                  "jax.debug.callback"}
+#: materializers that block only when fed a device value
+MATERIALIZERS = {"numpy.asarray", "numpy.array"}
+
+_DEV_MARK = re.compile(r"_dev\b|device", re.IGNORECASE)
+
+
+def _device_marked(node: ast.AST) -> bool:
+    try:
+        text = ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse of exotic nodes
+        return False
+    return bool(_DEV_MARK.search(text))
+
+
+class Sync001(Rule):
+    name = "SYNC001"
+    summary = "blocking device transfer with no SyncLedger recording in scope"
+    hint = ("record the round trip (`<...>.sync_ledger.record(kind, "
+            "nbytes)`) in the same function, or suppress with a reason if "
+            "the site is outside run orchestration")
+
+    def applies_to(self, rel: str) -> bool:
+        return not rel.startswith("pyabc_tpu/analysis/")
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        self._scan_scope(ctx, ctx.tree.body, findings, scope_name="<module>")
+        return findings
+
+    # ------------------------------------------------------------ internals
+    def _scan_scope(self, ctx: FileContext, body: list[ast.stmt],
+                    findings: list[Finding], scope_name: str) -> None:
+        """One function (or module) scope: collect this scope's blocking
+        calls and ledger evidence, recursing into nested scopes."""
+        blocking: list[tuple[ast.AST, str]] = []
+        has_ledger = False
+
+        def visit(node: ast.AST) -> None:
+            nonlocal has_ledger
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_scope(ctx, node.body, findings, node.name)
+                for d in node.decorator_list:
+                    visit(d)
+                return
+            if isinstance(node, ast.ClassDef):
+                self._scan_scope(ctx, node.body, findings, node.name)
+                return
+            if isinstance(node, ast.Call):
+                kind = self._blocking_kind(ctx, node)
+                if kind:
+                    blocking.append((node, kind))
+                if self._is_ledger_record(node):
+                    has_ledger = True
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        for stmt in body:
+            visit(stmt)
+
+        if blocking and not has_ledger:
+            for node, kind in blocking:
+                findings.append(self.finding(
+                    ctx, node,
+                    f"{kind} blocks on the device inside `{scope_name}` "
+                    "which never records into a SyncLedger — the sync "
+                    "accounting (syncs_per_run) is incomplete here",
+                ))
+
+    @staticmethod
+    def _is_ledger_record(call: ast.Call) -> bool:
+        func = call.func
+        if not (isinstance(func, ast.Attribute) and func.attr == "record"):
+            return False
+        try:
+            receiver = ast.unparse(func.value)
+        except Exception:  # pragma: no cover
+            return False
+        return "ledger" in receiver.lower()
+
+    def _blocking_kind(self, ctx: FileContext, call: ast.Call) -> str | None:
+        dotted = ctx.dotted_name(call.func)
+        if dotted in BLOCKING_CALLS:
+            return f"`{dotted}(...)`"
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            if func.attr == "block_until_ready":
+                return "`.block_until_ready()`"
+            if (func.attr == "item" and not call.args
+                    and _device_marked(func.value)):
+                return "`.item()` on a device-marked value"
+        if dotted in MATERIALIZERS and call.args \
+                and _device_marked(call.args[0]):
+            return f"`{dotted}()` on a device-marked value"
+        if (isinstance(func, ast.Name) and func.id == "float"
+                and len(call.args) == 1 and _device_marked(call.args[0])):
+            return "`float()` on a device-marked value"
+        return None

@@ -1,0 +1,44 @@
+"""TELEM001 — no ad-hoc telemetry containers outside observability/.
+
+Scatter-shot timing dicts (``phase_timings`` and friends) are how a
+codebase grows three clocks and four span schemas; all measurement goes
+through the named span/metric instruments of
+:mod:`pyabc_tpu.observability` so every datum has one schema, one clock,
+one exporter. Ported from the round-1 regex lint verbatim — this rule
+intentionally scans comment-stripped SOURCE LINES rather than the AST,
+because generated code in string literals (the bench's subprocess
+snippets) runs too and is held to the same bar.
+"""
+from __future__ import annotations
+
+import re
+
+from ..engine import FileContext, Finding, Rule
+
+_AD_HOC = re.compile(
+    r"\b(?:phase|stage|step)_timings?\b|\bspan_math\b|\btelemetry_clock\b"
+)
+
+
+class Telem001(Rule):
+    name = "TELEM001"
+    summary = "ad-hoc telemetry container outside pyabc_tpu/observability/"
+    hint = ("add a named span (tracer.span(...)) or metric instrument "
+            "(metrics.counter/gauge/histogram) instead of a timing dict")
+
+    def applies_to(self, rel: str) -> bool:
+        if rel.startswith(("pyabc_tpu/observability/", "pyabc_tpu/analysis/")):
+            return False
+        return rel.startswith("pyabc_tpu/") or rel in ("bench.py",
+                                                       "profile_gen.py")
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        for lineno in range(1, len(ctx.code_lines) + 1):
+            if _AD_HOC.search(ctx.code_line(lineno)):
+                out.append(self.finding(
+                    ctx, lineno,
+                    "ad-hoc telemetry container — measurement belongs to "
+                    "the observability subsystem",
+                ))
+        return out

@@ -356,7 +356,11 @@ class AdaptivePNormDistance(PNormDistance):
                 jnp.float32,
             )
         out = fn(records.sumstats_dev, records.valid_dev, self._x0_dev)
-        return np.asarray(jax.device_get(out), np.float64)
+        host = np.asarray(jax.device_get(out), np.float64)
+        # this tiny reduced-scale fetch still pays the tunnel floor: count
+        # it through the record ring's ledger into syncs_per_run
+        records.sync_ledger.record("scale_fetch", host.nbytes)
+        return host
 
     def _fit(self, t: int, samples) -> None:
         """weights[t] = 1/scale over the sample matrix (n, S), computed in

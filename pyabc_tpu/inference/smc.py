@@ -621,6 +621,7 @@ class ABCSMC:
                 transition_classes=[type(tr) for tr in self.transitions],
                 mesh=self.mesh,
             )
+            self._device_ctx.sync_ledger = self.sync_ledger
         if reset_t0 is not None:
             from ..observability.metrics import DEVICE_RESETS_TOTAL
 
@@ -1171,6 +1172,9 @@ class ABCSMC:
         self.sampler.tracer = self.tracer
         self.sampler.metrics = self.metrics
         self.sampler.sync_ledger = self.sync_ledger
+        if self._device_ctx is not None:
+            # an adopted/pre-built context records into THIS run's ledger
+            self._device_ctx.sync_ledger = self.sync_ledger
         # fresh health supervision per run: the trail and the rollback
         # budget are run state (resilience/health.py)
         from ..resilience.health import RunSupervisor

@@ -293,6 +293,11 @@ class DeviceContext:
         self.mesh = mesh
         self.d_max = max(m.space.dim for m in self.models)
         self._kernels: dict = {}
+        #: the adopting run's SyncLedger (rebound by ABCSMC.run): the
+        #: blocking fetches below must count into syncs_per_run (SYNC001)
+        from ..observability import NULL_SYNC_LEDGER
+
+        self.sync_ledger = NULL_SYNC_LEDGER
 
     # ------------------------------------------------------------------ build
     @staticmethod
@@ -1557,12 +1562,15 @@ class DeviceContext:
             key, B, mode, dyn, n_cap=n_cap, rec_cap=rec_cap,
             max_rounds=max_rounds, n_target=n_target,
         )
-        return jax.device_get(out)
+        host = jax.device_get(out)
+        self.sync_ledger.record("generation_fetch")
+        return host
 
     # ------------------------------------------------------------- dispatch
     def run_round(self, key, B: int, mode: str, dyn: dict) -> RoundResult:
         out = self.round_kernel(B, mode)(key, dyn)
         out = jax.device_get(out)
+        self.sync_ledger.record("round_fetch")
         return RoundResult(
             ms=np.asarray(out["m"], np.int32),
             thetas=np.asarray(out["theta"], np.float64),
@@ -1601,6 +1609,7 @@ class DeviceContext:
             jax.device_get(model_perturbation_kernel.device_params()),
             np.float64,
         )
+        self.sync_ledger.record("kernel_params_fetch", matrix.nbytes)
         # never-fitted models cannot propose: mask & renormalize rows
         matrix = matrix * fitted[None, :]
         row_sums = matrix.sum(axis=1, keepdims=True)

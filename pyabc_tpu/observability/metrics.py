@@ -226,7 +226,7 @@ class MetricsRegistry:
     def __init__(self, clock: Clock | None = None):
         self.clock = clock if clock is not None else SYSTEM_CLOCK
         self._lock = threading.Lock()
-        self._instruments: dict[str, Counter | Gauge | Histogram] = {}
+        self._instruments: dict[str, Counter | Gauge | Histogram] = {}  # abc-lint: guarded-by=_lock
 
     def _get(self, name: str, cls, help: str, **kwargs):
         with self._lock:

@@ -41,7 +41,7 @@ class SyncLedger:
         self.clock = clock if clock is not None else SYSTEM_CLOCK
         self._lock = threading.Lock()
         #: (ts, kind, nbytes) per sync, in record order
-        self.events: list[tuple[float, str, int]] = []
+        self.events: list[tuple[float, str, int]] = []  # abc-lint: guarded-by=_lock
 
     def record(self, kind: str, nbytes: int = 0) -> None:
         with self._lock:

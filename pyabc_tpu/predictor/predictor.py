@@ -371,17 +371,24 @@ class ModelSelectionPredictor(Predictor):
         n_val = max(int(n * self.split), 1)
         val, train = perm[:n_val], perm[n_val:]
         best, best_mse = None, np.inf
+        skipped: list[str] = []
         for p in self.predictors:
             try:
                 p.fit(x[train], y[train],
                       None if w is None else np.asarray(w)[train])
                 mse = float(np.mean((p.predict(x[val]) - y[val]) ** 2))
-            except Exception:  # singular fits etc.: skip candidate
+            except Exception as err:
+                # singular fits etc. legitimately disqualify a candidate,
+                # but never silently (EXC001): keep the trace for the
+                # all-candidates-failed error below
+                skipped.append(f"{type(p).__name__}: {err!r}")
                 continue
             if mse < best_mse:
                 best, best_mse = p, mse
         if best is None:
-            raise RuntimeError("no predictor could be fit")
+            raise RuntimeError(
+                "no predictor could be fit; candidates failed with: "
+                + "; ".join(skipped))
         best.fit(x, y, w)  # refit the winner on everything
         self.chosen = best
 

@@ -48,12 +48,19 @@ class DeviceRecords:
     ``np.asarray``).
     """
 
-    def __init__(self, sumstats_dev, valid_dev, scale=None):
+    def __init__(self, sumstats_dev, valid_dev, scale=None,
+                 sync_ledger=None):
+        from ..observability import NULL_SYNC_LEDGER
+
         self.sumstats_dev = sumstats_dev
         self.valid_dev = valid_dev
         #: (S,) scale vector precomputed by the in-kernel reduction, if the
         #: active distance registered one (Distance.device_record_reduce)
         self.scale = scale
+        #: the owning run's SyncLedger: the lazy fetches below are blocking
+        #: round trips and must count into syncs_per_run (SYNC001)
+        self.sync_ledger = (sync_ledger if sync_ledger is not None
+                            else NULL_SYNC_LEDGER)
         self._host: np.ndarray | None = None
 
     def to_host(self) -> np.ndarray:
@@ -62,6 +69,8 @@ class DeviceRecords:
             import jax
 
             ss, valid = jax.device_get((self.sumstats_dev, self.valid_dev))
+            self.sync_ledger.record("records_fetch",
+                                    getattr(ss, "nbytes", 0))
             self._host = np.asarray(ss, np.float64)[np.asarray(valid, bool)]
         return self._host
 

@@ -317,6 +317,7 @@ class BatchedSampler(Sampler):
                 sample.device_records = DeviceRecords(
                     rec_dev, out.get("rec_valid_dev", None),
                     scale=out.get("rec_scale"),
+                    sync_ledger=self.sync_ledger,
                 )
                 if prop_kw:
                     sample.all_ms = prop_kw["ms"]

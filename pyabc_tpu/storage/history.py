@@ -323,6 +323,13 @@ class History:
         row = self._conn.execute("SELECT MAX(id) FROM abc_smc").fetchone()
         return row[0]
 
+    def _wall_iso(self) -> str:
+        """Civil timestamp for db rows — from the INJECTED clock
+        (CLOCK001), so VirtualClock-driven tests write deterministic rows
+        and a run never mixes two wall sources."""
+        return datetime.datetime.fromtimestamp(
+            self.tracer.clock.wall()).isoformat()
+
     # ------------------------------------------------------------- creation
     @_locked
     def store_initial_data(self, ground_truth_model: int | None,
@@ -339,7 +346,7 @@ class History:
             "distance_function, epsilon_function, population_strategy) "
             "VALUES (?,?,?,?,?)",
             (
-                datetime.datetime.now().isoformat(),
+                self._wall_iso(),
                 json.dumps(options),
                 distance_function_json,
                 eps_function_json,
@@ -350,7 +357,7 @@ class History:
         cur.execute(
             "INSERT INTO populations (abc_smc_id, t, population_end_time, "
             "nr_samples, epsilon) VALUES (?,?,?,?,?)",
-            (self.id, PRE_TIME, datetime.datetime.now().isoformat(), 0, 0.0),
+            (self.id, PRE_TIME, self._wall_iso(), 0, 0.0),
         )
         pop_id = cur.lastrowid
         gt_m = ground_truth_model if ground_truth_model is not None else 0
@@ -419,7 +426,7 @@ class History:
         cur.execute(
             "INSERT INTO populations (abc_smc_id, t, population_end_time, "
             "nr_samples, epsilon, telemetry) VALUES (?,?,?,?,?,?)",
-            (self.id, int(t), datetime.datetime.now().isoformat(),
+            (self.id, int(t), self._wall_iso(),
              int(nr_simulations), float(current_epsilon),
              json.dumps(telemetry) if telemetry else None),
         )

@@ -1,23 +1,25 @@
 """Repo lint: telemetry stays in the observability subsystem.
 
-Two rules, enforced on source text at collection time:
+Round 11 moved the enforcement onto the ``abc-lint`` AST engine
+(``pyabc_tpu/analysis/`` — see ``tests/test_static_analysis.py`` for the
+engine's own suite and the repo-wide zero-unbaselined gate). This file
+keeps two things:
 
-1. Instrumented modules must not call ``time.time()`` (or
-   ``time.perf_counter()``) directly — all host timing goes through the
-   injected clock (``pyabc_tpu.observability.clock``), so spans and
-   deadlines are immune to wall-clock steps and tests can drive a
-   VirtualClock. Round 8 hardened this for the newly instrumented
-   elastic path: the broker trio (broker/worker/sampler + the wire
-   protocol) is PINNED in the list below — worker-side spans and the
-   NTP-style offset samples are only mergeable because every timestamp
-   on both sides of the wire comes from an injected clock.
-2. No new ``phase_timings``-style ad-hoc telemetry containers outside
-   ``pyabc_tpu/observability/`` — named span/metric instruments replace
-   scatter-shot timing dicts, so every measurement has one schema, one
-   clock, and one exporter.
+1. thin wrappers running the engine's CLOCK001 / TELEM001 / EXC001 rules
+   over the historically pinned surfaces, so the original guarantees
+   keep their own named tests (and their failure messages);
+2. the pin tests VERBATIM: ``INSTRUMENTED`` is no longer what *limits*
+   enforcement (the rules are repo-wide now), but dropping a
+   tracing-critical module, the resilience directory, or the health pair
+   from the pinned list must still fail loudly — the list documents
+   which modules' clocks the span-merge correctness depends on.
 """
-import re
 from pathlib import Path
+
+from pyabc_tpu.analysis import run_analysis
+from pyabc_tpu.analysis.rules.clock import Clock001
+from pyabc_tpu.analysis.rules.exceptions import Exc001
+from pyabc_tpu.analysis.rules.telemetry import Telem001
 
 REPO = Path(__file__).resolve().parent.parent
 
@@ -68,35 +70,39 @@ def _instrumented_files():
         else:
             yield rel, REPO / rel
 
-_TIME_TIME = re.compile(r"\btime\.(?:time|perf_counter)\(")
-_AD_HOC = re.compile(
-    r"\b(?:phase|stage|step)_timings?\b|\bspan_math\b|\btelemetry_clock\b"
-)
 
-
-def _code_lines(path: Path):
-    """(lineno, line) pairs with comments stripped (string-literal
-    timing text, e.g. generated subprocess code, still counts — that
-    code RUNS)."""
-    for i, raw in enumerate(path.read_text().splitlines(), 1):
-        line = raw.split("#", 1)[0]
-        if line.strip():
-            yield i, line
+def _run(rule, paths):
+    return run_analysis(REPO, paths, [rule])
 
 
 def test_instrumented_modules_use_injected_clock():
-    offenders = []
+    """Engine-backed (CLOCK001): the historically pinned modules carry
+    ZERO raw clock reads — not even suppressed ones (suppressions are for
+    the clock implementation itself, which is not on this list)."""
+    paths = []
     for rel, path in _instrumented_files():
         assert path.exists(), f"instrumented module moved: {rel}"
-        for lineno, line in _code_lines(path):
-            if _TIME_TIME.search(line):
-                offenders.append(f"{rel}:{lineno}: {line.strip()}")
+        paths.append(path)
+    res = _run(Clock001(), paths)
+    offenders = [f"{f.path}:{f.line}: {f.code}"
+                 for f in res.findings if f.rule == "CLOCK001"]
     assert not offenders, (
-        "direct time.time()/time.perf_counter() calls in instrumented "
-        "modules (use the observability clock — pyabc_tpu.observability."
-        "SYSTEM_CLOCK or the tracer's injected clock):\n"
-        + "\n".join(offenders)
+        "raw clock reads in instrumented modules (use the observability "
+        "clock — pyabc_tpu.observability.SYSTEM_CLOCK or the tracer's "
+        "injected clock):\n" + "\n".join(offenders)
     )
+
+
+def test_clock_discipline_is_repo_wide():
+    """Round 11: the allowlist inverted. CLOCK001 holds across ALL of
+    pyabc_tpu/ + bench.py; the only legal raw reads are the SystemClock
+    implementation's two, each suppressed with a reason."""
+    from pyabc_tpu.analysis import iter_python_files
+    files = iter_python_files([REPO / "pyabc_tpu", REPO / "bench.py"])
+    res = _run(Clock001(), files)
+    assert res.open == [], [f.to_dict() for f in res.open]
+    assert {f.path for f in res.suppressed} <= {
+        "pyabc_tpu/observability/clock.py"}
 
 
 def test_tracing_critical_modules_stay_pinned():
@@ -139,51 +145,28 @@ def test_health_modules_stay_pinned():
     assert "pyabc_tpu/resilience/health.py" in pinned
 
 
-#: a broad handler whose entire body is `pass`: `except:`,
-#: `except Exception:`, `except BaseException:` (with or without `as e`)
-_BARE_EXCEPT = re.compile(
-    r"^\s*except\s*(?:\(?\s*(?:Exception|BaseException)\s*\)?"
-    r"(?:\s+as\s+\w+)?)?\s*:\s*$"
-)
-
-
 def test_no_swallowed_broad_exceptions():
-    """Repo-wide lint (round 10): no `except Exception: pass` (or bare
-    `except:` / `except BaseException:` with a pass-only body) anywhere
-    in pyabc_tpu/. Silently swallowed errors are exactly the failure
-    mode the health-guard PR exists to eliminate — a broad handler must
-    log, count, re-raise, or otherwise leave a trace. Narrow handlers
-    (`except FileNotFoundError: pass`) stay legal: suppressing a SPECIFIC
-    expected condition is a statement, suppressing everything is a hole."""
-    offenders = []
-    for path in sorted((REPO / "pyabc_tpu").rglob("*.py")):
-        lines = list(_code_lines(path))
-        rel = path.relative_to(REPO)
-        for i, (lineno, line) in enumerate(lines):
-            if not _BARE_EXCEPT.match(line):
-                continue
-            if i + 1 < len(lines) and lines[i + 1][1].strip() == "pass":
-                offenders.append(f"{rel}:{lineno}: {line.strip()} pass")
+    """Engine-backed (EXC001, round 11): the AST form also catches the
+    multi-line swallowing bodies the old regex missed (`continue`, bare
+    `return`). Repo-wide over pyabc_tpu/ with zero open findings."""
+    from pyabc_tpu.analysis import iter_python_files
+    files = iter_python_files([REPO / "pyabc_tpu"])
+    res = _run(Exc001(), files)
+    offenders = [f"{f.path}:{f.line}: {f.code}" for f in res.open]
     assert not offenders, (
-        "broad exception handlers with a pass-only body (log/count/"
+        "broad exception handlers with a pass-equivalent body (log/count/"
         "re-raise instead — swallowed errors are invisible failures):\n"
         + "\n".join(offenders)
     )
 
 
 def test_no_ad_hoc_telemetry_outside_observability():
-    offenders = []
-    for path in sorted((REPO / "pyabc_tpu").rglob("*.py")):
-        if "observability" in path.parts:
-            continue
-        rel = path.relative_to(REPO)
-        for lineno, line in _code_lines(path):
-            if _AD_HOC.search(line):
-                offenders.append(f"{rel}:{lineno}: {line.strip()}")
-    for rel in ("bench.py", "profile_gen.py"):
-        for lineno, line in _code_lines(REPO / rel):
-            if _AD_HOC.search(line):
-                offenders.append(f"{rel}:{lineno}: {line.strip()}")
+    """Engine-backed (TELEM001): named instruments only, repo-wide."""
+    from pyabc_tpu.analysis import iter_python_files
+    files = iter_python_files([REPO / "pyabc_tpu"])
+    files += [REPO / "bench.py", REPO / "profile_gen.py"]
+    res = _run(Telem001(), files)
+    offenders = [f"{f.path}:{f.line}: {f.code}" for f in res.open]
     assert not offenders, (
         "ad-hoc telemetry containers outside pyabc_tpu/observability/ "
         "(add a named span or metric instrument instead):\n"

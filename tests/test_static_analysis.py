@@ -1,0 +1,433 @@
+"""abc-lint engine tests + the repo-wide zero-unbaselined gate.
+
+Three layers:
+
+1. golden fixture snippets per rule — fires / clean / suppressed /
+   baselined — plus engine mechanics (directive targeting, required
+   suppression reasons, import-alias resolution, baseline staleness);
+2. mutation tests against REAL tree files: un-ledgering one real fetch
+   site must make SYNC001 fire, un-splitting a real model's keys must
+   make RNG001 fire — proving the rules bite on production code, not
+   just fixtures;
+3. ``test_repo_is_lint_clean`` — the tier-1 gate: the whole default scan
+   set reports ZERO unbaselined findings against the committed baseline,
+   and the baseline itself is not stale.
+"""
+from pathlib import Path
+
+import pytest
+
+from pyabc_tpu.analysis import (
+    DEFAULT_TARGETS,
+    FileContext,
+    all_rules,
+    baseline,
+    iter_python_files,
+    run_analysis,
+)
+from pyabc_tpu.analysis.cli import main as lint_main
+from pyabc_tpu.analysis.engine import (
+    META_BAD_DIRECTIVE,
+    AnalysisResult,
+    Finding,
+)
+from pyabc_tpu.analysis.rules.clock import Clock001
+from pyabc_tpu.analysis.rules.exceptions import Exc001
+from pyabc_tpu.analysis.rules.locks import Lock001
+from pyabc_tpu.analysis.rules.rng import Rng001
+from pyabc_tpu.analysis.rules.sync import Sync001
+from pyabc_tpu.analysis.rules.telemetry import Telem001
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def check(rule, src, rel="pyabc_tpu/fixture.py"):
+    """Run one rule over an inline snippet; returns (open, suppressed)."""
+    ctx = FileContext(Path(rel), rel, src)
+    findings = []
+    for f in rule.check(ctx):
+        sup = ctx.find_suppression(f.rule, f.line)
+        if sup is not None:
+            f.status, f.reason = "suppressed", sup.reason
+        findings.append(f)
+    return ([f for f in findings if f.status == "open"],
+            [f for f in findings if f.status == "suppressed"])
+
+
+# ---------------------------------------------------------------- SYNC001
+
+SYNC_FIRES = """
+import jax
+def fetch(out):
+    return jax.device_get(out)
+"""
+
+SYNC_CLEAN = """
+import jax
+def fetch(self, out):
+    host = jax.device_get(out)
+    self.sync_ledger.record("chunk_fetch", 128)
+    return host
+"""
+
+SYNC_SUPPRESSED = """
+import jax
+def fetch(out):
+    # abc-lint: disable=SYNC001 standalone probe outside any run
+    return jax.device_get(out)
+"""
+
+
+def test_sync001_fires_on_unledgered_fetch():
+    open_, _ = check(Sync001(), SYNC_FIRES)
+    assert len(open_) == 1 and open_[0].rule == "SYNC001"
+    assert "SyncLedger" in open_[0].message
+
+
+def test_sync001_clean_when_scope_records():
+    open_, _ = check(Sync001(), SYNC_CLEAN)
+    assert open_ == []
+
+
+def test_sync001_suppression_with_reason():
+    open_, sup = check(Sync001(), SYNC_SUPPRESSED)
+    assert open_ == [] and len(sup) == 1
+    assert sup[0].reason == "standalone probe outside any run"
+
+
+def test_sync001_materializers_device_marked_only():
+    src = """
+import numpy as np
+def f(self, rec_dev, host_rows):
+    a = np.asarray(host_rows)          # host value: legal
+    b = np.asarray(rec_dev)            # device-marked: flagged
+    c = float(self.eps_dev)            # device-marked: flagged
+    d = rec_dev.item()                 # device-marked: flagged
+    return a, b, c, d
+"""
+    open_, _ = check(Sync001(), src)
+    assert sorted(f.line for f in open_) == [5, 6, 7]
+
+
+def test_sync001_nested_scope_needs_own_ledger():
+    # ledger evidence in the OUTER function must not excuse a closure
+    src = """
+import jax
+def outer(self, out):
+    self.sync_ledger.record("x")
+    def fetch():
+        return jax.device_get(out)
+    return fetch
+"""
+    open_, _ = check(Sync001(), src)
+    assert len(open_) == 1 and open_[0].line == 6
+
+
+def test_sync001_mutation_unledgering_real_fetch_site_fails():
+    """THE mutation guard: removing the SyncLedger record from a real
+    fetch site in sampler/batched.py must make SYNC001 fire there."""
+    path = REPO / "pyabc_tpu" / "sampler" / "batched.py"
+    src = path.read_text()
+    assert "self.sync_ledger.record" in src
+    rel = "pyabc_tpu/sampler/batched.py"
+    open_, _ = check(Sync001(), src, rel)
+    assert open_ == [], [f.to_dict() for f in open_]
+    mutated = src.replace("self.sync_ledger.record", "self._not_recording")
+    open_m, _ = check(Sync001(), mutated, rel)
+    assert len(open_m) >= 1, (
+        "un-ledgering every record call left SYNC001 silent — the rule "
+        "no longer guards the PR-2 sync accounting")
+
+
+# --------------------------------------------------------------- CLOCK001
+
+def test_clock001_fires_including_aliases():
+    src = """
+import time as _t
+from datetime import datetime as dtt
+def f():
+    a = _t.monotonic()
+    b = dtt.now()
+    return a, b
+"""
+    open_, _ = check(Clock001(), src)
+    assert sorted(f.line for f in open_) == [5, 6]
+
+
+def test_clock001_sleep_and_constructors_legal():
+    src = """
+import time, datetime
+def f():
+    time.sleep(0.1)
+    d = datetime.datetime(2026, 1, 1)
+    return d
+"""
+    open_, _ = check(Clock001(), src)
+    assert open_ == []
+
+
+def test_clock001_scope_excludes_profile_gen():
+    assert not Clock001().applies_to("profile_gen.py")
+    assert Clock001().applies_to("bench.py")
+    assert Clock001().applies_to("pyabc_tpu/sge/sge.py")
+
+
+def test_clock001_suppressed_in_systemclock_only():
+    """The clock implementation's two raw reads are suppressed WITH
+    reasons; repo-wide there are no other CLOCK001 sites."""
+    files = iter_python_files([REPO / "pyabc_tpu", REPO / "bench.py"])
+    res = run_analysis(REPO, files, [Clock001()])
+    assert res.open == [], [f.to_dict() for f in res.open]
+    assert {f.path for f in res.suppressed} == {
+        "pyabc_tpu/observability/clock.py"}
+    assert all(f.reason for f in res.suppressed)
+
+
+# ----------------------------------------------------------------- RNG001
+
+def test_rng001_fires_on_reuse_and_loop_carry():
+    src = """
+import jax
+def bad(key):
+    a = jax.random.normal(key)
+    return a + jax.random.uniform(key)
+def loop_bug(key, xs):
+    tot = 0.0
+    for x in xs:
+        tot += jax.random.normal(key)
+    return tot
+"""
+    open_, _ = check(Rng001(), src)
+    assert len(open_) == 2
+    assert {f.line for f in open_} == {5, 9}
+
+
+def test_rng001_clean_on_split_fold_and_branches():
+    src = """
+import jax
+def split_ok(key):
+    k1, k2 = jax.random.split(key)
+    return jax.random.normal(k1) + jax.random.uniform(k2)
+def fold_ok(key, xs):
+    tot = 0.0
+    for i in range(3):
+        key = jax.random.fold_in(key, i)
+        tot += jax.random.normal(key)
+    return tot
+def branch_ok(key, flag):
+    if flag:
+        return jax.random.normal(key)
+    return jax.random.uniform(key)
+"""
+    open_, _ = check(Rng001(), src)
+    assert open_ == []
+
+
+def test_rng001_mutation_unsplitting_real_model_fails():
+    """models/lotka_volterra.py derives k1/k2 via split; feeding the
+    root key to both noise draws instead must fire RNG001. (The first
+    full-tree run found ZERO real reuse — the split discipline holds —
+    so the real-tree evidence for this rule is this mutation guard.)"""
+    path = REPO / "pyabc_tpu" / "models" / "lotka_volterra.py"
+    src = path.read_text()
+    rel = "pyabc_tpu/models/lotka_volterra.py"
+    open_, _ = check(Rng001(), src, rel)
+    assert open_ == [], [f.to_dict() for f in open_]
+    for frag in ("jax.random.normal(k1, ", "jax.random.normal(k2, "):
+        assert src.count(frag) == 1, frag
+    mutated = (src
+               .replace("jax.random.normal(k1, ", "jax.random.normal(key, ")
+               .replace("jax.random.normal(k2, ", "jax.random.normal(key, "))
+    open_m, _ = check(Rng001(), mutated, rel)
+    assert len(open_m) == 1 and "key" in open_m[0].message
+
+
+# ----------------------------------------------------------------- EXC001
+
+def test_exc001_fires_on_multiline_equivalents():
+    src = """
+def f(xs):
+    for x in xs:
+        try:
+            x()
+        except Exception:
+            continue
+    try:
+        xs[0]()
+    except (ValueError, BaseException):
+        return
+"""
+    open_, _ = check(Exc001(), src)
+    assert len(open_) == 2
+
+
+def test_exc001_narrow_or_traced_handlers_legal():
+    src = """
+def f(x, log):
+    try:
+        x()
+    except FileNotFoundError:
+        pass
+    try:
+        x()
+    except Exception as e:
+        log.warning("boom: %r", e)
+"""
+    open_, _ = check(Exc001(), src)
+    assert open_ == []
+
+
+# ---------------------------------------------------------------- LOCK001
+
+LOCK_SRC = """
+import threading
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []  # abc-lint: guarded-by=_lock
+    def good(self):
+        with self._lock:
+            self._items.append(1)
+    def bad(self):
+        return len(self._items)
+    def _drain_locked(self):
+        self._items.clear()
+    def bad_call(self):
+        self._drain_locked()
+    # abc-lint: holds=_lock
+    def assumed(self):
+        return self._items[0]
+"""
+
+
+def test_lock001_fires_outside_lock_and_on_unlocked_locked_call():
+    open_, _ = check(Lock001(), LOCK_SRC)
+    assert {f.line for f in open_} == {11, 15}
+
+
+def test_lock001_with_block_suffix_and_holds_exempt():
+    open_, _ = check(Lock001(), LOCK_SRC)
+    lines = {f.line for f in open_}
+    # good/with (8-9), _locked suffix body (13), holds directive (18)
+    assert not lines & {8, 9, 13, 18}
+
+
+def test_lock001_real_tree_contracts_hold():
+    """The annotated classes (EvalBroker, SyncLedger, MetricsRegistry)
+    pass their own contracts — the `_touch` -> `_touch_locked` rename
+    was this rule's real-tree fix."""
+    files = [REPO / "pyabc_tpu" / "broker" / "broker.py",
+             REPO / "pyabc_tpu" / "observability" / "sync.py",
+             REPO / "pyabc_tpu" / "observability" / "metrics.py"]
+    res = run_analysis(REPO, files, [Lock001()])
+    assert res.open == [], [f.to_dict() for f in res.open]
+    # and the contracts are actually declared (not silently dropped)
+    broker_src = files[0].read_text()
+    assert broker_src.count("abc-lint: guarded-by=_lock") >= 10
+
+
+# --------------------------------------------------------------- TELEM001
+
+def test_telem001_fires_outside_observability_only():
+    src = "phase_timings = {}\n"
+    open_, _ = check(Telem001(), src, "pyabc_tpu/inference/x.py")
+    assert len(open_) == 1
+    assert not Telem001().applies_to("pyabc_tpu/observability/tracer.py")
+    assert Telem001().applies_to("bench.py")
+
+
+# ----------------------------------------------------- engine mechanics
+
+def test_suppression_without_reason_is_a_finding():
+    src = """
+import jax
+def fetch(out):
+    return jax.device_get(out)  # abc-lint: disable=SYNC001
+"""
+    ctx = FileContext(Path("x.py"), "pyabc_tpu/x.py", src)
+    assert [f.rule for f in ctx.meta_findings] == [META_BAD_DIRECTIVE]
+    # and the finding is NOT suppressed by the reasonless directive
+    open_, sup = check(Sync001(), src)
+    assert len(open_) == 1 and sup == []
+
+
+def test_standalone_comment_targets_next_code_line():
+    src = """
+import jax
+def fetch(out):
+    # abc-lint: disable=SYNC001 probe outside any run
+    return jax.device_get(out)
+"""
+    open_, sup = check(Sync001(), src)
+    assert open_ == [] and len(sup) == 1
+
+
+def test_unknown_directive_is_a_finding():
+    ctx = FileContext(Path("x.py"), "pyabc_tpu/x.py",
+                      "x = 1  # abc-lint: frobnicate=yes\n")
+    assert [f.rule for f in ctx.meta_findings] == [META_BAD_DIRECTIVE]
+
+
+def test_baseline_requires_reasons(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text('{"version": 1, "entries": [{"rule": "SYNC001", '
+                 '"path": "x.py", "code": "y", "reason": "  "}]}')
+    with pytest.raises(baseline.BaselineError):
+        baseline.load(p)
+
+
+def test_baseline_staleness_fails_lint():
+    """A baselined finding that no longer fires must fail: the baseline
+    only shrinks."""
+    res = AnalysisResult(findings=[])
+    baseline.apply(res, [{"rule": "SYNC001", "path": "gone.py",
+                          "code": "jax.device_get(x)", "reason": "old"}])
+    assert res.stale_baseline and not res.ok
+
+
+def test_baseline_matches_by_code_not_line():
+    f = Finding(rule="SYNC001", path="a.py", line=99, col=0, message="m",
+                code="jax.device_get(x)")
+    res = AnalysisResult(findings=[f])
+    baseline.apply(res, [{"rule": "SYNC001", "path": "a.py",
+                          "code": "jax.device_get(x)", "reason": "r"}])
+    assert f.status == "baselined" and res.ok
+
+
+def test_cli_select_ignore_and_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\nt = time.time()\n")
+    # CLOCK001 does not apply outside pyabc_tpu/, so craft a SYNC case
+    bad.write_text("import jax\nx = jax.device_get(1)\n")
+    assert lint_main([str(bad), "--no-baseline"]) == 1
+    assert lint_main([str(bad), "--no-baseline", "--ignore", "SYNC001"]) == 0
+    assert lint_main([str(bad), "--no-baseline", "--select", "EXC001"]) == 0
+    out = capsys.readouterr().out
+    assert "SYNC001" in out
+
+
+def test_cli_json_format(tmp_path, capsys):
+    import json
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\nx = jax.device_get(1)\n")
+    assert lint_main([str(bad), "--no-baseline", "--format", "json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["ok"] is False
+    assert payload["counts"]["open_by_rule"] == {"SYNC001": 1}
+
+
+# ------------------------------------------------------- the tier-1 gate
+
+def test_repo_is_lint_clean():
+    """abc-lint over the whole default scan set: zero unbaselined
+    findings, no stale baseline entries, every suppression/baseline
+    entry carries a reason (enforced at parse/load time)."""
+    targets = [REPO / t for t in DEFAULT_TARGETS]
+    files = iter_python_files([t for t in targets if t.exists()])
+    res = run_analysis(REPO, files, all_rules())
+    entries = baseline.load(REPO / baseline.DEFAULT_BASELINE_NAME)
+    baseline.apply(res, entries)
+    assert res.open == [], "\n".join(
+        f"{f.path}:{f.line}: {f.rule} {f.message}" for f in res.open)
+    assert res.stale_baseline == [], res.stale_baseline
+    assert all(f.reason for f in res.suppressed + res.baselined)
