@@ -1,0 +1,72 @@
+"""DISP001 — chunk dispatch/fetch flows only through the dispatch engine.
+
+Round 12 collapsed ``inference/smc.py``'s three overlapping loops
+(pipelined, fused-chunk + threaded-fetch, async-drain) into the single
+event-driven engine in ``pyabc_tpu/inference/dispatch.py``. The engine's
+invariants — double-buffered speculation, in-order processing with stop
+rollback, and the ``syncs_per_run <= chunks + O(1)`` budget — only hold
+if EVERY device kernel dispatch and packed fetch goes through it. This
+rule makes that structural: a direct call to one of the chunk
+dispatch/fetch kernels (``multigen_kernel`` — the fused G-generation
+program, ``fetch_pack_kernel`` — the compacted device->host fetch,
+``round_kernel`` — a raw proposal round) anywhere in ``pyabc_tpu/``
+outside the engine module (or ``inference/util.py``, where the kernels
+are defined and composed) is a finding, so the three-loop pattern cannot
+silently grow back.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileContext, Finding, Rule
+
+#: the chunk dispatch/fetch surface: invoking any of these IS a device
+#: dispatch (or the paired packed fetch) — the engine's whole job
+KERNEL_CALLS = {"multigen_kernel", "fetch_pack_kernel", "round_kernel"}
+
+#: the engine itself, and the DeviceContext module that defines/composes
+#: the kernels (its internal uses are the kernels' own implementation)
+ALLOWED = {
+    "pyabc_tpu/inference/dispatch.py",
+    "pyabc_tpu/inference/util.py",
+}
+
+
+class Disp001(Rule):
+    name = "DISP001"
+    summary = ("direct chunk-dispatch/fetch kernel call outside the "
+               "dispatch engine")
+    hint = ("route device dispatch/fetch through pyabc_tpu/inference/"
+            "dispatch.py (DispatchEngine / dispatch_speculative_round); "
+            "the engine owns speculation, stop rollback and the sync "
+            "budget — a bypass re-grows the three-loop pattern")
+
+    def applies_to(self, rel: str) -> bool:
+        return (rel.startswith("pyabc_tpu/")
+                and not rel.startswith("pyabc_tpu/analysis/")
+                and rel not in ALLOWED)
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute) \
+                    and func.attr in KERNEL_CALLS:
+                findings.append(self.finding(
+                    ctx, node,
+                    f"`.{func.attr}(...)` dispatches/fetches a device "
+                    f"chunk outside the dispatch engine — every chunk "
+                    f"round trip must flow through "
+                    f"pyabc_tpu/inference/dispatch.py",
+                ))
+            elif isinstance(func, ast.Name) and func.id in KERNEL_CALLS:
+                findings.append(self.finding(
+                    ctx, node,
+                    f"`{func.id}(...)` dispatches/fetches a device "
+                    f"chunk outside the dispatch engine — every chunk "
+                    f"round trip must flow through "
+                    f"pyabc_tpu/inference/dispatch.py",
+                ))
+        return findings

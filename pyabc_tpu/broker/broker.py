@@ -49,8 +49,8 @@ import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
 
-from ..observability import SYSTEM_CLOCK, global_metrics, \
-    register_worker_source
+from ..observability import SYSTEM_CLOCK, dispatch_sources_snapshot, \
+    global_metrics, register_worker_source
 from ..observability.metrics import (
     BATCHES_REDISPATCHED_TOTAL,
     DUPLICATES_DROPPED_TOTAL,
@@ -102,6 +102,11 @@ class BrokerStatus:
     #: broker round trips the workers reported retrying (summed from the
     #: per-worker trace summaries; per-worker counts in ``workers``)
     n_request_retries: int = 0
+    #: dispatch-engine state of fused runs live in THIS process (round
+    #: 12): in-flight chunks, speculative rollbacks, sync budget —
+    #: surfaced in ``abc-manager`` so a mixed elastic+fused orchestrator
+    #: shows both halves of its dispatch health in one place
+    dispatch: list = field(default_factory=list)
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -381,6 +386,7 @@ class EvalBroker:
                     int(info.get("n_retries", 0) or 0)
                     for info in self._workers.values()
                 ),
+                dispatch=dispatch_sources_snapshot(),
             )
 
     def worker_snapshot(self) -> dict:

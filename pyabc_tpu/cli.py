@@ -317,6 +317,20 @@ def manager_cmd(host, port, watch):
                 f"  departed {wid}: reason={info.get('reason')} "
                 f"results={info.get('n_results', 0)}"
             )
+        for eng in getattr(status, "dispatch", None) or []:
+            # fused-run dispatch engines live in the broker's process
+            # (round 12): speculation / rollback / sync-budget health
+            budget = eng.get("sync_budget", {}) or {}
+            click.echo(
+                f"  dispatch: state={eng.get('state')} t={eng.get('t')} "
+                f"in_flight={eng.get('in_flight')}/{eng.get('depth')} "
+                f"chunks={eng.get('chunks_processed')}"
+                f"/{eng.get('chunks_dispatched')} "
+                f"spec_rollbacks={eng.get('speculative_rollbacks')} "
+                f"syncs={budget.get('syncs')}<="
+                f"{budget.get('allowed')} "
+                f"budget_ok={budget.get('ok')}"
+            )
         if not watch:
             break
         _time.sleep(2.0)

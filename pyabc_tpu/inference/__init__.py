@@ -1,3 +1,4 @@
+from .dispatch import DispatchEngine
 from .smc import ABCSMC, GenerationSpec
 from .util import (
     DeviceContext,
@@ -10,7 +11,7 @@ from .util import (
 )
 
 __all__ = [
-    "ABCSMC", "GenerationSpec", "DeviceContext",
+    "ABCSMC", "DispatchEngine", "GenerationSpec", "DeviceContext",
     "create_simulate_function", "generate_valid_proposal",
     "evaluate_proposal", "create_prior_pdf", "create_transition_pdf",
     "create_weight_function",

@@ -1,0 +1,944 @@
+"""One async dispatch engine — double-buffered speculative chunk dispatch.
+
+Until round 12 ``inference/smc.py`` carried THREE overlapping loops that
+each re-implemented the same carry/fetch/stopping machinery: the
+per-generation pipelined loop, the fused-chunk loop with its threaded
+fetch pipeline, and the async-drain tail (a near-verbatim copy of the
+fused processing loop running on a background thread). The round-5..11
+instrumentation (SyncLedger, device-busy pseudo-thread, gap attribution)
+proved the residual dual-basis gap — ~143.7k accepted-particles/sec
+pipeline-full vs ~45.6k strict wall clock — is host orchestration plus
+the ~0.1 s/sync tunnel floor, not device compute. This module is the fix:
+ONE event-driven engine that every device dispatch and fetch flows
+through (abc-lint DISP001 makes that structural — direct
+``multigen_kernel`` / ``fetch_pack_kernel`` / ``round_kernel`` calls
+outside this module are findings).
+
+:class:`DispatchEngine` is a small state machine::
+
+    FILL ──► PROCESS ──► FILL            (steady state: device never idles)
+      │         │
+      │         ├──► RECOVER ──► FILL    (in-kernel health failure:
+      │         │                         rollback + redispatch, PR 5/6)
+      │         ├──► BOUNDARY ──► FILL   (sumstat-refit host boundary)
+      │         └──► STOPPED             (stopping rule hit: speculative
+      │                                   overrun rolled back, unpersisted)
+      └──► DRAIN ──► DONE                (schedule exhausted: the same
+                                          step body on a background thread)
+
+- **Double-buffered speculation**: chunk k+1 is dispatched off chunk k's
+  still-on-device final carry while chunk k's packed fetch is in flight —
+  the device never waits for host turnaround. Up to ``depth`` chunks keep
+  their ``device_get`` running on fetch threads (concurrent fetches
+  pipeline over the tunnel: 4x512KB measured 1.26 s sequential, 0.18 s
+  concurrent).
+- **Stop rollback**: stopping rules and epsilon/temperature adaptation
+  are evaluated from the already-landed packed fetch — no extra blocking
+  syncs. A speculative chunk that overruns a stopping-rule hit is
+  DISCARDED unpersisted (processing is strictly in order and the History
+  writer only ever sees generations below the stop), counted into
+  ``pyabc_tpu_speculative_rollbacks_total`` — a speculative run's History
+  is bit-identical to a non-speculative run's.
+- **Sync budget**: the engine owns the per-run sync budget
+  (``syncs_per_run <= chunks + O(1)``) asserted through
+  :meth:`~pyabc_tpu.observability.sync.SyncLedger.budget_report` and
+  exported as the ``pyabc_tpu_syncs_per_run`` gauge; the bench
+  ``dispatch`` lane regression-guards it.
+- **Engine states, not re-implementations**: the PR-6 health-word
+  supervision (RECOVER), PR-5 mid-chunk checkpointing, and the
+  ``drain_async`` handoff (DRAIN runs the SAME ``_step`` body on the
+  drain thread) are states of this one loop.
+
+The per-generation PIPELINED path (host-adaptive configs that cannot
+chain generations on device) routes through :func:`run_pipelined` /
+:func:`dispatch_speculative_round` below — same module, same rollback
+accounting, so the whole dispatch surface lives behind one door.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from ..observability import register_dispatch_source
+from ..observability.metrics import (
+    SPECULATIVE_ROLLBACKS_TOTAL,
+    SYNCS_PER_RUN_GAUGE,
+)
+
+logger = logging.getLogger("ABC.Dispatch")
+
+#: O(1) allowance of the per-run sync budget: blocking round trips that
+#: are per-RUN, not per-chunk — host calibration collect, an unfused
+#: generation-0 collect + adaptive records/scale fetches, a checkpoint
+#: restore, the final boundary build. Everything else must amortize into
+#: the per-chunk term or the budget report flips to not-ok.
+SYNC_BUDGET_O1 = 8
+
+#: engine states (strings — they ride snapshots/telemetry as-is)
+FILL = "fill"
+PROCESS = "process"
+RECOVER = "recover"
+BOUNDARY = "boundary"
+DRAIN = "drain"
+STOPPED = "stopped"
+DONE = "done"
+
+
+class DispatchEngine:
+    """Event-driven double-buffered chunk dispatch for the fused path.
+
+    Owns every device round trip of a fused run: the multigen kernel
+    build, chunk dispatch (speculative, chained carry-to-carry on
+    device), the packed fetch pipeline, in-order processing, health
+    rollback/redispatch, mid-chunk checkpoints, the drain-async handoff
+    and the per-run sync budget. The ``owner`` (ABCSMC) supplies the
+    STATISTICAL half as hooks: per-chunk host schedules
+    (``chunk_host_args``), carry construction (``rebuild_carry``),
+    generation limits (``g_limit``), chunk processing / host mirroring
+    (``_process_chunk``) and recovery-carry selection.
+    """
+
+    def __init__(self, owner, ctx, *, shapes, kernel_kwargs, g_limit,
+                 chunk_host_args, rebuild_carry, stop, n_of,
+                 sumstat_refit=False, adaptive=False, stochastic=False,
+                 temp_fixed=False, eps_quantile=False, adaptive_n=False,
+                 n_keep=None):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self.owner = owner
+        self.ctx = ctx
+        self.g_limit = g_limit
+        self.chunk_host_args = chunk_host_args
+        self.rebuild_carry = rebuild_carry
+        self.stop = dict(stop)
+        self.n_of = n_of
+        self.sumstat_refit = bool(sumstat_refit)
+        self.adaptive = bool(adaptive)
+        self.stochastic = bool(stochastic)
+        self.temp_fixed = bool(temp_fixed)
+        self.eps_quantile = bool(eps_quantile)
+        self.adaptive_n = bool(adaptive_n)
+        self.n_keep = n_keep
+        self._clock = owner._clock
+        # sumstat_refit mode can't speculate: each next chunk's carry
+        # needs the host predictor refit on the previous chunk's last
+        # population (depth 1, sync)
+        self.depth = 1 if sumstat_refit else max(
+            1, int(owner.fetch_pipeline_depth)
+        )
+        self._executor = (ThreadPoolExecutor(max_workers=self.depth)
+                          if self.depth > 1 else None)
+        self._probe_pool = (ThreadPoolExecutor(max_workers=1)
+                            if owner.compute_probe else None)
+        # the boundary sumstat refit feeds a host KDE fit — keep its wire
+        # format at full precision; every other config narrows (the
+        # device carry chain is f32 either way, so acceptances / epsilon
+        # trail / refits are bit-identical across fetch dtypes)
+        self.fetch_dtype = "float32" if sumstat_refit else owner.fetch_dtype
+        B, n_cap, rec_cap, max_rounds, G = shapes
+        self.G = int(G)
+        # the ONE multigen-kernel build of the run (DISP001: kernel
+        # construction and invocation both live in this module)
+        with owner.tracer.span("kernel.build", G=int(G), B=int(B),
+                               n_cap=int(n_cap)):
+            self.kern = ctx.multigen_kernel(
+                B, n_cap, rec_cap, max_rounds, G, **kernel_kwargs
+            )
+        # even at depth 1 (sync fetch) the NEXT chunk must be dispatched
+        # before fetching the current one — both for the speculative
+        # overlap and because the step loop drains `while pending`
+        self.refill_target = max(self.depth, 2)
+        # ---- engine state
+        self.state = FILL
+        self.pending: list = []   # ((handle, r5_bytes), t, g, carry_ref)
+        self.tail = None          # newest dispatched chunk (carry chain)
+        self.t = 0
+        self.sims_total = 0
+        self.chunk_index = 0
+        self.chunks_dispatched = 0
+        self.chunks_processed = 0
+        self.speculative_rollbacks = 0
+        self.good_carry = None    # (t, carry) newest known-healthy boundary
+        self.drained_async = False
+        self._t_chunk0 = self._clock.now()
+        # weakly registered with the process-wide observability snapshot
+        # (``/api/observability`` "dispatch" block, broker status /
+        # ``abc-manager``) — a collected engine silently drops out
+        register_dispatch_source(self)
+
+    # --------------------------------------------------------------- public
+    def run(self, t0: int, carry0, sims_total: int):
+        """Drive the state machine to DONE (or hand the tail to the
+        DRAIN thread). Returns the owner's History either way — on a
+        drain handoff it is incomplete until ``owner.drain_join()``."""
+        owner = self.owner
+        self.t = t0
+        self.sims_total = int(sims_total)
+        g0 = self.g_limit(t0)
+        self.good_carry = (t0, carry0)
+        owner._final_ck_state = None
+        self._t_chunk0 = self._clock.now()
+        # the FIRST dispatch triggers the multigen kernel's trace/compile
+        # (the dominant dark block on fresh runs, per the first coverage
+        # traces) — span it separately so compile time is attributed
+        with owner.tracer.span("dispatch", first=True, t_first=int(t0)):
+            res = self._dispatch_chunk(carry0, t0, g0)
+        self.pending = [(self._submit(res, t0, g0), t0, g0, res["carry"])]
+        self.tail = (res, t0, g0)
+        try:
+            while self.pending:
+                dispatch_s = self._refill()
+                if self._maybe_drain_handoff():
+                    return owner.history
+                outcome = self._process_next(dispatch_s)
+                if not self._after_process(outcome):
+                    break
+        finally:
+            # on a drain-async handoff the drain thread owns the pools
+            if not self.drained_async:
+                self._shutdown_pools()
+        self._complete()
+        return owner.history
+
+    def snapshot(self) -> dict:
+        """JSON-ready engine state for the observability snapshot."""
+        return {
+            "state": self.state,
+            "t": int(self.t),
+            "in_flight": len(self.pending),
+            "depth": int(self.depth),
+            "chunks_dispatched": int(self.chunks_dispatched),
+            "chunks_processed": int(self.chunks_processed),
+            "speculative_rollbacks": int(self.speculative_rollbacks),
+            "sync_budget": self.sync_budget_report(),
+        }
+
+    def sync_budget_report(self) -> dict:
+        """The per-run sync budget, asserted through the SyncLedger:
+        ``syncs_per_run <= chunks + O(1)`` — each PROCESSED chunk pays
+        exactly one packed fetch; compute probes (one per DISPATCHED
+        chunk, opt-in) and checkpoint fetches (one per
+        ``checkpoint_every`` processed chunks) are declared per-chunk
+        terms, everything else must fit the O(1) allowance."""
+        owner = self.owner
+        per_chunk_allowance = self.chunks_processed
+        if owner.compute_probe:
+            per_chunk_allowance += self.chunks_dispatched
+        if owner._checkpoint is not None and not self.sumstat_refit:
+            per_chunk_allowance += (
+                self.chunks_processed // max(owner.checkpoint_every, 1) + 1
+            )
+        return owner.sync_ledger.budget_report(
+            chunks=self.chunks_processed,
+            allowed=per_chunk_allowance + SYNC_BUDGET_O1,
+        )
+
+    # ----------------------------------------------------- dispatch / fetch
+    def _dispatch_chunk(self, carry, t_at: int, g_limit: int):
+        """Enqueue one chunk (async). ``carry`` is either the host-built
+        initial carry or the PREVIOUS chunk's on-device final carry —
+        chaining device-to-device lets chunk k+1 compute while chunk
+        k's outputs are still being fetched/persisted."""
+        import jax.numpy as jnp
+
+        # resilience fault site (round 10): numeric CORRUPTION of the
+        # dispatched chunk's input carry — silent NaN/cov/weight poison
+        # that never raises, exactly what the in-kernel health word
+        # exists to catch. The clean carry ref stays untouched (rollback
+        # reuses it); the poison is traceable jnp ops riding the normal
+        # dispatch, no sync.
+        from ..resilience.faults import maybe_corrupt
+
+        kind = maybe_corrupt("device.carry", t=int(t_at))
+        if kind is not None:
+            from ..ops.health import poison_carry
+
+            logger.warning(
+                "injected carry corruption %r at t=%d", kind, t_at
+            )
+            carry = poison_carry(carry, kind)
+        host = self.chunk_host_args(t_at, g_limit)
+        self.chunks_dispatched += 1
+        return self.kern(
+            self.owner._root_key, jnp.asarray(t_at, jnp.int32),
+            jnp.asarray(host["n_sched"]),
+            jnp.asarray(g_limit, jnp.int32), carry,
+            jnp.asarray(
+                self.owner.model_perturbation_kernel.device_params()),
+            jnp.asarray(host["eps_fixed"]),
+            jnp.asarray(self.stop["minimum_epsilon"], jnp.float32),
+            jnp.asarray(self.stop["min_acceptance_rate"], jnp.float32),
+            host["dist_sched"],
+            host["fold_sched"],
+        )
+
+    def _fetch_tree(self, res_i, t_at: int, g_lim: int):
+        """Device-side fetch compaction (ops/pack.py): theta / distance /
+        log_weight collapse into ONE narrowed-dtype row buffer sliced to
+        the scheduled population, slot is elided (the reservoir is
+        slot-ordered by construction), m ships only for K > 1, and
+        per-particle sum stats — the dominant payload when retained
+        (~70%) — ship only for generations History persists
+        (sumstat-refit mode additionally needs the chunk's FINAL
+        generation for the boundary refit)."""
+        import jax
+
+        owner = self.owner
+        outs = res_i["outs"]
+        ss_wanted = [
+            (self.sumstat_refit and g == g_lim - 1)
+            or owner.history.wants_sum_stats(t_at + g)
+            for g in range(g_lim)
+        ]
+        ss_gens = ("all" if all(ss_wanted)
+                   else tuple(g for g in range(g_lim) if ss_wanted[g]))
+        tree = self.ctx.fetch_pack_kernel(
+            n_keep=self.n_keep, dtype_name=self.fetch_dtype,
+            keep_m=owner.K > 1, ss_gens=ss_gens, g_keep=int(g_lim),
+        )(outs)
+        if "calib" in res_i and t_at == 0:
+            # the run-starting chunk carries the in-kernel calibration's
+            # initial weights / eps_0 for host mirroring
+            tree["__calib__"] = res_i["calib"]
+        # what the round-5 full-f32-ring fetch would have moved for this
+        # chunk (aval-level .nbytes — no device op): the compaction
+        # ratio ships with each chunk event so payload reduction is a
+        # regression-guarded metric, not a one-off
+        r5_bytes = sum(
+            x.nbytes for x in jax.tree.leaves(
+                {k: v for k, v in outs.items() if k != "sumstats"}
+            )
+        )
+        if ss_gens == "all":
+            r5_bytes += outs["sumstats"].nbytes
+        else:
+            r5_bytes += (
+                outs["sumstats"].nbytes // outs["sumstats"].shape[0]
+            ) * len(ss_gens)
+        return tree, r5_bytes
+
+    def _unpack_fetched(self, fetched):
+        """Host-side inverse of the pack kernel: restore the legacy
+        per-leaf layout (upcast — the narrowing lives on the wire only)
+        and reconstruct the elided leaves."""
+        from ..ops.pack import unpack_rows
+
+        rows = fetched.pop("rows")
+        theta, dist, log_w = unpack_rows(rows, self.ctx.d_max)
+        fetched["theta"] = theta
+        fetched["distance"] = dist
+        fetched["log_weight"] = log_w
+        gn = rows.shape[:2]
+        if "m" in fetched:
+            fetched["m"] = np.asarray(fetched["m"], np.int32)
+        else:
+            fetched["m"] = np.zeros(gn, np.int32)
+        # the reservoir is written in slot order, so arange is the
+        # identity the argsort-by-proposal-id trim expects
+        fetched["slot"] = np.broadcast_to(
+            np.arange(gn[1], dtype=np.int32), gn
+        )
+        if "sumstats" in fetched:
+            fetched["sumstats"] = np.asarray(
+                fetched["sumstats"], np.float32
+            )
+        return fetched
+
+    def _probe(self, out, disp_ts: float) -> None:
+        import jax
+
+        jax.block_until_ready(out)
+        self.owner.sync_ledger.record("compute_probe")
+        self.owner.probe_events.append((disp_ts, self._clock.now()))
+
+    def _submit(self, res_i, t_at: int, g_lim: int):
+        import jax
+
+        if self._probe_pool is not None:
+            self._probe_pool.submit(self._probe, res_i["outs"]["gen_ok"],
+                                    self._clock.now())
+        tree, r5_bytes = self._fetch_tree(res_i, t_at, g_lim)
+        if self._executor is None:
+            return tree, r5_bytes  # fetched synchronously at pop time
+        return self._executor.submit(jax.device_get, tree), r5_bytes
+
+    # ------------------------------------------------------------ the loop
+    def _refill(self) -> float:
+        """FILL: keep the device fed — dispatch speculative chunks off
+        the newest on-device carry and start their fetches, up to
+        ``depth`` in flight. Returns the dispatch wall share."""
+        self.state = FILL
+        t_disp0 = self._clock.now()
+        with self.owner.tracer.span("dispatch"):
+            while (not self.sumstat_refit
+                   and len(self.pending) < self.refill_target):
+                lr, lt, lg = self.tail
+                g_next = self.g_limit(lt + lg)
+                if g_next <= 0:
+                    break
+                nxt = self._dispatch_chunk(lr["carry"], lt + lg, g_next)
+                self.tail = (nxt, lt + lg, g_next)
+                self.pending.append((self._submit(nxt, lt + lg, g_next),
+                                     lt + lg, g_next, nxt["carry"]))
+        return self._clock.now() - t_disp0
+
+    def _maybe_drain_handoff(self) -> bool:
+        """DRAIN handoff: schedule exhausted and the owner asked for
+        ``drain_async`` — everything left is fetch+persist with no
+        successor compute to hide behind, so the SAME step body keeps
+        running on a background thread while the caller's next work
+        overlaps the latency."""
+        owner = self.owner
+        if not (owner.drain_async and not self.sumstat_refit
+                and self.chunk_index >= 1 and self.pending
+                and self.g_limit(self.tail[1] + self.tail[2]) <= 0):
+            return False
+        import threading
+
+        self.state = DRAIN
+        owner._drain_error = None
+        owner._drain_thread = threading.Thread(
+            target=self._drain_loop, daemon=True, name="pyabc-tpu-drain",
+        )
+        owner._drain_thread.start()
+        self.drained_async = True
+        return True
+
+    def _drain_loop(self) -> None:
+        """The DRAIN state: the foreground loop's step body, verbatim, on
+        the drain thread (only one of the two ever runs — the handoff is
+        the foreground's last act, so the engine state is safe)."""
+        owner = self.owner
+        try:
+            try:
+                while self.pending:
+                    (stop, last_pop, *_rest,
+                     health_fail) = self._process_next(0.0)
+                    if last_pop is not None:
+                        owner._mirror_chunk_fit(last_pop)
+                    if health_fail is not None:
+                        # the generation schedule already ended: no
+                        # redispatch can recover this — record the event
+                        # and surface a typed failure through
+                        # drain_join() instead of a silent partial db
+                        from ..resilience.health import DegenerateRunError
+
+                        owner.health_supervisor.on_failure(
+                            health_fail["t"], health_fail["word"],
+                            ess=health_fail.get("ess"),
+                            acc_rate=health_fail.get("acc_rate"),
+                            eps=health_fail.get("eps"),
+                        )
+                        raise DegenerateRunError(
+                            f"in-kernel health failure at "
+                            f"t={health_fail['t']} during the async "
+                            f"drain (schedule exhausted, no redispatch "
+                            f"possible)",
+                            owner.health_supervisor.trail,
+                        )
+                    if stop:
+                        self._discard_speculative("stopping_rule")
+                        break
+            finally:
+                self._shutdown_pools()
+            self._complete()
+        except BaseException as exc:  # surfaced by drain_join()
+            owner._drain_error = exc
+            try:
+                owner.history.flush()
+            except Exception:
+                logger.exception(
+                    "async history writer also failed while draining"
+                )
+
+    def _process_next(self, dispatch_s: float):
+        """PROCESS: fetch + host-process the oldest pending chunk (shared
+        by the foreground loop and the DRAIN thread)."""
+        import jax
+
+        owner = self.owner
+        clk = self._clock.now
+        self.state = PROCESS
+        # resilience fault site: an injected orchestrator kill lands
+        # HERE — after dispatch, before the chunk's results are
+        # processed/persisted — the worst spot for generation-granularity
+        # resume and exactly what the mid-chunk checkpoint heals
+        from ..resilience.faults import maybe_fault
+
+        maybe_fault("orchestrator.chunk", chunk_index=self.chunk_index)
+        (handle, r5_bytes), t_at, g_lim, carry_ref = self.pending.pop(0)
+        logger.info("t: %d..%d (fused chunk of %d)", t_at,
+                    t_at + g_lim - 1, g_lim)
+        with owner.tracer.span("chunk", t_first=int(t_at),
+                               gens=int(g_lim)) as c_span:
+            t_fetch0 = clk()
+            with owner.tracer.span("fetch", t_first=int(t_at)):
+                fetched = (handle.result() if self._executor is not None
+                           else jax.device_get(handle))
+            now = clk()
+            fetch_s = now - t_fetch0   # EXPOSED wait (latency pipelined)
+            chunk_s = now - self._t_chunk0  # pipeline period
+            self._t_chunk0 = now
+            # measured wire payload of this chunk (post-compaction);
+            # feeds the bench's fetch_bytes_per_chunk regression metric
+            fetch_bytes = sum(
+                int(np.asarray(leaf).nbytes)
+                for leaf in jax.tree.leaves(fetched)
+            )
+            owner.sync_ledger.record("chunk_fetch", fetch_bytes)
+            ss_rows = fetched.pop("__ss_rows__", None)
+            if ss_rows is not None:
+                ss_rows = {
+                    g: np.asarray(v, np.float32)
+                    for g, v in ss_rows.items()
+                }
+            elif "sumstats" not in fetched:
+                # no generation of this chunk retains sum stats: the
+                # pack kernel shipped none at all
+                ss_rows = {}
+            calib = fetched.pop("__calib__", None)
+            fetched = self._unpack_fetched(fetched)
+            if calib is not None:
+                owner._mirror_fused_calibration(calib)
+            mem_telemetry = owner._device_memory_telemetry()
+            self.chunk_index += 1
+            self.chunks_processed += 1
+            t_proc0 = clk()
+            s = self.stop
+            with owner.tracer.span("process", t_first=int(t_at)):
+                (stop, last_pop, last_sample, last_eps, last_acc_rate,
+                 self.t, self.sims_total, n_acc_chunk, g_done,
+                 health_fail) = owner._process_chunk(
+                    fetched, ss_rows, self.t, g_lim, self.n_of,
+                    self.adaptive_n, self.adaptive, self.stochastic,
+                    self.temp_fixed, self.eps_quantile,
+                    self.sumstat_refit, self.chunk_index, chunk_s,
+                    dispatch_s, fetch_s, self.depth, mem_telemetry,
+                    self.sims_total, s["minimum_epsilon"],
+                    s["max_nr_populations"], s["min_acceptance_rate"],
+                    s["max_total_nr_simulations"], s["max_walltime"],
+                    s["start_walltime"],
+                )
+            c_span.set(chunk_index=int(self.chunk_index),
+                       n_acc=int(n_acc_chunk), g_done=int(g_done),
+                       chunk_s=round(float(chunk_s), 6),
+                       fetch_s=round(float(fetch_s), 6),
+                       dispatch_s=round(float(dispatch_s), 6))
+            owner.metrics.histogram(
+                "pyabc_tpu_chunk_fetch_seconds",
+                "exposed device->host fetch wait per fused chunk",
+            ).observe(float(fetch_s))
+            owner.metrics.histogram(
+                "pyabc_tpu_chunk_fetch_bytes",
+                "device->host wire payload per fused chunk "
+                "(post-compaction)",
+            ).observe(float(fetch_bytes))
+            owner.metrics.counter(
+                "pyabc_tpu_particles_accepted",
+                "accepted particles across fused chunks",
+            ).inc(int(n_acc_chunk))
+        if health_fail is None and not stop and g_done == g_lim:
+            # the chunk boundary is known-healthy: it becomes the
+            # supervisor's rollback target and the graceful-shutdown
+            # final-checkpoint state
+            self.good_carry = (self.t, carry_ref)
+            if not self.sumstat_refit:
+                owner._final_ck_state = (carry_ref, self.t,
+                                         self.sims_total,
+                                         self.chunk_index)
+        if (owner._checkpoint is not None and not self.sumstat_refit
+                and health_fail is None
+                and not stop and g_done == g_lim
+                and self.chunk_index % owner.checkpoint_every == 0):
+            # persist the chunk's final device carry (flush-first: the
+            # db stays at-or-ahead of the checkpoint). sumstat-refit
+            # mode is excluded — its carry is rebuilt host-side at every
+            # chunk boundary, so the device carry is not the resume
+            # state there (README documents the deviation).
+            try:
+                owner._save_fused_checkpoint(
+                    carry_ref, self.t, self.sims_total, self.chunk_index
+                )
+            except Exception:
+                # a failed checkpoint degrades durability, never the run
+                logger.exception(
+                    "fused checkpoint save failed (run continues)"
+                )
+        if owner.chunk_event_cb is not None:
+            try:
+                ev = {
+                    "ts": clk(), "t_first": int(t_at),
+                    "gens": int(g_done), "n_acc": int(n_acc_chunk),
+                    "chunk_index": int(self.chunk_index),
+                    "chunk_s": float(chunk_s),
+                    "fetch_s": float(fetch_s),
+                    "fetch_bytes": int(fetch_bytes),
+                    "fetch_bytes_full_f32": int(r5_bytes),
+                    "dispatch_s": float(dispatch_s),
+                    "process_s": float(clk() - t_proc0),
+                }
+                if "refit" in fetched and g_done > 0:
+                    # refit-cadence telemetry rides the chunk events so
+                    # the bench's scale lane can report refits_per_run
+                    # without touching the History
+                    ev["refits"] = int(
+                        np.asarray(fetched["refit"])[:g_done].sum())
+                    ev["drift_last"] = float(
+                        np.asarray(fetched["drift"])[g_done - 1])
+                self.owner.chunk_event_cb(ev)
+            except Exception:
+                logger.exception("chunk_event_cb failed")
+        return (stop, last_pop, last_sample, last_eps, last_acc_rate,
+                t_at, g_lim, health_fail)
+
+    def _after_process(self, outcome) -> bool:
+        """Route the processed chunk's outcome to the next state.
+        Returns False to leave the loop (STOPPED / schedule done)."""
+        (stop, last_pop, last_sample, last_eps, last_acc_rate,
+         t_at, g_lim, health_fail) = outcome
+        owner = self.owner
+        if health_fail is not None:
+            self._recover(health_fail, last_pop)
+            return bool(self.pending)
+        continuing = (not stop and last_pop is not None
+                      and (self.pending
+                           or self.g_limit(t_at + g_lim) > 0))
+        if last_pop is not None \
+                and not (continuing and self.sumstat_refit):
+            # (the sumstat-refit continue path fits these inside
+            # _adapt_components below — don't pay the KDE fit twice)
+            owner._mirror_chunk_fit(last_pop)
+        if not continuing:
+            self.state = STOPPED
+            if stop:
+                # speculative chunks dispatched past the stopping-rule
+                # hit roll back: strictly-in-order processing means
+                # nothing of theirs was persisted or mirrored — discard
+                # them unfetched and count the rollback
+                self._discard_speculative("stopping_rule")
+            return False
+        if self.sumstat_refit:
+            self._boundary_refit(last_sample, last_pop, last_eps,
+                                 last_acc_rate)
+        return True
+
+    def _recover(self, health_fail: dict, last_pop) -> None:
+        """RECOVER: in-kernel health failure — abort the chunk (nothing
+        at/past the failed generation was persisted), let the supervisor
+        decide — it raises a typed DegenerateRunError for terminal
+        conditions — then roll the carry back and redispatch from the
+        failed generation. Speculative chunks dispatched off the
+        degraded carry are discarded with it."""
+        owner = self.owner
+        self.state = RECOVER
+        t_fail = health_fail["t"]
+        t_detect = self._clock.now()
+        if last_pop is not None:
+            # host proposal state now reflects t_fail - 1 — the state a
+            # host carry rebuild fits from
+            owner._mirror_chunk_fit(last_pop)
+        action = owner.health_supervisor.on_failure(
+            t_fail, health_fail["word"],
+            ess=health_fail.get("ess"),
+            acc_rate=health_fail.get("acc_rate"),
+            eps=health_fail.get("eps"),
+            chunk_index=self.chunk_index,
+        )
+        self._discard_speculative("health_rollback")
+        carry_rb, source = owner._health_recovery_carry(
+            action, t_fail, self.good_carry, self.rebuild_carry,
+        )
+        g_next = self.g_limit(t_fail)
+        if g_next <= 0:
+            return
+        logger.warning(
+            "health recovery at t=%d: %s from %s (kinds=%s)",
+            t_fail, action, source,
+            owner.health_supervisor.trail[-1]["kinds"],
+        )
+        with owner.tracer.span("dispatch", recovery=True,
+                               t_first=int(t_fail)):
+            res = self._dispatch_chunk(carry_rb, t_fail, g_next)
+        self.pending[:] = [(self._submit(res, t_fail, g_next), t_fail,
+                            g_next, res["carry"])]
+        self.tail = (res, t_fail, g_next)
+        owner.health_supervisor.note_recovered(
+            t_fail, action, source, t_detect)
+
+    def _boundary_refit(self, last_sample, last_pop, last_eps,
+                        last_acc_rate) -> None:
+        """BOUNDARY: host boundary adaptation for sumstat-refit mode —
+        refit the learned statistics on this chunk's final population,
+        refit the scale weights in the NEW feature space and re-derive
+        the epsilon under the updated distance (the per-generation
+        _adapt_components semantics applied at chunk granularity), then
+        dispatch the next chunk off a fresh host-built carry."""
+        owner = self.owner
+        self.state = BOUNDARY
+        # Declared deviation: the boundary scale refit sees the ACCEPTED
+        # population only (the reference's all_particles=False
+        # convention) — the all-evaluations ring stays on device;
+        # in-chunk refits use the full ring.
+        owner._adapt_components(self.t - 1, last_sample, last_pop,
+                                last_eps, last_acc_rate)
+        # the boundary refit DID run: flag it for resume's epsilon-trail
+        # replay (flush first — the row may still be queued on the
+        # writer thread, and update_telemetry skips missing rows)
+        owner.history.flush()
+        owner.history.update_telemetry(
+            self.t - 1, {"distance_changed": True}
+        )
+        g_next = self.g_limit(self.t)
+        res = self._dispatch_chunk(self.rebuild_carry(self.t), self.t,
+                                   g_next)
+        self.pending = [(self._submit(res, self.t, g_next), self.t,
+                         g_next, res["carry"])]
+        self.tail = (res, self.t, g_next)
+
+    # ------------------------------------------------------------- teardown
+    def _discard_speculative(self, reason: str) -> None:
+        """Roll back in-flight speculative chunks: they were dispatched
+        past a stopping-rule hit (or off a degraded carry) and nothing of
+        theirs may persist — in-order processing guarantees nothing has,
+        so the rollback is a discard, counted so the bench can guard it."""
+        n = len(self.pending)
+        if n == 0:
+            return
+        self.pending.clear()
+        self.speculative_rollbacks += n
+        from ..observability import global_metrics
+
+        for reg in (self.owner.metrics, global_metrics()):
+            reg.counter(
+                SPECULATIVE_ROLLBACKS_TOTAL,
+                "speculative chunks rolled back unpersisted (dispatched "
+                "past a stopping-rule hit or health failure)",
+            ).inc(n)
+        self.owner.tracer.record_span(
+            "rollback.speculative", self._clock.now(), self._clock.now(),
+            thread="dispatch", n=int(n), reason=reason,
+        )
+        logger.info(
+            "rolled back %d speculative chunk(s) (%s) — nothing past "
+            "the stop persists", n, reason,
+        )
+
+    def _shutdown_pools(self) -> None:
+        if self._executor is not None:
+            self._executor.shutdown(wait=True, cancel_futures=True)
+        if self._probe_pool is not None:
+            self._probe_pool.shutdown(wait=True)
+
+    def _complete(self) -> None:
+        """DONE: close out the run — History done, stale checkpoint
+        cleared, sync budget asserted and exported."""
+        owner = self.owner
+        self.state = DONE
+        owner.history.done()
+        if owner._checkpoint is not None:
+            # clean completion: the History holds everything; a stale
+            # checkpoint must not shadow a future run
+            owner._checkpoint.clear()
+        report = self.sync_budget_report()
+        from ..observability import global_metrics
+
+        for reg in (owner.metrics, global_metrics()):
+            # the run registry AND the process-wide one: the dashboard's
+            # /api/observability and the broker-status path read the
+            # global registry even when the run uses its own
+            reg.gauge(
+                SYNCS_PER_RUN_GAUGE,
+                "blocking device round trips of the last completed run "
+                "(budget: chunks + O(1))",
+            ).set(float(report["syncs"]))
+        if not report["ok"]:
+            # the budget is an invariant of this engine's design: a
+            # violation means a new blocking round trip crept into the
+            # per-chunk path — loud by default, fatal under the strict
+            # gate (bench dispatch lane, tests)
+            import os
+
+            msg = (f"sync budget exceeded: {report['syncs']} syncs for "
+                   f"{report['chunks']} chunks "
+                   f"(allowed {report['allowed']}; by_kind="
+                   f"{owner.sync_ledger.by_kind()})")
+            if os.environ.get("PYABC_TPU_SYNC_BUDGET_STRICT"):
+                raise RuntimeError(msg)
+            logger.warning(msg)
+
+
+# --------------------------------------------------------------------------
+# The per-generation PIPELINED path (host-adaptive configs): generation
+# t+1 is DISPATCHED to the device as soon as the adaptive components are
+# refit on generation t's final results; the host then persists
+# generation t to the History while the device is already simulating
+# t+1. Proposals always use FINAL generation-t weights, so the run is
+# statistically identical to the serial loop — no preliminary-weight
+# correction is needed; only host-side persistence/analysis overlaps.
+# --------------------------------------------------------------------------
+
+def dispatch_speculative_round(abc, t_next: int, n_estimate: int):
+    """Enqueue ONE eps=+inf proposal round for generation t_next off the
+    just-refit transitions (async; the host continues adapting). The
+    delayed acceptance (``abc._speculative_accept``) is applied once the
+    strategy updates fixed the generation's threshold/temperature."""
+    import jax
+
+    from ..core.random import generation_key
+
+    ctx = abc._build_device_ctx()
+    B = abc.sampler._pick_B(n_estimate)
+    mode, dyn = ctx.build_dyn_args(
+        t=t_next, eps_value=np.inf,
+        model_probabilities=abc._model_probs,
+        transitions=abc.transitions,
+        model_perturbation_kernel=abc.model_perturbation_kernel,
+    )
+    # dedicated key stream: must not collide with the generation
+    # kernel's fold_in(gen_key, round) sequence
+    key = jax.random.fold_in(
+        generation_key(abc._root_key, t_next), 1 << 20
+    )
+    out = ctx.round_kernel(B, mode)(key, dyn)
+    return {"out": out, "B": B, "accept": abc._speculative_accept,
+            "t": t_next}
+
+
+def run_pipelined(abc, t0, minimum_epsilon, max_nr_populations,
+                  min_acceptance_rate, max_total_nr_simulations,
+                  max_walltime, start_walltime):
+    """Cross-generation pipelined loop (the look-ahead analog) — the
+    unfused device path's half of the dispatch engine. See the module
+    docstring; ``abc`` (ABCSMC) supplies the statistical hooks."""
+    import copy
+
+    t = t0
+    sims_total = abc.history.total_nr_simulations
+    distance_changed_at_t = getattr(
+        abc, "_resumed_distance_changed", False)
+    last_strategies_s = 0.0  # first generation never speculates
+
+    clk = abc._clock.now
+
+    def _dispatch(t_next, speculative=None):
+        t_d0 = clk()
+        current_eps = abc.eps(t_next)
+        if hasattr(abc.acceptor, "note_epsilon"):
+            abc.acceptor.note_epsilon(t_next, current_eps,
+                                      distance_changed_at_t)
+        n_t = abc.population_strategy(t_next)
+        max_eval = (
+            n_t / min_acceptance_rate
+            if min_acceptance_rate > 0 else np.inf
+        )
+        logger.info("t: %d, eps: %.8g", t_next, current_eps)
+        with abc.tracer.span("dispatch", t=int(t_next), n=int(n_t)):
+            spec = abc._generation_spec(t_next)
+            spec_s = clk() - t_d0
+            handle = abc.sampler.dispatch(n_t, spec, t_next,
+                                          max_eval=max_eval,
+                                          speculative=speculative)
+        handle["dispatch_telemetry"] = {
+            "spec_s": round(spec_s, 4),
+            "enqueue_s": round(clk() - t_d0 - spec_s, 4),
+        }
+        if speculative is not None:
+            handle["dispatch_telemetry"]["speculative_accepted"] = (
+                len(handle["spec"]["slots"])
+                if handle.get("spec") else 0
+            )
+        return handle, current_eps, n_t
+
+    handle, current_eps, n_t = _dispatch(t)
+    while True:
+        t_gen0 = clk()
+        with abc.tracer.span("collect", t=int(t), n=int(n_t)):
+            sample = abc.sampler.collect(handle)
+        sample_s = clk() - t_gen0
+        n_acc = sample.n_accepted if sample.ms is not None else len(
+            sample.accepted_particles
+        )
+        if n_acc < n_t:
+            logger.info(
+                "stopping: only %d/%d accepted within budget", n_acc, n_t
+            )
+            break
+        pop = abc._sample_to_population(sample)
+        nr_evals = abc.sampler.nr_evaluations_
+        sims_total += nr_evals
+        acceptance_rate = n_t / nr_evals
+        logger.info(
+            "acceptance rate: %.5f (%d evaluations)", acceptance_rate,
+            nr_evals,
+        )
+        # shallow copy pins the PRE-adaptation distances for the db
+        # (_recompute_distances rebinds pop.distances; reference history
+        # keeps the original values)
+        db_pop = copy.copy(pop)
+
+        # central adaptation — the PROPOSAL part (transition refits)
+        # runs first so a speculative eps=+inf round for t+1 can start
+        # on the device WHILE the slow strategy updates (temperature
+        # bisection, epsilon quantiles, acceptor norms) run on the host;
+        # its delayed acceptance is applied at dispatch time (reference
+        # look-ahead with delayed evaluation, SURVEY.md §2.3)
+        t_adapt0 = clk()
+        spec_round = None
+        with abc.tracer.span("adapt", t=int(t)):
+            abc._adapt_proposal(pop)
+            # every stop rule is decidable BEFORE the slow strategy
+            # updates (model probs were refreshed by _adapt_proposal
+            # above) — don't burn a speculative round on a generation
+            # that will never be dispatched
+            surely_stopping = abc._check_stop(
+                t, current_eps, minimum_epsilon, max_nr_populations,
+                acceptance_rate, min_acceptance_rate, sims_total,
+                max_total_nr_simulations, max_walltime, start_walltime)
+            if (not surely_stopping
+                    and abc._speculation_capable()
+                    and last_strategies_s > abc.speculation_min_adapt_s):
+                spec_round = dispatch_speculative_round(abc, t + 1, n_t)
+            t_strat0 = clk()
+            distance_changed_at_t = abc._adapt_strategies(
+                t, sample, pop, current_eps, acceptance_rate
+            )
+            last_strategies_s = clk() - t_strat0
+        adapt_s = clk() - t_adapt0
+
+        # re-check AFTER the strategy updates: their duration counts
+        # against max_walltime (slow temperature bisections / distance
+        # refits must not buy an extra generation past the budget)
+        stop = surely_stopping or abc._check_stop(
+            t, current_eps, minimum_epsilon, max_nr_populations,
+            acceptance_rate, min_acceptance_rate, sims_total,
+            max_total_nr_simulations, max_walltime, start_walltime)
+
+        if not stop:
+            # LOOK-AHEAD: device starts generation t+1 now ...
+            next_handle, next_eps, next_n = _dispatch(
+                t + 1, speculative=spec_round)
+
+        # ... while the host persists generation t
+        t_persist0 = clk()
+        with abc.tracer.span("persist", t=int(t)):
+            abc.history.append_population(
+                t, current_eps, db_pop, nr_evals, abc.model_names,
+                telemetry={"sample_s": round(sample_s, 4),
+                           "adapt_s": round(adapt_s, 4),
+                           "n_evaluations": int(nr_evals),
+                           "acceptance_rate": round(acceptance_rate, 6),
+                           "distance_changed":
+                               bool(distance_changed_at_t),
+                           "pipelined": True,
+                           **handle.get("dispatch_telemetry", {})},
+            )
+        abc.history.update_telemetry(
+            t, {"persist_s": round(clk() - t_persist0, 4)}
+        )
+        if stop:
+            break
+        handle, current_eps, n_t = next_handle, next_eps, next_n
+        t += 1
+    abc.history.done()
+    return abc.history

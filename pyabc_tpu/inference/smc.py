@@ -362,6 +362,10 @@ class ABCSMC:
         self.probe_events: list[tuple[float, float]] = []
         self._drain_thread = None
         self._drain_error: BaseException | None = None
+        #: the current run's DispatchEngine (inference/dispatch.py) —
+        #: the single owner of chunk dispatch/fetch; tests and the bench
+        #: read its snapshot()/sync_budget_report() after a run
+        self._engine = None
         #: (carry_ref, t, sims, chunk_index) of the newest healthy chunk
         #: boundary — the graceful-shutdown final-checkpoint state
         self._final_ck_state = None
@@ -2144,37 +2148,37 @@ class ABCSMC:
         )
         refit_cadence = self._refit_cadence_cfg(n_cap)
         health_cfg = self._health_cfg()
-        with self.tracer.span("kernel.build", G=int(G), B=int(B),
-                              n_cap=int(n_cap)):
-            kern = ctx.multigen_kernel(
-                B, n_cap, rec_cap, max_rounds, G,
-                weight_sched=weight_sched,
-                fold_sched_mode=fold_sched_mode,
-                first_gen_prior=first_gen_prior,
-                fused_calibration=fused_cal,
-                adaptive=adaptive, eps_quantile=eps_quantile,
-                eps_weighted=getattr(self.eps, "weighted", True),
-                alpha=getattr(self.eps, "alpha", 0.5),
-                multiplier=getattr(self.eps, "quantile_multiplier", 1.0),
-                trans_cls=type(tr),
-                fit_statics=self._transition_fit_statics(n_max),
-                dims=tuple(p.space.dim for p in self.parameter_priors),
-                stochastic=stochastic,
-                temp_config=self._temp_config() if stochastic else None,
-                temp_fixed=temp_fixed,
-                complete_history=complete_history,
-                sumstat_transform=sumstat_mode,
-                adaptive_n=(
-                    (float(self.population_strategy.mean_cv),
-                     int(self.population_strategy.min_population_size),
-                     int(min(self.population_strategy.max_population_size,
-                             n_cap)),
-                     int(self.population_strategy.n_bootstrap))
-                    if adaptive_n else None
-                ),
-                refit_cadence=refit_cadence,
-                health_config=health_cfg,
-            )
+        # the multigen kernel's static configuration; the dispatch engine
+        # owns the build (kernel.build span) and every invocation —
+        # abc-lint DISP001 bans direct kernel calls outside the engine
+        kernel_kwargs = dict(
+            weight_sched=weight_sched,
+            fold_sched_mode=fold_sched_mode,
+            first_gen_prior=first_gen_prior,
+            fused_calibration=fused_cal,
+            adaptive=adaptive, eps_quantile=eps_quantile,
+            eps_weighted=getattr(self.eps, "weighted", True),
+            alpha=getattr(self.eps, "alpha", 0.5),
+            multiplier=getattr(self.eps, "quantile_multiplier", 1.0),
+            trans_cls=type(tr),
+            fit_statics=self._transition_fit_statics(n_max),
+            dims=tuple(p.space.dim for p in self.parameter_priors),
+            stochastic=stochastic,
+            temp_config=self._temp_config() if stochastic else None,
+            temp_fixed=temp_fixed,
+            complete_history=complete_history,
+            sumstat_transform=sumstat_mode,
+            adaptive_n=(
+                (float(self.population_strategy.mean_cv),
+                 int(self.population_strategy.min_population_size),
+                 int(min(self.population_strategy.max_population_size,
+                         n_cap)),
+                 int(self.population_strategy.n_bootstrap))
+                if adaptive_n else None
+            ),
+            refit_cadence=refit_cadence,
+            health_config=health_cfg,
+        )
 
         def _g_limit(t_at: int) -> int:
             g = G
@@ -2186,27 +2190,11 @@ class ABCSMC:
                 g = min(g, len(self.population_strategy.values) - t_at)
             return max(g, 0)
 
-        def _dispatch_chunk(carry, t_at: int, g_limit: int):
-            """Enqueue one chunk (async). ``carry`` is either the host-built
-            initial carry or the PREVIOUS chunk's on-device final carry —
-            chaining device-to-device lets chunk k+1 compute while chunk
-            k's outputs are still being fetched/persisted."""
-            # resilience fault site (round 10): numeric CORRUPTION of the
-            # dispatched chunk's input carry — silent NaN/cov/weight
-            # poison that never raises, exactly what the in-kernel health
-            # word exists to catch. The clean carry ref stays untouched
-            # (rollback reuses it); the poison is traceable jnp ops
-            # riding the normal dispatch, no sync.
-            from ..resilience.faults import maybe_corrupt
-
-            kind = maybe_corrupt("device.carry", t=int(t_at))
-            if kind is not None:
-                from ..ops.health import poison_carry
-
-                logger.warning(
-                    "injected carry corruption %r at t=%d", kind, t_at
-                )
-                carry = poison_carry(carry, kind)
+        def _chunk_host_args(t_at: int, g_limit: int) -> dict:
+            """Host-resolved per-chunk schedules — the STATISTICAL half
+            of a dispatch (epsilon ladder, population sizes, user weight
+            schedules, CV fold tables); the engine turns these into
+            kernel arguments and owns the invocation itself."""
             eps_fixed = np.zeros(G, np.float32)
             if (not eps_quantile and not stochastic) or temp_fixed:
                 for g in range(g_limit):
@@ -2249,17 +2237,8 @@ class ABCSMC:
                     for g in range(G)
                 ])
                 fold_sched = jnp.asarray(table)
-            return kern(
-                self._root_key, jnp.asarray(t_at, jnp.int32),
-                jnp.asarray(n_sched),
-                jnp.asarray(g_limit, jnp.int32), carry,
-                jnp.asarray(self.model_perturbation_kernel.device_params()),
-                jnp.asarray(eps_fixed),
-                jnp.asarray(minimum_epsilon, jnp.float32),
-                jnp.asarray(min_acceptance_rate, jnp.float32),
-                dist_sched,
-                fold_sched,
-            )
+            return {"eps_fixed": eps_fixed, "n_sched": n_sched,
+                    "dist_sched": dist_sched, "fold_sched": fold_sched}
 
         def _build_chunk_carry(t_at: int):
             """Host-state -> device chunk carry: per-model transition params
@@ -2373,6 +2352,36 @@ class ABCSMC:
                                 jnp.zeros((), jnp.int32)),)
             return base
 
+        from .dispatch import DispatchEngine
+
+        # the ONE async dispatch engine (round 12): kernel build, chunk
+        # dispatch/fetch pipeline, drain, health redispatch and the sync
+        # budget all live in inference/dispatch.py — this method only
+        # supplies the statistical hooks
+        engine = DispatchEngine(
+            self, ctx,
+            shapes=(B, n_cap, rec_cap, max_rounds, G),
+            kernel_kwargs=kernel_kwargs,
+            g_limit=_g_limit,
+            chunk_host_args=_chunk_host_args,
+            rebuild_carry=_build_chunk_carry,
+            stop={"minimum_epsilon": minimum_epsilon,
+                  "max_nr_populations": max_nr_populations,
+                  "min_acceptance_rate": min_acceptance_rate,
+                  "max_total_nr_simulations": max_total_nr_simulations,
+                  "max_walltime": max_walltime,
+                  "start_walltime": start_walltime},
+            n_of=self.population_strategy,
+            sumstat_refit=sumstat_mode,
+            adaptive=adaptive,
+            stochastic=stochastic,
+            temp_fixed=temp_fixed,
+            eps_quantile=eps_quantile,
+            adaptive_n=adaptive_n,
+            n_keep=n_keep,
+        )
+        self._engine = engine
+
         carry0 = None
         if self._resume_carry is not None \
                 and t == self.resumed_from_checkpoint_t:
@@ -2388,8 +2397,7 @@ class ABCSMC:
         if carry0 is None:
             carry0 = _build_chunk_carry(t)
 
-        g_limit = _g_limit(t)
-        if g_limit <= 0:
+        if _g_limit(t) <= 0:
             self.history.done()
             return self.history
         # sqlite persistence moves to a writer thread: the host path per
@@ -2397,18 +2405,7 @@ class ABCSMC:
         # chunk's device compute; history.done() flushes before returning
         self.history.start_async_writer()
         try:
-            return self._fused_chunk_loop(
-                t, g_limit, self.population_strategy, carry0, _g_limit,
-                _dispatch_chunk,
-                minimum_epsilon, max_nr_populations, min_acceptance_rate,
-                max_total_nr_simulations, max_walltime, start_walltime,
-                sims_total, eps_quantile, adaptive, stochastic,
-                temp_fixed=temp_fixed,
-                sumstat_refit=sumstat_mode,
-                rebuild_carry=_build_chunk_carry,
-                adaptive_n=adaptive_n,
-                n_keep=n_keep,
-            )
+            return engine.run(t, carry0, sims_total)
         except BaseException as exc:
             # drain queued generations before propagating — a mid-loop
             # failure (device error, interrupt) must not silently abandon
@@ -2430,482 +2427,16 @@ class ABCSMC:
                 self._save_final_checkpoint()
             raise
 
-    def _fused_chunk_loop(self, t, g_limit, n_of, carry0, _g_limit,
-                          _dispatch_chunk, minimum_epsilon,
-                          max_nr_populations, min_acceptance_rate,
-                          max_total_nr_simulations, max_walltime,
-                          start_walltime, sims_total, eps_quantile,
-                          adaptive, stochastic=False, temp_fixed=False,
-                          sumstat_refit=False,
-                          rebuild_carry=None,
-                          adaptive_n=False,
-                          n_keep=None) -> History:
-        import jax
-
-        from ..sampler.base import Sample, exp_normalize_log_weights
-
-        from concurrent.futures import ThreadPoolExecutor
-
-        # every synchronous device round-trip over a TPU tunnel costs
-        # ~0.1s of LATENCY regardless of payload, but concurrent fetches
-        # pipeline (measured: 4x512KB = 1.26s sequentially, 0.18s from 4
-        # threads). The loop therefore keeps up to `depth` chunks in
-        # flight, each with its device_get already running on a background
-        # thread, and processes results strictly in order — the fetch
-        # latency of chunk k hides behind the device's compute of chunks
-        # k+1..k+depth-1. The in-device `stopped` flag chains, so
-        # over-dispatch past a stop is a no-op. sumstat_refit mode can't
-        # speculate: each next chunk's carry needs the host predictor
-        # refit on the previous chunk's last population (depth 1, sync).
-        depth = 1 if sumstat_refit else max(
-            1, int(self.fetch_pipeline_depth)
-        )
-        executor = (ThreadPoolExecutor(max_workers=depth)
-                    if depth > 1 else None)
-
-        ctx = self._build_device_ctx()
-        if n_keep is None:
-            n_keep = self._fused_n_cap()
-        # the boundary sumstat refit feeds a host KDE fit — keep its wire
-        # format at full precision; every other config narrows (the device
-        # carry chain is f32 either way, so acceptances / epsilon trail /
-        # refits are bit-identical across fetch dtypes)
-        fetch_dtype = "float32" if sumstat_refit else self.fetch_dtype
-
-        def _fetch_tree(res_i, t_at, g_lim):
-            """Device-side fetch compaction (ops/pack.py): theta /
-            distance / log_weight collapse into ONE narrowed-dtype row
-            buffer sliced to the scheduled population, slot is elided
-            (the reservoir is slot-ordered by construction), m ships
-            only for K > 1, and per-particle sum stats — the dominant
-            payload when retained (~70%) — ship only for generations
-            History persists (sumstat-refit mode additionally needs the
-            chunk's FINAL generation for the boundary refit)."""
-            outs = res_i["outs"]
-            ss_wanted = [
-                (sumstat_refit and g == g_lim - 1)
-                or self.history.wants_sum_stats(t_at + g)
-                for g in range(g_lim)
-            ]
-            ss_gens = ("all" if all(ss_wanted)
-                       else tuple(g for g in range(g_lim) if ss_wanted[g]))
-            tree = ctx.fetch_pack_kernel(
-                n_keep=n_keep, dtype_name=fetch_dtype,
-                keep_m=self.K > 1, ss_gens=ss_gens, g_keep=int(g_lim),
-            )(outs)
-            if "calib" in res_i and t_at == 0:
-                # the run-starting chunk carries the in-kernel
-                # calibration's initial weights / eps_0 for host mirroring
-                tree["__calib__"] = res_i["calib"]
-            # what the round-5 full-f32-ring fetch would have moved for
-            # this chunk (aval-level .nbytes — no device op): the
-            # compaction ratio ships with each chunk event so payload
-            # reduction is a regression-guarded metric, not a one-off
-            r5_bytes = sum(
-                x.nbytes for x in jax.tree.leaves(
-                    {k: v for k, v in outs.items() if k != "sumstats"}
-                )
-            )
-            if ss_gens == "all":
-                r5_bytes += outs["sumstats"].nbytes
-            else:
-                r5_bytes += (
-                    outs["sumstats"].nbytes // outs["sumstats"].shape[0]
-                ) * len(ss_gens)
-            return tree, r5_bytes
-
-        def _unpack_fetched(fetched):
-            """Host-side inverse of the pack kernel: restore the legacy
-            per-leaf layout (upcast — the narrowing lives on the wire
-            only) and reconstruct the elided leaves."""
-            from ..ops.pack import unpack_rows
-
-            rows = fetched.pop("rows")
-            theta, dist, log_w = unpack_rows(rows, ctx.d_max)
-            fetched["theta"] = theta
-            fetched["distance"] = dist
-            fetched["log_weight"] = log_w
-            gn = rows.shape[:2]
-            if "m" in fetched:
-                fetched["m"] = np.asarray(fetched["m"], np.int32)
-            else:
-                fetched["m"] = np.zeros(gn, np.int32)
-            # the reservoir is written in slot order, so arange is the
-            # identity the argsort-by-proposal-id trim expects
-            fetched["slot"] = np.broadcast_to(
-                np.arange(gn[1], dtype=np.int32), gn
-            )
-            if "sumstats" in fetched:
-                fetched["sumstats"] = np.asarray(
-                    fetched["sumstats"], np.float32
-                )
-            return fetched
-
-        probe_pool = (ThreadPoolExecutor(max_workers=1)
-                      if self.compute_probe else None)
-        clk = self._clock.now
-
-        def _probe(out, disp_ts):
-            jax.block_until_ready(out)
-            self.sync_ledger.record("compute_probe")
-            self.probe_events.append((disp_ts, clk()))
-
-        def _submit(res_i, t_at, g_lim):
-            if probe_pool is not None:
-                probe_pool.submit(_probe, res_i["outs"]["gen_ok"],
-                                  clk())
-            tree, r5_bytes = _fetch_tree(res_i, t_at, g_lim)
-            if executor is None:
-                return tree, r5_bytes  # fetched synchronously at pop time
-            return executor.submit(jax.device_get, tree), r5_bytes
-
-        chunk_index = 0
-        t_chunk0 = clk()
-        # the FIRST dispatch triggers the multigen kernel's trace/compile
-        # (the dominant dark block on fresh runs, per the first coverage
-        # traces) — span it separately so compile time is attributed
-        with self.tracer.span("dispatch", first=True, t_first=int(t)):
-            res = _dispatch_chunk(carry0, t, g_limit)
-        #: (fetch handle, t_at, g_lim, final-carry ref) in dispatch order
-        #: — the carry ref is what a checkpoint persists after the chunk
-        #: is processed (the state every following chunk derives from)
-        pending = [(_submit(res, t, g_limit), t, g_limit, res["carry"])]
-        tail = (res, t, g_limit)  # newest dispatched chunk (carry chain)
-        # even at depth 1 (sync fetch) the NEXT chunk must be dispatched
-        # before fetching the current one — both for the old speculative
-        # overlap and because the drain check below is `while pending`
-        refill_target = max(depth, 2)
-        drained_async = False
-        #: (t, carry) of the newest KNOWN-HEALTHY chunk boundary — the
-        #: health supervisor's rollback target when no checkpoint covers
-        #: the failed generation (the host-built carry0 counts: a
-        #: corruption of the very first chunk rolls back to it)
-        good_carry = (t, carry0)
-        #: (carry_ref, t_next, sims, chunk_index) for the graceful-
-        #: shutdown final checkpoint (SIGTERM/SIGINT mid-run)
-        self._final_ck_state = None
-
-        def _process_next(dispatch_s):
-            """Fetch + host-process the oldest pending chunk (shared by
-            the main loop and the drain-async tail thread; only one of
-            them ever runs at a time, so the nonlocal state is safe)."""
-            nonlocal t, sims_total, chunk_index, t_chunk0, good_carry
-            # resilience fault site: an injected orchestrator kill lands
-            # HERE — after dispatch, before the chunk's results are
-            # processed/persisted — the worst spot for generation-
-            # granularity resume and exactly what the mid-chunk
-            # checkpoint heals
-            from ..resilience.faults import maybe_fault as _maybe_fault
-
-            _maybe_fault("orchestrator.chunk", chunk_index=chunk_index)
-            (handle, r5_bytes), t_at, g_lim, carry_ref = pending.pop(0)
-            logger.info("t: %d..%d (fused chunk of %d)", t_at,
-                        t_at + g_lim - 1, g_lim)
-            with self.tracer.span("chunk", t_first=int(t_at),
-                                  gens=int(g_lim)) as c_span:
-                t_fetch0 = clk()
-                with self.tracer.span("fetch", t_first=int(t_at)):
-                    fetched = (handle.result() if executor is not None
-                               else jax.device_get(handle))
-                now = clk()
-                fetch_s = now - t_fetch0  # EXPOSED wait (latency pipelined)
-                chunk_s = now - t_chunk0  # pipeline period: fetch-to-fetch
-                t_chunk0 = now
-                # measured wire payload of this chunk (post-compaction);
-                # feeds the bench's fetch_bytes_per_chunk regression metric
-                fetch_bytes = sum(
-                    int(np.asarray(leaf).nbytes)
-                    for leaf in jax.tree.leaves(fetched)
-                )
-                self.sync_ledger.record("chunk_fetch", fetch_bytes)
-                ss_rows = fetched.pop("__ss_rows__", None)
-                if ss_rows is not None:
-                    ss_rows = {
-                        g: np.asarray(v, np.float32)
-                        for g, v in ss_rows.items()
-                    }
-                elif "sumstats" not in fetched:
-                    # no generation of this chunk retains sum stats: the
-                    # pack kernel shipped none at all
-                    ss_rows = {}
-                calib = fetched.pop("__calib__", None)
-                fetched = _unpack_fetched(fetched)
-                if calib is not None:
-                    self._mirror_fused_calibration(calib)
-                mem_telemetry = self._device_memory_telemetry()
-                chunk_index += 1
-                t_proc0 = clk()
-                with self.tracer.span("process", t_first=int(t_at)):
-                    (stop, last_pop, last_sample, last_eps, last_acc_rate,
-                     t, sims_total, n_acc_chunk, g_done, health_fail) = \
-                        self._process_chunk(
-                            fetched, ss_rows, t, g_lim, n_of, adaptive_n,
-                            adaptive, stochastic, temp_fixed, eps_quantile,
-                            sumstat_refit, chunk_index, chunk_s, dispatch_s,
-                            fetch_s, depth, mem_telemetry,
-                            sims_total, minimum_epsilon, max_nr_populations,
-                            min_acceptance_rate, max_total_nr_simulations,
-                            max_walltime, start_walltime,
-                        )
-                c_span.set(chunk_index=int(chunk_index),
-                           n_acc=int(n_acc_chunk), g_done=int(g_done),
-                           chunk_s=round(float(chunk_s), 6),
-                           fetch_s=round(float(fetch_s), 6),
-                           dispatch_s=round(float(dispatch_s), 6))
-                self.metrics.histogram(
-                    "pyabc_tpu_chunk_fetch_seconds",
-                    "exposed device->host fetch wait per fused chunk",
-                ).observe(float(fetch_s))
-                self.metrics.histogram(
-                    "pyabc_tpu_chunk_fetch_bytes",
-                    "device->host wire payload per fused chunk "
-                    "(post-compaction)",
-                ).observe(float(fetch_bytes))
-                self.metrics.counter(
-                    "pyabc_tpu_particles_accepted",
-                    "accepted particles across fused chunks",
-                ).inc(int(n_acc_chunk))
-            if health_fail is None and not stop and g_done == g_lim:
-                # the chunk boundary is known-healthy: it becomes the
-                # supervisor's rollback target and the graceful-shutdown
-                # final-checkpoint state
-                good_carry = (t, carry_ref)
-                if not sumstat_refit:
-                    self._final_ck_state = (carry_ref, t, sims_total,
-                                            chunk_index)
-            if (self._checkpoint is not None and not sumstat_refit
-                    and health_fail is None
-                    and not stop and g_done == g_lim
-                    and chunk_index % self.checkpoint_every == 0):
-                # persist the chunk's final device carry (flush-first: the
-                # db stays at-or-ahead of the checkpoint). sumstat-refit
-                # mode is excluded — its carry is rebuilt host-side at
-                # every chunk boundary, so the device carry is not the
-                # resume state there (README documents the deviation).
-                try:
-                    self._save_fused_checkpoint(
-                        carry_ref, t, sims_total, chunk_index
-                    )
-                except Exception:
-                    # a failed checkpoint degrades durability, never the
-                    # run itself
-                    logger.exception(
-                        "fused checkpoint save failed (run continues)"
-                    )
-            if self.chunk_event_cb is not None:
-                try:
-                    ev = {
-                        "ts": clk(), "t_first": int(t_at),
-                        "gens": int(g_done), "n_acc": int(n_acc_chunk),
-                        "chunk_index": int(chunk_index),
-                        "chunk_s": float(chunk_s),
-                        "fetch_s": float(fetch_s),
-                        "fetch_bytes": int(fetch_bytes),
-                        "fetch_bytes_full_f32": int(r5_bytes),
-                        "dispatch_s": float(dispatch_s),
-                        "process_s": float(clk() - t_proc0),
-                    }
-                    if "refit" in fetched and g_done > 0:
-                        # refit-cadence telemetry rides the chunk events
-                        # so the bench's scale lane can report
-                        # refits_per_run without touching the History
-                        ev["refits"] = int(
-                            np.asarray(fetched["refit"])[:g_done].sum())
-                        ev["drift_last"] = float(
-                            np.asarray(fetched["drift"])[g_done - 1])
-                    self.chunk_event_cb(ev)
-                except Exception:
-                    logger.exception("chunk_event_cb failed")
-            return (stop, last_pop, last_sample, last_eps, last_acc_rate,
-                    t_at, g_lim, health_fail)
-
-        def _mirror_fit(last_pop):
-            self._model_probs = {
-                m: float(last_pop.model_probabilities_array()[m])
-                for m in last_pop.get_alive_models()
-            }
-            self._fit_transitions(last_pop)
-
-        def _drain_tail():
-            """Background drain of the final in-flight chunks: their
-            fetch latency has no successor compute in THIS run — the
-            drain_async caller overlaps it with its own next work."""
-            try:
-                try:
-                    while pending:
-                        stop, last_pop, *_rest, health_fail = \
-                            _process_next(0.0)
-                        if last_pop is not None:
-                            _mirror_fit(last_pop)
-                        if health_fail is not None:
-                            # the generation schedule already ended: no
-                            # redispatch can recover this — record the
-                            # event and surface a typed failure through
-                            # drain_join() instead of a silent partial db
-                            from ..resilience.health import (
-                                DegenerateRunError,
-                            )
-
-                            self.health_supervisor.on_failure(
-                                health_fail["t"], health_fail["word"],
-                                ess=health_fail.get("ess"),
-                                acc_rate=health_fail.get("acc_rate"),
-                                eps=health_fail.get("eps"),
-                            )
-                            raise DegenerateRunError(
-                                f"in-kernel health failure at "
-                                f"t={health_fail['t']} during the async "
-                                f"drain (schedule exhausted, no "
-                                f"redispatch possible)",
-                                self.health_supervisor.trail,
-                            )
-                        if stop:
-                            break
-                finally:
-                    if executor is not None:
-                        executor.shutdown(wait=True, cancel_futures=True)
-                    if probe_pool is not None:
-                        probe_pool.shutdown(wait=True)
-                self.history.done()
-                if self._checkpoint is not None:
-                    # clean completion: the History holds everything; a
-                    # stale checkpoint must not shadow a future run
-                    self._checkpoint.clear()
-            except BaseException as exc:  # surfaced by drain_join()
-                self._drain_error = exc
-                try:
-                    self.history.flush()
-                except Exception:
-                    logger.exception(
-                        "async history writer also failed while draining"
-                    )
-
-        try:
-            while pending:
-                # keep the device fed: dispatch + start fetches up to depth
-                t_disp0 = clk()
-                with self.tracer.span("dispatch"):
-                    while not sumstat_refit and len(pending) < refill_target:
-                        lr, lt, lg = tail
-                        g_next = _g_limit(lt + lg)
-                        if g_next <= 0:
-                            break
-                        nxt = _dispatch_chunk(lr["carry"], lt + lg, g_next)
-                        tail = (nxt, lt + lg, g_next)
-                        pending.append((_submit(nxt, lt + lg, g_next),
-                                        lt + lg, g_next, nxt["carry"]))
-                dispatch_s = clk() - t_disp0
-                if (self.drain_async and not sumstat_refit
-                        and chunk_index >= 1 and pending
-                        and _g_limit(tail[1] + tail[2]) <= 0):
-                    # schedule exhausted: everything left is drain — hand
-                    # it to the background thread and return
-                    import threading as _threading
-
-                    self._drain_error = None
-                    self._drain_thread = _threading.Thread(
-                        target=_drain_tail, daemon=True,
-                        name="pyabc-tpu-drain",
-                    )
-                    self._drain_thread.start()
-                    drained_async = True
-                    return self.history
-                (stop, last_pop, last_sample, last_eps, last_acc_rate,
-                 t_at, g_limit, health_fail) = _process_next(dispatch_s)
-                if health_fail is not None:
-                    # in-kernel health failure: abort the chunk (nothing
-                    # at/past the failed generation was persisted), let
-                    # the supervisor decide — it raises a typed
-                    # DegenerateRunError for terminal conditions — then
-                    # roll the carry back and redispatch from the failed
-                    # generation. Speculative chunks dispatched off the
-                    # degraded carry are discarded with it.
-                    t_fail = health_fail["t"]
-                    t_detect = clk()
-                    if last_pop is not None:
-                        # host proposal state now reflects t_fail - 1 —
-                        # the state a host carry rebuild fits from
-                        _mirror_fit(last_pop)
-                    action = self.health_supervisor.on_failure(
-                        t_fail, health_fail["word"],
-                        ess=health_fail.get("ess"),
-                        acc_rate=health_fail.get("acc_rate"),
-                        eps=health_fail.get("eps"),
-                        chunk_index=chunk_index,
-                    )
-                    pending.clear()
-                    carry_rb, source = self._health_recovery_carry(
-                        action, t_fail, good_carry, rebuild_carry,
-                    )
-                    g_next = _g_limit(t_fail)
-                    if g_next <= 0:
-                        break
-                    logger.warning(
-                        "health recovery at t=%d: %s from %s "
-                        "(kinds=%s)", t_fail, action, source,
-                        self.health_supervisor.trail[-1]["kinds"],
-                    )
-                    with self.tracer.span("dispatch", recovery=True,
-                                          t_first=int(t_fail)):
-                        res = _dispatch_chunk(carry_rb, t_fail, g_next)
-                    pending[:] = [(_submit(res, t_fail, g_next), t_fail,
-                                   g_next, res["carry"])]
-                    tail = (res, t_fail, g_next)
-                    self.health_supervisor.note_recovered(
-                        t_fail, action, source, t_detect)
-                    continue
-                continuing = (not stop and last_pop is not None
-                              and (pending
-                                   or _g_limit(t_at + g_limit) > 0))
-                if last_pop is not None \
-                        and not (continuing and sumstat_refit):
-                    # (the sumstat-refit continue path fits these inside
-                    # _adapt_components below — don't pay the KDE fit twice)
-                    _mirror_fit(last_pop)
-                if not continuing:
-                    break
-                if sumstat_refit:
-                    # host boundary adaptation: refit the learned
-                    # statistics on this chunk's final population, refit
-                    # the scale weights in the NEW feature space and
-                    # re-derive the epsilon under the updated distance
-                    # (the per-generation _adapt_components semantics
-                    # applied at chunk granularity), then dispatch the
-                    # next chunk off a fresh host-built carry.
-                    # Declared deviation: the boundary scale refit sees
-                    # the ACCEPTED population only (the reference's
-                    # all_particles=False convention) — the
-                    # all-evaluations ring stays on device; in-chunk
-                    # refits use the full ring.
-                    self._adapt_components(t - 1, last_sample, last_pop,
-                                           last_eps, last_acc_rate)
-                    # the boundary refit DID run: flag it for resume's
-                    # epsilon-trail replay (flush first — the row may
-                    # still be queued on the writer thread, and
-                    # update_telemetry skips missing rows)
-                    self.history.flush()
-                    self.history.update_telemetry(
-                        t - 1, {"distance_changed": True}
-                    )
-                    g_next = _g_limit(t)
-                    res = _dispatch_chunk(rebuild_carry(t), t, g_next)
-                    pending = [(_submit(res, t, g_next), t, g_next,
-                                res["carry"])]
-                    tail = (res, t, g_next)
-        finally:
-            # on a drain-async handoff the tail thread owns the executor
-            # and the probe pool
-            if not drained_async:
-                if executor is not None:
-                    executor.shutdown(wait=True, cancel_futures=True)
-                if probe_pool is not None:
-                    probe_pool.shutdown(wait=True)
-        self.history.done()
-        if self._checkpoint is not None:
-            # clean completion: the History holds everything; a stale
-            # checkpoint must not shadow a future run
-            self._checkpoint.clear()
-        return self.history
+    def _mirror_chunk_fit(self, last_pop) -> None:
+        """Mirror a processed chunk's final population into the host
+        proposal state (model probabilities + transition refits) — the
+        state further chunks, resume and telemetry all derive from.
+        Called by the dispatch engine after each chunk's processing."""
+        self._model_probs = {
+            m: float(last_pop.model_probabilities_array()[m])
+            for m in last_pop.get_alive_models()
+        }
+        self._fit_transitions(last_pop)
 
     def _device_w_to_host(self, w_struct) -> np.ndarray:
         """Convert a fetched device weight-params structure into the host
@@ -3383,30 +2914,6 @@ class ABCSMC:
         # so acceptance can be applied on the host once T/pdf_norm are known
         return type(a) is StochasticAcceptor
 
-    def _dispatch_speculative_round(self, t_next: int, n_estimate: int):
-        """Enqueue ONE eps=+inf proposal round for generation t_next off the
-        just-refit transitions (async; the host continues adapting)."""
-        import jax
-
-        from ..core.random import generation_key
-
-        ctx = self._build_device_ctx()
-        B = self.sampler._pick_B(n_estimate)
-        mode, dyn = ctx.build_dyn_args(
-            t=t_next, eps_value=np.inf,
-            model_probabilities=self._model_probs,
-            transitions=self.transitions,
-            model_perturbation_kernel=self.model_perturbation_kernel,
-        )
-        # dedicated key stream: must not collide with the generation
-        # kernel's fold_in(gen_key, round) sequence
-        key = jax.random.fold_in(
-            generation_key(self._root_key, t_next), 1 << 20
-        )
-        out = ctx.round_kernel(B, mode)(key, dyn)
-        return {"out": out, "B": B, "accept": self._speculative_accept,
-                "t": t_next}
-
     def _speculative_accept(self, t_next: int, fetched: dict):
         """Delayed acceptance for a speculative round, applied AFTER the
         strategy updates fixed generation t_next's threshold/temperature.
@@ -3436,148 +2943,22 @@ class ABCSMC:
     def _loop_pipelined(self, t0, minimum_epsilon, max_nr_populations,
                         min_acceptance_rate, max_total_nr_simulations,
                         max_walltime, start_walltime) -> History:
-        """Cross-generation pipelined loop (the look-ahead analog).
+        """Cross-generation pipelined loop (the look-ahead analog) —
+        delegated to the dispatch engine module
+        (:func:`pyabc_tpu.inference.dispatch.run_pipelined`): generation
+        t+1 is dispatched to the device as soon as the adaptive
+        components are refit on generation t's final results,
+        persistence overlaps the device's compute, and speculative
+        eps=+inf rounds ride the slow strategy updates. Proposals always
+        use FINAL generation-t weights, so the run is statistically
+        identical to the serial loop."""
+        from .dispatch import run_pipelined
 
-        Generation t+1 is DISPATCHED to the device as soon as the adaptive
-        components are refit on generation t's final results; the host then
-        persists generation t to the History while the device is already
-        simulating t+1. Unlike the reference's Redis look-ahead
-        (``redis_eps/sampler.py`` look_ahead mode), proposals always use
-        FINAL generation-t weights, so the run is statistically identical to
-        the serial loop — no preliminary-weight correction is needed; only
-        host-side persistence/analysis is overlapped.
-        """
-        import copy
-
-        t = t0
-        sims_total = self.history.total_nr_simulations
-        distance_changed_at_t = getattr(
-            self, "_resumed_distance_changed", False)
-        last_strategies_s = 0.0  # first generation never speculates
-
-        clk = self._clock.now
-
-        def _dispatch(t_next, speculative=None):
-            t_d0 = clk()
-            current_eps = self.eps(t_next)
-            if hasattr(self.acceptor, "note_epsilon"):
-                self.acceptor.note_epsilon(t_next, current_eps,
-                                           distance_changed_at_t)
-            n_t = self.population_strategy(t_next)
-            max_eval = (
-                n_t / min_acceptance_rate
-                if min_acceptance_rate > 0 else np.inf
-            )
-            logger.info("t: %d, eps: %.8g", t_next, current_eps)
-            with self.tracer.span("dispatch", t=int(t_next), n=int(n_t)):
-                spec = self._generation_spec(t_next)
-                spec_s = clk() - t_d0
-                handle = self.sampler.dispatch(n_t, spec, t_next,
-                                               max_eval=max_eval,
-                                               speculative=speculative)
-            handle["dispatch_telemetry"] = {
-                "spec_s": round(spec_s, 4),
-                "enqueue_s": round(clk() - t_d0 - spec_s, 4),
-            }
-            if speculative is not None:
-                handle["dispatch_telemetry"]["speculative_accepted"] = (
-                    len(handle["spec"]["slots"])
-                    if handle.get("spec") else 0
-                )
-            return handle, current_eps, n_t
-
-        handle, current_eps, n_t = _dispatch(t)
-        while True:
-            t_gen0 = clk()
-            with self.tracer.span("collect", t=int(t), n=int(n_t)):
-                sample = self.sampler.collect(handle)
-            sample_s = clk() - t_gen0
-            n_acc = sample.n_accepted if sample.ms is not None else len(
-                sample.accepted_particles
-            )
-            if n_acc < n_t:
-                logger.info(
-                    "stopping: only %d/%d accepted within budget", n_acc, n_t
-                )
-                break
-            pop = self._sample_to_population(sample)
-            nr_evals = self.sampler.nr_evaluations_
-            sims_total += nr_evals
-            acceptance_rate = n_t / nr_evals
-            logger.info(
-                "acceptance rate: %.5f (%d evaluations)", acceptance_rate,
-                nr_evals,
-            )
-            # shallow copy pins the PRE-adaptation distances for the db
-            # (_recompute_distances rebinds pop.distances; reference history
-            # keeps the original values)
-            db_pop = copy.copy(pop)
-
-            # central adaptation — the PROPOSAL part (transition refits)
-            # runs first so a speculative eps=+inf round for t+1 can start
-            # on the device WHILE the slow strategy updates (temperature
-            # bisection, epsilon quantiles, acceptor norms) run on the host;
-            # its delayed acceptance is applied at dispatch time (reference
-            # look-ahead with delayed evaluation, SURVEY.md §2.3)
-            t_adapt0 = clk()
-            spec_round = None
-            with self.tracer.span("adapt", t=int(t)):
-                self._adapt_proposal(pop)
-                # every stop rule is decidable BEFORE the slow strategy
-                # updates (model probs were refreshed by _adapt_proposal
-                # above) — don't burn a speculative round on a generation
-                # that will never be dispatched
-                surely_stopping = self._check_stop(
-                    t, current_eps, minimum_epsilon, max_nr_populations,
-                    acceptance_rate, min_acceptance_rate, sims_total,
-                    max_total_nr_simulations, max_walltime, start_walltime)
-                if (not surely_stopping
-                        and self._speculation_capable()
-                        and last_strategies_s > self.speculation_min_adapt_s):
-                    spec_round = self._dispatch_speculative_round(t + 1, n_t)
-                t_strat0 = clk()
-                distance_changed_at_t = self._adapt_strategies(
-                    t, sample, pop, current_eps, acceptance_rate
-                )
-                last_strategies_s = clk() - t_strat0
-            adapt_s = clk() - t_adapt0
-
-            # re-check AFTER the strategy updates: their duration counts
-            # against max_walltime (slow temperature bisections / distance
-            # refits must not buy an extra generation past the budget)
-            stop = surely_stopping or self._check_stop(
-                t, current_eps, minimum_epsilon, max_nr_populations,
-                acceptance_rate, min_acceptance_rate, sims_total,
-                max_total_nr_simulations, max_walltime, start_walltime)
-
-            if not stop:
-                # LOOK-AHEAD: device starts generation t+1 now ...
-                next_handle, next_eps, next_n = _dispatch(
-                    t + 1, speculative=spec_round)
-
-            # ... while the host persists generation t
-            t_persist0 = clk()
-            with self.tracer.span("persist", t=int(t)):
-                self.history.append_population(
-                    t, current_eps, db_pop, nr_evals, self.model_names,
-                    telemetry={"sample_s": round(sample_s, 4),
-                               "adapt_s": round(adapt_s, 4),
-                               "n_evaluations": int(nr_evals),
-                               "acceptance_rate": round(acceptance_rate, 6),
-                               "distance_changed":
-                                   bool(distance_changed_at_t),
-                               "pipelined": True,
-                               **handle.get("dispatch_telemetry", {})},
-                )
-            self.history.update_telemetry(
-                t, {"persist_s": round(clk() - t_persist0, 4)}
-            )
-            if stop:
-                break
-            handle, current_eps, n_t = next_handle, next_eps, next_n
-            t += 1
-        self.history.done()
-        return self.history
+        return run_pipelined(
+            self, t0, minimum_epsilon, max_nr_populations,
+            min_acceptance_rate, max_total_nr_simulations,
+            max_walltime, start_walltime,
+        )
 
     # -------------------------------------------------------- initialization
     def _initialize_components(self, max_nr_populations,

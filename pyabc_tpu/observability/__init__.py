@@ -83,6 +83,8 @@ __all__ = [
     "default_tracer", "global_metrics", "global_tracer",
     "set_global_tracer", "observability_snapshot",
     "register_worker_source", "unregister_worker_source",
+    "register_dispatch_source", "unregister_dispatch_source",
+    "dispatch_sources_snapshot",
 ]
 
 _lock = _threading.Lock()
@@ -178,13 +180,64 @@ def _workers_snapshot() -> dict:
     return out
 
 
+#: weakly-referenced providers of dispatch-engine state: each entry is a
+#: weakref to an object with ``snapshot() -> dict`` (the DispatchEngine
+#: registers itself on construction). Same lifecycle rules as the
+#: worker sources: dead refs prune on read.
+_dispatch_sources: list = []
+
+
+def register_dispatch_source(source) -> None:
+    """Register an object exposing ``snapshot()`` (dispatch-engine state:
+    in-flight chunks, speculative rollbacks, the per-run sync budget)
+    with the process-wide snapshot, via weakref — the dashboard's
+    ``/api/observability`` and the broker status then show the fused
+    run's dispatch health next to the elastic pool's."""
+    import weakref
+
+    with _lock:
+        _dispatch_sources.append(weakref.ref(source))
+
+
+def unregister_dispatch_source(source) -> None:
+    with _lock:
+        _dispatch_sources[:] = [
+            r for r in _dispatch_sources
+            if r() is not None and r() is not source
+        ]
+
+
+def dispatch_sources_snapshot() -> list:
+    """Snapshots of every live dispatch engine in this process."""
+    out: list = []
+    with _lock:
+        refs = list(_dispatch_sources)
+    for r in refs:
+        src = r()
+        if src is None:
+            continue
+        try:
+            out.append(src.snapshot())
+        except Exception as exc:  # snapshotting must never kill the
+            # dashboard — but the broken source is named, not swallowed
+            out.append({"__error__": repr(exc)[:200]})
+    with _lock:
+        _dispatch_sources[:] = [
+            r for r in _dispatch_sources if r() is not None
+        ]
+    return out
+
+
 def observability_snapshot() -> dict:
     """One JSON-ready dict of the process's tracer + metrics state —
     the in-process snapshot API (dashboard endpoint, bench block).
     ``workers`` carries the elastic pool's per-worker liveness, clock
-    offsets and last errors when a broker is live in this process."""
+    offsets and last errors when a broker is live in this process;
+    ``dispatch`` carries each live dispatch engine's state (in-flight
+    chunks, speculative rollbacks, sync budget)."""
     return {
         "tracer": global_tracer().snapshot(),
         "metrics": global_metrics().snapshot(),
         "workers": _workers_snapshot(),
+        "dispatch": dispatch_sources_snapshot(),
     }

@@ -200,6 +200,18 @@ PROPOSAL_WIDENINGS_TOTAL = "pyabc_tpu_health_proposal_widenings_total"
 #:  runs terminated with a typed DegenerateRunError (health trail attached)
 DEGENERATE_RUNS_TOTAL = "pyabc_tpu_degenerate_runs_total"
 
+# -- dispatch-engine instrument names (round 12) ------------------------------
+#
+# The single async dispatch engine (inference/dispatch.py) owns every
+# device round trip of a fused run and exports its two invariants:
+#:  blocking device round trips of the last completed run — the engine's
+#:  budget is `chunks + O(1)`, regression-guarded by the bench
+#:  `dispatch` lane
+SYNCS_PER_RUN_GAUGE = "pyabc_tpu_syncs_per_run"
+#:  speculative chunks rolled back unpersisted (dispatched past a
+#:  stopping-rule hit or discarded with a health-degraded carry)
+SPECULATIVE_ROLLBACKS_TOTAL = "pyabc_tpu_speculative_rollbacks_total"
+
 
 def health_event_metric(kind: str) -> str:
     """Per-kind health-event counter name — the registry's stand-in for

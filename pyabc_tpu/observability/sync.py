@@ -84,6 +84,25 @@ class SyncLedger:
             "tunnel_floor_s": round(n * float(sync_floor_s), 6),
         }
 
+    def budget_report(self, chunks: int, allowed: int) -> dict:
+        """Assert the dispatch engine's per-run sync budget
+        (``syncs_per_run <= chunks + O(1)``) against this ledger.
+
+        The engine computes ``allowed`` from its declared per-chunk
+        round trips (one packed fetch per processed chunk, plus opt-in
+        compute probes / checkpoint fetches) and an O(1) per-run
+        allowance; the LEDGER is the authority on what was actually
+        paid. ``ok=False`` means a blocking round trip crept into the
+        per-chunk path — the bench ``dispatch`` lane regression-guards
+        it and the engine raises under PYABC_TPU_SYNC_BUDGET_STRICT."""
+        n = self.count
+        return {
+            "syncs": int(n),
+            "chunks": int(chunks),
+            "allowed": int(allowed),
+            "ok": bool(n <= int(allowed)),
+        }
+
     def clear(self) -> None:
         with self._lock:
             self.events.clear()
@@ -111,6 +130,10 @@ class NullSyncLedger:
         return {"syncs": 0, "by_kind": {}, "bytes_by_kind": {},
                 "total_bytes": 0, "sync_floor_s": float(sync_floor_s),
                 "tunnel_floor_s": 0.0}
+
+    def budget_report(self, chunks: int, allowed: int) -> dict:
+        return {"syncs": 0, "chunks": int(chunks),
+                "allowed": int(allowed), "ok": True}
 
     def clear(self) -> None:
         pass

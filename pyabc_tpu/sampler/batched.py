@@ -137,7 +137,7 @@ class BatchedSampler(Sampler):
         generation-t weights, so no weight correction is needed).
 
         ``speculative``: an eps=+inf proposal round ALREADY dispatched for
-        this generation (ABCSMC._dispatch_speculative_round) — its delayed
+        this generation (inference.dispatch.dispatch_speculative_round) — its delayed
         host acceptance is applied now that the thresholds are final, and
         the main generation kernel only samples the SHORTFALL.
         """

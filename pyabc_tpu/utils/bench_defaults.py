@@ -89,3 +89,19 @@ DEFAULT_HEALTH_POP = 100
 DEFAULT_HEALTH_GENS = 8
 DEFAULT_HEALTH_G = 4
 HEALTH_MAX_ROLLBACKS = 1
+# dispatch lane (round 12): the single async dispatch engine measured on
+# BOTH bases of the SAME runs — strict wall clock (run start to last
+# persist, everything included) vs pipeline-full span (post-fill chunks
+# only) — so the dual-basis gap the engine exists to close is a guarded
+# ratio, not a narrative. CPU-capable fused gauss config; also exercises
+# a mid-schedule minimum_epsilon stop so >= 1 speculative chunk is
+# rolled back (bit-identity of that rollback is tier-1-tested in
+# tests/test_dispatch.py; the lane guards it stays EXERCISED).
+DEFAULT_DISPATCH_POP = 300
+DEFAULT_DISPATCH_GENS = 12
+DEFAULT_DISPATCH_G = 2
+DEFAULT_DISPATCH_RUNS = 3
+#: regression guard: strict wall-clock pps of a warm run must stay
+#: within this factor of the same run's pipeline-full span pps
+#: (ISSUE round 12 acceptance: within 1.5x)
+DISPATCH_WALL_TO_PIPELINE_MIN = 1.0 / 1.5

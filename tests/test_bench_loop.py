@@ -39,9 +39,11 @@ def bench(monkeypatch, tmp_path, capsys):
     monkeypatch.setenv("PYABC_TPU_BENCH_CPU", "1")
     monkeypatch.setenv("PYABC_TPU_BENCH_ELASTIC", "0")
     monkeypatch.setenv("PYABC_TPU_BENCH_RESILIENCE", "0")
-    # the health lane runs REAL fused runs on the shared tracer; these
-    # tests drive main() with fake runs and assert span-free coverage
+    # the health + dispatch lanes run REAL fused runs on the shared
+    # tracer; these tests drive main() with fake runs and assert
+    # span-free coverage
     monkeypatch.setenv("PYABC_TPU_BENCH_HEALTH", "0")
+    monkeypatch.setenv("PYABC_TPU_BENCH_DISPATCH", "0")
     monkeypatch.setattr(mod, "probe_platform", lambda *a, **k: "cpu")
     monkeypatch.setattr(mod, "run_host_baseline", lambda **k: 800.0)
     monkeypatch.setattr(

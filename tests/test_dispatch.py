@@ -1,0 +1,193 @@
+"""Dispatch-engine tests (round 12): the single async dispatch engine
+(`pyabc_tpu/inference/dispatch.py`) behind the fused path.
+
+Covers the engine-level guarantees the three-loop refactor must keep:
+
+- ``drain_join`` error paths: a background-drain failure re-raises on
+  join (not silently-partial History), a double join is a no-op, a
+  never-run object's join is a no-op;
+- speculative rollback: a run whose fetch pipeline dispatched chunks
+  PAST a stopping-rule hit discards them unpersisted — History is
+  bit-identical to a minimally-speculative run of the same seed — and
+  the rollback is counted (``pyabc_tpu_speculative_rollbacks_total``);
+- the per-run sync budget: ``syncs_per_run <= chunks + O(1)`` holds and
+  is exported (``pyabc_tpu_syncs_per_run`` gauge, engine snapshot,
+  ``/api/observability`` dispatch block).
+"""
+import jax
+import numpy as np
+import pytest
+
+import pyabc_tpu as pt
+from pyabc_tpu.observability import MetricsRegistry, observability_snapshot
+
+NOISE_SD = 0.5
+X_OBS = 1.0
+
+
+def _gauss_model():
+    @pt.JaxModel.from_function(["theta"], name="gauss_dispatch")
+    def model(key, theta):
+        return {"x": theta[0] + NOISE_SD * jax.random.normal(key)}
+
+    return model
+
+
+def _make(seed=81, pop=200, G=3, depth=3, metrics=None, **kwargs):
+    prior = pt.Distribution(theta=pt.RV("norm", 0.0, 1.0))
+    abc = pt.ABCSMC(
+        _gauss_model(), prior, pt.PNormDistance(p=2), population_size=pop,
+        eps=pt.MedianEpsilon(), seed=seed, fused_generations=G,
+        fetch_pipeline_depth=depth,
+        **({"metrics": metrics} if metrics is not None else {}),
+        **kwargs,
+    )
+    abc.new("sqlite://", {"x": X_OBS})
+    return abc
+
+
+def _history_arrays(h):
+    """Everything a bit-identity claim covers: epsilon trail plus every
+    generation's (theta, weight, distance) arrays."""
+    pops = h.get_all_populations().query("t >= 0")
+    out = {"eps": pops["epsilon"].to_numpy()}
+    for t in pops["t"]:
+        df, w = h.get_distribution(0, int(t))
+        out[f"theta_{t}"] = df["theta"].to_numpy()
+        out[f"w_{t}"] = np.asarray(w)
+        out[f"d_{t}"] = h.get_weighted_distances(
+            int(t))["distance"].to_numpy()
+    return out
+
+
+# ------------------------------------------------- drain_join error paths
+
+def test_drain_join_reraises_background_drain_error():
+    """An exception on the DRAIN thread (engine state DRAIN) must not
+    leave a silently partial History: drain_join re-raises it, and a
+    second join is a clean no-op (the error is consumed)."""
+    abc = _make(seed=83)
+    abc.drain_async = True
+
+    boom = RuntimeError("injected drain-side failure")
+    real_done = abc.history.done
+
+    def failing_done():
+        raise boom
+
+    # history.done() only runs in the engine's _complete(); on a
+    # drain_async run that is the drain thread's last act — so the
+    # failure happens strictly in the background
+    abc.history.done = failing_done
+    abc.run(max_nr_populations=9)
+    with pytest.raises(RuntimeError, match="injected drain-side"):
+        abc.drain_join()
+    # the error was consumed: a second join is a no-op, not a re-raise
+    abc.drain_join()
+    assert abc._drain_error is None
+    abc.history.done = real_done
+    abc.history.done()
+
+
+def test_drain_join_double_and_fresh_noop():
+    """drain_join is idempotent after a clean drain, and a no-op on an
+    object that never ran (no drain thread, no error)."""
+    abc = _make(seed=84)
+    abc.drain_async = True
+    h = abc.run(max_nr_populations=9)
+    abc.drain_join()
+    assert abc._drain_thread is None
+    abc.drain_join()  # second join: no thread, no error, no exception
+    assert h.n_populations == 9
+
+    fresh = _make(seed=85)
+    fresh.drain_join()  # never ran: nothing to join
+    assert fresh._drain_thread is None and fresh._drain_error is None
+
+
+# ------------------------------------- speculative rollback bit-identity
+
+def test_speculative_rollback_history_bit_identical():
+    """A stopping-rule hit (minimum_epsilon) lands mid-schedule while
+    the engine has speculative chunks in flight; they are rolled back
+    unpersisted. The History must be BIT-identical to a run of the same
+    seed with the minimal pipeline (depth 1): same epsilon trail, same
+    per-generation theta/weight/distance arrays, same generation count —
+    speculation may never change results, only hide latency."""
+    # reference trail to place the threshold mid-run (generation ~4 of 12)
+    probe = _make(seed=77, G=2, depth=1)
+    h_probe = probe.run(max_nr_populations=6)
+    eps_trail = h_probe.get_all_populations().query(
+        "t >= 0")["epsilon"].to_numpy()
+    assert len(eps_trail) >= 4
+    min_eps = float(eps_trail[3])  # stop once eps_used <= trail[3]
+
+    reg_spec = MetricsRegistry()
+    spec = _make(seed=77, G=2, depth=4, metrics=reg_spec)
+    spec.adopt_device_context(probe)
+    h_spec = spec.run(minimum_epsilon=min_eps, max_nr_populations=12)
+    eng = spec._engine
+    assert eng is not None
+    # the 12-generation schedule at G=2 keeps up to 4 chunks in flight;
+    # the stop at ~generation 4 must have discarded at least one
+    assert eng.speculative_rollbacks >= 1
+    assert reg_spec.snapshot()[
+        "pyabc_tpu_speculative_rollbacks_total"] >= 1
+
+    ref = _make(seed=77, G=2, depth=1)
+    ref.adopt_device_context(probe)
+    h_ref = ref.run(minimum_epsilon=min_eps, max_nr_populations=12)
+
+    a, b = _history_arrays(h_spec), _history_arrays(h_ref)
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(
+            a[k], b[k], err_msg=f"speculative run diverged at {k}"
+        )
+    # and nothing past the stop persisted: both stopped where the probe
+    # trail says the threshold was crossed
+    assert h_spec.n_populations == h_ref.n_populations <= 6
+
+
+# ----------------------------------------------------------- sync budget
+
+def test_sync_budget_and_snapshot():
+    """The engine's per-run sync budget holds on a clean fused run
+    (`syncs_per_run <= chunks + O(1)`, asserted through
+    SyncLedger.budget_report), the gauge is exported, and the engine's
+    state rides the process observability snapshot."""
+    reg = MetricsRegistry()
+    abc = _make(seed=86, metrics=reg)
+    h = abc.run(max_nr_populations=9)
+    assert h.n_populations == 9
+    eng = abc._engine
+    report = eng.sync_budget_report()
+    assert report["ok"], (report, abc.sync_ledger.by_kind())
+    assert report["syncs"] <= report["chunks"] + 8
+    assert report["chunks"] == eng.chunks_processed >= 1
+    snap = reg.snapshot()
+    assert snap["pyabc_tpu_syncs_per_run"] == report["syncs"]
+    # engine snapshot reaches the process-wide observability snapshot
+    # (the /api/observability "dispatch" block) while the engine lives
+    snap_proc = observability_snapshot()
+    states = [d.get("state") for d in snap_proc["dispatch"]]
+    assert "done" in states
+    # the gauge also lands on the process-wide registry, so dashboards
+    # and the broker-status path see it without the run's registry
+    assert snap_proc["metrics"][
+        "pyabc_tpu_syncs_per_run"] == report["syncs"]
+
+
+def test_sync_budget_strict_mode_raises(monkeypatch):
+    """Under PYABC_TPU_SYNC_BUDGET_STRICT a budget violation is fatal —
+    the bench dispatch lane and CI run with the invariant armed."""
+    monkeypatch.setenv("PYABC_TPU_SYNC_BUDGET_STRICT", "1")
+    abc = _make(seed=87)
+    # poison the ledger with per-chunk-looking noise far past any O(1)
+    # allowance BEFORE the run so _complete() sees a violation
+    for _ in range(64):
+        abc.sync_ledger.record("rogue_per_chunk_sync")
+    with pytest.raises(RuntimeError, match="sync budget exceeded"):
+        abc.run(max_nr_populations=5)
+    # the run still flushed/persisted what it had (no silent loss)
+    assert abc.history.n_populations >= 1

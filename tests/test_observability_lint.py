@@ -29,6 +29,9 @@ REPO = Path(__file__).resolve().parent.parent
 INSTRUMENTED = [
     "bench.py",
     "pyabc_tpu/inference/smc.py",
+    # round 12: the dispatch engine owns every chunk round trip — its
+    # fetch/probe timestamps and spans must live on the injected clock
+    "pyabc_tpu/inference/dispatch.py",
     "pyabc_tpu/sampler/batched.py",
     "pyabc_tpu/broker/broker.py",
     "pyabc_tpu/broker/protocol.py",

@@ -32,6 +32,7 @@ from pyabc_tpu.analysis.engine import (
     Finding,
 )
 from pyabc_tpu.analysis.rules.clock import Clock001
+from pyabc_tpu.analysis.rules.dispatch import Disp001
 from pyabc_tpu.analysis.rules.exceptions import Exc001
 from pyabc_tpu.analysis.rules.locks import Lock001
 from pyabc_tpu.analysis.rules.rng import Rng001
@@ -324,6 +325,65 @@ def test_lock001_real_tree_contracts_hold():
     # and the contracts are actually declared (not silently dropped)
     broker_src = files[0].read_text()
     assert broker_src.count("abc-lint: guarded-by=_lock") >= 10
+
+
+# --------------------------------------------------------------- DISP001
+
+def test_disp001_fires_outside_engine_module():
+    src = """
+def sneak_dispatch(self, carry, t):
+    return self.kern_cache.multigen_kernel(8, 256, 1, 4, 8)
+def sneak_fetch(self, ctx, outs):
+    return ctx.fetch_pack_kernel(n_keep=64, dtype_name="float16")(outs)
+def sneak_round(self, ctx, B, mode, key, dyn):
+    return ctx.round_kernel(B, mode)(key, dyn)
+"""
+    open_, _ = check(Disp001(), src, "pyabc_tpu/inference/smc.py")
+    assert len(open_) == 3, [f.to_dict() for f in open_]
+
+
+def test_disp001_engine_and_util_exempt():
+    src = "def build(self, ctx):\n    return ctx.multigen_kernel(1)\n"
+    assert not Disp001().applies_to("pyabc_tpu/inference/dispatch.py")
+    assert not Disp001().applies_to("pyabc_tpu/inference/util.py")
+    assert not Disp001().applies_to("tests/test_mesh.py")
+    assert Disp001().applies_to("pyabc_tpu/inference/smc.py")
+    assert Disp001().applies_to("pyabc_tpu/sampler/batched.py")
+    open_, _ = check(Disp001(), src, "pyabc_tpu/inference/x.py")
+    assert len(open_) == 1
+
+
+def test_disp001_suppression_with_reason():
+    src = """
+def probe(ctx, outs):
+    # abc-lint: disable=DISP001 standalone diagnostic outside any run
+    return ctx.fetch_pack_kernel(n_keep=8, dtype_name="float32")(outs)
+"""
+    open_, sup = check(Disp001(), src, "pyabc_tpu/inference/x.py")
+    assert open_ == [] and len(sup) == 1
+
+
+def test_disp001_mutation_direct_dispatch_in_smc_fails():
+    """THE mutation guard: re-growing a direct chunk dispatch/fetch in
+    smc.py (the three-loop pattern this rule exists to prevent) must
+    make DISP001 fire — today's smc.py is clean, a re-added call is a
+    finding."""
+    path = REPO / "pyabc_tpu" / "inference" / "smc.py"
+    src = path.read_text()
+    rel = "pyabc_tpu/inference/smc.py"
+    open_, _ = check(Disp001(), src, rel)
+    assert open_ == [], [f.to_dict() for f in open_]
+    mutated = src + (
+        "\n\ndef _resurrected_loop(self, ctx, outs):\n"
+        "    tree = ctx.fetch_pack_kernel(n_keep=64,\n"
+        "                                 dtype_name='float16')(outs)\n"
+        "    return tree\n"
+    )
+    open_m, _ = check(Disp001(), mutated, rel)
+    assert len(open_m) >= 1, (
+        "a direct fetch_pack_kernel call re-added to smc.py left "
+        "DISP001 silent — the engine's single-door invariant is no "
+        "longer guarded")
 
 
 # --------------------------------------------------------------- TELEM001
