@@ -1089,6 +1089,213 @@ def run_dispatch_lane(budget_s: float) -> dict:
     return out
 
 
+# -- mesh lane ----------------------------------------------------------------
+
+
+def mesh_lane_skip_reason() -> str | None:
+    """The `mesh` lane proves the sharded fused path (ISSUE 9) end to
+    end: the MULTICHIP dryrun promoted to a first-class measurement —
+    an 8-device sharded LV run with posterior parity, the untouched
+    sync budget, and a recorded per-device pps baseline for the next
+    TPU session. Runs in a SUBPROCESS (forced 8 virtual CPU devices
+    when no real multi-device platform exists), so it can never
+    pollute the parent bench's backend. PYABC_TPU_BENCH_MESH=0
+    disables it."""
+    if os.environ.get("PYABC_TPU_BENCH_MESH") == "0":
+        return "disabled via PYABC_TPU_BENCH_MESH=0"
+    return None
+
+
+def _mesh_lane_child() -> dict:
+    """The mesh lane's measured body — runs in the lane subprocess with
+    the multi-device platform already configured. Three seed-matched
+    runs of the SAME LV config:
+
+    1. virtual shards (``sharded=8``, no mesh) — the lane-key-reduction
+       parity reference;
+    2. the real mesh run (``mesh=local_mesh()``) — must be posterior-
+       IDENTICAL to (1) through the reduction, with the SyncLedger
+       budget strict and the imbalance gauge recorded;
+    3. the plain single-device run — statistical parity + the pps
+       denominator for the scaling ratio.
+
+    A warm mesh run (adopted kernels) supplies the headline
+    ``accepted_particles_per_sec_mesh``; per-device pps is the number
+    the next TPU session compares real chips against.
+    """
+    import jax
+    import numpy as np
+
+    import pyabc_tpu as pt
+    from pyabc_tpu.models import lotka_volterra as lv
+    from pyabc_tpu.observability import SYSTEM_CLOCK
+    from pyabc_tpu.parallel.distributed import local_mesh
+    from pyabc_tpu.utils.bench_defaults import (
+        DEFAULT_MESH_BUDGET_S,
+        DEFAULT_MESH_G,
+        DEFAULT_MESH_GENS,
+        DEFAULT_MESH_POP,
+    )
+
+    clock = SYSTEM_CLOCK
+    t0 = clock.now()
+    budget = float(os.environ.get("PYABC_TPU_BENCH_MESH_BUDGET_S",
+                                  DEFAULT_MESH_BUDGET_S))
+    pop = int(os.environ.get("PYABC_TPU_BENCH_MESH_POP",
+                             DEFAULT_MESH_POP))
+    G = int(os.environ.get("PYABC_TPU_BENCH_MESH_G", DEFAULT_MESH_G))
+    gens = int(os.environ.get("PYABC_TPU_BENCH_MESH_GENS",
+                              DEFAULT_MESH_GENS))
+    devs = jax.devices()
+    n_dev = len(devs)
+    out = {"n_devices": n_dev, "platform": devs[0].platform,
+           "pop_size": pop, "fused_generations": G, "generations": gens}
+    if n_dev < 2:
+        out["skipped"] = (
+            f"only {n_dev} device(s) available and forcing virtual "
+            f"devices was unavailable on this platform")
+        return out
+
+    def make(mesh=None, sharded=None, seed=7):
+        abc = pt.ABCSMC(
+            lv.make_lv_model(), lv.default_prior(), pt.PNormDistance(p=2),
+            population_size=pop, eps=pt.MedianEpsilon(), seed=seed,
+            mesh=mesh, sharded=sharded, fused_generations=G,
+        )
+        abc.new("sqlite://", lv.observed_data(seed=123),
+                store_sum_stats=False)
+        return abc
+
+    def run(abc, max_pops=gens):
+        t_run = clock.now()
+        h = abc.run(max_nr_populations=max_pops)
+        wall = clock.now() - t_run
+        df, w = h.get_distribution(0, h.max_t)
+        post = {c: float(np.sum(df[c].to_numpy() * np.asarray(w)))
+                for c in df.columns}
+        eps = h.get_all_populations().query(
+            "t >= 0")["epsilon"].to_numpy()
+        return h, wall, post, eps
+
+    # (1) the parity reference: the same reduction on one device
+    abc_v = make(sharded=n_dev)
+    h_v, _, post_v, eps_v = run(abc_v)
+    # (2) the real mesh run
+    mesh = local_mesh(n_dev)
+    abc_m = make(mesh=mesh)
+    h_m, wall_m_cold, post_m, eps_m = run(abc_m)
+    budget_rep = abc_m._engine.sync_budget_report()
+    snap = abc_m._engine.snapshot()
+    parity_eps = float(np.max(np.abs(eps_m - eps_v))) \
+        if len(eps_m) == len(eps_v) else float("inf")
+    parity_post = float(max(
+        abs(post_m[k] - post_v[k]) for k in post_m))
+    # warm mesh run: adopted kernels, pure steady state — the headline
+    pps_mesh = None
+    if clock.now() - t0 < budget * 0.7:
+        abc_w = make(mesh=mesh, seed=8)
+        abc_w.adopt_device_context(abc_m)
+        h_w, wall_w, _, _ = run(abc_w)
+        pps_mesh = pop * h_w.n_populations / max(wall_w, 1e-9)
+    # (3) single-device statistical reference + scaling denominator
+    pps_single = None
+    post_s = None
+    if clock.now() - t0 < budget * 0.85:
+        abc_s = make()
+        h_s, wall_s, post_s, _ = run(abc_s)
+        abc_s2 = make(seed=8)
+        adopted = True
+        try:
+            abc_s2.adopt_device_context(abc_s)
+        except Exception:
+            adopted = False
+        if adopted:
+            h_s2, wall_s2, _, _ = run(abc_s2)
+            pps_single = pop * h_s2.n_populations / max(wall_s2, 1e-9)
+        else:
+            pps_single = pop * h_s.n_populations / max(wall_s, 1e-9)
+    out.update({
+        "accepted_particles_per_sec_mesh": (
+            round(pps_mesh, 1) if pps_mesh else None),
+        "accepted_particles_per_sec_per_device": (
+            round(pps_mesh / n_dev, 1) if pps_mesh else None),
+        "accepted_particles_per_sec_single_device": (
+            round(pps_single, 1) if pps_single else None),
+        # NOTE for the TPU session: on forced VIRTUAL cpu devices the 8
+        # "devices" share the same cores, so this ratio measures
+        # sharding overhead, not scaling; real chips are where
+        # near-linear scaling is the target
+        "mesh_vs_single_ratio": (
+            round(pps_mesh / pps_single, 3)
+            if pps_mesh and pps_single else None),
+        "wall_cold_mesh_s": round(wall_m_cold, 2),
+        "posterior_mesh": {k: round(v, 5) for k, v in post_m.items()},
+        "posterior_single": (
+            {k: round(v, 5) for k, v in post_s.items()}
+            if post_s else None),
+        "parity": {
+            "max_abs_eps_diff_vs_virtual_shards": parity_eps,
+            "max_abs_posterior_diff_vs_virtual_shards": parity_post,
+            "generations": int(h_m.n_populations),
+        },
+        "util": {
+            "syncs_per_run": int(budget_rep["syncs"]),
+            "chunks_per_run": int(budget_rep["chunks"]),
+            "sync_budget_ok": bool(budget_rep["ok"]),
+            "imbalance": snap.get("mesh", {}).get("imbalance"),
+            "rounds_per_device": snap.get("mesh", {}).get(
+                "rounds_per_device"),
+        },
+        "regression_guard": {
+            # acceptance criterion: the mesh run is posterior-identical
+            # (through the lane-key reduction) to the reference
+            "pass_posterior_parity": bool(
+                parity_eps == 0.0 and parity_post == 0.0
+                and h_m.n_populations == h_v.n_populations),
+            # the row merge rides the packed fetch: budget untouched
+            "pass_sync_budget": bool(budget_rep["ok"]),
+            "pass_completed": bool(h_m.n_populations == gens),
+        },
+        "lane_s": round(clock.now() - t0, 2),
+    })
+    return out
+
+
+def run_mesh_lane(budget_s: float, platform: str = "cpu") -> dict:
+    """Run the mesh lane in a subprocess. On accelerator platforms the
+    child sees the real devices; on CPU it forces 8 virtual devices
+    (``--xla_force_host_platform_device_count``) — the same rig the
+    test suite and the CI ``mesh`` job use. A hung child never eats
+    the bench budget (timeout -> recorded error)."""
+    budget_s = max(float(budget_s), 60.0)
+    env = dict(os.environ)
+    env["PYABC_TPU_BENCH_MESH_CHILD"] = "1"
+    env["PYABC_TPU_BENCH_MESH_BUDGET_S"] = str(budget_s * 0.9)
+    # the budget is an armed invariant in the lane, not a soft warning
+    env["PYABC_TPU_SYNC_BUDGET_STRICT"] = "1"
+    if platform == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(HERE, "bench.py")],
+            env=env, capture_output=True, text=True, timeout=budget_s,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"mesh lane child timed out after {budget_s}s"}
+    for line in reversed(proc.stdout.strip().splitlines() or [""]):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    return {"error": f"mesh lane child rc={proc.returncode}: "
+                     f"{(proc.stderr or '')[-400:]}"}
+
+
 def main():
     from pyabc_tpu.utils.bench_defaults import (
         DEFAULT_BUDGET_S,
@@ -1118,6 +1325,29 @@ def main():
     _state["platform"] = platform
     if platform == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
+
+    # `abc-bench --lane mesh`: the MULTICHIP dryrun promoted to a
+    # first-class path — run ONLY the mesh lane and emit its JSON line
+    if (os.environ.get("PYABC_TPU_BENCH_LANE") or "").strip().lower() \
+            == "mesh":
+        _state["phase"] = "mesh"
+        _state["metric"] = "accepted_particles_per_sec_lv_mesh"
+        mesh_skip = mesh_lane_skip_reason()
+        if mesh_skip:
+            _state["mesh"] = {"skipped": mesh_skip}
+        else:
+            try:
+                _state["mesh"] = run_mesh_lane(
+                    budget - max(10.0, 0.05 * budget), platform)
+            except Exception as e:
+                _state["mesh"] = {"error": repr(e)[:300]}
+        _state["value"] = float(
+            _state["mesh"].get("accepted_particles_per_sec_mesh") or 0.0)
+        _state["partial"] = False
+        _state["budget_used_s"] = round(CLOCK.now() - t_start, 1)
+        _state["phase"] = "done"
+        _emit()
+        return
 
     # baseline first (cached): it is cheap and makes vs_baseline meaningful
     # even if the main run is cut short
@@ -1172,9 +1402,11 @@ def main():
     health_share = 0.0 if health_skip else 0.06
     dispatch_skip = dispatch_lane_skip_reason()
     dispatch_share = 0.0 if dispatch_skip else 0.10
+    mesh_skip = mesh_lane_skip_reason()
+    mesh_share = 0.0 if mesh_skip else 0.10
     spend_until = t_start + (budget - reserve) * (
         1.0 - scale_share - elastic_share - resilience_share
-        - health_share - dispatch_share)
+        - health_share - dispatch_share - mesh_share)
     # per-run host setup (ABCSMC construction, History/sqlite DDL, kernel
     # adoption) runs on this thread OVERLAPPED with the previous run's
     # device chunks — round 5 measured it as dark inter-run wall clock
@@ -1339,9 +1571,24 @@ def main():
         _state["phase"] = "dispatch"
         try:
             _state["dispatch"] = run_dispatch_lane(
-                max(t_start + budget - reserve - CLOCK.now(), 25.0))
+                max(t_start + budget - reserve - CLOCK.now()
+                    - (budget - reserve) * mesh_share, 25.0))
         except Exception as e:
             _state["dispatch"] = {"error": repr(e)[:300]}
+
+    # -- mesh lane: sharded fused sampling on the device mesh (round 13;
+    # runs in a forced-8-device subprocess — or its recorded skip
+    # reason, never silent)
+    if mesh_skip:
+        _state["mesh"] = {"skipped": mesh_skip}
+    else:
+        _state["phase"] = "mesh"
+        try:
+            _state["mesh"] = run_mesh_lane(
+                max(t_start + budget - reserve - CLOCK.now(), 60.0),
+                platform)
+        except Exception as e:
+            _state["mesh"] = {"error": repr(e)[:300]}
 
     _state["budget_used_s"] = round(CLOCK.now() - t_start, 1)
     _state["pop_size"] = pop
@@ -1637,4 +1884,12 @@ def _update_headline(events, run_infos, baseline, probe_events=None,
 
 
 if __name__ == "__main__":
+    if os.environ.get("PYABC_TPU_BENCH_MESH_CHILD"):
+        # mesh-lane subprocess: the multi-device platform is already
+        # configured in the environment; disarm the emit-once hook (its
+        # headline JSON would shadow the lane's line at exit) and print
+        # ONE JSON line
+        _emitted = True
+        print(json.dumps(_mesh_lane_child()))
+        sys.exit(0)
     main()

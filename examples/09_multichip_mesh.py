@@ -55,15 +55,27 @@ def main():
     def model(key, theta):
         return {"x": theta[0] + 0.5 * jax.random.normal(key)}
 
+    # non-adaptive distance => the SHARDED fused path (ISSUE 9): the
+    # population axis splits over the mesh via shard_map — per-device
+    # lane-key blocks and reservoirs, scalar-column collectives per
+    # generation, ONE row all-gather per chunk riding the packed fetch.
+    # (An adaptive distance would transparently fall back to the GSPMD
+    # replicated path; same API, same results.)
     abc = pt.ABCSMC(
         model, pt.Distribution(theta=pt.RV("norm", 0.0, 1.0)),
-        pt.AdaptivePNormDistance(p=2),
+        pt.PNormDistance(p=2),
         population_size=POP, eps=pt.MedianEpsilon(),
         seed=7, mesh=mesh, fused_generations=4,
     )
     assert abc._fused_chunk_capable(), "fused multigen path must be active"
+    if n_dev > 1 and (n_dev & (n_dev - 1)) == 0:
+        assert abc._sharded_n() == n_dev, "sharded path must be active"
     abc.new("sqlite://", {"x": 1.0})
     h = abc.run(max_nr_populations=GENS)
+    if abc._engine is not None and abc._engine.mesh_shards:
+        ms = abc._engine.snapshot()["mesh"]
+        print(f"sharded over {ms['devices']} devices, "
+              f"imbalance {ms.get('imbalance')}")
     eps = h.get_all_populations().query("t >= 0")["epsilon"].to_numpy()
     assert (np.diff(eps) < 0).all(), eps
     df, w = h.get_distribution(0, h.max_t)

@@ -9,6 +9,7 @@ give it a unique ``NAMEnnn`` id, and append it to :data:`RULE_CLASSES`
 from __future__ import annotations
 
 from .clock import Clock001
+from .collectives import Mesh001
 from .dispatch import Disp001
 from .exceptions import Exc001
 from .locks import Lock001
@@ -17,7 +18,7 @@ from .sync import Sync001
 from .telemetry import Telem001
 
 RULE_CLASSES = [Sync001, Clock001, Rng001, Exc001, Lock001, Telem001,
-                Disp001]
+                Disp001, Mesh001]
 
 
 def all_rules():

@@ -1,0 +1,74 @@
+"""MESH001 — cross-device collectives live in the kernel layer only.
+
+The sharded fused path (ISSUE 9) holds one discipline: cross-device
+traffic is a property of the KERNELS, budgeted and placed deliberately —
+per-generation scalar-column gathers and the chunk-boundary row merge
+inside ``DeviceContext``'s programs (``pyabc_tpu/inference/util.py``)
+plus the shard math in ``pyabc_tpu/ops/``. A collective anywhere else
+(``psum`` in an orchestrator, a stray ``all_gather`` in a sampler, a
+``shard_map`` wrapping host code) is an unbudgeted sync path: it bypasses
+the SyncLedger accounting, the ``syncs_per_run <= chunks + O(1)``
+invariant, and the chunk-boundary-only contract the bench ``mesh`` lane
+regression-guards. This rule makes the placement structural, the same
+way DISP001 pins dispatch/fetch to the engine.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileContext, Finding, Rule
+
+#: the cross-device surface: invoking any of these moves data (or
+#: partitions execution) across mesh devices
+COLLECTIVE_CALLS = {
+    "all_gather", "psum", "psum_scatter", "pmean", "pmin", "pmax",
+    "ppermute", "pshuffle", "all_to_all", "axis_index", "shard_map",
+}
+
+#: where collectives are legitimate: the kernel/composition layer
+#: (DeviceContext's programs) and the device-op modules under ops/
+ALLOWED_PREFIXES = ("pyabc_tpu/ops/",)
+ALLOWED_FILES = {"pyabc_tpu/inference/util.py"}
+
+
+class Mesh001(Rule):
+    name = "MESH001"
+    summary = ("cross-device collective outside the kernel layer "
+               "(inference/util.py + ops/)")
+    hint = ("place collectives inside DeviceContext's jitted programs "
+            "(pyabc_tpu/inference/util.py) or pyabc_tpu/ops/ — the "
+            "sharded path's contract is scalar-column gathers per "
+            "generation and ONE row merge per chunk riding the packed "
+            "fetch; a collective elsewhere is an unbudgeted sync path")
+
+    def applies_to(self, rel: str) -> bool:
+        if not rel.startswith("pyabc_tpu/"):
+            return False
+        if rel.startswith("pyabc_tpu/analysis/"):
+            return False
+        if rel in ALLOWED_FILES:
+            return False
+        return not any(rel.startswith(p) for p in ALLOWED_PREFIXES)
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = None
+            if isinstance(func, ast.Attribute) \
+                    and func.attr in COLLECTIVE_CALLS:
+                name = func.attr
+            elif isinstance(func, ast.Name) and func.id in COLLECTIVE_CALLS:
+                name = func.id
+            if name is None:
+                continue
+            findings.append(self.finding(
+                ctx, node,
+                f"`{name}(...)` is a cross-device collective outside "
+                f"the kernel layer — mesh traffic belongs in "
+                f"pyabc_tpu/inference/util.py or pyabc_tpu/ops/, where "
+                f"the chunk-boundary-only contract is enforced",
+            ))
+        return findings

@@ -83,10 +83,18 @@ def export_cmd(db, run_id, what, time_point, m, fmt, out):
 @click.option("--budget-s", type=float, default=DEFAULT_BUDGET_S,
               help="walltime budget in seconds")
 @click.option("--cpu", is_flag=True, help="force the CPU platform")
-def bench_cmd(pop, gens, budget_s, cpu):
+@click.option("--lane", type=click.Choice(["all", "mesh"]), default="all",
+              help="run only one bench lane: 'mesh' runs the sharded "
+                   "multi-device lane (the MULTICHIP dryrun promoted to "
+                   "a first-class path; forces 8 virtual CPU devices "
+                   "when no multi-device platform exists). Requires a "
+                   "repo checkout (bench.py).")
+def bench_cmd(pop, gens, budget_s, cpu, lane):
     """Run the Lotka-Volterra throughput benchmark (one JSON line)."""
     if cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
+    if lane and lane != "all":
+        os.environ["PYABC_TPU_BENCH_LANE"] = lane
     # explicit CLI flags win over any pre-existing env configuration
     os.environ["PYABC_TPU_BENCH_POP"] = str(pop)
     if gens is not None:

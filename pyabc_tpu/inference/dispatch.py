@@ -62,6 +62,9 @@ import numpy as np
 
 from ..observability import register_dispatch_source
 from ..observability.metrics import (
+    MESH_BUSY_MAX_GAUGE,
+    MESH_DEVICES_GAUGE,
+    MESH_IMBALANCE_GAUGE,
     SPECULATIVE_ROLLBACKS_TOTAL,
     SYNCS_PER_RUN_GAUGE,
 )
@@ -103,7 +106,7 @@ class DispatchEngine:
                  chunk_host_args, rebuild_carry, stop, n_of,
                  sumstat_refit=False, adaptive=False, stochastic=False,
                  temp_fixed=False, eps_quantile=False, adaptive_n=False,
-                 n_keep=None):
+                 n_keep=None, shard_merge=None, mesh_shards=None):
         from concurrent.futures import ThreadPoolExecutor
 
         self.owner = owner
@@ -120,6 +123,16 @@ class DispatchEngine:
         self.eps_quantile = bool(eps_quantile)
         self.adaptive_n = bool(adaptive_n)
         self.n_keep = n_keep
+        #: sharded fused sampling: the static row gather merging the
+        #: shard-blocked per-device reservoirs inside the packed fetch
+        #: (ops/shard.py::merge_index), and the mesh width for the
+        #: observability gauges. None/None on unsharded runs.
+        self.shard_merge = shard_merge
+        self.mesh_shards = int(mesh_shards) if mesh_shards else None
+        #: per-shard accounting of the last processed chunk (rounds and
+        #: accepted rows per device, imbalance ratio) — surfaced in
+        #: snapshot()["mesh"] and the pyabc_tpu_mesh_* gauges
+        self._mesh_stats = None
         self._clock = owner._clock
         # sumstat_refit mode can't speculate: each next chunk's carry
         # needs the host predictor refit on the previous chunk's last
@@ -203,7 +216,7 @@ class DispatchEngine:
 
     def snapshot(self) -> dict:
         """JSON-ready engine state for the observability snapshot."""
-        return {
+        snap = {
             "state": self.state,
             "t": int(self.t),
             "in_flight": len(self.pending),
@@ -213,6 +226,55 @@ class DispatchEngine:
             "speculative_rollbacks": int(self.speculative_rollbacks),
             "sync_budget": self.sync_budget_report(),
         }
+        if self.mesh_shards:
+            snap["mesh"] = {
+                "devices": int(self.mesh_shards),
+                "sharded": True,
+                **(self._mesh_stats or {}),
+            }
+        return snap
+
+    def _note_mesh_stats(self, fetched, g_done: int) -> None:
+        """Per-device busy/imbalance accounting from the chunk's
+        ``rounds_shard`` / ``n_acc_shard`` outputs (sharded runs ship
+        them on the packed fetch — zero extra syncs). Imbalance = max
+        over shards of rounds worked / mean — the number the mesh lane
+        records so uneven acceptance across shards is measured, not
+        assumed."""
+        if "rounds_shard" not in fetched or g_done <= 0:
+            return
+        rounds = np.asarray(fetched["rounds_shard"])[:g_done]
+        n_acc = np.asarray(fetched["n_acc_shard"])[:g_done]
+        per_dev_rounds = rounds.sum(axis=0).astype(float)
+        mean = float(per_dev_rounds.mean())
+        imbalance = (float(per_dev_rounds.max()) / mean
+                     if mean > 0 else 1.0)
+        busy_max = (float(per_dev_rounds.max()) / float(
+            per_dev_rounds.sum()) if per_dev_rounds.sum() > 0
+            else 1.0 / max(self.mesh_shards or 1, 1))
+        self._mesh_stats = {
+            "rounds_per_device": [int(r) for r in per_dev_rounds],
+            "accepted_per_device": [int(a) for a in n_acc.sum(axis=0)],
+            "imbalance": round(imbalance, 4),
+            "busy_max_frac": round(busy_max, 4),
+        }
+        from ..observability import global_metrics
+
+        for reg in (self.owner.metrics, global_metrics()):
+            reg.gauge(
+                MESH_DEVICES_GAUGE,
+                "devices of the mesh the sharded multigen kernel runs on",
+            ).set(float(self.mesh_shards))
+            reg.gauge(
+                MESH_IMBALANCE_GAUGE,
+                "per-shard proposal-round imbalance of the last chunk "
+                "(max/mean; 1.0 = perfectly balanced)",
+            ).set(imbalance)
+            reg.gauge(
+                MESH_BUSY_MAX_GAUGE,
+                "busiest shard's share of mesh proposal rounds in the "
+                "last chunk",
+            ).set(busy_max)
 
     def sync_budget_report(self) -> dict:
         """The per-run sync budget, asserted through the SyncLedger:
@@ -296,6 +358,7 @@ class DispatchEngine:
         tree = self.ctx.fetch_pack_kernel(
             n_keep=self.n_keep, dtype_name=self.fetch_dtype,
             keep_m=owner.K > 1, ss_gens=ss_gens, g_keep=int(g_lim),
+            merge_index=self.shard_merge,
         )(outs)
         if "calib" in res_i and t_at == 0:
             # the run-starting chunk carries the in-kernel calibration's
@@ -538,6 +601,8 @@ class DispatchEngine:
                 "pyabc_tpu_particles_accepted",
                 "accepted particles across fused chunks",
             ).inc(int(n_acc_chunk))
+        if self.mesh_shards:
+            self._note_mesh_stats(fetched, int(g_done))
         if health_fail is None and not stop and g_done == g_lim:
             # the chunk boundary is known-healthy: it becomes the
             # supervisor's rollback target and the graceful-shutdown

@@ -50,6 +50,7 @@ from ..populationstrategy import (
 from ..sampler.base import Sampler
 from ..sampler.batched import BatchedSampler
 from ..sampler.singlecore import SingleCoreSampler
+from ..ops.shard import merge_index as _shard_merge_index
 from ..storage.history import History
 from ..transition import (
     GridSearchCV,
@@ -162,6 +163,7 @@ class ABCSMC:
                  max_nr_recorded_particles: float = np.inf,
                  seed: int = 0,
                  mesh=None,
+                 sharded: int | bool | None = None,
                  pipeline: bool = True,
                  fused_generations: int = 8,
                  fetch_pipeline_depth: int = 3,
@@ -237,6 +239,21 @@ class ABCSMC:
         self.max_nr_recorded_particles = max_nr_recorded_particles
         self.seed = seed
         self.mesh = mesh
+        #: sharded fused sampling (ISSUE 9): split the population axis of
+        #: the multigen kernel over the one-axis device mesh with
+        #: shard_map — per-device lane-key blocks and reservoirs, scalar-
+        #: column collectives per generation, the accepted-row merge as a
+        #: single all-gather riding the packed fetch at chunk boundaries.
+        #: ``None`` (auto): shard whenever a single-process multi-device
+        #: mesh is present and the config is sharded-capable (constant
+        #: population, non-adaptive distance, uniform acceptor; see
+        #: ``_sharded_incapable_reason``), else fall back to the GSPMD
+        #: constraint path. ``True``: require it (raise with the reason
+        #: when unavailable). ``False``/``0``: never. An ``int`` without
+        #: a mesh runs the SAME reduction vmapped over that many virtual
+        #: shards on one device — the bit-level parity reference the
+        #: sharded tests compare a real mesh run against.
+        self.sharded = sharded
         #: overlap host persistence with the next generation's device run
         #: (the look-ahead analog; proposals use FINAL weights so no weight
         #: correction is needed — reference redis_eps look_ahead semantics
@@ -1628,6 +1645,81 @@ class ABCSMC:
             return False
         return True
 
+    def _sharded_n(self) -> int | None:
+        """Resolve the sharded fused path's shard count, or None.
+
+        Mesh present: the shard count IS the mesh width (single-process
+        meshes only — multi-host meshes keep the replicated GSPMD path).
+        No mesh but ``sharded=<int>``: that many VIRTUAL shards vmapped
+        on one device — the same reduction, used as the parity
+        reference. ``sharded=True`` makes capability failures loud."""
+        if self.sharded in (False, 0):
+            return None
+        requested = self.sharded is not None
+        n_req = (int(self.sharded)
+                 if isinstance(self.sharded, int)
+                 and not isinstance(self.sharded, bool) else None)
+        if self.mesh is not None:
+            devs = list(self.mesh.devices.flat)
+            if len({d.process_index for d in devs}) > 1:
+                if requested:
+                    raise ValueError(
+                        "sharded fused sampling is single-process only; "
+                        "multi-host meshes use the replicated GSPMD path"
+                    )
+                return None
+            n = len(devs)
+            if n_req is not None and n_req != n:
+                raise ValueError(
+                    f"sharded={n_req} but the mesh has {n} devices"
+                )
+        else:
+            n = n_req
+        if n is None or n <= 1:
+            return None
+        reason = self._sharded_incapable_reason(n)
+        if reason is not None:
+            if requested:
+                raise ValueError(
+                    f"sharded fused sampling unavailable: {reason}"
+                )
+            logger.info("sharded fused path off: %s", reason)
+            return None
+        return n
+
+    def _sharded_incapable_reason(self, n_shards: int) -> str | None:
+        """Why the sharded multigen kernel cannot serve this config (None
+        = capable). The sharded kernel covers the CORE fused feature set;
+        everything else falls back to the GSPMD constraint path (mesh
+        still used, outputs replicated) or the host loops — never an
+        error unless the user passed ``sharded=True``."""
+        if not self._fused_chunk_capable():
+            return "config cannot run fused chunks"
+        if type(self.population_strategy) is not ConstantPopulationSize:
+            return ("constant population sizes only (shard quotas and "
+                    "the packed-fetch merge gather are static)")
+        if type(self.acceptor) is StochasticAcceptor:
+            return "stochastic acceptors ride the GSPMD path"
+        d = self.distance_function
+        if getattr(d, "sumstat", None) is not None:
+            return "learned summary statistics ride the GSPMD path"
+        if (isinstance(d, AdaptivePNormDistance) and d.adaptive) or (
+                type(d) is AdaptiveAggregatedDistance and d.adaptive):
+            return ("adaptive distances ride the GSPMD path (the record "
+                    "ring stays shard-local; its scale reduction would "
+                    "need a per-generation row collective)")
+        if self._weight_schedule_fused():
+            return "per-generation weight schedules ride the GSPMD path"
+        if self._fused_adaptive_n_capable():
+            return "in-kernel adaptive population sizes ride the GSPMD path"
+        if n_shards & (n_shards - 1):
+            return ("shard count must be a power of two (lane batches "
+                    "and reservoir capacities are power-of-two buckets)")
+        if self._fused_n_cap() % n_shards:
+            return (f"population capacity {self._fused_n_cap()} not "
+                    f"divisible by {n_shards} shards")
+        return None
+
     def _weight_schedule_fused(self) -> bool:
         """True when the (non-adaptive) distance carries per-generation
         USER weight schedules that must be resolved per chunk generation
@@ -1662,6 +1754,11 @@ class ABCSMC:
             # learned-statistic scales must be fit in the TRANSFORMED
             # feature space; the in-kernel calibration reduces raw
             # sumstats, so that configuration stays host-side
+            return None
+        if self._sharded_n():
+            # sharded chunks keep calibration on the host (the record
+            # ring is shard-local); the one calibration collect rides
+            # the sync budget's O(1) allowance
             return None
         calib_w = bool(d.requires_calibration())
         calib_eps = bool(self.eps.requires_calibration())
@@ -2121,7 +2218,13 @@ class ABCSMC:
             n_max = n
         n_cap = self._fused_n_cap()  # == _pow2(n_max, 64), single source
         rec_cap = _pow2(8 * n_cap, 256) if (adaptive or stochastic) else 1
+        # sharded fused sampling (ISSUE 9): population axis over the mesh
+        sharded_n = self._sharded_n()
         B = self.sampler._pick_B(n_max)
+        if sharded_n:
+            # every shard needs a whole lane block (both are powers of
+            # two, so a bump keeps divisibility)
+            B = max(B, sharded_n)
         max_rounds = self.sampler.max_rounds
         if min_acceptance_rate > 0:
             max_rounds = max(1, min(
@@ -2147,6 +2250,15 @@ class ABCSMC:
             self._fused_calibration_cfg() if first_gen_prior else None
         )
         refit_cadence = self._refit_cadence_cfg(n_cap)
+        if sharded_n:
+            # the chunk-boundary proposal refit IS the cadence refit:
+            # default to one refit per G-generation chunk (row collective
+            # once per chunk); an explicit refit_every is honored. The
+            # drift guard needs cross-shard theta moments, so it is
+            # inactive here (threshold inf) — PR-3 exactness still holds,
+            # importance weights always use the params actually sampled.
+            every = self.refit_every if self.refit_every is not None else G
+            refit_cadence = (max(int(every), 1), float("inf"))
         health_cfg = self._health_cfg()
         # the multigen kernel's static configuration; the dispatch engine
         # owns the build (kernel.build span) and every invocation —
@@ -2178,6 +2290,7 @@ class ABCSMC:
             ),
             refit_cadence=refit_cadence,
             health_config=health_cfg,
+            sharded=sharded_n,
         )
 
         def _g_limit(t_at: int) -> int:
@@ -2379,6 +2492,11 @@ class ABCSMC:
             eps_quantile=eps_quantile,
             adaptive_n=adaptive_n,
             n_keep=n_keep,
+            shard_merge=(
+                None if not sharded_n else _shard_merge_index(
+                    n_keep, sharded_n, n_cap // sharded_n)
+            ),
+            mesh_shards=sharded_n,
         )
         self._engine = engine
 

@@ -679,7 +679,8 @@ class DeviceContext:
 
     # ----------------------------------------------------- fetch compaction
     def fetch_pack_kernel(self, *, n_keep: int, dtype_name: str,
-                          keep_m: bool, ss_gens, g_keep: int | None = None):
+                          keep_m: bool, ss_gens, g_keep: int | None = None,
+                          merge_index=None):
         """Jitted device-side compaction of a multigen ``outs`` tree
         before the host fetch (``ops/pack.py`` holds the math): theta /
         distance / log_weight collapse into ONE narrowed-dtype row
@@ -692,10 +693,20 @@ class DeviceContext:
 
         ``ss_gens``: static tuple of chunk-relative generations whose
         sum-stat rows to include, or ``"all"``.
+
+        ``merge_index`` (sharded fused sampling): static row gather
+        merging the shard-blocked per-device reservoirs into dense
+        accepted order INSIDE this one program — the chunk-boundary
+        all-gather of the sharded path rides the fetch it already pays,
+        so the per-run sync budget is untouched.
         """
         ss_key = "all" if ss_gens == "all" else tuple(int(g) for g in ss_gens)
+        merge_key = (None if merge_index is None
+                     else (len(merge_index), int(merge_index[0])
+                           if len(merge_index) else -1,
+                           int(merge_index[-1]) if len(merge_index) else -1))
         cache_key = ("fetch_pack", n_keep, dtype_name, keep_m, ss_key,
-                     g_keep)
+                     g_keep, merge_key)
         if cache_key in self._kernels:
             return self._kernels[cache_key]
 
@@ -703,16 +714,24 @@ class DeviceContext:
 
         dt = fetch_dtype_of(dtype_name)
         m_dtype = jnp.int8 if self.K <= 127 else jnp.int32
+        midx = None if merge_index is None else np.asarray(
+            merge_index, np.int32)
 
         def pack_fn(outs):
             return pack_outs(outs, n_keep=n_keep, dtype=dt, keep_m=keep_m,
-                             ss_gens=ss_key, m_dtype=m_dtype, g_keep=g_keep)
+                             ss_gens=ss_key, m_dtype=m_dtype, g_keep=g_keep,
+                             merge_index=midx)
 
-        if self.mesh is not None and len(
+        multi_host = self.mesh is not None and len(
             {d.process_index for d in self.mesh.devices.flat}
-        ) > 1:
+        ) > 1
+        if multi_host or (self.mesh is not None and midx is not None):
             # multi-host: keep the packed tree replicated like the outs it
-            # compacts, so every host can device_get it
+            # compacts, so every host can device_get it. Sharded
+            # single-host: replicating here makes the row merge an
+            # explicit all-gather INSIDE the fetch program (one
+            # collective per chunk) instead of n_devices host-side
+            # per-shard copies at device_get time.
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             fn = jax.jit(pack_fn, out_shardings=NamedSharding(self.mesh, P()))
@@ -738,7 +757,8 @@ class DeviceContext:
                         first_gen_prior: bool = False,
                         fused_calibration: tuple | None = None,
                         refit_cadence: tuple | None = None,
-                        health_config: tuple | None = None):
+                        health_config: tuple | None = None,
+                        sharded: int | None = None):
         """One jitted program for G WHOLE GENERATIONS (transition mode).
 
         The TPU-native endgame of the reference's per-generation scatter/
@@ -814,11 +834,40 @@ class DeviceContext:
                      stochastic, temp_config, temp_fixed, complete_history,
                      sumstat_transform, adaptive_n, weight_sched,
                      fold_sched_mode, first_gen_prior, fused_calibration,
-                     refit_cadence, health_config)
+                     refit_cadence, health_config, sharded)
         if cache_key in self._kernels:
             return self._kernels[cache_key]
         if stochastic and self.K != 1:
             raise ValueError("stochastic fused chunks support K=1 only")
+        if sharded is not None:
+            # the explicitly sharded variant: per-device lanes/reservoirs
+            # with chunk-boundary-only row collectives (ISSUE 9 tentpole)
+            if (adaptive or stochastic or sumstat_transform or weight_sched
+                    or fold_sched_mode or adaptive_n is not None
+                    or fused_calibration is not None):
+                raise ValueError(
+                    "sharded multigen supports the core fused config only "
+                    "(no adaptive distance / stochastic acceptor / learned "
+                    "sumstats / weight schedules / in-kernel adaptive n / "
+                    "in-kernel calibration) — the caller must gate these "
+                    "onto the GSPMD or host paths"
+                )
+            if refit_cadence is None:
+                raise ValueError(
+                    "sharded multigen requires a refit cadence (the "
+                    "chunk-boundary proposal refit is the cadence refit)"
+                )
+            fn = self._multigen_sharded(
+                B, n_cap, rec_cap, max_rounds, G, n_shards=int(sharded),
+                eps_quantile=eps_quantile, eps_weighted=eps_weighted,
+                alpha=alpha, multiplier=multiplier, trans_cls=trans_cls,
+                fit_statics=fit_statics, dims=dims,
+                complete_history=complete_history,
+                first_gen_prior=first_gen_prior,
+                refit_cadence=refit_cadence, health_config=health_config,
+            )
+            self._kernels[cache_key] = fn
+            return fn
 
         from ..ops.stats import normalize_log_weights, weighted_quantile
 
@@ -1374,6 +1423,433 @@ class DeviceContext:
             fn = jax.jit(multigen_fn)
         self._kernels[cache_key] = fn
         return fn
+
+    # ------------------------------------------- sharded multigen (ISSUE 9)
+    def _multigen_sharded(self, B: int, n_cap: int, rec_cap: int,
+                          max_rounds: int, G: int, *, n_shards: int,
+                          eps_quantile: bool, eps_weighted: bool,
+                          alpha: float, multiplier: float, trans_cls,
+                          fit_statics: tuple, dims: tuple,
+                          complete_history: bool, first_gen_prior: bool,
+                          refit_cadence: tuple,
+                          health_config: tuple | None):
+        """The sharded fused chunk: population axis split over the mesh
+        with chunk-boundary-only ROW collectives.
+
+        Layout (the *lane-key reduction*): the generation key still
+        splits into B lane keys exactly as on one device; shard ``d``
+        owns the contiguous lane block ``[d*B_loc, (d+1)*B_loc)`` and
+        compacts ITS accepted lanes into ITS reservoir shard of
+        ``n_cap / n_shards`` rows, targeting its quota of the
+        generation's population (``ops/shard.py``). Acceptance is
+        therefore selected per shard in local slot order — the same
+        proposals, keyed identically, reduced shard-blocked instead of
+        globally. The reduction is a pure function of ``n_shards``, not
+        of the physical device count: without a mesh the identical code
+        runs vmapped over virtual shards on one device, which is the
+        bit-level parity reference the sharded tests compare against.
+
+        Cross-shard traffic per GENERATION is scalar columns only —
+        distances, log-weights, model ids, per-shard counters (a few
+        bytes per row) — from which every shard computes the identical
+        replicated adaptation: weight normalization, the weighted-
+        quantile epsilon, model probabilities, stopping flags and the
+        health word. Theta rows cross shards exactly ONCE per chunk:
+        the cadence refit (forced to the chunk boundary by the caller's
+        ``refit_cadence``; sampling against the carried proposal in
+        between is statistically exact, PR-3 semantics) all-gathers the
+        accepted theta block and fits the next chunk's proposal
+        replicated on every device. Sum stats and the packed fetch rows
+        merge in ``fetch_pack_kernel`` via the static ``merge_index``
+        gather — one all-gather riding the fetch the run already pays,
+        so ``syncs_per_run`` is untouched and the dispatch engine's
+        speculation/rollback machinery works unchanged (the carry is
+        replicated and chains device-to-device exactly as before).
+        """
+        from jax.sharding import PartitionSpec as P
+
+        from ..ops.shard import shard_mask, shard_quota
+        from ..ops.stats import normalize_log_weights, weighted_quantile
+
+        if B % n_shards or n_cap % n_shards:
+            raise ValueError(
+                f"sharded multigen needs n_shards | B and n_shards | "
+                f"n_cap (got B={B}, n_cap={n_cap}, n_shards={n_shards})"
+            )
+        B_loc = B // n_shards
+        cap_loc = n_cap // n_shards
+        S = self.spec.total_size
+        d_max = self.d_max
+        K = self.K
+        refit_every_s, _drift_thr = refit_cadence
+        use_mesh = self.mesh is not None
+        if use_mesh:
+            mesh_devs = list(self.mesh.devices.flat)
+            if len(mesh_devs) != n_shards:
+                raise ValueError(
+                    f"mesh has {len(mesh_devs)} devices but the kernel "
+                    f"was requested with n_shards={n_shards}"
+                )
+            axis = self.mesh.axis_names[0]
+
+        def local_generation(shard_idx, gen_key, dyn, n_target, use_prior,
+                             stopped):
+            """One shard's whole generation: its lane-key block, its
+            reservoir, its quota. No collectives in here."""
+            quota_loc = (n_target // n_shards
+                         + (shard_idx < n_target % n_shards))
+
+            def _run_with(lane):
+                def run_lanes(key, dyn_):
+                    keys_all = jax.random.split(key, B)
+                    keys = jax.lax.dynamic_slice_in_dim(
+                        keys_all, shard_idx * B_loc, B_loc
+                    )
+                    return jax.vmap(lambda k: lane(k, dyn_))(keys)
+
+                return self._generation_while(
+                    gen_key, dyn, quota_loc, B=B_loc, n_cap=cap_loc,
+                    rec_cap=rec_cap, max_rounds=max_rounds,
+                    run_lanes=run_lanes,
+                )
+
+            def run_gen(_):
+                if not first_gen_prior:
+                    return _run_with(self._lane_transition)
+                return jax.lax.cond(
+                    use_prior,
+                    lambda: _run_with(self._lane_prior),
+                    lambda: _run_with(self._lane_transition),
+                )
+
+            def skip_gen(_):
+                z32 = jnp.zeros((), jnp.int32)
+                res = {
+                    "m": jnp.zeros((cap_loc,), jnp.int32),
+                    "theta": jnp.zeros((cap_loc, d_max), jnp.float32),
+                    "sumstats": jnp.zeros((cap_loc, S), jnp.float32),
+                    "distance": jnp.zeros((cap_loc,), jnp.float32),
+                    "log_weight": jnp.full((cap_loc,), -jnp.inf,
+                                           jnp.float32),
+                    "slot": jnp.full((cap_loc,), -1, jnp.int32),
+                }
+                rec = {
+                    "sumstats": jnp.zeros((rec_cap, S), jnp.float32),
+                    "distance": jnp.zeros((rec_cap,), jnp.float32),
+                    "accepted": jnp.zeros((rec_cap,), bool),
+                    "valid": jnp.zeros((rec_cap,), bool),
+                }
+                return z32, z32, z32, res, rec
+
+            n_acc_l, rounds_l, n_valid_l, res_l, _rec = jax.lax.cond(
+                stopped, skip_gen, run_gen, None
+            )
+            # local accepted-theta finiteness: the one health input that
+            # must be reduced across shards instead of recomputed from
+            # the gathered scalar columns
+            mask_loc = jnp.arange(cap_loc) < jnp.minimum(
+                n_acc_l, quota_loc)
+            theta_bad_l = ~jnp.all(jnp.isfinite(
+                jnp.where(mask_loc[:, None], res_l["theta"], 0.0)))
+            return n_acc_l, rounds_l, n_valid_l, res_l, theta_bad_l
+
+        # the two executions of the SAME shard program: on the mesh the
+        # shard is the device (collectives are all_gathers); without a
+        # mesh the shards are a vmapped leading axis on one device and
+        # the "collectives" are reshapes — bit-level the same reduction
+        class _MeshShards:
+            @staticmethod
+            def run_local(gen_key, dyn, n_target, use_prior, stopped):
+                idx = jax.lax.axis_index(axis)
+                return local_generation(idx, gen_key, dyn, n_target,
+                                        use_prior, stopped)
+
+            @staticmethod
+            def rows(x):
+                return jax.lax.all_gather(x, axis, tiled=True)
+
+            @staticmethod
+            def stack(x):
+                return jax.lax.all_gather(x, axis)
+
+        class _VirtualShards:
+            @staticmethod
+            def run_local(gen_key, dyn, n_target, use_prior, stopped):
+                return jax.vmap(
+                    local_generation,
+                    in_axes=(0, None, None, None, None, None),
+                )(jnp.arange(n_shards), gen_key, dyn, n_target, use_prior,
+                  stopped)
+
+            @staticmethod
+            def rows(x):
+                return x.reshape((n_shards * x.shape[1],) + x.shape[2:])
+
+            @staticmethod
+            def stack(x):
+                return x
+
+        def make_gen_step(A, root, t0, n_sched, g_limit, mpk_base,
+                          eps_fixed, min_eps, min_acc_rate):
+            def gen_step(carry, g):
+                carry_l = list(carry)
+                (trans_params, log_model_probs, fitted, dist_w,
+                 eps_carry, acc_state, stopped) = carry_l[:7]
+                tail = carry_l[7:]
+                gens_since = tail.pop(0)
+                health_state = (tail.pop(0) if health_config is not None
+                                else None)
+                pdf_norm, max_found, daly_k = acc_state
+                stopped = stopped | (g >= g_limit)
+                t = t0 + g
+                n_target = n_sched[g]
+                gen_key = jax.random.fold_in(root, t + 1)
+                eps_g = eps_carry if eps_quantile else eps_fixed[g]
+                # mask & renormalize the model-perturbation matrix —
+                # replicated math, identical to the unsharded kernel
+                matrix = mpk_base * fitted[None, :].astype(jnp.float32)
+                row_sums = matrix.sum(axis=1, keepdims=True)
+                matrix = jnp.where(
+                    row_sums > 0,
+                    matrix / jnp.where(row_sums > 0, row_sums, 1.0), 0.0,
+                )
+                probs = jnp.exp(log_model_probs)
+                model_factor = probs @ matrix
+                log_model_factor = jnp.where(
+                    model_factor > 0,
+                    jnp.log(jnp.maximum(model_factor, 1e-38)), -jnp.inf,
+                )
+                dyn = {
+                    "eps": eps_g,
+                    "dist_params": dist_w,
+                    "acc_params": (pdf_norm if complete_history else ()),
+                    "log_model_probs": log_model_probs,
+                    "mpk_matrix": matrix,
+                    "log_model_factor": log_model_factor,
+                    "trans_params": trans_params,
+                }
+                use_prior = (t == 0) if first_gen_prior \
+                    else jnp.asarray(False)
+                (n_acc_l, rounds_l, n_valid_l, res_l,
+                 theta_bad_l) = A.run_local(gen_key, dyn, n_target,
+                                            use_prior, stopped)
+                # ---- per-generation scalar-column collectives only
+                d_col = A.rows(res_l["distance"])
+                lw_col = A.rows(res_l["log_weight"])
+                m_col = A.rows(res_l["m"])
+                nacc_sh = A.stack(n_acc_l)
+                rounds_sh = A.stack(rounds_l)
+                nvalid_sh = A.stack(n_valid_l)
+                theta_bad = jnp.any(A.stack(theta_bad_l))
+                quota_sh = shard_quota(n_target, n_shards)
+                n_acc = jnp.sum(nacc_sh)
+                n_valid = jnp.sum(nvalid_sh)
+                rounds = jnp.max(rounds_sh)
+                # a sharded generation is complete when EVERY shard met
+                # its quota within the round budget (per-shard budgets —
+                # the documented deviation from the global-budget
+                # single-device reduction)
+                gen_ok = jnp.all(
+                    nacc_sh >= jnp.minimum(quota_sh, cap_loc)
+                ) & ~stopped
+                k_mask = shard_mask(nacc_sh, quota_sh, n_shards, cap_loc)
+                w_norm = normalize_log_weights(lw_col, k_mask)
+                d_new = d_col
+                if eps_quantile:
+                    pts = jnp.where(k_mask, d_new, jnp.inf)
+                    wts = (
+                        jnp.where(k_mask, w_norm, 0.0) if eps_weighted
+                        else k_mask.astype(jnp.float32)
+                    )
+                    eps_next = weighted_quantile(pts, wts,
+                                                 alpha) * multiplier
+                else:
+                    eps_next = eps_carry
+                model_probs_next = jnp.stack([
+                    jnp.where((m_col == m) & k_mask, w_norm, 0.0).sum()
+                    for m in range(K)
+                ])
+                counts = jnp.stack([
+                    (k_mask & (m_col == m)).sum() for m in range(K)
+                ])
+                min_count_of = getattr(
+                    trans_cls, "device_refit_min_count", None
+                )
+                tick = gens_since + 1
+                # the cadence refit IS the chunk-boundary merge point:
+                # between refits every shard proposes from the carried
+                # replicated params (statistically exact); a refit
+                # all-gathers the theta block once and fits replicated
+                refit_now = (
+                    (tick >= refit_every_s)
+                    | jnp.any(~fitted & (counts > 0))
+                    | ~jnp.any(fitted)
+                ) & ~stopped
+
+                def _refit_models(_):
+                    theta_glob = A.rows(res_l["theta"])
+                    trans_new = []
+                    refit_ok = []
+                    for m in range(K):
+                        w_m = jnp.where(m_col == m, w_norm, 0.0)
+                        fit_m = trans_cls.device_fit(
+                            theta_glob, w_m, dim=dims[m],
+                            **dict(fit_statics[m]),
+                        )
+                        if min_count_of is not None:
+                            ok = counts[m] >= min_count_of(dims[m])
+                            fit_m = jax.tree.map(
+                                lambda new, old: jnp.where(ok, new, old),
+                                fit_m, trans_params[m],
+                            )
+                        else:
+                            ok = counts[m] > 0
+                        refit_ok.append(ok)
+                        trans_new.append(fit_m)
+                    fitted_new = jnp.stack(refit_ok) | (fitted
+                                                        & (counts > 0))
+                    return tuple(trans_new), fitted_new
+
+                def _skip_refit(_):
+                    return trans_params, fitted & (counts > 0)
+
+                trans_next, fitted_next = jax.lax.cond(
+                    refit_now, _refit_models, _skip_refit, None
+                )
+                gens_since_next = jnp.where(
+                    refit_now, 0, tick).astype(jnp.int32)
+                log_model_probs_next = jnp.where(
+                    model_probs_next > 0,
+                    jnp.log(jnp.maximum(model_probs_next, 1e-38)),
+                    -jnp.inf,
+                )
+                acc_rate = n_acc / jnp.maximum(n_valid, 1)
+                eps_min_next = (jnp.minimum(pdf_norm, eps_g)
+                                if complete_history else pdf_norm)
+                acc_state_next = (eps_min_next, max_found, daly_k)
+                stopped_next = (
+                    stopped | ~gen_ok | (eps_g <= min_eps)
+                    | (acc_rate < min_acc_rate)
+                )
+                if health_config is not None:
+                    from ..ops.health import (
+                        BIT_EPS_NONFINITE,
+                        BIT_PSD_FAIL,
+                        _bit,
+                        eps_stall_update,
+                        params_unhealthy,
+                        population_bits_cols,
+                    )
+
+                    ess_floor, acc_floor, stall_w, stall_rtol = \
+                        health_config
+                    eps_prev_c, stall_count_c = health_state
+                    word, ess = population_bits_cols(
+                        theta_bad=theta_bad, k_mask=k_mask,
+                        w_norm=w_norm, d_new=d_new, n_acc=n_acc,
+                        ess_floor=ess_floor, n_target=n_target,
+                        acc_rate=acc_rate, acc_floor=acc_floor,
+                    )
+                    psd_bad = params_unhealthy(trans_params, fitted) \
+                        | params_unhealthy(trans_next, fitted_next)
+                    word = word | _bit(psd_bad, BIT_PSD_FAIL)
+                    eps_bad = (~jnp.isfinite(eps_g)
+                               | ~jnp.isfinite(eps_next))
+                    word = word | _bit(eps_bad, BIT_EPS_NONFINITE)
+                    stall_bit, stall_n = eps_stall_update(
+                        eps_prev_c, eps_g, stall_count_c,
+                        window=stall_w, rtol=stall_rtol,
+                    )
+                    word = word | stall_bit
+                    word = jnp.where(stopped, jnp.int32(0), word)
+                    health_state_next = (
+                        jnp.where(stopped, eps_prev_c, eps_g),
+                        jnp.where(stopped, stall_count_c, stall_n),
+                    )
+                else:
+                    word = ess = health_state_next = None
+                out = {
+                    **res_l,
+                    "eps_used": eps_g, "eps_next": eps_next,
+                    "dist_w_next": dist_w, "n_acc": n_acc,
+                    "rounds": rounds, "n_valid": n_valid,
+                    "gen_ok": gen_ok, "model_probs": model_probs_next,
+                    "refit": refit_now,
+                    "drift": jnp.zeros((), jnp.float32),
+                    "rows_changed": jnp.zeros((), jnp.int32),
+                    # per-shard accounting for the mesh observability
+                    # gauges (imbalance = how unevenly the mesh worked)
+                    "n_acc_shard": nacc_sh, "rounds_shard": rounds_sh,
+                }
+                if health_config is not None:
+                    out["health"] = word
+                    out["ess"] = ess
+                new_carry = [trans_next, log_model_probs_next,
+                             fitted_next, dist_w, eps_next,
+                             acc_state_next, stopped_next,
+                             gens_since_next]
+                if health_config is not None:
+                    new_carry.append(health_state_next)
+                return tuple(new_carry), out
+
+            return gen_step
+
+        ROW_LOCAL = ("m", "theta", "sumstats", "distance", "log_weight",
+                     "slot")
+
+        def _chunk_body(A, root, t0, n_sched, g_limit, carry0, mpk_base,
+                        eps_fixed, min_eps, min_acc_rate):
+            step = make_gen_step(A, root, t0, n_sched, g_limit, mpk_base,
+                                 eps_fixed, min_eps, min_acc_rate)
+            final_carry, outs = jax.lax.scan(step, carry0, jnp.arange(G))
+            rows = {k: outs.pop(k) for k in ROW_LOCAL}
+            return rows, outs, final_carry
+
+        if use_mesh:
+            from jax.experimental.shard_map import shard_map
+
+            def inner(root_data, t0, n_sched, g_limit, carry0, mpk_base,
+                      eps_fixed, min_eps, min_acc_rate):
+                root_k = jax.random.wrap_key_data(root_data)
+                return _chunk_body(_MeshShards, root_k, t0, n_sched,
+                                   g_limit, carry0, mpk_base, eps_fixed,
+                                   min_eps, min_acc_rate)
+
+            inner_sharded = shard_map(
+                inner, mesh=self.mesh, in_specs=(P(),) * 9,
+                # rows: scan axis G unsharded, reservoir axis sharded;
+                # everything else (per-generation scalars, the carry the
+                # next chunk chains off) replicated
+                out_specs=(P(None, axis), P(), P()),
+                check_rep=False,
+            )
+
+            def multigen_fn(root, t0, n_sched, g_limit, carry0, mpk_base,
+                            eps_fixed, min_eps, min_acc_rate,
+                            dist_sched=None, fold_sched=None):
+                rows, repl, carry = inner_sharded(
+                    jax.random.key_data(root), t0, n_sched, g_limit,
+                    carry0, mpk_base, eps_fixed, min_eps, min_acc_rate,
+                )
+                return {"outs": {**rows, **repl}, "carry": carry}
+        else:
+            def multigen_fn(root, t0, n_sched, g_limit, carry0, mpk_base,
+                            eps_fixed, min_eps, min_acc_rate,
+                            dist_sched=None, fold_sched=None):
+                rows, repl, carry = _chunk_body(
+                    _VirtualShards, root, t0, n_sched, g_limit, carry0,
+                    mpk_base, eps_fixed, min_eps, min_acc_rate,
+                )
+                # virtual shards: ys rows are (G, n_shards, cap_loc, ...)
+                # — flatten the shard blocks into the same global layout
+                # the mesh run produces
+                rows = {
+                    k: v.reshape((G, n_cap) + v.shape[3:])
+                    for k, v in rows.items()
+                }
+                return {"outs": {**rows, **repl}, "carry": carry}
+
+        return jax.jit(multigen_fn)
 
     def _stochastic_gen_update(self, temp_config, trans_cls, trans_next,
                                rec, res, k_mask, w_norm, pdf_norm, max_found,

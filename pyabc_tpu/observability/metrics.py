@@ -212,6 +212,18 @@ SYNCS_PER_RUN_GAUGE = "pyabc_tpu_syncs_per_run"
 #:  stopping-rule hit or discarded with a health-degraded carry)
 SPECULATIVE_ROLLBACKS_TOTAL = "pyabc_tpu_speculative_rollbacks_total"
 
+# Sharded fused sampling (ISSUE 9): the dispatch engine's mesh gauges.
+#:  devices of the mesh the sharded multigen kernel runs on (1 when the
+#:  run is unsharded)
+MESH_DEVICES_GAUGE = "pyabc_tpu_mesh_devices"
+#:  per-shard work imbalance of the last processed chunk: max over
+#:  shards of proposal rounds worked, divided by the mean — 1.0 is a
+#:  perfectly balanced mesh; the bench `mesh` lane records it
+MESH_IMBALANCE_GAUGE = "pyabc_tpu_mesh_shard_imbalance"
+#:  busiest-shard share of total mesh rounds in the last processed
+#:  chunk (1/n_devices when perfectly balanced)
+MESH_BUSY_MAX_GAUGE = "pyabc_tpu_mesh_shard_busy_max_frac"
+
 
 def health_event_metric(kind: str) -> str:
     """Per-kind health-event counter name — the registry's stand-in for

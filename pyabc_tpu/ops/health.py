@@ -100,6 +100,21 @@ def population_bits(res, k_mask, w_norm, d_new, n_acc, *,
     """Health bits derived from one generation's accepted population."""
     theta_bad = ~jnp.all(jnp.isfinite(
         jnp.where(k_mask[:, None], res["theta"], 0.0)))
+    return population_bits_cols(
+        theta_bad=theta_bad, k_mask=k_mask, w_norm=w_norm, d_new=d_new,
+        n_acc=n_acc, ess_floor=ess_floor, n_target=n_target,
+        acc_rate=acc_rate, acc_floor=acc_floor,
+    )
+
+
+def population_bits_cols(*, theta_bad, k_mask, w_norm, d_new, n_acc,
+                         ess_floor: float, n_target, acc_rate,
+                         acc_floor: float):
+    """Population health bits from column data + a precomputed theta
+    flag. The sharded multigen kernel keeps theta rows device-local and
+    reduces their finiteness check across shards (a one-bit collective);
+    every other bit derives from the gathered scalar columns — the exact
+    same math as the single-device :func:`population_bits`."""
     w_masked = jnp.where(k_mask, w_norm, 0.0)
     w_bad = ~jnp.all(jnp.isfinite(w_masked))
     d_bad = ~jnp.all(jnp.isfinite(jnp.where(k_mask, d_new, 0.0)))

@@ -77,7 +77,7 @@ def _cast_monotone_down(x, dtype):
 
 def pack_outs(outs: dict, *, n_keep: int, dtype, keep_m: bool,
               ss_gens: tuple[int, ...] | str, m_dtype=jnp.int8,
-              g_keep: int | None = None) -> dict:
+              g_keep: int | None = None, merge_index=None) -> dict:
     """Traceable compaction of a multigen ``outs`` tree (leading G axis).
 
     Returns the fetch tree: every non-row leaf passes through (sliced to
@@ -87,28 +87,47 @@ def pack_outs(outs: dict, *, n_keep: int, dtype, keep_m: bool,
     ``ss_gens`` is the static tuple of chunk-relative generations whose
     sum stats the host wants, or ``"all"`` (an empty tuple ships NO sum
     stats — the host reconstructs the empty map).
+
+    ``merge_index`` (sharded fused sampling): a static ``(n_keep,)``
+    gather over the row axis that merges the shard-blocked per-device
+    reservoir layout (``ops/shard.py::merge_index``) into dense
+    accepted-row order. Running it HERE — inside the one jitted fetch
+    program — is what makes the cross-device row merge a
+    chunk-boundary-only collective: GSPMD lowers the gather over the
+    sharded axis into a single all-gather riding the fetch, adding zero
+    blocking syncs.
     """
     if g_keep is not None:
         # every leaf of the scan's ys carries the leading G axis,
         # including structured distance params (dicts/tuples)
         outs = jax.tree.map(lambda v: v[:g_keep], outs)
+
+    if merge_index is not None:
+        idx = jnp.asarray(merge_index, jnp.int32)
+
+        def take(v):
+            return v[:, idx]
+    else:
+        def take(v):
+            return v[:, :n_keep]
+
     packed = {k: v for k, v in outs.items() if k not in ROW_KEYS}
     packed["rows"] = jnp.concatenate(
         [
-            outs["theta"][:, :n_keep, :].astype(dtype),
+            take(outs["theta"]).astype(dtype),
             _cast_monotone_down(
-                outs["distance"][:, :n_keep, None], dtype),
-            outs["log_weight"][:, :n_keep, None].astype(dtype),
+                take(outs["distance"])[..., None], dtype),
+            take(outs["log_weight"])[..., None].astype(dtype),
         ],
         axis=-1,
     )
     if keep_m:
-        packed["m"] = outs["m"][:, :n_keep].astype(m_dtype)
+        packed["m"] = take(outs["m"]).astype(m_dtype)
     if ss_gens == "all":
-        packed["sumstats"] = outs["sumstats"][:, :n_keep].astype(dtype)
+        packed["sumstats"] = take(outs["sumstats"]).astype(dtype)
     elif ss_gens:
         packed["__ss_rows__"] = {
-            int(g): outs["sumstats"][int(g), :n_keep].astype(dtype)
+            int(g): take(outs["sumstats"])[int(g)].astype(dtype)
             for g in ss_gens
         }
     return packed

@@ -1,0 +1,90 @@
+"""Shard math for the sharded fused sampling path (ISSUE 9 tentpole).
+
+The sharded multigen kernel (``DeviceContext.multigen_kernel(...,
+sharded=n)``) splits the population axis over the one-axis device mesh:
+each device proposes its own block of lanes (the *lane-key reduction*:
+global lane ``i`` keeps the exact PRNG key it has on one device — device
+``d`` simply owns the contiguous block ``[d*B_loc, (d+1)*B_loc)``) and
+compacts acceptances into its own reservoir shard of ``n_cap /
+n_shards`` rows. This module holds the small, host-and-trace-shared
+arithmetic of that layout:
+
+- :func:`shard_quota` — how many accepted rows each shard owes a
+  generation (uneven populations spread the remainder over the leading
+  shards);
+- :func:`merge_index` — the static gather that reorders the
+  shard-blocked reservoir layout ``[shard0 rows | shard1 rows | ...]``
+  into the dense accepted-row order the host's slot-ordered trim
+  expects (it rides the packed-fetch program, so the row all-gather
+  happens exactly once per chunk);
+- :func:`shard_mask` — the traceable global accepted-row mask over the
+  shard-blocked layout.
+
+Everything the kernel does per generation across shards is a
+*scalar-column* collective (distances, log-weights, model ids, per-shard
+counters — a few bytes per row); the row payloads (theta, sum stats)
+cross devices only through :func:`merge_index` at chunk boundaries and
+through the in-kernel chunk-end proposal refit.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def shard_quota_host(n_target: int, n_shards: int) -> np.ndarray:
+    """Per-shard accepted-row quotas for a generation target (host side).
+
+    ``n_target`` rows spread as evenly as possible: the first
+    ``n_target % n_shards`` shards take one extra row, so any population
+    size works on any mesh width (the uneven-shard contract)."""
+    base, extra = divmod(int(n_target), int(n_shards))
+    return np.asarray(
+        [base + (1 if s < extra else 0) for s in range(int(n_shards))],
+        np.int32,
+    )
+
+
+def shard_quota(n_target, n_shards: int):
+    """Traceable twin of :func:`shard_quota_host` for an in-kernel
+    (possibly traced) generation target: ``(n_shards,)`` int32."""
+    import jax.numpy as jnp
+
+    base = n_target // n_shards
+    extra = n_target % n_shards
+    return (base + (jnp.arange(n_shards, dtype=jnp.int32) < extra)
+            ).astype(jnp.int32)
+
+
+def merge_index(n_keep: int, n_shards: int, cap_loc: int) -> np.ndarray:
+    """Static gather indices merging the shard-blocked reservoir into
+    dense accepted-row order.
+
+    Shard ``s`` keeps its first ``quota[s]`` rows at global (gathered)
+    positions ``[s*cap_loc, s*cap_loc + quota[s])``; the packed fetch
+    gathers them back-to-back so the host sees ``n_keep`` dense rows,
+    exactly like the single-device reservoir."""
+    quota = shard_quota_host(n_keep, n_shards)
+    if int(quota.max(initial=0)) > cap_loc:
+        raise ValueError(
+            f"shard quota {int(quota.max())} exceeds per-shard reservoir "
+            f"capacity {cap_loc} (n_keep={n_keep}, n_shards={n_shards})"
+        )
+    parts = [
+        s * cap_loc + np.arange(quota[s], dtype=np.int32)
+        for s in range(n_shards)
+    ]
+    return np.concatenate(parts) if parts else np.zeros(0, np.int32)
+
+
+def shard_mask(nacc_sh, quota_sh, n_shards: int, cap_loc: int):
+    """Traceable accepted-row mask over the shard-blocked global layout:
+    row ``j`` (shard ``j // cap_loc``, offset ``j % cap_loc``) is
+    accepted iff its offset is below both its shard's quota and its
+    shard's actual acceptance count."""
+    import jax.numpy as jnp
+
+    j = jnp.arange(n_shards * cap_loc)
+    sh = j // cap_loc
+    off = j % cap_loc
+    lim = jnp.minimum(nacc_sh, quota_sh)
+    return off < lim[sh]

@@ -98,6 +98,23 @@ def global_mesh(axis_name: str = "particles"):
     return Mesh(np.asarray(jax.devices()), axis_names=(axis_name,))
 
 
+def local_mesh(n_devices: int | None = None, axis_name: str = "particles"):
+    """Single-process 1-D mesh over THIS process's devices — the mesh
+    the sharded fused path (``ABCSMC(mesh=..., sharded=...)``) shards
+    the population axis over. On CPU hosts the standard test rig forces
+    virtual devices first (``XLA_FLAGS=--xla_force_host_platform_device_
+    count=8``); pass ``n_devices`` to cap the width (power-of-two widths
+    divide the power-of-two lane/reservoir buckets evenly)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.local_devices()
+    if n_devices is not None:
+        devs = devs[: int(n_devices)]
+    # abc-lint: disable=SYNC001 np.asarray reshapes the host-side Device LIST for Mesh; no array leaves a device
+    return Mesh(np.asarray(devs), axis_names=(axis_name,))
+
+
 def is_primary() -> bool:
     import jax
 

@@ -105,3 +105,13 @@ DEFAULT_DISPATCH_RUNS = 3
 #: within this factor of the same run's pipeline-full span pps
 #: (ISSUE round 12 acceptance: within 1.5x)
 DISPATCH_WALL_TO_PIPELINE_MIN = 1.0 / 1.5
+# mesh lane (round 13): sharded fused sampling on the device mesh — the
+# MULTICHIP dryrun's shapes (pop 1024, G=8) promoted to a measured
+# first-class path. gens = 9 => gen 0 + exactly one full G=8 chunk per
+# run, the minimum that exercises the chunk-boundary merge + a second
+# (short) chunk's dispatch. The lane runs in a subprocess (forced 8
+# virtual CPU devices without an accelerator) under its own budget.
+DEFAULT_MESH_POP = 1024
+DEFAULT_MESH_G = 8
+DEFAULT_MESH_GENS = 9
+DEFAULT_MESH_BUDGET_S = 120.0

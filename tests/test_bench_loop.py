@@ -44,6 +44,9 @@ def bench(monkeypatch, tmp_path, capsys):
     # span-free coverage
     monkeypatch.setenv("PYABC_TPU_BENCH_HEALTH", "0")
     monkeypatch.setenv("PYABC_TPU_BENCH_DISPATCH", "0")
+    # the mesh lane spawns a REAL forced-8-device subprocess; it has its
+    # own unit tests (tests/test_sharded.py) and a live child smoke
+    monkeypatch.setenv("PYABC_TPU_BENCH_MESH", "0")
     monkeypatch.setattr(mod, "probe_platform", lambda *a, **k: "cpu")
     monkeypatch.setattr(mod, "run_host_baseline", lambda **k: 800.0)
     monkeypatch.setattr(
@@ -193,6 +196,7 @@ def test_headline_both_bases_and_full_coverage(bench, monkeypatch, capsys):
     # resilience lanes, so their recorded skip reasons must appear
     assert d["elastic"]["skipped"].startswith("disabled")
     assert d["resilience"]["skipped"].startswith("disabled")
+    assert d["mesh"]["skipped"].startswith("disabled")
 
 
 def test_one_off_failure_retries_and_completes(bench, monkeypatch, capsys):

@@ -18,6 +18,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 import pyabc_tpu as pt
 from pyabc_tpu.models import model_selection as msel
 
+pytestmark = pytest.mark.mesh
+
 PRIOR_SD = 1.0
 NOISE_SD = 0.5
 X_OBS = 1.0

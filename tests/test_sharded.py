@@ -1,0 +1,341 @@
+"""Sharded fused sampling (ISSUE 9): the shard_map multigen kernel.
+
+conftest forces ``--xla_force_host_platform_device_count=8``, so a real
+8-device mesh exists and GSPMD/shard_map insert real cross-device
+collectives — the same mechanism the CI ``mesh`` job and the bench
+``mesh`` lane use.
+
+The parity contract: the sharded reduction (per-shard lane-key blocks,
+per-shard reservoirs and quotas) is a pure function of ``n_shards``, not
+of the physical device count — ``ABCSMC(sharded=8)`` WITHOUT a mesh runs
+the identical reduction vmapped over virtual shards on one device, and a
+real 8-device mesh run must be bit-identical to it. Statistical
+agreement with the plain single-device reduction is asserted separately
+(different reductions of the same proposals, same posterior).
+"""
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import pyabc_tpu as pt
+from pyabc_tpu.observability import MetricsRegistry
+
+pytestmark = pytest.mark.mesh
+
+PRIOR_SD = 1.0
+NOISE_SD = 0.5
+X_OBS = 1.0
+POST_VAR = 1.0 / (1 / PRIOR_SD**2 + 1 / NOISE_SD**2)
+POST_MU = POST_VAR * (X_OBS / NOISE_SD**2)
+
+
+def _mesh(n=8):
+    devs = jax.devices("cpu")
+    if len(devs) < n:
+        pytest.skip(f"need {n} virtual cpu devices, have {len(devs)}")
+    return Mesh(np.asarray(devs[:n]), axis_names=("particles",))
+
+
+def _gauss_model():
+    @pt.JaxModel.from_function(["theta"], name="gauss_sharded")
+    def model(key, theta):
+        return {"x": theta[0] + NOISE_SD * jax.random.normal(key)}
+
+    return model
+
+
+def _make(seed=21, pop=128, G=3, mesh=None, sharded=None, **kwargs):
+    abc = pt.ABCSMC(
+        _gauss_model(), pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD)),
+        pt.PNormDistance(p=2), population_size=pop,
+        eps=pt.MedianEpsilon(), seed=seed, mesh=mesh, sharded=sharded,
+        fused_generations=G, **kwargs,
+    )
+    abc.new("sqlite://", {"x": X_OBS})
+    return abc
+
+
+def _history_arrays(h):
+    """Everything a bit-identity claim covers: epsilon trail plus every
+    generation's (theta, weight, distance) arrays."""
+    pops = h.get_all_populations().query("t >= 0")
+    out = {"eps": pops["epsilon"].to_numpy()}
+    for t in pops["t"]:
+        df, w = h.get_distribution(0, int(t))
+        out[f"theta_{t}"] = df["theta"].to_numpy()
+        out[f"w_{t}"] = np.asarray(w)
+        out[f"d_{t}"] = h.get_weighted_distances(
+            int(t))["distance"].to_numpy()
+    return out
+
+
+def _moments(h):
+    df, w = h.get_distribution(0, h.max_t)
+    mu = float(np.sum(df["theta"] * w))
+    sd = float(np.sqrt(np.sum(w * (df["theta"] - mu) ** 2)))
+    return mu, sd
+
+
+# ------------------------------------------------------------ parity
+
+class TestShardedParity:
+    def test_mesh_bit_identical_to_virtual_shards(self):
+        """The lane-key reduction contract: an 8-device shard_map run and
+        the SAME reduction vmapped over 8 virtual shards on one device
+        produce bit-identical Histories — sharding is an execution
+        choice, never a statistical one."""
+        abc_v = _make(seed=21, sharded=8)
+        assert abc_v._sharded_n() == 8
+        h_v = abc_v.run(max_nr_populations=7)
+
+        abc_m = _make(seed=21, mesh=_mesh())
+        assert abc_m._sharded_n() == 8  # auto: mesh width
+        h_m = abc_m.run(max_nr_populations=7)
+
+        a, b = _history_arrays(h_m), _history_arrays(h_v)
+        assert set(a) == set(b)
+        for k in a:
+            np.testing.assert_array_equal(
+                a[k], b[k], err_msg=f"mesh vs virtual shards diverged "
+                                    f"at {k}")
+        snap = abc_m._engine.snapshot()
+        assert snap["mesh"]["devices"] == 8
+        assert snap["mesh"]["imbalance"] >= 1.0
+        assert len(snap["mesh"]["rounds_per_device"]) == 8
+
+    def test_sharded_statistical_parity_with_single_device(self):
+        """Different reductions of the same proposal stream: the sharded
+        run must agree with the plain single-device run on the posterior
+        (and both with the conjugate analytic answer)."""
+        h_s = _make(seed=23).run(max_nr_populations=6)
+        h_m = _make(seed=23, mesh=_mesh()).run(max_nr_populations=6)
+        mu_s, sd_s = _moments(h_s)
+        mu_m, sd_m = _moments(h_m)
+        assert mu_m == pytest.approx(POST_MU, abs=0.25)
+        assert mu_m == pytest.approx(mu_s, abs=0.2)
+        assert sd_m == pytest.approx(sd_s, abs=0.15)
+
+    def test_multimodel_sharded(self):
+        """K>1 rides the sharded kernel: model ids travel with the
+        gathered scalar columns, refits stay per-model masked."""
+        from pyabc_tpu.models import model_selection as msel
+
+        models, priors, analytic = msel.tractable_pair()
+        abc = pt.ABCSMC(models, priors, pt.PNormDistance(p=2),
+                        population_size=600, eps=pt.MedianEpsilon(),
+                        seed=22, mesh=_mesh(), sharded=True,
+                        fused_generations=3)
+        assert abc._sharded_n() == 8
+        abc.new("sqlite://", {"x": X_OBS})
+        h = abc.run(max_nr_populations=5)
+        probs = h.get_model_probabilities(h.max_t)
+        expected = analytic(X_OBS)
+        for m in range(2):
+            p = float(probs["p"].get(m, 0.0))
+            assert p == pytest.approx(expected[m], abs=0.2), (m, p)
+
+
+# ------------------------------------------------------- uneven shards
+
+class TestUnevenShards:
+    @pytest.mark.parametrize("pop", [300, 100])
+    def test_population_not_divisible_by_mesh(self, pop):
+        """pop % 8 != 0: leading shards take the remainder (static
+        quotas), padding rows never leak — every persisted generation
+        has exactly ``pop`` particles with positive total weight."""
+        abc = _make(seed=31, pop=pop, mesh=_mesh(), sharded=True)
+        h = abc.run(max_nr_populations=5)
+        counts = h.get_nr_particles_per_population()
+        for t in range(h.max_t + 1):
+            assert counts[t] == pop, (t, counts[t])
+            df, w = h.get_distribution(0, t)
+            assert len(df) == pop
+            w = np.asarray(w)
+            assert np.all(np.isfinite(w)) and w.sum() == pytest.approx(1.0)
+            assert np.all(np.isfinite(df["theta"].to_numpy()))
+        mu, _ = _moments(h)
+        assert mu == pytest.approx(POST_MU, abs=0.3)
+
+    def test_shard_quota_and_merge_index(self):
+        from pyabc_tpu.ops.shard import merge_index, shard_quota_host
+
+        q = shard_quota_host(300, 8)
+        assert q.sum() == 300 and q.max() - q.min() <= 1
+        idx = merge_index(300, 8, 64)
+        assert len(idx) == 300
+        # shard-blocked, dense within each shard
+        assert idx[0] == 0 and idx[q[0]] == 64
+        with pytest.raises(ValueError):
+            merge_index(300, 8, 16)  # quota 38 > per-shard capacity 16
+
+
+# ------------------------------------------------- sharding mechanics
+
+class TestShardingMechanics:
+    def test_outs_genuinely_sharded_and_merge_in_fetch(self):
+        """The chunk outputs' row leaves live sharded across the 8
+        devices (each holds its reservoir shard, not a replica); the
+        packed fetch tree is the merged dense layout."""
+        from pyabc_tpu.inference.dispatch import DispatchEngine
+
+        captured = {}
+        orig = DispatchEngine._fetch_tree
+
+        def spy(self, res_i, t_at, g_lim):
+            sh = res_i["outs"]["theta"].sharding
+            captured.setdefault("spec", sh.spec if isinstance(
+                sh, NamedSharding) else None)
+            captured.setdefault(
+                "shard_shapes",
+                {s.data.shape
+                 for s in res_i["outs"]["theta"].addressable_shards},
+            )
+            return orig(self, res_i, t_at, g_lim)
+
+        DispatchEngine._fetch_tree = spy
+        try:
+            abc = _make(seed=41, pop=128, G=3, mesh=_mesh())
+            h = abc.run(max_nr_populations=4)
+        finally:
+            DispatchEngine._fetch_tree = orig
+        assert h.n_populations == 4
+        # n_cap = 128 -> 16 rows per device; G=3 scan axis unsharded
+        assert captured["spec"] == P(None, "particles")
+        assert captured["shard_shapes"] == {(3, 16, 1)}
+
+    def test_per_shard_rng_lanes_distinct(self):
+        """Each shard proposes from its own lane-key block: a
+        generation's accepted thetas contain no cross-shard duplicates
+        (distinct PRNG lanes, not a replicated draw)."""
+        abc = _make(seed=43, pop=128, mesh=_mesh())
+        h = abc.run(max_nr_populations=3)
+        df, _ = h.get_distribution(0, h.max_t)
+        th = df["theta"].to_numpy()
+        # merged layout is shard-blocked (16 rows per shard at pop 128):
+        # no shard block may replicate another, and the accepted set is
+        # overwhelmingly distinct (the f16 wire dtype may collapse a few
+        # near-identical draws, so exact all-unique is too strict)
+        blocks = th.reshape(8, 16)
+        for i in range(8):
+            for j in range(i + 1, 8):
+                assert not np.array_equal(blocks[i], blocks[j]), (i, j)
+        assert len(np.unique(th)) >= int(0.9 * len(th))
+
+
+# ------------------------------------- engine invariants under sharding
+
+class TestShardedEngine:
+    def test_sync_budget_holds(self, monkeypatch):
+        """The row merge rides the packed fetch: a sharded run pays the
+        same syncs as an unsharded one — asserted STRICT (a budget
+        violation raises instead of warning)."""
+        monkeypatch.setenv("PYABC_TPU_SYNC_BUDGET_STRICT", "1")
+        abc = _make(seed=51, mesh=_mesh())
+        abc.run(max_nr_populations=7)
+        report = abc._engine.sync_budget_report()
+        assert report["ok"], report
+        assert report["syncs"] <= report["chunks"] + 8
+
+    def test_speculative_rollback_bit_identical(self):
+        """A stopping-rule hit with speculative sharded chunks in flight
+        rolls them back unpersisted: History bit-identical to the
+        depth-1 run of the same seed (rollback stays bit-identical per
+        device — the carry chain and per-shard reservoirs never leak
+        into the db)."""
+        mesh = _mesh()
+        probe = _make(seed=77, G=2, mesh=mesh, fetch_pipeline_depth=1)
+        h_probe = probe.run(max_nr_populations=6)
+        eps_trail = h_probe.get_all_populations().query(
+            "t >= 0")["epsilon"].to_numpy()
+        assert len(eps_trail) >= 4
+        min_eps = float(eps_trail[3])
+
+        reg = MetricsRegistry()
+        spec = _make(seed=77, G=2, mesh=mesh, fetch_pipeline_depth=4,
+                     metrics=reg)
+        spec.adopt_device_context(probe)
+        h_spec = spec.run(minimum_epsilon=min_eps, max_nr_populations=12)
+        assert spec._engine.speculative_rollbacks >= 1
+        assert reg.snapshot()[
+            "pyabc_tpu_speculative_rollbacks_total"] >= 1
+
+        ref = _make(seed=77, G=2, mesh=mesh, fetch_pipeline_depth=1)
+        ref.adopt_device_context(probe)
+        h_ref = ref.run(minimum_epsilon=min_eps, max_nr_populations=12)
+
+        a, b = _history_arrays(h_spec), _history_arrays(h_ref)
+        assert set(a) == set(b)
+        for k in a:
+            np.testing.assert_array_equal(
+                a[k], b[k], err_msg=f"sharded speculative run diverged "
+                                    f"at {k}")
+        assert h_spec.n_populations == h_ref.n_populations <= 6
+
+    def test_health_poison_recovery_under_sharding(self):
+        """The in-kernel health word still fires sharded (NaN flag is a
+        cross-shard reduction) and recovery rolls back to a healthy
+        carry: the poisoned run completes with the clean run's
+        posterior."""
+        from pyabc_tpu.resilience.faults import (
+            FaultPlan,
+            FaultRule,
+            install_fault_plan,
+            uninstall_fault_plan,
+        )
+
+        mesh = _mesh()
+        clean = _make(seed=61, mesh=mesh)
+        h_clean = clean.run(max_nr_populations=7)
+
+        install_fault_plan(FaultPlan([
+            FaultRule(site="device.carry", kind="nan_poison", after=1,
+                      max_fires=1),
+        ]))
+        try:
+            poisoned = _make(seed=61, mesh=mesh)
+            poisoned.adopt_device_context(clean)
+            h_p = poisoned.run(max_nr_populations=7)
+        finally:
+            uninstall_fault_plan()
+        assert len(poisoned.health_supervisor.trail) >= 1
+        a, b = _history_arrays(h_clean), _history_arrays(h_p)
+        for k in a:
+            np.testing.assert_allclose(
+                a[k], b[k], rtol=1e-6, atol=1e-7,
+                err_msg=f"poisoned sharded run diverged at {k}")
+
+
+# ------------------------------------------------------------ gating
+
+class TestShardedGating:
+    def test_explicit_sharded_with_adaptive_distance_raises(self):
+        abc = pt.ABCSMC(
+            _gauss_model(),
+            pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD)),
+            pt.AdaptivePNormDistance(p=2), population_size=128,
+            eps=pt.MedianEpsilon(), seed=1, mesh=_mesh(), sharded=True,
+            fused_generations=3,
+        )
+        with pytest.raises(ValueError, match="adaptive distances"):
+            abc._sharded_n()
+
+    def test_auto_mode_falls_back_for_adaptive_distance(self):
+        abc = pt.ABCSMC(
+            _gauss_model(),
+            pt.Distribution(theta=pt.RV("norm", 0.0, PRIOR_SD)),
+            pt.AdaptivePNormDistance(p=2), population_size=128,
+            eps=pt.MedianEpsilon(), seed=1, mesh=_mesh(),
+            fused_generations=3,
+        )
+        assert abc._sharded_n() is None  # GSPMD path serves it instead
+
+    def test_non_power_of_two_virtual_shards_raise(self):
+        abc = _make(seed=1, sharded=3)
+        with pytest.raises(ValueError, match="power of two"):
+            abc._sharded_n()
+
+    def test_mesh_width_mismatch_raises(self):
+        abc = _make(seed=1, mesh=_mesh(), sharded=4)
+        with pytest.raises(ValueError, match="mesh has 8 devices"):
+            abc._sharded_n()

@@ -32,6 +32,7 @@ from pyabc_tpu.analysis.engine import (
     Finding,
 )
 from pyabc_tpu.analysis.rules.clock import Clock001
+from pyabc_tpu.analysis.rules.collectives import Mesh001
 from pyabc_tpu.analysis.rules.dispatch import Disp001
 from pyabc_tpu.analysis.rules.exceptions import Exc001
 from pyabc_tpu.analysis.rules.locks import Lock001
@@ -384,6 +385,80 @@ def test_disp001_mutation_direct_dispatch_in_smc_fails():
         "a direct fetch_pack_kernel call re-added to smc.py left "
         "DISP001 silent — the engine's single-door invariant is no "
         "longer guarded")
+
+
+# --------------------------------------------------------------- MESH001
+
+MESH_FIRES = """
+import jax
+def sneak_reduce(x):
+    return jax.lax.psum(x, "particles")
+def sneak_gather(x):
+    return jax.lax.all_gather(x, "particles", tiled=True)
+def sneak_spmd(fn, mesh):
+    from jax.experimental.shard_map import shard_map
+    return shard_map(fn, mesh=mesh, in_specs=None, out_specs=None)
+"""
+
+MESH_CLEAN = """
+import numpy as np
+def quotas(n, shards):
+    return np.asarray([n // shards] * shards)
+"""
+
+MESH_SUPPRESSED = """
+import jax
+def probe(x):
+    # abc-lint: disable=MESH001 standalone diagnostic outside any run
+    return jax.lax.psum(x, "particles")
+"""
+
+
+def test_mesh001_fires_on_collectives_outside_kernel_layer():
+    open_, _ = check(Mesh001(), MESH_FIRES, "pyabc_tpu/inference/smc.py")
+    assert len(open_) == 3, [f.to_dict() for f in open_]
+    assert {"psum", "all_gather", "shard_map"} <= {
+        f.message.split("`")[1].split("(")[0] for f in open_
+    }
+
+
+def test_mesh001_kernel_layer_and_tests_exempt():
+    assert not Mesh001().applies_to("pyabc_tpu/inference/util.py")
+    assert not Mesh001().applies_to("pyabc_tpu/ops/shard.py")
+    assert not Mesh001().applies_to("pyabc_tpu/ops/pack.py")
+    assert not Mesh001().applies_to("tests/test_sharded.py")
+    assert Mesh001().applies_to("pyabc_tpu/inference/smc.py")
+    assert Mesh001().applies_to("pyabc_tpu/inference/dispatch.py")
+    assert Mesh001().applies_to("pyabc_tpu/parallel/distributed.py")
+    assert Mesh001().applies_to("pyabc_tpu/sampler/batched.py")
+    open_, _ = check(Mesh001(), MESH_CLEAN, "pyabc_tpu/inference/x.py")
+    assert open_ == []
+
+
+def test_mesh001_suppression_with_reason():
+    open_, sup = check(Mesh001(), MESH_SUPPRESSED,
+                       "pyabc_tpu/inference/x.py")
+    assert open_ == [] and len(sup) == 1 and sup[0].reason
+
+
+def test_mesh001_mutation_stray_psum_in_smc_fails():
+    """THE mutation guard: a stray collective growing into smc.py (an
+    unbudgeted sync path outside the kernel layer) must make MESH001
+    fire — today's smc.py is clean, a re-added psum is a finding."""
+    path = REPO / "pyabc_tpu" / "inference" / "smc.py"
+    src = path.read_text()
+    rel = "pyabc_tpu/inference/smc.py"
+    open_, _ = check(Mesh001(), src, rel)
+    assert open_ == [], [f.to_dict() for f in open_]
+    mutated = src + (
+        "\n\ndef _stray_mesh_reduce(self, x):\n"
+        "    import jax\n"
+        "    return jax.lax.psum(x, 'particles')\n"
+    )
+    open_m, _ = check(Mesh001(), mutated, rel)
+    assert len(open_m) >= 1, (
+        "a psum re-added to smc.py left MESH001 silent — the "
+        "chunk-boundary-only collective contract is no longer guarded")
 
 
 # --------------------------------------------------------------- TELEM001
