@@ -20,7 +20,8 @@ Env knobs: PYABC_TPU_BENCH_POP (default 1000), PYABC_TPU_BENCH_GENS (31),
 PYABC_TPU_BENCH_G (fused generations per chunk, 16),
 PYABC_TPU_BENCH_BUDGET_S (300), PYABC_TPU_BENCH_CPU=1 (force CPU platform),
 PYABC_TPU_BENCH_STORE_SS=1 (store per-particle sum stats in the db),
-PYABC_TPU_BENCH_ELASTIC/RESILIENCE/HEALTH/DISPATCH=0 (disable those lanes).
+PYABC_TPU_BENCH_ELASTIC/RESILIENCE/HEALTH/DISPATCH/SERVE=0 (disable those
+lanes).
 """
 import atexit
 import json
@@ -1296,6 +1297,182 @@ def run_mesh_lane(budget_s: float, platform: str = "cpu") -> dict:
                      f"{(proc.stderr or '')[-400:]}"}
 
 
+# -- serve lane ---------------------------------------------------------------
+
+
+def serve_lane_skip_reason() -> str | None:
+    """The `serve` lane proves the round-14 multi-tenant containment
+    contract end to end: a fleet of CPU tenants WITH an injected
+    serial-killer tenant must complete with survivors' wall clock within
+    10% of the same fleet fault-free, fair throughput across tenants,
+    and repeat program shapes hitting the kernel cache (zero compile).
+    CPU-cheap (small fused gauss fleets); PYABC_TPU_BENCH_SERVE=0
+    disables it."""
+    if os.environ.get("PYABC_TPU_BENCH_SERVE") == "0":
+        return "disabled via PYABC_TPU_BENCH_SERVE=0"
+    return None
+
+
+def run_serve_lane(budget_s: float) -> dict:
+    """Multi-tenant chaos lane: two fleets on ONE scheduler.
+
+    Fleet A (baseline): N same-shape gaussian tenants, fault-free.
+    Fleet B (chaos): the same N tenant configs PLUS one chaos tenant
+    hard-killed at every chunk (scoped by ``fault_scope``), which fails
+    after its requeue budget. Guards:
+
+    - ISOLATION: median survivor wall clock in fleet B <= 1.10x the
+      fleet-A median (+0.75 s absolute timing slack on shared cores) —
+      "a faulted tenant adds <= 10% to survivors' wall clock";
+    - FAIRNESS: max/min per-tenant throughput ratio within fleet A
+      bounded (equal shapes, equal slots -> near-equal service);
+    - CACHE: repeat shapes hit the shape-keyed kernel cache (every
+      fleet-B tenant adopts fleet A's compiled context: zero compile).
+    """
+    import numpy as np
+
+    from pyabc_tpu.resilience import (
+        FaultPlan,
+        FaultRule,
+        install_fault_plan,
+        uninstall_fault_plan,
+    )
+    from pyabc_tpu.serving import COMPLETED, FAILED, RunScheduler, TenantSpec
+    from pyabc_tpu.utils.bench_defaults import (
+        DEFAULT_SERVE_GENS,
+        DEFAULT_SERVE_POP,
+        DEFAULT_SERVE_SLOTS,
+        DEFAULT_SERVE_TENANTS,
+        SERVE_FAIRNESS_MAX_RATIO,
+        SERVE_ISOLATION_MAX_INFLATION,
+        SERVE_ISOLATION_SLACK_S,
+    )
+
+    n_tenants = int(os.environ.get("PYABC_TPU_BENCH_SERVE_TENANTS",
+                                   DEFAULT_SERVE_TENANTS))
+    pop = int(os.environ.get("PYABC_TPU_BENCH_SERVE_POP",
+                             DEFAULT_SERVE_POP))
+    gens = int(os.environ.get("PYABC_TPU_BENCH_SERVE_GENS",
+                              DEFAULT_SERVE_GENS))
+    n_slots = int(os.environ.get("PYABC_TPU_BENCH_SERVE_SLOTS",
+                                 DEFAULT_SERVE_SLOTS))
+    t_lane0 = CLOCK.now()
+
+    import tempfile
+
+    sched = RunScheduler(
+        n_slots=n_slots, max_queued=2 * n_tenants + 2,
+        lease_timeout_s=90.0, max_requeues=1,
+        base_dir=tempfile.mkdtemp(prefix="abc-bench-serve-"),
+    )
+
+    def spec(seed):
+        return TenantSpec(model="gaussian", population_size=pop,
+                          generations=gens, seed=seed,
+                          fused_generations=2)
+
+    def run_fleet(tag, seeds, chaos=False):
+        tenants = []
+        if chaos:
+            # the victim id must not be a substring of any survivor id:
+            # FaultRule.match is substring-based fault-domain selection
+            tenants.append(sched.submit(
+                spec(9009), tenant_id="serialkiller"))
+        tenants += [
+            sched.submit(spec(s), tenant_id=f"{tag}-{i}")
+            for i, s in enumerate(seeds)
+        ]
+        deadline = CLOCK.now() + max(budget_s * 0.4, 60.0)
+        import time as _t
+
+        while CLOCK.now() < deadline:
+            if all(t.state in (COMPLETED, FAILED) for t in tenants):
+                break
+            _t.sleep(0.1)
+        return tenants
+
+    try:
+        seeds = [500 + i for i in range(n_tenants)]
+        # warm-up: ONE tenant compiles the fleet shape, so both fleets
+        # measure warm service time (wall-clock comparisons and the
+        # fairness ratio would otherwise mix a ~seconds XLA compile
+        # into some tenants' run_s and not others')
+        run_fleet("warm", [499])
+        base = run_fleet("base", seeds)
+        install_fault_plan(FaultPlan([
+            FaultRule(site="orchestrator.chunk", kind="kill", every=1,
+                      max_fires=None, match="serialkiller"),
+        ]))
+        try:
+            chaos = run_fleet("fleetb", seeds, chaos=True)
+        finally:
+            uninstall_fault_plan()
+
+        base_ok = [t for t in base if t.state == COMPLETED]
+        chaos_tenant = chaos[0]
+        survivors = [t for t in chaos[1:] if t.state == COMPLETED]
+        base_walls = [t.run_s for t in base_ok]
+        surv_walls = [t.run_s for t in survivors]
+        base_med = float(np.median(base_walls)) if base_walls else 0.0
+        surv_med = float(np.median(surv_walls)) if surv_walls else 1e9
+        # per-tenant throughput over fleet A (equal shapes -> fairness)
+        pps = [pop * gens / t.run_s for t in base_ok if t.run_s > 0]
+        fairness = (max(pps) / min(pps)) if pps else float("inf")
+        cache = sched.kernel_cache.stats()
+        # every fleet-B tenant reuses fleet A's compiled shape
+        chaos_hits = [t.kernel_cache_hit for t in chaos[1:]]
+        compile_spans_b = sum(t.compile_span_count() for t in chaos[1:])
+
+        isolation_bound = (base_med * SERVE_ISOLATION_MAX_INFLATION
+                           + SERVE_ISOLATION_SLACK_S)
+        out = {
+            "metric": "serve_multi_tenant_chaos",
+            "n_tenants": n_tenants, "n_slots": n_slots,
+            "pop_size": pop, "generations": gens,
+            "lane_s": round(CLOCK.now() - t_lane0, 2),
+            "baseline_completed": len(base_ok),
+            "survivors_completed": len(survivors),
+            "chaos_tenant_state": chaos_tenant.state,
+            "chaos_tenant_requeues": int(chaos_tenant.requeues),
+            "survivor_wall_median_s": round(surv_med, 3),
+            "baseline_wall_median_s": round(base_med, 3),
+            "survivor_inflation": round(
+                surv_med / base_med, 4) if base_med else None,
+            "fairness_max_min_pps_ratio": round(fairness, 4),
+            "tenant_pps": [round(v, 1) for v in pps],
+            "kernel_cache": cache,
+            "fleet_b_cache_hits": sum(1 for h in chaos_hits if h),
+            "fleet_b_compile_spans": int(compile_spans_b),
+            "stale_reports_discarded": int(
+                sched.stale_reports_discarded),
+        }
+        guard = {
+            "pass_all_survivors_complete": bool(
+                len(survivors) == n_tenants
+                and len(base_ok) == n_tenants),
+            "pass_chaos_contained": bool(
+                chaos_tenant.state == FAILED
+                and chaos_tenant.requeues == 1),
+            # the <=10% isolation criterion, with absolute slack for
+            # shared-core timing noise on small runs
+            "pass_isolation": bool(surv_med <= isolation_bound),
+            "isolation_bound_s": round(isolation_bound, 3),
+            # equal-shape tenants through equal slots: generous bound
+            # for a 1-core box where slot overlap is scheduler luck
+            "pass_fairness": bool(fairness <= SERVE_FAIRNESS_MAX_RATIO),
+            # repeat shapes pay zero compile: every fleet-B tenant hits
+            "pass_cache_hits": bool(
+                all(chaos_hits) and compile_spans_b == 0),
+        }
+        out["regression_guard"] = guard
+        out["value"] = 1.0 if all(
+            v for k, v in guard.items() if k.startswith("pass_")
+        ) else 0.0
+        return out
+    finally:
+        sched.shutdown()
+
+
 def main():
     from pyabc_tpu.utils.bench_defaults import (
         DEFAULT_BUDGET_S,
@@ -1343,6 +1520,27 @@ def main():
                 _state["mesh"] = {"error": repr(e)[:300]}
         _state["value"] = float(
             _state["mesh"].get("accepted_particles_per_sec_mesh") or 0.0)
+        _state["partial"] = False
+        _state["budget_used_s"] = round(CLOCK.now() - t_start, 1)
+        _state["phase"] = "done"
+        _emit()
+        return
+
+    # `abc-bench --lane serve`: ONLY the multi-tenant chaos lane
+    if (os.environ.get("PYABC_TPU_BENCH_LANE") or "").strip().lower() \
+            == "serve":
+        _state["phase"] = "serve"
+        _state["metric"] = "serve_multi_tenant_chaos"
+        serve_skip = serve_lane_skip_reason()
+        if serve_skip:
+            _state["serve"] = {"skipped": serve_skip}
+        else:
+            try:
+                _state["serve"] = run_serve_lane(
+                    budget - max(10.0, 0.05 * budget))
+            except Exception as e:
+                _state["serve"] = {"error": repr(e)[:300]}
+        _state["value"] = float(_state["serve"].get("value") or 0.0)
         _state["partial"] = False
         _state["budget_used_s"] = round(CLOCK.now() - t_start, 1)
         _state["phase"] = "done"
@@ -1404,9 +1602,11 @@ def main():
     dispatch_share = 0.0 if dispatch_skip else 0.10
     mesh_skip = mesh_lane_skip_reason()
     mesh_share = 0.0 if mesh_skip else 0.10
+    serve_skip = serve_lane_skip_reason()
+    serve_share = 0.0 if serve_skip else 0.08
     spend_until = t_start + (budget - reserve) * (
         1.0 - scale_share - elastic_share - resilience_share
-        - health_share - dispatch_share - mesh_share)
+        - health_share - dispatch_share - mesh_share - serve_share)
     # per-run host setup (ABCSMC construction, History/sqlite DDL, kernel
     # adoption) runs on this thread OVERLAPPED with the previous run's
     # device chunks — round 5 measured it as dark inter-run wall clock
@@ -1585,10 +1785,23 @@ def main():
         _state["phase"] = "mesh"
         try:
             _state["mesh"] = run_mesh_lane(
-                max(t_start + budget - reserve - CLOCK.now(), 60.0),
+                max(t_start + budget - reserve - CLOCK.now()
+                    - (budget - reserve) * serve_share, 60.0),
                 platform)
         except Exception as e:
             _state["mesh"] = {"error": repr(e)[:300]}
+
+    # -- serve lane: multi-tenant chaos containment (round 14;
+    # CPU-capable — or its recorded skip reason, never silent)
+    if serve_skip:
+        _state["serve"] = {"skipped": serve_skip}
+    else:
+        _state["phase"] = "serve"
+        try:
+            _state["serve"] = run_serve_lane(
+                max(t_start + budget - reserve - CLOCK.now(), 45.0))
+        except Exception as e:
+            _state["serve"] = {"error": repr(e)[:300]}
 
     _state["budget_used_s"] = round(CLOCK.now() - t_start, 1)
     _state["pop_size"] = pop

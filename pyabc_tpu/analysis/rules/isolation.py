@@ -1,0 +1,73 @@
+"""ISO001 — runs exist only inside the scheduler's leased path.
+
+The serving layer's containment story (round 14) hangs on ONE
+structural fact: every ABC-SMC run in ``pyabc_tpu/serving/`` is
+constructed, leased, supervised and torn down by the
+:class:`RunScheduler` — that is where fault scopes are entered, run
+leases granted, per-tenant namespaces bound and device slots counted.
+An ``ABCSMC(...)`` (or a raw ``DispatchEngine(...)`` / ``DeviceContext
+(...)``, or a device-context acquisition via ``_build_device_ctx`` /
+``adopt_device_context``) anywhere else in the serving package is an
+UNLEASED run: invisible to admission control, unkillable by lease
+expiry, uncounted against device slots — exactly the bypass that turns
+"multi-tenant with hard fault isolation" back into "several runs in one
+process". This rule makes the bypass a finding.
+
+Scope: ``pyabc_tpu/serving/`` only (the inference/bench/test layers
+construct ABCSMC legitimately), with ``scheduler.py`` — the leased
+path itself — exempt.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileContext, Finding, Rule
+
+#: constructing any of these IS starting (or arming) a run
+RUN_CONSTRUCTORS = {"ABCSMC", "DispatchEngine", "DeviceContext"}
+
+#: calling any of these acquires a compiled device context
+CONTEXT_ACQUIRERS = {"_build_device_ctx", "adopt_device_context",
+                     "_adopt_device_context_inner"}
+
+#: the scheduler's leased path — the one legitimate construction site
+ALLOWED = {"pyabc_tpu/serving/scheduler.py"}
+
+
+class Iso001(Rule):
+    name = "ISO001"
+    summary = ("run construction / device-context acquisition in the "
+               "serving layer outside the scheduler's leased path")
+    hint = ("only pyabc_tpu/serving/scheduler.py may construct "
+            "ABCSMC/DispatchEngine/DeviceContext or acquire a device "
+            "context — an unleased run bypasses admission control, run "
+            "leases, fault scoping and slot accounting; route it "
+            "through RunScheduler.submit()")
+
+    def applies_to(self, rel: str) -> bool:
+        return rel.startswith("pyabc_tpu/serving/") and rel not in ALLOWED
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = (func.id if isinstance(func, ast.Name)
+                    else func.attr if isinstance(func, ast.Attribute)
+                    else None)
+            if name in RUN_CONSTRUCTORS:
+                findings.append(self.finding(
+                    ctx, node,
+                    f"`{name}(...)` constructs a run outside the "
+                    f"scheduler's leased path — serving-layer runs must "
+                    f"be admitted, leased and supervised by RunScheduler",
+                ))
+            elif name in CONTEXT_ACQUIRERS:
+                findings.append(self.finding(
+                    ctx, node,
+                    f"`{name}(...)` acquires a device context outside "
+                    f"the scheduler's leased path — device slots are "
+                    f"leased resources in the serving layer",
+                ))
+        return findings

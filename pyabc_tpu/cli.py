@@ -83,12 +83,16 @@ def export_cmd(db, run_id, what, time_point, m, fmt, out):
 @click.option("--budget-s", type=float, default=DEFAULT_BUDGET_S,
               help="walltime budget in seconds")
 @click.option("--cpu", is_flag=True, help="force the CPU platform")
-@click.option("--lane", type=click.Choice(["all", "mesh"]), default="all",
+@click.option("--lane", type=click.Choice(["all", "mesh", "serve"]),
+              default="all",
               help="run only one bench lane: 'mesh' runs the sharded "
                    "multi-device lane (the MULTICHIP dryrun promoted to "
                    "a first-class path; forces 8 virtual CPU devices "
-                   "when no multi-device platform exists). Requires a "
-                   "repo checkout (bench.py).")
+                   "when no multi-device platform exists); 'serve' runs "
+                   "the multi-tenant chaos lane (N CPU tenants with "
+                   "injected kills — guards isolation, fairness and the "
+                   "kernel-cache hit rate). Requires a repo checkout "
+                   "(bench.py).")
 def bench_cmd(pop, gens, budget_s, cpu, lane):
     """Run the Lotka-Volterra throughput benchmark (one JSON line)."""
     if cpu:
@@ -344,6 +348,79 @@ def manager_cmd(host, port, watch):
         _time.sleep(2.0)
 
 
+@click.command("abc-serve")
+@click.option("--host", default="127.0.0.1", help="bind address")
+@click.option("--port", type=int, default=8766, help="port (0 = ephemeral)")
+@click.option("--slots", type=int, default=1,
+              help="concurrent device slots (tenants running at once)")
+@click.option("--max-queued", type=int, default=16,
+              help="admission queue depth; a full queue answers HTTP 429 "
+              "with a measured Retry-After instead of queueing unboundedly")
+@click.option("--lease-timeout-s", type=float, default=30.0,
+              help="run-lease timeout: a tenant orchestrator silent for "
+              "this long (hung) is presumed dead, its slot reclaimed and "
+              "the tenant requeued from its checkpoint. Size it above the "
+              "worst healthy chunk+compile wall time; DEAD orchestrators "
+              "are detected immediately regardless")
+@click.option("--max-requeues", type=int, default=1,
+              help="lease-expiry requeues per tenant before it fails "
+              "terminally with its health trail")
+@click.option("--base-dir", default=None,
+              help="directory for per-tenant History dbs + checkpoints "
+              "(default: a fresh temp dir)")
+@click.option("--writer-threads", type=int, default=2,
+              help="shared async History writer threads (the pooled "
+              "writer serving every tenant's db)")
+def serve_cmd(host, port, slots, max_queued, lease_timeout_s, max_requeues,
+              base_dir, writer_threads):
+    """Multi-tenant ABC-SMC serving: a RunScheduler multiplexing leased
+    tenant runs over shared device slots, fronted by the submit/status/
+    stream HTTP API. SIGTERM/SIGINT drains gracefully — every live
+    tenant flushes its History and writes a final checkpoint before the
+    process exits."""
+    import signal as _signal
+
+    from .serving import RunScheduler, serve_api
+
+    sched = RunScheduler(
+        n_slots=slots, max_queued=max_queued,
+        lease_timeout_s=lease_timeout_s, max_requeues=max_requeues,
+        base_dir=base_dir, writer_threads=writer_threads,
+    )
+    httpd = serve_api(sched, host=host, port=port, block=False)
+    click.echo(
+        f"abc-serve on http://{host}:{httpd.server_port} "
+        f"(slots={slots}, max_queued={max_queued}, "
+        f"base_dir={sched.base_dir})", err=True,
+    )
+
+    stop = {"sig": None}
+
+    def _on_signal(signum, frame):
+        stop["sig"] = signum
+
+    for sig in (_signal.SIGTERM, _signal.SIGINT):
+        _signal.signal(sig, _on_signal)
+    import time as _time
+
+    while stop["sig"] is None:
+        _time.sleep(0.2)
+    click.echo(
+        f"signal {stop['sig']}: draining tenants (flush + final "
+        f"checkpoint)...", err=True,
+    )
+    summary = sched.drain(timeout_s=60.0)
+    httpd.shutdown()
+    sched.shutdown()
+    n_forced = len(summary["forced"])
+    click.echo(
+        f"drained: {len(summary['states'])} tenant(s), "
+        f"{n_forced} forced", err=True,
+    )
+    if n_forced:
+        raise SystemExit(1)
+
+
 @click.command("abc-server")
 @click.argument("db")
 @click.option("--host", default="127.0.0.1", help="bind address")
@@ -361,4 +438,5 @@ if __name__ == "__main__":  # pragma: no cover - manual invocation helper
     cmd = sys.argv[1] if len(sys.argv) > 1 else ""
     sys.argv = [sys.argv[0]] + sys.argv[2:]
     {"export": export_cmd, "bench": bench_cmd, "server": server_cmd,
-     "worker": worker_cmd, "manager": manager_cmd}.get(cmd, export_cmd)()
+     "worker": worker_cmd, "manager": manager_cmd,
+     "serve": serve_cmd}.get(cmd, export_cmd)()

@@ -151,6 +151,18 @@ class DispatchEngine:
         self.fetch_dtype = "float32" if sumstat_refit else owner.fetch_dtype
         B, n_cap, rec_cap, max_rounds, G = shapes
         self.G = int(G)
+        # does this run PAY the multigen trace/compile? A context
+        # adopted from a same-shape donor (bench back-to-backs, the
+        # serving layer's shape-keyed kernel cache) already holds the
+        # jitted program for these shapes — its first dispatch is
+        # cache-hit cheap, and the first-dispatch span below is marked
+        # compile=False so the serving tests can assert a repeat-shape
+        # tenant compiles NOTHING
+        self._fresh_compile = not any(
+            isinstance(k, tuple) and len(k) >= 6 and k[0] == "multigen"
+            and k[1:6] == (B, n_cap, rec_cap, max_rounds, G)
+            for k in getattr(ctx, "_kernels", {})
+        )
         # the ONE multigen-kernel build of the run (DISP001: kernel
         # construction and invocation both live in this module)
         with owner.tracer.span("kernel.build", G=int(G), B=int(B),
@@ -194,8 +206,11 @@ class DispatchEngine:
         self._t_chunk0 = self._clock.now()
         # the FIRST dispatch triggers the multigen kernel's trace/compile
         # (the dominant dark block on fresh runs, per the first coverage
-        # traces) — span it separately so compile time is attributed
-        with owner.tracer.span("dispatch", first=True, t_first=int(t0)):
+        # traces) — span it separately so compile time is attributed;
+        # `compile` marks whether this run actually paid the trace (see
+        # the _fresh_compile probe in __init__)
+        with owner.tracer.span("dispatch", first=True, t_first=int(t0),
+                               compile=self._fresh_compile):
             res = self._dispatch_chunk(carry0, t0, g0)
         self.pending = [(self._submit(res, t0, g0), t0, g0, res["carry"])]
         self.tail = (res, t0, g0)
@@ -523,6 +538,15 @@ class DispatchEngine:
         owner = self.owner
         clk = self._clock.now
         self.state = PROCESS
+        # cooperative graceful stop (serving-layer drain): a stop
+        # requested from another thread becomes the SIGTERM path at this
+        # chunk boundary — flush + final checkpoint via the owner's
+        # BaseException handler, exactly like an in-thread signal
+        stop_signum = getattr(owner, "_stop_signum", None)
+        if stop_signum is not None:
+            from .smc import GracefulShutdown
+
+            raise GracefulShutdown(stop_signum)
         # resilience fault site: an injected orchestrator kill lands
         # HERE — after dispatch, before the chunk's results are
         # processed/persisted — the worst spot for generation-granularity

@@ -451,6 +451,15 @@ class ABCSMC:
         #: the current run's RunSupervisor (fresh per run; tests read
         #: its trail / rollback count after a run)
         self.health_supervisor = None
+        #: cooperative graceful-stop request (round 14, the serving
+        #: layer): signal handlers only exist on the main thread, but a
+        #: RunScheduler runs MANY tenants on orchestrator threads — a
+        #: drain must still give each of them the SIGTERM path (flush +
+        #: final checkpoint). :meth:`request_graceful_stop` sets this;
+        #: the dispatch engine converts it into a GracefulShutdown at
+        #: the next chunk boundary, which flows through the exact
+        #: BaseException path an in-thread signal would have taken.
+        self._stop_signum: int | None = None
         #: decoded checkpoint carry awaiting adoption by the fused loop
         self._resume_carry = None
         #: generation the last run resumed at via the checkpoint (None =
@@ -893,6 +902,22 @@ class ABCSMC:
             max_total_nr_simulations, max_walltime,
         )
 
+    def request_graceful_stop(self, signum: int | None = None) -> None:
+        """Ask a run owned by ANOTHER thread to stop gracefully.
+
+        Thread-safe and idempotent. The fused dispatch engine checks the
+        flag at each chunk boundary and raises :class:`GracefulShutdown`
+        there, so the run flushes its async History writer and writes a
+        final checkpoint from the newest healthy carry — exactly the
+        SIGTERM semantics, without a signal (handlers cannot be
+        installed off the main thread). The serving layer's drain path
+        calls this on every live tenant. No-op after the run finished.
+        """
+        import signal as _signal
+
+        self._stop_signum = int(signum if signum is not None
+                                else _signal.SIGTERM)
+
     def drain_join(self) -> None:
         """Block until a ``drain_async`` background drain (the fused
         loop's final in-flight fetches + persist) has finished, and
@@ -1130,6 +1155,10 @@ class ABCSMC:
     def _run_impl(self, minimum_epsilon, max_nr_populations,
                   min_acceptance_rate, max_total_nr_simulations,
                   max_walltime) -> History:
+        # a stop requested against a PREVIOUS run of this object must
+        # not abort the new one (requeued tenants build fresh objects,
+        # but back-to-back run() calls on one object are supported)
+        self._stop_signum = None
         with self.tracer.span("run", db=getattr(self.history, "db", None)):
             with self._graceful_signals():
                 return self._run_inner(

@@ -85,6 +85,8 @@ __all__ = [
     "register_worker_source", "unregister_worker_source",
     "register_dispatch_source", "unregister_dispatch_source",
     "dispatch_sources_snapshot",
+    "register_tenant_source", "unregister_tenant_source",
+    "tenant_sources_snapshot",
 ]
 
 _lock = _threading.Lock()
@@ -228,16 +230,72 @@ def dispatch_sources_snapshot() -> list:
     return out
 
 
+#: weakly-referenced multi-tenant namespaces: tenant name -> weakref to
+#: an object with ``namespace_snapshot() -> dict`` (the serving layer's
+#: Tenant registers itself while it lives). Pre-round-14 the snapshot
+#: assumed ONE run per process — concurrent runs interleaved their spans
+#: in the global tracer and overwrote each other's gauges; namespacing
+#: gives every run its own tracer/metrics pair and aggregates them here
+#: side by side instead.
+_tenant_sources: dict = {}
+
+
+def register_tenant_source(name: str, source) -> None:
+    """Register a tenant namespace (an object with
+    ``namespace_snapshot()``) under ``name`` with the process-wide
+    snapshot, via weakref. A later registration under the same name
+    replaces the earlier one (tenant ids are unique per scheduler)."""
+    import weakref
+
+    with _lock:
+        _tenant_sources[str(name)] = weakref.ref(source)
+
+
+def unregister_tenant_source(name: str) -> None:
+    with _lock:
+        _tenant_sources.pop(str(name), None)
+
+
+def tenant_sources_snapshot() -> dict:
+    """{tenant name: namespace snapshot} for every live tenant.
+
+    Race-free by construction: the registry is copied under the module
+    lock, each namespace snapshots its OWN tracer/metrics (which lock
+    internally), and a tenant garbage-collected mid-iteration simply
+    drops out — two concurrent callers each get a consistent view."""
+    with _lock:
+        refs = dict(_tenant_sources)
+    out: dict = {}
+    for name, ref in refs.items():
+        src = ref()
+        if src is None:
+            continue
+        try:
+            out[name] = src.namespace_snapshot()
+        except Exception as exc:  # snapshotting must never kill the
+            # dashboard — but the broken source is named, not swallowed
+            out[name] = {"__error__": repr(exc)[:200]}
+    with _lock:
+        for name in list(_tenant_sources):
+            if _tenant_sources[name]() is None:
+                del _tenant_sources[name]
+    return out
+
+
 def observability_snapshot() -> dict:
     """One JSON-ready dict of the process's tracer + metrics state —
     the in-process snapshot API (dashboard endpoint, bench block).
     ``workers`` carries the elastic pool's per-worker liveness, clock
     offsets and last errors when a broker is live in this process;
     ``dispatch`` carries each live dispatch engine's state (in-flight
-    chunks, speculative rollbacks, sync budget)."""
+    chunks, speculative rollbacks, sync budget); ``tenants`` carries
+    each live serving-layer tenant's PRIVATE tracer/metrics namespace —
+    concurrent runs aggregate side by side instead of interleaving
+    through the process globals."""
     return {
         "tracer": global_tracer().snapshot(),
         "metrics": global_metrics().snapshot(),
         "workers": _workers_snapshot(),
         "dispatch": dispatch_sources_snapshot(),
+        "tenants": tenant_sources_snapshot(),
     }

@@ -93,13 +93,33 @@ def _prom_name(name: str) -> str:
     return out if not out[:1].isdigit() else "_" + out
 
 
-def prometheus_text(registry) -> str:
+def _prom_labels(labels: dict | None, extra: str = "") -> str:
+    """Render a label set (plus pre-formatted ``extra`` pairs like the
+    histogram ``le``) as ``{k="v",...}`` — empty string for none."""
+    parts = []
+    for k, v in (labels or {}).items():
+        val = str(v).replace("\\", "\\\\").replace('"', '\\"')
+        parts.append(_prom_name(str(k)) + '="' + val + '"')
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def prometheus_text(registry, labels: dict | None = None) -> str:
     """Prometheus text-format dump of every instrument in ``registry``.
 
     Histograms render cumulative ``_bucket`` series plus ``_count`` /
     ``_sum``, counters get a ``_total`` suffix, gauges render as-is.
+
+    ``labels`` attaches a constant label set to every rendered series —
+    the multi-tenant serving layer renders each tenant's private
+    registry with ``labels={"tenant": name}`` so one scrape carries
+    every run's series WITHOUT collisions (pre-round-14 the exporter
+    assumed one run per process and concurrent runs overwrote each
+    other's gauges).
     """
     lines: list[str] = []
+    lab = _prom_labels(labels)
     for inst in registry.instruments():
         name = _prom_name(inst.name)
         if isinstance(inst, Counter):
@@ -111,12 +131,12 @@ def prometheus_text(registry) -> str:
             if inst.help:
                 lines.append(f"# HELP {name} {inst.help}")
             lines.append(f"# TYPE {name} counter")
-            lines.append(f"{name} {inst.value:g}")
+            lines.append(f"{name}{lab} {inst.value:g}")
         elif isinstance(inst, Gauge):
             if inst.help:
                 lines.append(f"# HELP {name} {inst.help}")
             lines.append(f"# TYPE {name} gauge")
-            lines.append(f"{name} {inst.value:g}")
+            lines.append(f"{name}{lab} {inst.value:g}")
         elif isinstance(inst, Histogram):
             if inst.help:
                 lines.append(f"# HELP {name} {inst.help}")
@@ -127,8 +147,12 @@ def prometheus_text(registry) -> str:
                 count, total = inst.count, inst.sum
             for edge, n in zip(inst.bucket_bounds(), buckets[:-1]):
                 cum += n
-                lines.append(f'{name}_bucket{{le="{edge:g}"}} {cum}')
-            lines.append(f'{name}_bucket{{le="+Inf"}} {count}')
-            lines.append(f"{name}_count {count}")
-            lines.append(f"{name}_sum {total:g}")
+                le = 'le="%g"' % edge
+                lines.append(
+                    f"{name}_bucket{_prom_labels(labels, le)} {cum}")
+            le_inf = 'le="+Inf"'
+            lines.append(
+                f"{name}_bucket{_prom_labels(labels, le_inf)} {count}")
+            lines.append(f"{name}_count{lab} {count}")
+            lines.append(f"{name}_sum{lab} {total:g}")
     return "\n".join(lines) + ("\n" if lines else "")

@@ -225,6 +225,36 @@ MESH_IMBALANCE_GAUGE = "pyabc_tpu_mesh_shard_imbalance"
 MESH_BUSY_MAX_GAUGE = "pyabc_tpu_mesh_shard_busy_max_frac"
 
 
+# -- multi-tenant serving instrument names (round 14) -------------------------
+#
+# The RunScheduler/AdmissionController gauges and counters; one
+# canonical place so the scheduler, serve API, bench `serve` lane and
+# dashboard agree:
+#:  tenants currently holding a device slot (running)
+TENANTS_LIVE_GAUGE = "pyabc_tpu_tenant_live"
+#:  tenants admitted and waiting for a device slot
+TENANTS_QUEUED_GAUGE = "pyabc_tpu_tenant_queued"
+#:  submissions admitted (queued or started)
+TENANT_ADMISSIONS_TOTAL = "pyabc_tpu_tenant_admissions_total"
+#:  submissions rejected with typed backpressure (AdmissionRejectedError
+#:  + Retry-After) instead of unbounded queueing
+TENANT_REJECTIONS_TOTAL = "pyabc_tpu_tenant_admission_rejected_total"
+#:  run leases reaped (orchestrator thread dead or hung past the lease
+#:  timeout) with the tenant requeued from its checkpoint
+TENANT_REQUEUES_TOTAL = "pyabc_tpu_tenant_requeues_total"
+#:  tenants that finished with a posterior (the happy path)
+TENANT_COMPLETED_TOTAL = "pyabc_tpu_tenant_completed_total"
+#:  tenants that failed terminally (requeue budget exhausted, degenerate
+#:  run, unhandled orchestrator error)
+TENANT_FAILURES_TOTAL = "pyabc_tpu_tenant_failures_total"
+#:  tenants drained gracefully (flush + final checkpoint) on SIGTERM
+TENANT_DRAINS_TOTAL = "pyabc_tpu_tenant_drains_total"
+#:  shape-keyed kernel-cache hits (tenant paid zero compile) / misses
+TENANT_KERNEL_CACHE_HITS_TOTAL = "pyabc_tpu_tenant_kernel_cache_hits_total"
+TENANT_KERNEL_CACHE_MISSES_TOTAL = \
+    "pyabc_tpu_tenant_kernel_cache_misses_total"
+
+
 def health_event_metric(kind: str) -> str:
     """Per-kind health-event counter name — the registry's stand-in for
     ``pyabc_tpu_health_events_total{kind=...}`` (the text exposition has

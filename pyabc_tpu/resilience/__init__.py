@@ -55,6 +55,8 @@ from .faults import (
     InjectedPersistError,
     InjectedTransientError,
     active_fault_plan,
+    current_fault_scope,
+    fault_scope,
     install_fault_plan,
     maybe_corrupt,
     maybe_fault,
@@ -76,6 +78,7 @@ __all__ = [
     "InjectedPersistError", "InjectedDeviceReset",
     "active_fault_plan", "install_fault_plan", "maybe_fault",
     "maybe_corrupt", "uninstall_fault_plan",
+    "fault_scope", "current_fault_scope",
     "DegenerateRunError", "RunSupervisor", "decode_health",
     "LeaseTable",
     "RetryPolicy", "DEFAULT_RETRY_POLICY", "DEFAULT_PERSIST_RETRY_POLICY",

@@ -49,12 +49,22 @@ when no plan is active (production pays one module-global read).
 ``abc-worker --fault-plan "worker.batch:kill:after=2"`` installs a
 parsed plan in a worker process; the bench ``resilience`` lane does the
 same in its mortal-worker subprocesses.
+
+Tenant scoping (round 14, the serving layer): one process now hosts
+MANY concurrent runs, but the plan stays process-global — so a rule's
+``match`` also tests the calling thread's :func:`fault_scope` tag. The
+RunScheduler wraps each tenant's orchestrator thread in
+``fault_scope(tenant_id)``, making
+``orchestrator.chunk:kill:match=tenant-3`` fire ONLY inside tenant 3's
+fault domain even though every tenant probes the same plan — the
+containment contract the chaos tests inject against.
 """
 from __future__ import annotations
 
 import random
 import threading
 import time
+from contextlib import contextmanager as _contextmanager
 from dataclasses import dataclass, field
 
 from ..observability import SYSTEM_CLOCK, global_metrics
@@ -144,6 +154,35 @@ class FaultRule:
             raise ValueError("every must be >= 1")
 
 
+#: thread-local fault-domain tag (the serving layer's tenant id); empty
+#: outside any scope. Read lock-free per probe.
+_SCOPE = threading.local()
+
+
+@_contextmanager
+def fault_scope(tag: str):
+    """Tag every probe on the calling thread with a fault-domain id.
+
+    A rule's ``match`` then selects this domain: ``match=tenant-3`` hits
+    probes made inside ``fault_scope("tenant-3")`` (or probes whose
+    explicit ``worker_id`` ctx matches, as before). Scopes nest; the
+    previous tag is restored on exit. Threads the scoped code spawns do
+    NOT inherit the tag — a tenant's fault domain is its orchestrator
+    thread, which is where every instrumented serving-path site probes.
+    """
+    prev = getattr(_SCOPE, "tag", "")
+    _SCOPE.tag = str(tag)
+    try:
+        yield
+    finally:
+        _SCOPE.tag = prev
+
+
+def current_fault_scope() -> str:
+    """The calling thread's fault-domain tag ('' outside any scope)."""
+    return getattr(_SCOPE, "tag", "")
+
+
 class FaultPlan:
     """A seeded, clock-injected set of fault rules probed at named sites."""
 
@@ -211,7 +250,8 @@ class FaultPlan:
                 if (rule.kind in _KIND_CORRUPT) is not corrupt:
                     continue
                 if rule.match and rule.match not in str(
-                        ctx.get("worker_id", "")):
+                        ctx.get("worker_id", "")) \
+                        and rule.match not in current_fault_scope():
                     continue
                 rule.n_probes += 1
                 if rule.n_probes <= rule.after:

@@ -1,0 +1,54 @@
+"""pyabc_tpu.serving — multi-tenant ABC-SMC serving with hard fault
+isolation (round 14).
+
+The ROADMAP's heavy-traffic north star made concrete: one process,
+shared device slots, MANY concurrent ABC-SMC runs as leased TENANTS.
+The subsystem composes the production spine the previous rounds built —
+run-level leases reuse :class:`~pyabc_tpu.resilience.lease.LeaseTable`
+(PR 5), containment rides the per-run RunSupervisor/checkpoint/
+GracefulShutdown machinery (PR 5/6), per-tenant observability
+namespaces extend ``observability_snapshot()`` (PR 1), and admission
+hits a shape-keyed compiled-kernel cache (PR 2's adoption, generalized)
+— into four pieces:
+
+- :mod:`.tenant` — :class:`TenantSpec` (declarative, JSON-postable) and
+  :class:`Tenant` (supervised runtime record + private tracer/metrics
+  namespace);
+- :mod:`.admission` — :class:`AdmissionController`: bounded queueing
+  with typed backpressure (:class:`AdmissionRejectedError` carrying a
+  measured ``retry_after_s``);
+- :mod:`.scheduler` — :class:`RunScheduler`: slot leasing, orchestrator
+  threads under per-tenant fault scopes, lease-expiry requeue from
+  checkpoints, graceful SIGTERM drain;
+- :mod:`.api` — the ``abc-serve`` HTTP surface (submit/status/stream,
+  ``/metrics`` with per-tenant labels).
+
+The headline contract, chaos-tested on CPU in ``tests/test_serving.py``
+and guarded by the bench ``serve`` lane: a fault injected into tenant A
+(any PR-5/PR-6 kind, at any site) never stalls, corrupts or starves
+tenant B.
+"""
+from .admission import AdmissionController, AdmissionRejectedError
+from .api import serve_api
+from .scheduler import RunScheduler
+from .tenant import (
+    CANCELLED,
+    COMPLETED,
+    DRAINED,
+    FAILED,
+    MODEL_BUILDERS,
+    QUEUED,
+    REQUEUED,
+    RUNNING,
+    TERMINAL_STATES,
+    Tenant,
+    TenantSpec,
+)
+
+__all__ = [
+    "AdmissionController", "AdmissionRejectedError",
+    "RunScheduler", "serve_api",
+    "Tenant", "TenantSpec", "MODEL_BUILDERS",
+    "QUEUED", "RUNNING", "REQUEUED", "COMPLETED", "FAILED",
+    "CANCELLED", "DRAINED", "TERMINAL_STATES",
+]

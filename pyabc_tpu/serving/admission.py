@@ -1,0 +1,118 @@
+"""Bounded admission with typed backpressure.
+
+A serving process protecting millions of users cannot queue unboundedly:
+past the configured depth a submission is REFUSED with
+:class:`AdmissionRejectedError` carrying a ``retry_after_s`` estimate
+(the HTTP API maps it to ``429 Too Many Requests`` + ``Retry-After``),
+so load sheds at the front door with an honest signal instead of
+accumulating latent work that times out after the client stopped
+caring. Admission also validates the spec — a malformed tenant is
+rejected before it costs a queue slot, let alone a device slot.
+
+The retry-after estimate is measured, not guessed: completed runs feed
+an exponentially-weighted per-run wall clock, and the hint is
+``queue_ahead x avg_run_s / n_slots`` (floored at 1 s) — the time until
+a freed slot plausibly reaches a NEW submission.
+"""
+from __future__ import annotations
+
+import threading
+
+from ..observability.metrics import (
+    TENANT_ADMISSIONS_TOTAL,
+    TENANT_REJECTIONS_TOTAL,
+)
+
+
+class AdmissionRejectedError(RuntimeError):
+    """Typed backpressure: the queue is full (or the spec is invalid —
+    then ``retry_after_s`` is None: retrying the same bad spec later
+    will not help)."""
+
+    def __init__(self, reason: str, retry_after_s: float | None = None):
+        super().__init__(reason)
+        self.reason = reason
+        self.retry_after_s = (
+            None if retry_after_s is None else float(retry_after_s)
+        )
+
+
+class AdmissionController:
+    """Validates specs and enforces the bounded-queue contract.
+
+    Owned by the scheduler (which reports queue/live occupancy at each
+    ``admit`` call); thread-safe — API handler threads race submissions
+    against the scheduler pump by design.
+    """
+
+    def __init__(self, *, max_queued: int = 16, n_slots: int = 1,
+                 clock=None, metrics=None, avg_run_s0: float = 5.0):
+        from ..observability import NULL_METRICS, SYSTEM_CLOCK
+
+        self.max_queued = int(max_queued)
+        self.n_slots = max(int(n_slots), 1)
+        self.clock = clock if clock is not None else SYSTEM_CLOCK
+        self.metrics = metrics if metrics is not None else NULL_METRICS
+        self._lock = threading.Lock()
+        self._avg_run_s = float(avg_run_s0)  # abc-lint: guarded-by=_lock
+        self.admitted_total = 0
+        self.rejected_total = 0
+
+    # ------------------------------------------------------------- policy
+    def admit(self, spec, *, queued_now: int, live_now: int) -> None:
+        """Raise :class:`AdmissionRejectedError` or return (admitted).
+
+        ``queued_now``/``live_now`` are the scheduler's occupancy at the
+        instant of the call (it holds its own lock around submit)."""
+        try:
+            spec.validate()
+        except ValueError as exc:
+            self._reject()
+            raise AdmissionRejectedError(
+                f"invalid spec: {exc}", retry_after_s=None
+            ) from exc
+        if queued_now >= self.max_queued:
+            retry = self.retry_after_s(queued_now)
+            self._reject()
+            raise AdmissionRejectedError(
+                f"admission queue full ({queued_now}/{self.max_queued} "
+                f"queued, {live_now} live): retry after ~{retry:.1f}s",
+                retry_after_s=retry,
+            )
+        with self._lock:
+            self.admitted_total += 1
+        self.metrics.counter(
+            TENANT_ADMISSIONS_TOTAL,
+            "tenant submissions admitted (queued or started)",
+        ).inc()
+
+    def retry_after_s(self, queued_now: int) -> float:
+        """Measured backpressure hint: how long until a new submission
+        plausibly reaches a device slot."""
+        with self._lock:
+            avg = self._avg_run_s
+        return max(1.0, (int(queued_now) + 1) * avg / self.n_slots)
+
+    def note_run_seconds(self, run_s: float) -> None:
+        """Feed one completed run's wall clock into the EW average the
+        retry-after hint derives from."""
+        run_s = max(float(run_s), 0.0)
+        with self._lock:
+            self._avg_run_s = 0.7 * self._avg_run_s + 0.3 * run_s
+
+    def _reject(self) -> None:
+        with self._lock:
+            self.rejected_total += 1
+        self.metrics.counter(
+            TENANT_REJECTIONS_TOTAL,
+            "tenant submissions rejected with typed backpressure",
+        ).inc()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "max_queued": self.max_queued,
+                "admitted_total": self.admitted_total,
+                "rejected_total": self.rejected_total,
+                "avg_run_s": round(self._avg_run_s, 3),
+            }

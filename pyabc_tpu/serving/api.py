@@ -1,0 +1,203 @@
+"""Submit/status/stream HTTP API over a :class:`RunScheduler`.
+
+Grown from ``visserver/server.py``'s stdlib ``ThreadingHTTPServer``
+pattern (no Flask in this environment) into the serving front door:
+
+- ``POST /api/submit``            JSON :class:`TenantSpec` -> ``{id}``;
+                                  a full queue answers ``429`` with a
+                                  measured ``Retry-After`` header
+                                  (typed backpressure, never unbounded
+                                  queueing)
+- ``GET  /api/tenants``           all tenants' status + scheduler state
+- ``GET  /api/tenant/<id>``       one tenant's status (state, progress,
+                                  lease/requeue history, health trail)
+- ``GET  /api/tenant/<id>/stream`` chunked NDJSON event tail
+                                  (lifecycle + per-chunk progress;
+                                  ``?since=<seq>`` resumes, the stream
+                                  ends when the tenant is terminal)
+- ``POST /api/tenant/<id>/cancel`` cancel (graceful for running runs)
+- ``GET  /api/observability``     the process snapshot — per-tenant
+                                  namespaces aggregated side by side
+- ``GET  /metrics``               Prometheus text: the global registry
+                                  plus every live tenant's private
+                                  registry rendered with a
+                                  ``{tenant="<id>"}`` label
+
+Security posture: binds loopback by default and trusts its callers —
+the same stance as the visserver dashboard; a production deployment
+fronts it with real auth.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .admission import AdmissionRejectedError
+from .scheduler import RunScheduler
+from .tenant import TERMINAL_STATES, TenantSpec
+
+
+def _make_handler(sched: RunScheduler):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet by default
+            pass
+
+        # ----------------------------------------------------- plumbing
+        def _send(self, code: int, payload: bytes,
+                  ctype: str = "application/json",
+                  headers: dict | None = None) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(payload)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, str(v))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def _json(self, code: int, obj,
+                  headers: dict | None = None) -> None:
+            self._send(code, json.dumps(obj, default=str).encode(),
+                       headers=headers)
+
+        def _body(self) -> dict:
+            n = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(n) if n else b"{}"
+            return json.loads(raw.decode() or "{}")
+
+        # ------------------------------------------------------- routes
+        def do_POST(self):  # noqa: N802 - stdlib API
+            try:
+                if self.path == "/api/submit":
+                    return self._submit()
+                if (self.path.startswith("/api/tenant/")
+                        and self.path.endswith("/cancel")):
+                    tid = self.path[len("/api/tenant/"):-len("/cancel")]
+                    ok = sched.cancel(tid)
+                    return self._json(200 if ok else 404,
+                                      {"cancelled": ok, "id": tid})
+                self._json(404, {"error": "not found"})
+            except Exception as exc:  # surface as 500, keep serving
+                self._json(500, {"error": repr(exc)[:300]})
+
+        def do_GET(self):  # noqa: N802 - stdlib API
+            try:
+                if self.path == "/api/tenants":
+                    return self._json(200, sched.snapshot())
+                if self.path == "/api/observability":
+                    from ..observability import observability_snapshot
+
+                    return self._json(200, observability_snapshot())
+                if self.path == "/metrics":
+                    return self._metrics()
+                if self.path.startswith("/api/tenant/"):
+                    rest = self.path[len("/api/tenant/"):]
+                    if rest.endswith("/stream") or "/stream?" in rest:
+                        tid, _, q = rest.partition("/stream")
+                        return self._stream(tid, q.lstrip("?"))
+                    tenant = sched.get(rest)
+                    if tenant is None:
+                        return self._json(404, {"error": "unknown tenant",
+                                                "id": rest})
+                    return self._json(200, tenant.to_status())
+                self._json(404, {"error": "not found"})
+            except BrokenPipeError:  # client went away mid-stream
+                pass
+            except Exception as exc:
+                self._json(500, {"error": repr(exc)[:300]})
+
+        # ------------------------------------------------------ handlers
+        def _submit(self) -> None:
+            body = self._body()
+            try:
+                spec = TenantSpec.from_dict(body)
+            except (TypeError, ValueError) as exc:
+                return self._json(400, {"error": f"bad spec: {exc}"})
+            try:
+                tenant = sched.submit(spec)
+            except AdmissionRejectedError as exc:
+                code = 429 if exc.retry_after_s is not None else 400
+                headers = (
+                    {"Retry-After": f"{exc.retry_after_s:.0f}"}
+                    if exc.retry_after_s is not None else None
+                )
+                return self._json(
+                    code,
+                    {"error": exc.reason,
+                     "retry_after_s": exc.retry_after_s},
+                    headers=headers,
+                )
+            self._json(200, {"id": tenant.id,
+                             "state": tenant.state,
+                             "db": tenant.db_path})
+
+        def _metrics(self) -> None:
+            from ..observability import global_metrics
+            from ..observability.export import prometheus_text
+
+            parts = [prometheus_text(global_metrics())]
+            for st in sched.snapshot()["tenants"]:
+                tenant = sched.get(st["id"])
+                if tenant is not None:
+                    parts.append(prometheus_text(
+                        tenant.metrics, labels={"tenant": tenant.id}))
+            self._send(200, "".join(parts).encode(),
+                       ctype="text/plain; version=0.0.4")
+
+        def _stream(self, tid: str, query: str) -> None:
+            tenant = sched.get(tid)
+            if tenant is None:
+                return self._json(404, {"error": "unknown tenant",
+                                        "id": tid})
+            since = 0
+            for kv in query.split("&"):
+                k, _, v = kv.partition("=")
+                if k == "since" and v.isdigit():
+                    since = int(v)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def write_chunk(data: bytes) -> None:
+                self.wfile.write(f"{len(data):X}\r\n".encode())
+                self.wfile.write(data + b"\r\n")
+                self.wfile.flush()
+
+            seq = since
+            while True:
+                events = tenant.events_since(seq, timeout_s=1.0)
+                for ev in events:
+                    seq = max(seq, int(ev["seq"]))
+                    write_chunk(
+                        (json.dumps(ev, default=str) + "\n").encode())
+                if not events and tenant.state in TERMINAL_STATES:
+                    break
+            write_chunk(
+                (json.dumps({"kind": "end", "state": tenant.state})
+                 + "\n").encode())
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+
+    return Handler
+
+
+def serve_api(sched: RunScheduler, host: str = "127.0.0.1",
+              port: int = 8766, block: bool = True) -> ThreadingHTTPServer:
+    """Serve the tenant API over ``sched``; ``block=False`` runs it on a
+    daemon thread and returns the server (tests / embedding)."""
+    httpd = ThreadingHTTPServer((host, port), _make_handler(sched))
+    if block:  # pragma: no cover - manual invocation
+        print(f"abc-serve API on http://{host}:{httpd.server_port}")
+        try:
+            httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            httpd.server_close()
+        return httpd
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    return httpd

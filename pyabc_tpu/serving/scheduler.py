@@ -1,0 +1,526 @@
+"""RunScheduler — admit, queue and supervise concurrent ABC-SMC runs.
+
+The serving layer's core (round 14): one process, ``n_slots`` device
+slots, MANY tenants. Every live tenant is a LEASED run — the slot
+handout reuses :class:`~pyabc_tpu.resilience.lease.LeaseTable`
+semantics verbatim (one slot per tenant, deadlines on the injected
+clock, any orchestrator heartbeat refreshes): an orchestrator thread
+that dies hard (injected kill — no report, no goodbye) or hangs past
+the lease timeout is PRESUMED DEAD, its device slot is reclaimed, and
+the tenant is requeued to resume from its PR-5 checkpoint — or failed
+with its PR-6 health trail once the requeue budget is spent. Survivor
+tenants never notice; that containment is chaos-tested on CPU.
+
+Fault domains: each tenant's run gets its own orchestrator thread under
+``fault_scope(tenant_id)`` (a process-global FaultPlan rule with
+``match=<tenant>`` fires only inside that tenant), its own RunSupervisor
+budget (per-run by construction), its own History database on the
+shared :class:`~pyabc_tpu.storage.WriterPool` (sticky persist failures
+latch per handle), and its own tracer/metrics namespace (registered
+with ``observability_snapshot()`` — concurrent runs aggregate, never
+interleave).
+
+Zero-compile admission: a shape-keyed
+:class:`~pyabc_tpu.utils.xla_cache.KernelCache` adopts the compiled
+``DeviceContext`` of any previously-served identical program shape into
+the new tenant, so tenant k+1 with a seen shape pays no trace/compile
+at all.
+
+ISO001 (abc-lint): this module is the ONLY place in
+``pyabc_tpu/serving/`` allowed to construct :class:`ABCSMC` or touch a
+device context — runs exist solely inside the scheduler's leased path.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+from collections import deque
+
+from ..observability import (
+    SYSTEM_CLOCK,
+    global_metrics,
+    register_tenant_source,
+)
+from ..observability.metrics import (
+    TENANT_COMPLETED_TOTAL,
+    TENANT_DRAINS_TOTAL,
+    TENANT_FAILURES_TOTAL,
+    TENANT_KERNEL_CACHE_HITS_TOTAL,
+    TENANT_KERNEL_CACHE_MISSES_TOTAL,
+    TENANT_REQUEUES_TOTAL,
+    TENANTS_LIVE_GAUGE,
+    TENANTS_QUEUED_GAUGE,
+)
+from ..resilience.lease import LeaseTable
+from ..storage import WriterPool
+from ..utils.xla_cache import KernelCache
+from .admission import AdmissionController, AdmissionRejectedError
+from .tenant import (
+    CANCELLED,
+    COMPLETED,
+    DRAINED,
+    FAILED,
+    QUEUED,
+    REQUEUED,
+    RUNNING,
+    TERMINAL_STATES,
+    Tenant,
+    TenantSpec,
+)
+
+
+class RunScheduler:
+    """Admits, queues and supervises tenants over shared device slots."""
+
+    def __init__(self, n_slots: int = 1, *, max_queued: int = 16,
+                 lease_timeout_s: float = 15.0, max_requeues: int = 1,
+                 base_dir: str | None = None, clock=None, metrics=None,
+                 writer_threads: int = 2, kernel_cache_entries: int = 8,
+                 tick_s: float = 0.05):
+        self.clock = clock if clock is not None else SYSTEM_CLOCK
+        self.metrics = metrics if metrics is not None else global_metrics()
+        self.n_slots = max(int(n_slots), 1)
+        self.max_requeues = int(max_requeues)
+        self.tick_s = float(tick_s)
+        if base_dir is None:
+            import tempfile
+
+            base_dir = tempfile.mkdtemp(prefix="abc-serve-")
+        self.base_dir = str(base_dir)
+        os.makedirs(self.base_dir, exist_ok=True)
+
+        self.admission = AdmissionController(
+            max_queued=max_queued, n_slots=self.n_slots, clock=self.clock,
+            metrics=self.metrics,
+        )
+        #: run-level leases: slot index leased to tenant id; heartbeats
+        #: come from the tenant's per-chunk callback
+        self.leases = LeaseTable(self.clock, timeout_s=lease_timeout_s)
+        self.kernel_cache = KernelCache(max_entries=kernel_cache_entries)
+        self.writer_pool = WriterPool(n_threads=writer_threads)
+
+        self._lock = threading.RLock()
+        self._wake = threading.Condition(self._lock)
+        self._tenants: dict[str, Tenant] = {}  # abc-lint: guarded-by=_lock
+        self._queue: deque = deque()  # abc-lint: guarded-by=_lock
+        self._free_slots: list[int] = list(range(self.n_slots))  # abc-lint: guarded-by=_lock
+        self._slot_of: dict[str, int] = {}  # abc-lint: guarded-by=_lock
+        self._reports: deque = deque()  # abc-lint: guarded-by=_lock
+        self._ids = itertools.count(1)
+        self._draining = False
+        self._shutdown = False
+        self.stale_reports_discarded = 0
+        self._pump = threading.Thread(
+            target=self._pump_loop, daemon=True, name="abc-serve-pump")
+        self._pump.start()
+
+    # ------------------------------------------------------------- submit
+    def submit(self, spec: TenantSpec, tenant_id: str | None = None
+               ) -> Tenant:
+        """Admit a run (or raise :class:`AdmissionRejectedError`).
+
+        Returns the supervised :class:`Tenant` immediately; the run
+        starts when a device slot frees up."""
+        with self._lock:
+            if self._shutdown or self._draining:
+                raise AdmissionRejectedError(
+                    "scheduler is draining: not admitting new tenants",
+                    retry_after_s=None,
+                )
+            queued_now = len(self._queue)
+            live_now = len(self._slot_of)
+            self.admission.admit(
+                spec, queued_now=queued_now, live_now=live_now)
+            tid = (str(tenant_id) if tenant_id is not None
+                   else f"tenant-{next(self._ids)}")
+            if tid in self._tenants:
+                raise AdmissionRejectedError(
+                    f"tenant id {tid!r} already exists", retry_after_s=None)
+            tenant = Tenant(
+                tid, spec, clock=self.clock,
+                db_path=f"sqlite:///{self.base_dir}/{tid}.db",
+                checkpoint_path=os.path.join(self.base_dir, f"{tid}.ck"),
+            )
+            self._tenants[tid] = tenant
+            self._queue.append(tid)
+            register_tenant_source(tid, tenant)
+            tenant.record_event("admitted", queued_ahead=queued_now)
+            self._set_occupancy_gauges_locked()
+            self._wake.notify_all()
+        return tenant
+
+    def get(self, tenant_id: str) -> Tenant | None:
+        with self._lock:
+            return self._tenants.get(str(tenant_id))
+
+    def cancel(self, tenant_id: str) -> bool:
+        """Cancel a queued tenant immediately; ask a running one to stop
+        gracefully (it flushes + checkpoints, then lands CANCELLED).
+        Returns False for unknown/terminal tenants."""
+        with self._lock:
+            tenant = self._tenants.get(str(tenant_id))
+            if tenant is None or tenant.state in TERMINAL_STATES:
+                return False
+            tenant.cancel_requested = True
+            if tenant.state in (QUEUED, REQUEUED):
+                self._dequeue_locked(tenant.id)
+                self._finish_locked(tenant, CANCELLED,
+                                    error="cancelled before start")
+                return True
+            if tenant.state == RUNNING and tenant.abc is not None:
+                tenant.abc.request_graceful_stop()
+                tenant.record_event("cancel_requested")
+            return True
+
+    # -------------------------------------------------------------- drain
+    def drain(self, timeout_s: float = 60.0) -> dict:
+        """Graceful SIGTERM path: stop admitting, cancel queued tenants,
+        ask every RUNNING tenant to stop (each flushes its History and
+        writes a final checkpoint via the PR-6 GracefulShutdown path),
+        and wait for them. Returns a summary; tenants still running at
+        the deadline are reported ``forced`` (their leases will reap)."""
+        with self._lock:
+            self._draining = True
+            for tid in list(self._queue):
+                tenant = self._tenants[tid]
+                self._dequeue_locked(tid)
+                self._finish_locked(tenant, CANCELLED,
+                                    error="drained before start")
+            running = [t for t in self._tenants.values()
+                       if t.state == RUNNING]
+            for tenant in running:
+                if tenant.abc is not None:
+                    tenant.abc.request_graceful_stop()
+                tenant.record_event("drain_requested")
+            self._wake.notify_all()
+        deadline = self.clock.now() + float(timeout_s)
+        while self.clock.now() < deadline:
+            with self._lock:
+                live = [t for t in self._tenants.values()
+                        if t.state == RUNNING]
+            if not live:
+                break
+            import time as _time
+
+            _time.sleep(0.02)
+        with self._lock:
+            states = {t.id: t.state for t in self._tenants.values()}
+            forced = [tid for tid, st in states.items() if st == RUNNING]
+        return {"states": states, "forced": forced}
+
+    def shutdown(self) -> None:
+        """Stop the pump and the writer pool (drain first for grace)."""
+        with self._lock:
+            self._shutdown = True
+            self._wake.notify_all()
+        self._pump.join(timeout=10)
+        self.writer_pool.close()
+
+    # ----------------------------------------------------------- snapshot
+    def snapshot(self) -> dict:
+        with self._lock:
+            tenants = [t.to_status() for t in self._tenants.values()]
+            queue = list(self._queue)
+            free = len(self._free_slots)
+        return {
+            "n_slots": self.n_slots,
+            "free_slots": free,
+            "queue": queue,
+            "draining": self._draining,
+            "tenants": tenants,
+            "leases": self.leases.stats(),
+            "admission": self.admission.stats(),
+            "kernel_cache": self.kernel_cache.stats(),
+            "stale_reports_discarded": int(self.stale_reports_discarded),
+        }
+
+    # ------------------------------------------------------------ pump
+    def _pump_loop(self) -> None:
+        while True:
+            # _wake shares _lock; acquiring the lock is what wait() needs
+            with self._lock:
+                if self._shutdown:
+                    return
+                self._drain_reports_locked()
+                self._reap_leases_locked()
+                self._start_queued_locked()
+                self._set_occupancy_gauges_locked()
+                self._wake.wait(timeout=self.tick_s)
+
+    def _drain_reports_locked(self) -> None:
+        while self._reports:
+            tid, epoch, outcome, payload = self._reports.popleft()
+            tenant = self._tenants.get(tid)
+            if tenant is None:
+                continue
+            if epoch != tenant.epoch or tenant.state != RUNNING:
+                # a STALE attempt (lease already reaped, tenant moved
+                # on) woke up and reported: exactly-once means its
+                # outcome is discarded, loudly
+                self.stale_reports_discarded += 1
+                tenant.record_event("stale_report_discarded",
+                                    outcome=outcome, epoch=epoch)
+                continue
+            self._release_slot_locked(tenant)
+            run_s = payload.get("run_s", 0.0)
+            tenant.run_s += run_s
+            self.admission.note_run_seconds(run_s)
+            if outcome == COMPLETED:
+                tenant.result = payload.get("result")
+                self._finish_locked(tenant, COMPLETED)
+            elif outcome == DRAINED:
+                state = (CANCELLED
+                         if getattr(tenant, "cancel_requested", False)
+                         else DRAINED)
+                self._finish_locked(tenant, state,
+                                    error=payload.get("error"))
+            else:  # failed
+                tenant.health_trail = payload.get("trail") or []
+                self._finish_locked(tenant, FAILED,
+                                    error=payload.get("error"))
+
+    def _reap_leases_locked(self) -> None:
+        # hard-dead orchestrator threads (injected kill: no report, no
+        # goodbye) are presumed dead immediately — same contract as the
+        # broker's worker liveness window, just cheaper to detect
+        dead = [
+            t.id for t in self._tenants.values()
+            if t.state == RUNNING and t.thread is not None
+            and not t.thread.is_alive()
+        ]
+        for ev in self.leases.reap(self.clock.now(), dead_wids=dead):
+            tenant = self._tenants.get(ev["wid"])
+            if tenant is None or tenant.state != RUNNING:
+                continue
+            tenant.record_event("lease_reaped", reason=ev["reason"])
+            # stale-ify the attempt: a hung thread waking later reports
+            # into a bumped epoch and is discarded; ask it to stop at
+            # its next chunk so it cannot keep burning the device
+            tenant.epoch += 1
+            if tenant.abc is not None:
+                tenant.abc.request_graceful_stop()
+            self._release_slot_locked(tenant, lease_already_gone=True)
+            if self._draining:
+                self._finish_locked(
+                    tenant, FAILED,
+                    error=f"lease {ev['reason']} during drain")
+            elif tenant.requeues >= self.max_requeues:
+                self._finish_locked(
+                    tenant, FAILED,
+                    error=(f"requeue budget exhausted after lease "
+                           f"{ev['reason']} "
+                           f"({tenant.requeues}/{self.max_requeues})"))
+            else:
+                tenant.requeues += 1
+                tenant.state = REQUEUED
+                tenant.abc = None
+                self._queue.append(tenant.id)
+                tenant.record_event("requeued", attempt=tenant.attempt)
+                self.metrics.counter(
+                    TENANT_REQUEUES_TOTAL,
+                    "run leases reaped with the tenant requeued from "
+                    "its checkpoint",
+                ).inc()
+
+    def _start_queued_locked(self) -> None:
+        i = 0
+        while self._free_slots and i < len(self._queue):
+            tid = self._queue[i]
+            tenant = self._tenants[tid]
+            # a requeued tenant must not race its own stale thread on
+            # the db/checkpoint: wait for that thread to exit first (the
+            # slot stays free for OTHER tenants meanwhile — no head-of-
+            # line blocking: we skip, not stall)
+            if tenant.thread is not None and tenant.thread.is_alive():
+                i += 1
+                continue
+            del self._queue[i]
+            slot = self._free_slots.pop(0)
+            self._slot_of[tid] = slot
+            self.leases.grant(tid, slot, slot + 1)
+            tenant.state = RUNNING
+            tenant.attempt += 1
+            epoch = tenant.epoch
+            if tenant.started_at is None:
+                tenant.started_at = self.clock.now()
+            tenant.record_event("started", slot=slot,
+                                attempt=tenant.attempt)
+            tenant.thread = threading.Thread(
+                target=self._run_tenant_attempt,
+                args=(tenant, epoch),
+                daemon=True, name=f"abc-serve-{tid}-a{tenant.attempt}",
+            )
+            tenant.thread.start()
+
+    def _release_slot_locked(self, tenant: Tenant,
+                             lease_already_gone: bool = False) -> None:
+        slot = self._slot_of.pop(tenant.id, None)
+        if slot is None:
+            return
+        if not lease_already_gone:
+            self.leases.note_delivery(slot)
+        self._free_slots.append(slot)
+
+    def _dequeue_locked(self, tid: str) -> None:
+        try:
+            self._queue.remove(tid)
+        except ValueError:
+            pass
+
+    def _finish_locked(self, tenant: Tenant, state: str,
+                       error: str | None = None) -> None:
+        tenant.state = state
+        tenant.error = error
+        tenant.finished_at = self.clock.now()
+        tenant.abc = None
+        tenant.record_event(state, error=error)
+        counters = {
+            COMPLETED: (TENANT_COMPLETED_TOTAL,
+                        "tenants finished with a posterior"),
+            FAILED: (TENANT_FAILURES_TOTAL,
+                     "tenants failed terminally"),
+            DRAINED: (TENANT_DRAINS_TOTAL,
+                      "tenants drained gracefully (flush + final "
+                      "checkpoint)"),
+        }
+        if state in counters:
+            name, help_ = counters[state]
+            self.metrics.counter(name, help_).inc()
+        self._set_occupancy_gauges_locked()
+        self._wake.notify_all()
+
+    def _set_occupancy_gauges_locked(self) -> None:
+        self.metrics.gauge(
+            TENANTS_LIVE_GAUGE,
+            "tenants currently holding a device slot",
+        ).set(len(self._slot_of))
+        self.metrics.gauge(
+            TENANTS_QUEUED_GAUGE,
+            "tenants admitted and waiting for a device slot",
+        ).set(len(self._queue))
+
+    # ------------------------------------------- the leased run (ISO001)
+    def _heartbeat(self, tenant: Tenant, epoch: int) -> None:
+        """Refresh the tenant's run lease — orchestrator-thread progress
+        only (setup milestones + per-chunk events); a hung thread makes
+        no progress and its lease expires. NOTE the timeout contract:
+        ``lease_timeout_s`` must exceed the worst silent stretch of a
+        healthy run (one chunk's compute plus, on a kernel-cache miss,
+        the XLA compile) — a DEAD thread is detected immediately via
+        thread liveness, the timeout only bounds HANG detection."""
+        with self._lock:
+            if epoch != tenant.epoch:
+                return  # stale attempt: no heartbeat rights
+            self.leases.touch_worker(tenant.id)
+
+    def _on_chunk(self, tenant: Tenant, epoch: int, ev: dict) -> None:
+        """Per-chunk heartbeat from the tenant's orchestrator thread:
+        refresh the run lease, advance progress, feed the event stream."""
+        self._heartbeat(tenant, epoch)
+        with self._lock:
+            if epoch != tenant.epoch:
+                return
+        done = int(ev.get("t_first", 0)) + int(ev.get("gens", 0))
+        tenant.generations_done = max(tenant.generations_done, done)
+        tenant.record_event(
+            "chunk", t_first=ev.get("t_first"), gens=ev.get("gens"),
+            n_acc=ev.get("n_acc"), chunk_s=round(ev.get("chunk_s", 0.0), 6),
+        )
+
+    def _report(self, tenant: Tenant, epoch: int, outcome: str,
+                **payload) -> None:
+        with self._lock:
+            self._reports.append((tenant.id, epoch, outcome, payload))
+            self._wake.notify_all()
+
+    def _run_tenant_attempt(self, tenant: Tenant, epoch: int) -> None:
+        """One leased attempt, on its own orchestrator thread — the only
+        place in pyabc_tpu/serving/ that builds an ABCSMC (ISO001)."""
+        from ..inference.smc import ABCSMC, GracefulShutdown
+        from ..resilience.faults import InjectedKill, fault_scope
+        from ..resilience.health import DegenerateRunError
+
+        t_run0 = self.clock.now()
+        with fault_scope(tenant.id):
+            try:
+                built = tenant.spec.abcsmc_kwargs()
+                abc = ABCSMC(
+                    tracer=tenant.tracer, metrics=tenant.metrics,
+                    checkpoint_path=tenant.checkpoint_path,
+                    **built["kwargs"],
+                )
+                self._heartbeat(tenant, epoch)  # setup milestone: built
+                if tenant.abc_id is not None:
+                    # requeued attempt: resume this tenant's run — the
+                    # PR-5 checkpoint adoption inside run() restores the
+                    # mid-chunk carry bit-exact
+                    abc.load(tenant.db_path, tenant.abc_id)
+                else:
+                    abc.new(tenant.db_path, built["observed"],
+                            store_sum_stats=tenant.spec.store_sum_stats)
+                    tenant.abc_id = int(abc.history.id)
+                # per-tenant History stream on the SHARED writer pool,
+                # tagged with this tenant's fault domain
+                abc.history.writer_pool = self.writer_pool
+                abc.history.writer_scope = tenant.id
+                self._heartbeat(tenant, epoch)  # setup milestone: db open
+                hit = self.kernel_cache.adopt_or_register(abc)
+                if tenant.kernel_cache_hit is None:
+                    tenant.kernel_cache_hit = hit
+                self.metrics.counter(
+                    TENANT_KERNEL_CACHE_HITS_TOTAL if hit
+                    else TENANT_KERNEL_CACHE_MISSES_TOTAL,
+                    "shape-keyed kernel cache hits (zero compile)"
+                    if hit else "shape-keyed kernel cache misses",
+                ).inc()
+                tenant.record_event("kernel_cache",
+                                    hit=hit, attempt=tenant.attempt)
+                tenant.abc = abc
+                abc.chunk_event_cb = (
+                    lambda ev, _t=tenant, _e=epoch:
+                    self._on_chunk(_t, _e, ev)
+                )
+                run_kwargs: dict = {
+                    "max_nr_populations": int(tenant.spec.generations),
+                }
+                if tenant.spec.minimum_epsilon is not None:
+                    run_kwargs["minimum_epsilon"] = float(
+                        tenant.spec.minimum_epsilon)
+                if tenant.spec.max_walltime_s is not None:
+                    run_kwargs["max_walltime"] = float(
+                        tenant.spec.max_walltime_s)
+                h = abc.run(**run_kwargs)
+                self.kernel_cache.register_from(abc)
+                result = {
+                    "n_populations": int(h.n_populations),
+                    "total_simulations": int(h.total_nr_simulations),
+                }
+                self._report(
+                    tenant, epoch, COMPLETED, result=result,
+                    run_s=self.clock.now() - t_run0,
+                )
+            except InjectedKill:
+                # HARD death: no report, no slot release — the run
+                # lease must expire (or the dead thread be noticed) for
+                # the scheduler to reclaim and requeue; this is the
+                # chaos tests' primary weapon
+                return
+            except GracefulShutdown as exc:
+                self._report(
+                    tenant, epoch, DRAINED, error=str(exc),
+                    run_s=self.clock.now() - t_run0,
+                )
+            except DegenerateRunError as exc:
+                self._report(
+                    tenant, epoch, FAILED,
+                    error=f"degenerate run: {exc}", trail=exc.trail,
+                    run_s=self.clock.now() - t_run0,
+                )
+            except BaseException as exc:  # noqa: BLE001 - tenant fault
+                # domain boundary: ANY other orchestrator failure is
+                # contained to this tenant and reported typed
+                self._report(
+                    tenant, epoch, FAILED, error=repr(exc)[:500],
+                    run_s=self.clock.now() - t_run0,
+                )

@@ -1,0 +1,324 @@
+"""Tenant identity, lifecycle and per-tenant observability namespace.
+
+A TENANT is one admitted ABC-SMC run living inside the shared serving
+process: a declarative :class:`TenantSpec` (what to run), plus the
+runtime :class:`Tenant` record the :class:`~pyabc_tpu.serving.scheduler.
+RunScheduler` supervises — state machine, attempt counter, run lease,
+private History database/checkpoint paths, and a PRIVATE
+tracer/metrics pair registered with
+:func:`pyabc_tpu.observability.observability_snapshot` so concurrent
+runs aggregate side by side instead of interleaving through process
+globals (the pre-round-14 one-run-per-process assumption).
+
+Fault-domain contract: everything a tenant's run does — device chunks,
+History persists, health recovery — happens on its orchestrator thread
+inside ``fault_scope(tenant_id)`` with its own RunSupervisor budget and
+its own sticky-isolated writer handle, so an injected kill, hang or
+NaN-poison against tenant A is invisible to tenant B except through OS
+scheduling. The chaos tests in ``tests/test_serving.py`` hold that
+line.
+
+This module deliberately does NOT construct :class:`ABCSMC` or touch a
+device context — abc-lint ISO001 reserves that for the scheduler's
+leased path; :meth:`TenantSpec.abcsmc_kwargs` only DESCRIBES the run.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from ..observability import MetricsRegistry, Tracer
+
+# ------------------------------------------------------------ tenant states
+#: admitted, waiting for a device slot
+QUEUED = "queued"
+#: holding a device slot; orchestrator thread live under a run lease
+RUNNING = "running"
+#: lease reaped (thread dead or hung); waiting to restart from checkpoint
+REQUEUED = "requeued"
+#: finished with a posterior
+COMPLETED = "completed"
+#: terminal failure (requeue budget exhausted / degenerate / error)
+FAILED = "failed"
+#: cancelled by the client before completion
+CANCELLED = "cancelled"
+#: gracefully drained on SIGTERM: History flushed + final checkpoint
+DRAINED = "drained"
+
+#: states the scheduler will never move a tenant out of
+TERMINAL_STATES = frozenset({COMPLETED, FAILED, CANCELLED, DRAINED})
+
+
+def _build_gaussian(spec: "TenantSpec") -> dict:
+    """Conjugate-normal toy: cheap fused-path workload (CPU chaos tests
+    and the bench `serve` lane run fleets of these)."""
+    import pyabc_tpu as pt
+
+    noise_sd = float(spec.params.get("noise_sd", 0.5))
+
+    @pt.JaxModel.from_function(["theta"], name="gauss")
+    def model(key, theta):
+        import jax
+
+        return {"x": theta[0] + noise_sd * jax.random.normal(key)}
+
+    prior = pt.Distribution(theta=pt.RV("norm", 0.0, 1.0))
+    return {
+        "models": model,
+        "parameter_priors": prior,
+        "distance_function": pt.PNormDistance(p=2),
+        "eps": pt.MedianEpsilon(),
+        "observed": {"x": float(spec.params.get("x_obs", 1.0))},
+    }
+
+
+def _build_lotka_volterra(spec: "TenantSpec") -> dict:
+    """The bench's LV ODE config — the production-shaped workload."""
+    import pyabc_tpu as pt
+    from ..models import lotka_volterra as lv
+
+    return {
+        "models": lv.make_lv_model(),
+        "parameter_priors": lv.default_prior(),
+        "distance_function": pt.AdaptivePNormDistance(p=2),
+        "eps": pt.MedianEpsilon(),
+        "observed": lv.observed_data(seed=int(spec.data_seed)),
+    }
+
+
+#: declarative model registry the submit API draws from; each builder
+#: maps a spec to ABCSMC component kwargs + the observed data
+MODEL_BUILDERS = {
+    "gaussian": _build_gaussian,
+    "lotka_volterra": _build_lotka_volterra,
+}
+
+
+@dataclass
+class TenantSpec:
+    """What to run — declarative and JSON-serializable (the submit API
+    posts exactly these fields).
+
+    ``model`` names a :data:`MODEL_BUILDERS` entry; ``params`` feeds the
+    builder (observation value, noise scale, ...); ``abcsmc_overrides``
+    passes through to the ABCSMC constructor (checkpointing, health
+    floors) — the scheduler supplies tracer/metrics/checkpoint_path
+    itself and rejects overrides colliding with them.
+    """
+
+    model: str = "gaussian"
+    population_size: int = 100
+    generations: int = 4
+    seed: int = 0
+    fused_generations: int = 4
+    data_seed: int = 123
+    #: per-particle sumstat retention. Default True: lease-expiry
+    #: REQUEUE resumes via History `load()`, whose adaptive-state
+    #: restore reads the last stored generation's sum stats — a tenant
+    #: that opts out trades the smaller fetch/db for failing its
+    #: requeue (first attempts are unaffected)
+    store_sum_stats: bool | int = True
+    minimum_epsilon: float | None = None
+    max_walltime_s: float | None = None
+    params: dict = field(default_factory=dict)
+    abcsmc_overrides: dict = field(default_factory=dict)
+
+    #: constructor kwargs the scheduler owns; a spec override colliding
+    #: with one of these is an admission-time validation error
+    RESERVED_OVERRIDES = frozenset({
+        "tracer", "metrics", "checkpoint_path", "seed",
+        "population_size", "fused_generations",
+    })
+
+    def validate(self) -> None:
+        if self.model not in MODEL_BUILDERS:
+            raise ValueError(
+                f"unknown model {self.model!r} "
+                f"(one of {sorted(MODEL_BUILDERS)})"
+            )
+        if int(self.population_size) < 2:
+            raise ValueError("population_size must be >= 2")
+        if int(self.generations) < 1:
+            raise ValueError("generations must be >= 1")
+        if int(self.fused_generations) < 1:
+            raise ValueError("fused_generations must be >= 1")
+        bad = self.RESERVED_OVERRIDES & set(self.abcsmc_overrides)
+        if bad:
+            raise ValueError(
+                f"abcsmc_overrides may not set {sorted(bad)}: the "
+                f"scheduler owns those (per-tenant namespace/lease path)"
+            )
+
+    def abcsmc_kwargs(self) -> dict:
+        """ABCSMC constructor kwargs + the observed data for this spec.
+
+        Returns ``{"kwargs": {...}, "observed": {...}}``; the SCHEDULER
+        turns this into a live run inside its leased path (ISO001).
+        """
+        built = MODEL_BUILDERS[self.model](self)
+        observed = built.pop("observed")
+        kwargs = dict(built)
+        kwargs.update(
+            population_size=int(self.population_size),
+            seed=int(self.seed),
+            fused_generations=int(self.fused_generations),
+        )
+        kwargs.update(self.abcsmc_overrides)
+        return {"kwargs": kwargs, "observed": observed}
+
+    def to_dict(self) -> dict:
+        return {
+            "model": self.model,
+            "population_size": int(self.population_size),
+            "generations": int(self.generations),
+            "seed": int(self.seed),
+            "fused_generations": int(self.fused_generations),
+            "data_seed": int(self.data_seed),
+            "store_sum_stats": self.store_sum_stats,
+            "minimum_epsilon": self.minimum_epsilon,
+            "max_walltime_s": self.max_walltime_s,
+            "params": dict(self.params),
+            "abcsmc_overrides": {
+                k: v for k, v in self.abcsmc_overrides.items()
+            },
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TenantSpec":
+        known = {f for f in cls.__dataclass_fields__}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"unknown TenantSpec fields {sorted(unknown)}")
+        return cls(**d)
+
+
+class Tenant:
+    """One admitted run's supervised runtime record.
+
+    Owned and mutated by the scheduler (under its lock for state
+    transitions); exposes read-only JSON-ready views for the API and a
+    ``namespace_snapshot`` for the process observability snapshot. The
+    private ``tracer``/``metrics`` pair IS the tenant's observability
+    namespace — the scheduler passes them into the tenant's ABCSMC so
+    every span/gauge of the run lands here, not in process globals.
+    """
+
+    def __init__(self, tenant_id: str, spec: TenantSpec, *, clock,
+                 db_path: str, checkpoint_path: str,
+                 max_events: int = 512):
+        self.id = str(tenant_id)
+        self.spec = spec
+        self.clock = clock
+        self.db_path = str(db_path)
+        self.checkpoint_path = str(checkpoint_path)
+        self.state = QUEUED
+        self.attempt = 0
+        self.requeues = 0
+        self.abc_id: int | None = None
+        self.submitted_at = clock.now()
+        self.started_at: float | None = None
+        self.finished_at: float | None = None
+        #: wall seconds actually spent RUNNING (summed over attempts)
+        self.run_s = 0.0
+        self.generations_done = 0
+        self.error: str | None = None
+        #: the PR-6 health trail of a failed run, shipped with status
+        self.health_trail: list[dict] = []
+        self.kernel_cache_hit: bool | None = None
+        self.cancel_requested = False
+        self.result: dict | None = None
+        #: live run handle (the scheduler's leased ABCSMC); None unless
+        #: RUNNING — drain and cancel reach the run through it
+        self.abc = None
+        #: current orchestrator thread (one per attempt)
+        self.thread: threading.Thread | None = None
+        #: epoch guard: results reported by a STALE attempt (a hung
+        #: thread waking after its lease was reaped) are discarded
+        self.epoch = 0
+        self._lock = threading.Lock()
+        self._events: list[dict] = []  # abc-lint: guarded-by=_lock
+        self._event_seq = 0  # abc-lint: guarded-by=_lock
+        self._event_waiters = threading.Condition(self._lock)
+        self._max_events = int(max_events)
+        #: per-tenant observability namespace (its own injected-clock
+        #: tracer + registry; the run, History writer and supervisor all
+        #: record here)
+        self.tracer = Tracer(clock=clock)
+        self.metrics = MetricsRegistry(clock=clock)
+
+    # ----------------------------------------------------------- events
+    def record_event(self, kind: str, **attrs) -> None:
+        """Append one lifecycle/progress event (bounded ring; the
+        streaming API tails it)."""
+        with self._lock:
+            self._event_seq += 1
+            self._events.append({
+                "seq": self._event_seq, "kind": kind,
+                "ts": round(self.clock.now(), 6), **attrs,
+            })
+            if len(self._events) > self._max_events:
+                del self._events[: len(self._events) - self._max_events]
+            self._event_waiters.notify_all()
+
+    def events_since(self, seq: int, timeout_s: float = 0.0) -> list[dict]:
+        """Events with ``seq > seq`` (optionally waiting up to
+        ``timeout_s`` for the first new one) — the stream API's tail."""
+        deadline = self.clock.now() + max(float(timeout_s), 0.0)
+        with self._lock:
+            while True:
+                out = [e for e in self._events if e["seq"] > seq]
+                if out or timeout_s <= 0:
+                    return out
+                remaining = deadline - self.clock.now()
+                if remaining <= 0:
+                    return out
+                self._event_waiters.wait(timeout=min(remaining, 0.25))
+
+    # ------------------------------------------------------------ views
+    def to_status(self) -> dict:
+        """JSON-ready status for the API / scheduler snapshot."""
+        with self._lock:
+            n_events = self._event_seq
+        return {
+            "id": self.id,
+            "state": self.state,
+            "model": self.spec.model,
+            "population_size": int(self.spec.population_size),
+            "generations": int(self.spec.generations),
+            "generations_done": int(self.generations_done),
+            "seed": int(self.spec.seed),
+            "attempt": int(self.attempt),
+            "requeues": int(self.requeues),
+            "submitted_at": round(self.submitted_at, 6),
+            "started_at": self.started_at,
+            "finished_at": self.finished_at,
+            "run_s": round(self.run_s, 6),
+            "db": self.db_path,
+            "checkpoint": self.checkpoint_path,
+            "kernel_cache_hit": self.kernel_cache_hit,
+            "error": self.error,
+            "health_trail": list(self.health_trail),
+            "result": self.result,
+            "n_events": n_events,
+        }
+
+    def namespace_snapshot(self) -> dict:
+        """This tenant's slice of ``observability_snapshot()`` —
+        private tracer + metrics, never interleaved with another run's."""
+        return {
+            "state": self.state,
+            "tracer": self.tracer.snapshot(),
+            "metrics": self.metrics.snapshot(),
+        }
+
+    def compile_span_count(self) -> int:
+        """Dispatch spans of this tenant that PAID a kernel trace/compile
+        (`compile=True`); 0 for a shape-keyed kernel-cache hit — the
+        serving tests assert exactly that."""
+        return sum(
+            1 for sp in self.tracer.spans()
+            if sp.name == "dispatch" and sp.attrs.get("compile")
+        )
+
+    def __repr__(self):
+        return f"Tenant({self.id!r}, state={self.state!r})"

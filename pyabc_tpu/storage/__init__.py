@@ -1,7 +1,14 @@
 from .bytes_storage import df_from_bytes, df_to_bytes, np_from_bytes, np_to_bytes
-from .history import PRE_TIME, History, create_sqlite_db_id
+from .history import (
+    PRE_TIME,
+    History,
+    PooledWriter,
+    WriterPool,
+    create_sqlite_db_id,
+)
 
 __all__ = [
     "History", "PRE_TIME", "create_sqlite_db_id",
+    "WriterPool", "PooledWriter",
     "np_to_bytes", "np_from_bytes", "df_to_bytes", "df_from_bytes",
 ]

@@ -113,6 +113,40 @@ def _locked(fn):
     return wrapper
 
 
+def _execute_with_retry(fn, args, kwargs, *, tracer, metrics, clock,
+                        retry, transient_types, backlog: int) -> None:
+    """One queued db write under the bounded transient-retry policy —
+    shared by the per-History :class:`_AsyncWriter` thread and the
+    multi-tenant :class:`WriterPool` workers (round 14), so the retry /
+    span / counter semantics cannot drift between the two."""
+    import time as _time
+
+    from ..observability.metrics import PERSIST_RETRIES_TOTAL
+    from ..resilience.faults import maybe_fault
+
+    for attempt in range(retry.attempts):
+        try:
+            maybe_fault("history.persist", attempt=attempt)
+            with tracer.span("db.write", backlog=backlog, attempt=attempt):
+                fn(*args, **kwargs)
+            return
+        except transient_types:
+            if attempt >= retry.attempts - 1:
+                raise
+            delay = retry.delay_s(attempt)
+            metrics.counter(
+                PERSIST_RETRIES_TOTAL,
+                "transient History persist failures retried before "
+                "sticky latching",
+            ).inc()
+            t0 = clock.now()
+            _time.sleep(delay)
+            tracer.record_span(
+                "recovery.persist_retry", t0, clock.now(),
+                thread="recovery", attempt=attempt,
+            )
+
+
 class _AsyncWriter:
     """Single background thread draining queued db writes in order.
 
@@ -160,34 +194,12 @@ class _AsyncWriter:
         self._thread.start()
 
     def _write_with_retry(self, fn, args, kwargs):
-        import time as _time
-
-        from ..observability.metrics import PERSIST_RETRIES_TOTAL
-        from ..resilience.faults import maybe_fault
-
-        for attempt in range(self._retry.attempts):
-            try:
-                maybe_fault("history.persist", attempt=attempt)
-                with self._tracer.span("db.write",
-                                       backlog=self._queue.qsize(),
-                                       attempt=attempt):
-                    fn(*args, **kwargs)
-                return
-            except self._transient_types:
-                if attempt >= self._retry.attempts - 1:
-                    raise
-                delay = self._retry.delay_s(attempt)
-                self._metrics.counter(
-                    PERSIST_RETRIES_TOTAL,
-                    "transient History persist failures retried before "
-                    "sticky latching",
-                ).inc()
-                t0 = self._clock.now()
-                _time.sleep(delay)
-                self._tracer.record_span(
-                    "recovery.persist_retry", t0, self._clock.now(),
-                    thread="recovery", attempt=attempt,
-                )
+        _execute_with_retry(
+            fn, args, kwargs, tracer=self._tracer, metrics=self._metrics,
+            clock=self._clock, retry=self._retry,
+            transient_types=self._transient_types,
+            backlog=self._queue.qsize(),
+        )
 
     def _run(self):
         while True:
@@ -233,6 +245,174 @@ class _AsyncWriter:
         self._check()
 
 
+class PooledWriter:
+    """One History's write stream on a shared :class:`WriterPool`.
+
+    Same contract as :class:`_AsyncWriter` (FIFO order per History,
+    bounded transient retry, sticky error re-raised on submit/flush/
+    close) but the draining thread comes from the pool — a 32-tenant
+    serving process runs a handful of writer threads instead of 32.
+
+    Fault ISOLATION is per handle: a persist failure latches only THIS
+    handle sticky-dead (its queued work drains unexecuted, its owner's
+    submit/flush re-raise); every other tenant's handle keeps writing.
+    Ordering: the ``_scheduled`` flag guarantees at most one pool worker
+    drains a handle at a time, so one History's appends never interleave
+    or reorder; fairness comes from draining ONE item per scheduling
+    turn and re-enqueueing the handle behind other tenants' work.
+    """
+
+    def __init__(self, pool: "WriterPool", tracer=None, metrics=None,
+                 transient_types: tuple = (), retry=None, clock=None,
+                 scope_tag: str = ""):
+        import collections
+        import threading
+
+        from ..resilience.retry import DEFAULT_PERSIST_RETRY_POLICY
+
+        self._pool = pool
+        #: fault-domain tag: pool workers execute this handle's writes
+        #: inside ``fault_scope(scope_tag)``, so a history.persist fault
+        #: rule matched to one tenant fires only on THAT tenant's
+        #: stream even though the threads are shared
+        self._scope_tag = str(scope_tag)
+        self._tracer = tracer if tracer is not None else NULL_TRACER
+        self._metrics = metrics if metrics is not None else NULL_METRICS
+        self._transient_types = tuple(transient_types)
+        self._retry = (retry if retry is not None
+                       else DEFAULT_PERSIST_RETRY_POLICY)
+        self._clock = clock if clock is not None else self._tracer.clock
+        self._lock = threading.Lock()
+        self._idle = threading.Condition(self._lock)
+        self._items: "collections.deque" = collections.deque()  # abc-lint: guarded-by=_lock
+        self._scheduled = False  # abc-lint: guarded-by=_lock
+        self._error: BaseException | None = None
+        self._backlog_gauge = self._metrics.gauge(
+            "pyabc_tpu_db_writer_backlog",
+            "queued population appends awaiting a writer thread",
+        )
+
+    def _check(self):
+        if self._error is not None:
+            raise self._error
+
+    def submit(self, fn, *args, **kwargs):
+        self._check()
+        with self._lock:
+            self._items.append((fn, args, kwargs))
+            self._backlog_gauge.set(len(self._items))
+            if not self._scheduled:
+                self._scheduled = True
+                self._pool._enqueue(self)
+
+    def flush(self):
+        """Block until everything queued so far is written."""
+        # _idle shares _lock, so holding the lock is what wait() needs
+        with self._lock:
+            while self._items or self._scheduled:
+                self._idle.wait(timeout=0.5)
+        self._check()
+
+    def close(self):
+        # unlike _AsyncWriter there is no private thread to retire; a
+        # drained handle simply stops being scheduled
+        self.flush()
+
+    # ------------------------------------------------- pool-worker side
+    def _drain_one(self) -> None:
+        """Execute the oldest queued write; called by a pool worker
+        holding this handle's scheduling turn. Reschedules the handle if
+        more work remains, else signals idle."""
+        with self._lock:
+            if not self._items:
+                self._scheduled = False
+                self._idle.notify_all()
+                return
+            fn, args, kwargs = self._items.popleft()
+            backlog = len(self._items)
+        from ..resilience.faults import fault_scope
+
+        try:
+            # after a failure, drain without executing (sticky-dead):
+            # later appends must not commit on top of broken db state
+            if self._error is None:
+                with fault_scope(self._scope_tag):
+                    _execute_with_retry(
+                        fn, args, kwargs, tracer=self._tracer,
+                        metrics=self._metrics, clock=self._clock,
+                        retry=self._retry,
+                        transient_types=self._transient_types,
+                        backlog=backlog,
+                    )
+        except BaseException as exc:  # noqa: BLE001 - surfaced later
+            self._error = exc
+        finally:
+            with self._lock:
+                self._backlog_gauge.set(len(self._items))
+                if self._items:
+                    self._pool._enqueue(self)
+                else:
+                    self._scheduled = False
+                    self._idle.notify_all()
+
+
+class WriterPool:
+    """Shared async-History-writer threads for a multi-tenant process.
+
+    The serving layer gives every tenant its own History database, but
+    one dedicated writer thread per tenant (the per-run
+    :class:`_AsyncWriter`) multiplies idle threads by the tenant count.
+    The pool runs ``n_threads`` workers draining all tenants' queued
+    appends round-robin (one item per handle per turn), with each
+    tenant's ordering, transient-retry and sticky-error semantics kept
+    in its own :class:`PooledWriter` handle — one tenant's dead db
+    never stalls or poisons another's stream. ``History.writer_pool``
+    opts a History in; ``start_async_writer`` then hands out a pooled
+    handle instead of spawning a thread.
+    """
+
+    def __init__(self, n_threads: int = 2, name: str = "abc-writer"):
+        import queue
+        import threading
+
+        self._ready: "queue.Queue" = queue.Queue()
+        self._closed = False
+        self._threads = [
+            threading.Thread(target=self._work, daemon=True,
+                             name=f"{name}-{i}")
+            for i in range(max(int(n_threads), 1))
+        ]
+        for th in self._threads:
+            th.start()
+
+    def handle(self, tracer=None, metrics=None, transient_types: tuple = (),
+               retry=None, clock=None, scope_tag: str = "") -> PooledWriter:
+        """A new per-History write stream on this pool."""
+        if self._closed:
+            raise RuntimeError("WriterPool is closed")
+        return PooledWriter(self, tracer=tracer, metrics=metrics,
+                            transient_types=transient_types, retry=retry,
+                            clock=clock, scope_tag=scope_tag)
+
+    def _enqueue(self, handle: PooledWriter) -> None:
+        self._ready.put(handle)
+
+    def _work(self) -> None:
+        while True:
+            handle = self._ready.get()
+            if handle is None:
+                return
+            handle._drain_one()
+
+    def close(self) -> None:
+        """Stop the workers (handles should be flushed first)."""
+        self._closed = True
+        for _ in self._threads:
+            self._ready.put(None)
+        for th in self._threads:
+            th.join(timeout=10)
+
+
 class History:
     """Experiment record over one sqlite database; multiple runs per db.
 
@@ -268,7 +448,15 @@ class History:
         self.tracer = tracer if tracer is not None else NULL_TRACER
         self.metrics = metrics if metrics is not None else NULL_METRICS
         self._lock = threading.RLock()
-        self._writer: _AsyncWriter | None = None
+        self._writer: _AsyncWriter | PooledWriter | None = None
+        #: opt-in shared writer threads (round 14, multi-tenant serving):
+        #: set to a :class:`WriterPool` BEFORE the first
+        #: ``start_async_writer`` call and queued appends drain on the
+        #: pool's workers (per-History ordering and sticky-error
+        #: isolation preserved) instead of a dedicated thread per run;
+        #: ``writer_scope`` tags the stream's fault domain (tenant id)
+        self.writer_pool: WriterPool | None = None
+        self.writer_scope: str = ""
         with self.tracer.span("db.setup", db=db):
             self._conn, self._dialect = open_database(db, _db_path)
             self._conn.executescript(_SCHEMA)
@@ -282,7 +470,7 @@ class History:
             self.id = _id if _id is not None else self._latest_id()
 
     # ------------------------------------------------------- async writing
-    def start_async_writer(self) -> "_AsyncWriter":
+    def start_async_writer(self) -> "_AsyncWriter | PooledWriter":
         if self._writer is None:
             from ..resilience.faults import InjectedTransientError
 
@@ -290,11 +478,19 @@ class History:
             # "database is locked"/"busy", a dropped pg connection that
             # reconnects) + the fault plan's injected transient; schema /
             # integrity / programming errors stay immediately sticky
-            self._writer = _AsyncWriter(
-                self.tracer, self.metrics,
-                transient_types=(self._dialect.OperationalError,
-                                 InjectedTransientError),
-            )
+            transient = (self._dialect.OperationalError,
+                         InjectedTransientError)
+            if self.writer_pool is not None:
+                self._writer = self.writer_pool.handle(
+                    tracer=self.tracer, metrics=self.metrics,
+                    transient_types=transient,
+                    scope_tag=self.writer_scope,
+                )
+            else:
+                self._writer = _AsyncWriter(
+                    self.tracer, self.metrics,
+                    transient_types=transient,
+                )
         return self._writer
 
     def append_population_async(self, *args, **kwargs) -> None:

@@ -1,14 +1,27 @@
-"""Persistent XLA compilation-cache setup, shared by every entry point.
+"""Compiled-program caching: the persistent XLA disk cache, and the
+in-process shape-keyed kernel cache the serving layer shares.
 
-The fused multi-generation programs cost ~15-25 s of XLA compile each;
-the cache deserializes them in ~1 s. One helper so the policy (default
-directory, min-compile-time threshold, env-var export for subprocess
-inheritance) cannot drift between `bench.py`, `tests/conftest.py` and
-`__graft_entry__.py`.
+Two layers with different hit costs:
+
+- :func:`setup_xla_cache` — JAX's persistent compilation cache on disk.
+  The fused multi-generation programs cost ~15-25 s of XLA compile
+  each; the disk cache deserializes them in ~1 s. One helper so the
+  policy (default directory, min-compile-time threshold, env-var export
+  for subprocess inheritance) cannot drift between `bench.py`,
+  `tests/conftest.py` and `__graft_entry__.py`.
+- :class:`KernelCache` — round 14 (multi-tenant serving): live
+  ``DeviceContext`` objects keyed by PROGRAM SHAPE (models, population,
+  fused G, distance/acceptor/transition config, observed-data digest).
+  A tenant whose shape was already served adopts the cached context's
+  jitted kernels (`ABCSMC.adopt_device_context` semantics) and pays
+  ZERO compile — not even the ~1 s disk-cache deserialize — which is
+  what makes admission of the millionth identical workload cheap.
 """
 from __future__ import annotations
 
 import os
+import threading
+from collections import OrderedDict
 
 
 def setup_xla_cache(default_dir: str, *, export_env: bool = False) -> str | None:
@@ -38,3 +51,125 @@ def setup_xla_cache(default_dir: str, *, export_env: bool = False) -> str | None
             f"fused programs will recompile every run", stacklevel=2,
         )
         return None
+
+
+def program_shape_key(abc) -> tuple:
+    """The program-shape identity of a prepared ABCSMC run.
+
+    Two runs with equal keys trace to the SAME jitted device programs
+    (and close over the same observed data), so adopting one's
+    ``DeviceContext`` into the other skips trace+compile entirely.
+    Everything the compiled kernels specialize on is in the key: model
+    identities and count, population schedule, fused chunk length,
+    fetch dtype, distance/acceptor/transition types and the flattened
+    observed-data bytes (kernels close over ``x_0``; adoption refuses
+    mismatched observations, so the digest gates lookup too). The run
+    seed is deliberately ABSENT — RNG keys are array arguments, one
+    compiled program serves every seed.
+
+    Requires ``abc.new(...)``/``abc.load(...)`` to have run (the spec
+    exists); raises otherwise so a half-built run cannot poison the
+    cache with an underspecified key.
+    """
+    import hashlib
+    import json
+
+    import numpy as np
+
+    if abc.spec is None:
+        raise ValueError(
+            "program_shape_key needs a prepared run: call .new()/.load() "
+            "(the observed-data spec is part of the program shape)"
+        )
+    x0 = np.ascontiguousarray(
+        np.asarray(abc.spec.flatten_host(abc.x_0), np.float32))
+    return (
+        tuple(abc.model_names),
+        int(abc.K),
+        json.dumps(abc.population_strategy.get_config(), sort_keys=True,
+                   default=str),
+        int(abc.fused_generations),
+        str(abc.fetch_dtype),
+        type(abc.distance_function).__name__,
+        type(abc.acceptor).__name__,
+        tuple(type(tr).__name__ for tr in abc.transitions),
+        int(abc.spec.total_size),
+        hashlib.sha256(x0.tobytes()).hexdigest(),
+    )
+
+
+class KernelCache:
+    """Shape-keyed live ``DeviceContext`` cache (multi-tenant serving).
+
+    ``adopt_or_register(abc)`` is the whole API: on a HIT the cached
+    context's compiled kernels are adopted into ``abc`` (tenant k+1
+    with a seen shape pays zero compile); on a MISS the tenant's own
+    context is registered after it exists (:meth:`register_from`). LRU
+    over ``max_entries`` bounds device/host memory held by cached
+    programs. Thread-safe — admission and tenant orchestrator threads
+    race on it by design.
+    """
+
+    def __init__(self, max_entries: int = 8):
+        self.max_entries = max(int(max_entries), 1)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, object]" = OrderedDict()  # abc-lint: guarded-by=_lock
+        self.hits = 0
+        self.misses = 0
+
+    def adopt_or_register(self, abc) -> bool:
+        """Adopt cached kernels into ``abc`` if its shape was seen.
+
+        Returns True on a cache hit (kernels adopted — ``abc`` will not
+        compile), False on a miss (call :meth:`register_from` once the
+        run has built its context). A cached context that fails
+        adoption (defensive: the key should preclude it) is evicted and
+        counted a miss, never an error.
+        """
+        if not getattr(abc, "_device_capable", False):
+            return False  # host path: nothing compiled to share
+        key = program_shape_key(abc)
+        with self._lock:
+            ctx = self._entries.get(key)
+            if ctx is not None:
+                self._entries.move_to_end(key)
+        if ctx is not None:
+            try:
+                abc._adopt_device_context_inner(ctx)
+            except Exception:
+                with self._lock:
+                    self._entries.pop(key, None)
+                    self.misses += 1
+                return False
+            with self._lock:
+                self.hits += 1
+            return True
+        with self._lock:
+            self.misses += 1
+        return False
+
+    def register_from(self, abc) -> bool:
+        """Offer ``abc``'s built device context for future same-shape
+        runs; returns True if it was (newly) cached."""
+        ctx = abc._device_ctx
+        if ctx is None:
+            return False
+        key = program_shape_key(abc)
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                return False
+            self._entries[key] = ctx
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+            return True
+
+    def stats(self) -> dict:
+        with self._lock:
+            n = len(self._entries)
+            hits, misses = self.hits, self.misses
+        total = hits + misses
+        return {
+            "entries": n, "hits": hits, "misses": misses,
+            "hit_rate": round(hits / total, 4) if total else None,
+        }

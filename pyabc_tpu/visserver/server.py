@@ -180,7 +180,13 @@ class AbcDashboard:
         (idle age, presumed_dead), clock offset + RTT uncertainty,
         throughput counters, last error and departure tombstones — so a
         stalled ``broker.wait()`` diagnoses from the dashboard instead
-        of a dark poll loop."""
+        of a dark poll loop.
+
+        Round 14: the ``tenants`` section aggregates each live
+        serving-layer tenant's PRIVATE tracer/metrics namespace —
+        embedded next to an ``abc-serve`` scheduler, concurrent runs
+        show up side by side instead of interleaved through the
+        process globals."""
         from ..observability import observability_snapshot
 
         return json.dumps(observability_snapshot(), default=str)

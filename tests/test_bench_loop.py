@@ -47,6 +47,10 @@ def bench(monkeypatch, tmp_path, capsys):
     # the mesh lane spawns a REAL forced-8-device subprocess; it has its
     # own unit tests (tests/test_sharded.py) and a live child smoke
     monkeypatch.setenv("PYABC_TPU_BENCH_MESH", "0")
+    # the serve lane runs REAL tenant fleets on a RunScheduler (its own
+    # tests: tests/test_serving.py); these loop tests drive a virtual
+    # clock the scheduler's deadlines must not live on
+    monkeypatch.setenv("PYABC_TPU_BENCH_SERVE", "0")
     monkeypatch.setattr(mod, "probe_platform", lambda *a, **k: "cpu")
     monkeypatch.setattr(mod, "run_host_baseline", lambda **k: 800.0)
     monkeypatch.setattr(

@@ -1,0 +1,608 @@
+"""Multi-tenant serving layer (round 14): chaos-tested containment.
+
+The contract under test, end to end on CPU:
+
+1. CHAOS ISOLATION — with a FaultPlan killing / NaN-poisoning ONE
+   tenant (scoped by ``fault_scope``), every surviving tenant completes
+   with a posterior BIT-IDENTICAL to its seed-matched solo run: a
+   neighbor's death is invisible except through OS scheduling.
+2. RUN LEASES — a tenant whose orchestrator thread dies hard mid-chunk
+   (injected kill: no report, no goodbye) is discovered, its device
+   slot reclaimed, and the tenant requeued to resume from its PR-5
+   checkpoint — the final trajectory bit-identical to an uninterrupted
+   run.
+3. ADMISSION — a full queue answers with typed backpressure
+   (AdmissionRejectedError + measured retry-after), never unbounded
+   queueing.
+4. DRAIN — SIGTERM semantics: every live tenant flushes its History
+   and writes a final checkpoint before the scheduler reports drained.
+5. NAMESPACING — two interleaved runs keep separate tracer/metrics
+   namespaces in ``observability_snapshot()`` (the pre-round-14
+   one-run-per-process collision), snapshots race-free while both run.
+6. ZERO COMPILE — a repeat-shape tenant adopts the shape-keyed kernel
+   cache and records NO compile-marked dispatch span.
+"""
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import pyabc_tpu as pt
+from pyabc_tpu.observability import observability_snapshot
+from pyabc_tpu.resilience import (
+    FaultPlan,
+    FaultRule,
+    install_fault_plan,
+    uninstall_fault_plan,
+)
+from pyabc_tpu.resilience.faults import (
+    InjectedPersistError,
+    current_fault_scope,
+    fault_scope,
+)
+from pyabc_tpu.serving import (
+    CANCELLED,
+    COMPLETED,
+    DRAINED,
+    FAILED,
+    AdmissionRejectedError,
+    RunScheduler,
+    TenantSpec,
+    serve_api,
+)
+from pyabc_tpu.storage import History, WriterPool
+
+# the cheap fused gaussian config every serving test rides (one compiled
+# shape for the whole module thanks to the shared XLA disk cache +
+# in-process kernel cache)
+POP = 100
+GENS = 6
+G = 2
+
+
+def spec_for(seed: int, gens: int = GENS, pop: int = POP,
+             **kw) -> TenantSpec:
+    return TenantSpec(model="gaussian", population_size=pop,
+                      generations=gens, seed=seed, fused_generations=G,
+                      **kw)
+
+
+def solo_reference(seed: int, db: str, gens: int = GENS,
+                   pop: int = POP) -> History:
+    """A seed-matched SOLO run of the tenant gaussian config — the
+    parity baseline chaos survivors are compared against (same model
+    builder, no scheduler in the loop)."""
+    from pyabc_tpu.serving.tenant import _build_gaussian
+
+    built = _build_gaussian(spec_for(seed))
+    observed = built.pop("observed")
+    abc = pt.ABCSMC(population_size=pop, seed=seed, fused_generations=G,
+                    **built)
+    abc.new(db, observed, store_sum_stats=True)
+    return abc.run(max_nr_populations=gens)
+
+
+def wait_terminal(tenants, timeout_s: float = 300.0) -> None:
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        if all(t.state in (COMPLETED, FAILED, CANCELLED, DRAINED)
+               for t in tenants):
+            return
+        time.sleep(0.1)
+    raise AssertionError(
+        f"tenants not terminal after {timeout_s}s: "
+        f"{[(t.id, t.state) for t in tenants]}"
+    )
+
+
+def assert_history_parity(db_a: str, db_b: str, gens: int) -> None:
+    """Bit-identical trajectories: epsilon trail + per-generation
+    sorted thetas and weights."""
+    ha, hb = History(db_a), History(db_b)
+    assert ha.n_populations == hb.n_populations == gens
+    eps_a = ha.get_all_populations().query("t >= 0")["epsilon"].to_numpy()
+    eps_b = hb.get_all_populations().query("t >= 0")["epsilon"].to_numpy()
+    assert np.array_equal(eps_a, eps_b), (eps_a, eps_b)
+    for t in range(gens):
+        df_a, w_a = ha.get_distribution(0, t)
+        df_b, w_b = hb.get_distribution(0, t)
+        assert np.array_equal(np.sort(df_a["theta"].to_numpy()),
+                              np.sort(df_b["theta"].to_numpy())), t
+        assert np.array_equal(np.sort(w_a), np.sort(w_b)), t
+    ha.close()
+    hb.close()
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_fault_plan():
+    uninstall_fault_plan()
+    yield
+    uninstall_fault_plan()
+
+
+@pytest.fixture
+def make_scheduler(tmp_path):
+    """Scheduler factory with guaranteed shutdown (no leaked pumps)."""
+    scheds = []
+
+    def make(**kw):
+        kw.setdefault("base_dir", str(tmp_path / f"serve{len(scheds)}"))
+        kw.setdefault("lease_timeout_s", 60.0)
+        s = RunScheduler(**kw)
+        scheds.append(s)
+        return s
+
+    yield make
+    for s in scheds:
+        s.shutdown()
+
+
+# ===================================================== chaos isolation
+def test_chaos_isolation_killed_tenant_never_harms_survivors(
+        make_scheduler, tmp_path):
+    """THE acceptance criterion: tenant A killed hard at EVERY chunk
+    (every generation it ever processes), tenants B and C complete with
+    posteriors bit-identical to their seed-matched solo runs. A's
+    containment: it fails loudly after the requeue budget, with its
+    lease history on record — and nothing else in the process notices.
+    """
+    sched = make_scheduler(n_slots=2, lease_timeout_s=60.0,
+                           max_requeues=2)
+    install_fault_plan(FaultPlan([
+        # kill on every single chunk-processing attempt of the chaos
+        # tenant, forever — it can never make progress
+        FaultRule(site="orchestrator.chunk", kind="kill", every=1,
+                  max_fires=None, match="chaos"),
+    ]))
+    chaos = sched.submit(spec_for(seed=101), tenant_id="tenant-chaos")
+    surv1 = sched.submit(spec_for(seed=7), tenant_id="tenant-s1")
+    surv2 = sched.submit(spec_for(seed=8), tenant_id="tenant-s2")
+    wait_terminal([chaos, surv1, surv2])
+    uninstall_fault_plan()
+
+    # survivors: completed, full schedule
+    assert surv1.state == COMPLETED, (surv1.state, surv1.error)
+    assert surv2.state == COMPLETED, (surv2.state, surv2.error)
+    assert surv1.result["n_populations"] == GENS
+    assert surv2.result["n_populations"] == GENS
+
+    # chaos tenant: contained, typed, with its lease trail
+    assert chaos.state == FAILED
+    assert chaos.requeues == sched.max_requeues
+    kinds = [e["kind"] for e in chaos.events_since(0)]
+    assert "lease_reaped" in kinds and "requeued" in kinds
+
+    # posterior parity vs seed-matched solo runs — bit-identical
+    ref1 = f"sqlite:///{tmp_path}/ref1.db"
+    ref2 = f"sqlite:///{tmp_path}/ref2.db"
+    solo_reference(7, ref1)
+    solo_reference(8, ref2)
+    assert_history_parity(surv1.db_path, ref1, GENS)
+    assert_history_parity(surv2.db_path, ref2, GENS)
+
+
+def test_chaos_nan_poison_recovers_in_domain(make_scheduler, tmp_path):
+    """A NaN-poisoned tenant (PR-6 silent numerical corruption at
+    device.carry) RECOVERS inside its own fault domain — rollback +
+    redispatch — and still completes; its neighbor's posterior stays
+    bit-identical to the solo baseline. One tenant's numerics never
+    bleed into another's."""
+    sched = make_scheduler(n_slots=2)
+    install_fault_plan(FaultPlan([
+        FaultRule(site="device.carry", kind="nan_poison", after=1,
+                  max_fires=1, match="poison"),
+    ]))
+    poisoned = sched.submit(spec_for(seed=21), tenant_id="tenant-poison")
+    clean = sched.submit(spec_for(seed=22), tenant_id="tenant-clean")
+    wait_terminal([poisoned, clean])
+    uninstall_fault_plan()
+
+    assert poisoned.state == COMPLETED, (poisoned.state, poisoned.error)
+    assert clean.state == COMPLETED, (clean.state, clean.error)
+    # the poison actually landed in the poisoned tenant's namespace:
+    # its private metrics carry the health rollback, the clean
+    # tenant's carry none
+    p_m = poisoned.metrics.snapshot()
+    c_m = clean.metrics.snapshot()
+    assert p_m.get("pyabc_tpu_health_events_total", 0) >= 1
+    assert c_m.get("pyabc_tpu_health_events_total", 0) == 0
+    # neighbor parity vs solo
+    ref = f"sqlite:///{tmp_path}/ref_clean.db"
+    solo_reference(22, ref)
+    assert_history_parity(clean.db_path, ref, GENS)
+    # PR-6 contract carried into serving: the poisoned run's RECOVERED
+    # posterior is itself bit-identical to its solo baseline
+    ref_p = f"sqlite:///{tmp_path}/ref_poison.db"
+    solo_reference(21, ref_p)
+    assert_history_parity(poisoned.db_path, ref_p, GENS)
+
+
+# ============================================ lease-expiry requeue (run)
+def test_killed_orchestrator_requeues_and_resumes_bit_identical(
+        make_scheduler, tmp_path):
+    """Satellite: a tenant killed ONCE mid-chunk dies hard (no report);
+    the scheduler discovers the dead thread, reclaims the slot,
+    requeues the tenant, and the resumed attempt adopts the PR-5
+    checkpoint — the final History bit-identical to an uninterrupted
+    seed-matched run."""
+    sched = make_scheduler(n_slots=1, max_requeues=1)
+    install_fault_plan(FaultPlan([
+        # fire on the SECOND chunk-processing of the victim (after one
+        # full chunk persisted + checkpointed), once
+        FaultRule(site="orchestrator.chunk", kind="kill", after=1,
+                  max_fires=1, match="victim"),
+    ]))
+    victim = sched.submit(spec_for(seed=31, gens=8),
+                          tenant_id="tenant-victim")
+    wait_terminal([victim])
+    uninstall_fault_plan()
+
+    assert victim.state == COMPLETED, (victim.state, victim.error)
+    assert victim.requeues == 1 and victim.attempt == 2
+    kinds = [e["kind"] for e in victim.events_since(0)]
+    assert "lease_reaped" in kinds and "requeued" in kinds
+
+    ref = f"sqlite:///{tmp_path}/ref_victim.db"
+    solo_reference(31, ref, gens=8)
+    assert_history_parity(victim.db_path, ref, 8)
+    # each generation persisted exactly once (resume pruned, no doubles)
+    h = History(victim.db_path)
+    pops = h.get_all_populations().query("t >= 0")["t"].to_list()
+    assert sorted(pops) == sorted(set(pops)) == list(range(8))
+    h.close()
+
+
+def test_requeue_budget_exhaustion_fails_with_trail(make_scheduler):
+    """A tenant that dies on every attempt fails TERMINALLY (typed,
+    with its event trail) instead of cycling forever."""
+    sched = make_scheduler(n_slots=1, max_requeues=1)
+    install_fault_plan(FaultPlan([
+        FaultRule(site="orchestrator.chunk", kind="kill", every=1,
+                  max_fires=None, match="doomed"),
+    ]))
+    doomed = sched.submit(spec_for(seed=41), tenant_id="tenant-doomed")
+    wait_terminal([doomed])
+    uninstall_fault_plan()
+    assert doomed.state == FAILED
+    assert "requeue budget exhausted" in (doomed.error or "")
+    assert doomed.requeues == 1
+
+
+# ======================================================= admission
+def test_admission_backpressure_is_typed_and_bounded(make_scheduler):
+    sched = make_scheduler(n_slots=1, max_queued=1)
+    t1 = sched.submit(spec_for(seed=51, gens=8))
+    # wait until t1 holds the slot so the queue occupancy is exact
+    t0 = time.monotonic()
+    while t1.state == "queued" and time.monotonic() - t0 < 60:
+        time.sleep(0.02)
+    t2 = sched.submit(spec_for(seed=52))
+    with pytest.raises(AdmissionRejectedError) as exc_info:
+        sched.submit(spec_for(seed=53))
+    err = exc_info.value
+    assert err.retry_after_s is not None and err.retry_after_s >= 1.0
+    assert sched.admission.rejected_total == 1
+    wait_terminal([t1, t2])
+    assert t1.state == COMPLETED and t2.state == COMPLETED
+
+
+def test_invalid_spec_rejected_without_retry_hint(make_scheduler):
+    sched = make_scheduler(n_slots=1)
+    with pytest.raises(AdmissionRejectedError) as exc_info:
+        sched.submit(TenantSpec(model="no-such-model"))
+    assert exc_info.value.retry_after_s is None
+    with pytest.raises(AdmissionRejectedError):
+        # reserved override: the scheduler owns the tracer binding
+        sched.submit(spec_for(seed=1, abcsmc_overrides={"tracer": None}))
+
+
+# ========================================================== drain
+def test_drain_flushes_and_final_checkpoints_every_tenant(
+        make_scheduler):
+    """SIGTERM semantics: drain() stops admission, every RUNNING tenant
+    takes the PR-6 GracefulShutdown path (History flushed + final
+    checkpoint written) and lands DRAINED."""
+    sched = make_scheduler(n_slots=2)
+    a = sched.submit(spec_for(seed=61, gens=40), tenant_id="tenant-da")
+    b = sched.submit(spec_for(seed=62, gens=40), tenant_id="tenant-db")
+    # wait for real progress so there is a carry to checkpoint
+    t0 = time.monotonic()
+    while ((a.generations_done < 2 or b.generations_done < 2)
+           and time.monotonic() - t0 < 120):
+        time.sleep(0.05)
+    assert a.generations_done >= 2 and b.generations_done >= 2
+    summary = sched.drain(timeout_s=60.0)
+    assert summary["forced"] == []
+    # both live tenants drained (a fast run may legitimately have
+    # completed in the race window)
+    for t in (a, b):
+        assert t.state in (DRAINED, COMPLETED), (t.id, t.state, t.error)
+    drained = [t for t in (a, b) if t.state == DRAINED]
+    assert drained, "drain raced both tenants to completion"
+    for t in drained:
+        # final checkpoint on disk, History flushed and readable
+        assert os.path.exists(t.checkpoint_path), t.id
+        h = History(t.db_path)
+        assert h.n_populations >= 2
+        h.close()
+    # admission is closed while draining
+    with pytest.raises(AdmissionRejectedError):
+        sched.submit(spec_for(seed=63))
+
+
+def test_cancel_queued_and_running(make_scheduler):
+    sched = make_scheduler(n_slots=1)
+    run = sched.submit(spec_for(seed=71, gens=40), tenant_id="tenant-r")
+    queued = sched.submit(spec_for(seed=72), tenant_id="tenant-q")
+    assert sched.cancel("tenant-q") is True
+    assert queued.state == CANCELLED
+    t0 = time.monotonic()
+    while run.generations_done < 2 and time.monotonic() - t0 < 120:
+        time.sleep(0.05)
+    assert sched.cancel("tenant-r") is True
+    wait_terminal([run])
+    assert run.state == CANCELLED
+    assert sched.cancel("tenant-r") is False  # terminal: no-op
+    assert sched.cancel("nope") is False
+
+
+# ============================================ observability namespacing
+def test_two_interleaved_runs_keep_separate_namespaces(make_scheduler):
+    """Satellite: the pre-round-14 global-state collision, regressed.
+    Two tenants run CONCURRENTLY; their spans/metrics land in their own
+    namespaces (observability_snapshot()['tenants']), racing snapshot
+    readers never error, and neither tenant's series leak into the
+    other's."""
+    sched = make_scheduler(n_slots=2)
+    errors: list = []
+    stop = threading.Event()
+
+    def hammer():
+        # concurrent snapshot readers while both runs mutate state
+        try:
+            while not stop.is_set():
+                snap = observability_snapshot()
+                json.dumps(snap, default=str)  # JSON-ready, always
+        except Exception as exc:  # pragma: no cover - the assertion
+            errors.append(exc)
+
+    readers = [threading.Thread(target=hammer) for _ in range(2)]
+    for r in readers:
+        r.start()
+    ta = sched.submit(spec_for(seed=81), tenant_id="tenant-a")
+    tb = sched.submit(spec_for(seed=82), tenant_id="tenant-b")
+    wait_terminal([ta, tb])
+    stop.set()
+    for r in readers:
+        r.join()
+    assert not errors, errors
+    assert ta.state == COMPLETED and tb.state == COMPLETED
+
+    snap = observability_snapshot()["tenants"]
+    assert "tenant-a" in snap and "tenant-b" in snap
+    for tid in ("tenant-a", "tenant-b"):
+        by_name = snap[tid]["tracer"]["spans_by_name"]
+        # a full run's span families, private to the namespace
+        assert "chunk" in by_name and "run" in by_name
+        assert by_name["run"]["count"] == 1  # ONE run here, never two
+    # namespace content matches the tenant's private tracer exactly
+    assert snap["tenant-a"]["tracer"] == ta.tracer.snapshot()
+    # private metrics: each namespace carries its own syncs_per_run
+    assert "pyabc_tpu_syncs_per_run" in snap["tenant-a"]["metrics"]
+    assert "pyabc_tpu_syncs_per_run" in snap["tenant-b"]["metrics"]
+
+
+def test_prometheus_text_tenant_labels():
+    """The exporter half of the collision fix: one scrape can carry two
+    runs' registries as label-disambiguated series."""
+    from pyabc_tpu.observability.export import prometheus_text
+    from pyabc_tpu.observability.metrics import MetricsRegistry
+
+    ra, rb = MetricsRegistry(), MetricsRegistry()
+    ra.gauge("pyabc_tpu_syncs_per_run", "syncs").set(3)
+    rb.gauge("pyabc_tpu_syncs_per_run", "syncs").set(5)
+    text = (prometheus_text(ra, labels={"tenant": "a"})
+            + prometheus_text(rb, labels={"tenant": "b"}))
+    assert 'pyabc_tpu_syncs_per_run{tenant="a"} 3' in text
+    assert 'pyabc_tpu_syncs_per_run{tenant="b"} 5' in text
+
+
+# ============================================= kernel cache / zero compile
+def test_repeat_shape_tenant_pays_zero_compile(make_scheduler):
+    """Acceptance criterion: tenant k+1 with a seen program shape
+    adopts the cached compiled context — kernel-cache hit, and NOT ONE
+    compile-marked dispatch span in its namespace."""
+    sched = make_scheduler(n_slots=1)  # sequential: t1 registers, t2 hits
+    t1 = sched.submit(spec_for(seed=91))
+    t2 = sched.submit(spec_for(seed=92))
+    wait_terminal([t1, t2])
+    assert t1.state == COMPLETED and t2.state == COMPLETED
+    assert t1.kernel_cache_hit is False
+    assert t2.kernel_cache_hit is True
+    assert t2.compile_span_count() == 0, (
+        "a repeat-shape tenant paid a kernel compile")
+    stats = sched.kernel_cache.stats()
+    assert stats["hits"] >= 1 and stats["entries"] >= 1
+
+
+# ====================================================== writer pool
+def test_writer_pool_preserves_order_and_flush():
+    pool = WriterPool(n_threads=2)
+    try:
+        out_a: list = []
+        out_b: list = []
+        ha = pool.handle()
+        hb = pool.handle()
+        for i in range(50):
+            ha.submit(out_a.append, ("a", i))
+            hb.submit(out_b.append, ("b", i))
+        ha.flush()
+        hb.flush()
+        assert out_a == [("a", i) for i in range(50)]
+        assert out_b == [("b", i) for i in range(50)]
+    finally:
+        pool.close()
+
+
+def test_writer_pool_sticky_error_isolated_per_handle():
+    """One tenant's dead db latches ONLY its own handle; the shared
+    pool keeps serving every other tenant's stream."""
+    pool = WriterPool(n_threads=1)  # ONE shared thread: worst case
+    try:
+        install_fault_plan(FaultPlan([
+            FaultRule(site="history.persist", kind="error", max_fires=1,
+                      match="tenant-bad"),
+        ]))
+        good_rows: list = []
+        bad = pool.handle(scope_tag="tenant-bad")
+        good = pool.handle(scope_tag="tenant-good")
+        bad.submit(good_rows.append, "b0")   # dies here (injected)
+        bad.submit(good_rows.append, "b1")   # drained unexecuted
+        for i in range(5):
+            good.submit(good_rows.append, f"g{i}")
+        good.flush()  # the good stream is unaffected
+        assert [r for r in good_rows if r.startswith("g")] == \
+            [f"g{i}" for i in range(5)]
+        with pytest.raises(InjectedPersistError):
+            bad.flush()
+        # sticky: later submits re-raise too
+        with pytest.raises(InjectedPersistError):
+            bad.submit(good_rows.append, "b2")
+        assert "b1" not in good_rows and "b2" not in good_rows
+    finally:
+        uninstall_fault_plan()
+        pool.close()
+
+
+def test_fault_scope_is_thread_local():
+    assert current_fault_scope() == ""
+    seen = {}
+    with fault_scope("outer"):
+        assert current_fault_scope() == "outer"
+        with fault_scope("inner"):
+            assert current_fault_scope() == "inner"
+        assert current_fault_scope() == "outer"
+
+        def child():
+            seen["tag"] = current_fault_scope()
+
+        th = threading.Thread(target=child)
+        th.start()
+        th.join()
+    # spawned threads do NOT inherit the scope: a tenant's domain is
+    # its orchestrator thread
+    assert seen["tag"] == ""
+    assert current_fault_scope() == ""
+
+
+# =========================================================== HTTP API
+def test_api_submit_status_stream_metrics(make_scheduler):
+    sched = make_scheduler(n_slots=1, max_queued=1)
+    httpd = serve_api(sched, port=0, block=False)
+    port = httpd.server_port
+    base = f"http://127.0.0.1:{port}"
+
+    def post(path, body):
+        req = urllib.request.Request(
+            base + path, data=json.dumps(body).encode(), method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status, json.loads(r.read()), dict(r.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read()), dict(e.headers)
+
+    try:
+        st, sub, _ = post("/api/submit", spec_for(seed=201).to_dict())
+        assert st == 200 and sub["id"]
+        tid = sub["id"]
+        # malformed spec -> 400
+        st, err, _ = post("/api/submit", {"model": "nope"})
+        assert st == 400 and "invalid spec" in err["error"]
+
+        tenant = sched.get(tid)
+        wait_terminal([tenant])
+        with urllib.request.urlopen(f"{base}/api/tenant/{tid}",
+                                    timeout=30) as r:
+            status = json.loads(r.read())
+        assert status["state"] == COMPLETED
+        assert status["generations_done"] == GENS
+
+        # stream: full NDJSON event tail, terminated by an end record
+        with urllib.request.urlopen(f"{base}/api/tenant/{tid}/stream",
+                                    timeout=30) as r:
+            lines = [json.loads(ln) for ln in r.read().splitlines() if ln]
+        kinds = [ev["kind"] for ev in lines]
+        assert kinds[0] == "admitted" and kinds[-1] == "end"
+        assert "chunk" in kinds and COMPLETED in kinds
+
+        # scheduler + tenants snapshot
+        with urllib.request.urlopen(f"{base}/api/tenants",
+                                    timeout=30) as r:
+            snap = json.loads(r.read())
+        assert snap["n_slots"] == 1
+        assert any(t["id"] == tid for t in snap["tenants"])
+
+        # observability endpoint aggregates the tenant namespace
+        with urllib.request.urlopen(f"{base}/api/observability",
+                                    timeout=30) as r:
+            obs = json.loads(r.read())
+        assert tid in obs["tenants"]
+
+        # /metrics: global families + tenant-labelled series
+        with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+            text = r.read().decode()
+        assert "pyabc_tpu_tenant_live" in text
+        assert f'tenant="{tid}"' in text
+
+        # unknown tenant -> 404
+        try:
+            urllib.request.urlopen(f"{base}/api/tenant/ghost", timeout=30)
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        httpd.shutdown()
+
+
+def test_api_backpressure_is_http_429_with_retry_after(make_scheduler):
+    sched = make_scheduler(n_slots=1, max_queued=1)
+    httpd = serve_api(sched, port=0, block=False)
+    base = f"http://127.0.0.1:{httpd.server_port}"
+    try:
+        t1 = sched.submit(spec_for(seed=211, gens=8))
+        t0 = time.monotonic()
+        while t1.state == "queued" and time.monotonic() - t0 < 60:
+            time.sleep(0.02)
+        sched.submit(spec_for(seed=212))  # fills the queue
+        req = urllib.request.Request(
+            base + "/api/submit",
+            data=json.dumps(spec_for(seed=213).to_dict()).encode(),
+            method="POST")
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(req, timeout=30)
+        e = exc_info.value
+        assert e.code == 429
+        assert float(e.headers["Retry-After"]) >= 1
+        body = json.loads(e.read())
+        assert body["retry_after_s"] >= 1.0
+        wait_terminal([t1])
+    finally:
+        httpd.shutdown()
+
+
+# ======================================================== fairness sanity
+def test_slots_rotate_through_queue_no_starvation(make_scheduler):
+    """More tenants than slots: every tenant eventually runs and
+    completes (FIFO slot handout, no head-of-line pathologies)."""
+    sched = make_scheduler(n_slots=2, max_queued=8)
+    tenants = [sched.submit(spec_for(seed=300 + i, gens=4))
+               for i in range(5)]
+    wait_terminal(tenants)
+    for t in tenants:
+        assert t.state == COMPLETED, (t.id, t.state, t.error)
+        assert t.result["n_populations"] == 4

@@ -551,6 +551,103 @@ def test_cli_json_format(tmp_path, capsys):
     assert payload["counts"]["open_by_rule"] == {"SYNC001": 1}
 
 
+# --------------------------------------------------------------- ISO001
+
+ISO_FIRES = """
+import pyabc_tpu as pt
+def sneak_run(spec):
+    abc = pt.ABCSMC(spec.models, spec.priors)
+    return abc
+def sneak_engine(owner, ctx):
+    from pyabc_tpu.inference.dispatch import DispatchEngine
+    return DispatchEngine(owner, ctx)
+def sneak_context(abc, donor):
+    abc.adopt_device_context(donor)
+    return abc._build_device_ctx()
+"""
+
+ISO_CLEAN = """
+def describe(spec):
+    # describing a run is fine; constructing one is the scheduler's job
+    return {"kwargs": {"population_size": spec.population_size}}
+"""
+
+ISO_SUPPRESSED = """
+import pyabc_tpu as pt
+def probe(models, priors):
+    # abc-lint: disable=ISO001 offline capability probe, never admitted
+    return pt.ABCSMC(models, priors)
+"""
+
+
+def test_iso001_fires_on_unleased_run_construction():
+    from pyabc_tpu.analysis.rules.isolation import Iso001
+
+    open_, _ = check(Iso001(), ISO_FIRES, "pyabc_tpu/serving/api.py")
+    assert len(open_) == 4, [f.to_dict() for f in open_]
+    msgs = " ".join(f.message for f in open_)
+    assert "ABCSMC" in msgs and "DispatchEngine" in msgs
+    assert "adopt_device_context" in msgs and "_build_device_ctx" in msgs
+
+
+def test_iso001_scope_is_serving_minus_scheduler():
+    from pyabc_tpu.analysis.rules.isolation import Iso001
+
+    r = Iso001()
+    # the leased path itself is exempt; everything else in serving/ is in
+    assert not r.applies_to("pyabc_tpu/serving/scheduler.py")
+    assert r.applies_to("pyabc_tpu/serving/api.py")
+    assert r.applies_to("pyabc_tpu/serving/tenant.py")
+    assert r.applies_to("pyabc_tpu/serving/admission.py")
+    # the rest of the tree constructs runs legitimately
+    assert not r.applies_to("pyabc_tpu/inference/smc.py")
+    assert not r.applies_to("bench.py")
+    assert not r.applies_to("tests/test_serving.py")
+    open_, _ = check(r, ISO_CLEAN, "pyabc_tpu/serving/tenant.py")
+    assert open_ == []
+
+
+def test_iso001_suppression_with_reason():
+    from pyabc_tpu.analysis.rules.isolation import Iso001
+
+    open_, sup = check(Iso001(), ISO_SUPPRESSED,
+                       "pyabc_tpu/serving/api.py")
+    assert open_ == [] and len(sup) == 1 and sup[0].reason
+
+
+def test_iso001_mutation_unleased_run_in_api_fails():
+    """THE mutation guard: an ABCSMC construction growing into the
+    serving API (a run bypassing admission, leases and fault scoping)
+    must make ISO001 fire — today's api.py is clean, a re-added
+    construction is a finding."""
+    from pyabc_tpu.analysis.rules.isolation import Iso001
+
+    path = REPO / "pyabc_tpu" / "serving" / "api.py"
+    src = path.read_text()
+    rel = "pyabc_tpu/serving/api.py"
+    open_, _ = check(Iso001(), src, rel)
+    assert open_ == [], [f.to_dict() for f in open_]
+    mutated = src + (
+        "\n\ndef _quick_run(spec):\n"
+        "    from ..inference.smc import ABCSMC\n"
+        "    abc = ABCSMC(spec.models, spec.priors)\n"
+        "    return abc.run()\n"
+    )
+    open_m, _ = check(Iso001(), mutated, rel)
+    assert len(open_m) >= 1, (
+        "an ABCSMC construction re-added to serving/api.py left ISO001 "
+        "silent — the leased-path isolation contract is no longer "
+        "guarded")
+
+
+def test_registry_has_nine_rules_with_iso001():
+    from pyabc_tpu.analysis.rules import rule_ids
+
+    ids = rule_ids()
+    assert len(ids) == 9
+    assert "ISO001" in ids
+
+
 # ------------------------------------------------------- the tier-1 gate
 
 def test_repo_is_lint_clean():
