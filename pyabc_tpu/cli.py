@@ -356,12 +356,14 @@ def manager_cmd(host, port, watch):
 @click.option("--max-queued", type=int, default=16,
               help="admission queue depth; a full queue answers HTTP 429 "
               "with a measured Retry-After instead of queueing unboundedly")
-@click.option("--lease-timeout-s", type=float, default=30.0,
+@click.option("--lease-timeout-s", type=float, default=60.0,
               help="run-lease timeout: a tenant orchestrator silent for "
               "this long (hung) is presumed dead, its slot reclaimed and "
               "the tenant requeued from its checkpoint. Size it above the "
-              "worst healthy chunk+compile wall time; DEAD orchestrators "
-              "are detected immediately regardless")
+              "worst healthy chunk+compile wall time (a fused program's "
+              "XLA compile alone is 15-25 s and happens between "
+              "heartbeats); DEAD orchestrators are detected immediately "
+              "regardless")
 @click.option("--max-requeues", type=int, default=1,
               help="lease-expiry requeues per tenant before it fails "
               "terminally with its health trail")

@@ -167,12 +167,75 @@ class JaxModel(Model):
         out = self._jitted_sim(key, theta)
         return {k: np.asarray(v) for k, v in out.items()}
 
+    def content_hash(self) -> str:
+        """Identity of the TRACED computation, not the display name.
+
+        Digests the simulator's code object plus every value its
+        closure cells and defaults capture (recursing through nested
+        functions), so two models built under the same name but closing
+        over different constants — e.g. a builder-parameterized noise
+        scale — hash differently. The serving kernel cache keys
+        compiled programs on this: a name-only key would hand tenant B
+        tenant A's kernels and silently compute the wrong posterior.
+        """
+        import hashlib
+
+        h = hashlib.sha256()
+        h.update(self.name.encode())
+        h.update("|".join(self.space.names).encode())
+        _digest_callable(self.sim, h, set())
+        return h.hexdigest()
+
     @staticmethod
     def from_function(space, name="jax_model"):
         """Decorator form: ``@JaxModel.from_function(["a","b"])``."""
         def wrap(fn):
             return JaxModel(fn, space, name=name)
         return wrap
+
+
+def _digest_value(v, h, seen: set) -> None:
+    """Feed one captured value into ``h``: functions recurse, numerics
+    go in as dtype/shape/bytes, everything else as repr."""
+    if callable(v) and hasattr(v, "__code__"):
+        _digest_callable(v, h, seen)
+        return
+    try:
+        arr = np.asarray(v)
+    except Exception:
+        arr = None  # unconvertible capture: repr is its identity
+    if arr is not None and arr.dtype != object:
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+        return
+    h.update(repr(v).encode())
+
+
+def _digest_callable(fn, h, seen: set) -> None:
+    import types
+
+    if id(fn) in seen:
+        return
+    seen.add(id(fn))
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        h.update(repr(fn).encode())
+        return
+    h.update(code.co_code)
+    h.update("|".join(code.co_names).encode())
+    for const in code.co_consts:
+        if isinstance(const, types.CodeType):
+            h.update(const.co_code)
+        else:
+            h.update(repr(const).encode())
+    for cell in fn.__closure__ or ():
+        try:
+            _digest_value(cell.cell_contents, h, seen)
+        except ValueError:  # empty cell
+            h.update(b"<empty-cell>")
+    for default in fn.__defaults__ or ():
+        _digest_value(default, h, seen)
 
 
 def assert_models(models) -> list[Model]:

@@ -176,6 +176,20 @@ class LeaseTable:
             })
         return events
 
+    def discard_requeued(self) -> int:
+        """Drop every requeued range without redispatching it; returns
+        the number of slots discarded.
+
+        For BATCH leases the requeue deque feeds ``take_requeued`` — a
+        successor worker picks the orphaned slots up. RUN-level leases
+        (the serving scheduler: one lease = one tenant's whole slot)
+        reclaim and requeue the TENANT instead, so nothing ever pops
+        the deque; the owner must discard after reaping or the ranges
+        accumulate for the process lifetime."""
+        n = sum(b - a for a, b, _t in self._requeue)
+        self._requeue.clear()
+        return n
+
     # ---------------------------------------------------------------- views
     def stats(self) -> dict:
         return {

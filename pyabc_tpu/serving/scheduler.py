@@ -41,6 +41,7 @@ from ..observability import (
     SYSTEM_CLOCK,
     global_metrics,
     register_tenant_source,
+    unregister_tenant_source,
 )
 from ..observability.metrics import (
     TENANT_COMPLETED_TOTAL,
@@ -73,16 +74,32 @@ from .tenant import (
 class RunScheduler:
     """Admits, queues and supervises tenants over shared device slots."""
 
+    #: default run-lease timeout. Sized ABOVE the worst silent stretch
+    #: of a HEALTHY fresh-shape tenant: the fused program's 15-25 s XLA
+    #: compile happens inside ``abc.run()``, between the "db open"
+    #: heartbeat and the first chunk event — a timeout inside that
+    #: window falsely reaps every kernel-cache-missing tenant
+    #: mid-compile (and its requeued retry recompiles and is reaped
+    #: again). Dead threads are detected immediately regardless; this
+    #: only bounds HANG detection.
+    DEFAULT_LEASE_TIMEOUT_S = 60.0
+
     def __init__(self, n_slots: int = 1, *, max_queued: int = 16,
-                 lease_timeout_s: float = 15.0, max_requeues: int = 1,
+                 lease_timeout_s: float = DEFAULT_LEASE_TIMEOUT_S,
+                 max_requeues: int = 1,
                  base_dir: str | None = None, clock=None, metrics=None,
                  writer_threads: int = 2, kernel_cache_entries: int = 8,
-                 tick_s: float = 0.05):
+                 tick_s: float = 0.05, max_terminal_tenants: int = 256):
         self.clock = clock if clock is not None else SYSTEM_CLOCK
         self.metrics = metrics if metrics is not None else global_metrics()
         self.n_slots = max(int(n_slots), 1)
         self.max_requeues = int(max_requeues)
         self.tick_s = float(tick_s)
+        #: terminal tenants retained for status queries; beyond this the
+        #: oldest-finished are evicted (records, event rings, private
+        #: tracer/metrics namespaces) so a long-lived serving process
+        #: does not grow without bound
+        self.max_terminal_tenants = max(int(max_terminal_tenants), 1)
         if base_dir is None:
             import tempfile
 
@@ -107,6 +124,8 @@ class RunScheduler:
         self._free_slots: list[int] = list(range(self.n_slots))  # abc-lint: guarded-by=_lock
         self._slot_of: dict[str, int] = {}  # abc-lint: guarded-by=_lock
         self._reports: deque = deque()  # abc-lint: guarded-by=_lock
+        #: terminal tenant ids, oldest-finished first (eviction order)
+        self._terminal_order: deque = deque()  # abc-lint: guarded-by=_lock
         self._ids = itertools.count(1)
         self._draining = False
         self._shutdown = False
@@ -168,8 +187,12 @@ class RunScheduler:
                 self._finish_locked(tenant, CANCELLED,
                                     error="cancelled before start")
                 return True
-            if tenant.state == RUNNING and tenant.abc is not None:
-                tenant.abc.request_graceful_stop()
+            if tenant.state == RUNNING:
+                # tenant.abc may still be None (attempt thread mid-
+                # build): the flag set above is re-checked by the
+                # attempt right after it assigns tenant.abc
+                if tenant.abc is not None:
+                    tenant.abc.request_graceful_stop()
                 tenant.record_event("cancel_requested")
             return True
 
@@ -194,16 +217,18 @@ class RunScheduler:
                     tenant.abc.request_graceful_stop()
                 tenant.record_event("drain_requested")
             self._wake.notify_all()
-        deadline = self.clock.now() + float(timeout_s)
-        while self.clock.now() < deadline:
-            with self._lock:
-                live = [t for t in self._tenants.values()
-                        if t.state == RUNNING]
-            if not live:
-                break
-            import time as _time
-
-            _time.sleep(0.02)
+        # the wait deadline rides SYSTEM_CLOCK, not the injected clock:
+        # a manually-stepped test clock never advances on its own, and
+        # a hung RUNNING tenant would spin this loop forever instead of
+        # timing out and landing in `forced`. The injected clock keeps
+        # its job for lease/timestamp bookkeeping only.
+        deadline = SYSTEM_CLOCK.now() + float(timeout_s)
+        with self._lock:
+            while any(t.state == RUNNING for t in self._tenants.values()):
+                remaining = deadline - SYSTEM_CLOCK.now()
+                if remaining <= 0:
+                    break
+                self._wake.wait(timeout=min(remaining, self.tick_s))
         with self._lock:
             states = {t.id: t.state for t in self._tenants.values()}
             forced = [tid for tid, st in states.items() if st == RUNNING]
@@ -289,7 +314,12 @@ class RunScheduler:
             if t.state == RUNNING and t.thread is not None
             and not t.thread.is_alive()
         ]
-        for ev in self.leases.reap(self.clock.now(), dead_wids=dead):
+        events = self.leases.reap(self.clock.now(), dead_wids=dead)
+        # run-level leases requeue the TENANT, never the slot range:
+        # nothing ever pops the table's requeue deque, so discard the
+        # ranges the reap just pushed or they accumulate forever
+        self.leases.discard_requeued()
+        for ev in events:
             tenant = self._tenants.get(ev["wid"])
             if tenant is None or tenant.state != RUNNING:
                 continue
@@ -387,8 +417,29 @@ class RunScheduler:
         if state in counters:
             name, help_ = counters[state]
             self.metrics.counter(name, help_).inc()
+        self._evict_terminal_locked(tenant.id)
         self._set_occupancy_gauges_locked()
         self._wake.notify_all()
+
+    def _evict_terminal_locked(self, tid: str) -> None:
+        """Bound terminal-tenant retention: keep the newest
+        ``max_terminal_tenants`` finished records for status queries,
+        evict the oldest beyond that (tenant record, event ring,
+        observability namespace) — a long-lived serving process must
+        not grow with every tenant it has ever finished."""
+        self._terminal_order.append(tid)
+        while len(self._terminal_order) > self.max_terminal_tenants:
+            old_tid = self._terminal_order.popleft()
+            old = self._tenants.get(old_tid)
+            if old is None:
+                continue
+            if old.state not in TERMINAL_STATES:  # resurrection guard
+                continue
+            # a stale attempt thread may still be unwinding; it holds
+            # its own reference to the Tenant object and reports into a
+            # bumped epoch, so dropping the registry entry is safe
+            del self._tenants[old_tid]
+            unregister_tenant_source(old_tid)
 
     def _set_occupancy_gauges_locked(self) -> None:
         self.metrics.gauge(
@@ -421,6 +472,15 @@ class RunScheduler:
         with self._lock:
             if epoch != tenant.epoch:
                 return
+            # re-assert an acknowledged stop (idempotent): a cancel or
+            # drain that raced run() entry — which clears any pre-run
+            # stop request — would otherwise be lost and the run would
+            # land COMPLETED despite the ack
+            run = (tenant.abc
+                   if tenant.cancel_requested or self._draining
+                   else None)
+        if run is not None:
+            run.request_graceful_stop()
         done = int(ev.get("t_first", 0)) + int(ev.get("gens", 0))
         tenant.generations_done = max(tenant.generations_done, done)
         tenant.record_event(
@@ -476,7 +536,24 @@ class RunScheduler:
                 ).inc()
                 tenant.record_event("kernel_cache",
                                     hit=hit, attempt=tenant.attempt)
-                tenant.abc = abc
+                with self._lock:
+                    tenant.abc = abc
+                    # a cancel (or drain) acknowledged while tenant.abc
+                    # was still None set the flag with no run handle to
+                    # stop — honor it here or the run proceeds to
+                    # COMPLETED despite the ack. Skipping run() (rather
+                    # than request_graceful_stop) because run() clears
+                    # any pre-run stop request at entry.
+                    stop_now = (epoch == tenant.epoch
+                                and (tenant.cancel_requested
+                                     or self._draining))
+                if stop_now:
+                    self._report(
+                        tenant, epoch, DRAINED,
+                        error="stopped before run start",
+                        run_s=self.clock.now() - t_run0,
+                    )
+                    return
                 abc.chunk_event_cb = (
                     lambda ev, _t=tenant, _e=epoch:
                     self._on_chunk(_t, _e, ev)

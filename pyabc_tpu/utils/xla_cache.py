@@ -53,19 +53,58 @@ def setup_xla_cache(default_dir: str, *, export_env: bool = False) -> str | None
         return None
 
 
+def _model_identity(model) -> str:
+    """A model's contribution to the program-shape key: the content
+    hash of its traced closure when it has one (JaxModel), else repr —
+    the NAME alone is not identity, two builders can both say "gauss"
+    while closing over different constants."""
+    content_hash = getattr(model, "content_hash", None)
+    if callable(content_hash):
+        return content_hash()
+    return f"{type(model).__name__}:{model!r}"
+
+
+def _component_config(obj) -> str:
+    """Config digest of a distance/acceptor/eps/transition component:
+    its ``get_config()`` when implemented, else the construction-time
+    scalar attributes — type names alone miss e.g. ``PNormDistance(p=1)``
+    vs ``p=2``, which trace to different kernels."""
+    import json
+
+    cfg = None
+    get_config = getattr(obj, "get_config", None)
+    if callable(get_config):
+        try:
+            cfg = get_config()
+        except Exception:
+            cfg = None
+    if cfg is None:
+        cfg = {
+            k: v for k, v in sorted(vars(obj).items())
+            if not k.startswith("_")
+            and isinstance(v, (bool, int, float, str, type(None)))
+        }
+    return f"{type(obj).__name__}:" + json.dumps(
+        cfg, sort_keys=True, default=repr)
+
+
 def program_shape_key(abc) -> tuple:
     """The program-shape identity of a prepared ABCSMC run.
 
     Two runs with equal keys trace to the SAME jitted device programs
     (and close over the same observed data), so adopting one's
     ``DeviceContext`` into the other skips trace+compile entirely.
-    Everything the compiled kernels specialize on is in the key: model
-    identities and count, population schedule, fused chunk length,
-    fetch dtype, distance/acceptor/transition types and the flattened
-    observed-data bytes (kernels close over ``x_0``; adoption refuses
-    mismatched observations, so the digest gates lookup too). The run
-    seed is deliberately ABSENT — RNG keys are array arguments, one
-    compiled program serves every seed.
+    Everything the compiled kernels close over or specialize on is in
+    the key: model CONTENT hashes (the traced simulator's code +
+    closure constants, not just its display name), model count and
+    prior weights, per-model parameter priors, population schedule,
+    fused chunk length, fetch dtype, distance/acceptor/eps/transition
+    CONFIGS (not just type names — ``PNormDistance(p=1)`` and ``p=2``
+    are different programs) and the flattened observed-data bytes
+    (kernels close over ``x_0``; adoption refuses mismatched
+    observations, so the digest gates lookup too). The run seed is
+    deliberately ABSENT — RNG keys are array arguments, one compiled
+    program serves every seed.
 
     Requires ``abc.new(...)``/``abc.load(...)`` to have run (the spec
     exists); raises otherwise so a half-built run cannot poison the
@@ -83,16 +122,21 @@ def program_shape_key(abc) -> tuple:
         )
     x0 = np.ascontiguousarray(
         np.asarray(abc.spec.flatten_host(abc.x_0), np.float32))
+    prior_probs = np.ascontiguousarray(
+        np.asarray(abc.model_prior_probs, np.float64))
     return (
-        tuple(abc.model_names),
+        tuple(_model_identity(m) for m in abc.models),
         int(abc.K),
+        hashlib.sha256(prior_probs.tobytes()).hexdigest(),
+        tuple(repr(p) for p in abc.parameter_priors),
         json.dumps(abc.population_strategy.get_config(), sort_keys=True,
                    default=str),
         int(abc.fused_generations),
         str(abc.fetch_dtype),
-        type(abc.distance_function).__name__,
-        type(abc.acceptor).__name__,
-        tuple(type(tr).__name__ for tr in abc.transitions),
+        _component_config(abc.distance_function),
+        _component_config(abc.acceptor),
+        _component_config(abc.eps),
+        tuple(_component_config(tr) for tr in abc.transitions),
         int(abc.spec.total_size),
         hashlib.sha256(x0.tobytes()).hexdigest(),
     )
@@ -128,7 +172,11 @@ class KernelCache:
         """
         if not getattr(abc, "_device_capable", False):
             return False  # host path: nothing compiled to share
-        key = program_shape_key(abc)
+        # pin the PRE-RUN key on the instance: register_from() runs
+        # after the run, when adaptive components may have refit state —
+        # recomputing there could register under a key no future
+        # lookup (always pre-run) would ever produce
+        key = abc._program_shape_key = program_shape_key(abc)
         with self._lock:
             ctx = self._entries.get(key)
             if ctx is not None:
@@ -154,7 +202,9 @@ class KernelCache:
         ctx = abc._device_ctx
         if ctx is None:
             return False
-        key = program_shape_key(abc)
+        key = getattr(abc, "_program_shape_key", None)
+        if key is None:
+            key = program_shape_key(abc)
         with self._lock:
             if key in self._entries:
                 self._entries.move_to_end(key)

@@ -50,6 +50,7 @@ from pyabc_tpu.serving import (
     COMPLETED,
     DRAINED,
     FAILED,
+    RUNNING,
     AdmissionRejectedError,
     RunScheduler,
     TenantSpec,
@@ -428,6 +429,168 @@ def test_repeat_shape_tenant_pays_zero_compile(make_scheduler):
         "a repeat-shape tenant paid a kernel compile")
     stats = sched.kernel_cache.stats()
     assert stats["hits"] >= 1 and stats["entries"] >= 1
+
+
+def _prepared_gaussian(tmp_path, tag: str, *, noise_sd=0.5, x_obs=1.0,
+                       p=2.0, prior_sd=1.0):
+    """A prepared (new()'d, never run) gaussian ABCSMC — what the
+    kernel cache sees at adopt time — with every knob the builder can
+    bake into the traced closure exposed."""
+    from pyabc_tpu.serving.tenant import _build_gaussian
+
+    built = _build_gaussian(spec_for(
+        seed=0, params={"noise_sd": noise_sd, "x_obs": x_obs}))
+    built["distance_function"] = pt.PNormDistance(p=p)
+    built["parameter_priors"] = pt.Distribution(
+        theta=pt.RV("norm", 0.0, prior_sd))
+    observed = built.pop("observed")
+    abc = pt.ABCSMC(population_size=POP, seed=0, fused_generations=G,
+                    **built)
+    abc.new(f"sqlite:///{tmp_path}/key_{tag}.db", observed)
+    return abc
+
+
+def test_program_shape_key_sees_closure_and_config(tmp_path):
+    """The isolation-contract regression the name-only key violated:
+    two tenants with the SAME x_obs but different noise_sd (or prior
+    scale, or distance p) trace to DIFFERENT compiled programs — equal
+    keys would silently hand tenant B tenant A's kernels and bit-wrong
+    posteriors. Identical configs still collapse to one key (the
+    zero-compile hit path)."""
+    from pyabc_tpu.utils.xla_cache import program_shape_key
+
+    base = program_shape_key(_prepared_gaussian(tmp_path, "a"))
+    same = program_shape_key(_prepared_gaussian(tmp_path, "b"))
+    assert base == same  # seed/db differ, program shape does not
+
+    varied = {
+        "noise_sd": _prepared_gaussian(tmp_path, "n", noise_sd=0.7),
+        "x_obs": _prepared_gaussian(tmp_path, "x", x_obs=2.0),
+        "distance p": _prepared_gaussian(tmp_path, "p", p=1.0),
+        "prior scale": _prepared_gaussian(tmp_path, "s", prior_sd=3.0),
+    }
+    for what, abc in varied.items():
+        assert program_shape_key(abc) != base, (
+            f"key blind to {what}: cross-tenant kernel adoption would "
+            f"compute the wrong posterior")
+
+
+def test_jax_model_content_hash_distinguishes_closures():
+    """Model identity is the traced closure, not the display name."""
+    from pyabc_tpu.serving.tenant import _build_gaussian
+
+    a = _build_gaussian(spec_for(seed=1, params={"noise_sd": 0.5}))
+    b = _build_gaussian(spec_for(seed=2, params={"noise_sd": 0.5}))
+    c = _build_gaussian(spec_for(seed=1, params={"noise_sd": 0.9}))
+    assert a["models"].name == c["models"].name == "gauss"
+    assert a["models"].content_hash() == b["models"].content_hash()
+    assert a["models"].content_hash() != c["models"].content_hash()
+
+
+# ============================================ scheduler hygiene regressions
+def test_cancel_before_run_handle_exists_lands_cancelled(
+        make_scheduler, monkeypatch):
+    """A cancel acknowledged while the attempt thread is still building
+    (tenant.abc is None) must stop the run once the handle exists —
+    not let it proceed to COMPLETED despite the ack."""
+    gate = threading.Event()
+    building = threading.Event()
+    orig = TenantSpec.abcsmc_kwargs
+
+    def slow_build(self):
+        building.set()
+        assert gate.wait(60)
+        return orig(self)
+
+    monkeypatch.setattr(TenantSpec, "abcsmc_kwargs", slow_build)
+    sched = make_scheduler(n_slots=1)
+    t = sched.submit(spec_for(seed=501), tenant_id="tenant-precancel")
+    assert building.wait(60)
+    assert t.state == RUNNING and t.abc is None
+    assert sched.cancel("tenant-precancel") is True
+    gate.set()
+    wait_terminal([t])
+    assert t.state == CANCELLED, (t.state, t.error)
+
+
+def test_drain_times_out_on_wall_clock_under_injected_clock(
+        make_scheduler):
+    """drain()'s deadline must advance on WALL time: with a manually-
+    stepped fake clock (the resilience-test pattern) and a hung RUNNING
+    tenant, a clock-based deadline never fires and drain spins forever
+    instead of reporting the tenant forced."""
+    from pyabc_tpu.serving.tenant import Tenant
+
+    class ManualClock:
+        def __init__(self):
+            self.t = 100.0
+
+        def now(self):
+            return self.t
+
+    sched = make_scheduler(clock=ManualClock())
+    hung = Tenant("tenant-hung", spec_for(seed=1), clock=sched.clock,
+                  db_path="sqlite:///:memory:",
+                  checkpoint_path=os.devnull)
+    hung.state = RUNNING
+    with sched._lock:
+        sched._tenants[hung.id] = hung
+    t0 = time.monotonic()
+    summary = sched.drain(timeout_s=0.5)
+    assert time.monotonic() - t0 < 30, "drain ignored its timeout"
+    assert summary["forced"] == ["tenant-hung"]
+    with sched._lock:  # let shutdown proceed cleanly
+        del sched._tenants[hung.id]
+
+
+def test_terminal_tenants_evicted_beyond_retention_cap(make_scheduler):
+    """A long-lived serving process must not grow with every tenant it
+    ever finished: beyond max_terminal_tenants the oldest terminal
+    records (and their observability namespaces) are evicted, and
+    run-lease reaps leave no slot ranges behind in the lease table."""
+    sched = make_scheduler(n_slots=1, max_queued=8,
+                           max_terminal_tenants=2)
+    runner = sched.submit(spec_for(seed=511, gens=40),
+                          tenant_id="tenant-evict-run")
+    cancelled = [
+        sched.submit(spec_for(seed=512 + i), tenant_id=f"tenant-ev{i}")
+        for i in range(4)
+    ]
+    for t in cancelled:
+        assert sched.cancel(t.id) is True
+        assert t.state == CANCELLED
+    # newest two terminal records retained, oldest two evicted
+    assert sched.get("tenant-ev0") is None
+    assert sched.get("tenant-ev1") is None
+    assert sched.get("tenant-ev2") is not None
+    assert sched.get("tenant-ev3") is not None
+    snap = observability_snapshot()["tenants"]
+    assert "tenant-ev0" not in snap and "tenant-ev1" not in snap
+    sched.cancel("tenant-evict-run")
+    wait_terminal([runner])
+    assert sched.leases.stats()["requeued_slots"] == 0
+
+
+def test_lease_table_discard_requeued():
+    """Run-level leases never redispatch slot ranges; discarding after
+    a reap keeps the table bounded."""
+    from pyabc_tpu.resilience.lease import LeaseTable
+
+    class Clock:
+        t = 0.0
+
+        def now(self):
+            return self.t
+
+    clock = Clock()
+    table = LeaseTable(clock, timeout_s=1.0)
+    table.grant("tenant-x", 0, 1)
+    clock.t = 5.0
+    events = table.reap(clock.now())
+    assert len(events) == 1
+    assert table.stats()["requeued_slots"] == 1
+    assert table.discard_requeued() == 1
+    assert table.stats()["requeued_slots"] == 0
 
 
 # ====================================================== writer pool
