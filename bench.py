@@ -1301,168 +1301,247 @@ def run_mesh_lane(budget_s: float, platform: str = "cpu") -> dict:
 
 
 def serve_lane_skip_reason() -> str | None:
-    """The `serve` lane proves the round-14 multi-tenant containment
-    contract end to end: a fleet of CPU tenants WITH an injected
-    serial-killer tenant must complete with survivors' wall clock within
-    10% of the same fleet fault-free, fair throughput across tenants,
-    and repeat program shapes hitting the kernel cache (zero compile).
-    CPU-cheap (small fused gauss fleets); PYABC_TPU_BENCH_SERVE=0
-    disables it."""
+    """The `serve` lane (round 15: mesh-aware serving) proves the
+    topology-aware scheduler end to end on a forced-8-device mesh: a
+    MIXED fleet (a width-4 sharded tenant + width-1 unsharded tenants)
+    with one checkpoint-PREEMPTION and one injected ``device_lost``
+    event must complete with every posterior bit-identical to its
+    seed-matched solo run, the preempted tenant resuming on a
+    DIFFERENT-width sub-mesh, the allocator's books balancing exactly
+    (zero leaked/overlapping ranges), and the round-14 fairness +
+    strict-sync-budget guards still holding. Runs in a SUBPROCESS
+    (forced 8 virtual CPU devices when no real multi-device platform
+    exists, strict sync budget armed). The round-14 chaos-ISOLATION
+    guard lives on in tier-1 (tests/test_serving.py).
+    PYABC_TPU_BENCH_SERVE=0 disables it."""
     if os.environ.get("PYABC_TPU_BENCH_SERVE") == "0":
         return "disabled via PYABC_TPU_BENCH_SERVE=0"
     return None
 
 
-def run_serve_lane(budget_s: float) -> dict:
-    """Multi-tenant chaos lane: two fleets on ONE scheduler.
+def _serve_lane_child() -> dict:
+    """The serve lane's measured body — runs in the lane subprocess
+    with the 8-device platform configured and the sync budget strict.
 
-    Fleet A (baseline): N same-shape gaussian tenants, fault-free.
-    Fleet B (chaos): the same N tenant configs PLUS one chaos tenant
-    hard-killed at every chunk (scoped by ``fault_scope``), which fails
-    after its requeue budget. Guards:
+    Timeline (one scheduler, one pool of 8 devices):
 
-    - ISOLATION: median survivor wall clock in fleet B <= 1.10x the
-      fleet-A median (+0.75 s absolute timing slack on shared cores) —
-      "a faulted tenant adds <= 10% to survivors' wall clock";
-    - FAIRNESS: max/min per-tenant throughput ratio within fleet A
-      bounded (equal shapes, equal slots -> near-equal service);
-    - CACHE: repeat shapes hit the shape-keyed kernel cache (every
-      fleet-B tenant adopts fleet A's compiled context: zero compile).
+    1. solo references, no scheduler: each fleet seed's gaussian run,
+       plus the big tenant's 4-shard run VIRTUALLY on one device (the
+       kernel's width-independence contract makes that the bit-level
+       reference for ANY sub-mesh placement);
+    2. fleet A (fairness baseline): the unsharded tenants alone on the
+       full pool, fault-free — per-tenant pps feeds the r14 fairness
+       guard;
+    3. fleet B (mixed + events): the ``sharded=4`` big tenant (leases a
+       width-4 sub-mesh) + the same unsharded tenants. Once the big
+       tenant has checkpointed chunks, it is checkpoint-PREEMPTED
+       (event 1), requeues, resumes; then ``device_lost`` for devices
+       0-5 is injected at the polled ``device.mesh`` site (event 2) —
+       leases touching them reap, capacity shrinks 8 -> 2, and every
+       affected tenant (budget untouched) re-places on the surviving
+       width-2 block. Everything must still complete bit-identical.
     """
+    import tempfile
+    import time as _time
+
+    import jax
     import numpy as np
 
+    import pyabc_tpu as pt
+    from pyabc_tpu.observability import SYSTEM_CLOCK
     from pyabc_tpu.resilience import (
         FaultPlan,
-        FaultRule,
         install_fault_plan,
         uninstall_fault_plan,
     )
-    from pyabc_tpu.serving import COMPLETED, FAILED, RunScheduler, TenantSpec
+    from pyabc_tpu.serving import COMPLETED, RunScheduler, TenantSpec
+    from pyabc_tpu.serving.tenant import _build_gaussian
+    from pyabc_tpu.storage import History
     from pyabc_tpu.utils.bench_defaults import (
+        DEFAULT_SERVE_BIG_GENS,
+        DEFAULT_SERVE_BIG_POP,
+        DEFAULT_SERVE_BUDGET_S,
         DEFAULT_SERVE_GENS,
         DEFAULT_SERVE_POP,
-        DEFAULT_SERVE_SLOTS,
         DEFAULT_SERVE_TENANTS,
         SERVE_FAIRNESS_MAX_RATIO,
-        SERVE_ISOLATION_MAX_INFLATION,
-        SERVE_ISOLATION_SLACK_S,
     )
 
-    n_tenants = int(os.environ.get("PYABC_TPU_BENCH_SERVE_TENANTS",
-                                   DEFAULT_SERVE_TENANTS))
+    clock = SYSTEM_CLOCK
+    t0 = clock.now()
+    budget = float(os.environ.get("PYABC_TPU_BENCH_SERVE_BUDGET_S",
+                                  DEFAULT_SERVE_BUDGET_S))
+    n_small = int(os.environ.get("PYABC_TPU_BENCH_SERVE_TENANTS",
+                                 DEFAULT_SERVE_TENANTS))
     pop = int(os.environ.get("PYABC_TPU_BENCH_SERVE_POP",
                              DEFAULT_SERVE_POP))
     gens = int(os.environ.get("PYABC_TPU_BENCH_SERVE_GENS",
                               DEFAULT_SERVE_GENS))
-    n_slots = int(os.environ.get("PYABC_TPU_BENCH_SERVE_SLOTS",
-                                 DEFAULT_SERVE_SLOTS))
-    t_lane0 = CLOCK.now()
+    big_pop = int(os.environ.get("PYABC_TPU_BENCH_SERVE_BIG_POP",
+                                 DEFAULT_SERVE_BIG_POP))
+    big_gens = int(os.environ.get("PYABC_TPU_BENCH_SERVE_BIG_GENS",
+                                  DEFAULT_SERVE_BIG_GENS))
+    G = 2
+    n_dev = len(jax.devices())
+    out = {"n_devices": n_dev, "n_small_tenants": n_small,
+           "pop_size": pop, "generations": gens,
+           "big": {"pop_size": big_pop, "generations": big_gens,
+                   "sharded": 4}}
+    if n_dev < 8:
+        out["skipped"] = (
+            f"only {n_dev} device(s) and forcing virtual devices was "
+            f"unavailable on this platform")
+        return out
 
-    import tempfile
+    base_dir = tempfile.mkdtemp(prefix="abc-bench-serve-")
+    small_seeds = [500 + i for i in range(n_small)]
+    BIG_SEED = 9001
 
-    sched = RunScheduler(
-        n_slots=n_slots, max_queued=2 * n_tenants + 2,
-        lease_timeout_s=90.0, max_requeues=1,
-        base_dir=tempfile.mkdtemp(prefix="abc-bench-serve-"),
-    )
-
-    def spec(seed):
+    def small_spec(seed):
         return TenantSpec(model="gaussian", population_size=pop,
                           generations=gens, seed=seed,
-                          fused_generations=2)
+                          fused_generations=G)
 
-    def run_fleet(tag, seeds, chaos=False):
-        tenants = []
-        if chaos:
-            # the victim id must not be a substring of any survivor id:
-            # FaultRule.match is substring-based fault-domain selection
-            tenants.append(sched.submit(
-                spec(9009), tenant_id="serialkiller"))
-        tenants += [
-            sched.submit(spec(s), tenant_id=f"{tag}-{i}")
-            for i, s in enumerate(seeds)
-        ]
-        deadline = CLOCK.now() + max(budget_s * 0.4, 60.0)
-        import time as _t
+    def history_arrays(db):
+        h = History(db)
+        eps = h.get_all_populations().query(
+            "t >= 0")["epsilon"].to_numpy()
+        arrs = [eps]
+        for t in range(h.n_populations):
+            df, w = h.get_distribution(0, t)
+            arrs.append(np.sort(df["theta"].to_numpy()))
+            arrs.append(np.sort(np.asarray(w)))
+        n = h.n_populations
+        h.close()
+        return n, arrs
 
-        while CLOCK.now() < deadline:
-            if all(t.state in (COMPLETED, FAILED) for t in tenants):
-                break
-            _t.sleep(0.1)
-        return tenants
+    def bit_identical(db_a, db_b):
+        na, a = history_arrays(db_a)
+        nb, b = history_arrays(db_b)
+        return (na == nb and len(a) == len(b)
+                and all(np.array_equal(x, y) for x, y in zip(a, b)))
+
+    # -- 1. solo references (these double as the shape warm-up)
+    def solo(seed, db, *, pop_, gens_, sharded=None):
+        built = _build_gaussian(small_spec(seed))
+        observed = built.pop("observed")
+        abc = pt.ABCSMC(population_size=pop_, seed=seed,
+                        fused_generations=G, sharded=sharded, **built)
+        abc.new(db, observed, store_sum_stats=True)
+        abc.run(max_nr_populations=gens_)
+
+    for s in small_seeds:
+        solo(s, f"sqlite:///{base_dir}/ref_{s}.db", pop_=pop, gens_=gens)
+    solo(BIG_SEED, f"sqlite:///{base_dir}/ref_big.db",
+         pop_=big_pop, gens_=big_gens, sharded=4)
+
+    sched = RunScheduler(
+        n_devices=8, max_queued=4 * n_small + 4, lease_timeout_s=120.0,
+        max_requeues=1, base_dir=base_dir,
+    )
+
+    def wait(tenants, share):
+        deadline = clock.now() + max(budget * share, 30.0)
+        while clock.now() < deadline:
+            if all(t.state in ("completed", "failed", "cancelled",
+                               "drained") for t in tenants):
+                return True
+            _time.sleep(0.1)
+        return False
 
     try:
-        seeds = [500 + i for i in range(n_tenants)]
-        # warm-up: ONE tenant compiles the fleet shape, so both fleets
-        # measure warm service time (wall-clock comparisons and the
-        # fairness ratio would otherwise mix a ~seconds XLA compile
-        # into some tenants' run_s and not others')
-        run_fleet("warm", [499])
-        base = run_fleet("base", seeds)
-        install_fault_plan(FaultPlan([
-            FaultRule(site="orchestrator.chunk", kind="kill", every=1,
-                      max_fires=None, match="serialkiller"),
-        ]))
-        try:
-            chaos = run_fleet("fleetb", seeds, chaos=True)
-        finally:
-            uninstall_fault_plan()
-
-        base_ok = [t for t in base if t.state == COMPLETED]
-        chaos_tenant = chaos[0]
-        survivors = [t for t in chaos[1:] if t.state == COMPLETED]
-        base_walls = [t.run_s for t in base_ok]
-        surv_walls = [t.run_s for t in survivors]
-        base_med = float(np.median(base_walls)) if base_walls else 0.0
-        surv_med = float(np.median(surv_walls)) if surv_walls else 1e9
-        # per-tenant throughput over fleet A (equal shapes -> fairness)
-        pps = [pop * gens / t.run_s for t in base_ok if t.run_s > 0]
+        # -- 2. fleet A: fairness baseline on the full healthy pool
+        fleet_a = [sched.submit(small_spec(s), tenant_id=f"a-{i}")
+                   for i, s in enumerate(small_seeds)]
+        wait(fleet_a, 0.25)
+        a_ok = [t for t in fleet_a if t.state == COMPLETED]
+        pps = [pop * gens / t.run_s for t in a_ok if t.run_s > 0]
         fairness = (max(pps) / min(pps)) if pps else float("inf")
-        cache = sched.kernel_cache.stats()
-        # every fleet-B tenant reuses fleet A's compiled shape
-        chaos_hits = [t.kernel_cache_hit for t in chaos[1:]]
-        compile_spans_b = sum(t.compile_span_count() for t in chaos[1:])
 
-        isolation_bound = (base_med * SERVE_ISOLATION_MAX_INFLATION
-                           + SERVE_ISOLATION_SLACK_S)
-        out = {
-            "metric": "serve_multi_tenant_chaos",
-            "n_tenants": n_tenants, "n_slots": n_slots,
-            "pop_size": pop, "generations": gens,
-            "lane_s": round(CLOCK.now() - t_lane0, 2),
-            "baseline_completed": len(base_ok),
-            "survivors_completed": len(survivors),
-            "chaos_tenant_state": chaos_tenant.state,
-            "chaos_tenant_requeues": int(chaos_tenant.requeues),
-            "survivor_wall_median_s": round(surv_med, 3),
-            "baseline_wall_median_s": round(base_med, 3),
-            "survivor_inflation": round(
-                surv_med / base_med, 4) if base_med else None,
+        # -- 3. fleet B: mixed, one preemption + one device_lost
+        big = sched.submit(
+            TenantSpec(model="gaussian", population_size=big_pop,
+                       generations=big_gens, seed=BIG_SEED,
+                       fused_generations=G, sharded=4),
+            tenant_id="bigshard")
+        fleet_b = [sched.submit(small_spec(s), tenant_id=f"b-{i}")
+                   for i, s in enumerate(small_seeds)]
+        t_ev = clock.now()
+        while big.generations_done < 2 and clock.now() - t_ev < 120:
+            _time.sleep(0.05)
+        preempt_ack = sched.preempt("bigshard")
+        t_ev = clock.now()
+        while big.preemptions < 1 and clock.now() - t_ev < 120:
+            _time.sleep(0.05)
+        # let it resume before the mesh loss
+        t_ev = clock.now()
+        while big.state != "running" and clock.now() - t_ev < 120:
+            _time.sleep(0.05)
+        install_fault_plan(FaultPlan.parse(
+            "device.mesh:device_lost:devices=0-5"))
+        t_ev = clock.now()
+        while sched.devices_lost_total < 6 and clock.now() - t_ev < 60:
+            _time.sleep(0.05)
+        uninstall_fault_plan()
+        completed_in_time = wait([big] + fleet_b, 0.55)
+
+        place = sched.allocator.stats()
+        invariant_problems = sched.allocator.check_invariants()
+        b_ok = [t for t in fleet_b if t.state == COMPLETED]
+        parity_small = all(
+            bit_identical(t.db_path,
+                          f"sqlite:///{base_dir}/ref_{500 + i}.db")
+            for i, t in enumerate(fleet_b) if t.state == COMPLETED)
+        parity_big = (big.state == COMPLETED and bit_identical(
+            big.db_path, f"sqlite:///{base_dir}/ref_big.db"))
+
+        out.update({
+            "metric": "serve_mesh_aware_mixed_fleet",
+            "lane_s": round(clock.now() - t0, 2),
+            "fleet_a_completed": len(a_ok),
+            "fleet_b_completed": len(b_ok),
+            "big_state": big.state,
+            "big_widths": list(big.widths),
+            "big_preemptions": int(big.preemptions),
+            "big_requeue_budget_spent": int(big.requeues),
+            "big_device_loss_requeues": int(big.device_loss_requeues),
+            "devices_lost": int(sched.devices_lost_total),
+            "healthy_devices_after": place["healthy_devices"],
             "fairness_max_min_pps_ratio": round(fairness, 4),
             "tenant_pps": [round(v, 1) for v in pps],
-            "kernel_cache": cache,
-            "fleet_b_cache_hits": sum(1 for h in chaos_hits if h),
-            "fleet_b_compile_spans": int(compile_spans_b),
+            "retry_after_repriced": sched.snapshot()["admission"],
+            "placement": place,
+            "allocator_invariant_problems": invariant_problems,
+            "kernel_cache": sched.kernel_cache.stats(),
             "stale_reports_discarded": int(
                 sched.stale_reports_discarded),
-        }
+        })
         guard = {
-            "pass_all_survivors_complete": bool(
-                len(survivors) == n_tenants
-                and len(base_ok) == n_tenants),
-            "pass_chaos_contained": bool(
-                chaos_tenant.state == FAILED
-                and chaos_tenant.requeues == 1),
-            # the <=10% isolation criterion, with absolute slack for
-            # shared-core timing noise on small runs
-            "pass_isolation": bool(surv_med <= isolation_bound),
-            "isolation_bound_s": round(isolation_bound, 3),
-            # equal-shape tenants through equal slots: generous bound
-            # for a 1-core box where slot overlap is scheduler luck
+            # every tenant (both fleets + the twice-displaced big one)
+            # finishes with a posterior, under the STRICT sync budget
+            # armed in this child's environment
+            "pass_all_complete": bool(
+                completed_in_time and len(a_ok) == n_small
+                and len(b_ok) == n_small and big.state == COMPLETED),
+            # survivors' posteriors bit-identical to solo runs
+            "pass_survivor_parity": bool(parity_small),
+            # the preempted tenant resumed BIT-identical on a
+            # DIFFERENT-width sub-mesh (4 -> the surviving 2-wide
+            # block after the mesh loss), budget untouched
+            "pass_preempted_resumes_different_width": bool(
+                preempt_ack and big.preemptions == 1
+                and parity_big and len(big.widths) >= 2
+                and big.widths[0] == 4 and big.widths[-1] < 4
+                and big.requeues == 0),
+            "pass_device_loss_survived": bool(
+                sched.devices_lost_total == 6
+                and place["healthy_devices"] == 2),
+            # zero leaked/overlapping device ranges after coalescing
+            "pass_allocator_books_balance": invariant_problems == [],
+            # the r14 fairness guard, measured on fleet A
             "pass_fairness": bool(fairness <= SERVE_FAIRNESS_MAX_RATIO),
-            # repeat shapes pay zero compile: every fleet-B tenant hits
-            "pass_cache_hits": bool(
-                all(chaos_hits) and compile_spans_b == 0),
+            "sync_budget_strict_armed": bool(
+                os.environ.get("PYABC_TPU_SYNC_BUDGET_STRICT") == "1"),
         }
         out["regression_guard"] = guard
         out["value"] = 1.0 if all(
@@ -1471,6 +1550,39 @@ def run_serve_lane(budget_s: float) -> dict:
         return out
     finally:
         sched.shutdown()
+
+
+def run_serve_lane(budget_s: float) -> dict:
+    """Run the serve lane in a subprocess with 8 forced virtual CPU
+    devices (accelerator platforms see their real devices) and the
+    sync budget STRICT — the same rig as the mesh lane and the CI
+    ``serve`` job. A hung child never eats the bench budget."""
+    budget_s = max(float(budget_s), 240.0)
+    env = dict(os.environ)
+    env["PYABC_TPU_BENCH_SERVE_CHILD"] = "1"
+    env["PYABC_TPU_BENCH_SERVE_BUDGET_S"] = str(budget_s * 0.9)
+    env["PYABC_TPU_SYNC_BUDGET_STRICT"] = "1"
+    if probe_platform() == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(HERE, "bench.py")],
+            env=env, capture_output=True, text=True, timeout=budget_s,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"serve lane child timed out after {budget_s}s"}
+    for line in reversed(proc.stdout.strip().splitlines() or [""]):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    return {"error": f"serve lane child rc={proc.returncode}: "
+                     f"{(proc.stderr or '')[-400:]}"}
 
 
 def main():
@@ -1530,7 +1642,7 @@ def main():
     if (os.environ.get("PYABC_TPU_BENCH_LANE") or "").strip().lower() \
             == "serve":
         _state["phase"] = "serve"
-        _state["metric"] = "serve_multi_tenant_chaos"
+        _state["metric"] = "serve_mesh_aware_mixed_fleet"
         serve_skip = serve_lane_skip_reason()
         if serve_skip:
             _state["serve"] = {"skipped": serve_skip}
@@ -2104,5 +2216,10 @@ if __name__ == "__main__":
         # ONE JSON line
         _emitted = True
         print(json.dumps(_mesh_lane_child()))
+        sys.exit(0)
+    if os.environ.get("PYABC_TPU_BENCH_SERVE_CHILD"):
+        # serve-lane subprocess: same contract as the mesh child
+        _emitted = True
+        print(json.dumps(_serve_lane_child()))
         sys.exit(0)
     main()

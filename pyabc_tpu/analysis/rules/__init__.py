@@ -14,12 +14,13 @@ from .dispatch import Disp001
 from .exceptions import Exc001
 from .isolation import Iso001
 from .locks import Lock001
+from .placement_rule import Place001
 from .rng import Rng001
 from .sync import Sync001
 from .telemetry import Telem001
 
 RULE_CLASSES = [Sync001, Clock001, Rng001, Exc001, Lock001, Telem001,
-                Disp001, Mesh001, Iso001]
+                Disp001, Mesh001, Iso001, Place001]
 
 
 def all_rules():

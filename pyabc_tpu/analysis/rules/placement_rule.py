@@ -1,0 +1,77 @@
+"""PLACE001 — topology decisions live only in the placement module.
+
+Mesh-aware serving (round 15) hangs on ONE structural fact: every
+device-topology decision in ``pyabc_tpu/serving/`` — which devices
+exist, which are healthy, which contiguous range a tenant runs on, the
+``jax.sharding.Mesh`` a lease maps to — flows through
+``serving/placement.py``'s :class:`SubMeshAllocator` and its sanctioned
+``build_mesh`` / ``platform_device_count`` wrappers. A ``Mesh(...)``
+construction or a ``jax.devices()`` enumeration anywhere else in the
+serving package is an UNTRACKED placement: devices used without a
+lease, invisible to the buddy allocator's books, immune to device-loss
+quarantine and degraded cordons — exactly the bypass that turns "zero
+leaked/overlapping device ranges" back into hope. This rule makes the
+bypass a finding (the placement twin of ISO001's unleased-run rule).
+
+Scope: ``pyabc_tpu/serving/`` only (inference/ops/bench/test layers
+construct meshes legitimately), with ``placement.py`` — the sanctioned
+topology site — exempt.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileContext, Finding, Rule
+
+#: constructing any of these IS building a device mesh
+MESH_CONSTRUCTORS = {"Mesh", "local_mesh", "global_mesh", "make_mesh"}
+
+#: calling any of these enumerates the device topology
+DEVICE_ENUMERATORS = {"devices", "local_devices", "device_count",
+                      "local_device_count"}
+
+#: the sanctioned topology module — the one legitimate site
+ALLOWED = {"pyabc_tpu/serving/placement.py"}
+
+
+class Place001(Rule):
+    name = "PLACE001"
+    summary = ("Mesh construction / device enumeration in the serving "
+               "layer outside the placement module")
+    hint = ("only pyabc_tpu/serving/placement.py may construct a Mesh "
+            "or enumerate devices — device ranges are LEASED resources "
+            "tracked by the SubMeshAllocator (loss quarantine, degraded "
+            "cordons, coalescing); route topology through "
+            "placement.build_mesh()/platform_device_count() or an "
+            "allocator lease")
+
+    def applies_to(self, rel: str) -> bool:
+        return rel.startswith("pyabc_tpu/serving/") and rel not in ALLOWED
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = (func.id if isinstance(func, ast.Name)
+                    else func.attr if isinstance(func, ast.Attribute)
+                    else None)
+            if name in MESH_CONSTRUCTORS:
+                findings.append(self.finding(
+                    ctx, node,
+                    f"`{name}(...)` constructs a device mesh outside "
+                    f"the placement module — sub-meshes are leased "
+                    f"resources; an untracked Mesh bypasses the "
+                    f"allocator's books, device-loss quarantine and "
+                    f"degraded cordons",
+                ))
+            elif name in DEVICE_ENUMERATORS:
+                findings.append(self.finding(
+                    ctx, node,
+                    f"`{name}(...)` enumerates devices outside the "
+                    f"placement module — topology is placement.py's "
+                    f"job; ad-hoc enumeration drifts from the "
+                    f"allocator's healthy/lost/degraded view",
+                ))
+        return findings

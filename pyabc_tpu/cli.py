@@ -352,7 +352,20 @@ def manager_cmd(host, port, watch):
 @click.option("--host", default="127.0.0.1", help="bind address")
 @click.option("--port", type=int, default=8766, help="port (0 = ephemeral)")
 @click.option("--slots", type=int, default=1,
-              help="concurrent device slots (tenants running at once)")
+              help="legacy pool sizing: a pool of this many width-1 "
+              "devices (ignored when --devices is given)")
+@click.option("--devices", "n_devices", type=int, default=None,
+              help="device-pool width for sub-mesh placement (0 = "
+              "probe the platform); a sharded=n tenant leases a "
+              "contiguous 1/2/4/8-wide sub-mesh from this pool")
+@click.option("--packing", type=int, default=1,
+              help="width-1 tenants packed per device (wider sub-mesh "
+              "leases stay exclusive)")
+@click.option("--preempt-queue-wait-s", type=float, default=None,
+              help="auto-preemption: a queued tenant unplaceable for "
+              "this long checkpoint-preempts the widest running tenant "
+              "(it requeues and resumes bit-identical on the next free "
+              "sub-mesh); unset = explicit POST .../preempt only")
 @click.option("--max-queued", type=int, default=16,
               help="admission queue depth; a full queue answers HTTP 429 "
               "with a measured Retry-After instead of queueing unboundedly")
@@ -373,26 +386,36 @@ def manager_cmd(host, port, watch):
 @click.option("--writer-threads", type=int, default=2,
               help="shared async History writer threads (the pooled "
               "writer serving every tenant's db)")
-def serve_cmd(host, port, slots, max_queued, lease_timeout_s, max_requeues,
+def serve_cmd(host, port, slots, n_devices, packing, preempt_queue_wait_s,
+              max_queued, lease_timeout_s, max_requeues,
               base_dir, writer_threads):
-    """Multi-tenant ABC-SMC serving: a RunScheduler multiplexing leased
-    tenant runs over shared device slots, fronted by the submit/status/
-    stream HTTP API. SIGTERM/SIGINT drains gracefully — every live
-    tenant flushes its History and writes a final checkpoint before the
-    process exits."""
+    """Multi-tenant ABC-SMC serving: a RunScheduler leasing contiguous
+    SUB-MESHES of the device pool to tenants (sharded tenants span
+    1/2/4/8 devices, small tenants pack per device), fronted by the
+    submit/status/stream HTTP API. Big tenants can be checkpoint-
+    preempted; device loss shrinks the pool and re-places the affected
+    tenants on narrower sub-meshes, bit-identically. SIGTERM/SIGINT
+    drains gracefully — every live tenant flushes its History and
+    writes a final checkpoint before the process exits."""
     import signal as _signal
 
     from .serving import RunScheduler, serve_api
+    from .serving.placement import platform_device_count
 
+    if n_devices == 0:
+        n_devices = platform_device_count()
     sched = RunScheduler(
-        n_slots=slots, max_queued=max_queued,
+        n_slots=slots, n_devices=n_devices, packing=packing,
+        preempt_queue_wait_s=preempt_queue_wait_s,
+        max_queued=max_queued,
         lease_timeout_s=lease_timeout_s, max_requeues=max_requeues,
         base_dir=base_dir, writer_threads=writer_threads,
     )
     httpd = serve_api(sched, host=host, port=port, block=False)
     click.echo(
         f"abc-serve on http://{host}:{httpd.server_port} "
-        f"(slots={slots}, max_queued={max_queued}, "
+        f"(devices={sched.allocator.n_devices}, "
+        f"packing={sched.packing}, max_queued={max_queued}, "
         f"base_dir={sched.base_dir})", err=True,
     )
 

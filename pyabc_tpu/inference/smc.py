@@ -1677,11 +1677,17 @@ class ABCSMC:
     def _sharded_n(self) -> int | None:
         """Resolve the sharded fused path's shard count, or None.
 
-        Mesh present: the shard count IS the mesh width (single-process
-        meshes only — multi-host meshes keep the replicated GSPMD path).
-        No mesh but ``sharded=<int>``: that many VIRTUAL shards vmapped
-        on one device — the same reduction, used as the parity
-        reference. ``sharded=True`` makes capability failures loud."""
+        Mesh present without an explicit count: the shard count IS the
+        mesh width (single-process meshes only — multi-host meshes keep
+        the replicated GSPMD path). Mesh present WITH ``sharded=<int>``:
+        the mesh width only has to DIVIDE the shard count — each device
+        runs its block of virtual shards (the hybrid execution), so an
+        n-shard checkpoint resumes bit-identical on any divisor-width
+        sub-mesh (mesh-aware serving re-places tenants on whatever
+        width is free). No mesh but ``sharded=<int>``: that many
+        VIRTUAL shards vmapped on one device — the same reduction, used
+        as the parity reference. ``sharded=True`` makes capability
+        failures loud."""
         if self.sharded in (False, 0):
             return None
         requested = self.sharded is not None
@@ -1697,11 +1703,17 @@ class ABCSMC:
                         "multi-host meshes use the replicated GSPMD path"
                     )
                 return None
-            n = len(devs)
-            if n_req is not None and n_req != n:
+            w = len(devs)
+            if n_req is None:
+                n = w
+            elif n_req < w or n_req % w:
                 raise ValueError(
-                    f"sharded={n_req} but the mesh has {n} devices"
+                    f"sharded={n_req} cannot run on a {w}-device mesh: "
+                    f"the mesh width must divide the shard count (each "
+                    f"device then runs n_shards/width virtual shards)"
                 )
+            else:
+                n = n_req
         else:
             n = n_req
         if n is None or n <= 1:

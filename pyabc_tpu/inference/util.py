@@ -1465,6 +1465,17 @@ class DeviceContext:
         so ``syncs_per_run`` is untouched and the dispatch engine's
         speculation/rollback machinery works unchanged (the carry is
         replicated and chains device-to-device exactly as before).
+
+        Width-independence (round 15, mesh-aware serving): the mesh
+        width ``w`` only has to DIVIDE ``n_shards`` — device ``d`` then
+        runs the ``v = n_shards / w`` virtual shards ``[d*v, (d+1)*v)``
+        vmapped inside the shard_map (the hybrid execution), and the
+        per-generation collectives become reshape-then-all_gather. The
+        reduction stays a pure function of ``n_shards``, so a
+        checkpoint taken at any width resumes BIT-identical at any
+        other width (including w=1 and the no-mesh virtual path) —
+        which is what lets the serving scheduler re-place a preempted
+        or device-loss-orphaned tenant on whatever sub-mesh is free.
         """
         from jax.sharding import PartitionSpec as P
 
@@ -1483,13 +1494,17 @@ class DeviceContext:
         K = self.K
         refit_every_s, _drift_thr = refit_cadence
         use_mesh = self.mesh is not None
+        v_loc = 1
         if use_mesh:
             mesh_devs = list(self.mesh.devices.flat)
-            if len(mesh_devs) != n_shards:
+            w_mesh = len(mesh_devs)
+            if n_shards % w_mesh:
                 raise ValueError(
-                    f"mesh has {len(mesh_devs)} devices but the kernel "
-                    f"was requested with n_shards={n_shards}"
+                    f"mesh has {w_mesh} devices but the kernel was "
+                    f"requested with n_shards={n_shards}: the mesh "
+                    f"width must divide the shard count"
                 )
+            v_loc = n_shards // w_mesh
             axis = self.mesh.axis_names[0]
 
         def local_generation(shard_idx, gen_key, dyn, n_target, use_prior,
@@ -1553,10 +1568,13 @@ class DeviceContext:
                 jnp.where(mask_loc[:, None], res_l["theta"], 0.0)))
             return n_acc_l, rounds_l, n_valid_l, res_l, theta_bad_l
 
-        # the two executions of the SAME shard program: on the mesh the
-        # shard is the device (collectives are all_gathers); without a
-        # mesh the shards are a vmapped leading axis on one device and
-        # the "collectives" are reshapes — bit-level the same reduction
+        # the three executions of the SAME shard program: on a
+        # full-width mesh the shard is the device (collectives are
+        # all_gathers); without a mesh the shards are a vmapped leading
+        # axis on one device and the "collectives" are reshapes; on a
+        # NARROWER mesh (w | n_shards) each device vmaps its v =
+        # n_shards/w virtual shards and the collectives compose
+        # reshape + all_gather — bit-level the same reduction
         class _MeshShards:
             @staticmethod
             def run_local(gen_key, dyn, n_target, use_prior, stopped):
@@ -1588,6 +1606,30 @@ class DeviceContext:
             @staticmethod
             def stack(x):
                 return x
+
+        class _HybridShards:
+            """w devices × v_loc virtual shards per device: device ``d``
+            owns global shards ``[d*v_loc, (d+1)*v_loc)``, so flattening
+            its local virtual axis and tiling the all_gather reproduces
+            the global shard-blocked order exactly."""
+
+            @staticmethod
+            def run_local(gen_key, dyn, n_target, use_prior, stopped):
+                dev = jax.lax.axis_index(axis)
+                idx = dev * v_loc + jnp.arange(v_loc)
+                return jax.vmap(
+                    local_generation,
+                    in_axes=(0, None, None, None, None, None),
+                )(idx, gen_key, dyn, n_target, use_prior, stopped)
+
+            @staticmethod
+            def rows(x):
+                flat = x.reshape((v_loc * x.shape[1],) + x.shape[2:])
+                return jax.lax.all_gather(flat, axis, tiled=True)
+
+            @staticmethod
+            def stack(x):
+                return jax.lax.all_gather(x, axis, tiled=True)
 
         def make_gen_step(A, root, t0, n_sched, g_limit, mpk_base,
                           eps_fixed, min_eps, min_acc_rate):
@@ -1808,12 +1850,24 @@ class DeviceContext:
         if use_mesh:
             from jax.experimental.shard_map import shard_map
 
+            Sh = _MeshShards if v_loc == 1 else _HybridShards
+
             def inner(root_data, t0, n_sched, g_limit, carry0, mpk_base,
                       eps_fixed, min_eps, min_acc_rate):
                 root_k = jax.random.wrap_key_data(root_data)
-                return _chunk_body(_MeshShards, root_k, t0, n_sched,
-                                   g_limit, carry0, mpk_base, eps_fixed,
-                                   min_eps, min_acc_rate)
+                rows, repl, carry = _chunk_body(
+                    Sh, root_k, t0, n_sched, g_limit, carry0, mpk_base,
+                    eps_fixed, min_eps, min_acc_rate)
+                if v_loc > 1:
+                    # flatten each device's virtual-shard axis so the
+                    # sharded out_spec concatenates device blocks into
+                    # the same (G, n_cap, ...) global layout the
+                    # full-width mesh run produces
+                    rows = {
+                        k: x.reshape((G, v_loc * cap_loc) + x.shape[3:])
+                        for k, x in rows.items()
+                    }
+                return rows, repl, carry
 
             inner_sharded = shard_map(
                 inner, mesh=self.mesh, in_specs=(P(),) * 9,

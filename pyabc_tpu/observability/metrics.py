@@ -254,6 +254,26 @@ TENANT_KERNEL_CACHE_HITS_TOTAL = "pyabc_tpu_tenant_kernel_cache_hits_total"
 TENANT_KERNEL_CACHE_MISSES_TOTAL = \
     "pyabc_tpu_tenant_kernel_cache_misses_total"
 
+# -- mesh-aware serving instrument names (round 15) ---------------------------
+#
+# Sub-mesh placement, checkpoint-preemption and device-loss survival:
+#:  healthy devices in the serving pool (shrinks on device_lost)
+SUBMESH_DEVICES_HEALTHY_GAUGE = "pyabc_tpu_submesh_devices_healthy"
+#:  devices currently in free blocks (allocatable capacity)
+SUBMESH_DEVICES_FREE_GAUGE = "pyabc_tpu_submesh_devices_free"
+#:  widest contiguous sub-mesh allocatable right now (fragmentation
+#:  signal: healthy-free high but widest low = drain candidates exist)
+SUBMESH_WIDEST_FREE_GAUGE = "pyabc_tpu_submesh_widest_free"
+#:  tenants checkpoint-preempted at a chunk boundary and requeued (to
+#:  drain fragmentation or admit latency-sensitive small tenants)
+TENANT_PREEMPTIONS_TOTAL = "pyabc_tpu_tenant_preemptions_total"
+#:  devices marked lost (hard mesh loss — capacity shrunk, leases reaped)
+DEVICES_LOST_TOTAL = "pyabc_tpu_devices_lost_total"
+#:  tenants requeued because their sub-mesh lost a device (infrastructure
+#:  fault: does NOT consume the tenant's own requeue budget)
+TENANT_DEVICE_LOSS_REQUEUES_TOTAL = \
+    "pyabc_tpu_tenant_device_loss_requeues_total"
+
 
 def health_event_metric(kind: str) -> str:
     """Per-kind health-event counter name — the registry's stand-in for

@@ -59,6 +59,7 @@ from .faults import (
     fault_scope,
     install_fault_plan,
     maybe_corrupt,
+    maybe_device_fault,
     maybe_fault,
     uninstall_fault_plan,
 )
@@ -77,7 +78,7 @@ __all__ = [
     "InjectedConnectionError", "InjectedTransientError",
     "InjectedPersistError", "InjectedDeviceReset",
     "active_fault_plan", "install_fault_plan", "maybe_fault",
-    "maybe_corrupt", "uninstall_fault_plan",
+    "maybe_corrupt", "maybe_device_fault", "uninstall_fault_plan",
     "fault_scope", "current_fault_scope",
     "DegenerateRunError", "RunSupervisor", "decode_health",
     "LeaseTable",

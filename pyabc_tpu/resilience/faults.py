@@ -16,6 +16,9 @@ site                 probed where
 ``device.context``   DeviceContext build/reuse (simulated device reset)
 ``device.carry``     fused loop, each chunk's input carry (numeric
                      corruption — polled, not raised; see below)
+``device.mesh``      serving scheduler pump, each tick (mesh topology:
+                     ``device_lost`` / ``device_degraded`` — polled;
+                     the scheduler applies the loss or cordon)
 ==================== =======================================================
 
 Rule kinds map to actions: ``kill`` raises :class:`InjectedKill` (hard
@@ -117,7 +120,16 @@ _KIND_SLEEP = {"hang": 30.0, "slow": 0.05, "delay": 0.05}
 #: numeric-corruption kinds: POLLED by the site (maybe_corrupt), which
 #: applies the corruption itself instead of receiving an exception
 _KIND_CORRUPT = ("nan_poison", "cov_corrupt", "weight_zero")
-KINDS = tuple(_KIND_EXC) + tuple(_KIND_SLEEP) + _KIND_CORRUPT
+#: mesh-topology kinds (round 15, mesh-aware serving): POLLED by the
+#: scheduler pump at the ``device.mesh`` site (maybe_device_fault) —
+#: ``device_lost`` marks the rule's ``devices`` range dead (leases
+#: touching them are reaped, capacity shrinks), ``device_degraded``
+#: cordons them (no new placements, existing leases drain naturally).
+#: Nothing raises: losing hardware is a scheduler event, not an
+#: exception on any tenant's thread.
+_KIND_DEVICE = ("device_lost", "device_degraded")
+KINDS = (tuple(_KIND_EXC) + tuple(_KIND_SLEEP) + _KIND_CORRUPT
+         + _KIND_DEVICE)
 
 
 @dataclass
@@ -130,7 +142,9 @@ class FaultRule:
     ``max_fires``: stop after N firings (None = unbounded). ``match``:
     substring that must appear in the probe's ``worker_id`` context (so
     one process-global plan can kill only the "mortal" worker).
-    ``delay_s``: sleep duration for hang/slow/delay kinds.
+    ``delay_s``: sleep duration for hang/slow/delay kinds. ``devices``:
+    for the mesh-topology kinds, which device indices the event hits —
+    ``"3"`` (one device) or ``"4-7"`` (inclusive range).
     """
 
     site: str
@@ -141,6 +155,7 @@ class FaultRule:
     max_fires: int | None = 1
     match: str = ""
     delay_s: float | None = None
+    devices: str = ""
     #: probe / fire counters (mutated by the owning plan, under its lock)
     n_probes: int = field(default=0, compare=False)
     n_fires: int = field(default=0, compare=False)
@@ -152,6 +167,25 @@ class FaultRule:
             )
         if self.every < 1:
             raise ValueError("every must be >= 1")
+        if self.kind in _KIND_DEVICE and not self.devices:
+            raise ValueError(
+                f"fault kind {self.kind!r} needs a devices= option "
+                f"(e.g. devices=3 or devices=4-7)"
+            )
+        if self.devices:
+            self.device_indices()  # validate the spec eagerly
+
+    def device_indices(self) -> list[int]:
+        """The device indices a mesh-topology rule hits (``"3"`` or an
+        inclusive ``"4-7"`` range)."""
+        s = str(self.devices).strip()
+        lo, sep, hi = s.partition("-")
+        if not sep:
+            return [int(s)]
+        lo_i, hi_i = int(lo), int(hi)
+        if hi_i < lo_i:
+            raise ValueError(f"bad devices range {s!r}")
+        return list(range(lo_i, hi_i + 1))
 
 
 #: thread-local fault-domain tag (the serving layer's tenant id); empty
@@ -229,7 +263,7 @@ class FaultPlan:
                         opts[k] = None if v.lower() == "none" else int(v)
                     elif k in ("p", "delay_s"):
                         opts[k] = float(v)
-                    elif k == "match":
+                    elif k in ("match", "devices"):
                         opts[k] = v
                     else:
                         raise ValueError(f"unknown fault option {k!r}")
@@ -238,16 +272,25 @@ class FaultPlan:
             raise ValueError(f"empty fault spec {spec!r}")
         return cls(rules, **kwargs)
 
-    def _fire_locked(self, site: str, corrupt: bool,
+    @staticmethod
+    def _kind_class(kind: str) -> str:
+        if kind in _KIND_CORRUPT:
+            return "corrupt"
+        if kind in _KIND_DEVICE:
+            return "device"
+        return "raise"
+
+    def _fire_locked(self, site: str, kind_class: str,
                      ctx: dict) -> FaultRule | None:
         """Evaluate the matching rules for one probe/poll; rule counters
         only advance for rules of the REQUESTED class (raise/sleep vs
-        corruption), so mixed plans stay deterministic per site."""
+        corruption vs mesh topology), so mixed plans stay deterministic
+        per site."""
         with self._lock:
             for rule in self.rules:
                 if rule.site != site:
                     continue
-                if (rule.kind in _KIND_CORRUPT) is not corrupt:
+                if self._kind_class(rule.kind) != kind_class:
                     continue
                 if rule.match and rule.match not in str(
                         ctx.get("worker_id", "")) \
@@ -274,7 +317,7 @@ class FaultPlan:
     def probe(self, site: str, **ctx) -> None:
         """Evaluate every raise/sleep rule for ``site``; raise/sleep if
         one fires (corruption rules are polled, not probed)."""
-        fired = self._fire_locked(site, False, ctx)
+        fired = self._fire_locked(site, "raise", ctx)
         if fired is None:
             return
         self._metrics.counter(
@@ -290,7 +333,7 @@ class FaultPlan:
     def poll(self, site: str, **ctx) -> str | None:
         """Evaluate the CORRUPTION rules for ``site``; returns the fired
         kind (the caller applies the corruption) or None."""
-        fired = self._fire_locked(site, True, ctx)
+        fired = self._fire_locked(site, "corrupt", ctx)
         if fired is None:
             return None
         self._metrics.counter(
@@ -298,6 +341,19 @@ class FaultPlan:
             "faults fired by the active FaultPlan",
         ).inc()
         return fired.kind
+
+    def poll_device(self, site: str, **ctx) -> dict | None:
+        """Evaluate the MESH-TOPOLOGY rules for ``site``; returns
+        ``{"kind", "devices"}`` (the scheduler applies the loss or
+        cordon) or None."""
+        fired = self._fire_locked(site, "device", ctx)
+        if fired is None:
+            return None
+        self._metrics.counter(
+            FAULTS_INJECTED_TOTAL,
+            "faults fired by the active FaultPlan",
+        ).inc()
+        return {"kind": fired.kind, "devices": fired.device_indices()}
 
     def n_fired(self, site: str | None = None) -> int:
         with self._lock:
@@ -339,3 +395,14 @@ def maybe_corrupt(site: str, **ctx) -> str | None:
     if plan is None:
         return None
     return plan.poll(site, **ctx)
+
+
+def maybe_device_fault(site: str = "device.mesh", **ctx) -> dict | None:
+    """Poll the active plan for a mesh-topology event at ``site``; the
+    serving scheduler applies the returned ``{"kind", "devices"}``
+    (``device_lost`` reaps + shrinks, ``device_degraded`` cordons).
+    None = topology unchanged."""
+    plan = _ACTIVE
+    if plan is None:
+        return None
+    return plan.poll_device(site, **ctx)

@@ -16,12 +16,17 @@ hits a shape-keyed compiled-kernel cache (PR 2's adoption, generalized)
   namespace);
 - :mod:`.admission` — :class:`AdmissionController`: bounded queueing
   with typed backpressure (:class:`AdmissionRejectedError` carrying a
-  measured ``retry_after_s``);
-- :mod:`.scheduler` — :class:`RunScheduler`: slot leasing, orchestrator
-  threads under per-tenant fault scopes, lease-expiry requeue from
-  checkpoints, graceful SIGTERM drain;
-- :mod:`.api` — the ``abc-serve`` HTTP surface (submit/status/stream,
-  ``/metrics`` with per-tenant labels).
+  chip-second-priced ``retry_after_s``);
+- :mod:`.placement` — :class:`SubMeshAllocator` (round 15): buddy
+  allocation of contiguous 1/2/4/8-device sub-meshes with coalescing,
+  width-1 packing, device-loss quarantine and degraded cordons — the
+  ONE sanctioned Mesh/topology site in the package (PLACE001);
+- :mod:`.scheduler` — :class:`RunScheduler`: sub-mesh leasing,
+  orchestrator threads under per-tenant fault scopes, lease-expiry
+  requeue from checkpoints, checkpoint-preemption, device-loss
+  survival, graceful SIGTERM drain;
+- :mod:`.api` — the ``abc-serve`` HTTP surface (submit/status/stream/
+  preempt, ``/metrics`` with per-tenant labels).
 
 The headline contract, chaos-tested on CPU in ``tests/test_serving.py``
 and guarded by the bench ``serve`` lane: a fault injected into tenant A
@@ -30,6 +35,7 @@ tenant B.
 """
 from .admission import AdmissionController, AdmissionRejectedError
 from .api import serve_api
+from .placement import SubMeshAllocator, feasible_widths
 from .scheduler import RunScheduler
 from .tenant import (
     CANCELLED,
@@ -48,6 +54,7 @@ from .tenant import (
 __all__ = [
     "AdmissionController", "AdmissionRejectedError",
     "RunScheduler", "serve_api",
+    "SubMeshAllocator", "feasible_widths",
     "Tenant", "TenantSpec", "MODEL_BUILDERS",
     "QUEUED", "RUNNING", "REQUEUED", "COMPLETED", "FAILED",
     "CANCELLED", "DRAINED", "TERMINAL_STATES",

@@ -1,4 +1,4 @@
-"""Bounded admission with typed backpressure.
+"""Bounded admission with typed backpressure, priced in CHIP-SECONDS.
 
 A serving process protecting millions of users cannot queue unboundedly:
 past the configured depth a submission is REFUSED with
@@ -9,19 +9,43 @@ accumulating latent work that times out after the client stopped
 caring. Admission also validates the spec — a malformed tenant is
 rejected before it costs a queue slot, let alone a device slot.
 
-The retry-after estimate is measured, not guessed: completed runs feed
-an exponentially-weighted per-run wall clock, and the hint is
-``queue_ahead x avg_run_s / n_slots`` (floored at 1 s) — the time until
-a freed slot plausibly reaches a NEW submission.
+Round 15 (mesh-aware serving): queue POSITION stopped being the unit of
+cost the moment slots became sub-meshes — a width-4 tenant ahead of you
+consumes four chips for its whole run, a packed width-1 tenant a
+fraction of one. The backpressure hint is therefore priced in
+chip-seconds over the POOL: completed runs feed an exponentially
+weighted per-run CHIP-second average (wall seconds × lease width), and
+the hint is ``queue_depth × avg_chip_seconds / healthy_chips`` (floored
+at 1 s) — the time until the pool plausibly works off the backlog ahead
+of a new submission. Device loss shrinks ``healthy_chips`` via
+:meth:`AdmissionController.set_capacity`, so the same queue honestly
+costs more after the mesh halves.
+
+Cold start: with ZERO completed runs the EW average is unseeded and a
+measured hint would degenerate to nothing. The first hints are instead
+seeded from the REJECTED spec itself — its generation schedule gives
+the chunk count (``ceil(generations / fused_generations)``), priced at
+:data:`DEFAULT_CHUNK_S` per chunk and scaled by population size — so
+the very first 429 already carries an honest, spec-shaped Retry-After.
 """
 from __future__ import annotations
 
+import math
 import threading
 
 from ..observability.metrics import (
     TENANT_ADMISSIONS_TOTAL,
     TENANT_REJECTIONS_TOTAL,
 )
+
+#: cold-start price of one fused chunk at the reference population —
+#: deliberately conservative (a CPU-ish steady-state chunk; real XLA
+#: compiles cost more once, measured averages take over immediately
+#: after the first completion)
+DEFAULT_CHUNK_S = 2.0
+#: population the per-chunk estimate is calibrated at; bigger
+#: populations scale the cold-start estimate linearly
+REFERENCE_POP = 1000
 
 
 class AdmissionRejectedError(RuntimeError):
@@ -37,24 +61,40 @@ class AdmissionRejectedError(RuntimeError):
         )
 
 
+def spec_chip_seconds_estimate(spec) -> float:
+    """A spec's cold-start chip-second price, from its population
+    schedule: chunks (``ceil(generations / fused_generations)``) ×
+    :data:`DEFAULT_CHUNK_S`, scaled linearly above :data:`REFERENCE_POP`.
+    Chip-seconds, not wall seconds: a sharded run spreads the same work
+    over more chips, it does not shrink it."""
+    gens = max(int(getattr(spec, "generations", 1)), 1)
+    fused = max(int(getattr(spec, "fused_generations", 1)), 1)
+    pop = max(int(getattr(spec, "population_size", REFERENCE_POP)), 2)
+    chunks = math.ceil(gens / fused)
+    return chunks * DEFAULT_CHUNK_S * max(1.0, pop / REFERENCE_POP)
+
+
 class AdmissionController:
     """Validates specs and enforces the bounded-queue contract.
 
     Owned by the scheduler (which reports queue/live occupancy at each
-    ``admit`` call); thread-safe — API handler threads race submissions
-    against the scheduler pump by design.
+    ``admit`` call and completed chip-seconds per run); thread-safe —
+    API handler threads race submissions against the scheduler pump by
+    design.
     """
 
-    def __init__(self, *, max_queued: int = 16, n_slots: int = 1,
-                 clock=None, metrics=None, avg_run_s0: float = 5.0):
+    def __init__(self, *, max_queued: int = 16, n_chips: int = 1,
+                 clock=None, metrics=None):
         from ..observability import NULL_METRICS, SYSTEM_CLOCK
 
         self.max_queued = int(max_queued)
-        self.n_slots = max(int(n_slots), 1)
         self.clock = clock if clock is not None else SYSTEM_CLOCK
         self.metrics = metrics if metrics is not None else NULL_METRICS
         self._lock = threading.Lock()
-        self._avg_run_s = float(avg_run_s0)  # abc-lint: guarded-by=_lock
+        self._n_chips = max(int(n_chips), 1)  # abc-lint: guarded-by=_lock
+        #: EW-averaged chip-seconds per completed run; None until the
+        #: first completion (cold start: spec-seeded hints)
+        self._avg_chip_s: float | None = None  # abc-lint: guarded-by=_lock
         self.admitted_total = 0
         self.rejected_total = 0
 
@@ -72,7 +112,7 @@ class AdmissionController:
                 f"invalid spec: {exc}", retry_after_s=None
             ) from exc
         if queued_now >= self.max_queued:
-            retry = self.retry_after_s(queued_now)
+            retry = self.retry_after_s(queued_now, spec=spec)
             self._reject()
             raise AdmissionRejectedError(
                 f"admission queue full ({queued_now}/{self.max_queued} "
@@ -86,19 +126,36 @@ class AdmissionController:
             "tenant submissions admitted (queued or started)",
         ).inc()
 
-    def retry_after_s(self, queued_now: int) -> float:
-        """Measured backpressure hint: how long until a new submission
-        plausibly reaches a device slot."""
+    def retry_after_s(self, queued_now: int, spec=None) -> float:
+        """Measured backpressure hint in wall seconds: the chip-second
+        backlog ahead of a new submission, worked off by the healthy
+        pool — ``queue_depth × avg_chip_s / healthy_chips``. Before any
+        run has completed the average is seeded from the spec's own
+        schedule (:func:`spec_chip_seconds_estimate`)."""
         with self._lock:
-            avg = self._avg_run_s
-        return max(1.0, (int(queued_now) + 1) * avg / self.n_slots)
+            avg = self._avg_chip_s
+            chips = self._n_chips
+        if avg is None:
+            avg = (spec_chip_seconds_estimate(spec)
+                   if spec is not None else DEFAULT_CHUNK_S)
+        return max(1.0, (int(queued_now) + 1) * avg / chips)
 
-    def note_run_seconds(self, run_s: float) -> None:
-        """Feed one completed run's wall clock into the EW average the
-        retry-after hint derives from."""
-        run_s = max(float(run_s), 0.0)
+    def note_run_seconds(self, run_s: float, chips: int = 1) -> None:
+        """Feed one completed run's cost into the EW average: wall
+        seconds × the width of the sub-mesh it held = chip-seconds."""
+        chip_s = max(float(run_s), 0.0) * max(int(chips), 1)
         with self._lock:
-            self._avg_run_s = 0.7 * self._avg_run_s + 0.3 * run_s
+            if self._avg_chip_s is None:
+                self._avg_chip_s = chip_s
+            else:
+                self._avg_chip_s = 0.7 * self._avg_chip_s + 0.3 * chip_s
+
+    def set_capacity(self, n_chips: int) -> None:
+        """The pool changed size (device loss / restore): the SAME
+        backlog now honestly costs ``old/new`` times as much wall
+        time."""
+        with self._lock:
+            self._n_chips = max(int(n_chips), 1)
 
     def _reject(self) -> None:
         with self._lock:
@@ -114,5 +171,10 @@ class AdmissionController:
                 "max_queued": self.max_queued,
                 "admitted_total": self.admitted_total,
                 "rejected_total": self.rejected_total,
-                "avg_run_s": round(self._avg_run_s, 3),
+                "n_chips": self._n_chips,
+                "avg_chip_s": (
+                    None if self._avg_chip_s is None
+                    else round(self._avg_chip_s, 3)
+                ),
+                "cold_start": self._avg_chip_s is None,
             }

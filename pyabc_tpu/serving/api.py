@@ -16,6 +16,12 @@ pattern (no Flask in this environment) into the serving front door:
                                   ``?since=<seq>`` resumes, the stream
                                   ends when the tenant is terminal)
 - ``POST /api/tenant/<id>/cancel`` cancel (graceful for running runs)
+- ``POST /api/tenant/<id>/preempt`` checkpoint-preempt a running
+                                  tenant: it stops at its next chunk
+                                  boundary, requeues with its
+                                  checkpoint, and resumes on whatever
+                                  sub-mesh is next free (409 when not
+                                  running)
 - ``GET  /api/observability``     the process snapshot — per-tenant
                                   namespaces aggregated side by side
 - ``GET  /metrics``               Prometheus text: the global registry
@@ -78,6 +84,12 @@ def _make_handler(sched: RunScheduler):
                     ok = sched.cancel(tid)
                     return self._json(200 if ok else 404,
                                       {"cancelled": ok, "id": tid})
+                if (self.path.startswith("/api/tenant/")
+                        and self.path.endswith("/preempt")):
+                    tid = self.path[len("/api/tenant/"):-len("/preempt")]
+                    ok = sched.preempt(tid)
+                    return self._json(200 if ok else 409,
+                                      {"preempted": ok, "id": tid})
                 self._json(404, {"error": "not found"})
             except Exception as exc:  # surface as 500, keep serving
                 self._json(500, {"error": repr(exc)[:300]})

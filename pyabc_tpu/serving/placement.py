@@ -1,0 +1,406 @@
+"""Sub-mesh placement: the buddy allocator behind topology-aware slots.
+
+Round 15 (mesh-aware serving): a serving "slot" stops being one opaque
+device and becomes a CONTIGUOUS SUB-MESH of 1/2/4/8 devices. This
+module owns all of the topology:
+
+- :class:`SubMeshAllocator` — a buddy-style allocator over the device
+  line. Width-``2^k`` blocks live at aligned offsets; an allocation
+  splits the smallest sufficient free block down to the requested
+  width, a free merges buddies back up (coalescing), so fragmentation
+  is bounded by the buddy invariant instead of accumulating. Width-1
+  blocks can be SHARED by up to ``packing`` tenants (many small tenants
+  per chip); wider blocks are exclusive (a sharded tenant owns its
+  sub-mesh). Devices can be marked LOST (reaped from capacity —
+  quarantined on free, never re-issued) or DEGRADED (cordoned: existing
+  leases drain naturally, no new placements).
+- :func:`feasible_widths` — the placement policy for a ``sharded=n``
+  request: the PR-9/15 kernel contract makes the reduction a pure
+  function of ``n_shards``, so ANY power-of-two divisor width works
+  bit-identically; the scheduler tries them widest-first.
+- :func:`build_mesh` / :func:`platform_device_count` — the ONE place in
+  ``pyabc_tpu/serving/`` allowed to construct a ``jax.sharding.Mesh``
+  or enumerate devices (abc-lint PLACE001): placement decisions
+  anywhere else would bypass the allocator's accounting exactly the
+  way an unleased run bypasses ISO001.
+
+The allocator is pure bookkeeping with NO lock of its own — the owning
+:class:`~pyabc_tpu.serving.scheduler.RunScheduler` mutates it under its
+scheduler lock, same contract as the resilience ``LeaseTable``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _is_pow2(n: int) -> bool:
+    return n >= 1 and (n & (n - 1)) == 0
+
+
+def _aligned_blocks(lo: int, hi: int) -> list[tuple[int, int]]:
+    """Greedy aligned power-of-two decomposition of ``[lo, hi)`` —
+    the buddy seed for any pool width (power of two or not)."""
+    out = []
+    while lo < hi:
+        size = 1
+        while lo % (2 * size) == 0 and lo + 2 * size <= hi:
+            size *= 2
+        out.append((lo, size))
+        lo += size
+    return out
+
+
+class SubMeshAllocator:
+    """Buddy allocation of contiguous device ranges with shared width-1
+    blocks, loss quarantine and degraded cordons.
+
+    Every device index is, at all times, in exactly ONE of: a free
+    block, a shared width-1 block, an exclusive lease, or the lost set
+    — :meth:`check_invariants` recomputes that partition from scratch
+    and is asserted by the serving tests and the bench ``serve`` lane
+    ("zero leaked/overlapping device ranges").
+    """
+
+    def __init__(self, n_devices: int, *, packing: int = 1):
+        self.n_devices = int(n_devices)
+        if self.n_devices < 1:
+            raise ValueError("need at least one device")
+        self.packing = max(int(packing), 1)
+        #: width -> sorted list of free block offsets
+        self._free: dict[int, list[int]] = {}
+        for lo, size in _aligned_blocks(0, self.n_devices):
+            self._free.setdefault(size, []).append(lo)
+        #: owner -> (lo, width) for exclusive (width >= 2 or unshared) leases
+        self._exclusive: dict[str, tuple[int, int]] = {}
+        #: width-1 block offset -> set of owners sharing it
+        self._shared: dict[int, set[str]] = {}
+        self._owner_shared: dict[str, int] = {}
+        self._lost: set[int] = set()
+        self._degraded: set[int] = set()
+        # lifetime counters (observability)
+        self.allocs_total = 0
+        self.frees_total = 0
+        self.coalesces_total = 0
+        self.devices_lost_total = 0
+
+    # ------------------------------------------------------------ alloc
+    def alloc(self, width: int, owner: str) -> int | None:
+        """Lease a contiguous ``width``-device sub-mesh to ``owner``;
+        returns the base device index, or None when nothing fits now
+        (the tenant stays queued). Width 1 packs into a shared block
+        when ``packing > 1``."""
+        width = int(width)
+        owner = str(owner)
+        if not _is_pow2(width):
+            raise ValueError(f"sub-mesh width must be a power of two, "
+                             f"got {width}")
+        if owner in self._exclusive or owner in self._owner_shared:
+            raise ValueError(f"owner {owner!r} already holds a lease")
+        if width == 1 and self.packing > 1:
+            # densest shared block first: keeps whole devices free for
+            # wide sub-meshes instead of spreading singles around
+            best = None
+            for lo, owners in self._shared.items():
+                if len(owners) >= self.packing or lo in self._degraded:
+                    continue
+                if best is None or len(owners) > len(self._shared[best]):
+                    best = lo
+            if best is not None:
+                self._shared[best].add(owner)
+                self._owner_shared[owner] = best
+                self.allocs_total += 1
+                return best
+            lo = self._carve(1)
+            if lo is None:
+                return None
+            self._shared[lo] = {owner}
+            self._owner_shared[owner] = lo
+            self.allocs_total += 1
+            return lo
+        lo = self._carve(width)
+        if lo is None:
+            return None
+        self._exclusive[owner] = (lo, width)
+        self.allocs_total += 1
+        return lo
+
+    def _carve(self, width: int) -> int | None:
+        """Carve an aligned free block of exactly ``width``, splitting
+        the smallest sufficient larger block buddy-style. Degraded
+        devices are cordoned at sub-block granularity: a half-degraded
+        big block still serves requests that fit its clean half."""
+        found = None  # (size, block_lo, clean_sub_lo)
+        for size in sorted(self._free):
+            if size < width:
+                continue
+            for lo in sorted(self._free[size]):
+                sub = next(
+                    (s for s in range(lo, lo + size, width)
+                     if not self._range_degraded(s, width)), None)
+                if sub is not None:
+                    found = (size, lo, sub)
+                    break
+            if found:
+                break
+        if found is None:
+            return None
+        size, lo, sub = found
+        self._free[size].remove(lo)
+        # split down toward the clean sub-block, freeing the siblings
+        while size > width:
+            size //= 2
+            if sub < lo + size:
+                self._free.setdefault(size, []).append(lo + size)
+            else:
+                self._free.setdefault(size, []).append(lo)
+                lo += size
+        return lo
+
+    def _range_degraded(self, lo: int, size: int) -> bool:
+        return any(d in self._degraded for d in range(lo, lo + size))
+
+    # ------------------------------------------------------------- free
+    def free(self, owner: str) -> None:
+        """Return ``owner``'s sub-mesh; buddies coalesce, lost devices
+        quarantine (they never re-enter a free list)."""
+        owner = str(owner)
+        lo1 = self._owner_shared.pop(owner, None)
+        if lo1 is not None:
+            owners = self._shared[lo1]
+            owners.discard(owner)
+            self.frees_total += 1
+            if not owners:
+                del self._shared[lo1]
+                self._release_range(lo1, 1)
+            return
+        if owner not in self._exclusive:
+            raise KeyError(f"owner {owner!r} holds no lease")
+        lo, width = self._exclusive.pop(owner)
+        self.frees_total += 1
+        self._release_range(lo, width)
+
+    def _release_range(self, lo: int, width: int) -> None:
+        if any(d in self._lost for d in range(lo, lo + width)):
+            # quarantine: only the healthy survivors of the range come
+            # back, device by device (they coalesce with whatever
+            # healthy neighborhood exists)
+            for d in range(lo, lo + width):
+                if d not in self._lost:
+                    self._coalesce(d, 1)
+            return
+        self._coalesce(lo, width)
+
+    def _coalesce(self, lo: int, size: int) -> None:
+        while size < self.n_devices:
+            buddy = lo ^ size
+            free_list = self._free.get(size, [])
+            if buddy not in free_list:
+                break
+            free_list.remove(buddy)
+            lo = min(lo, buddy)
+            size *= 2
+            self.coalesces_total += 1
+        self._free.setdefault(size, []).append(lo)
+
+    # ----------------------------------------------------- device health
+    def mark_lost(self, devices) -> list[str]:
+        """Hard device loss: shrink capacity and quarantine. Free blocks
+        containing a lost device are split down and the dead device
+        removed; leased blocks stay leased (the scheduler reaps those
+        leases and the quarantine happens at free time). Returns the
+        owners whose lease touches a lost device — every one of them
+        must be re-placed."""
+        affected: set[str] = set()
+        for d in sorted({int(x) for x in devices}):
+            if d < 0 or d >= self.n_devices or d in self._lost:
+                continue
+            self._lost.add(d)
+            self._degraded.discard(d)
+            self.devices_lost_total += 1
+            blk = self._free_block_containing(d)
+            if blk is not None:
+                lo, size = blk
+                self._free[size].remove(lo)
+                # split down to isolate d; re-add the healthy siblings
+                while size > 1:
+                    size //= 2
+                    if d < lo + size:
+                        self._free.setdefault(size, []).append(lo + size)
+                    else:
+                        self._free.setdefault(size, []).append(lo)
+                        lo += size
+                continue  # d itself (width 1) is quarantined: not re-added
+            for owner, (lo, width) in self._exclusive.items():
+                if lo <= d < lo + width:
+                    affected.add(owner)
+            owners = self._shared.get(d)
+            if owners:
+                affected.update(owners)
+        return sorted(affected)
+
+    def mark_degraded(self, devices) -> None:
+        """Cordon: no NEW placements on these devices; existing leases
+        drain naturally (the soft half of device loss)."""
+        for d in devices:
+            d = int(d)
+            if 0 <= d < self.n_devices and d not in self._lost:
+                self._degraded.add(d)
+
+    def restore(self, devices) -> None:
+        """Bring devices back (repaired / re-attached): lost ones
+        re-enter the free pool and coalesce, degraded cordons lift."""
+        for d in sorted({int(x) for x in devices}):
+            if d < 0 or d >= self.n_devices:
+                continue
+            self._degraded.discard(d)
+            if d in self._lost:
+                self._lost.remove(d)
+                self._coalesce(d, 1)
+
+    def _free_block_containing(self, d: int) -> tuple[int, int] | None:
+        for size, los in self._free.items():
+            for lo in los:
+                if lo <= d < lo + size:
+                    return (lo, size)
+        return None
+
+    # ------------------------------------------------------------ views
+    def healthy_count(self) -> int:
+        return self.n_devices - len(self._lost)
+
+    def free_device_count(self) -> int:
+        return sum(size * len(los) for size, los in self._free.items())
+
+    def lease_of(self, owner: str) -> tuple[int, int] | None:
+        """(lo, width) of ``owner``'s lease, shared blocks width 1."""
+        owner = str(owner)
+        if owner in self._owner_shared:
+            return (self._owner_shared[owner], 1)
+        return self._exclusive.get(owner)
+
+    def widest_free(self) -> int:
+        """Largest sub-mesh allocatable RIGHT NOW (0 = pool exhausted)."""
+        widths = [
+            size for size, los in self._free.items()
+            if any(not self._range_degraded(lo, size) for lo in los)
+        ]
+        return max(widths, default=0)
+
+    def stats(self) -> dict:
+        free_devices = self.free_device_count()
+        return {
+            "n_devices": self.n_devices,
+            "packing": self.packing,
+            "healthy_devices": self.healthy_count(),
+            "lost_devices": sorted(self._lost),
+            "degraded_devices": sorted(self._degraded),
+            "free_devices": free_devices,
+            "widest_free": self.widest_free(),
+            "free_blocks": {
+                size: sorted(los)
+                for size, los in sorted(self._free.items()) if los
+            },
+            "exclusive_leases": {
+                owner: {"lo": lo, "width": w}
+                for owner, (lo, w) in sorted(self._exclusive.items())
+            },
+            "shared_blocks": {
+                lo: sorted(owners)
+                for lo, owners in sorted(self._shared.items())
+            },
+            "allocs_total": self.allocs_total,
+            "frees_total": self.frees_total,
+            "coalesces_total": self.coalesces_total,
+            "devices_lost_total": self.devices_lost_total,
+        }
+
+    def check_invariants(self) -> list[str]:
+        """Recompute the device partition from scratch; returns every
+        violation found (leaked, overlapping or double-booked ranges).
+        Empty list == the allocator's books balance exactly."""
+        problems: list[str] = []
+        seen: dict[int, str] = {}
+
+        def claim(d: int, what: str) -> None:
+            if d in seen:
+                problems.append(
+                    f"device {d} double-booked: {seen[d]} and {what}")
+            seen[d] = what
+
+        for size, los in self._free.items():
+            if not _is_pow2(size):
+                problems.append(f"non-power-of-two free size {size}")
+            for lo in los:
+                if lo % size:
+                    problems.append(f"misaligned free block ({lo},{size})")
+                for d in range(lo, lo + size):
+                    claim(d, f"free[{lo},{size})")
+        for owner, (lo, width) in self._exclusive.items():
+            if lo % width:
+                problems.append(
+                    f"misaligned lease {owner}=({lo},{width})")
+            for d in range(lo, lo + width):
+                claim(d, f"lease:{owner}")
+        for lo, owners in self._shared.items():
+            if not owners:
+                problems.append(f"empty shared block at {lo}")
+            if len(owners) > self.packing:
+                problems.append(
+                    f"shared block {lo} overpacked: {sorted(owners)}")
+            claim(lo, f"shared:{sorted(owners)}")
+        for d in self._lost:
+            claim(d, "lost")
+        missing = [d for d in range(self.n_devices) if d not in seen]
+        if missing:
+            problems.append(f"leaked devices (in no range): {missing}")
+        out_of_range = [d for d in seen if d < 0 or d >= self.n_devices]
+        if out_of_range:
+            problems.append(f"devices out of range: {sorted(out_of_range)}")
+        return problems
+
+
+def feasible_widths(sharded: int | None) -> list[int]:
+    """Candidate sub-mesh widths for a tenant, widest first.
+
+    ``sharded=n``: every power-of-two divisor of ``n`` (the kernel's
+    width-independence contract — ``n`` shards run bit-identically at
+    any divisor width, down to virtual shards on one device).
+    Unsharded: width 1 only."""
+    if not sharded or int(sharded) <= 1:
+        return [1]
+    n = int(sharded)
+    if not _is_pow2(n):
+        raise ValueError(f"sharded={n} must be a power of two")
+    out = []
+    w = n
+    while w >= 1:
+        out.append(w)
+        w //= 2
+    return out
+
+
+def platform_device_count() -> int:
+    """How many real devices this process sees (the serving pool's
+    default width). The one sanctioned enumeration site (PLACE001)."""
+    import jax
+
+    return len(jax.devices())
+
+
+def build_mesh(lo: int, width: int, axis_name: str = "particles"):
+    """The jax Mesh over physical devices ``[lo, lo+width)`` — or None
+    when the lease is logical-only (width 1, or a pool wider than the
+    platform: the tenant then runs its shards VIRTUALLY on one device,
+    bit-identical by the kernel contract). The one sanctioned Mesh
+    construction site in the serving layer (PLACE001)."""
+    if int(width) <= 1:
+        return None
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if int(lo) + int(width) > len(devs):
+        return None
+    # abc-lint: disable=SYNC001 np.asarray reshapes the host-side Device LIST for Mesh; no array leaves a device
+    return Mesh(np.asarray(devs[int(lo):int(lo) + int(width)]),
+                axis_names=(axis_name,))

@@ -1,15 +1,41 @@
 """RunScheduler — admit, queue and supervise concurrent ABC-SMC runs.
 
-The serving layer's core (round 14): one process, ``n_slots`` device
-slots, MANY tenants. Every live tenant is a LEASED run — the slot
-handout reuses :class:`~pyabc_tpu.resilience.lease.LeaseTable`
-semantics verbatim (one slot per tenant, deadlines on the injected
-clock, any orchestrator heartbeat refreshes): an orchestrator thread
-that dies hard (injected kill — no report, no goodbye) or hangs past
-the lease timeout is PRESUMED DEAD, its device slot is reclaimed, and
-the tenant is requeued to resume from its PR-5 checkpoint — or failed
-with its PR-6 health trail once the requeue budget is spent. Survivor
-tenants never notice; that containment is chaos-tested on CPU.
+The serving layer's core (round 14, made topology-aware in round 15):
+one process, a DEVICE POOL, MANY tenants. Every live tenant is a LEASED
+run — the handout reuses :class:`~pyabc_tpu.resilience.lease.LeaseTable`
+semantics verbatim (deadlines on the injected clock, any orchestrator
+heartbeat refreshes): an orchestrator thread that dies hard (injected
+kill — no report, no goodbye) or hangs past the lease timeout is
+PRESUMED DEAD, its sub-mesh is reclaimed, and the tenant is requeued to
+resume from its PR-5 checkpoint — or failed with its PR-6 health trail
+once the requeue budget is spent. Survivor tenants never notice; that
+containment is chaos-tested on CPU.
+
+Mesh-aware serving (round 15) — three additions on the same spine:
+
+- SUB-MESH LEASES: a slot is a contiguous sub-mesh of 1/2/4/8 devices
+  from :class:`~pyabc_tpu.serving.placement.SubMeshAllocator` (buddy
+  allocation, coalescing on free, width-1 packing). A ``sharded=n``
+  tenant is granted the WIDEST free power-of-two divisor of ``n`` —
+  the PR-9/15 kernel contract makes the reduction a pure function of
+  the shard count, so any width is bit-identical, down to virtual
+  shards on one device. Admission prices backpressure in chip-seconds
+  over the healthy pool, not queue position.
+- CHECKPOINT-PREEMPTION: :meth:`preempt` (or the auto policy when a
+  queued tenant sits unplaceable past ``preempt_queue_wait_s``) asks a
+  big tenant to stop at its next chunk boundary via the PR-10 graceful
+  path; instead of landing DRAINED it REQUEUES with its checkpoint and
+  resumes — bit-identical by construction — on whatever sub-mesh is
+  free next. Preemption drains fragmentation and admits latency-
+  sensitive small tenants without ever losing a big tenant's work.
+- DEVICE-LOSS SURVIVAL: a ``device_lost`` event (the polled
+  ``device.mesh`` fault site, or :meth:`mark_devices_lost` from real
+  monitoring) marks devices dead: every lease touching them is reaped,
+  the allocator quarantines the devices (capacity shrinks, admission
+  reprices), and the affected tenants requeue WITHOUT consuming their
+  requeue budget — an infrastructure fault is not the tenant's fault —
+  to resume on a different-width sub-mesh. Losing half the mesh
+  degrades throughput, never correctness.
 
 Fault domains: each tenant's run gets its own orchestrator thread under
 ``fault_scope(tenant_id)`` (a process-global FaultPlan rule with
@@ -44,11 +70,17 @@ from ..observability import (
     unregister_tenant_source,
 )
 from ..observability.metrics import (
+    DEVICES_LOST_TOTAL,
+    SUBMESH_DEVICES_FREE_GAUGE,
+    SUBMESH_DEVICES_HEALTHY_GAUGE,
+    SUBMESH_WIDEST_FREE_GAUGE,
     TENANT_COMPLETED_TOTAL,
+    TENANT_DEVICE_LOSS_REQUEUES_TOTAL,
     TENANT_DRAINS_TOTAL,
     TENANT_FAILURES_TOTAL,
     TENANT_KERNEL_CACHE_HITS_TOTAL,
     TENANT_KERNEL_CACHE_MISSES_TOTAL,
+    TENANT_PREEMPTIONS_TOTAL,
     TENANT_REQUEUES_TOTAL,
     TENANTS_LIVE_GAUGE,
     TENANTS_QUEUED_GAUGE,
@@ -56,6 +88,7 @@ from ..observability.metrics import (
 from ..resilience.lease import LeaseTable
 from ..storage import WriterPool
 from ..utils.xla_cache import KernelCache
+from . import placement
 from .admission import AdmissionController, AdmissionRejectedError
 from .tenant import (
     CANCELLED,
@@ -84,16 +117,38 @@ class RunScheduler:
     #: only bounds HANG detection.
     DEFAULT_LEASE_TIMEOUT_S = 60.0
 
-    def __init__(self, n_slots: int = 1, *, max_queued: int = 16,
+    def __init__(self, n_slots: int = 1, *, n_devices: int | None = None,
+                 packing: int = 1, max_queued: int = 16,
                  lease_timeout_s: float = DEFAULT_LEASE_TIMEOUT_S,
                  max_requeues: int = 1,
+                 preempt_queue_wait_s: float | None = None,
                  base_dir: str | None = None, clock=None, metrics=None,
                  writer_threads: int = 2, kernel_cache_entries: int = 8,
                  tick_s: float = 0.05, max_terminal_tenants: int = 256):
         self.clock = clock if clock is not None else SYSTEM_CLOCK
         self.metrics = metrics if metrics is not None else global_metrics()
-        self.n_slots = max(int(n_slots), 1)
+        #: the device pool the allocator manages. ``n_devices`` sizes it
+        #: explicitly (pass ``placement.platform_device_count()`` to
+        #: serve the real platform); the legacy ``n_slots`` shorthand
+        #: sizes a pool of that many width-1-equivalent devices —
+        #: single-device deployments keep their exact pre-round-15
+        #: concurrency semantics. Leases beyond the PHYSICAL device
+        #: count (or width 1) run their shards virtually — bit-identical
+        #: by the kernel's width-independence contract.
+        pool = int(n_devices) if n_devices is not None else max(
+            int(n_slots), 1)
+        self.allocator = placement.SubMeshAllocator(
+            pool, packing=max(int(packing), 1))
+        self.packing = self.allocator.packing
+        #: width-1-equivalent concurrency, kept for API/status compat
+        self.n_slots = pool * self.packing
         self.max_requeues = int(max_requeues)
+        #: auto-preemption: a queued tenant unplaceable for this long
+        #: triggers a checkpoint-preemption of the widest running tenant
+        #: (None = only explicit ``preempt()`` calls preempt)
+        self.preempt_queue_wait_s = (
+            None if preempt_queue_wait_s is None
+            else float(preempt_queue_wait_s))
         self.tick_s = float(tick_s)
         #: terminal tenants retained for status queries; beyond this the
         #: oldest-finished are evicted (records, event rings, private
@@ -108,10 +163,12 @@ class RunScheduler:
         os.makedirs(self.base_dir, exist_ok=True)
 
         self.admission = AdmissionController(
-            max_queued=max_queued, n_slots=self.n_slots, clock=self.clock,
+            max_queued=max_queued, n_chips=pool, clock=self.clock,
             metrics=self.metrics,
         )
-        #: run-level leases: slot index leased to tenant id; heartbeats
+        #: run-level leases: synthetic unique slot ids leased per tenant
+        #: (device RANGES live in the allocator; packed width-1 tenants
+        #: share devices, so lease slots must not collide); heartbeats
         #: come from the tenant's per-chunk callback
         self.leases = LeaseTable(self.clock, timeout_s=lease_timeout_s)
         self.kernel_cache = KernelCache(max_entries=kernel_cache_entries)
@@ -121,8 +178,9 @@ class RunScheduler:
         self._wake = threading.Condition(self._lock)
         self._tenants: dict[str, Tenant] = {}  # abc-lint: guarded-by=_lock
         self._queue: deque = deque()  # abc-lint: guarded-by=_lock
-        self._free_slots: list[int] = list(range(self.n_slots))  # abc-lint: guarded-by=_lock
-        self._slot_of: dict[str, int] = {}  # abc-lint: guarded-by=_lock
+        #: tenant id -> synthetic lease slot id of the current attempt
+        self._lease_slot_of: dict[str, int] = {}  # abc-lint: guarded-by=_lock
+        self._lease_seq = itertools.count()
         self._reports: deque = deque()  # abc-lint: guarded-by=_lock
         #: terminal tenant ids, oldest-finished first (eviction order)
         self._terminal_order: deque = deque()  # abc-lint: guarded-by=_lock
@@ -130,6 +188,7 @@ class RunScheduler:
         self._draining = False
         self._shutdown = False
         self.stale_reports_discarded = 0
+        self.devices_lost_total = 0
         self._pump = threading.Thread(
             target=self._pump_loop, daemon=True, name="abc-serve-pump")
         self._pump.start()
@@ -148,7 +207,7 @@ class RunScheduler:
                     retry_after_s=None,
                 )
             queued_now = len(self._queue)
-            live_now = len(self._slot_of)
+            live_now = len(self._lease_slot_of)
             self.admission.admit(
                 spec, queued_now=queued_now, live_now=live_now)
             tid = (str(tenant_id) if tenant_id is not None
@@ -194,6 +253,30 @@ class RunScheduler:
                 if tenant.abc is not None:
                     tenant.abc.request_graceful_stop()
                 tenant.record_event("cancel_requested")
+            return True
+
+    def preempt(self, tenant_id: str) -> bool:
+        """Checkpoint-preempt a RUNNING tenant: it stops at its next
+        chunk boundary through the graceful path (flush + checkpoint,
+        bit-identical by construction), REQUEUES, and resumes on
+        whatever sub-mesh is free when its turn comes — possibly a
+        different width (the kernel's width-independence contract).
+        Frees its sub-mesh to drain fragmentation or admit latency-
+        sensitive small tenants. Returns False for tenants not
+        currently running."""
+        with self._lock:
+            tenant = self._tenants.get(str(tenant_id))
+            if tenant is None or tenant.state != RUNNING:
+                return False
+            if tenant.cancel_requested or tenant.preempt_requested:
+                return False
+            tenant.preempt_requested = True
+            tenant._preempt_t0 = self.clock.now()
+            if tenant.abc is not None:
+                tenant.abc.request_graceful_stop()
+            tenant.record_event(
+                "preempt_requested",
+                width=tenant.submesh_width, lo=tenant.submesh_lo)
             return True
 
     # -------------------------------------------------------------- drain
@@ -247,18 +330,100 @@ class RunScheduler:
         with self._lock:
             tenants = [t.to_status() for t in self._tenants.values()]
             queue = list(self._queue)
-            free = len(self._free_slots)
+            place = self.allocator.stats()
         return {
             "n_slots": self.n_slots,
-            "free_slots": free,
+            "free_slots": place["free_devices"] * self.packing,
             "queue": queue,
             "draining": self._draining,
             "tenants": tenants,
+            "placement": place,
+            "devices_lost_total": int(self.devices_lost_total),
             "leases": self.leases.stats(),
             "admission": self.admission.stats(),
             "kernel_cache": self.kernel_cache.stats(),
             "stale_reports_discarded": int(self.stale_reports_discarded),
         }
+
+    # --------------------------------------------------- device health
+    def mark_devices_lost(self, devices) -> list[str]:
+        """Hard mesh loss (real monitoring or the injected
+        ``device_lost`` fault): quarantine the devices, reap every
+        lease touching them, requeue the affected tenants (their
+        requeue budget untouched — infrastructure faults are not the
+        tenant's fault) and reprice admission on the shrunken pool.
+        Returns the affected tenant ids."""
+        with self._lock:
+            return self._apply_device_loss_locked(devices)
+
+    def mark_devices_degraded(self, devices) -> None:
+        """Soft cordon: no NEW placements on these devices; existing
+        leases drain naturally."""
+        with self._lock:
+            self.allocator.mark_degraded(devices)
+            self._set_occupancy_gauges_locked()
+
+    def _apply_device_loss_locked(self, devices) -> list[str]:
+        devices = sorted({int(d) for d in devices})
+        before = self.allocator.healthy_count()
+        affected = self.allocator.mark_lost(devices)
+        n_lost = before - self.allocator.healthy_count()
+        if n_lost:
+            self.devices_lost_total += n_lost
+            self.metrics.counter(
+                DEVICES_LOST_TOTAL,
+                "devices marked lost (mesh loss: capacity shrunk, "
+                "leases reaped)",
+            ).inc(n_lost)
+        self.admission.set_capacity(self.allocator.healthy_count())
+        t_loss = self.clock.now()
+        for tid in affected:
+            tenant = self._tenants.get(tid)
+            if tenant is None or tenant.state != RUNNING:
+                continue
+            tenant.record_event(
+                "device_lost", devices=devices,
+                width=tenant.submesh_width, lo=tenant.submesh_lo)
+            tenant._device_loss_t0 = t_loss
+            # stale-ify the attempt (a thread still computing on "lost"
+            # hardware reports into a bumped epoch and is discarded)
+            # and ask it to stop at its next chunk boundary
+            tenant.epoch += 1
+            if tenant.abc is not None:
+                tenant.abc.request_graceful_stop()
+            self._release_placement_locked(tenant)
+            if self._draining:
+                self._finish_locked(
+                    tenant, FAILED, error="device lost during drain")
+                continue
+            tenant.device_loss_requeues += 1
+            tenant.state = REQUEUED
+            tenant.abc = None
+            self._queue.append(tenant.id)
+            tenant.record_event("requeued", attempt=tenant.attempt,
+                                cause="device_lost")
+            self.metrics.counter(
+                TENANT_DEVICE_LOSS_REQUEUES_TOTAL,
+                "tenants requeued because their sub-mesh lost a device "
+                "(requeue budget untouched)",
+            ).inc()
+        self._set_occupancy_gauges_locked()
+        self._wake.notify_all()
+        return affected
+
+    def _poll_device_faults_locked(self) -> None:
+        """The deterministic ``device.mesh`` chaos site: the pump polls
+        the active FaultPlan every tick, so mesh loss is injectable on
+        CPU exactly like every other fault kind."""
+        from ..resilience.faults import maybe_device_fault
+
+        ev = maybe_device_fault("device.mesh")
+        if ev is None:
+            return
+        if ev["kind"] == "device_lost":
+            self._apply_device_loss_locked(ev["devices"])
+        else:  # device_degraded
+            self.allocator.mark_degraded(ev["devices"])
 
     # ------------------------------------------------------------ pump
     def _pump_loop(self) -> None:
@@ -268,8 +433,10 @@ class RunScheduler:
                 if self._shutdown:
                     return
                 self._drain_reports_locked()
+                self._poll_device_faults_locked()
                 self._reap_leases_locked()
                 self._start_queued_locked()
+                self._maybe_auto_preempt_locked()
                 self._set_occupancy_gauges_locked()
                 self._wake.wait(timeout=self.tick_s)
 
@@ -287,23 +454,53 @@ class RunScheduler:
                 tenant.record_event("stale_report_discarded",
                                     outcome=outcome, epoch=epoch)
                 continue
-            self._release_slot_locked(tenant)
+            width = tenant.submesh_width or 1
+            self._release_placement_locked(tenant)
             run_s = payload.get("run_s", 0.0)
             tenant.run_s += run_s
-            self.admission.note_run_seconds(run_s)
+            # chip-seconds: wall time × the sub-mesh width it held
+            self.admission.note_run_seconds(run_s, chips=width)
             if outcome == COMPLETED:
                 tenant.result = payload.get("result")
                 self._finish_locked(tenant, COMPLETED)
             elif outcome == DRAINED:
-                state = (CANCELLED
-                         if getattr(tenant, "cancel_requested", False)
-                         else DRAINED)
-                self._finish_locked(tenant, state,
-                                    error=payload.get("error"))
+                if (tenant.preempt_requested
+                        and not getattr(tenant, "cancel_requested", False)
+                        and not self._draining):
+                    self._requeue_preempted_locked(tenant)
+                else:
+                    state = (CANCELLED
+                             if getattr(tenant, "cancel_requested", False)
+                             else DRAINED)
+                    self._finish_locked(tenant, state,
+                                        error=payload.get("error"))
             else:  # failed
                 tenant.health_trail = payload.get("trail") or []
                 self._finish_locked(tenant, FAILED,
                                     error=payload.get("error"))
+
+    def _requeue_preempted_locked(self, tenant: Tenant) -> None:
+        """A checkpoint-preempted tenant is NOT terminal: it requeues
+        with its checkpoint (requeue budget untouched) and will resume
+        on whatever sub-mesh is free when its turn comes."""
+        tenant.preempt_requested = False
+        tenant.preemptions += 1
+        tenant.state = REQUEUED
+        tenant.abc = None
+        self._queue.append(tenant.id)
+        tenant.record_event("preempted", attempt=tenant.attempt)
+        if tenant._preempt_t0 is not None:
+            tenant.tracer.record_span(
+                "preempt.drain", tenant._preempt_t0, self.clock.now(),
+                thread="scheduler",
+            )
+            tenant._preempt_t0 = None
+        self.metrics.counter(
+            TENANT_PREEMPTIONS_TOTAL,
+            "tenants checkpoint-preempted at a chunk boundary and "
+            "requeued",
+        ).inc()
+        self._wake.notify_all()
 
     def _reap_leases_locked(self) -> None:
         # hard-dead orchestrator threads (injected kill: no report, no
@@ -330,7 +527,8 @@ class RunScheduler:
             tenant.epoch += 1
             if tenant.abc is not None:
                 tenant.abc.request_graceful_stop()
-            self._release_slot_locked(tenant, lease_already_gone=True)
+            self._release_placement_locked(tenant,
+                                           lease_already_gone=True)
             if self._draining:
                 self._finish_locked(
                     tenant, FAILED,
@@ -355,26 +553,51 @@ class RunScheduler:
 
     def _start_queued_locked(self) -> None:
         i = 0
-        while self._free_slots and i < len(self._queue):
+        now = self.clock.now()
+        while i < len(self._queue):
             tid = self._queue[i]
             tenant = self._tenants[tid]
             # a requeued tenant must not race its own stale thread on
             # the db/checkpoint: wait for that thread to exit first (the
-            # slot stays free for OTHER tenants meanwhile — no head-of-
-            # line blocking: we skip, not stall)
+            # capacity stays free for OTHER tenants meanwhile — no
+            # head-of-line blocking: we skip, not stall)
             if tenant.thread is not None and tenant.thread.is_alive():
                 i += 1
                 continue
+            # sub-mesh placement: widest free power-of-two divisor of
+            # the requested shard count (any width is bit-identical by
+            # the kernel contract), width 1 for unsharded tenants
+            lo = width = None
+            for w in placement.feasible_widths(tenant.spec.sharded):
+                got = self.allocator.alloc(w, tid)
+                if got is not None:
+                    lo, width = got, w
+                    break
+            if lo is None:
+                if tenant._unplaced_since is None:
+                    tenant._unplaced_since = now
+                i += 1
+                continue
+            tenant._unplaced_since = None
             del self._queue[i]
-            slot = self._free_slots.pop(0)
-            self._slot_of[tid] = slot
+            slot = next(self._lease_seq)
+            self._lease_slot_of[tid] = slot
             self.leases.grant(tid, slot, slot + 1)
+            tenant.submesh_lo, tenant.submesh_width = lo, width
+            tenant.widths.append(width)
+            if tenant._device_loss_t0 is not None:
+                # the device-loss recovery span: loss event -> re-placed
+                tenant.tracer.record_span(
+                    "device_loss.replace", tenant._device_loss_t0, now,
+                    thread="scheduler",
+                )
+                tenant._device_loss_t0 = None
             tenant.state = RUNNING
             tenant.attempt += 1
             epoch = tenant.epoch
             if tenant.started_at is None:
                 tenant.started_at = self.clock.now()
-            tenant.record_event("started", slot=slot,
+            tenant.record_event("started", lo=lo, width=width,
                                 attempt=tenant.attempt)
             tenant.thread = threading.Thread(
                 target=self._run_tenant_attempt,
@@ -383,14 +606,51 @@ class RunScheduler:
             )
             tenant.thread.start()
 
-    def _release_slot_locked(self, tenant: Tenant,
-                             lease_already_gone: bool = False) -> None:
-        slot = self._slot_of.pop(tenant.id, None)
+    def _maybe_auto_preempt_locked(self) -> None:
+        """The preemption POLICY: when a queued tenant has been
+        unplaceable past ``preempt_queue_wait_s`` (pool fully leased or
+        fragmented), checkpoint-preempt the widest running tenant — its
+        freed block coalesces, the waiter places, and the preempted
+        tenant resumes from its checkpoint later. One in-flight
+        preemption at a time (no mass eviction)."""
+        if self.preempt_queue_wait_s is None or self._draining:
+            return
+        if any(t.preempt_requested for t in self._tenants.values()):
+            return  # one at a time; wait for it to drain
+        now = self.clock.now()
+        starved = [
+            t for tid in self._queue
+            if (t := self._tenants[tid])._unplaced_since is not None
+            and now - t._unplaced_since >= self.preempt_queue_wait_s
+        ]
+        if not starved:
+            return
+        victims = [
+            t for t in self._tenants.values()
+            if t.state == RUNNING and not t.cancel_requested
+            and t.submesh_width is not None
+        ]
+        if not victims:
+            return
+        # widest first (frees the most coalescable capacity), oldest
+        # attempt as the tiebreak (most progress already checkpointed)
+        victim = max(victims,
+                     key=lambda t: (t.submesh_width, -t.attempt))
+        self.preempt(victim.id)
+
+    def _release_placement_locked(self, tenant: Tenant,
+                                  lease_already_gone: bool = False
+                                  ) -> None:
+        slot = self._lease_slot_of.pop(tenant.id, None)
         if slot is None:
             return
         if not lease_already_gone:
             self.leases.note_delivery(slot)
-        self._free_slots.append(slot)
+        try:
+            self.allocator.free(tenant.id)
+        except KeyError:
+            pass  # defensively idempotent (double release)
+        tenant.submesh_lo = tenant.submesh_width = None
 
     def _dequeue_locked(self, tid: str) -> None:
         try:
@@ -444,12 +704,25 @@ class RunScheduler:
     def _set_occupancy_gauges_locked(self) -> None:
         self.metrics.gauge(
             TENANTS_LIVE_GAUGE,
-            "tenants currently holding a device slot",
-        ).set(len(self._slot_of))
+            "tenants currently holding a sub-mesh lease",
+        ).set(len(self._lease_slot_of))
         self.metrics.gauge(
             TENANTS_QUEUED_GAUGE,
-            "tenants admitted and waiting for a device slot",
+            "tenants admitted and waiting for a sub-mesh",
         ).set(len(self._queue))
+        place = self.allocator
+        self.metrics.gauge(
+            SUBMESH_DEVICES_HEALTHY_GAUGE,
+            "healthy devices in the serving pool",
+        ).set(place.healthy_count())
+        self.metrics.gauge(
+            SUBMESH_DEVICES_FREE_GAUGE,
+            "devices currently in free blocks",
+        ).set(place.free_device_count())
+        self.metrics.gauge(
+            SUBMESH_WIDEST_FREE_GAUGE,
+            "widest contiguous sub-mesh allocatable right now",
+        ).set(place.widest_free())
 
     # ------------------------------------------- the leased run (ISO001)
     def _heartbeat(self, tenant: Tenant, epoch: int) -> None:
@@ -472,12 +745,13 @@ class RunScheduler:
         with self._lock:
             if epoch != tenant.epoch:
                 return
-            # re-assert an acknowledged stop (idempotent): a cancel or
-            # drain that raced run() entry — which clears any pre-run
-            # stop request — would otherwise be lost and the run would
-            # land COMPLETED despite the ack
+            # re-assert an acknowledged stop (idempotent): a cancel,
+            # preempt or drain that raced run() entry — which clears any
+            # pre-run stop request — would otherwise be lost and the run
+            # would land COMPLETED despite the ack
             run = (tenant.abc
-                   if tenant.cancel_requested or self._draining
+                   if (tenant.cancel_requested or tenant.preempt_requested
+                       or self._draining)
                    else None)
         if run is not None:
             run.request_graceful_stop()
@@ -505,9 +779,23 @@ class RunScheduler:
         with fault_scope(tenant.id):
             try:
                 built = tenant.spec.abcsmc_kwargs()
+                # sub-mesh placement -> kernel execution: a physical
+                # mesh over the leased devices when the platform has
+                # them (width > 1), else the shards run VIRTUALLY on
+                # one device — either way the SAME n-shard reduction,
+                # bit-identical across attempts at different widths
+                place_kwargs: dict = {}
+                if tenant.spec.sharded:
+                    with self._lock:
+                        lo, width = tenant.submesh_lo, tenant.submesh_width
+                    place_kwargs = {
+                        "sharded": int(tenant.spec.sharded),
+                        "mesh": placement.build_mesh(lo or 0, width or 1),
+                    }
                 abc = ABCSMC(
                     tracer=tenant.tracer, metrics=tenant.metrics,
                     checkpoint_path=tenant.checkpoint_path,
+                    **place_kwargs,
                     **built["kwargs"],
                 )
                 self._heartbeat(tenant, epoch)  # setup milestone: built
@@ -546,6 +834,7 @@ class RunScheduler:
                     # any pre-run stop request at entry.
                     stop_now = (epoch == tenant.epoch
                                 and (tenant.cancel_requested
+                                     or tenant.preempt_requested
                                      or self._draining))
                 if stop_now:
                     self._report(

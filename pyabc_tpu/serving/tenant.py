@@ -112,6 +112,14 @@ class TenantSpec:
     seed: int = 0
     fused_generations: int = 4
     data_seed: int = 123
+    #: sharded fused sampling (mesh-aware serving): run this many shards
+    #: (a power of two >= 2), mapped to a CONTIGUOUS SUB-MESH lease by
+    #: the scheduler. The reduction is a pure function of the shard
+    #: count, so placement may grant ANY divisor width — 4 shards on 4
+    #: chips, 2 chips, or virtually on 1 — and a preempted/requeued
+    #: tenant resumes bit-identical on whatever width is free next.
+    #: None = unsharded (a width-1 slot, packable per chip).
+    sharded: int | None = None
     #: per-particle sumstat retention. Default True: lease-expiry
     #: REQUEUE resumes via History `load()`, whose adaptive-state
     #: restore reads the last stored generation's sum stats — a tenant
@@ -127,7 +135,7 @@ class TenantSpec:
     #: with one of these is an admission-time validation error
     RESERVED_OVERRIDES = frozenset({
         "tracer", "metrics", "checkpoint_path", "seed",
-        "population_size", "fused_generations",
+        "population_size", "fused_generations", "mesh", "sharded",
     })
 
     def validate(self) -> None:
@@ -142,6 +150,12 @@ class TenantSpec:
             raise ValueError("generations must be >= 1")
         if int(self.fused_generations) < 1:
             raise ValueError("fused_generations must be >= 1")
+        if self.sharded is not None:
+            n = int(self.sharded)
+            if n < 2 or n & (n - 1):
+                raise ValueError(
+                    "sharded must be a power of two >= 2 (or None for "
+                    "an unsharded width-1 tenant)")
         bad = self.RESERVED_OVERRIDES & set(self.abcsmc_overrides)
         if bad:
             raise ValueError(
@@ -174,6 +188,8 @@ class TenantSpec:
             "seed": int(self.seed),
             "fused_generations": int(self.fused_generations),
             "data_seed": int(self.data_seed),
+            "sharded": (None if self.sharded is None
+                        else int(self.sharded)),
             "store_sum_stats": self.store_sum_stats,
             "minimum_epsilon": self.minimum_epsilon,
             "max_walltime_s": self.max_walltime_s,
@@ -226,6 +242,27 @@ class Tenant:
         self.health_trail: list[dict] = []
         self.kernel_cache_hit: bool | None = None
         self.cancel_requested = False
+        #: checkpoint-preemption: the scheduler asked this tenant to
+        #: stop at its next chunk boundary and REQUEUE (drain
+        #: fragmentation / admit a latency-sensitive small tenant);
+        #: unlike cancel/drain the stop is not terminal
+        self.preempt_requested = False
+        self.preemptions = 0
+        #: scheduler bookkeeping: when the preempt was requested (span
+        #: start) / since when the queued tenant has been unplaceable
+        #: (auto-preemption trigger)
+        self._preempt_t0: float | None = None
+        self._unplaced_since: float | None = None
+        self._device_loss_t0: float | None = None
+        #: requeues caused by the sub-mesh losing a device — an
+        #: infrastructure fault, so NOT charged against max_requeues
+        self.device_loss_requeues = 0
+        #: current sub-mesh lease (None while queued) + the width
+        #: history, one entry per started attempt: the device-loss and
+        #: preemption contracts assert re-placement on a DIFFERENT width
+        self.submesh_lo: int | None = None
+        self.submesh_width: int | None = None
+        self.widths: list[int] = []
         self.result: dict | None = None
         #: live run handle (the scheduler's leased ABCSMC); None unless
         #: RUNNING — drain and cancel reach the run through it
@@ -289,6 +326,17 @@ class Tenant:
             "seed": int(self.spec.seed),
             "attempt": int(self.attempt),
             "requeues": int(self.requeues),
+            "sharded": (None if self.spec.sharded is None
+                        else int(self.spec.sharded)),
+            "submesh": (
+                None if self.submesh_width is None else {
+                    "lo": self.submesh_lo,
+                    "width": self.submesh_width,
+                }
+            ),
+            "widths": list(self.widths),
+            "preemptions": int(self.preemptions),
+            "device_loss_requeues": int(self.device_loss_requeues),
             "submitted_at": round(self.submitted_at, 6),
             "started_at": self.started_at,
             "finished_at": self.finished_at,

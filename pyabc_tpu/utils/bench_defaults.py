@@ -115,21 +115,21 @@ DEFAULT_MESH_POP = 1024
 DEFAULT_MESH_G = 8
 DEFAULT_MESH_GENS = 9
 DEFAULT_MESH_BUDGET_S = 120.0
-# serve lane (round 14): multi-tenant chaos containment — two
-# same-seed gaussian fleets on ONE RunScheduler, fleet B plus a
-# serial-killer tenant hard-killed at every chunk. Sized so a WARM
-# tenant run is ~1 s on the 1-core box: long enough that the <=10%
-# survivor-wall isolation guard measures contention, not timer noise,
-# short enough that the whole lane (warm-up + 2 fleets) stays ~15 s.
+# serve lane (round 15): mesh-aware serving on a forced-8-device pool —
+# a mixed fleet (one sharded=4 big tenant on a width-4 sub-mesh lease +
+# unsharded width-1 tenants) through one checkpoint-preemption and one
+# injected device_lost (6 of 8 devices), every posterior bit-identical
+# to its solo run. Small-tenant shapes sized so a warm run is ~1 s on
+# the 1-core box; the big tenant is long enough (gens x pop) that both
+# events land mid-run instead of racing its completion. (The round-14
+# chaos-ISOLATION guard lives in tier-1: tests/test_serving.py.)
 DEFAULT_SERVE_TENANTS = 4
-DEFAULT_SERVE_POP = 1000
-DEFAULT_SERVE_GENS = 8
-DEFAULT_SERVE_SLOTS = 2
-#: isolation guard: chaos-fleet survivor wall median must stay within
-#: this factor of the fault-free fleet median (+ absolute slack below)
-SERVE_ISOLATION_MAX_INFLATION = 1.10
-SERVE_ISOLATION_SLACK_S = 0.75
+DEFAULT_SERVE_POP = 300
+DEFAULT_SERVE_GENS = 6
+DEFAULT_SERVE_BIG_POP = 800
+DEFAULT_SERVE_BIG_GENS = 16
+DEFAULT_SERVE_BUDGET_S = 300.0
 #: fairness guard: max/min per-tenant accepted-pps ratio across the
-#: fault-free fleet (equal shapes through equal slots; the bound is
-#: generous because slot overlap on a 1-core box is scheduler luck)
+#: fault-free fleet (equal shapes through equal capacity; the bound is
+#: generous because overlap on a 1-core box is scheduler luck)
 SERVE_FAIRNESS_MAX_RATIO = 3.0

@@ -124,6 +124,15 @@ def program_shape_key(abc) -> tuple:
         np.asarray(abc.spec.flatten_host(abc.x_0), np.float32))
     prior_probs = np.ascontiguousarray(
         np.asarray(abc.model_prior_probs, np.float64))
+    # placement identity (mesh-aware serving): a sharded program and
+    # its unsharded twin are DIFFERENT compiled kernels, and a shard_map
+    # program is pinned to its mesh's physical devices — adopting a
+    # context compiled for another sub-mesh would dispatch onto devices
+    # the tenant does not lease
+    mesh = getattr(abc, "mesh", None)
+    mesh_sig = (None if mesh is None
+                else tuple(int(d.id) for d in mesh.devices.flat))
+    shard_sig = repr(getattr(abc, "sharded", None))
     return (
         tuple(_model_identity(m) for m in abc.models),
         int(abc.K),
@@ -139,6 +148,8 @@ def program_shape_key(abc) -> tuple:
         tuple(_component_config(tr) for tr in abc.transitions),
         int(abc.spec.total_size),
         hashlib.sha256(x0.tobytes()).hexdigest(),
+        mesh_sig,
+        shard_sig,
     )
 
 

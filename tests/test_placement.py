@@ -1,0 +1,201 @@
+"""Sub-mesh placement (round 15): the buddy allocator's books balance.
+
+The serving contract under test: every device index is at all times in
+exactly one of {free blocks, shared width-1 blocks, exclusive leases,
+lost} — ``check_invariants()`` recomputes that partition from scratch,
+and it must stay empty through allocation, packing, coalescing, device
+loss (quarantine), degraded cordons and restores, including a seeded
+randomized interleaving (the lease-table property-test pattern from
+round 10)."""
+import random
+
+import pytest
+
+from pyabc_tpu.serving.placement import (
+    SubMeshAllocator,
+    _aligned_blocks,
+    feasible_widths,
+)
+
+
+def test_aligned_seed_decomposition():
+    assert _aligned_blocks(0, 8) == [(0, 8)]
+    assert _aligned_blocks(0, 5) == [(0, 4), (4, 1)]
+    assert _aligned_blocks(0, 6) == [(0, 4), (4, 2)]
+
+
+def test_alloc_free_coalesce_roundtrip():
+    a = SubMeshAllocator(8)
+    assert a.check_invariants() == []
+    assert a.alloc(4, "big") == 0
+    assert a.alloc(2, "mid") == 4
+    assert a.alloc(1, "s1") == 6
+    assert a.alloc(1, "s2") == 7
+    assert a.widest_free() == 0
+    assert a.alloc(1, "nope") is None
+    assert a.check_invariants() == []
+    # frees coalesce back to one full-width block
+    for owner in ("big", "mid", "s1", "s2"):
+        a.free(owner)
+        assert a.check_invariants() == []
+    assert a.widest_free() == 8
+    assert a.coalesces_total >= 3
+
+
+def test_width_must_be_power_of_two_and_single_lease_per_owner():
+    a = SubMeshAllocator(8)
+    with pytest.raises(ValueError):
+        a.alloc(3, "x")
+    a.alloc(2, "x")
+    with pytest.raises(ValueError):
+        a.alloc(1, "x")  # one lease per owner
+    with pytest.raises(KeyError):
+        a.free("never-leased")
+
+
+def test_packing_shares_width1_blocks_densely():
+    a = SubMeshAllocator(8, packing=2)
+    assert a.alloc(1, "a") == a.alloc(1, "b")  # same shared block
+    assert a.alloc(1, "c") != a._owner_shared["a"]  # third opens a new one
+    # wide leases never share
+    assert a.alloc(4, "wide") == 4
+    assert a.check_invariants() == []
+    a.free("a")
+    # block still held by b: not freed, not coalesced
+    assert a.lease_of("b") is not None
+    a.free("b")
+    a.free("c")
+    a.free("wide")
+    assert a.widest_free() == 8 and a.check_invariants() == []
+
+
+def test_device_loss_in_free_block_splits_and_quarantines():
+    a = SubMeshAllocator(8)
+    assert a.mark_lost([5]) == []  # nothing leased: no one affected
+    assert a.healthy_count() == 7
+    assert a.check_invariants() == []
+    # 5 is quarantined: the widest allocatable block is the clean half
+    assert a.widest_free() == 4
+    assert a.alloc(4, "w") == 0
+    # re-losing the same device is idempotent
+    assert a.mark_lost([5]) == []
+    assert a.healthy_count() == 7
+
+
+def test_device_loss_under_lease_reports_owner_and_quarantines_on_free():
+    a = SubMeshAllocator(8)
+    assert a.alloc(4, "t") == 0
+    assert a.mark_lost([2]) == ["t"]
+    # the lease itself stays (the scheduler reaps it); freeing it
+    # returns only the healthy survivors
+    a.free("t")
+    assert a.check_invariants() == []
+    assert a.healthy_count() == 7
+    assert a.free_device_count() == 7
+    # the lost device never re-enters a free list
+    assert a.widest_free() == 4
+
+
+def test_shared_block_loss_reports_every_packed_owner():
+    a = SubMeshAllocator(2, packing=3)
+    a.alloc(1, "a")
+    a.alloc(1, "b")
+    lo = a._owner_shared["a"]
+    assert a.mark_lost([lo]) == ["a", "b"]
+    a.free("a")
+    a.free("b")
+    assert a.check_invariants() == []
+    assert a.healthy_count() == 1
+
+
+def test_degraded_cordons_subblocks_but_existing_leases_drain():
+    a = SubMeshAllocator(8)
+    assert a.alloc(2, "keep") == 0
+    a.mark_degraded([2, 3])
+    # the cordon blocks NEW placements on 2-3, the clean half still serves
+    assert a.alloc(4, "w") == 4
+    assert a.alloc(2, "no") is None
+    a.restore([2, 3])
+    assert a.alloc(2, "yes") == 2
+    assert a.check_invariants() == []
+
+
+def test_restore_returns_lost_devices_and_recoalesces():
+    a = SubMeshAllocator(8)
+    a.mark_lost([3])
+    assert a.widest_free() == 4
+    a.restore([3])
+    assert a.healthy_count() == 8
+    assert a.widest_free() == 8
+    assert a.check_invariants() == []
+
+
+def test_non_power_of_two_pool():
+    a = SubMeshAllocator(5)
+    assert a.check_invariants() == []
+    assert a.alloc(4, "w") == 0
+    assert a.alloc(1, "s") == 4
+    a.free("w")
+    a.free("s")
+    assert a.widest_free() == 4
+    assert a.check_invariants() == []
+
+
+def test_feasible_widths_policy():
+    assert feasible_widths(None) == [1]
+    assert feasible_widths(1) == [1]
+    assert feasible_widths(4) == [4, 2, 1]
+    assert feasible_widths(8) == [8, 4, 2, 1]
+    with pytest.raises(ValueError):
+        feasible_widths(6)
+
+
+def test_randomized_interleaving_books_always_balance():
+    """The property test: 4000 seeded random alloc/free/lose/restore
+    operations; after EVERY op the partition recomputes clean — zero
+    leaked, overlapping or double-booked device ranges."""
+    rng = random.Random(0)
+    a = SubMeshAllocator(8, packing=3)
+    live: dict[str, int] = {}
+    for i in range(4000):
+        op = rng.random()
+        if op < 0.45 or not live:
+            got = a.alloc(rng.choice([1, 1, 1, 2, 4, 8]), f"o{i}")
+            if got is not None:
+                live[f"o{i}"] = got
+        elif op < 0.85:
+            owner = rng.choice(sorted(live))
+            a.free(owner)
+            del live[owner]
+        elif op < 0.92:
+            for owner in a.mark_lost([rng.randrange(8)]):
+                a.free(owner)  # the scheduler's reap-then-free path
+                del live[owner]
+        else:
+            a.restore([rng.randrange(8)])
+        assert a.check_invariants() == [], (i, a.check_invariants())
+    stats = a.stats()
+    assert stats["allocs_total"] == a.allocs_total >= 1
+    assert stats["frees_total"] == a.frees_total >= 1
+
+
+def test_build_mesh_physical_vs_virtual():
+    """Width-1 and beyond-platform leases are logical (None: the tenant
+    runs its shards virtually); in-platform wide leases get a real Mesh
+    over exactly the leased devices (conftest forces 8 CPU devices)."""
+    import jax
+
+    from pyabc_tpu.serving.placement import (
+        build_mesh,
+        platform_device_count,
+    )
+
+    n = platform_device_count()
+    assert n == len(jax.devices())
+    assert build_mesh(0, 1) is None
+    assert build_mesh(n, 2) is None  # beyond the platform: virtual
+    if n >= 4:
+        mesh = build_mesh(2, 2)
+        devs = list(mesh.devices.flat)
+        assert [d.id for d in devs] == [jax.devices()[2].id,
+                                        jax.devices()[3].id]

@@ -191,6 +191,65 @@ def test_maybe_fault_is_noop_without_plan():
     maybe_fault("worker.batch", worker_id="w")  # must not raise
 
 
+# ----------------------------------------- device.mesh topology kinds (r15)
+def test_device_fault_kinds_parse_poll_and_count():
+    """The mesh-topology kinds are POLLED (the serving scheduler
+    applies the loss/cordon) and parse a ``devices=`` range; the
+    deterministic counters (after/max_fires) work exactly like every
+    other kind, and firings count into faults_injected_total."""
+    from pyabc_tpu.observability import global_metrics
+    from pyabc_tpu.observability.metrics import FAULTS_INJECTED_TOTAL
+    from pyabc_tpu.resilience import (
+        install_fault_plan,
+        maybe_device_fault,
+        uninstall_fault_plan,
+    )
+
+    plan = FaultPlan.parse(
+        "device.mesh:device_lost:after=1,devices=4-7;"
+        "device.mesh:device_degraded:devices=2")
+    install_fault_plan(plan)
+    try:
+        before = global_metrics().counter(
+            FAULTS_INJECTED_TOTAL, "faults fired").value
+        # first poll: device_lost skipped (after=1), degraded fires
+        assert maybe_device_fault() == {
+            "kind": "device_degraded", "devices": [2]}
+        assert maybe_device_fault() == {
+            "kind": "device_lost", "devices": [4, 5, 6, 7]}
+        assert maybe_device_fault() is None  # both one-shot by default
+        assert plan.n_fired("device.mesh") == 2
+        assert global_metrics().counter(
+            FAULTS_INJECTED_TOTAL, "faults fired").value == before + 2
+    finally:
+        uninstall_fault_plan()
+
+
+def test_device_fault_kinds_need_devices_and_never_probe():
+    """A device kind without ``devices=`` is a spec error; probe() and
+    poll() never see device rules (class separation keeps mixed plans
+    deterministic per site) and maybe_device_fault is a no-op without a
+    plan."""
+    from pyabc_tpu.resilience import maybe_device_fault
+
+    with pytest.raises(ValueError):
+        FaultRule(site="device.mesh", kind="device_lost")
+    with pytest.raises(ValueError):
+        FaultPlan.parse("device.mesh:device_lost:devices=7-4")
+    assert maybe_device_fault() is None  # no plan installed
+    plan = FaultPlan([
+        FaultRule(site="device.mesh", kind="device_lost", devices="0"),
+        FaultRule(site="device.mesh", kind="kill"),
+    ])
+    # probe consumes only the raise-class rule; the device rule's
+    # counters are untouched by it
+    with pytest.raises(InjectedKill):
+        plan.probe("device.mesh")
+    assert plan.poll("device.mesh") is None  # corruption class: none here
+    ev = plan.poll_device("device.mesh")
+    assert ev == {"kind": "device_lost", "devices": [0]}
+
+
 # ------------------------------------------------- protocol.request retry
 def test_request_retries_through_injected_drops():
     broker = EvalBroker("127.0.0.1", 0)

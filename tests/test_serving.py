@@ -74,16 +74,19 @@ def spec_for(seed: int, gens: int = GENS, pop: int = POP,
 
 
 def solo_reference(seed: int, db: str, gens: int = GENS,
-                   pop: int = POP) -> History:
+                   pop: int = POP, sharded: int | None = None) -> History:
     """A seed-matched SOLO run of the tenant gaussian config — the
     parity baseline chaos survivors are compared against (same model
-    builder, no scheduler in the loop)."""
+    builder, no scheduler in the loop). ``sharded=n`` runs the n-shard
+    reduction VIRTUALLY on one device — by the kernel's width-
+    independence contract that is the bit-level reference for a
+    scheduler-placed run at ANY sub-mesh width."""
     from pyabc_tpu.serving.tenant import _build_gaussian
 
     built = _build_gaussian(spec_for(seed))
     observed = built.pop("observed")
     abc = pt.ABCSMC(population_size=pop, seed=seed, fused_generations=G,
-                    **built)
+                    sharded=sharded, **built)
     abc.new(db, observed, store_sum_stats=True)
     return abc.run(max_nr_populations=gens)
 
@@ -756,6 +759,216 @@ def test_api_backpressure_is_http_429_with_retry_after(make_scheduler):
         wait_terminal([t1])
     finally:
         httpd.shutdown()
+
+
+# ================================================ mesh-aware serving (r15)
+def test_sharded_tenant_gets_submesh_lease_and_matches_virtual_solo(
+        make_scheduler, tmp_path):
+    """Tentpole: a ``sharded=4`` tenant maps to a contiguous width-4
+    sub-mesh lease (conftest forces 8 CPU devices, so the mesh is
+    real), and its posterior is BIT-identical to the seed-matched solo
+    virtual-shard run — the PR-9 mesh==virtual contract holding through
+    the scheduler's leased path."""
+    sched = make_scheduler(n_devices=8)
+    t = sched.submit(spec_for(seed=601, sharded=4), tenant_id="t-shard")
+    small = sched.submit(spec_for(seed=602), tenant_id="t-small")
+    wait_terminal([t, small])
+    assert t.state == COMPLETED, (t.state, t.error)
+    assert small.state == COMPLETED, (small.state, small.error)
+    assert t.widths == [4]
+    assert t.to_status()["submesh"] is None  # released on completion
+    ref = f"sqlite:///{tmp_path}/ref_shard.db"
+    solo_reference(601, ref, sharded=4)
+    assert_history_parity(t.db_path, ref, GENS)
+    assert sched.allocator.check_invariants() == []
+    assert sched.allocator.widest_free() == 8  # coalesced back
+
+
+def test_sharded_spec_validation():
+    with pytest.raises(ValueError):
+        spec_for(seed=1, sharded=3).validate()
+    with pytest.raises(ValueError):
+        spec_for(seed=1, sharded=1).validate()
+    spec_for(seed=1, sharded=8).validate()
+    # the scheduler owns placement: mesh/sharded overrides are reserved
+    with pytest.raises(ValueError):
+        spec_for(seed=1, abcsmc_overrides={"mesh": None}).validate()
+
+
+def test_preempted_tenant_requeues_and_resumes_bit_identical_narrower(
+        make_scheduler, tmp_path):
+    """Tentpole: checkpoint-preemption. A width-4 tenant is preempted
+    at a chunk boundary (graceful stop -> checkpoint), its sub-mesh
+    frees (a queued small tenant takes a slice), and it RESUMES on the
+    narrower sub-mesh that is left — full History bit-identical to the
+    seed-matched uninterrupted solo run, requeue budget untouched."""
+    gens = 8
+    sched = make_scheduler(n_devices=4)
+    big = sched.submit(spec_for(seed=611, gens=gens, sharded=4),
+                       tenant_id="t-big")
+    t0 = time.monotonic()
+    while big.generations_done < 2 and time.monotonic() - t0 < 120:
+        time.sleep(0.05)
+    assert big.generations_done >= 2
+    # no capacity left: the small tenant queues behind the big lease
+    small = sched.submit(spec_for(seed=612, gens=4), tenant_id="t-sm")
+    assert sched.preempt("t-big") is True
+    assert sched.preempt("t-big") is False  # one in-flight preempt
+    t0 = time.monotonic()
+    while big.preemptions < 1 and time.monotonic() - t0 < 120:
+        time.sleep(0.05)
+    wait_terminal([big, small])
+    assert big.state == COMPLETED, (big.state, big.error)
+    assert small.state == COMPLETED, (small.state, small.error)
+    assert big.preemptions == 1
+    assert big.requeues == 0  # preemption never charges the budget
+    kinds = [e["kind"] for e in big.events_since(0)]
+    assert "preempt_requested" in kinds and "preempted" in kinds
+    # resumed on a DIFFERENT (narrower) width: the small tenant holds a
+    # device, so the widest free divisor of 4 was 2
+    assert big.widths[0] == 4 and big.widths[1] < 4, big.widths
+    # the preempt drain landed as a span in the tenant's namespace
+    assert any(sp.name == "preempt.drain"
+               for sp in big.tracer.spans())
+    ref = f"sqlite:///{tmp_path}/ref_big.db"
+    solo_reference(611, ref, gens=gens, sharded=4)
+    assert_history_parity(big.db_path, ref, gens)
+    # each generation persisted exactly once across the preemption
+    h = History(big.db_path)
+    pops = h.get_all_populations().query("t >= 0")["t"].to_list()
+    assert sorted(pops) == sorted(set(pops)) == list(range(gens))
+    h.close()
+    assert sched.allocator.check_invariants() == []
+
+
+def test_device_loss_shrinks_capacity_and_replaces_on_narrower_width(
+        make_scheduler, tmp_path):
+    """Tentpole: device-loss survival. An injected ``device_lost`` at
+    the polled ``device.mesh`` site kills 6 of 8 devices including the
+    running tenant's sub-mesh: its lease is reaped, the allocator
+    quarantines the devices (capacity 8 -> 2, admission reprices), and
+    the tenant resumes on the surviving width-2 sub-mesh — bit-
+    identical to the seed-matched solo run, requeue budget untouched
+    (infrastructure faults are not the tenant's fault)."""
+    from pyabc_tpu.observability.metrics import FAULTS_INJECTED_TOTAL
+
+    gens = 8
+    sched = make_scheduler(n_devices=8, max_requeues=1)
+    t = sched.submit(spec_for(seed=621, gens=gens, sharded=4),
+                     tenant_id="t-loss")
+    t0 = time.monotonic()
+    while t.generations_done < 2 and time.monotonic() - t0 < 120:
+        time.sleep(0.05)
+    assert t.submesh_width == 4 and t.submesh_lo == 0
+    from pyabc_tpu.observability import global_metrics
+
+    faults_before = global_metrics().counter(
+        FAULTS_INJECTED_TOTAL, "faults fired").value
+    install_fault_plan(FaultPlan.parse(
+        "device.mesh:device_lost:devices=0-5"))
+    t0 = time.monotonic()
+    while t.device_loss_requeues < 1 and time.monotonic() - t0 < 60:
+        time.sleep(0.05)
+    uninstall_fault_plan()
+    wait_terminal([t])
+    assert t.state == COMPLETED, (t.state, t.error)
+    assert t.device_loss_requeues == 1 and t.requeues == 0
+    assert t.widths == [4, 2], t.widths  # survivors: devices 6-7
+    kinds = [e["kind"] for e in t.events_since(0)]
+    assert "device_lost" in kinds
+    # the injected topology event counts like every other fault
+    assert global_metrics().counter(
+        FAULTS_INJECTED_TOTAL, "faults fired").value > faults_before
+    # the device-loss recovery span covers loss -> re-placement
+    assert any(sp.name == "device_loss.replace"
+               for sp in t.tracer.spans())
+    # capacity shrank for real: allocator AND admission agree
+    assert sched.allocator.healthy_count() == 2
+    assert sched.snapshot()["admission"]["n_chips"] == 2
+    assert sched.devices_lost_total == 6
+    assert sched.allocator.check_invariants() == []
+    ref = f"sqlite:///{tmp_path}/ref_loss.db"
+    solo_reference(621, ref, gens=gens, sharded=4)
+    assert_history_parity(t.db_path, ref, gens)
+
+
+def test_cold_start_retry_after_seeded_from_spec(make_scheduler):
+    """Satellite: with ZERO completed runs the measured EW average
+    does not exist — the first 429s seed their Retry-After from the
+    REJECTED spec's own schedule (chunks x default per-chunk price x
+    population scale) instead of degenerating."""
+    from pyabc_tpu.serving.admission import spec_chip_seconds_estimate
+
+    sched = make_scheduler(n_slots=1, max_queued=0)
+    spec = spec_for(seed=631, gens=12, pop=2000)
+    assert sched.admission.stats()["cold_start"] is True
+    with pytest.raises(AdmissionRejectedError) as exc_info:
+        sched.submit(spec)
+    est = spec_chip_seconds_estimate(spec)
+    # gens=12 / G=2 -> 6 chunks x 2.0 s x (2000/1000) = 24 chip-s
+    assert est == pytest.approx(24.0)
+    assert exc_info.value.retry_after_s == pytest.approx(est)
+    # a bigger spec carries a proportionally bigger honest hint
+    with pytest.raises(AdmissionRejectedError) as exc_info2:
+        sched.submit(spec_for(seed=632, gens=24, pop=2000))
+    assert exc_info2.value.retry_after_s == pytest.approx(2 * est)
+
+
+def test_admission_prices_chip_seconds_not_queue_position(
+        make_scheduler):
+    """A completed wide run feeds width x wall seconds into the EW
+    average, and device loss reprices the SAME backlog higher."""
+    from pyabc_tpu.serving.admission import AdmissionController
+
+    adm = AdmissionController(max_queued=4, n_chips=8)
+    adm.note_run_seconds(10.0, chips=4)  # 40 chip-seconds
+    assert adm.stats()["avg_chip_s"] == pytest.approx(40.0)
+    hint_8 = adm.retry_after_s(3)
+    assert hint_8 == pytest.approx(4 * 40.0 / 8)
+    adm.set_capacity(2)  # 6 devices lost
+    assert adm.retry_after_s(3) == pytest.approx(4 * 40.0 / 2)
+    assert adm.retry_after_s(3) > hint_8
+
+
+def test_api_preempt_endpoint(make_scheduler):
+    sched = make_scheduler(n_slots=1)
+    httpd = serve_api(sched, port=0, block=False)
+    base = f"http://127.0.0.1:{httpd.server_port}"
+    try:
+        req = urllib.request.Request(
+            base + "/api/tenant/ghost/preempt", data=b"{}",
+            method="POST")
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(req, timeout=30)
+        assert exc_info.value.code == 409  # not running: nothing to do
+    finally:
+        httpd.shutdown()
+
+
+def test_auto_preemption_frees_capacity_for_starved_queue(
+        make_scheduler, tmp_path):
+    """The preemption POLICY: with ``preempt_queue_wait_s`` armed, a
+    queued tenant that sits unplaceable triggers a checkpoint
+    preemption of the widest running tenant; both complete, and the
+    preempted tenant's posterior still matches its solo run."""
+    gens, pop = 16, 1000  # long enough that the policy beats the run
+    sched = make_scheduler(n_devices=2, preempt_queue_wait_s=0.2)
+    big = sched.submit(spec_for(seed=641, gens=gens, pop=pop, sharded=2),
+                       tenant_id="t-auto-big")
+    t0 = time.monotonic()
+    while big.generations_done < 2 and time.monotonic() - t0 < 120:
+        time.sleep(0.05)
+    small = sched.submit(spec_for(seed=642, gens=4),
+                         tenant_id="t-auto-sm")
+    wait_terminal([big, small])
+    assert small.state == COMPLETED, (small.state, small.error)
+    assert big.state == COMPLETED, (big.state, big.error)
+    assert big.preemptions >= 1
+    ref = f"sqlite:///{tmp_path}/ref_auto.db"
+    h_ref = solo_reference(641, ref, gens=gens, pop=pop, sharded=2)
+    # pop-1000 MedianEpsilon runs legitimately stop early (round
+    # budget); parity is over the generations BOTH runs produced
+    assert_history_parity(big.db_path, ref, int(h_ref.n_populations))
 
 
 # ======================================================== fairness sanity

@@ -104,6 +104,28 @@ class TestShardedParity:
         assert snap["mesh"]["imbalance"] >= 1.0
         assert len(snap["mesh"]["rounds_per_device"]) == 8
 
+    @pytest.mark.parametrize("width", [1, 2, 4])
+    def test_divisor_width_bit_identical_to_virtual_shards(self, width):
+        """Round 15 width-independence: 8 shards on a NARROWER mesh
+        (each device vmapping 8/width virtual shards inside the
+        shard_map — the hybrid execution) stay bit-identical to the
+        virtual-shard reference. This is the kernel contract the
+        serving scheduler's re-place-on-any-width story stands on."""
+        abc_v = _make(seed=23, sharded=8)
+        h_v = abc_v.run(max_nr_populations=4)
+
+        abc_h = _make(seed=23, mesh=_mesh(width), sharded=8)
+        assert abc_h._sharded_n() == 8
+        h_h = abc_h.run(max_nr_populations=4)
+
+        a, b = _history_arrays(h_h), _history_arrays(h_v)
+        assert set(a) == set(b)
+        for k in a:
+            np.testing.assert_array_equal(
+                a[k], b[k],
+                err_msg=(f"width-{width} hybrid diverged from virtual "
+                         f"shards at {k}"))
+
     def test_sharded_statistical_parity_with_single_device(self):
         """Different reductions of the same proposal stream: the sharded
         run must agree with the plain single-device run on the posterior
@@ -335,7 +357,18 @@ class TestShardedGating:
         with pytest.raises(ValueError, match="power of two"):
             abc._sharded_n()
 
-    def test_mesh_width_mismatch_raises(self):
+    def test_mesh_width_must_divide_shard_count(self):
+        # fewer shards than devices cannot spread over the mesh
         abc = _make(seed=1, mesh=_mesh(), sharded=4)
-        with pytest.raises(ValueError, match="mesh has 8 devices"):
+        with pytest.raises(ValueError, match="must divide"):
             abc._sharded_n()
+
+    def test_divisor_width_mesh_runs_hybrid_shards(self):
+        """Round 15 (mesh-aware serving): the mesh width only has to
+        DIVIDE the shard count — each device vmaps its block of virtual
+        shards, so an n-shard checkpoint re-places on any divisor-width
+        sub-mesh."""
+        assert _make(seed=1, mesh=_mesh(2), sharded=8)._sharded_n() == 8
+        assert _make(seed=1, mesh=_mesh(4), sharded=8)._sharded_n() == 8
+        # width == shards stays the plain per-device execution
+        assert _make(seed=1, mesh=_mesh(8), sharded=8)._sharded_n() == 8

@@ -640,12 +640,105 @@ def test_iso001_mutation_unleased_run_in_api_fails():
         "guarded")
 
 
-def test_registry_has_nine_rules_with_iso001():
+# ------------------------------------------------------------- PLACE001
+
+PLACE_FIRES = """
+import jax
+import numpy as np
+from jax.sharding import Mesh
+def sneak_mesh(width):
+    devs = jax.devices()
+    return Mesh(np.asarray(devs[:width]), axis_names=("particles",))
+def sneak_enum():
+    return jax.local_devices(), jax.device_count()
+"""
+
+PLACE_CLEAN = """
+from . import placement
+def place(allocator, tenant_id, width):
+    lo = allocator.alloc(width, tenant_id)
+    return None if lo is None else placement.build_mesh(lo, width)
+"""
+
+PLACE_SUPPRESSED = """
+import jax
+def probe():
+    # abc-lint: disable=PLACE001 offline capability probe, no lease taken
+    return len(jax.devices())
+"""
+
+
+def test_place001_fires_on_mesh_and_enumeration():
+    from pyabc_tpu.analysis.rules.placement_rule import Place001
+
+    open_, _ = check(Place001(), PLACE_FIRES,
+                     "pyabc_tpu/serving/scheduler.py")
+    assert len(open_) == 4, [f.to_dict() for f in open_]
+    msgs = " ".join(f.message for f in open_)
+    assert "Mesh" in msgs and "devices" in msgs
+    assert "local_devices" in msgs and "device_count" in msgs
+
+
+def test_place001_scope_is_serving_minus_placement():
+    from pyabc_tpu.analysis.rules.placement_rule import Place001
+
+    r = Place001()
+    # the sanctioned topology module is exempt; the rest of serving/ is in
+    assert not r.applies_to("pyabc_tpu/serving/placement.py")
+    assert r.applies_to("pyabc_tpu/serving/scheduler.py")
+    assert r.applies_to("pyabc_tpu/serving/api.py")
+    assert r.applies_to("pyabc_tpu/serving/tenant.py")
+    # the rest of the tree builds meshes legitimately
+    assert not r.applies_to("pyabc_tpu/inference/util.py")
+    assert not r.applies_to("pyabc_tpu/parallel/distributed.py")
+    assert not r.applies_to("bench.py")
+    assert not r.applies_to("tests/test_sharded.py")
+    open_, _ = check(r, PLACE_CLEAN, "pyabc_tpu/serving/scheduler.py")
+    assert open_ == []
+
+
+def test_place001_suppression_with_reason():
+    from pyabc_tpu.analysis.rules.placement_rule import Place001
+
+    open_, sup = check(Place001(), PLACE_SUPPRESSED,
+                       "pyabc_tpu/serving/scheduler.py")
+    assert open_ == [] and len(sup) == 1 and sup[0].reason
+
+
+def test_place001_mutation_stray_mesh_in_scheduler_fails():
+    """THE mutation guard: a Mesh construction (or device enumeration)
+    growing into the scheduler — placement decided outside the
+    allocator's books — must make PLACE001 fire; today's scheduler.py
+    is clean, a re-added construction is a finding."""
+    from pyabc_tpu.analysis.rules.placement_rule import Place001
+
+    path = REPO / "pyabc_tpu" / "serving" / "scheduler.py"
+    src = path.read_text()
+    rel = "pyabc_tpu/serving/scheduler.py"
+    open_, _ = check(Place001(), src, rel)
+    assert open_ == [], [f.to_dict() for f in open_]
+    mutated = src + (
+        "\n\ndef _quick_mesh(width):\n"
+        "    import jax\n"
+        "    import numpy as np\n"
+        "    from jax.sharding import Mesh\n"
+        "    return Mesh(np.asarray(jax.devices()[:width]),\n"
+        "                axis_names=('particles',))\n"
+    )
+    open_m, _ = check(Place001(), mutated, rel)
+    assert len(open_m) >= 2, (
+        "a Mesh construction re-added to serving/scheduler.py left "
+        "PLACE001 silent — the placement-confinement contract is no "
+        "longer guarded")
+
+
+def test_registry_has_ten_rules_with_iso001_and_place001():
     from pyabc_tpu.analysis.rules import rule_ids
 
     ids = rule_ids()
-    assert len(ids) == 9
+    assert len(ids) == 10
     assert "ISO001" in ids
+    assert "PLACE001" in ids
 
 
 # ------------------------------------------------------- the tier-1 gate
