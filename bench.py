@@ -1123,6 +1123,14 @@ def _mesh_lane_child() -> dict:
     A warm mesh run (adopted kernels) supplies the headline
     ``accepted_particles_per_sec_mesh``; per-device pps is the number
     the next TPU session compares real chips against.
+
+    Round 16 adds an ADAPTIVE leg (4): the AdaptivePNormDistance config
+    the capability gate used to route onto the GSPMD fallback now runs
+    the sharded kernel — its own bit-identity parity guard vs virtual
+    shards, the strict sync budget (the moment-based scale reduction
+    must ride existing collectives), the new cross-shard collective
+    accounting, and a warm ``accepted_particles_per_sec_mesh_adaptive``
+    headline.
     """
     import jax
     import numpy as np
@@ -1215,6 +1223,75 @@ def _mesh_lane_child() -> dict:
             pps_single = pop * h_s2.n_populations / max(wall_s2, 1e-9)
         else:
             pps_single = pop * h_s.n_populations / max(wall_s, 1e-9)
+    # (4) ADAPTIVE workload (round 16, ISSUE 12): the adaptive-distance
+    # config the capability gate used to reject now runs the sharded
+    # kernel — measured with its own parity contract (mesh run
+    # BIT-identical to the virtual-shard run), the strict sync budget
+    # (the scale reduction rides existing collectives) and its own
+    # warm-pps headline for the next TPU session.
+    adaptive_block = {"skipped": "budget exhausted before adaptive leg"}
+    if clock.now() - t0 < budget * 0.9:
+        from pyabc_tpu.distance.scale import standard_deviation
+
+        def make_adaptive(mesh=None, sharded=None, seed=9):
+            abc = pt.ABCSMC(
+                lv.make_lv_model(), lv.default_prior(),
+                pt.AdaptivePNormDistance(
+                    p=2, scale_function=standard_deviation),
+                population_size=pop, eps=pt.MedianEpsilon(), seed=seed,
+                mesh=mesh, sharded=sharded, fused_generations=G,
+            )
+            abc.new("sqlite://", lv.observed_data(seed=123),
+                    store_sum_stats=False)
+            return abc
+
+        abc_av = make_adaptive(sharded=n_dev)
+        h_av, _, post_av, eps_av = run(abc_av)
+        abc_am = make_adaptive(mesh=mesh)
+        h_am, _, post_am, eps_am = run(abc_am)
+        budget_a = abc_am._engine.sync_budget_report()
+        snap_a = abc_am._engine.snapshot().get("mesh", {})
+        par_eps_a = float(np.max(np.abs(eps_am - eps_av))) \
+            if len(eps_am) == len(eps_av) else float("inf")
+        par_post_a = float(max(
+            abs(post_am[k] - post_av[k]) for k in post_am))
+        pps_adaptive = None
+        if clock.now() - t0 < budget:
+            abc_aw = make_adaptive(mesh=mesh, seed=10)
+            abc_aw.adopt_device_context(abc_am)
+            h_aw, wall_aw, _, _ = run(abc_aw)
+            pps_adaptive = (pop * h_aw.n_populations
+                            / max(wall_aw, 1e-9))
+        adaptive_block = {
+            "accepted_particles_per_sec_mesh_adaptive": (
+                round(pps_adaptive, 1) if pps_adaptive else None),
+            "posterior_mesh": {
+                k: round(v, 5) for k, v in post_am.items()},
+            "parity": {
+                "max_abs_eps_diff_vs_virtual_shards": par_eps_a,
+                "max_abs_posterior_diff_vs_virtual_shards": par_post_a,
+                "generations": int(h_am.n_populations),
+            },
+            "util": {
+                "syncs_per_run": int(budget_a["syncs"]),
+                "chunks_per_run": int(budget_a["chunks"]),
+                "sync_budget_ok": bool(budget_a["ok"]),
+                "row_collectives_total": snap_a.get(
+                    "row_collectives_total"),
+                "scale_reduction_bytes_per_gen": snap_a.get(
+                    "scale_reduction_bytes_per_gen"),
+            },
+            "regression_guard": {
+                # the round-16 contract: the ADAPTIVE mesh run is
+                # bit-identical to its virtual-shard reference and the
+                # scale reduction added no blocking round trips
+                "pass_adaptive_parity": bool(
+                    par_eps_a == 0.0 and par_post_a == 0.0
+                    and h_am.n_populations == h_av.n_populations),
+                "pass_adaptive_sync_budget": bool(budget_a["ok"]),
+            },
+        }
+    out["adaptive"] = adaptive_block
     out.update({
         "accepted_particles_per_sec_mesh": (
             round(pps_mesh, 1) if pps_mesh else None),

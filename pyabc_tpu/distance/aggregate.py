@@ -227,6 +227,85 @@ class AdaptiveAggregatedDistance(AggregatedDistance):
 
         return reduce
 
+    def _sharded_scale_name(self) -> str | None:
+        """Validated builtin scale name applicable to the 1-arg value
+        columns this class reduces over, or None (custom function,
+        observation-dependent scale — host semantics would differ)."""
+        from .scale import SCALE_FUNCTIONS
+
+        if self.scale_function is _span_of_values:
+            return "span"
+        name = getattr(self.scale_function, "__name__", "")
+        if SCALE_FUNCTIONS.get(name) is not self.scale_function:
+            return None
+        if name in self._TWO_ARG_SCALES:
+            return None
+        return name
+
+    def sharded_scale_capable(self) -> bool:
+        """True when the per-generation sub-distance rescaling is
+        expressible over the fixed per-shard moment block of the value
+        columns — the condition for the SHARDED multigen kernel."""
+        from ..ops.scale_reduce import SHARDED_SCALE_NAMES
+
+        if not self.adaptive or not self._subs_device_constant():
+            return False
+        name = self._sharded_scale_name()
+        return name is not None and name in SHARDED_SCALE_NAMES
+
+    def device_sharded_reduce(self, spec: SumStatSpec | None = None):
+        """Moment-expressed scale reduction for the sharded multigen
+        kernel: record columns are the per-record sub-distance values vs
+        the observation (the same columns :meth:`device_record_reduce`
+        scales), with a zero observation column vector as the moment
+        center (the value-column scales never reference x0 — enforced by
+        the two-arg exclusion)."""
+        import jax
+
+        from ..ops.scale_reduce import MOMENT_ROWS
+
+        if not self.sharded_scale_capable():
+            return None
+        fns = [d.device_fn(spec) for d in self.distances]
+        sub_params = tuple(d.device_params(None) for d in self.distances)
+        n_sub = len(fns)
+
+        def cols(rec_ss, x0):
+            return jnp.stack(
+                [
+                    jax.vmap(lambda s, f=f, p=p: f(s, x0, p))(rec_ss)
+                    for f, p in zip(fns, sub_params)
+                ],
+                axis=1,
+            )  # (n_records, K)
+
+        return {
+            "cols": cols, "x0_cols": jnp.zeros(n_sub, jnp.float32),
+            "name": self._sharded_scale_name(),
+            "moment_rows": MOMENT_ROWS, "cols_dim": n_sub,
+        }
+
+    def device_sharded_dfeat(self, spec: SumStatSpec | None = None):
+        """In-lane distance features for the SHARDED kernel's
+        recompute-under-new-weights step (see
+        AdaptivePNormDistance.device_sharded_dfeat): the features are the
+        per-row sub-distance values, the combine the weighted sum with
+        the refit 1/scale weights (sub-params chunk-constant under the
+        non-adaptive-subs gate)."""
+        fns = [d.device_fn(spec) for d in self.distances]
+        sub_params = tuple(d.device_params(None) for d in self.distances)
+
+        def row(ss, x0):
+            return jnp.stack(
+                [f(ss, x0, p) for f, p in zip(fns, sub_params)]
+            )
+
+        def combine(feat, params):
+            wf, _subs = params
+            return jnp.sum(wf * feat)
+
+        return {"row": row, "combine": combine, "dim": len(fns)}
+
     def device_weight_update(self):
         """Traceable scale -> aggregated-distance-params post-processing
         (twin of :meth:`_fit`'s 1/scale weighting; sub-params are

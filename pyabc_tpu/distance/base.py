@@ -70,6 +70,28 @@ class Distance(ABC):
         sync over a TPU tunnel costs more than the whole reduction)."""
         return None
 
+    def sharded_scale_capable(self) -> bool:
+        """True when this distance's adaptive scale refit decomposes into
+        per-shard partial moments (``ops/scale_reduce.py``) so the
+        SHARDED multigen kernel can serve it with scalar-per-stat
+        collectives only. Non-adaptive distances return False — they
+        have no scale refit to shard (the sharded gate treats them as
+        trivially capable)."""
+        return False
+
+    def device_sharded_reduce(self, spec: SumStatSpec):
+        """Sharded counterpart of :meth:`device_record_reduce`: the
+        moment-expressed scale reduction config for the sharded multigen
+        kernel, or None. Keys: ``cols`` (optional batched
+        ``fn(rec_ss (n,S), x0 (S,)) -> (n, C)`` record-column transform;
+        None = raw sum stats), ``x0_cols`` (the (C,) observation in
+        column space; None = the kernel's x0), ``name`` (the validated
+        scale-function name for
+        :func:`~pyabc_tpu.ops.scale_reduce.scale_from_moments`) and
+        ``moment_rows``/``cols_dim`` (static collective-payload
+        accounting for the mesh observability block)."""
+        return None
+
     def requires_calibration(self) -> bool:
         """True if initialize() needs a prior calibration sample."""
         return False

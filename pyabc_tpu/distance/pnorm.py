@@ -305,6 +305,76 @@ class AdaptivePNormDistance(PNormDistance):
             return None
         return _device_scale_impls().get(name)
 
+    def _sharded_scale_name(self) -> str | None:
+        """The validated builtin scale-function name (identity-checked
+        like :meth:`device_scale_impl` — a custom function shadowing a
+        builtin name must stay on the host), or None."""
+        from .scale import SCALE_FUNCTIONS
+
+        name = getattr(self.scale_function, "__name__", "")
+        if SCALE_FUNCTIONS.get(name) is not self.scale_function:
+            return None
+        return name
+
+    def sharded_scale_capable(self) -> bool:
+        """True when the adaptive scale refit is expressible over the
+        fixed per-shard moment block — the condition for the SHARDED
+        multigen kernel (median-based and true two-pass scales need the
+        full cross-shard ring and ride the GSPMD fallback;
+        ``_sharded_incapable_reason`` names the alternatives)."""
+        from ..ops.scale_reduce import SHARDED_SCALE_NAMES
+
+        if not self.adaptive or self.sumstat is not None:
+            return False
+        name = self._sharded_scale_name()
+        return name is not None and name in SHARDED_SCALE_NAMES
+
+    def device_sharded_reduce(self, spec=None):
+        """Moment-expressed scale reduction for the sharded multigen
+        kernel (see Distance.device_sharded_reduce): raw sum-stat
+        columns, the kernel's own x0 as the moment center, and the
+        validated scale-function name for the replicated finisher."""
+        from ..ops.scale_reduce import MOMENT_ROWS
+
+        if not self.sharded_scale_capable():
+            return None
+        return {
+            "cols": None, "x0_cols": None,
+            "name": self._sharded_scale_name(),
+            "moment_rows": MOMENT_ROWS,
+            "cols_dim": (spec.total_size if spec is not None else None),
+        }
+
+    def device_sharded_dfeat(self, spec):
+        """In-lane distance features for the SHARDED kernel's
+        recompute-under-new-weights step: ``row(ss, x0) -> (S,)`` stores
+        ``|x - x0|^p`` per statistic in the reservoir at accept time, and
+        ``combine(feat, w) -> scalar`` evaluates the weighted norm
+        ``(sum w^p feat)^(1/p)`` after the new weights exist. Factorizing
+        this way keeps the post-generation recompute off the sum-stat
+        rows — re-running the full distance on them makes XLA
+        re-materialize the simulation chain differently between the
+        vmapped virtual-shard and per-device programs (a measured
+        ULP-level bit-identity break); the declared fp deviation is that
+        ``(sum (w a)^p)^(1/p)`` becomes ``(sum w^p a^p)^(1/p)``."""
+        p = self.p
+
+        if np.isinf(p):
+            def row(ss, x0):
+                return jnp.abs(ss - x0)
+
+            def combine(feat, w):
+                return jnp.max(w * feat)
+        else:
+            def row(ss, x0):
+                return jnp.abs(ss - x0) ** p
+
+            def combine(feat, w):
+                return jnp.sum((w ** p) * feat) ** (1.0 / p)
+
+        return {"row": row, "combine": combine,
+                "dim": spec.total_size}
+
     def device_weight_update(self):
         """Traceable scale -> weight post-processing for the multi-generation
         device run: ``fn(scale (S,)) -> (S,)`` mirroring :meth:`_fit`

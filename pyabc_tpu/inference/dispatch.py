@@ -65,6 +65,8 @@ from ..observability.metrics import (
     MESH_BUSY_MAX_GAUGE,
     MESH_DEVICES_GAUGE,
     MESH_IMBALANCE_GAUGE,
+    MESH_ROW_COLLECTIVES_TOTAL,
+    MESH_SCALE_BYTES_GAUGE,
     SPECULATIVE_ROLLBACKS_TOTAL,
     SYNCS_PER_RUN_GAUGE,
 )
@@ -106,7 +108,8 @@ class DispatchEngine:
                  chunk_host_args, rebuild_carry, stop, n_of,
                  sumstat_refit=False, adaptive=False, stochastic=False,
                  temp_fixed=False, eps_quantile=False, adaptive_n=False,
-                 n_keep=None, shard_merge=None, mesh_shards=None):
+                 n_keep=None, shard_merge=None, mesh_shards=None,
+                 mesh_scale_bytes=0):
         from concurrent.futures import ThreadPoolExecutor
 
         self.owner = owner
@@ -125,10 +128,20 @@ class DispatchEngine:
         self.n_keep = n_keep
         #: sharded fused sampling: the static row gather merging the
         #: shard-blocked per-device reservoirs inside the packed fetch
-        #: (ops/shard.py::merge_index), and the mesh width for the
+        #: (ops/shard.py::merge_index), or the string "dynamic" when the
+        #: kernel re-indexes the merge per generation (population
+        #: schedules / in-kernel adaptive n), and the mesh width for the
         #: observability gauges. None/None on unsharded runs.
         self.shard_merge = shard_merge
         self.mesh_shards = int(mesh_shards) if mesh_shards else None
+        #: per-generation cross-shard payload of the adaptive scale
+        #: reduction + stochastic record-column gathers (config-derived;
+        #: 0 for non-adaptive configs) — the round-16 collectives made
+        #: visible in the gap accounting instead of assumed free
+        self.mesh_scale_bytes = int(mesh_scale_bytes)
+        #: cross-shard ROW collectives so far (packed-fetch merge
+        #: gathers + in-kernel cadence-refit theta all-gathers)
+        self.row_collectives = 0
         #: per-shard accounting of the last processed chunk (rounds and
         #: accepted rows per device, imbalance ratio) — surfaced in
         #: snapshot()["mesh"] and the pyabc_tpu_mesh_* gauges
@@ -151,6 +164,7 @@ class DispatchEngine:
         self.fetch_dtype = "float32" if sumstat_refit else owner.fetch_dtype
         B, n_cap, rec_cap, max_rounds, G = shapes
         self.G = int(G)
+        self._n_cap = int(n_cap)
         # does this run PAY the multigen trace/compile? A context
         # adopted from a same-shape donor (bench back-to-backs, the
         # serving layer's shape-keyed kernel cache) already holds the
@@ -245,6 +259,9 @@ class DispatchEngine:
             snap["mesh"] = {
                 "devices": int(self.mesh_shards),
                 "sharded": True,
+                "row_collectives_total": int(self.row_collectives),
+                "scale_reduction_bytes_per_gen": int(
+                    self.mesh_scale_bytes),
                 **(self._mesh_stats or {}),
             }
         return snap
@@ -267,6 +284,15 @@ class DispatchEngine:
         busy_max = (float(per_dev_rounds.max()) / float(
             per_dev_rounds.sum()) if per_dev_rounds.sum() > 0
             else 1.0 / max(self.mesh_shards or 1, 1))
+        # cross-shard ROW collectives of this chunk: one packed-fetch
+        # merge gather + one theta all-gather per in-kernel cadence
+        # refit — counted from the chunk's own refit flags so the gap
+        # accounting sees what actually crossed the mesh
+        chunk_row_colls = 1
+        if "refit" in fetched:
+            chunk_row_colls += int(
+                np.asarray(fetched["refit"])[:g_done].sum())
+        self.row_collectives += chunk_row_colls
         self._mesh_stats = {
             "rounds_per_device": [int(r) for r in per_dev_rounds],
             "accepted_per_device": [int(a) for a in n_acc.sum(axis=0)],
@@ -276,6 +302,17 @@ class DispatchEngine:
         from ..observability import global_metrics
 
         for reg in (self.owner.metrics, global_metrics()):
+            reg.counter(
+                MESH_ROW_COLLECTIVES_TOTAL,
+                "cross-shard row collectives (packed-fetch merge "
+                "gathers + cadence-refit theta all-gathers) of sharded "
+                "runs",
+            ).inc(chunk_row_colls)
+            reg.gauge(
+                MESH_SCALE_BYTES_GAUGE,
+                "per-generation cross-shard payload of the adaptive "
+                "scale reduction + stochastic record-column gathers",
+            ).set(float(self.mesh_scale_bytes))
             reg.gauge(
                 MESH_DEVICES_GAUGE,
                 "devices of the mesh the sharded multigen kernel runs on",
@@ -373,7 +410,11 @@ class DispatchEngine:
         tree = self.ctx.fetch_pack_kernel(
             n_keep=self.n_keep, dtype_name=self.fetch_dtype,
             keep_m=owner.K > 1, ss_gens=ss_gens, g_keep=int(g_lim),
-            merge_index=self.shard_merge,
+            # "dynamic" = the HOST merges per generation (population
+            # schedules / adaptive n); the kernel ships the full
+            # shard-blocked reservoir untouched
+            merge_index=(None if isinstance(self.shard_merge, str)
+                         else self.shard_merge),
         )(outs)
         if "calib" in res_i and t_at == 0:
             # the run-starting chunk carries the in-kernel calibration's
@@ -395,6 +436,38 @@ class DispatchEngine:
                 outs["sumstats"].nbytes // outs["sumstats"].shape[0]
             ) * len(ss_gens)
         return tree, r5_bytes
+
+    def _merge_shard_rows(self, fetched, ss_rows, t_at: int,
+                          g_lim: int) -> None:
+        """Host half of the DYNAMIC shard merge (population schedules /
+        in-kernel adaptive n): each generation's fetched rows arrive in
+        the shard-blocked reservoir layout; re-index its first ``n_t``
+        rows with that generation's static-quota merge gather
+        (ops/shard.py::merge_index) so downstream slicing sees the same
+        dense accepted order the static in-fetch merge produces. A numpy
+        take per generation — microseconds against the fetch itself."""
+        from ..ops.shard import merge_index
+
+        cap_loc = self._n_cap // self.mesh_shards
+        for g in range(g_lim):
+            if self.adaptive_n:
+                n_t = int(np.asarray(fetched["n_target"][g]))
+            else:
+                n_t = int(self.n_of(t_at + g))
+            n_t = min(n_t, self._n_cap)
+            idx = merge_index(n_t, self.mesh_shards, cap_loc)
+            for key in ("theta", "distance", "log_weight", "m",
+                        "sumstats"):
+                if key in fetched:
+                    v = fetched[key]
+                    if not v.flags.writeable:
+                        v = fetched[key] = np.array(v)
+                    v[g, :n_t] = v[g][idx]
+            if ss_rows and g in ss_rows:
+                v = ss_rows[g]
+                if not v.flags.writeable:
+                    v = ss_rows[g] = np.array(v)
+                v[:n_t] = v[idx]
 
     def _unpack_fetched(self, fetched):
         """Host-side inverse of the pack kernel: restore the legacy
@@ -586,6 +659,8 @@ class DispatchEngine:
                 ss_rows = {}
             calib = fetched.pop("__calib__", None)
             fetched = self._unpack_fetched(fetched)
+            if isinstance(self.shard_merge, str):
+                self._merge_shard_rows(fetched, ss_rows, t_at, g_lim)
             if calib is not None:
                 owner._mirror_fused_calibration(calib)
             mem_telemetry = owner._device_memory_telemetry()

@@ -1236,6 +1236,15 @@ class ABCSMC:
         )
 
         t0 = self.history.max_t + 1
+        # checkpoint adoption gates on _fused_chunk_capable, which for
+        # horizon-needing temperature schemes (ExpDecayFixedIter, ...)
+        # reads the epsilon's population horizon — but eps.initialize
+        # only runs AFTER adoption. Pre-seed the horizon from this run's
+        # argument so a stochastic run's checkpoint is not silently
+        # rejected (initialize re-sets the same value later).
+        if getattr(self.eps, "_max_nr_populations", False) is None \
+                and np.isfinite(max_nr_populations):
+            self.eps._max_nr_populations = int(max_nr_populations)
         # mid-chunk checkpoint adoption (resilience subsystem): a killed
         # orchestrator resumes from the exact device carry it
         # checkpointed — possibly pruning History rows persisted past it
@@ -1730,35 +1739,53 @@ class ABCSMC:
 
     def _sharded_incapable_reason(self, n_shards: int) -> str | None:
         """Why the sharded multigen kernel cannot serve this config (None
-        = capable). The sharded kernel covers the CORE fused feature set;
-        everything else falls back to the GSPMD constraint path (mesh
-        still used, outputs replicated) or the host loops — never an
-        error unless the user passed ``sharded=True``."""
+        = capable). Round 16 (ISSUE 12) shrank this gate to the
+        genuinely-impossible cases: adaptive distances (pass-decomposable
+        scale functions), stochastic acceptors + temperature schemes,
+        per-generation weight/population schedules and in-kernel adaptive
+        population sizes all SHARD now. Every remaining reason names the
+        fallback path that serves the config and the change that would
+        shard it — the strings are part of the contract
+        (tests/test_sharded.py asserts each is reachable)."""
         if not self._fused_chunk_capable():
-            return "config cannot run fused chunks"
-        if type(self.population_strategy) is not ConstantPopulationSize:
-            return ("constant population sizes only (shard quotas and "
-                    "the packed-fetch merge gather are static)")
-        if type(self.acceptor) is StochasticAcceptor:
-            return "stochastic acceptors ride the GSPMD path"
+            return ("config cannot run fused chunks, so there is no "
+                    "multigen kernel to shard; the per-generation host "
+                    "loops serve it (see _fused_chunk_capable for the "
+                    "fused feature set)")
         d = self.distance_function
         if getattr(d, "sumstat", None) is not None:
-            return "learned summary statistics ride the GSPMD path"
-        if (isinstance(d, AdaptivePNormDistance) and d.adaptive) or (
-                type(d) is AdaptiveAggregatedDistance and d.adaptive):
-            return ("adaptive distances ride the GSPMD path (the record "
-                    "ring stays shard-local; its scale reduction would "
-                    "need a per-generation row collective)")
-        if self._weight_schedule_fused():
-            return "per-generation weight schedules ride the GSPMD path"
-        if self._fused_adaptive_n_capable():
-            return "in-kernel adaptive population sizes ride the GSPMD path"
+            return ("learned summary statistics refit HOST-side in the "
+                    "transformed feature space at chunk boundaries, so "
+                    "the shard-local record ring cannot carry their "
+                    "scale state; the replicated GSPMD path serves this "
+                    "config (drop the sumstat transform to shard)")
+        if ((isinstance(d, AdaptivePNormDistance) and d.adaptive)
+                or (type(d) is AdaptiveAggregatedDistance and d.adaptive)) \
+                and not d.sharded_scale_capable():
+            scale_name = getattr(
+                getattr(d, "scale_function", None), "__name__",
+                repr(getattr(d, "scale_function", None)))
+            from ..ops.scale_reduce import SHARDED_SCALE_NAMES
+
+            return (f"adaptive scale function {scale_name!r} has no "
+                    f"moment-decomposable sharded reduction (median-"
+                    f"based and custom scales need the full cross-shard "
+                    f"record ring); the replicated GSPMD path serves "
+                    f"this config — switch to a decomposable "
+                    f"scale_function "
+                    f"({', '.join(sorted(SHARDED_SCALE_NAMES))}) to "
+                    f"shard")
         if n_shards & (n_shards - 1):
-            return ("shard count must be a power of two (lane batches "
-                    "and reservoir capacities are power-of-two buckets)")
+            return (f"shard count {n_shards} is not a power of two "
+                    f"(lane batches and reservoir capacities are "
+                    f"power-of-two buckets); the GSPMD path serves this "
+                    f"config — pass sharded=<power of two> (or a pow2 "
+                    f"mesh) to shard")
         if self._fused_n_cap() % n_shards:
-            return (f"population capacity {self._fused_n_cap()} not "
-                    f"divisible by {n_shards} shards")
+            return (f"population capacity {self._fused_n_cap()} is not "
+                    f"divisible by {n_shards} shards; the GSPMD path "
+                    f"serves this config — pick a shard count dividing "
+                    f"the pow2 population bucket to shard")
         return None
 
     def _weight_schedule_fused(self) -> bool:
@@ -2258,9 +2285,19 @@ class ABCSMC:
         else:
             n_max = n
         n_cap = self._fused_n_cap()  # == _pow2(n_max, 64), single source
-        rec_cap = _pow2(8 * n_cap, 256) if (adaptive or stochastic) else 1
         # sharded fused sampling (ISSUE 9): population axis over the mesh
         sharded_n = self._sharded_n()
+        # record-ring capacity for the adaptive/stochastic mechanisms; in
+        # sharded mode the ring is PER SHARD, so the per-shard cap scales
+        # down to keep the total recorded evaluations comparable to the
+        # unsharded ring (a pure configuration choice — the virtual-shard
+        # parity reference uses the identical per-shard cap)
+        if adaptive or stochastic:
+            rec_cap = _pow2(
+                max(8 * n_cap // (sharded_n or 1), 1), 256
+            )
+        else:
+            rec_cap = 1
         B = self.sampler._pick_B(n_max)
         if sharded_n:
             # every shard needs a whole lane block (both are powers of
@@ -2333,6 +2370,41 @@ class ABCSMC:
             health_config=health_cfg,
             sharded=sharded_n,
         )
+        # sharded merge semantics: a constant population keeps the STATIC
+        # in-fetch merge gather (ops/shard.py::merge_index — the
+        # round-13 program byte-identical); per-generation schedules and
+        # in-kernel adaptive n ship the full shard-blocked reservoir and
+        # the HOST re-indexes each generation with its own static-quota
+        # merge (DispatchEngine._merge_shard_rows) — adding a
+        # per-generation gather to the kernel outputs perturbs XLA's
+        # fusion differently per execution mode and breaks the
+        # mesh == virtual-shard bit-identity contract
+        dynamic_pop = bool(sharded_n) and (
+            adaptive_n
+            or type(self.population_strategy) is not ConstantPopulationSize
+        )
+        if dynamic_pop:
+            # the fetch ships every reservoir row (shard-blocked layout);
+            # the host merge slices each generation to its scheduled n
+            n_keep = n_cap
+        # per-generation cross-shard collective payload of the adaptive
+        # mechanisms (scalar-per-stat scale partials + the ring's scalar
+        # columns for the stochastic record reweighting) — exported into
+        # snapshot()["mesh"] so the new traffic is accounted, not assumed
+        mesh_scale_bytes = 0
+        if sharded_n and adaptive:
+            shard_cfg = self.distance_function.device_sharded_reduce(
+                self.spec)
+            if shard_cfg is not None:
+                cols_dim = shard_cfg["cols_dim"] or self.spec.total_size
+                mesh_scale_bytes += (
+                    4 * shard_cfg["moment_rows"] * cols_dim * sharded_n
+                )
+        if sharded_n and stochastic:
+            schemes = self._temp_config()[0]
+            if any(s[0] == "acceptance_rate" for s in schemes):
+                # logq / logq_new / kernel value (f32) + validity (bool)
+                mesh_scale_bytes += (3 * 4 + 1) * rec_cap * sharded_n
 
         def _g_limit(t_at: int) -> int:
             g = G
@@ -2534,10 +2606,13 @@ class ABCSMC:
             adaptive_n=adaptive_n,
             n_keep=n_keep,
             shard_merge=(
-                None if not sharded_n else _shard_merge_index(
+                None if not sharded_n
+                else "dynamic" if dynamic_pop
+                else _shard_merge_index(
                     n_keep, sharded_n, n_cap // sharded_n)
             ),
             mesh_shards=sharded_n,
+            mesh_scale_bytes=mesh_scale_bytes,
         )
         self._engine = engine
 

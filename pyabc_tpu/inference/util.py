@@ -481,20 +481,42 @@ class DeviceContext:
     # ---------------------------------------------------- fused generation
     def _generation_while(self, key, dyn, n_target, *, B, n_cap, rec_cap,
                           max_rounds, run_lanes, all_accept=False,
-                          record_proposal=False):
+                          record_proposal=False, moment_cfg=None,
+                          dfeat_cfg=None):
         """Traceable mask-and-refill loop for ONE generation.
 
         Proposes B-lane rounds until ``n_target`` acceptances (or the round
         budget), compacting accepted lanes into a fixed reservoir in
         proposal order — the deterministic slot-ordered trim happens by
         construction. Shared by the single-generation kernel and the
-        multi-generation scan. Returns (n_acc, rounds, n_valid, res, rec).
+        multi-generation scan. Returns (n_acc, rounds, n_valid, res, rec),
+        plus the moment block when ``moment_cfg`` is set.
 
         ``record_proposal`` extends the record ring with the proposal
         identity (m, theta) and its log-density under the generation's
         proposal (``logq``) — the AcceptanceRateScheme's record
         reweighting needs them (reference transition_pd_prev).
+
+        ``moment_cfg = (C, cols_fn, x0_kernel, x0_cols)`` (sharded
+        adaptive distances, ISSUE 12): accumulate the scale reduction's
+        ``(MOMENT_ROWS, C)`` moment block IN-LOOP over the ring-eligible
+        rows instead of reducing the ring afterwards — the ring's
+        sum-stat rows stay dead, which keeps the lane program identical
+        between the vmapped virtual-shard and per-device shard_map
+        executions (the bit-identity contract; see ops/scale_reduce.py).
+
+        ``dfeat_cfg = (C, row_fn, x0)`` (same contract): store each
+        ACCEPTED row's distance-feature vector in the reservoir at
+        accept time, so the post-generation distance recompute under the
+        refit weights never re-reads the sum-stat rows.
         """
+        if moment_cfg is not None:
+            from ..ops.scale_reduce import (
+                accumulate_moments,
+                init_moments,
+            )
+
+            mom_C, mom_cols_fn, mom_x0_kernel, mom_x0_cols = moment_cfg
         d_max, S = self.d_max, self.spec.total_size
         res0 = {
             "m": jnp.zeros((n_cap,), jnp.int32),
@@ -504,6 +526,8 @@ class DeviceContext:
             "log_weight": jnp.full((n_cap,), -jnp.inf, jnp.float32),
             "slot": jnp.full((n_cap,), -1, jnp.int32),
         }
+        if dfeat_cfg is not None:
+            res0["dfeat"] = jnp.zeros((n_cap, dfeat_cfg[0]), jnp.float32)
         rec0 = {
             "sumstats": jnp.zeros((rec_cap, S), jnp.float32),
             "distance": jnp.zeros((rec_cap,), jnp.float32),
@@ -518,13 +542,15 @@ class DeviceContext:
                   jnp.zeros((), jnp.int32),  # round
                   jnp.zeros((), jnp.int32),  # n_valid (true model evals)
                   res0, rec0)
+        if moment_cfg is not None:
+            state0 = state0 + (init_moments(mom_C),)
 
         def cond(state):
-            n_acc, r, _, _, _ = state
+            n_acc, r = state[0], state[1]
             return (n_acc < n_target) & (r < max_rounds)
 
         def body(state):
-            n_acc, r, n_valid, res, rec = state
+            n_acc, r, n_valid, res, rec = state[:5]
             out = run_lanes(jax.random.fold_in(key, r), dyn)
             acc = out["valid"] if all_accept else (
                 out["accepted"] & out["valid"]
@@ -550,6 +576,12 @@ class DeviceContext:
                 "slot": res["slot"].at[write_pos].set(
                     slots, mode="drop"),
             }
+            if dfeat_cfg is not None:
+                _dC, dfeat_row, dfeat_x0 = dfeat_cfg
+                res["dfeat"] = state[3]["dfeat"].at[write_pos].set(
+                    jax.vmap(lambda s: dfeat_row(s, dfeat_x0))(
+                        out["sumstats"]),
+                    mode="drop")
             # record ring: first rec_cap evaluations, in slot order
             rec_pos = jnp.where(out["valid"] & (slots < rec_cap),
                                 slots, rec_cap)
@@ -571,9 +603,16 @@ class DeviceContext:
                 rec_next["logq"] = rec["logq"].at[rec_pos].set(
                     out["logq"], mode="drop")
             rec = rec_next
-            return (n_acc + jnp.sum(acc, dtype=jnp.int32), r + 1,
-                    n_valid + jnp.sum(out["valid"], dtype=jnp.int32),
-                    res, rec)
+            nxt = (n_acc + jnp.sum(acc, dtype=jnp.int32), r + 1,
+                   n_valid + jnp.sum(out["valid"], dtype=jnp.int32),
+                   res, rec)
+            if moment_cfg is not None:
+                take = out["valid"] & (slots < rec_cap)
+                cols = (out["sumstats"] if mom_cols_fn is None
+                        else mom_cols_fn(out["sumstats"], mom_x0_kernel))
+                nxt = nxt + (accumulate_moments(
+                    state[5], cols, take, mom_x0_cols),)
+            return nxt
 
         return jax.lax.while_loop(cond, body, state0)
 
@@ -841,16 +880,15 @@ class DeviceContext:
             raise ValueError("stochastic fused chunks support K=1 only")
         if sharded is not None:
             # the explicitly sharded variant: per-device lanes/reservoirs
-            # with chunk-boundary-only row collectives (ISSUE 9 tentpole)
-            if (adaptive or stochastic or sumstat_transform or weight_sched
-                    or fold_sched_mode or adaptive_n is not None
-                    or fused_calibration is not None):
+            # with chunk-boundary-only row collectives (ISSUE 9 tentpole;
+            # ISSUE 12 extended it to the adaptive mechanisms — adaptive
+            # distances, stochastic acceptors, weight/pop schedules and
+            # in-kernel adaptive n all ride the scalar-column collectives)
+            if sumstat_transform or fused_calibration is not None:
                 raise ValueError(
-                    "sharded multigen supports the core fused config only "
-                    "(no adaptive distance / stochastic acceptor / learned "
-                    "sumstats / weight schedules / in-kernel adaptive n / "
-                    "in-kernel calibration) — the caller must gate these "
-                    "onto the GSPMD or host paths"
+                    "sharded multigen cannot serve learned summary "
+                    "statistics or in-kernel calibration — the caller "
+                    "must gate these onto the GSPMD or host paths"
                 )
             if refit_cadence is None:
                 raise ValueError(
@@ -865,6 +903,10 @@ class DeviceContext:
                 complete_history=complete_history,
                 first_gen_prior=first_gen_prior,
                 refit_cadence=refit_cadence, health_config=health_config,
+                adaptive=adaptive, stochastic=stochastic,
+                temp_config=temp_config, temp_fixed=temp_fixed,
+                weight_sched=weight_sched,
+                fold_sched_mode=fold_sched_mode, adaptive_n=adaptive_n,
             )
             self._kernels[cache_key] = fn
             return fn
@@ -1432,7 +1474,14 @@ class DeviceContext:
                           fit_statics: tuple, dims: tuple,
                           complete_history: bool, first_gen_prior: bool,
                           refit_cadence: tuple,
-                          health_config: tuple | None):
+                          health_config: tuple | None,
+                          adaptive: bool = False,
+                          stochastic: bool = False,
+                          temp_config: tuple | None = None,
+                          temp_fixed: bool = False,
+                          weight_sched: bool = False,
+                          fold_sched_mode: bool = False,
+                          adaptive_n: tuple | None = None):
         """The sharded fused chunk: population axis split over the mesh
         with chunk-boundary-only ROW collectives.
 
@@ -1476,6 +1525,20 @@ class DeviceContext:
         other width (including w=1 and the no-mesh virtual path) —
         which is what lets the serving scheduler re-place a preempted
         or device-loss-orphaned tenant on whatever sub-mesh is free.
+
+        Adaptive mechanisms (ISSUE 12 — the capability-gate kill): the
+        record ring stays SHARD-LOCAL; adaptive distances refit via the
+        pass-decomposed scale reduction of ``ops/scale_reduce.py``
+        (per-shard partial moments, an all-gather of scalar-per-stat
+        columns, a replicated combine — no new host fetch), stochastic
+        acceptors gather the ring's SCALAR columns (kernel value,
+        proposal log-density old/new, validity) and run the identical
+        replicated ``_stochastic_gen_update`` every device already
+        computes, per-generation population schedules ride dynamic
+        shard quotas with the packed-fetch merge gather re-indexed per
+        generation (``ops/shard.py::merge_index_dyn``), and user weight
+        schedules / CV fold tables resolve per generation on the
+        replicated column exactly as in the unsharded kernel.
         """
         from jax.sharding import PartitionSpec as P
 
@@ -1494,6 +1557,44 @@ class DeviceContext:
         K = self.K
         refit_every_s, _drift_thr = refit_cadence
         use_mesh = self.mesh is not None
+        dist_fn = self.distance.device_fn(self.spec)
+        weight_post = (
+            self.distance.device_weight_update() if adaptive else None
+        )
+        adapt_cfg = (
+            self.distance.device_sharded_reduce(self.spec)
+            if adaptive else None
+        )
+        if adaptive and (weight_post is None or adapt_cfg is None):
+            raise RuntimeError(
+                "adaptive sharded run needs a moment-expressible device "
+                "scale reduction + weight twin "
+                "(distance.device_sharded_reduce)"
+            )
+        if adaptive:
+            from ..ops.scale_reduce import (
+                combine_moments,
+                scale_from_moments,
+            )
+
+            scale_finish = scale_from_moments(adapt_cfg["name"])
+            mom_x0_cols = (self.x0 if adapt_cfg["x0_cols"] is None
+                           else adapt_cfg["x0_cols"])
+            moment_cfg = (adapt_cfg["cols_dim"] or S,
+                          adapt_cfg["cols"], self.x0, mom_x0_cols)
+            dfeat = self.distance.device_sharded_dfeat(self.spec)
+            dfeat_combine = dfeat["combine"]
+            dfeat_cfg = (dfeat["dim"], dfeat["row"], self.x0)
+        else:
+            moment_cfg = None
+            dfeat_cfg = dfeat_combine = None
+        record_proposal = stochastic
+        # the AcceptanceRateScheme is the one temperature scheme that
+        # reads the record ring; without it the ring's scalar columns
+        # never need to cross shards
+        need_rec_cols = stochastic and temp_config is not None and any(
+            sch[0] == "acceptance_rate" for sch in temp_config[0]
+        )
         v_loc = 1
         if use_mesh:
             mesh_devs = list(self.mesh.devices.flat)
@@ -1522,11 +1623,15 @@ class DeviceContext:
                     )
                     return jax.vmap(lambda k: lane(k, dyn_))(keys)
 
-                return self._generation_while(
+                out = self._generation_while(
                     gen_key, dyn, quota_loc, B=B_loc, n_cap=cap_loc,
                     rec_cap=rec_cap, max_rounds=max_rounds,
-                    run_lanes=run_lanes,
+                    run_lanes=run_lanes, record_proposal=record_proposal,
+                    moment_cfg=moment_cfg, dfeat_cfg=dfeat_cfg,
                 )
+                if moment_cfg is None:
+                    out = out + (jnp.zeros((0,), jnp.float32),)
+                return out
 
             def run_gen(_):
                 if not first_gen_prior:
@@ -1548,17 +1653,30 @@ class DeviceContext:
                                            jnp.float32),
                     "slot": jnp.full((cap_loc,), -1, jnp.int32),
                 }
+                if dfeat_cfg is not None:
+                    res["dfeat"] = jnp.zeros((cap_loc, dfeat_cfg[0]),
+                                             jnp.float32)
                 rec = {
                     "sumstats": jnp.zeros((rec_cap, S), jnp.float32),
                     "distance": jnp.zeros((rec_cap,), jnp.float32),
                     "accepted": jnp.zeros((rec_cap,), bool),
                     "valid": jnp.zeros((rec_cap,), bool),
                 }
-                return z32, z32, z32, res, rec
+                if record_proposal:
+                    rec["m"] = jnp.zeros((rec_cap,), jnp.int32)
+                    rec["theta"] = jnp.zeros((rec_cap, d_max),
+                                             jnp.float32)
+                    rec["logq"] = jnp.zeros((rec_cap,), jnp.float32)
+                if moment_cfg is None:
+                    mom = jnp.zeros((0,), jnp.float32)
+                else:
+                    from ..ops.scale_reduce import init_moments
 
-            n_acc_l, rounds_l, n_valid_l, res_l, _rec = jax.lax.cond(
-                stopped, skip_gen, run_gen, None
-            )
+                    mom = init_moments(moment_cfg[0])
+                return z32, z32, z32, res, rec, mom
+
+            (n_acc_l, rounds_l, n_valid_l, res_l, rec_l,
+             mom_l) = jax.lax.cond(stopped, skip_gen, run_gen, None)
             # local accepted-theta finiteness: the one health input that
             # must be reduced across shards instead of recomputed from
             # the gathered scalar columns
@@ -1566,30 +1684,24 @@ class DeviceContext:
                 n_acc_l, quota_loc)
             theta_bad_l = ~jnp.all(jnp.isfinite(
                 jnp.where(mask_loc[:, None], res_l["theta"], 0.0)))
-            return n_acc_l, rounds_l, n_valid_l, res_l, theta_bad_l
+            return (n_acc_l, rounds_l, n_valid_l, res_l, rec_l, mom_l,
+                    theta_bad_l)
 
-        # the three executions of the SAME shard program: on a
-        # full-width mesh the shard is the device (collectives are
-        # all_gathers); without a mesh the shards are a vmapped leading
-        # axis on one device and the "collectives" are reshapes; on a
-        # NARROWER mesh (w | n_shards) each device vmaps its v =
-        # n_shards/w virtual shards and the collectives compose
-        # reshape + all_gather — bit-level the same reduction
-        class _MeshShards:
-            @staticmethod
-            def run_local(gen_key, dyn, n_target, use_prior, stopped):
-                idx = jax.lax.axis_index(axis)
-                return local_generation(idx, gen_key, dyn, n_target,
-                                        use_prior, stopped)
-
-            @staticmethod
-            def rows(x):
-                return jax.lax.all_gather(x, axis, tiled=True)
-
-            @staticmethod
-            def stack(x):
-                return jax.lax.all_gather(x, axis)
-
+        # the two executions of the SAME shard program: without a mesh
+        # the shards are a vmapped leading axis on one device and the
+        # "collectives" are reshapes; on a mesh of ANY divisor width
+        # (including the full width, v_loc == 1) each device vmaps its
+        # block of v = n_shards/w virtual shards and the collectives
+        # compose reshape + all_gather — bit-level the same reduction.
+        # The full-width mesh deliberately keeps the SINGLETON vmap
+        # instead of running the shard body unbatched: XLA compiles the
+        # batched and unbatched lane programs with different elementwise
+        # fusion/contraction choices, and the resulting ULP differences
+        # in the simulated statistics broke mesh == virtual bit-identity
+        # for multi-stat models and uneven quotas (a latent round-13
+        # defect, found and fixed in round 16) — vmapping everywhere
+        # keeps every width in the same codegen class (measured:
+        # tests/test_sharded.py parity suite).
         class _VirtualShards:
             @staticmethod
             def run_local(gen_key, dyn, n_target, use_prior, stopped):
@@ -1606,6 +1718,11 @@ class DeviceContext:
             @staticmethod
             def stack(x):
                 return x
+
+            @staticmethod
+            def map_local(fn):
+                # per-shard local computation over the vmapped shard axis
+                return jax.vmap(fn)
 
         class _HybridShards:
             """w devices × v_loc virtual shards per device: device ``d``
@@ -1631,22 +1748,35 @@ class DeviceContext:
             def stack(x):
                 return jax.lax.all_gather(x, axis, tiled=True)
 
+            @staticmethod
+            def map_local(fn):
+                # per-virtual-shard computation over the device's block
+                return jax.vmap(fn)
+
         def make_gen_step(A, root, t0, n_sched, g_limit, mpk_base,
-                          eps_fixed, min_eps, min_acc_rate):
+                          eps_fixed, min_eps, min_acc_rate,
+                          dist_sched=None, fold_sched=None):
             def gen_step(carry, g):
                 carry_l = list(carry)
                 (trans_params, log_model_probs, fitted, dist_w,
                  eps_carry, acc_state, stopped) = carry_l[:7]
                 tail = carry_l[7:]
+                n_carry = tail.pop(0) if adaptive_n is not None else None
                 gens_since = tail.pop(0)
                 health_state = (tail.pop(0) if health_config is not None
                                 else None)
                 pdf_norm, max_found, daly_k = acc_state
                 stopped = stopped | (g >= g_limit)
                 t = t0 + g
-                n_target = n_sched[g]
+                # per-generation population target: schedules vary it,
+                # in-kernel adaptive n carries the previous generation's
+                # bootstrap-CV decision — both feed DYNAMIC shard quotas
+                n_target = n_sched[g] if n_carry is None else n_carry
                 gen_key = jax.random.fold_in(root, t + 1)
-                eps_g = eps_carry if eps_quantile else eps_fixed[g]
+                if (stochastic and not temp_fixed) or eps_quantile:
+                    eps_g = eps_carry
+                else:
+                    eps_g = eps_fixed[g]
                 # mask & renormalize the model-perturbation matrix —
                 # replicated math, identical to the unsharded kernel
                 matrix = mpk_base * fitted[None, :].astype(jnp.float32)
@@ -1661,10 +1791,17 @@ class DeviceContext:
                     model_factor > 0,
                     jnp.log(jnp.maximum(model_factor, 1e-38)), -jnp.inf,
                 )
+                # per-generation USER weight schedules resolve on the
+                # replicated column exactly as in the unsharded kernel
+                if weight_sched:
+                    dist_w_gen = jax.tree.map(lambda v: v[g], dist_sched)
+                else:
+                    dist_w_gen = dist_w
                 dyn = {
                     "eps": eps_g,
-                    "dist_params": dist_w,
-                    "acc_params": (pdf_norm if complete_history else ()),
+                    "dist_params": dist_w_gen,
+                    "acc_params": (pdf_norm if stochastic or complete_history
+                                   else ()),
                     "log_model_probs": log_model_probs,
                     "mpk_matrix": matrix,
                     "log_model_factor": log_model_factor,
@@ -1672,7 +1809,7 @@ class DeviceContext:
                 }
                 use_prior = (t == 0) if first_gen_prior \
                     else jnp.asarray(False)
-                (n_acc_l, rounds_l, n_valid_l, res_l,
+                (n_acc_l, rounds_l, n_valid_l, res_l, rec_l, mom_l,
                  theta_bad_l) = A.run_local(gen_key, dyn, n_target,
                                             use_prior, stopped)
                 # ---- per-generation scalar-column collectives only
@@ -1696,7 +1833,42 @@ class DeviceContext:
                 ) & ~stopped
                 k_mask = shard_mask(nacc_sh, quota_sh, n_shards, cap_loc)
                 w_norm = normalize_log_weights(lw_col, k_mask)
-                d_new = d_col
+                if adaptive:
+                    # adaptive-distance scale refit with the record ring
+                    # SHARD-LOCAL: each shard accumulated its moment
+                    # block IN-LOOP (ops/scale_reduce.py — the ring's
+                    # sum-stat rows stay dead, which is what keeps the
+                    # lane program bit-stable across execution modes);
+                    # the only cross-shard traffic is this all-gather of
+                    # scalar-per-stat moment columns + the replicated
+                    # combine/finisher every shard computes identically
+                    mom_glob = combine_moments(A.stack(mom_l))
+                    scale = scale_finish(mom_glob, mom_x0_cols)
+                    dist_w_next = weight_post(scale)
+                    # recompute accepted distances under the NEW weights
+                    # before the epsilon update (host _recompute_distances
+                    # semantics; History keeps the original values). The
+                    # recompute reads the reservoir's in-lane DISTANCE
+                    # FEATURE rows (|x - x0|^p per stat / sub-distance
+                    # values — stored at accept time), NOT the sum-stat
+                    # rows: a post-loop re-evaluation of the distance on
+                    # the sum stats makes XLA re-materialize the
+                    # simulation chain differently between the vmapped
+                    # virtual-shard and per-device programs, breaking the
+                    # bit-identity contract (measured; see
+                    # device_sharded_dfeat).
+                    d_new = A.rows(A.map_local(
+                        lambda f: jax.vmap(
+                            lambda r: dfeat_combine(r, dist_w_next)
+                        )(f)
+                    )(res_l["dfeat"]))
+                    # the feature rows are internal to the recompute:
+                    # they must not leak into the chunk outputs
+                    res_l = {k: v for k, v in res_l.items()
+                             if k != "dfeat"}
+                else:
+                    dist_w_next = dist_w
+                    d_new = d_col
                 if eps_quantile:
                     pts = jnp.where(k_mask, d_new, jnp.inf)
                     wts = (
@@ -1727,6 +1899,11 @@ class DeviceContext:
                     | jnp.any(~fitted & (counts > 0))
                     | ~jnp.any(fitted)
                 ) & ~stopped
+                # GridSearchCV x ListPopulationSize: this generation's
+                # host-built fold-id row (the fixed-seed rule over ITS n)
+                fit_extra = (
+                    {"folds": fold_sched[g]} if fold_sched_mode else {}
+                )
 
                 def _refit_models(_):
                     theta_glob = A.rows(res_l["theta"])
@@ -1736,7 +1913,7 @@ class DeviceContext:
                         w_m = jnp.where(m_col == m, w_norm, 0.0)
                         fit_m = trans_cls.device_fit(
                             theta_glob, w_m, dim=dims[m],
-                            **dict(fit_statics[m]),
+                            **dict(fit_statics[m]), **fit_extra,
                         )
                         if min_count_of is not None:
                             ok = counts[m] >= min_count_of(dims[m])
@@ -1766,9 +1943,42 @@ class DeviceContext:
                     -jnp.inf,
                 )
                 acc_rate = n_acc / jnp.maximum(n_valid, 1)
-                eps_min_next = (jnp.minimum(pdf_norm, eps_g)
-                                if complete_history else pdf_norm)
-                acc_state_next = (eps_min_next, max_found, daly_k)
+                if stochastic:
+                    # the temperature/pdf-norm recursions are replicated
+                    # scalar adaptation over the gathered columns; the
+                    # AcceptanceRateScheme's record reweighting reads the
+                    # ring's SCALAR columns only — proposal log-densities
+                    # (old, and new against the just-refit transition,
+                    # evaluated shard-locally), kernel values, validity
+                    rec_cols = {
+                        "logq": A.rows(rec_l["logq"]),
+                        "valid": A.rows(rec_l["valid"]),
+                        "distance": A.rows(rec_l["distance"]),
+                    } if need_rec_cols else {
+                        "logq": jnp.zeros((1,), jnp.float32),
+                        "valid": jnp.zeros((1,), bool),
+                        "distance": jnp.zeros((1,), jnp.float32),
+                    }
+                    if need_rec_cols:
+                        rec_cols["logq_new"] = A.rows(A.map_local(
+                            lambda th: jax.vmap(
+                                lambda x: trans_cls.device_logpdf(
+                                    x, trans_next[0])
+                            )(th)
+                        )(rec_l["theta"]))
+                    (eps_next, acc_state_next, temp_extra
+                     ) = self._stochastic_gen_update(
+                        temp_config, trans_cls, trans_next, rec_cols,
+                        {"distance": d_col}, k_mask, w_norm, pdf_norm,
+                        max_found, daly_k, eps_carry, acc_rate, t,
+                    )
+                    if temp_fixed:
+                        eps_next = eps_fixed[jnp.minimum(g + 1, G - 1)]
+                else:
+                    eps_min_next = (jnp.minimum(pdf_norm, eps_g)
+                                    if complete_history else pdf_norm)
+                    acc_state_next = (eps_min_next, max_found, daly_k)
+                    temp_extra = {}
                 stopped_next = (
                     stopped | ~gen_ok | (eps_g <= min_eps)
                     | (acc_rate < min_acc_rate)
@@ -1811,9 +2021,13 @@ class DeviceContext:
                 else:
                     word = ess = health_state_next = None
                 out = {
+                    "dbg_dcol": d_col, "dbg_lw": lw_col,
+                    "dbg_nacc": nacc_sh, "dbg_rounds": rounds_sh,
+                    "dbg_th": A.rows(res_l["theta"]),
+                    "dbg_ss": A.rows(res_l["sumstats"]),
                     **res_l,
                     "eps_used": eps_g, "eps_next": eps_next,
-                    "dist_w_next": dist_w, "n_acc": n_acc,
+                    "dist_w_next": dist_w_next, "n_acc": n_acc,
                     "rounds": rounds, "n_valid": n_valid,
                     "gen_ok": gen_ok, "model_probs": model_probs_next,
                     "refit": refit_now,
@@ -1822,14 +2036,59 @@ class DeviceContext:
                     # per-shard accounting for the mesh observability
                     # gauges (imbalance = how unevenly the mesh worked)
                     "n_acc_shard": nacc_sh, "rounds_shard": rounds_sh,
+                    **temp_extra,
                 }
                 if health_config is not None:
                     out["health"] = word
                     out["ess"] = ess
+                if adaptive_n is not None:
+                    # in-kernel AdaptivePopulationSize: the bootstrap-CV
+                    # bisection is replicated math over the just-refit
+                    # kernels — every shard computes the identical next
+                    # target, which feeds the next generation's dynamic
+                    # quotas (same key discipline as the unsharded twin)
+                    from ..transition.util import (
+                        device_mean_cv as _cv_generic,
+                        device_required_nr as _nr_generic,
+                    )
+
+                    target_cv, min_n, max_n, n_boot = adaptive_n
+                    boot_key = jax.random.fold_in(gen_key, max_rounds)
+                    probs_sum = jnp.maximum(model_probs_next.sum(), 1e-38)
+
+                    def cv_at(nn):
+                        tot = jnp.zeros((), jnp.float32)
+                        for m in range(K):
+                            key_m = (boot_key if K == 1
+                                     else jax.random.fold_in(boot_key, m))
+                            cv_m = _cv_generic(
+                                trans_cls, trans_next[m], key_m, nn,
+                                dim=dims[m], n_bootstrap=n_boot,
+                                **dict(fit_statics[m]),
+                            )
+                            tot = tot + jnp.where(
+                                model_probs_next[m] > 0,
+                                model_probs_next[m] / probs_sum * cv_m,
+                                0.0,
+                            )
+                        return tot
+
+                    n_next = jax.lax.cond(
+                        stopped_next,
+                        lambda: n_target,
+                        lambda: _nr_generic(
+                            cv_at, target_cv=target_cv, min_n=min_n,
+                            max_n=max_n,
+                        ),
+                    )
+                    out["n_target"] = n_target
+                    out["n_next"] = n_next
                 new_carry = [trans_next, log_model_probs_next,
-                             fitted_next, dist_w, eps_next,
-                             acc_state_next, stopped_next,
-                             gens_since_next]
+                             fitted_next, dist_w_next, eps_next,
+                             acc_state_next, stopped_next]
+                if adaptive_n is not None:
+                    new_carry.append(n_next)
+                new_carry.append(gens_since_next)
                 if health_config is not None:
                     new_carry.append(health_state_next)
                 return tuple(new_carry), out
@@ -1840,37 +2099,48 @@ class DeviceContext:
                      "slot")
 
         def _chunk_body(A, root, t0, n_sched, g_limit, carry0, mpk_base,
-                        eps_fixed, min_eps, min_acc_rate):
+                        eps_fixed, min_eps, min_acc_rate, dist_sched,
+                        fold_sched):
             step = make_gen_step(A, root, t0, n_sched, g_limit, mpk_base,
-                                 eps_fixed, min_eps, min_acc_rate)
+                                 eps_fixed, min_eps, min_acc_rate,
+                                 dist_sched=dist_sched,
+                                 fold_sched=fold_sched)
             final_carry, outs = jax.lax.scan(step, carry0, jnp.arange(G))
             rows = {k: outs.pop(k) for k in ROW_LOCAL}
             return rows, outs, final_carry
 
+        # schedule tables are replicated chunk inputs; shard_map needs a
+        # leaf in every argument slot, so inactive schedules ride as a
+        # zero scalar placeholder
+        def _sched_or_zero(sched):
+            return sched if sched is not None else jnp.zeros((),
+                                                             jnp.float32)
+
         if use_mesh:
             from jax.experimental.shard_map import shard_map
 
-            Sh = _MeshShards if v_loc == 1 else _HybridShards
+            Sh = _HybridShards
 
             def inner(root_data, t0, n_sched, g_limit, carry0, mpk_base,
-                      eps_fixed, min_eps, min_acc_rate):
+                      eps_fixed, min_eps, min_acc_rate, dist_sched,
+                      fold_sched):
                 root_k = jax.random.wrap_key_data(root_data)
                 rows, repl, carry = _chunk_body(
                     Sh, root_k, t0, n_sched, g_limit, carry0, mpk_base,
-                    eps_fixed, min_eps, min_acc_rate)
-                if v_loc > 1:
-                    # flatten each device's virtual-shard axis so the
-                    # sharded out_spec concatenates device blocks into
-                    # the same (G, n_cap, ...) global layout the
-                    # full-width mesh run produces
-                    rows = {
-                        k: x.reshape((G, v_loc * cap_loc) + x.shape[3:])
-                        for k, x in rows.items()
-                    }
+                    eps_fixed, min_eps, min_acc_rate, dist_sched,
+                    fold_sched)
+                # flatten each device's virtual-shard axis (singleton
+                # on a full-width mesh) so the sharded out_spec
+                # concatenates device blocks into the same
+                # (G, n_cap, ...) global layout every width produces
+                rows = {
+                    k: x.reshape((G, v_loc * cap_loc) + x.shape[3:])
+                    for k, x in rows.items()
+                }
                 return rows, repl, carry
 
             inner_sharded = shard_map(
-                inner, mesh=self.mesh, in_specs=(P(),) * 9,
+                inner, mesh=self.mesh, in_specs=(P(),) * 11,
                 # rows: scan axis G unsharded, reservoir axis sharded;
                 # everything else (per-generation scalars, the carry the
                 # next chunk chains off) replicated
@@ -1884,6 +2154,8 @@ class DeviceContext:
                 rows, repl, carry = inner_sharded(
                     jax.random.key_data(root), t0, n_sched, g_limit,
                     carry0, mpk_base, eps_fixed, min_eps, min_acc_rate,
+                    _sched_or_zero(dist_sched),
+                    _sched_or_zero(fold_sched),
                 )
                 return {"outs": {**rows, **repl}, "carry": carry}
         else:
@@ -1893,6 +2165,8 @@ class DeviceContext:
                 rows, repl, carry = _chunk_body(
                     _VirtualShards, root, t0, n_sched, g_limit, carry0,
                     mpk_base, eps_fixed, min_eps, min_acc_rate,
+                    _sched_or_zero(dist_sched),
+                    _sched_or_zero(fold_sched),
                 )
                 # virtual shards: ys rows are (G, n_shards, cap_loc, ...)
                 # — flatten the shard blocks into the same global layout
@@ -1976,10 +2250,16 @@ class DeviceContext:
             if sch[0] == "acceptance_rate":
                 target = sch[1]
                 # record reweighting to the NEXT proposal (reference
-                # transition_pd / transition_pd_prev)
-                logq_new = jax.vmap(
-                    lambda th: trans_cls.device_logpdf(th, trans_next[0])
-                )(rec["theta"])
+                # transition_pd / transition_pd_prev). The sharded kernel
+                # evaluates the new proposal density SHARD-LOCALLY and
+                # ships it as a gathered scalar column ("logq_new") —
+                # theta rows never cross shards for it.
+                logq_new = rec.get("logq_new")
+                if logq_new is None:
+                    logq_new = jax.vmap(
+                        lambda th: trans_cls.device_logpdf(
+                            th, trans_next[0])
+                    )(rec["theta"])
                 lw = jnp.clip(logq_new - rec["logq"], -60.0, 60.0)
                 rv = rec["valid"]
                 w_rec = jnp.where(rv, jnp.exp(lw), 0.0)

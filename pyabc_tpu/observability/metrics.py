@@ -223,6 +223,14 @@ MESH_IMBALANCE_GAUGE = "pyabc_tpu_mesh_shard_imbalance"
 #:  busiest-shard share of total mesh rounds in the last processed
 #:  chunk (1/n_devices when perfectly balanced)
 MESH_BUSY_MAX_GAUGE = "pyabc_tpu_mesh_shard_busy_max_frac"
+#:  cross-shard ROW collectives of sharded runs (per-chunk packed-fetch
+#:  merge gathers + in-kernel cadence-refit theta all-gathers) — the gap
+#:  accounting's view of what actually crosses the mesh beyond the
+#:  per-generation scalar columns (round 16: adaptive sharded configs)
+MESH_ROW_COLLECTIVES_TOTAL = "pyabc_tpu_mesh_row_collectives_total"
+#:  per-generation cross-shard payload of the adaptive scale reduction +
+#:  stochastic record-column gathers (bytes; 0 for non-adaptive configs)
+MESH_SCALE_BYTES_GAUGE = "pyabc_tpu_mesh_scale_reduction_bytes_per_gen"
 
 
 # -- multi-tenant serving instrument names (round 14) -------------------------

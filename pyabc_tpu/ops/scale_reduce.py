@@ -1,0 +1,135 @@
+"""Sharded scale reductions for adaptive distances (ISSUE 12 tentpole).
+
+The adaptive-distance gate was the last reason the sharded multigen
+kernel (``inference/util.py::_multigen_sharded``) refused the configs
+PAPER.md says matter most: ``AdaptivePNormDistance.adaptive`` refits
+per-statistic ``1/scale`` weights every generation over the record ring
+of ALL evaluated simulations — and the sharded kernel keeps row
+payloads strictly shard-local per generation. This module closes the
+gap for every *moment-expressible* scale function:
+
+1. each shard accumulates a fixed ``(MOMENT_ROWS, C)`` moment block
+   IN-LOOP while its generation runs (sums, sums of squares, deviations
+   from the observation, counts, extrema — a few scalars per
+   statistic), so the ring's sum-stat rows are never kept live;
+2. the per-generation collective is one all-gather of the stacked
+   ``(n_shards, MOMENT_ROWS, C)`` partials — *scalar-per-stat columns*,
+   riding the per-generation collective round the kernel already pays
+   for the distance/weight columns (NO new host fetch: the SyncLedger
+   count of an adaptive sharded run equals the non-adaptive run's);
+3. a replicated combine + finisher every shard computes identically.
+
+Why in-loop accumulation instead of reducing the record ring after the
+generation: keeping the ring's ``(rec_cap, S)`` sum-stat rows live
+changes how XLA materializes the lane's distance computation, and the
+vmapped virtual-shard program then differs from the per-device
+shard_map program at the ULP level — breaking the mesh == virtual-shard
+bit-identity contract. Fixed-size in-loop accumulators leave the ring
+rows dead and the lane program byte-stable (measured, not assumed:
+tests/test_sharded.py pins the contract for adaptive configs).
+
+Median-based scale functions (``median_absolute_deviation`` & friends)
+and true two-pass functions (``mean_absolute_deviation``, which needs
+deviations about the not-yet-known global mean) have no fixed-size
+moment form: they stay on the replicated GSPMD fallback, and
+``ABCSMC._sharded_incapable_reason`` names the functions a user can
+switch to.
+"""
+from __future__ import annotations
+
+#: rows of the per-shard moment block: sum, sum of squares, sum of
+#: absolute deviations from the observation, count, max, min
+MOMENT_ROWS = 6
+
+#: scale-function names expressible over the moment block — importable
+#: WITHOUT jax for gate checks and error messages
+SHARDED_SCALE_NAMES = frozenset({
+    "mean", "bias", "span", "standard_deviation",
+    "root_mean_square_deviation",
+    "mean_absolute_deviation_to_observation",
+    "standard_deviation_to_observation",
+})
+
+
+def init_moments(C: int):
+    """Zero moment block (extrema seeded at ∓inf so an empty shard
+    contributes the identity under max/min merges)."""
+    import jax.numpy as jnp
+
+    z = jnp.zeros((C,), jnp.float32)
+    return jnp.stack([
+        z, z, z, z,
+        jnp.full((C,), -jnp.inf, jnp.float32),
+        jnp.full((C,), jnp.inf, jnp.float32),
+    ])
+
+
+def accumulate_moments(mom, cols, take, x0):
+    """Fold one proposal round's record columns into the shard's moment
+    block. ``cols (B, C)``, ``take (B,)`` = this round's ring-eligible
+    rows (valid simulation AND inside the record window), ``x0 (C,)``
+    the observation in column space."""
+    import jax.numpy as jnp
+
+    t = take[:, None]
+    csum = jnp.where(t, cols, 0.0).sum(axis=0)
+    csq = jnp.where(t, cols * cols, 0.0).sum(axis=0)
+    cad = jnp.where(t, jnp.abs(cols - x0[None, :]), 0.0).sum(axis=0)
+    cnt = jnp.broadcast_to(
+        take.sum().astype(jnp.float32), (cols.shape[1],)
+    )
+    cmax = jnp.where(t, cols, -jnp.inf).max(axis=0)
+    cmin = jnp.where(t, cols, jnp.inf).min(axis=0)
+    return jnp.stack([
+        mom[0] + csum, mom[1] + csq, mom[2] + cad, mom[3] + cnt,
+        jnp.maximum(mom[4], cmax), jnp.minimum(mom[5], cmin),
+    ])
+
+
+def combine_moments(parts):
+    """Merge the stacked per-shard blocks ``(n_shards, MOMENT_ROWS, C)``
+    into the global block — sums add, extrema reduce. Pure function of
+    the stacked array in shard order, so the mesh all-gather and the
+    virtual-shard identity produce bit-identical results."""
+    import jax.numpy as jnp
+
+    sums = parts[:, :4].sum(axis=0)
+    mx = parts[:, 4].max(axis=0)
+    mn = parts[:, 5].min(axis=0)
+    return jnp.concatenate([sums, mx[None], mn[None]], axis=0)
+
+
+def scale_from_moments(name: str):
+    """The finisher ``fn(mom_global, x0) -> (C,)`` for a supported scale
+    name, or None. Variance-bearing scales use the one-pass
+    ``E[x²] - mean²`` form (clamped at 0) — a declared fp deviation from
+    the unsharded two-pass device twin; the sharded contract compares
+    mesh against virtual shards, which share this exact form."""
+    if name not in SHARDED_SCALE_NAMES:
+        return None
+    import jax.numpy as jnp
+
+    def _n(mom):
+        return jnp.maximum(mom[3], 1.0)
+
+    def _mean(mom):
+        return mom[0] / _n(mom)
+
+    def _var(mom):
+        return jnp.maximum(mom[1] / _n(mom) - _mean(mom) ** 2, 0.0)
+
+    impls = {
+        "mean": lambda mom, x0: _mean(mom),
+        "bias": lambda mom, x0: jnp.abs(_mean(mom) - x0),
+        "span": lambda mom, x0: mom[4] - mom[5],
+        "standard_deviation": lambda mom, x0: jnp.sqrt(_var(mom)),
+        "root_mean_square_deviation": lambda mom, x0: jnp.sqrt(
+            (_mean(mom) - x0) ** 2 + _var(mom)),
+        "mean_absolute_deviation_to_observation":
+            lambda mom, x0: mom[2] / _n(mom),
+        "standard_deviation_to_observation": lambda mom, x0: jnp.sqrt(
+            jnp.maximum(
+                (mom[1] - 2.0 * x0 * mom[0] + mom[3] * x0 * x0)
+                / _n(mom), 0.0)),
+    }
+    return impls[name]

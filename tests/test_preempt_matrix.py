@@ -132,3 +132,85 @@ def test_preempt_any_boundary_resume_any_width_bit_identical(
             f"resume width {resume_width} after boundary {boundary} on "
             f"width {interrupt_width} diverged from the uninterrupted "
             f"run")
+
+
+# --------------------------------------------- adaptive carries (round 16)
+#
+# ISSUE 12 satellite: checkpoint-preemption bit-identity asserted for
+# ADAPTIVE chunk carries — the adaptive-distance scale state rides the
+# carry's dist_w slot and the stochastic acceptor's temperature trail +
+# pdf-norm recursion ride the eps/acc_state slots; a preempted run must
+# resume them mid-trail bit-identically on a different width.
+
+def _make_flavored(flavor, db, *, width, seed=31, checkpoint_path=None):
+    from pyabc_tpu.distance.scale import standard_deviation
+
+    if flavor == "adaptive":
+        dist = pt.AdaptivePNormDistance(
+            p=2, scale_function=standard_deviation)
+        eps = pt.MedianEpsilon()
+        acceptor = None
+        model = _model()
+    else:  # stochastic
+        from pyabc_tpu.epsilon.temperature import ExpDecayFixedIterScheme
+
+        dist = pt.IndependentNormalKernel(var=[NOISE_SD**2])
+        # exp-decay ladder from a pinned high start: the trail spans the
+        # full run, so the preemption genuinely interrupts a live
+        # temperature recursion (the default acceptance-rate initial
+        # would land at T=1 immediately for this well-matched model)
+        eps = pt.Temperature(schemes=[ExpDecayFixedIterScheme()],
+                             initial_temperature=100.0)
+        acceptor = pt.StochasticAcceptor()
+
+        @pt.JaxModel.from_function(["theta"], name="det_preempt")
+        def model(key, theta):
+            return {"x": theta[0]}
+
+    return pt.ABCSMC(
+        model, pt.Distribution(theta=pt.RV("norm", 0.0, 1.0)),
+        dist, population_size=pt.ListPopulationSize(
+            [POP, POP - 12, POP, POP - 24, POP, POP]),
+        eps=eps, acceptor=acceptor, seed=seed, mesh=_mesh(width),
+        sharded=N_SHARDS, fused_generations=G,
+        checkpoint_path=checkpoint_path,
+    )
+
+
+@pytest.mark.parametrize("flavor", ["adaptive", "stochastic"])
+def test_preempt_adaptive_carry_bit_identical(flavor, tmp_path):
+    """One adaptive cell per flavor: interrupt the width-2 run at the
+    first chunk boundary, resume at width 4 — scale state / temperature
+    trail / pdf-norm carry restored bit-identically vs the solo
+    virtual-shard run."""
+    ref_db = f"sqlite:///{tmp_path}/ref_{flavor}.db"
+    ref = _make_flavored(flavor, ref_db, width=None)
+    ref.new(ref_db, {"x": 1.0})
+    h_ref = ref.run(max_nr_populations=GENS)
+    reference = _history_arrays(h_ref)
+    assert h_ref.n_populations == GENS
+
+    db = f"sqlite:///{tmp_path}/run_{flavor}.db"
+    ck = str(tmp_path / f"run_{flavor}.ck")
+    abc = _make_flavored(flavor, db, width=2, checkpoint_path=ck)
+    abc.new(db, {"x": 1.0})
+    abc_id = int(abc.history.id)
+
+    def on_chunk(ev):
+        abc.request_graceful_stop()
+
+    abc.chunk_event_cb = on_chunk
+    with pytest.raises(GracefulShutdown):
+        abc.run(max_nr_populations=GENS)
+    assert 0 < abc.history.n_populations < GENS
+
+    abc2 = _make_flavored(flavor, db, width=4, checkpoint_path=ck)
+    abc2.load(db, abc_id)
+    h = abc2.run(max_nr_populations=GENS)
+    assert h.n_populations == GENS
+    got = _history_arrays(h)
+    assert len(got) == len(reference)
+    for a, b in zip(reference, got):
+        assert np.array_equal(a, b), (
+            f"{flavor} preempt/resume diverged from the uninterrupted "
+            f"run")
