@@ -1662,6 +1662,157 @@ def run_serve_lane(budget_s: float) -> dict:
                      f"{(proc.stderr or '')[-400:]}"}
 
 
+# -- storage lane -------------------------------------------------------------
+
+
+def storage_lane_skip_reason() -> str | None:
+    """The `storage` lane (round 17) measures History INGEST — the
+    dual-basis gap at scenario-zoo scale, where the async writer (not
+    the kernel) becomes the ceiling: the same pop-16384 packed-fetch
+    generations appended to the row store (reference SQL layout, WAL
+    on and off) and to the columnar generation-batch store (one
+    Parquet record batch per generation, narrow dtypes preserved).
+    Headline = columnar/row ingest ratio, regression-guarded >= 10x.
+    Host-only (no jax, no device): nothing to skip except opt-out."""
+    if os.environ.get("PYABC_TPU_BENCH_STORAGE") == "0":
+        return "disabled via PYABC_TPU_BENCH_STORAGE=0"
+    return None
+
+
+def run_storage_lane(budget_s: float) -> dict:
+    """Apples-to-apples History ingest: each store receives EXACTLY what
+    the live fused loop hands it for a persisted generation — the row
+    store a deferred-built Population (normalization included, as on the
+    writer thread), the columnar store a GenerationBatch wrapping the
+    raw packed-fetch slices. Synchronous appends, so the measured wall
+    is pure ingest (no queue time)."""
+    import tempfile
+
+    import numpy as np
+
+    from pyabc_tpu.core.parameters import ParameterSpace
+    from pyabc_tpu.core.population import Population
+    from pyabc_tpu.core.sumstat_spec import SumStatSpec
+    from pyabc_tpu.sampler.base import Sample, exp_normalize_log_weights
+    from pyabc_tpu.storage import GenerationBatch, History
+    from pyabc_tpu.storage.columnar import has_pyarrow
+    from pyabc_tpu.utils.bench_defaults import (
+        DEFAULT_STORAGE_GENS,
+        DEFAULT_STORAGE_GUARD_MIN_X,
+        DEFAULT_STORAGE_POP,
+    )
+
+    pop = int(os.environ.get("PYABC_TPU_BENCH_STORAGE_POP",
+                             DEFAULT_STORAGE_POP))
+    gens = int(os.environ.get("PYABC_TPU_BENCH_STORAGE_GENS",
+                              DEFAULT_STORAGE_GENS))
+    d, S = 4, 8
+    t_lane0 = CLOCK.now()
+    rng = np.random.default_rng(1234)
+    fetches = [
+        {
+            "ms": np.zeros(pop, np.int32),
+            "thetas": rng.normal(size=(pop, d)).astype(np.float16),
+            "log_weights": rng.normal(size=pop).astype(np.float16),
+            "distances": np.abs(rng.normal(size=pop)).astype(np.float16),
+            "sumstats": rng.normal(size=(pop, S)).astype(np.float16),
+            "slots": np.arange(pop),
+        }
+        for _ in range(gens)
+    ]
+    names = [[f"p{i}" for i in range(d)]]
+    spec = SumStatSpec({"x": np.zeros(S)})
+    tmp = tempfile.mkdtemp(prefix="pyabc_tpu_storage_lane_")
+
+    def _population(arrs) -> Population:
+        # the row path's deferred _build: Sample normalization +
+        # Population construction, charged to the ingest wall exactly
+        # as it is on the live writer thread
+        sample = Sample()
+        sample.set_accepted(
+            ms=arrs["ms"],
+            thetas=np.asarray(arrs["thetas"], np.float64),
+            weights=exp_normalize_log_weights(arrs["log_weights"]),
+            distances=np.asarray(arrs["distances"], np.float64),
+            sumstats=np.asarray(arrs["sumstats"], np.float64),
+            proposal_ids=arrs["slots"],
+        )
+        return Population(
+            ms=sample.ms, thetas=sample.thetas, weights=sample.weights,
+            distances=sample.distances, sumstats=sample.sumstats,
+            spaces=[ParameterSpace(n) for n in names],
+            sumstat_spec=spec, model_names=["m0"],
+        )
+
+    def _ingest(db_url: str, make_payload, wal: bool = True,
+                n_gens: int = gens) -> dict:
+        h = History(db_url, wal=wal)
+        h.store_initial_data(None, {}, {"x": np.zeros(S)}, {},
+                             ["m0"], "{}", "{}", "{}")
+        t0 = CLOCK.now()
+        for t in range(n_gens):
+            h.append_population(t, 1.0 - 0.01 * t, make_payload(fetches[t]),
+                                3 * pop, ["m0"])
+        wall = CLOCK.now() - t0
+        on_disk = h.last_ingest["bytes_on_disk"]
+        h.close()
+        rows = pop * n_gens
+        return {
+            "rows": rows,
+            "wall_s": round(wall, 4),
+            "rows_per_sec": round(rows / max(wall, 1e-9), 1),
+            "bytes_per_particle": round(on_disk / rows, 2),
+        }
+
+    rows_wal = _ingest(f"sqlite:///{tmp}/rows_wal.db", _population)
+    # WAL satellite: same ingest with the pragmas off (fresh db,
+    # rollback-journal mode) — the measured delta the pragma buys
+    rows_nowal = _ingest(f"sqlite:///{tmp}/rows_nowal.db", _population,
+                         wal=False, n_gens=max(gens // 2, 1))
+    out = {
+        "pop": pop, "gens": gens,
+        "rows_store": rows_wal,
+        "rows_store_no_wal": rows_nowal,
+        "wal_speedup_x": round(
+            rows_wal["rows_per_sec"]
+            / max(rows_nowal["rows_per_sec"], 1e-9), 2),
+    }
+    guard_min = float(os.environ.get(
+        "PYABC_TPU_BENCH_STORAGE_GUARD_MIN_X",
+        DEFAULT_STORAGE_GUARD_MIN_X))
+    if has_pyarrow():
+        col = _ingest(
+            f"sqlite+columnar:///{tmp}/col.db",
+            lambda arrs: GenerationBatch.from_fetch(
+                param_names=names, **arrs),
+        )
+        ratio = col["rows_per_sec"] / max(rows_wal["rows_per_sec"], 1e-9)
+        out["columnar_store"] = col
+        out["ingest_ratio_columnar_vs_rows"] = round(ratio, 2)
+        # regression guard: the tentpole's acceptance line — columnar
+        # ingest must stay >= 10x the row store at pop-16384 scale
+        out["guard_min_ratio_x"] = guard_min
+        out["guard_ok"] = bool(ratio >= guard_min)
+        out["value"] = round(col["rows_per_sec"], 1)
+    else:
+        out["columnar_store"] = {"skipped": "pyarrow not installed"}
+        out["guard_ok"] = None
+        out["value"] = 0.0
+    out["util"] = {
+        "history_ingest_rows_per_sec_rows": rows_wal["rows_per_sec"],
+        "history_ingest_rows_per_sec_columnar": (
+            out.get("columnar_store", {}).get("rows_per_sec", 0.0)
+            if has_pyarrow() else 0.0),
+        "history_bytes_per_particle_rows": rows_wal["bytes_per_particle"],
+        "history_bytes_per_particle_columnar": (
+            out["columnar_store"].get("bytes_per_particle")
+            if has_pyarrow() else None),
+        "wal_speedup_x": out["wal_speedup_x"],
+    }
+    out["lane_s"] = round(CLOCK.now() - t_lane0, 2)
+    return out
+
+
 def main():
     from pyabc_tpu.utils.bench_defaults import (
         DEFAULT_BUDGET_S,
@@ -1709,6 +1860,29 @@ def main():
                 _state["mesh"] = {"error": repr(e)[:300]}
         _state["value"] = float(
             _state["mesh"].get("accepted_particles_per_sec_mesh") or 0.0)
+        _state["partial"] = False
+        _state["budget_used_s"] = round(CLOCK.now() - t_start, 1)
+        _state["phase"] = "done"
+        _emit()
+        return
+
+    # `abc-bench --lane storage`: ONLY the History-ingest lane (host
+    # work — no device, no jax compile; runs inline)
+    if (os.environ.get("PYABC_TPU_BENCH_LANE") or "").strip().lower() \
+            == "storage":
+        _state["phase"] = "storage"
+        _state["metric"] = "history_ingest_rows_per_sec_columnar"
+        storage_skip = storage_lane_skip_reason()
+        if storage_skip:
+            _state["storage"] = {"skipped": storage_skip}
+        else:
+            try:
+                _state["storage"] = run_storage_lane(
+                    budget - max(5.0, 0.05 * budget))
+            except Exception as e:
+                _state["storage"] = {"error": repr(e)[:300]}
+        _state["value"] = float(_state["storage"].get("value") or 0.0)
+        _state["util"] = _state["storage"].get("util", {})
         _state["partial"] = False
         _state["budget_used_s"] = round(CLOCK.now() - t_start, 1)
         _state["phase"] = "done"

@@ -83,7 +83,8 @@ def export_cmd(db, run_id, what, time_point, m, fmt, out):
 @click.option("--budget-s", type=float, default=DEFAULT_BUDGET_S,
               help="walltime budget in seconds")
 @click.option("--cpu", is_flag=True, help="force the CPU platform")
-@click.option("--lane", type=click.Choice(["all", "mesh", "serve"]),
+@click.option("--lane",
+              type=click.Choice(["all", "mesh", "serve", "storage"]),
               default="all",
               help="run only one bench lane: 'mesh' runs the sharded "
                    "multi-device lane (the MULTICHIP dryrun promoted to "
@@ -91,8 +92,10 @@ def export_cmd(db, run_id, what, time_point, m, fmt, out):
                    "when no multi-device platform exists); 'serve' runs "
                    "the multi-tenant chaos lane (N CPU tenants with "
                    "injected kills — guards isolation, fairness and the "
-                   "kernel-cache hit rate). Requires a repo checkout "
-                   "(bench.py).")
+                   "kernel-cache hit rate); 'storage' measures History "
+                   "ingest (row store WAL on/off vs the columnar "
+                   "generation-batch store, >=10x regression guard). "
+                   "Requires a repo checkout (bench.py).")
 def bench_cmd(pop, gens, budget_s, cpu, lane):
     """Run the Lotka-Volterra throughput benchmark (one JSON line)."""
     if cpu:

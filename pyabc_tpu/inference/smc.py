@@ -2831,6 +2831,25 @@ class ABCSMC:
                 else:
                     last_deferred = (_build, current_eps, acceptance_rate)
                     pop_arg = (lambda b=_build: b()[1])
+                if self.history.columnar:
+                    # columnar store: the packed-fetch arrays go to the
+                    # History AS-IS (narrow dtypes, slot order) wrapped
+                    # in a GenerationBatch — no Population round-trip
+                    # for persistence; sort + weight normalization run
+                    # on the writer thread and land bit-identical to
+                    # the row store's values (the host-side last_pop
+                    # above is still built where refits need it)
+                    from ..storage.columnar import GenerationBatch
+
+                    pop_arg = GenerationBatch.from_fetch(
+                        ms=fetched["m"][g][:n],
+                        thetas=fetched["theta"][g][:n],
+                        log_weights=fetched["log_weight"][g][:n],
+                        distances=fetched["distance"][g][:n],
+                        sumstats=ss_raw,
+                        slots=fetched["slot"][g][:n],
+                        param_names=[list(s.names) for s in self._spaces()],
+                    )
                 self.history.append_population_async(
                     t, current_eps, pop_arg, nr_evals, self.model_names,
                     telemetry={

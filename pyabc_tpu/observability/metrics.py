@@ -282,6 +282,20 @@ DEVICES_LOST_TOTAL = "pyabc_tpu_devices_lost_total"
 TENANT_DEVICE_LOSS_REQUEUES_TOTAL = \
     "pyabc_tpu_tenant_device_loss_requeues_total"
 
+# -- History storage instrument names (round 17) ------------------------------
+#
+# The columnar generation-batch backend's ingest accounting; one
+# canonical place so History, the serve API and the bench `storage`
+# lane agree:
+#:  accepted particles persisted per second by the LAST append (row or
+#:  columnar store; measured on the thread that executed the write, so
+#:  with an async writer it reflects true ingest, not queue time)
+HISTORY_INGEST_ROWS_PER_SEC_GAUGE = "pyabc_tpu_history_ingest_rows_per_sec"
+#:  bytes on disk attributable to this History's current run after the
+#:  last append (columnar: sum of the run's generation files; rows:
+#:  sqlite main db + WAL)
+HISTORY_BYTES_ON_DISK_GAUGE = "pyabc_tpu_history_bytes_on_disk"
+
 
 def health_event_metric(kind: str) -> str:
     """Per-kind health-event counter name — the registry's stand-in for

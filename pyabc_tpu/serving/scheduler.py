@@ -215,9 +215,14 @@ class RunScheduler:
             if tid in self._tenants:
                 raise AdmissionRejectedError(
                     f"tenant id {tid!r} already exists", retry_after_s=None)
+            # the URL scheme carries the tenant's store choice, so every
+            # re-open (requeue-resume load(), parity helpers, dashboards)
+            # picks the right backend without out-of-band state
+            scheme = ("sqlite+columnar" if spec.store == "columnar"
+                      else "sqlite")
             tenant = Tenant(
                 tid, spec, clock=self.clock,
-                db_path=f"sqlite:///{self.base_dir}/{tid}.db",
+                db_path=f"{scheme}:///{self.base_dir}/{tid}.db",
                 checkpoint_path=os.path.join(self.base_dir, f"{tid}.ck"),
             )
             self._tenants[tid] = tenant

@@ -126,6 +126,13 @@ class TenantSpec:
     #: that opts out trades the smaller fetch/db for failing its
     #: requeue (first attempts are unaffected)
     store_sum_stats: bool | int = True
+    #: History backend for this tenant's private db: "rows" (reference
+    #: SQL layout) or "columnar" (round 17 — SQL metadata + one Parquet
+    #: record batch per generation, written straight from the packed
+    #: fetch; needs the optional pyarrow). The scheduler encodes the
+    #: choice in the tenant's db URL scheme, so requeue-resume and the
+    #: parity helpers re-open it self-describingly.
+    store: str = "rows"
     minimum_epsilon: float | None = None
     max_walltime_s: float | None = None
     params: dict = field(default_factory=dict)
@@ -150,6 +157,17 @@ class TenantSpec:
             raise ValueError("generations must be >= 1")
         if int(self.fused_generations) < 1:
             raise ValueError("fused_generations must be >= 1")
+        if self.store not in ("rows", "columnar"):
+            raise ValueError(
+                f"store must be 'rows' or 'columnar', got {self.store!r}")
+        if self.store == "columnar":
+            from ..storage.columnar import has_pyarrow
+
+            if not has_pyarrow():
+                raise ValueError(
+                    "store='columnar' needs the optional 'pyarrow' "
+                    "package on the serving host (pip install pyarrow); "
+                    "submit with store='rows' instead")
         if self.sharded is not None:
             n = int(self.sharded)
             if n < 2 or n & (n - 1):
@@ -191,6 +209,7 @@ class TenantSpec:
             "sharded": (None if self.sharded is None
                         else int(self.sharded)),
             "store_sum_stats": self.store_sum_stats,
+            "store": self.store,
             "minimum_epsilon": self.minimum_epsilon,
             "max_walltime_s": self.max_walltime_s,
             "params": dict(self.params),

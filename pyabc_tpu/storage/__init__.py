@@ -1,4 +1,5 @@
 from .bytes_storage import df_from_bytes, df_to_bytes, np_from_bytes, np_to_bytes
+from .columnar import ColumnarStore, GenerationBatch
 from .history import (
     PRE_TIME,
     History,
@@ -10,5 +11,6 @@ from .history import (
 __all__ = [
     "History", "PRE_TIME", "create_sqlite_db_id",
     "WriterPool", "PooledWriter",
+    "ColumnarStore", "GenerationBatch",
     "np_to_bytes", "np_from_bytes", "df_to_bytes", "df_from_bytes",
 ]

@@ -1,0 +1,471 @@
+"""Columnar generation-batch storage: Arrow-native History ingest.
+
+The dual-basis gap this closes (ROADMAP "Columnar History"): at
+scenario-zoo scale (10^5-10^6 particles x generations x tenants) every
+accepted particle used to fan out into per-row ``particles`` /
+``parameters`` / ``samples`` SQL inserts — the async writer, not the
+fused kernel, became the throughput ceiling. The wire format is already
+columnar: the packed fetch ships ONE narrowed ``[theta|distance|
+log_weight]`` buffer per chunk. This module lands each
+``append_population`` as one Parquet record batch written straight from
+those arrays — no per-particle Python round-trips, narrow fetch dtypes
+(float16/bfloat16->float32 upcast only where Parquet requires) preserved
+on disk instead of widened to REAL.
+
+Layout (hybrid store, selected by ``History(store="columnar")`` or a
+``sqlite+columnar:///`` / ``columnar:///`` db URL): run/population/model
+METADATA stays in the SQL store (``abc_smc``/``populations``/``models``
+rows, observed data at PRE_TIME), while per-particle payloads
+(particles/parameters/sumstats) land as one file per generation under a
+sidecar directory next to the sqlite file::
+
+    <db>.columnar/run<abc_id>/t<t>.parquet
+
+Durability contracts carried over verbatim from the row store:
+
+- files are written tmp + ``os.replace`` BEFORE the metadata commit, so
+  a generation is visible iff both the file and its ``populations`` row
+  exist (an orphan file without a row is invisible and overwritten on
+  re-append);
+- ``prune_from`` deletes metadata rows first (commit), then the
+  generation files — the resume seam sees row-truth either way;
+- reads auto-detect per generation (file present -> columnar), so a
+  plain ``History(db)`` opened on a columnar-written db — the serving
+  parity helpers do exactly this — reads it transparently.
+
+pyarrow is OPTIONAL (the ``bytes_storage._has_parquet`` gating
+contract): selecting the columnar store without it raises an informative
+ImportError at construction; the default row store never imports it.
+"""
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+import numpy as np
+
+#: file-level schema version (bump on layout changes; readers reject
+#: newer versions loudly instead of misparsing)
+SCHEMA_VERSION = 1
+
+#: key the run metadata rides under in the Parquet key-value metadata
+_META_KEY = b"pyabc_tpu"
+
+
+def has_pyarrow() -> bool:
+    """Single gating predicate (mirrors ``bytes_storage._has_parquet``)."""
+    from .bytes_storage import _has_parquet
+
+    return _has_parquet()
+
+
+def require_pyarrow(context: str):
+    """Import pyarrow or raise the informative gating error."""
+    try:
+        import pyarrow  # noqa: F401
+        import pyarrow.parquet  # noqa: F401
+
+        return pyarrow
+    except ImportError as err:
+        raise ImportError(
+            f"{context} needs the optional 'pyarrow' package "
+            f"(pip install pyarrow); the default row store "
+            f"(History(store='rows'), plain sqlite:/// urls) works "
+            f"without it"
+        ) from err
+
+
+def _storage_dtype(dt: np.dtype) -> np.dtype:
+    """The on-disk dtype for a fetched array: narrow dtypes are kept
+    (float16 round-trips through Parquet), except bfloat16 — which
+    Parquet has no physical type for — upcast to float32 (exact)."""
+    dt = np.dtype(dt)
+    if dt.kind == "V" or dt.name == "bfloat16":  # ml_dtypes.bfloat16
+        return np.dtype(np.float32)
+    return dt
+
+
+class GenerationBatch:
+    """One generation's accepted particles as raw packed-fetch arrays.
+
+    The fused chunk loop hands THIS to ``History.append_population`` for
+    columnar-store runs instead of a deferred ``Population`` builder:
+    normalization (slot-order sort + stable exp of log weights) runs in
+    :meth:`materialize` on the async writer thread, and the narrow fetch
+    dtypes survive all the way to disk. The normalization pipeline
+    replicates ``Sample.set_accepted`` + ``Population.__init__`` bit for
+    bit, so a columnar run's stored posterior is IDENTICAL to the same
+    seed's row-store posterior.
+
+    A materialized batch also quacks enough like a ``Population``
+    (``ms``/``weights``/``distances``/``thetas``/``sumstats``/``spaces``/
+    ``model_probabilities_array``/``get_alive_models``) for the ROW store
+    to persist it — the bench's apples-to-apples ingest comparison feeds
+    the same batches to both stores.
+    """
+
+    def __init__(self, *, ms, thetas, weights, distances, sumstats,
+                 param_names, log_weights=None, slots=None):
+        #: per-model parameter names (column order of the theta matrix)
+        self.param_names = [list(names) for names in param_names]
+        self._ms = ms
+        self._thetas = thetas
+        self._log_weights = log_weights
+        self._weights = weights
+        self._distances = distances
+        self._sumstats = sumstats
+        self._slots = slots
+        self._materialized = log_weights is None and slots is None
+        self._f64 = {}
+
+    # ------------------------------------------------------ construction
+    @classmethod
+    def from_fetch(cls, *, ms, thetas, log_weights, distances, sumstats,
+                   slots, param_names) -> "GenerationBatch":
+        """Wrap raw packed-fetch slices (narrow dtypes, proposal-slot
+        order pending); normalization is deferred to the writer thread."""
+        return cls(ms=ms, thetas=thetas, weights=None, distances=distances,
+                   sumstats=sumstats, param_names=param_names,
+                   log_weights=log_weights, slots=slots)
+
+    @classmethod
+    def from_population(cls, pop) -> "GenerationBatch":
+        """Adapt an already-normalized Population (host sampler paths)."""
+        return cls(
+            ms=pop.ms, thetas=pop.thetas, weights=pop.weights,
+            distances=pop.distances, sumstats=pop.sumstats,
+            param_names=[list(s.names) for s in pop.spaces],
+        )
+
+    def materialize(self) -> "GenerationBatch":
+        """Sort by eval-slot id and normalize weights — the exact
+        ``Sample.set_accepted`` -> ``Population.__init__`` pipeline, so
+        the stored arrays are bit-identical to the row-store path's."""
+        if self._materialized:
+            return self
+        from ..sampler.base import exp_normalize_log_weights
+
+        order = np.argsort(np.asarray(self._slots), kind="stable")
+        self._ms = np.asarray(self._ms)[order]
+        self._thetas = np.asarray(self._thetas)[order]
+        self._distances = np.asarray(self._distances)[order]
+        if self._sumstats is not None:
+            self._sumstats = np.asarray(self._sumstats)[order]
+        log_w = np.asarray(self._log_weights)[order]
+        w = exp_normalize_log_weights(log_w)
+        total = w.sum()
+        if not np.isfinite(total) or total <= 0:
+            raise ValueError(f"population total weight invalid: {total}")
+        self._weights = w / total
+        self._log_weights = self._slots = None
+        self._materialized = True
+        return self
+
+    # ------------------------------------- raw (narrow-dtype) accessors
+    @property
+    def ms(self) -> np.ndarray:
+        self.materialize()
+        return np.asarray(self._ms, np.int32)
+
+    @property
+    def weights(self) -> np.ndarray:
+        self.materialize()
+        return np.asarray(self._weights, np.float64)
+
+    @property
+    def thetas_raw(self) -> np.ndarray:
+        self.materialize()
+        return np.asarray(self._thetas)
+
+    @property
+    def distances_raw(self) -> np.ndarray:
+        self.materialize()
+        return np.asarray(self._distances)
+
+    @property
+    def sumstats_raw(self) -> np.ndarray | None:
+        self.materialize()
+        return (np.asarray(self._sumstats)
+                if self._sumstats is not None else None)
+
+    # ------------------------- Population-compatible (row-store) surface
+    def _widened(self, name, raw):
+        if name not in self._f64:
+            self._f64[name] = np.asarray(raw, np.float64)
+        return self._f64[name]
+
+    @property
+    def thetas(self) -> np.ndarray:
+        return self._widened("thetas", self.thetas_raw)
+
+    @property
+    def distances(self) -> np.ndarray:
+        return self._widened("distances", self.distances_raw)
+
+    @property
+    def sumstats(self) -> np.ndarray | None:
+        raw = self.sumstats_raw
+        return None if raw is None else self._widened("sumstats", raw)
+
+    @property
+    def spaces(self):
+        from ..core.parameters import ParameterSpace
+
+        return [ParameterSpace(names) for names in self.param_names]
+
+    def model_probabilities_array(self) -> np.ndarray:
+        probs = np.zeros(len(self.param_names))
+        np.add.at(probs, self.ms, self.weights)
+        return probs
+
+    def get_alive_models(self) -> list[int]:
+        return [int(m) for m in np.unique(self.ms)]
+
+    def __len__(self) -> int:
+        self.materialize()
+        return len(np.asarray(self._ms))
+
+
+class ColumnarStore:
+    """One-file-per-generation Parquet persistence under a run directory.
+
+    Owned by a :class:`~pyabc_tpu.storage.history.History`; all calls run
+    under the History's lock (no locking here). Pure storage: the
+    within-model weight normalization written to disk is computed with
+    the SAME float64 operations the row store applies, so every read
+    path is bit-compatible across stores.
+    """
+
+    def __init__(self, root: str | Path):
+        self.root = Path(root)
+
+    # ------------------------------------------------------------- paths
+    def run_dir(self, abc_id: int) -> Path:
+        return self.root / f"run{int(abc_id)}"
+
+    def gen_path(self, abc_id: int, t: int) -> Path:
+        return self.run_dir(abc_id) / f"t{int(t)}.parquet"
+
+    def has(self, abc_id: int | None, t: int) -> bool:
+        return abc_id is not None and self.gen_path(abc_id, t).is_file()
+
+    def bytes_on_disk(self, abc_id: int) -> int:
+        d = self.run_dir(abc_id)
+        if not d.is_dir():
+            return 0
+        return sum(p.stat().st_size for p in d.glob("t*.parquet"))
+
+    # ------------------------------------------------------------- write
+    def write_generation(self, abc_id: int, t: int, pop,
+                         store_sumstats: bool) -> tuple[int, int]:
+        """Persist one generation's particles as a single record batch.
+
+        ``pop`` is a Population or materialized GenerationBatch. Rows are
+        grouped by alive model in ascending-m order, within a model in
+        slot order — exactly the row store's particle-id order. Returns
+        ``(n_rows, file_bytes)``.
+        """
+        pa = require_pyarrow("the columnar History store")
+        import pyarrow.parquet as pq
+
+        ms = np.asarray(pop.ms)
+        weights = np.asarray(pop.weights, np.float64)
+        thetas = np.asarray(getattr(pop, "thetas_raw", pop.thetas))
+        dists = np.asarray(getattr(pop, "distances_raw", pop.distances))
+        ss = getattr(pop, "sumstats_raw", pop.sumstats) \
+            if store_sumstats else None
+        probs = pop.model_probabilities_array()
+        alive = pop.get_alive_models()
+
+        # per-model grouping + within-model weights, float-op-identical
+        # to the row store's inserted values
+        idx = np.concatenate(
+            [np.flatnonzero(ms == m) for m in alive]
+        ) if alive else np.zeros(0, np.int64)
+        w_model = np.concatenate(
+            [weights[ms == m] / probs[m] for m in alive]
+        ) if alive else np.zeros(0, np.float64)
+
+        theta_dt = _storage_dtype(thetas.dtype)
+        dist_dt = _storage_dtype(dists.dtype)
+        n, d_max = thetas.shape
+        cols = {
+            "m": pa.array(ms[idx].astype(np.int32), pa.int32()),
+            "w": pa.array(w_model, pa.float64()),
+            "distance": pa.array(dists[idx].astype(dist_dt, copy=False)),
+        }
+        theta_flat = np.ascontiguousarray(
+            thetas[idx].astype(theta_dt, copy=False)).reshape(-1)
+        cols["theta"] = pa.FixedSizeListArray.from_arrays(
+            pa.array(theta_flat), d_max)
+        meta = {
+            "v": SCHEMA_VERSION,
+            "abc_id": int(abc_id),
+            "t": int(t),
+            "n": int(n),
+            "param_names": [list(names) for names in pop.param_names]
+            if hasattr(pop, "param_names")
+            else [list(s.names) for s in pop.spaces],
+            "theta_dtype": theta_dt.name,
+            "distance_dtype": dist_dt.name,
+        }
+        if ss is not None:
+            ss = np.asarray(ss)
+            ss_dt = _storage_dtype(ss.dtype)
+            ss_flat = np.ascontiguousarray(
+                ss[idx].astype(ss_dt, copy=False)).reshape(-1)
+            cols["sumstat"] = pa.FixedSizeListArray.from_arrays(
+                pa.array(ss_flat), int(ss.shape[1]))
+            meta["sumstat_dtype"] = ss_dt.name
+        table = pa.table(cols).replace_schema_metadata(
+            {_META_KEY: json.dumps(meta).encode()})
+
+        path = self.gen_path(abc_id, t)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(".tmp")
+        # accepted thetas/distances are high-entropy floats: compression
+        # buys little and costs writer-thread CPU — store plain pages
+        pq.write_table(table, tmp, compression="none")
+        os.replace(tmp, path)
+        return int(n), path.stat().st_size
+
+    # -------------------------------------------------------------- read
+    def _load(self, abc_id: int, t: int) -> tuple[dict, dict]:
+        """(columns as numpy arrays, run metadata) for one generation."""
+        require_pyarrow("reading a columnar-store History generation")
+        import pyarrow.parquet as pq
+
+        table = pq.read_table(self.gen_path(abc_id, t))
+        raw_meta = (table.schema.metadata or {}).get(_META_KEY)
+        meta = json.loads(raw_meta.decode()) if raw_meta else {}
+        if meta.get("v", SCHEMA_VERSION) > SCHEMA_VERSION:
+            raise ValueError(
+                f"columnar generation file {self.gen_path(abc_id, t)} has "
+                f"schema v{meta['v']} > supported v{SCHEMA_VERSION}"
+            )
+        n = table.num_rows
+        cols = {
+            "m": table["m"].combine_chunks().to_numpy(),
+            "w": table["w"].combine_chunks().to_numpy(),
+            "distance": np.asarray(
+                table["distance"].combine_chunks().to_numpy(
+                    zero_copy_only=False)),
+        }
+        for name in ("theta", "sumstat"):
+            if name in table.column_names:
+                fsl = table[name].combine_chunks()
+                width = fsl.type.list_size
+                flat = np.asarray(
+                    fsl.values.to_numpy(zero_copy_only=False))
+                cols[name] = flat.reshape(n, width)
+        return cols, meta
+
+    def n_particles(self, abc_id: int, t: int) -> int:
+        """Row count from the Parquet footer (no data pages read)."""
+        require_pyarrow("reading a columnar-store History generation")
+        import pyarrow.parquet as pq
+
+        return pq.ParquetFile(
+            self.gen_path(abc_id, t)).metadata.num_rows
+
+    def distribution(self, abc_id: int, t: int, m: int):
+        """(parameter DataFrame, within-model normalized weights) —
+        the row store's ``get_distribution`` contract (columns sorted by
+        name, rows in particle-id order)."""
+        import pandas as pd
+
+        cols, meta = self._load(abc_id, t)
+        mask = cols["m"] == int(m)
+        if not mask.any():
+            raise KeyError(f"no particles for model {m} at t={t}")
+        names = list(meta["param_names"][int(m)])
+        theta = np.asarray(cols["theta"][mask], np.float64)
+        # the SQL read path pivots on parameter name, which sorts
+        # columns alphabetically — match it so transition refits see the
+        # same column order either way
+        order = sorted(range(len(names)), key=lambda i: names[i])
+        df = pd.DataFrame(
+            {names[i]: theta[:, i] for i in order})
+        w = np.asarray(cols["w"][mask], np.float64)
+        return df, w / w.sum()
+
+    def parameter_names(self, abc_id: int, t: int, m: int) -> list[str]:
+        _, meta = self._load(abc_id, t)
+        try:
+            return sorted(meta["param_names"][int(m)])
+        except (KeyError, IndexError):
+            raise KeyError(f"no particles for model {m} at t={t}")
+
+    def weighted_distances(self, abc_id: int, t: int,
+                           p_by_m: dict[int, float]):
+        """['distance', 'w'] with overall-normalized weights — the
+        ``particles.w * models.p_model`` join, computed in float64."""
+        import pandas as pd
+
+        cols, _ = self._load(abc_id, t)
+        p = np.asarray([p_by_m.get(int(m), 0.0) for m in cols["m"]],
+                       np.float64)
+        return pd.DataFrame({
+            "distance": np.asarray(cols["distance"], np.float64),
+            "w": cols["w"] * p,
+        })
+
+    def weighted_sum_stats(self, abc_id: int, t: int,
+                           p_by_m: dict[int, float]):
+        """(overall weights, float64 sumstat matrix) or None when the
+        generation was stored without sum stats."""
+        cols, _ = self._load(abc_id, t)
+        if "sumstat" not in cols:
+            return None
+        p = np.asarray([p_by_m.get(int(m), 0.0) for m in cols["m"]],
+                       np.float64)
+        return cols["w"] * p, np.asarray(cols["sumstat"], np.float64)
+
+    def population_extended(self, abc_id: int, t: int,
+                            model_names: dict[int, str]):
+        """The row store's ``get_population_extended`` melt: one row per
+        (particle, parameter)."""
+        import pandas as pd
+
+        cols, meta = self._load(abc_id, t)
+        frames = []
+        for m in np.unique(cols["m"]):
+            mask = cols["m"] == m
+            names = list(meta["param_names"][int(m)])
+            theta = np.asarray(cols["theta"][mask], np.float64)
+            k = len(names)
+            frames.append(pd.DataFrame({
+                "m": np.repeat(cols["m"][mask], k),
+                "model_name": model_names.get(int(m), f"m{int(m)}"),
+                "w": np.repeat(cols["w"][mask], k),
+                "distance": np.repeat(
+                    np.asarray(cols["distance"][mask], np.float64), k),
+                "par_name": np.tile(np.asarray(names, object), mask.sum()),
+                "par_value": theta[:, :k].reshape(-1),
+            }))
+        return (pd.concat(frames, ignore_index=True) if frames
+                else pd.DataFrame(columns=[
+                    "m", "model_name", "w", "distance",
+                    "par_name", "par_value"]))
+
+    # ------------------------------------------------------------- prune
+    def prune(self, abc_id: int, t_from: int) -> int:
+        """Delete this run's generation files with t >= ``t_from``.
+
+        Called AFTER the metadata-row delete committed: rows are the
+        visibility truth, so a crash between the commit and the unlink
+        leaves only invisible orphans (overwritten on re-append)."""
+        d = self.run_dir(abc_id)
+        if not d.is_dir():
+            return 0
+        removed = 0
+        for p in d.glob("t*.parquet"):
+            try:
+                t = int(p.stem[1:])
+            except ValueError:
+                continue
+            if t >= int(t_from):
+                p.unlink(missing_ok=True)
+                removed += 1
+        return removed

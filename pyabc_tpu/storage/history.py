@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import datetime
 import json
+import os
 import sqlite3
 from pathlib import Path
 
@@ -94,6 +95,19 @@ def _db_path(db: str) -> str:
     if db.startswith("sqlite:///"):
         return db[len("sqlite:///"):]
     return db
+
+
+def _parse_store_url(db: str) -> tuple[str, str | None]:
+    """(sql url, store hint) for a History db url.
+
+    ``columnar:///x.db`` and ``sqlite+columnar:///x.db`` select the
+    hybrid columnar store (SQL metadata + one Parquet file per
+    generation); everything else carries no hint (row store unless
+    ``History(store=...)`` overrides)."""
+    for prefix in ("sqlite+columnar:", "columnar:"):
+        if db.startswith(prefix):
+            return "sqlite:" + db[len(prefix):], "columnar"
+    return db, None
 
 
 def _locked(fn):
@@ -424,10 +438,23 @@ class History:
 
     def __init__(self, db: str, _id: int | None = None,
                  store_sum_stats: bool | int = True, *,
-                 tracer=None, metrics=None):
+                 tracer=None, metrics=None, store: str | None = None,
+                 wal: bool = True):
         import threading
 
+        #: the ORIGINAL url (scheme preserved): serving/tests re-open
+        #: tenant db paths verbatim and the scheme is self-describing
         self.db = db
+        db, url_store = _parse_store_url(db)
+        if store is None:
+            store = url_store or "rows"
+        if store not in ("rows", "columnar"):
+            raise ValueError(
+                f"History store must be 'rows' or 'columnar', got {store!r}")
+        #: "rows" = everything in SQL (reference layout); "columnar" =
+        #: hybrid (SQL metadata, one Parquet record batch per generation
+        #: written straight from the packed-fetch arrays)
+        self.store = store
         #: per-particle summary-statistic retention policy: ``True`` stores
         #: every generation (reference behavior), ``False`` stores none, an
         #: int k stores every k-th generation (t % k == 0). Skipping sum
@@ -449,6 +476,9 @@ class History:
         self.metrics = metrics if metrics is not None else NULL_METRICS
         self._lock = threading.RLock()
         self._writer: _AsyncWriter | PooledWriter | None = None
+        #: last append's ingest accounting ({rows, s, rows_per_sec,
+        #: bytes_on_disk}); None until the first append lands
+        self.last_ingest: dict | None = None
         #: opt-in shared writer threads (round 14, multi-tenant serving):
         #: set to a :class:`WriterPool` BEFORE the first
         #: ``start_async_writer`` call and queued appends drain on the
@@ -459,6 +489,39 @@ class History:
         self.writer_scope: str = ""
         with self.tracer.span("db.setup", db=db):
             self._conn, self._dialect = open_database(db, _db_path)
+            sqlite_path = (_db_path(db) if self._dialect.name == "sqlite"
+                           else None)
+            if (wal and sqlite_path is not None
+                    and sqlite_path != ":memory:"):
+                # WAL + synchronous=NORMAL: appends no longer rewrite
+                # the rollback journal and fsync once per commit instead
+                # of twice — measured in the bench `storage` lane;
+                # guarded to the sqlite dialect (postgres has its own
+                # WAL and rejects these pragmas)
+                self._conn.execute("PRAGMA journal_mode=WAL")
+                self._conn.execute("PRAGMA synchronous=NORMAL")
+            # the columnar sidecar: ACTIVE (written) when store=columnar;
+            # otherwise a read-only prober so a plain History(db) opened
+            # on a columnar-written db still reads every generation
+            from .columnar import ColumnarStore, require_pyarrow
+
+            if self.store == "columnar":
+                require_pyarrow("History(store='columnar')")
+                if sqlite_path is None:
+                    raise ValueError(
+                        "the columnar store keeps metadata in a run-local "
+                        "sqlite file; postgres metadata urls are not "
+                        "supported (use store='rows')")
+                if sqlite_path == ":memory:":
+                    import tempfile
+
+                    sqlite_path = tempfile.mkdtemp(
+                        prefix="pyabc_tpu_columnar_") + "/mem.db"
+            self._colstore = (
+                ColumnarStore(sqlite_path + ".columnar")
+                if sqlite_path is not None and sqlite_path != ":memory:"
+                else None
+            )
             self._conn.executescript(_SCHEMA)
             # schema migration for dbs created before the telemetry column
             cols = self._dialect.table_columns(self._conn, "populations")
@@ -504,6 +567,14 @@ class History:
     def flush(self) -> None:
         if self._writer is not None:
             self._writer.flush()
+
+    @property
+    def columnar(self) -> bool:
+        """True when appends land as columnar generation batches — the
+        fused loop checks this to hand the packed-fetch arrays through
+        (a :class:`~pyabc_tpu.storage.columnar.GenerationBatch`) instead
+        of materializing a Population for persistence."""
+        return self.store == "columnar"
 
     def wants_sum_stats(self, t: int) -> bool:
         """Whether generation t's per-particle sum stats should be stored
@@ -586,12 +657,19 @@ class History:
     def append_population(self, t: int, current_epsilon: float, population,
                           nr_simulations: int, model_names: list[str],
                           telemetry: dict | None = None) -> None:
+        from .columnar import GenerationBatch
+
         if callable(population):
             # deferred construction: the fused loop ships raw device-fetched
             # arrays and a builder; normalization + Population construction
             # then run HERE — on the async writer thread when one is active —
             # instead of on the latency-critical chunk-processing thread
             population = population()
+        if isinstance(population, GenerationBatch):
+            # same deferral for the columnar path: slot-order sort +
+            # weight normalization run on the writer thread, and the
+            # narrow fetch dtypes ride through to disk untouched
+            population = population.materialize()
         with self._lock:
             try:
                 self._append_population_locked(
@@ -611,6 +689,7 @@ class History:
     def _append_population_locked(self, t, current_epsilon, population,
                                   nr_simulations, model_names,
                                   telemetry) -> None:
+        t_in0 = self.tracer.clock.now()
         cur = self._conn.cursor()
         try:
             # grab the write lock up front: the batched particle insert
@@ -628,7 +707,6 @@ class History:
         )
         pop_id = cur.lastrowid
         probs = population.model_probabilities_array()
-        spec = population.sumstat_spec
         for m in population.get_alive_models():
             cur.execute(
                 "INSERT INTO models (population_id, m, name, p_model) "
@@ -637,7 +715,42 @@ class History:
                  model_names[m] if m < len(model_names) else f"m{m}",
                  float(probs[m])),
             )
-            model_id = cur.lastrowid
+        if self.columnar:
+            # hybrid mode: model/population metadata rows above stay in
+            # SQL; the particle payload lands as ONE Parquet record
+            # batch, written (tmp + rename) BEFORE the metadata commit
+            # so a generation is visible iff file and row both exist
+            n_rows, _ = self._colstore.write_generation(
+                self.id, int(t), population,
+                store_sumstats=(population.sumstats is not None
+                                and self.wants_sum_stats(t)),
+            )
+            self._conn.commit()
+            self._note_ingest(n_rows, t_in0)
+            return
+        self._append_particle_rows_locked(cur, pop_id, t, population, probs)
+        self._conn.commit()
+        self._note_ingest(len(population.ms), t_in0)
+
+    def _append_particle_rows_locked(self, cur, pop_id, t, population,
+                                     probs) -> None:
+        """The row-store particle fan-out (reference ORM layout)."""
+        # one id allocation per append (NOT per alive model): the
+        # explicit-id insert below only needs a base the whole append's
+        # rows build on — re-running MAX(id) inside the loop re-scanned
+        # the table once per model inside the write transaction
+        base = cur.execute(
+            "SELECT COALESCE(MAX(id), 0) FROM particles"
+        ).fetchone()[0]
+        # models rows were just inserted in alive-model order; recover
+        # their ids for the particle foreign keys
+        model_ids = {
+            int(m): mid for mid, m in cur.execute(
+                "SELECT id, m FROM models WHERE population_id=?", (pop_id,)
+            ).fetchall()
+        }
+        for m in population.get_alive_models():
+            model_id = model_ids[int(m)]
             mask = population.ms == m
             idxs = np.flatnonzero(mask)
             space = population.spaces[m]
@@ -646,10 +759,8 @@ class History:
             # batched inserts with explicit particle ids: one executemany per
             # table instead of 2+d statements per particle (at pop sizes of
             # 10^3-10^5 the per-row Python round-trips dominate persistence)
-            base = cur.execute(
-                "SELECT COALESCE(MAX(id), 0) FROM particles"
-            ).fetchone()[0]
             pids = range(base + 1, base + 1 + len(idxs))
+            base += len(idxs)
             cur.executemany(
                 "INSERT INTO particles (id, model_id, w, distance) "
                 "VALUES (?,?,?,?)",
@@ -671,7 +782,42 @@ class History:
                     [(pid, "__flat__", np_to_bytes(population.sumstats[i]))
                      for pid, i in zip(pids, idxs)],
                 )
-        self._conn.commit()
+
+    def _note_ingest(self, n_rows: int, t_in0: float) -> None:
+        """Export the append's ingest accounting (round 17): rows/sec of
+        the write that just committed + this run's bytes on disk."""
+        from ..observability.metrics import (
+            HISTORY_BYTES_ON_DISK_GAUGE,
+            HISTORY_INGEST_ROWS_PER_SEC_GAUGE,
+        )
+
+        dt = self.tracer.clock.now() - t_in0
+        rate = (float(n_rows) / dt) if dt > 0 else 0.0
+        on_disk = 0
+        if self.columnar and self._colstore is not None:
+            on_disk = self._colstore.bytes_on_disk(self.id)
+        else:
+            path = _db_path(_parse_store_url(self.db)[0])
+            if path != ":memory:" and os.path.exists(path):
+                on_disk = os.path.getsize(path)
+                wal = path + "-wal"
+                if os.path.exists(wal):
+                    on_disk += os.path.getsize(wal)
+        self.metrics.gauge(
+            HISTORY_INGEST_ROWS_PER_SEC_GAUGE,
+            "accepted particles persisted per second by the last "
+            "History append",
+        ).set(rate)
+        self.metrics.gauge(
+            HISTORY_BYTES_ON_DISK_GAUGE,
+            "bytes on disk for this History's run after the last append",
+        ).set(float(on_disk))
+        #: last-append accounting for the bench `storage` lane (reading
+        #: the gauges back is registry-dependent; this is the direct tap)
+        self.last_ingest = {
+            "rows": int(n_rows), "s": dt, "rows_per_sec": rate,
+            "bytes_on_disk": int(on_disk),
+        }
 
     @_locked
     def prune_from(self, t: int) -> int:
@@ -713,6 +859,11 @@ class History:
         cur.execute(
             f"DELETE FROM populations WHERE id IN ({ph})", pop_ids)
         self._conn.commit()
+        # columnar generation files go AFTER the metadata commit: rows
+        # are the visibility truth, so a crash between commit and unlink
+        # leaves only invisible orphan files (overwritten on re-append)
+        if self._colstore is not None:
+            self._colstore.prune(self.id, int(t))
         return len(pop_ids)
 
     def update_telemetry(self, t: int, telemetry: dict) -> None:
@@ -758,6 +909,23 @@ class History:
             return self.max_t
         return t
 
+    def _columnar_gen(self, t: int) -> bool:
+        """Whether generation t's particles live in a columnar file.
+
+        Checked per generation (not per store mode) so a plain
+        ``History(db)`` opened on a columnar-written db — or a hybrid db
+        holding runs of both kinds — reads every generation correctly."""
+        return self._colstore is not None and self._colstore.has(self.id, t)
+
+    def _p_by_m(self, pop_id: int) -> dict[int, float]:
+        """{m: p_model} from the (always-SQL) models metadata rows."""
+        return {
+            int(m): float(p) for m, p in self._conn.execute(
+                "SELECT m, p_model FROM models WHERE population_id=?",
+                (pop_id,),
+            ).fetchall()
+        }
+
     @property
     @_locked
     def max_t(self) -> int:
@@ -789,6 +957,8 @@ class History:
         pop_id = self._pop_id(t)
         if pop_id is None:
             raise KeyError(f"no population t={t}")
+        if self._columnar_gen(t):
+            return self._colstore.distribution(self.id, t, int(m))
         df = pd.read_sql_query(
             """
             SELECT particles.id AS pid, particles.w AS w,
@@ -818,6 +988,8 @@ class History:
         pop_id = self._pop_id(t)
         if pop_id is None:
             raise KeyError(f"no population t={t}")
+        if self._columnar_gen(t):
+            return self._colstore.parameter_names(self.id, t, int(m))
         rows = self._conn.execute(
             """
             SELECT DISTINCT parameters.name
@@ -874,13 +1046,22 @@ class History:
             """,
             self._conn, params=(self.id,),
         )
-        return df.set_index("t")["n"]
+        s = df.set_index("t")["n"]
+        # columnar generations have no particle rows in SQL — their
+        # counts come from the Parquet footer (a metadata-only read)
+        for t in s.index:
+            if t >= 0 and s[t] == 0 and self._columnar_gen(int(t)):
+                s[t] = self._colstore.n_particles(self.id, int(t))
+        return s
 
     @_locked
     def get_weighted_distances(self, t: int | None = None) -> pd.DataFrame:
         """['distance', 'w'] with overall-normalized weights (ref API)."""
         t = self._resolve_t(t)
         pop_id = self._pop_id(t)
+        if self._columnar_gen(t):
+            return self._colstore.weighted_distances(
+                self.id, t, self._p_by_m(pop_id))
         df = pd.read_sql_query(
             """
             SELECT particles.distance AS distance,
@@ -897,6 +1078,17 @@ class History:
                                ) -> tuple[np.ndarray, np.ndarray]:
         t = self._resolve_t(t)
         pop_id = self._pop_id(t)
+        if self._columnar_gen(t):
+            res = self._colstore.weighted_sum_stats(
+                self.id, t, self._p_by_m(pop_id))
+            if res is None:
+                raise ValueError(
+                    f"no sum stats stored for generation {t}: the run was "
+                    f"written with store_sum_stats disabled for this "
+                    f"generation (this handle has store_sum_stats="
+                    f"{self.store_sum_stats!r})"
+                )
+            return res
         df = pd.read_sql_query(
             """
             SELECT particles.id AS pid,
@@ -926,6 +1118,14 @@ class History:
     def get_population_extended(self, t: int | None = None) -> pd.DataFrame:
         t = self._resolve_t(t)
         pop_id = self._pop_id(t)
+        if self._columnar_gen(t):
+            names = {
+                int(m): nm for m, nm in self._conn.execute(
+                    "SELECT m, name FROM models WHERE population_id=?",
+                    (pop_id,),
+                ).fetchall()
+            }
+            return self._colstore.population_extended(self.id, t, names)
         return pd.read_sql_query(
             """
             SELECT models.m AS m, models.name AS model_name,

@@ -133,3 +133,12 @@ DEFAULT_SERVE_BUDGET_S = 300.0
 #: fault-free fleet (equal shapes through equal capacity; the bound is
 #: generous because overlap on a 1-core box is scheduler luck)
 SERVE_FAIRNESS_MAX_RATIO = 3.0
+# storage lane (round 17): History ingest — the same pop-16384
+# packed-fetch generations appended to the row store (WAL on/off) and
+# the columnar generation-batch store. pop matches the scale lane's
+# headline population (the ISSUE acceptance scale); 6 generations keep
+# the row-store leg a few seconds on the 1-core box while amortizing
+# per-append setup; the guard is the tentpole's >=10x acceptance line.
+DEFAULT_STORAGE_POP = 16384
+DEFAULT_STORAGE_GENS = 6
+DEFAULT_STORAGE_GUARD_MIN_X = 10.0

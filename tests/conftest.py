@@ -13,6 +13,25 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# CI fallback leg (round 17): PYABC_TPU_BLOCK_PYARROW=1 makes pyarrow
+# unimportable for the whole test process, proving the default row store
+# and every optional-integration gate stay green without it. Installed
+# BEFORE jax/pandas imports so nothing can cache a pyarrow module first.
+if os.environ.get("PYABC_TPU_BLOCK_PYARROW") == "1":
+    import importlib.abc
+    import sys
+
+    class _PyarrowBlocker(importlib.abc.MetaPathFinder):
+        def find_spec(self, name, path=None, target=None):
+            if name == "pyarrow" or name.startswith("pyarrow."):
+                raise ImportError(
+                    f"{name} import blocked (PYABC_TPU_BLOCK_PYARROW=1)")
+            return None
+
+    for _m in [m for m in sys.modules if m.split(".")[0] == "pyarrow"]:
+        del sys.modules[_m]
+    sys.meta_path.insert(0, _PyarrowBlocker())
+
 import jax
 import numpy as np
 import pytest
@@ -40,6 +59,26 @@ setup_xla_cache(
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+def _pyarrow_available() -> bool:
+    from pyabc_tpu.storage.columnar import has_pyarrow
+
+    return has_pyarrow()
+
+
+@pytest.fixture(params=[
+    "sqlite",
+    pytest.param("sqlite+columnar", marks=pytest.mark.skipif(
+        not _pyarrow_available(),
+        reason="columnar History store needs the optional pyarrow")),
+])
+def store_scheme(request):
+    """Both History backends (round 17): tests taking this fixture run
+    once against the row store and once against the columnar store —
+    the durability contracts (resume, prune_from, checkpoint ordering,
+    serving requeue) must hold identically on each."""
+    return request.param
 
 
 @pytest.fixture(autouse=True)

@@ -235,3 +235,32 @@ def test_seed_zero_failure_aborts_cleanly(bench, monkeypatch, capsys):
     d = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert d["partial"] is True  # nothing measured, honestly labeled
     assert any("error" in r for r in d["runs"])
+
+
+def test_storage_lane_measures_both_stores(bench, monkeypatch):
+    """The round-17 History-ingest lane: real (small) ingests against
+    both backends, the >=10x regression guard evaluated, bytes per
+    particle + the WAL delta in the util block."""
+    from pyabc_tpu.observability import SYSTEM_CLOCK
+    from pyabc_tpu.storage.columnar import has_pyarrow
+
+    monkeypatch.setattr(bench, "CLOCK", SYSTEM_CLOCK)
+    monkeypatch.setenv("PYABC_TPU_BENCH_STORAGE_POP", "512")
+    monkeypatch.setenv("PYABC_TPU_BENCH_STORAGE_GENS", "2")
+    out = bench.run_storage_lane(60.0)
+    assert out["rows_store"]["rows_per_sec"] > 0
+    assert out["rows_store"]["bytes_per_particle"] > 0
+    assert out["wal_speedup_x"] > 0
+    assert "history_bytes_per_particle_rows" in out["util"]
+    if has_pyarrow():
+        assert out["columnar_store"]["rows_per_sec"] > 0
+        # the 10x acceptance line is asserted at pop-16384 scale by the
+        # real lane run (BASELINE.md round 17); at pop 512 the parquet
+        # framing overhead only allows a weaker sanity bound
+        assert out["ingest_ratio_columnar_vs_rows"] > 1.0
+        assert isinstance(out["guard_ok"], bool)
+        assert (out["columnar_store"]["bytes_per_particle"]
+                < out["rows_store"]["bytes_per_particle"])
+    else:
+        assert out["columnar_store"] == {"skipped": "pyarrow not installed"}
+        assert out["guard_ok"] is None

@@ -607,8 +607,12 @@ def test_async_writer_transient_exhaustion_latches_sticky():
         h.flush()
 
 
-def test_history_prune_from():
-    h = _history_with_run()
+def test_history_prune_from(tmp_path, store_scheme):
+    # both backends (round 17): the columnar leg must delete generation
+    # FILES together with the metadata rows
+    h = pt.History(f"{store_scheme}:///{tmp_path}/prune.db")
+    h.store_initial_data(None, {}, {"x": np.array([1.0])}, {}, ["m0"],
+                         "{}", "{}", "{}")
     pop = _tiny_population()
     for t in range(3):
         h.append_population(t, 1.0 - 0.2 * t, pop, 5, ["m0"])
@@ -618,6 +622,10 @@ def test_history_prune_from():
     df, w = h.get_distribution(0, 0)  # survivors intact
     assert len(df) == 5
     assert h.prune_from(5) == 0
+    if h.columnar:
+        assert [p.name for p in
+                h._colstore.run_dir(h.id).glob("*.parquet")] \
+            == ["t0.parquet"]
 
 
 # -------------------------------------------------- checkpoint round-trip
@@ -714,11 +722,13 @@ def test_checkpoint_version_mismatch_detected(tmp_path):
         mgr.load()
 
 
-def test_corrupt_checkpoint_falls_back_to_history_resume(tmp_path):
+def test_corrupt_checkpoint_falls_back_to_history_resume(
+        tmp_path, store_scheme):
     """End-to-end: a bit-flipped checkpoint does not block resume — the
     run falls back to generation-granularity History replay (the
-    epsilon-trail path) and completes."""
-    db = f"sqlite:///{tmp_path}/run.db"
+    epsilon-trail path) and completes. Both backends: the columnar leg
+    replays the trail out of the Parquet generations."""
+    db = f"{store_scheme}:///{tmp_path}/run.db"
     ck = str(tmp_path / "carry.ck")
     abc1 = _fused_abc(ck)
     abc1.new(db, {"x": X_OBS})
@@ -758,15 +768,22 @@ def _fused_abc(ckpath, seed=11, pop=200, G=4):
                      checkpoint_path=ckpath)
 
 
-def test_orchestrator_kill_then_resume_mid_chunk(tmp_path):
+def test_orchestrator_kill_then_resume_mid_chunk(tmp_path, store_scheme):
     """The acceptance criterion: kill the orchestrator between chunks,
     resume from the checkpoint, and the fused-loop carry (RNG key data,
     fitted-proposal state, epsilon trail, refit counter) round-trips
     BIT-EXACT — proven end-to-end by the resumed run's populations being
     bit-identical to an uninterrupted seed-matched run, which
     generation-granularity History resume (host refit replay + RNG
-    restart) cannot produce."""
-    db_i = f"sqlite:///{tmp_path}/interrupted.db"
+    restart) cannot produce.
+
+    Parameterized over BOTH History backends (round 17): the
+    db-at-or-ahead-of-checkpoint ordering and the prune-before-rerun
+    seam must hold identically when generations land as columnar
+    Parquet batches — and the interrupted columnar run must end
+    bit-identical to the clean ROW-store reference (cross-store
+    parity)."""
+    db_i = f"{store_scheme}:///{tmp_path}/interrupted.db"
     db_c = f"sqlite:///{tmp_path}/clean.db"
     ck = str(tmp_path / "carry.ck")
     gens = 8

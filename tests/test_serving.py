@@ -228,12 +228,18 @@ def test_chaos_nan_poison_recovers_in_domain(make_scheduler, tmp_path):
 
 # ============================================ lease-expiry requeue (run)
 def test_killed_orchestrator_requeues_and_resumes_bit_identical(
-        make_scheduler, tmp_path):
+        make_scheduler, tmp_path, store_scheme):
     """Satellite: a tenant killed ONCE mid-chunk dies hard (no report);
     the scheduler discovers the dead thread, reclaims the slot,
     requeues the tenant, and the resumed attempt adopts the PR-5
     checkpoint — the final History bit-identical to an uninterrupted
-    seed-matched run."""
+    seed-matched run.
+
+    Parameterized over BOTH History backends (round 17): the columnar
+    tenant's requeue-resume must read its adaptive state back through
+    the Parquet files and end bit-identical to a ROW-store solo
+    reference — the cross-store parity contract."""
+    store = "columnar" if "columnar" in store_scheme else "rows"
     sched = make_scheduler(n_slots=1, max_requeues=1)
     install_fault_plan(FaultPlan([
         # fire on the SECOND chunk-processing of the victim (after one
@@ -241,12 +247,17 @@ def test_killed_orchestrator_requeues_and_resumes_bit_identical(
         FaultRule(site="orchestrator.chunk", kind="kill", after=1,
                   max_fires=1, match="victim"),
     ]))
-    victim = sched.submit(spec_for(seed=31, gens=8),
+    victim = sched.submit(spec_for(seed=31, gens=8, store=store),
                           tenant_id="tenant-victim")
     wait_terminal([victim])
     uninstall_fault_plan()
 
     assert victim.state == COMPLETED, (victim.state, victim.error)
+    if store == "columnar":
+        # the tenant db URL is self-describing: every re-open (the
+        # resume load() above, the parity read below) picks the store
+        # from the scheme alone
+        assert victim.db_path.startswith("sqlite+columnar:///")
     assert victim.requeues == 1 and victim.attempt == 2
     kinds = [e["kind"] for e in victim.events_since(0)]
     assert "lease_reaped" in kinds and "requeued" in kinds
