@@ -932,6 +932,272 @@ def run_health_lane(budget_s: float) -> dict:
     return out
 
 
+# -- scenario lane ------------------------------------------------------------
+
+
+def scenario_lane_skip_reason() -> str | None:
+    """The `scenario` lane (ISSUE 15) measures the segmented
+    early-reject engine on the scenario zoo — tau-leap Gillespie
+    (birth-death headline + stochastic LV), the network SIR with large
+    per-particle state, and K>1 model selection — each with a pps
+    number; the Gillespie lane additionally runs early-reject OFF for
+    the speedup guard and asserts ON/OFF posterior bit-parity plus a
+    host-oracle posterior check. PYABC_TPU_BENCH_SCENARIO=0 disables."""
+    if os.environ.get("PYABC_TPU_BENCH_SCENARIO") == "0":
+        return "disabled via PYABC_TPU_BENCH_SCENARIO=0"
+    return None
+
+
+def run_scenario_lane(budget_s: float, platform: str = "cpu") -> dict:
+    """Scenario-zoo lane. The headline is the Gillespie birth-death
+    EARLY-REJECT contrast: one deep MedianEpsilon run per mode (ON /
+    OFF), pps computed over the LATE-GENERATION WINDOW (acceptance
+    <= the few-percent regime the tentpole targets — early generations
+    barely retire and would dilute an honest measurement; both modes
+    use the identical window). Guards:
+
+    - ``parity_ok``: ON and OFF accepted populations BIT-identical for
+      every generation (the engine's soundness contract);
+    - ``speedup_ok``: late-window accepted-particles/s ON >= 2x OFF
+      (armed only when the run actually reaches the low-acceptance
+      window — the regime where the claim lives);
+    - ``oracle_ok``: ON-run posterior mean of gen 2 within a loose
+      statistical band of a host-path (SingleCoreSampler) run of the
+      same config — device vs host oracle;
+    - ``sync_ok``: the dispatch engine's syncs_per_run budget holds —
+      the segment accounting rides the packed fetch, zero extra syncs.
+    """
+    import jax
+    import numpy as np
+
+    import pyabc_tpu as pt
+    from pyabc_tpu.models import gillespie as g
+    from pyabc_tpu.models import model_selection as msel
+    from pyabc_tpu.models import sir as sir_mod
+    from pyabc_tpu.utils.bench_defaults import (
+        DEFAULT_SCENARIO_GENS,
+        DEFAULT_SCENARIO_POP,
+        DEFAULT_SCENARIO_POP_TPU,
+        DEFAULT_SCENARIO_SEGS,
+        SCENARIO_LATE_ACC,
+        SCENARIO_SPEEDUP_MIN_X,
+    )
+
+    t_lane0 = CLOCK.now()
+    cpu = platform == "cpu"
+    pop = int(os.environ.get(
+        "PYABC_TPU_BENCH_SCENARIO_POP",
+        DEFAULT_SCENARIO_POP if cpu else DEFAULT_SCENARIO_POP_TPU))
+    gens = int(os.environ.get(
+        "PYABC_TPU_BENCH_SCENARIO_GENS", DEFAULT_SCENARIO_GENS))
+    segments = int(os.environ.get(
+        "PYABC_TPU_BENCH_SCENARIO_SEGS", DEFAULT_SCENARIO_SEGS))
+    late_acc = float(os.environ.get(
+        "PYABC_TPU_BENCH_SCENARIO_LATE_ACC", SCENARIO_LATE_ACC))
+    G = 2  # short chunks (G=1 would disable fused chunks entirely):
+    #      per-chunk walls localize the late window at 2-gen granularity
+
+    obs = g.observed_birth_death(segments=segments)
+
+    def build(early, seed=7):
+        abc = pt.ABCSMC(
+            g.make_birth_death_model(segments=segments),
+            g.birth_death_prior(), pt.PNormDistance(p=2),
+            population_size=pop, eps=pt.MedianEpsilon(), seed=seed,
+            early_reject=early, fused_generations=G, tracer=TRACER,
+        )
+        abc.new("sqlite://", obs)
+        return abc
+
+    runs = {}
+    for early in ("auto", False):
+        events = []
+        abc = build(early)
+        abc.chunk_event_cb = lambda ev, es=events: es.append(dict(ev))
+        t0 = CLOCK.now()
+        h = abc.run(max_nr_populations=gens,
+                    max_walltime=budget_s * 0.35)
+        runs[early] = {"abc": abc, "h": h, "events": events,
+                       "run_s": CLOCK.now() - t0}
+
+    if not runs["auto"]["events"] or not runs[False]["events"]:
+        # e.g. a config that silently fell off the fused path — the
+        # lane must fail loudly, not report 0 pps as a measurement
+        return {"error": "fused chunk path did not engage "
+                         "(no chunk events); scenario lane needs the "
+                         "multigen kernel",
+                "lane_s": round(CLOCK.now() - t_lane0, 2)}
+    h_on, h_off = runs["auto"]["h"], runs[False]["h"]
+    gens_done = min(h_on.max_t, h_off.max_t) + 1
+    parity = True
+    for t in range(gens_done):
+        d1, w1 = h_on.get_distribution(m=0, t=t)
+        d2, w2 = h_off.get_distribution(m=0, t=t)
+        parity &= (np.array_equal(np.asarray(d1), np.asarray(d2))
+                   and np.array_equal(w1, w2))
+
+    def window(run, lo, hi):
+        """(wall_s, accepted) summed over the chunks FULLY inside
+        generations [lo, hi] — a straddling chunk would smuggle an
+        above-threshold generation's wall into the late window (chunk
+        walls include their host share)."""
+        wall = acc = 0.0
+        for ev in run["events"]:
+            t_first, n_gens = ev["t_first"], ev["gens"]
+            if n_gens and t_first >= lo and t_first + n_gens - 1 <= hi:
+                wall += ev["chunk_s"]
+                acc += ev["n_acc"]
+        return wall, acc
+
+    # the late window: generations at/below the target acceptance in
+    # BOTH runs (same generation set — parity makes the trails equal)
+    late_from = None
+    for t in range(gens_done):
+        tel = h_off.get_telemetry(t) or {}
+        if tel.get("acceptance_rate", 1.0) <= late_acc:
+            late_from = t
+            break
+    out = {
+        "metric": "accepted_particles_per_sec_gillespie_early_reject",
+        "pop_size": pop, "generations": int(gens_done),
+        "segments": segments, "platform": platform,
+        "parity_ok": bool(parity),
+        "late_acc_threshold": late_acc,
+    }
+    for early, label in (("auto", "on"), (False, "off")):
+        wall, acc = window(runs[early], 1, gens_done - 1)  # excl. gen 0
+        out[f"pps_{label}"] = round(acc / max(wall, 1e-9), 1)
+        out[f"run_s_{label}"] = round(runs[early]["run_s"], 2)
+    if late_from is not None and late_from < gens_done:
+        for early, label in (("auto", "on"), (False, "off")):
+            wall, acc = window(runs[early], late_from, gens_done - 1)
+            out[f"pps_late_{label}"] = round(acc / max(wall, 1e-9), 1)
+        out["late_window_from_t"] = int(late_from)
+        out["speedup_late_x"] = round(
+            out["pps_late_on"] / max(out["pps_late_off"], 1e-9), 2)
+        out["speedup_ok"] = bool(
+            out["speedup_late_x"] >= SCENARIO_SPEEDUP_MIN_X)
+    else:
+        out["late_window_from_t"] = None
+        out["speedup_ok"] = None  # window not reached inside budget
+    out["speedup_run_x"] = round(
+        out["pps_on"] / max(out["pps_off"], 1e-9), 2)
+    # per-chunk walls (both modes) so the window arithmetic is auditable
+    for early, label in (("auto", "on"), (False, "off")):
+        out[f"chunk_walls_{label}"] = [
+            (int(ev["t_first"]), int(ev["gens"]),
+             round(float(ev["chunk_s"]), 2))
+            for ev in runs[early]["events"]
+        ]
+    eng = runs["auto"]["abc"]._engine
+    sync_rep = eng.sync_budget_report() if eng is not None else {}
+    out["sync_ok"] = bool(sync_rep.get("ok", False))
+    out["syncs_per_run"] = int(sync_rep.get("syncs", -1))
+    # early-reject accounting (rides the packed fetch)
+    retired = seg_steps = seg_resolved = 0
+    occ_last = None
+    for t in range(h_on.max_t + 1):
+        tel = h_on.get_telemetry(t) or {}
+        retired += tel.get("retired_early", 0)
+        seg_steps += tel.get("seg_steps", 0)
+        seg_resolved += tel.get("seg_resolved", 0)
+        occ_last = tel.get("segment_occupancy", occ_last)
+    out["util"] = {
+        "lanes_retired_early_total": int(retired),
+        "segment_occupancy_last": occ_last,
+        "avg_segments_per_resolved": round(
+            seg_steps / max(seg_resolved, 1), 2),
+        "sim_work_saved_frac": round(
+            1.0 - seg_steps / max(seg_resolved * segments, 1), 4),
+    }
+
+    # host-oracle posterior check (small config, loose statistical band)
+    if CLOCK.now() - t_lane0 < budget_s * 0.8:
+        try:
+            oracle_pop, oracle_gens = 96, 3
+            mus = {}
+            for leg in ("fused", "host_oracle"):
+                abc_o = pt.ABCSMC(
+                    g.make_birth_death_model(segments=segments),
+                    g.birth_death_prior(), pt.PNormDistance(p=2),
+                    population_size=oracle_pop, eps=pt.MedianEpsilon(),
+                    seed=21, tracer=TRACER,
+                    **({"sampler": pt.SingleCoreSampler()}
+                       if leg == "host_oracle" else {}),
+                )
+                abc_o.new("sqlite://", obs)
+                h_o = abc_o.run(max_nr_populations=oracle_gens)
+                df, w = h_o.get_distribution(m=0, t=h_o.max_t)
+                mus[leg] = np.asarray([
+                    float(np.average(df[c], weights=w))
+                    for c in ("log_b", "log_d")
+                ])
+            err = float(np.max(np.abs(mus["fused"] - mus["host_oracle"])))
+            out["oracle_posterior_mean_err"] = round(err, 3)
+            # prior is 2-3 units wide; two pop-96 ABC runs at gen 3
+            # carry ~0.1-0.2 posterior-mean MC error each
+            out["oracle_ok"] = bool(err < 0.5)
+        except Exception as e:
+            out["oracle_ok"] = False
+            out["oracle_error"] = repr(e)[:300]
+    else:
+        out["oracle_ok"] = None  # budget spent before the oracle leg
+
+    # secondary zoo lanes: pps probes (early-reject ON), small configs
+    def zoo_pps(name, models, priors, observation, pop_z, gens_z,
+                seg_ok=True):
+        try:
+            abc_z = pt.ABCSMC(
+                models, priors, pt.PNormDistance(p=2),
+                population_size=pop_z, eps=pt.MedianEpsilon(), seed=5,
+                early_reject="auto" if seg_ok else False,
+                fused_generations=gens_z, tracer=TRACER,
+            )
+            abc_z.new("sqlite://", observation)
+            t0 = CLOCK.now()
+            h_z = abc_z.run(max_nr_populations=gens_z)
+            wall = CLOCK.now() - t0
+            n_acc = (h_z.max_t + 1) * pop_z
+            entry = {
+                "pps": round(n_acc / max(wall, 1e-9), 1),
+                "pop_size": pop_z, "generations": int(h_z.max_t + 1),
+                "retired_early_total": int(sum(
+                    (h_z.get_telemetry(t) or {}).get("retired_early", 0)
+                    for t in range(h_z.max_t + 1))),
+            }
+            if name == "model_selection":
+                probs = h_z.get_model_probabilities(h_z.max_t)
+                entry["model_probs"] = [
+                    round(float(probs["p"].get(m_i, 0.0)), 4)
+                    for m_i in range(len(models))
+                ]
+            out[name] = entry
+        except Exception as e:
+            out[name] = {"error": repr(e)[:300]}
+
+    if CLOCK.now() - t_lane0 < budget_s * 0.85:
+        zoo_pps("stochastic_lv",
+                g.make_stochastic_lv_model(segments=segments),
+                g.stochastic_lv_prior(),
+                g.observed_stochastic_lv(segments=segments),
+                256 if cpu else 16384, 4)
+    if CLOCK.now() - t_lane0 < budget_s * 0.9:
+        zoo_pps("network_sir",
+                sir_mod.make_network_sir_model(),
+                sir_mod.network_sir_prior(),
+                sir_mod.observed_network_sir(),
+                256 if cpu else 16384, 4)
+    if CLOCK.now() - t_lane0 < budget_s * 0.95:
+        models_k, priors_k, _ts = msel.ode_family(segments=4)
+        zoo_pps("model_selection", models_k, priors_k,
+                msel.observed_ode_family(seed=0, segments=4),
+                192 if cpu else 8192, 3)
+
+    out["lane_s"] = round(CLOCK.now() - t_lane0, 2)
+    out["value"] = out.get("pps_late_on", out["pps_on"])
+    return out
+
+
 # -- dispatch lane ------------------------------------------------------------
 
 
@@ -1860,6 +2126,29 @@ def main():
                 _state["mesh"] = {"error": repr(e)[:300]}
         _state["value"] = float(
             _state["mesh"].get("accepted_particles_per_sec_mesh") or 0.0)
+        _state["partial"] = False
+        _state["budget_used_s"] = round(CLOCK.now() - t_start, 1)
+        _state["phase"] = "done"
+        _emit()
+        return
+
+    # `abc-bench --lane scenario`: the scenario-zoo / early-reject lane
+    # (ISSUE 15) — Gillespie ON-vs-OFF headline + SIR + K>1 probes
+    if (os.environ.get("PYABC_TPU_BENCH_LANE") or "").strip().lower() \
+            == "scenario":
+        _state["phase"] = "scenario"
+        _state["metric"] = "accepted_particles_per_sec_gillespie_early_reject"
+        scenario_skip = scenario_lane_skip_reason()
+        if scenario_skip:
+            _state["scenario"] = {"skipped": scenario_skip}
+        else:
+            try:
+                _state["scenario"] = run_scenario_lane(
+                    budget - max(10.0, 0.05 * budget), platform)
+            except Exception as e:
+                _state["scenario"] = {"error": repr(e)[:300]}
+        _state["value"] = float(_state["scenario"].get("value") or 0.0)
+        _state["util"] = _state["scenario"].get("util", {})
         _state["partial"] = False
         _state["budget_used_s"] = round(CLOCK.now() - t_start, 1)
         _state["phase"] = "done"

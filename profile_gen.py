@@ -125,22 +125,56 @@ def profile_refit(pop: int, dim: int, k_fraction: float, refit_every: int,
     return report
 
 
+def build_scenario(model_name: str, segments: int | None):
+    """(models, priors, observation, distance) for the profiling run —
+    the refit/segment harness drives the scenario kernels, not just LV
+    (ISSUE 15 satellite). Segmented construction (where supported) lets
+    ``--early-reject`` profile the segment-inner proposal loop."""
+    import pyabc_tpu as pt
+
+    if model_name == "lv":
+        from pyabc_tpu.models import lotka_volterra as lv
+
+        return (lv.make_lv_model(), lv.default_prior(),
+                lv.observed_data(seed=123), pt.AdaptivePNormDistance(p=2))
+    if model_name == "gillespie":
+        from pyabc_tpu.models import gillespie as g
+
+        seg = {"segments": segments} if segments else {}
+        return (g.make_birth_death_model(**seg), g.birth_death_prior(),
+                g.observed_birth_death(**seg), pt.PNormDistance(p=2))
+    if model_name == "sir":
+        from pyabc_tpu.models import sir
+
+        seg = {"segments": segments} if segments else {}
+        return (sir.make_network_sir_model(**seg), sir.network_sir_prior(),
+                sir.observed_network_sir(**seg), pt.PNormDistance(p=2))
+    if model_name == "model_selection":
+        from pyabc_tpu.models import model_selection as msel
+
+        models, priors, _ts = msel.ode_family(segments=segments)
+        obs = msel.observed_ode_family(seed=0, segments=segments)
+        return models, priors, obs, pt.PNormDistance(p=2)
+    raise ValueError(f"unknown --model {model_name!r}")
+
+
 def main(pop: int = 1000, transition: str = "mvn", generations: int = 3,
-         k_fraction: float = 0.25, refit_every: int | None = None):
+         k_fraction: float = 0.25, refit_every: int | None = None,
+         model_name: str = "lv", segments: int | None = None,
+         early_reject: str = "auto"):
     import jax
 
     import pyabc_tpu as pt
-    from pyabc_tpu.models import lotka_volterra as lv
 
-    model = lv.make_lv_model()
-    prior = lv.default_prior()
-    obs = lv.observed_data(seed=123)
+    model, prior, obs, distance = build_scenario(model_name, segments)
 
     trans = (pt.LocalTransition(k_fraction=k_fraction)
              if transition == "local" else None)
     abc = pt.ABCSMC(
-        model, prior, pt.AdaptivePNormDistance(p=2),
+        model, prior, distance,
         population_size=pop, eps=pt.MedianEpsilon(), seed=0,
+        early_reject={"auto": "auto", "on": True,
+                      "off": False}[early_reject],
         **({"transitions": trans} if trans is not None else {}),
         **({"refit_every": refit_every} if refit_every is not None else {}),
     )
@@ -192,6 +226,18 @@ if __name__ == "__main__":
     ap.add_argument("--pop", type=int, default=1000,
                     help="population size (16384 reproduces the r5 scale "
                          "case)")
+    ap.add_argument("--model",
+                    choices=("lv", "gillespie", "sir", "model_selection"),
+                    default="lv",
+                    help="scenario kernel to profile (gillespie = "
+                         "tau-leap birth-death, sir = network SIR, "
+                         "model_selection = K=3 ODE family)")
+    ap.add_argument("--segments", type=int, default=None,
+                    help="segmented construction (early-reject protocol) "
+                         "for scenario models that support it")
+    ap.add_argument("--early-reject", choices=("auto", "on", "off"),
+                    default="auto",
+                    help="segmented early-reject mode for the SMC run")
     ap.add_argument("--transition", choices=("mvn", "local"), default="mvn")
     ap.add_argument("--generations", type=int, default=3)
     ap.add_argument("--k-fraction", type=float, default=0.25)
@@ -211,4 +257,5 @@ if __name__ == "__main__":
     else:
         main(pop=args.pop, transition=args.transition,
              generations=args.generations, k_fraction=args.k_fraction,
-             refit_every=args.refit_every)
+             refit_every=args.refit_every, model_name=args.model,
+             segments=args.segments, early_reject=args.early_reject)

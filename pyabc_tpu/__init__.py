@@ -75,6 +75,7 @@ from .epsilon import (
 )
 from .inference import ABCSMC
 from .model import IntegratedModel, JaxModel, Model, ModelResult, SimpleModel
+from .ops.segment import SegmentedSim
 from .populationstrategy import (
     AdaptivePopulationSize,
     ConstantPopulationSize,

@@ -92,6 +92,24 @@ def main(argv: list[str] | None = None) -> int:
     files = iter_python_files(targets)
     result = run_analysis(root, files, all_rules(),
                           select=select, ignore=ignore)
+    if not args.paths:
+        # whole-repo scan: every INSTRUMENTED kernel-layer file must be
+        # present — a rename must not silently un-lint a pinned module
+        from .engine import INSTRUMENTED, META_MISSING_INSTRUMENTED, Finding
+
+        scanned = {
+            p.resolve().relative_to(root.resolve()).as_posix()
+            for p in files
+        }
+        for pinned in sorted(INSTRUMENTED - scanned):
+            result.findings.append(Finding(
+                rule=META_MISSING_INSTRUMENTED, path=pinned, line=1,
+                col=0,
+                message=(f"pinned INSTRUMENTED module {pinned} missing "
+                         f"from the scan — renamed or deleted without "
+                         f"updating analysis/engine.py"),
+                hint="update INSTRUMENTED alongside the move",
+            ))
 
     baseline_path = Path(args.baseline) if args.baseline else \
         root / baseline_mod.DEFAULT_BASELINE_NAME

@@ -44,6 +44,25 @@ _HOLDS_RE = re.compile(r"^holds=(?P<lock>[\w.]+)\s*$")
 #: engine-level meta findings
 META_BAD_DIRECTIVE = "LINT001"   # malformed / reasonless directive
 META_PARSE_ERROR = "LINT002"     # file failed to parse
+META_MISSING_INSTRUMENTED = "LINT003"  # pinned kernel-layer file absent
+
+#: the INSTRUMENTED set: kernel-layer modules the discipline contracts
+#: were written FOR — collectives confinement (MESH001), the sync
+#: ledger accounting, the dispatch single-door. A default (repo-root)
+#: scan that cannot find one of these produces a finding instead of
+#: silently linting a tree where the file was renamed away — a pinned
+#: module must never drop out of the scan unnoticed.
+INSTRUMENTED = frozenset({
+    "pyabc_tpu/inference/util.py",
+    "pyabc_tpu/inference/dispatch.py",
+    "pyabc_tpu/inference/smc.py",
+    "pyabc_tpu/ops/pack.py",
+    "pyabc_tpu/ops/shard.py",
+    "pyabc_tpu/ops/scale_reduce.py",
+    "pyabc_tpu/ops/select.py",
+    "pyabc_tpu/ops/segment.py",
+    "pyabc_tpu/ops/health.py",
+})
 
 
 @dataclass

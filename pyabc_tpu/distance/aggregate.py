@@ -82,6 +82,48 @@ class AggregatedDistance(Distance):
 
         return fn
 
+    def device_bound_fn(self, spec: SumStatSpec):
+        """Monotone prefix bound for the weighted sum of plain p-norm
+        sub-distances: each sub accumulates its own p-th-power partial
+        sum (running max at p=inf), and the combined bound
+        ``sum_k w_k * acc_k^(1/p_k)`` is non-decreasing as entries fold
+        in whenever every top-level weight is non-negative — which the
+        early-reject capability gate checks host-side. Non-p-norm or
+        transformed subs have no sound per-prefix bound (None)."""
+        from .pnorm import PNormDistance
+
+        if not all(type(d) is PNormDistance and d.sumstat is None
+                   for d in self.distances):
+            return None
+        ps = [d.p for d in self.distances]
+        rtol = PNormDistance.BOUND_RTOL
+        n_sub = len(self.distances)
+
+        def init():
+            return jnp.zeros((n_sub,), jnp.float32)
+
+        def step(acc, vals, idx, x0, params):
+            _w_top, subparams = params
+            parts = []
+            for k, p in enumerate(ps):
+                diff = subparams[k][idx] * jnp.abs(vals - x0[idx])
+                if np.isinf(p):
+                    parts.append(jnp.maximum(acc[k], jnp.max(diff)))
+                else:
+                    parts.append(acc[k] + jnp.sum(diff ** p))
+            return jnp.stack(parts)
+
+        def exceeds(acc, threshold, params):
+            w_top, _subparams = params
+            vals = []
+            for k, p in enumerate(ps):
+                vals.append(acc[k] if np.isinf(p)
+                            else acc[k] ** (1.0 / p))
+            total = jnp.sum(w_top * jnp.stack(vals))
+            return total > threshold * (1.0 + rtol)
+
+        return {"init": init, "step": step, "exceeds": exceeds}
+
 
 class AdaptiveAggregatedDistance(AggregatedDistance):
     """Aggregated distance that rescales sub-distances each generation so all

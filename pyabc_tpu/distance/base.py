@@ -61,6 +61,28 @@ class Distance(ABC):
         """Traceable ``fn(x_flat, x0_flat, params) -> scalar distance``."""
         raise NotImplementedError(f"{type(self).__name__} has no device form")
 
+    def device_bound_fn(self, spec: SumStatSpec):
+        """Optional monotone lower-bound accumulator over sum-stat
+        PREFIXES — the soundness contract of the segmented early-reject
+        engine (ISSUE 15). Returns None (default: no sound bound, the
+        early-reject mode gates off) or a dict of traceable closures:
+
+        - ``init() -> acc`` — the empty-prefix accumulator;
+        - ``step(acc, vals (k,), idx (k,) int32, x0 (S,), params) ->
+          acc`` — fold the newly emitted flat entries ``vals`` at flat
+          positions ``idx`` into the accumulator;
+        - ``exceeds(acc, threshold, params) -> bool`` — True only when
+          the FINAL distance over the complete vector is provably above
+          ``threshold`` (a small relative slack must absorb
+          summation-order ULP effects: a false keep wastes work, a
+          false retire would be unsound).
+
+        Monotonicity requirement: folding more entries must never
+        decrease the implied bound — p-norms with non-negative weights
+        qualify; signed/normalized forms do not.
+        """
+        return None
+
     def device_record_reduce(self, spec: SumStatSpec):
         """Optional traceable reduction folded into the generation kernel:
         ``fn(rec_sumstats (n,S), rec_valid (n,), x0 (S,)) -> (S,)``.

@@ -220,6 +220,54 @@ class PNormDistance(Distance):
 
         return fn
 
+    #: relative slack on the early-reject comparison: the engine's
+    #: running prefix sum and the full-vector ``jnp.sum`` may round in
+    #: different orders, so a bound within this band of the threshold
+    #: never retires (a false keep costs wasted segments; a false retire
+    #: would be unsound). 1e-4 is ~200x the f32 summation error of a
+    #: 10^4-entry sum and invisible against any real epsilon margin.
+    BOUND_RTOL = 1e-4
+
+    def device_bound_fn(self, spec: SumStatSpec):
+        """Monotone lower-bound accumulator over sum-stat prefixes.
+
+        For the weighted p-norm ``(sum_i (w_i |x_i - x0_i|)^p)^(1/p)``
+        every term is non-negative, so the p-th-power partial sum over
+        any index subset lower-bounds the full sum and is non-decreasing
+        as entries fold in — the textbook-sound prefix bound. ``p=inf``
+        accumulates the running max. Weights come from the SAME
+        per-generation ``device_params`` the accept test uses, so a
+        weight schedule reweights the bound and the final test together.
+        Learned sumstat transforms mix entries across the prefix and
+        have no sound per-prefix bound (None).
+        """
+        if self.sumstat is not None:
+            return None
+        p = self.p
+        rtol = self.BOUND_RTOL
+
+        def init():
+            return jnp.zeros((), jnp.float32)
+
+        if np.isinf(p):
+            def step(acc, vals, idx, x0, params):
+                diff = params[idx] * jnp.abs(vals - x0[idx])
+                return jnp.maximum(acc, jnp.max(diff))
+
+            def exceeds(acc, threshold, params):
+                return acc > threshold * (1.0 + rtol)
+        else:
+            def step(acc, vals, idx, x0, params):
+                diff = params[idx] * jnp.abs(vals - x0[idx])
+                return acc + jnp.sum(diff ** p)
+
+            def exceeds(acc, threshold, params):
+                # compare in the p-th-power domain: acc is the partial
+                # p-sum, the accept test is d = total**(1/p) <= thr
+                return acc > (threshold * (1.0 + rtol)) ** p
+
+        return {"init": init, "step": step, "exceeds": exceeds}
+
     def get_config(self):
         return {"name": type(self).__name__, "p": self.p}
 

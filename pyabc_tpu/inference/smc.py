@@ -164,6 +164,7 @@ class ABCSMC:
                  seed: int = 0,
                  mesh=None,
                  sharded: int | bool | None = None,
+                 early_reject: bool | str = "auto",
                  pipeline: bool = True,
                  fused_generations: int = 8,
                  fetch_pipeline_depth: int = 3,
@@ -254,6 +255,22 @@ class ABCSMC:
         #: shards on one device — the bit-level parity reference the
         #: sharded tests compare a real mesh run against.
         self.sharded = sharded
+        #: segmented early-reject execution (ISSUE 15): when every model
+        #: declares a segmented-simulation protocol and the distance has
+        #: a monotone prefix bound, the fused kernel's proposal loop
+        #: runs segment by segment and RETIRES lanes whose partial
+        #: distance already exceeds the generation epsilon, refilling
+        #: them with fresh proposals — accepted particles stay
+        #: bit-identical to the unsegmented run (only provably-rejected
+        #: work is skipped). ``"auto"``: on whenever capable; ``True``:
+        #: require it (raise with the blocking reason); ``False``: never
+        #: (the classic full-trajectory loop).
+        if early_reject not in ("auto", True, False):
+            raise ValueError(
+                f"early_reject must be 'auto', True or False, "
+                f"got {early_reject!r}"
+            )
+        self.early_reject = early_reject
         #: overlap host persistence with the next generation's device run
         #: (the look-ahead analog; proposals use FINAL weights so no weight
         #: correction is needed — reference redis_eps look_ahead semantics
@@ -1788,6 +1805,73 @@ class ABCSMC:
                     f"the pow2 population bucket to shard")
         return None
 
+    def _early_reject_incapable_reason(self, *, adaptive: bool,
+                                       stochastic: bool,
+                                       sumstat_mode: bool,
+                                       sharded_n: int | None
+                                       ) -> str | None:
+        """Why the segmented early-reject engine cannot serve this fused
+        config (None = capable). Mirrors ``_sharded_incapable_reason``:
+        every reason names the path that still serves the config —
+        incapable configs fall back LOUDLY to the classic
+        full-trajectory loop, they never silently change semantics."""
+        from ..ops.segment import uniform_protocol_reason
+
+        reason = uniform_protocol_reason(self.models)
+        if reason is not None:
+            return (f"{reason}; the classic full-trajectory kernel "
+                    f"serves this config — declare "
+                    f"JaxModel(segmented=...) to enable early reject")
+        if self.spec is None:
+            return "no SumStatSpec yet (run not initialized)"
+        if self.distance_function.device_bound_fn(self.spec) is None:
+            return (f"{type(self.distance_function).__name__} has no "
+                    f"monotone prefix bound (device_bound_fn); the "
+                    f"classic kernel serves it — p-norm-family "
+                    f"distances bound soundly")
+        if adaptive:
+            return ("adaptive distances refit their scale from the "
+                    "record ring of ALL simulations, but early reject "
+                    "leaves retired trajectories without complete "
+                    "statistics — the ring would be survivor-biased; "
+                    "the classic kernel serves adaptive configs")
+        if stochastic or type(self.acceptor) is not UniformAcceptor:
+            return ("only the UniformAcceptor's accept test "
+                    "(distance <= eps) is decidable from a distance "
+                    "lower bound; stochastic/custom acceptors keep the "
+                    "classic kernel")
+        if sumstat_mode:
+            return ("learned summary statistics mix trajectory entries "
+                    "across the prefix — no sound per-segment bound; "
+                    "the classic kernel serves this config")
+        if sharded_n:
+            return ("the sharded multigen kernel keeps its own "
+                    "lane-key reduction; segmented early reject "
+                    "composes with the unsharded kernel only — drop "
+                    "sharded= (or set early_reject=False) for now")
+        if self.mesh is not None:
+            return ("the GSPMD mesh path constrains lane arrays per "
+                    "round; the segmented engine's refill gathers are "
+                    "unsharded for now — run without a mesh for early "
+                    "reject")
+        d = self.distance_function
+        for w in getattr(d, "weights", {}).values():
+            if np.any(np.asarray(w) < 0):
+                return ("negative distance weights break the bound's "
+                        "monotonicity; the classic kernel serves them")
+        if hasattr(d, "distances"):
+            if np.any(np.asarray(d.factors) < 0) or any(
+                np.any(np.asarray(w) < 0) for w in d.weights.values()
+            ) or any(
+                np.any(np.asarray(w) < 0)
+                for sub in d.distances
+                for w in getattr(sub, "weights", {}).values()
+            ):
+                return ("negative aggregated-distance weights/factors "
+                        "break the bound's monotonicity; the classic "
+                        "kernel serves them")
+        return None
+
     def _weight_schedule_fused(self) -> bool:
         """True when the (non-adaptive) distance carries per-generation
         USER weight schedules that must be resolved per chunk generation
@@ -2337,11 +2421,34 @@ class ABCSMC:
             # importance weights always use the params actually sampled.
             every = self.refit_every if self.refit_every is not None else G
             refit_cadence = (max(int(every), 1), float("inf"))
+        # segmented early-reject execution (ISSUE 15): on when requested
+        # and capable — incapable configs fall back loudly (the reason
+        # names the serving path), early_reject=True makes them fatal
+        seg_cfg = None
+        if self.early_reject in ("auto", True):
+            seg_reason = self._early_reject_incapable_reason(
+                adaptive=adaptive, stochastic=stochastic,
+                sumstat_mode=sumstat_mode, sharded_n=sharded_n,
+            )
+            if seg_reason is None:
+                seg_cfg = ctx.segment_cfg()
+            elif self.early_reject is True:
+                raise ValueError(
+                    f"early_reject=True unavailable: {seg_reason}"
+                )
+            elif any(
+                getattr(m, "segmented", None) is not None
+                for m in self.models
+            ):
+                # only worth a log line when the user built segmented
+                # models — every plain config would spam otherwise
+                logger.info("segmented early reject off: %s", seg_reason)
         health_cfg = self._health_cfg()
         # the multigen kernel's static configuration; the dispatch engine
         # owns the build (kernel.build span) and every invocation —
         # abc-lint DISP001 bans direct kernel calls outside the engine
         kernel_kwargs = dict(
+            segment_cfg=seg_cfg,
             weight_sched=weight_sched,
             fold_sched_mode=fold_sched_mode,
             first_gen_prior=first_gen_prior,
@@ -2824,6 +2931,39 @@ class ABCSMC:
                     refit_tel = {"refit": refit_g,
                                  "drift": round(drift_g, 5),
                                  "refit_rows_changed": rows_g}
+                if "retired" in fetched:
+                    # early-reject accounting (ISSUE 15) rides the
+                    # packed fetch — mirror it into the retired-lanes
+                    # counter and the segment-occupancy gauge (global
+                    # registry too: /api/observability reads it)
+                    from ..observability import global_metrics
+                    from ..observability.metrics import (
+                        SIM_LANES_RETIRED_TOTAL,
+                        SIM_SEGMENT_OCCUPANCY_GAUGE,
+                    )
+
+                    retired_g = int(fetched["retired"][g])
+                    steps_g = int(fetched["seg_steps"][g])
+                    slots_g = int(fetched["seg_lane_slots"][g])
+                    occ_g = steps_g / max(slots_g, 1)
+                    for reg in (self.metrics, global_metrics()):
+                        reg.counter(
+                            SIM_LANES_RETIRED_TOTAL,
+                            "lanes retired between segments: provably-"
+                            "rejected trajectories whose remaining "
+                            "simulation work was skipped",
+                        ).inc(retired_g)
+                        reg.gauge(
+                            SIM_SEGMENT_OCCUPANCY_GAUGE,
+                            "productive segment-step share of lane "
+                            "sweeps in the last fused generation",
+                        ).set(occ_g)
+                    resolved_g = int(fetched["seg_resolved"][g])
+                    refit_tel = {**refit_tel,
+                                 "retired_early": retired_g,
+                                 "segment_occupancy": round(occ_g, 4),
+                                 "seg_steps": steps_g,
+                                 "seg_resolved": resolved_g}
                 if g == g_last_ok or sumstat_refit:
                     last_sample, last_pop = _build()
                     last_eps, last_acc_rate = current_eps, acceptance_rate

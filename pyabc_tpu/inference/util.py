@@ -616,6 +616,423 @@ class DeviceContext:
 
         return jax.lax.while_loop(cond, body, state0)
 
+    # ------------------------------------------ segmented early reject
+    def segment_cfg(self) -> dict:
+        """Build the segmented early-reject execution config (ISSUE 15):
+        the uniform segment protocol of the model family, the flat-index
+        emission map, and the distance's monotone prefix-bound closures.
+        Raises with the blocking reason when the config cannot run the
+        segmented engine — callers that want a soft fallback gate first
+        (``ABCSMC._early_reject_incapable_reason``)."""
+        from ..ops.segment import index_map_for, uniform_protocol_reason
+
+        reason = uniform_protocol_reason(self.models)
+        if reason is not None:
+            raise ValueError(f"segmented execution unavailable: {reason}")
+        bound = self.distance.device_bound_fn(self.spec)
+        if bound is None:
+            raise ValueError(
+                "segmented execution unavailable: "
+                f"{type(self.distance).__name__} has no monotone "
+                "prefix bound (device_bound_fn)"
+            )
+        seg0 = self.models[0].segmented
+        return {
+            "n_segments": int(seg0.n_segments),
+            "seg_size": int(seg0.seg_size),
+            "index_map": jnp.asarray(index_map_for(seg0, self.spec)),
+            "bound": bound,
+            "use_hist": bool(getattr(self.acceptor,
+                                     "use_complete_history", False)),
+        }
+
+    def _seg_propose(self, kind: str):
+        """One lane's PROPOSAL phase (everything before the simulator),
+        key-split-identical to ``_lane_prior`` / ``_lane_transition``
+        with the simulation call replaced by the segment-chain ``init``
+        — a proposal that later runs all its segments therefore consumes
+        randomness exactly as the classic lane does, which is what makes
+        the early-reject population bit-comparable to the unsegmented
+        run."""
+        segs = [m.segmented for m in self.models]
+
+        if kind == "prior":
+            def propose(key, dyn):
+                km, kt, ksim, kacc = jax.random.split(key, 4)
+                m = jax.random.categorical(km, self.model_prior_logits)
+
+                def make_branch(i):
+                    prior = self.priors[i]
+
+                    def branch(kt, ksim):
+                        theta = prior.rvs_array(kt)
+                        logpri = prior.logpdf_array(theta)
+                        carry = segs[i].init(ksim, theta)
+                        pad = self.d_max - theta.shape[0]
+                        theta = (jnp.pad(theta, (0, pad)) if pad
+                                 else theta)
+                        return theta, logpri, carry
+
+                    return branch
+
+                branches = [make_branch(i) for i in range(self.K)]
+                if self.K == 1:
+                    theta, logpri, carry = branches[0](kt, ksim)
+                else:
+                    theta, logpri, carry = jax.lax.switch(
+                        m, branches, kt, ksim)
+                return {
+                    "m": m.astype(jnp.int32), "theta": theta,
+                    "logpri": logpri, "logq": jnp.zeros(()),
+                    "valid": jnp.asarray(True), "kacc": kacc,
+                    "carry": carry,
+                }
+
+            return propose
+
+        def propose(key, dyn):
+            km1, km2, kt, ksim, kacc = jax.random.split(key, 5)
+            m_anc = jax.random.categorical(km1, dyn["log_model_probs"])
+            m = jax.random.categorical(
+                km2, jnp.log(dyn["mpk_matrix"][m_anc] + 1e-38))
+
+            def make_branch(i):
+                prior = self.priors[i]
+                dim = self.models[i].space.dim
+                trans_cls = self.transition_classes[i]
+
+                def branch(kt, ksim, trans_params_all):
+                    params = trans_params_all[i]
+                    keys = jax.random.split(kt, DeviceContext.N_REDRAWS)
+                    theta = trans_cls.device_rvs(
+                        keys[0], params)[: self.d_max]
+                    logpri = prior.logpdf_array(theta[:dim])
+                    for r in range(1, DeviceContext.N_REDRAWS):
+                        redraw = trans_cls.device_rvs(
+                            keys[r], params)[: self.d_max]
+                        re_logpri = prior.logpdf_array(redraw[:dim])
+                        take_new = ~jnp.isfinite(logpri)
+                        theta = jnp.where(take_new, redraw, theta)
+                        logpri = jnp.where(take_new, re_logpri, logpri)
+                    valid = jnp.isfinite(logpri)
+                    logq = trans_cls.device_logpdf(theta, params)
+                    theta_m = theta[:dim]
+                    carry = segs[i].init(ksim, theta_m)
+                    pad = self.d_max - dim
+                    theta_out = (jnp.pad(theta_m, (0, pad)) if pad
+                                 else theta_m)
+                    return theta_out, logpri, logq, valid, carry
+
+                return branch
+
+            branches = [make_branch(i) for i in range(self.K)]
+            if self.K == 1:
+                theta, logpri, logq, valid, carry = branches[0](
+                    kt, ksim, dyn["trans_params"])
+            else:
+                theta, logpri, logq, valid, carry = jax.lax.switch(
+                    m, branches, kt, ksim, dyn["trans_params"])
+            return {
+                "m": m.astype(jnp.int32), "theta": theta,
+                "logpri": logpri, "logq": logq, "valid": valid,
+                "kacc": kacc, "carry": carry,
+            }
+
+        return propose
+
+    def _seg_step_fn(self):
+        """Per-lane segment advance, switched over the model id. The
+        step must be uniform in ``seg_idx`` (data, not control flow) —
+        lanes sit at different segment indices inside one vmap."""
+        segs = [m.segmented for m in self.models]
+        if self.K == 1:
+            return lambda m, carry, j: segs[0].step(carry, j)
+
+        def step(m, carry, j):
+            return jax.lax.switch(m, [s.step for s in segs], carry, j)
+
+        return step
+
+    def _generation_while_seg(self, key, dyn, n_target, *, B, n_cap,
+                              rec_cap, max_rounds, kind, seg_cfg,
+                              all_accept=False, record_proposal=False):
+        """Segment-inner proposal loop with mid-flight lane refill — the
+        early-reject twin of :meth:`_generation_while` (ISSUE 15).
+
+        Every lane holds ONE candidate at some segment progress; each
+        sweep advances all live lanes one fixed-length segment and folds
+        the emitted stats into the distance's monotone prefix bound.
+        Between segments, lanes whose bound already exceeds the
+        generation threshold are RETIRED (they are provably rejected —
+        accepted lanes always run to completion, so only discardable
+        work is skipped) and refilled with fresh proposals through the
+        same rank/cumsum compaction the reservoir write uses.
+
+        Key/slot discipline: proposals are materialized one ROUND BLOCK
+        at a time — block ``r`` is ``vmap(propose)(split(fold_in(key,
+        r), B))``, exactly the classic round's keys and proposal math at
+        exactly the classic per-round cost — and refilling lanes GATHER
+        their proposal by slot from the two live blocks (refills of one
+        sweep span at most two rounds). Slots are handed out in lane
+        order, every surviving candidate runs exactly ``n_segments``
+        sweeps, so completions stay slot-ordered and the reservoir keeps
+        the classic slot-ordered-by-construction invariant: the first
+        ``n_target`` accepted slots are BIT-IDENTICAL to the classic
+        path's. ``rounds``/``n_valid`` count resolved proposals, which
+        can differ at the stop margin (classic resolves whole rounds);
+        the record ring keeps COMPLETED evaluations only — both
+        documented deviations, inert under the non-adaptive gate.
+
+        Returns the classic 5-tuple plus a dict of early-reject
+        accounting: lanes retired, productive segment steps, resolved
+        proposals, and sweeps (occupancy = seg_steps / (B * sweeps)).
+        """
+        from ..ops.segment import gather_lanes, select_lanes
+
+        d_max, S = self.d_max, self.spec.total_size
+        n_seg = int(seg_cfg["n_segments"])
+        index_map = seg_cfg["index_map"]
+        bound = seg_cfg["bound"]
+        budget = max_rounds * B
+        # backstop against a buggy protocol spinning the loop: every
+        # sweep either advances a candidate or drains one
+        hard_cap = (max_rounds + 2) * n_seg + 2
+
+        propose = self._seg_propose(kind)
+        step_fn = self._seg_step_fn()
+        acc_dev = self.acceptor.device_fn(self.distance.device_fn(self.spec))
+        eps = dyn["eps"]
+        thr = (jnp.minimum(eps, dyn["acc_params"])
+               if seg_cfg["use_hist"] else eps)
+        dist_params = dyn["dist_params"]
+        seg_size = int(seg_cfg["seg_size"])
+        # stats accumulate SEGMENT-MAJOR as (B, n_seg, seg_size) via a
+        # dense one-hot FMA — a per-lane scatter here costs more than a
+        # whole segment of simulation on CPU backends. dense_pos is the
+        # static permutation back to the spec's flat order, applied only
+        # at completion; x0/weight rows per segment are pre-gathered.
+        imap_np = np.asarray(seg_cfg["index_map"])
+        dense_pos = np.empty(S, np.int32)
+        for j in range(n_seg):
+            dense_pos[imap_np[j]] = j * seg_size + np.arange(seg_size)
+        dense_pos = jnp.asarray(dense_pos)
+        x0_by_seg = self.x0[seg_cfg["index_map"]]
+
+        def propose_block(r):
+            keys = jax.random.split(jax.random.fold_in(key, r), B)
+            return jax.vmap(lambda k: propose(k, dyn))(keys)
+
+        res0 = {
+            "m": jnp.zeros((n_cap,), jnp.int32),
+            "theta": jnp.zeros((n_cap, d_max), jnp.float32),
+            "sumstats": jnp.zeros((n_cap, S), jnp.float32),
+            "distance": jnp.zeros((n_cap,), jnp.float32),
+            "log_weight": jnp.full((n_cap,), -jnp.inf, jnp.float32),
+            "slot": jnp.full((n_cap,), -1, jnp.int32),
+        }
+        rec0 = {
+            "sumstats": jnp.zeros((rec_cap, S), jnp.float32),
+            "distance": jnp.zeros((rec_cap,), jnp.float32),
+            "accepted": jnp.zeros((rec_cap,), bool),
+            "valid": jnp.zeros((rec_cap,), bool),
+        }
+        if record_proposal:
+            rec0["m"] = jnp.zeros((rec_cap,), jnp.int32)
+            rec0["theta"] = jnp.zeros((rec_cap, d_max), jnp.float32)
+            rec0["logq"] = jnp.zeros((rec_cap,), jnp.float32)
+
+        blocks0 = jax.tree.map(
+            lambda a, b: jnp.concatenate([a, b]),
+            propose_block(0), propose_block(1),
+        )
+        acc0_one = bound["init"]()
+        lane0 = {
+            # proposal fields start as block 0's rows; the first sweep's
+            # refill re-selects the same rows, so nothing extra is paid
+            **gather_lanes(blocks0, jnp.arange(B)),
+            "seg_idx": jnp.zeros((B,), jnp.int32),
+            "stats": jnp.zeros((B, n_seg, seg_size), jnp.float32),
+            "bacc": jnp.broadcast_to(
+                acc0_one, (B,) + acc0_one.shape).astype(jnp.float32),
+            "slot": jnp.zeros((B,), jnp.int32),
+            "active": jnp.zeros((B,), bool),
+        }
+        z32 = jnp.zeros((), jnp.int32)
+        state0 = (z32, z32, z32,                  # n_acc, n_started, n_valid
+                  z32, z32, z32, z32,             # retired, steps, resolved, sweeps
+                  jnp.asarray(True),              # any_live
+                  z32,                            # r_head
+                  jnp.ones((B,), bool),           # alive
+                  blocks0, res0, rec0, lane0)
+
+        def cond(state):
+            n_acc, any_live, sweeps = state[0], state[7], state[6]
+            return (n_acc < n_target) & any_live & (sweeps < hard_cap)
+
+        def body(state):
+            (n_acc, n_started, n_valid, retired, seg_steps, resolved,
+             sweeps, _any_live, r_head, alive, blocks, res, rec,
+             lane) = state
+            # ---- refill: resolved lanes take the next slots in lane
+            # order (the same rank/cumsum compaction the reservoir
+            # write uses), gathering their precomputed proposal rows
+            need = alive & ~lane["active"]
+            rank = jnp.cumsum(need.astype(jnp.int32)) - 1
+            slot_new = n_started + jnp.where(need, rank, 0)
+            can = need & (slot_new < budget)
+            alive = alive & ~(need & ~can)
+            off = jnp.clip(slot_new - r_head * B, 0, 2 * B - 1)
+            fresh = gather_lanes(blocks, off)
+            lane_new = {
+                **fresh,
+                "seg_idx": jnp.zeros((B,), jnp.int32),
+                "stats": jnp.zeros((B, n_seg, seg_size), jnp.float32),
+                "bacc": lane0["bacc"],
+                "slot": slot_new.astype(jnp.int32),
+                "active": jnp.ones((B,), bool),
+            }
+            lane = select_lanes(can, lane_new, lane)
+            n_started = jnp.minimum(
+                n_started + jnp.sum(need, dtype=jnp.int32), budget)
+            # consume round blocks as the slot cursor crosses a round
+            # boundary: one propose_block per B slots, the classic cost
+            shift = n_started >= (r_head + 1) * B
+            blocks = jax.lax.cond(
+                shift,
+                lambda bl: jax.tree.map(
+                    lambda a, b: jnp.concatenate([a[B:], b]),
+                    bl, propose_block(r_head + 2)),
+                lambda bl: bl,
+                blocks,
+            )
+            r_head = r_head + shift.astype(jnp.int32)
+            # ---- one segment for every live lane
+            stepmask = lane["active"]
+            seg_i = jnp.minimum(lane["seg_idx"], n_seg - 1)
+            idx_row = index_map[seg_i]
+            carry2, vals = jax.vmap(step_fn)(
+                lane["m"], lane["carry"], seg_i)
+            lane["carry"] = select_lanes(stepmask, carry2, lane["carry"])
+            # segment-major dense accumulation: each (lane, segment)
+            # cell is written once, so the one-hot FMA equals a scatter
+            # at pure vector-math cost
+            oh = jax.nn.one_hot(seg_i, n_seg, dtype=jnp.float32)
+            stats2 = lane["stats"] + oh[:, :, None] * vals[:, None, :]
+            lane["stats"] = jnp.where(
+                stepmask[:, None, None], stats2, lane["stats"])
+            bacc2 = jax.vmap(
+                lambda a, v, i: bound["step"](a, v, i, self.x0,
+                                              dist_params)
+            )(lane["bacc"], vals, idx_row)
+            lane["bacc"] = select_lanes(stepmask, bacc2, lane["bacc"])
+            lane["seg_idx"] = lane["seg_idx"] + stepmask.astype(jnp.int32)
+            seg_steps = seg_steps + jnp.sum(stepmask, dtype=jnp.int32)
+            # ---- completions: the classic accept test on the fully
+            # assembled stats (bit-identical inputs -> identical
+            # verdict), gated so sweeps where no cohort survived to the
+            # final segment — most sweeps in the heavy-retire regime —
+            # skip the reservoir/ring writes entirely
+            done = stepmask & (lane["seg_idx"] >= n_seg)
+
+            def _complete(args):
+                res_c, rec_c = args
+                stats_flat = lane["stats"].reshape(
+                    (B, n_seg * seg_size))[:, dense_pos]
+                d, accept, log_acc_w = jax.vmap(
+                    lambda k, s: acc_dev(k, s, self.x0, eps,
+                                         dist_params, dyn["acc_params"])
+                )(lane["kacc"], stats_flat)
+                if kind == "transition":
+                    log_w = (
+                        self.model_prior_logits[lane["m"]]
+                        + lane["logpri"] + log_acc_w
+                        - dyn["log_model_factor"][lane["m"]]
+                        - lane["logq"]
+                    )
+                    logq_full = (dyn["log_model_factor"][lane["m"]]
+                                 + lane["logq"])
+                else:
+                    log_w = log_acc_w
+                    logq_full = (self.model_prior_logits[lane["m"]]
+                                 + lane["logpri"])
+                log_w = jnp.where(lane["valid"], log_w, -jnp.inf)
+                acc = (done & lane["valid"] if all_accept
+                       else done & accept & lane["valid"])
+                rank_a = jnp.cumsum(acc.astype(jnp.int32)) - 1
+                pos = n_acc + rank_a
+                write_pos = jnp.where(acc & (pos < n_cap), pos, n_cap)
+                res_c = {
+                    "m": res_c["m"].at[write_pos].set(
+                        lane["m"], mode="drop"),
+                    "theta": res_c["theta"].at[write_pos].set(
+                        lane["theta"], mode="drop"),
+                    "sumstats": res_c["sumstats"].at[write_pos].set(
+                        stats_flat, mode="drop"),
+                    "distance": res_c["distance"].at[write_pos].set(
+                        d, mode="drop"),
+                    "log_weight": res_c["log_weight"].at[write_pos].set(
+                        jnp.where(all_accept, 0.0, log_w), mode="drop"),
+                    "slot": res_c["slot"].at[write_pos].set(
+                        lane["slot"], mode="drop"),
+                }
+                # record ring: completed evaluations in slot order (the
+                # documented deviation — retired lanes have no stats)
+                rec_pos = jnp.where(
+                    done & lane["valid"] & (lane["slot"] < rec_cap),
+                    lane["slot"], rec_cap)
+                rec_n = {
+                    "sumstats": rec_c["sumstats"].at[rec_pos].set(
+                        stats_flat, mode="drop"),
+                    "distance": rec_c["distance"].at[rec_pos].set(
+                        d, mode="drop"),
+                    "accepted": rec_c["accepted"].at[rec_pos].set(
+                        acc, mode="drop"),
+                    "valid": rec_c["valid"].at[rec_pos].set(
+                        done & lane["valid"], mode="drop"),
+                }
+                if record_proposal:
+                    rec_n["m"] = rec_c["m"].at[rec_pos].set(
+                        lane["m"], mode="drop")
+                    rec_n["theta"] = rec_c["theta"].at[rec_pos].set(
+                        lane["theta"], mode="drop")
+                    rec_n["logq"] = rec_c["logq"].at[rec_pos].set(
+                        logq_full, mode="drop")
+                return res_c, rec_n, jnp.sum(acc, dtype=jnp.int32)
+
+            res, rec, acc_inc = jax.lax.cond(
+                jnp.any(done), _complete,
+                lambda args: (args[0], args[1], jnp.zeros((), jnp.int32)),
+                (res, rec),
+            )
+            # ---- retirement: provably rejected mid-trajectory (bound
+            # sound + slack, so a surviving lane ALWAYS gets the exact
+            # final test above; invalid draws are rejected at segment 1)
+            exceeds = jax.vmap(
+                lambda a: bound["exceeds"](a, thr, dist_params)
+            )(lane["bacc"])
+            retire = stepmask & ~done & (exceeds | ~lane["valid"])
+            resolved_now = done | retire
+            lane["active"] = stepmask & ~resolved_now
+            n_acc = n_acc + acc_inc
+            n_valid = n_valid + jnp.sum(resolved_now & lane["valid"],
+                                        dtype=jnp.int32)
+            retired = retired + jnp.sum(retire, dtype=jnp.int32)
+            resolved = resolved + jnp.sum(resolved_now, dtype=jnp.int32)
+            any_live = jnp.any(lane["active"]) | (n_started < budget)
+            return (n_acc, n_started, n_valid, retired, seg_steps,
+                    resolved, sweeps + 1, any_live, r_head, alive,
+                    blocks, res, rec, lane)
+
+        (n_acc, n_started, n_valid, retired, seg_steps, resolved,
+         sweeps, _live, _rh, _alive, _blocks, res, rec,
+         _lane) = jax.lax.while_loop(cond, body, state0)
+        rounds = (n_started + B - 1) // B
+        segx = {"retired": retired, "seg_steps": seg_steps,
+                "seg_resolved": resolved,
+                # total lane-sweep slots: the occupancy denominator
+                "seg_lane_slots": sweeps * B}
+        return n_acc, rounds, n_valid, res, rec, segx
+
     def generation_kernel(self, B: int, mode: str, n_cap: int, rec_cap: int,
                           max_rounds: int, record_proposal: bool = False):
         """One jitted program for a WHOLE generation: a ``lax.while_loop``
@@ -797,7 +1214,8 @@ class DeviceContext:
                         fused_calibration: tuple | None = None,
                         refit_cadence: tuple | None = None,
                         health_config: tuple | None = None,
-                        sharded: int | None = None):
+                        sharded: int | None = None,
+                        segment_cfg: dict | None = None):
         """One jitted program for G WHOLE GENERATIONS (transition mode).
 
         The TPU-native endgame of the reference's per-generation scatter/
@@ -867,17 +1285,33 @@ class DeviceContext:
         existing packed fetch — zero extra blocking syncs — and the host
         ``RunSupervisor`` maps nonzero words to recovery actions.
         """
+        seg_token = (None if segment_cfg is None else
+                     (segment_cfg["n_segments"], segment_cfg["seg_size"],
+                      segment_cfg["use_hist"]))
         cache_key = ("multigen", B, n_cap, rec_cap, max_rounds, G, adaptive,
                      eps_quantile, eps_weighted, alpha, multiplier,
                      trans_cls.__name__, fit_statics, dims,
                      stochastic, temp_config, temp_fixed, complete_history,
                      sumstat_transform, adaptive_n, weight_sched,
                      fold_sched_mode, first_gen_prior, fused_calibration,
-                     refit_cadence, health_config, sharded)
+                     refit_cadence, health_config, sharded, seg_token)
         if cache_key in self._kernels:
             return self._kernels[cache_key]
         if stochastic and self.K != 1:
             raise ValueError("stochastic fused chunks support K=1 only")
+        if segment_cfg is not None and (
+                sharded is not None or adaptive or stochastic
+                or sumstat_transform):
+            # the caller gates these combinations with a named fallback
+            # (ABCSMC._early_reject_incapable_reason); reaching here
+            # means the gate was bypassed. (In-kernel calibration DOES
+            # compose: the eps=+inf prior round keeps the classic lane —
+            # nothing can retire at an infinite threshold.)
+            raise ValueError(
+                "segmented early reject composes with the plain "
+                "unsharded multigen kernel only (non-adaptive distance, "
+                "uniform acceptor)"
+            )
         if sharded is not None:
             # the explicitly sharded variant: per-device lanes/reservoirs
             # with chunk-boundary-only row collectives (ISSUE 9 tentpole;
@@ -1036,6 +1470,27 @@ class DeviceContext:
                 }
 
                 def run_gen(_):
+                    if segment_cfg is not None:
+                        # ISSUE 15: the segment-inner early-reject loop
+                        # replaces the round loop — between segments,
+                        # provably-rejected lanes retire and refill so
+                        # vector lanes spend cycles on viable candidates
+                        def _seg(kind):
+                            return self._generation_while_seg(
+                                gen_key, dyn, n_target, B=B, n_cap=n_cap,
+                                rec_cap=rec_cap, max_rounds=max_rounds,
+                                kind=kind, seg_cfg=segment_cfg,
+                                record_proposal=stochastic,
+                            )
+
+                        if not first_gen_prior:
+                            return _seg("transition")
+                        return jax.lax.cond(
+                            t == 0,
+                            lambda: _seg("prior"),
+                            lambda: _seg("transition"),
+                        )
+
                     def _with(lanes):
                         return self._generation_while(
                             gen_key, dyn, n_target, B=B, n_cap=n_cap,
@@ -1077,11 +1532,22 @@ class DeviceContext:
                         rec["theta"] = jnp.zeros((rec_cap, self.d_max),
                                                  jnp.float32)
                         rec["logq"] = jnp.zeros((rec_cap,), jnp.float32)
+                    if segment_cfg is not None:
+                        return z32, z32, z32, res, rec, {
+                            "retired": z32, "seg_steps": z32,
+                            "seg_resolved": z32, "seg_lane_slots": z32,
+                        }
                     return z32, z32, z32, res, rec
 
-                n_acc, rounds, n_valid, res, rec = jax.lax.cond(
-                    stopped, skip_gen, run_gen, None
-                )
+                if segment_cfg is not None:
+                    (n_acc, rounds, n_valid, res, rec,
+                     segx) = jax.lax.cond(stopped, skip_gen, run_gen,
+                                          None)
+                else:
+                    n_acc, rounds, n_valid, res, rec = jax.lax.cond(
+                        stopped, skip_gen, run_gen, None
+                    )
+                    segx = None
                 gen_ok = (n_acc >= jnp.minimum(n_target, n_cap)) & ~stopped
                 k_mask = (
                     jnp.arange(n_cap) < jnp.minimum(n_acc, n_target)
@@ -1304,6 +1770,12 @@ class DeviceContext:
                     "model_probs": model_probs_next,
                     **temp_extra,
                 }
+                if segx is not None:
+                    # early-reject accounting rides the packed fetch
+                    # (four int32 per generation, zero extra syncs):
+                    # the host mirrors them into the retired-lanes
+                    # counter and the segment-occupancy gauge
+                    out.update(segx)
                 if refit_cadence is not None:
                     # refit events + drift + incremental-factorization
                     # occupancy ship with every generation: the host

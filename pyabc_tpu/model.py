@@ -128,18 +128,37 @@ class JaxModel(Model):
 
     Parameters
     ----------
-    sim: the traceable simulator.
+    sim: the traceable simulator. May be None when ``segmented`` is
+        given — the full simulator is then synthesized from the segment
+        chain (``ops/segment.py::full_sim_from_segments``), so the
+        classic kernel, the host path and the early-reject engine all
+        execute the identical per-step math.
     space: parameter name->column registry (order of theta entries).
     name: model display name.
+    segmented: optional :class:`~pyabc_tpu.ops.segment.SegmentedSim`
+        protocol (carry + fixed-length segment step + per-segment
+        partial sum stats). Declaring it makes the model eligible for
+        the fused kernel's segmented early-reject execution mode, which
+        retires provably-rejected lanes between segments instead of
+        paying the full trajectory (ISSUE 15).
     """
 
-    def __init__(self, sim: Callable, space: ParameterSpace | list[str],
-                 name: str = "jax_model"):
+    def __init__(self, sim: Callable | None,
+                 space: ParameterSpace | list[str],
+                 name: str = "jax_model", segmented=None):
         super().__init__(name)
         if not isinstance(space, ParameterSpace):
             space = ParameterSpace(space)
+        if sim is None:
+            if segmented is None:
+                raise ValueError("JaxModel needs sim or segmented")
+            from .ops.segment import full_sim_from_segments
+
+            sim = full_sim_from_segments(segmented)
         self.sim = sim
         self.space = space
+        #: optional segmented-simulation protocol (early-reject mode)
+        self.segmented = segmented
         self._sumstat_spec: SumStatSpec | None = None
         self._jitted_sim = None
 
@@ -184,6 +203,14 @@ class JaxModel(Model):
         h.update(self.name.encode())
         h.update("|".join(self.space.names).encode())
         _digest_callable(self.sim, h, set())
+        if self.segmented is not None:
+            # the segmented twin is part of the traced identity: two
+            # models with equal full sims but different segment chains
+            # compile different early-reject programs
+            h.update(str(self.segmented.n_segments).encode())
+            h.update(repr(self.segmented.layout).encode())
+            _digest_callable(self.segmented.init, h, set())
+            _digest_callable(self.segmented.step, h, set())
         return h.hexdigest()
 
     @staticmethod

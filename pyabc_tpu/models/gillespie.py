@@ -9,6 +9,15 @@ variant is provided.
 
 Generic engine + two canonical systems: birth-death and the
 Lotka-Volterra reaction network (stochastic LV).
+
+Segmented construction (ISSUE 15): passing ``segments=K`` to a model
+constructor factors the leap chain into K fixed-length segments
+(:class:`~pyabc_tpu.ops.segment.SegmentedSim`) — per-leap keys derive
+from the lane's sim key via ``fold_in(key, leap_index)`` so any segment
+is reproducible in isolation, and the full simulator is synthesized
+FROM the segment chain, so the classic kernel and the early-reject
+engine run identical math on identical keys. The unsegmented
+constructors keep the original ``split(key, n_leaps)`` stream.
 """
 from __future__ import annotations
 
@@ -20,6 +29,7 @@ import numpy as np
 
 from ..core.random_variables import RV, Distribution
 from ..model import JaxModel
+from ..ops.segment import SegmentedSim
 
 
 def tau_leap(key, x0, stoich: jnp.ndarray, propensity_fn: Callable,
@@ -33,10 +43,22 @@ def tau_leap(key, x0, stoich: jnp.ndarray, propensity_fn: Callable,
     stoich: (n_reactions, n_species) stoichiometry matrix.
     propensity_fn: (x, *)-> (n_reactions,) nonneg rates.
     n_leaps: fixed number of tau leaps; tau = t1 / n_leaps.
+    save_every: thin the saved trajectory to every save_every-th state;
+        must divide ``n_leaps`` — a non-dividing value would silently
+        drop the trailing partial window and return a wrong-length
+        trajectory.
     midpoint: midpoint (2nd-order) tau-leap.
 
     Returns (n_saved, n_species) trajectory of the post-leap states.
     """
+    if save_every < 1:
+        raise ValueError(f"save_every must be >= 1, got {save_every}")
+    if n_leaps % save_every:
+        raise ValueError(
+            f"save_every={save_every} does not divide n_leaps={n_leaps}: "
+            f"the saved trajectory would silently drop the trailing "
+            f"{n_leaps % save_every} leap(s)"
+        )
     tau = t1 / n_leaps
     stoich = jnp.asarray(stoich, jnp.float32)
 
@@ -57,16 +79,105 @@ def tau_leap(key, x0, stoich: jnp.ndarray, propensity_fn: Callable,
     return traj
 
 
+def _check_obs_grid(n_leaps: int, n_obs: int, segments: int | None) -> int:
+    """Validate the leap/observation/segment grid; returns save_every."""
+    if n_leaps % n_obs:
+        raise ValueError(
+            f"n_obs={n_obs} does not divide n_leaps={n_leaps}: the "
+            f"implied save_every would silently yield a wrong-length "
+            f"trajectory — pick n_obs | n_leaps"
+        )
+    if segments is not None:
+        if segments < 1:
+            raise ValueError(f"segments must be >= 1, got {segments}")
+        if n_obs % segments or n_leaps % segments:
+            raise ValueError(
+                f"segments={segments} must divide both n_obs={n_obs} "
+                f"and n_leaps={n_leaps} (each segment emits a whole "
+                f"block of observations)"
+            )
+    return n_leaps // n_obs
+
+
+def tau_leap_segmented(*, x0: Sequence[float], stoich, prop: Callable,
+                       rates_of: Callable, t1: float, n_leaps: int,
+                       n_obs: int, segments: int,
+                       channels: tuple, midpoint: bool = False
+                       ) -> SegmentedSim:
+    """Factor a tau-leap system into the segmented-simulation protocol.
+
+    ``prop(x, rates) -> (n_reactions,)`` and ``rates_of(theta) ->
+    (n_rates,)`` keep the carry a plain array pytree; ``channels`` is a
+    tuple of ``(stat_name, species_index)`` in emit order. Per-leap keys
+    are ``fold_in(sim_key, global_leap_index)`` — segment ``j`` is
+    reproducible without replaying segments ``< j``.
+    """
+    save_every = _check_obs_grid(n_leaps, n_obs, segments)
+    leaps_per_seg = n_leaps // segments
+    obs_per_seg = n_obs // segments
+    tau = t1 / n_leaps
+    stoich = jnp.asarray(stoich, jnp.float32)
+    x0 = jnp.asarray(x0, jnp.float32)
+
+    def init(key, theta):
+        return {"x": x0, "key": key,
+                "rates": jnp.asarray(rates_of(theta), jnp.float32)}
+
+    def step(carry, seg):
+        rates = carry["rates"]
+
+        def leap(x, i):
+            k = jax.random.fold_in(carry["key"],
+                                   seg * leaps_per_seg + i)
+            a = jnp.maximum(prop(x, rates), 0.0)
+            if midpoint:
+                x_mid = jnp.maximum(x + 0.5 * tau * a @ stoich, 0.0)
+                a = jnp.maximum(prop(x_mid, rates), 0.0)
+            n_fire = jax.random.poisson(k, a * tau).astype(jnp.float32)
+            x_new = jnp.maximum(x + n_fire @ stoich, 0.0)
+            return x_new, x_new
+
+        x_fin, traj = jax.lax.scan(
+            leap, carry["x"],
+            jnp.arange(leaps_per_seg, dtype=jnp.int32))
+        saved = traj[save_every - 1 :: save_every]
+        vals = jnp.concatenate([saved[:, si] for _n, si in channels])
+        return {**carry, "x": x_fin}, vals
+
+    layout = tuple((name, obs_per_seg) for name, _si in channels)
+    return SegmentedSim(n_segments=segments, init=init, step=step,
+                        layout=layout)
+
+
 # --------------------------------------------------------------------------
 # canonical systems
 # --------------------------------------------------------------------------
 
+_BD_STOICH = ((1.0,), (-1.0,))
+
+
 def make_birth_death_model(x0: float = 40.0, t1: float = 10.0,
                            n_leaps: int = 200, n_obs: int = 20,
+                           segments: int | None = None,
+                           midpoint: bool = False,
                            name: str = "birth_death") -> JaxModel:
-    """Birth-death process: 0 ->(b) X, X ->(d) 0; theta = (log10 b, log10 d)."""
-    stoich = jnp.asarray([[1.0], [-1.0]])
-    save_every = n_leaps // n_obs
+    """Birth-death process: 0 ->(b) X, X ->(d) 0; theta = (log10 b, log10 d).
+
+    ``segments=K`` builds the segmented early-reject protocol (the full
+    simulator is then the synthesized segment chain).
+    """
+    save_every = _check_obs_grid(n_leaps, n_obs, segments)
+    stoich = jnp.asarray(_BD_STOICH)
+
+    if segments is not None:
+        seg = tau_leap_segmented(
+            x0=[x0], stoich=_BD_STOICH,
+            prop=lambda x, r: jnp.stack([r[0], r[1] * x[0]]),
+            rates_of=lambda th: jnp.stack([10.0 ** th[0], 10.0 ** th[1]]),
+            t1=t1, n_leaps=n_leaps, n_obs=n_obs, segments=segments,
+            channels=(("x", 0),), midpoint=midpoint,
+        )
+        return JaxModel(None, ["log_b", "log_d"], name=name, segmented=seg)
 
     def sim(key, theta):
         b, d = 10.0 ** theta[0], 10.0 ** theta[1]
@@ -75,7 +186,7 @@ def make_birth_death_model(x0: float = 40.0, t1: float = 10.0,
             return jnp.stack([b, d * x[0]])
 
         traj = tau_leap(key, jnp.asarray([x0]), stoich, prop, t1, n_leaps,
-                        save_every=save_every)
+                        save_every=save_every, midpoint=midpoint)
         return {"x": traj[:, 0]}
 
     return JaxModel(sim, ["log_b", "log_d"], name=name)
@@ -88,17 +199,34 @@ def birth_death_prior() -> Distribution:
     )
 
 
+_LV_STOICH = (
+    (1.0, 0.0),   # prey birth
+    (-1.0, 1.0),  # predation converts prey to predator
+    (0.0, -1.0),  # predator death
+)
+
+
 def make_stochastic_lv_model(t1: float = 15.0, n_leaps: int = 300,
                              n_obs: int = 20,
+                             segments: int | None = None,
+                             midpoint: bool = False,
                              name: str = "stochastic_lv") -> JaxModel:
     """Stochastic Lotka-Volterra reaction network (3 channels):
     prey birth, predation, predator death; theta = log10 rates."""
-    stoich = jnp.asarray([
-        [1.0, 0.0],   # prey birth
-        [-1.0, 1.0],  # predation converts prey to predator
-        [0.0, -1.0],  # predator death
-    ])
-    save_every = n_leaps // n_obs
+    save_every = _check_obs_grid(n_leaps, n_obs, segments)
+    stoich = jnp.asarray(_LV_STOICH)
+
+    if segments is not None:
+        seg = tau_leap_segmented(
+            x0=[50.0, 100.0], stoich=_LV_STOICH,
+            prop=lambda x, r: jnp.stack(
+                [r[0] * x[0], r[1] * x[0] * x[1], r[2] * x[1]]),
+            rates_of=lambda th: 10.0 ** th[:3],
+            t1=t1, n_leaps=n_leaps, n_obs=n_obs, segments=segments,
+            channels=(("pred", 1), ("prey", 0)), midpoint=midpoint,
+        )
+        return JaxModel(None, ["log_r1", "log_r2", "log_r3"], name=name,
+                        segmented=seg)
 
     def sim(key, theta):
         r1, r2, r3 = 10.0 ** theta[0], 10.0 ** theta[1], 10.0 ** theta[2]
@@ -108,7 +236,7 @@ def make_stochastic_lv_model(t1: float = 15.0, n_leaps: int = 300,
             return jnp.stack([r1 * prey, r2 * prey * pred, r3 * pred])
 
         traj = tau_leap(key, jnp.asarray([50.0, 100.0]), stoich, prop, t1,
-                        n_leaps, save_every=save_every)
+                        n_leaps, save_every=save_every, midpoint=midpoint)
         return {"prey": traj[:, 0], "pred": traj[:, 1]}
 
     return JaxModel(sim, ["log_r1", "log_r2", "log_r3"], name=name)
@@ -125,5 +253,12 @@ def stochastic_lv_prior() -> Distribution:
 def observed_birth_death(seed: int = 0, **kwargs) -> dict:
     model = make_birth_death_model(**kwargs)
     theta = jnp.asarray([1.0, -0.5])  # b=10, d=0.32
+    out = model.sim(jax.random.key(seed), theta)
+    return {k: np.asarray(v) for k, v in out.items()}
+
+
+def observed_stochastic_lv(seed: int = 0, **kwargs) -> dict:
+    model = make_stochastic_lv_model(**kwargs)
+    theta = jnp.asarray([0.2, -1.9, 0.1])
     out = model.sim(jax.random.key(seed), theta)
     return {k: np.asarray(v) for k, v in out.items()}

@@ -50,25 +50,24 @@ def tractable_pair(noise_sds=(0.6, 1.2), prior_sd: float = 1.0):
     return models, priors, analytic_posterior
 
 
-def ode_family(n_obs: int = 12, t1: float = 8.0, noise_sd: float = 0.3):
+def ode_family(n_obs: int = 12, t1: float = 8.0, noise_sd: float = 0.3,
+               segments: int | None = None, n_substeps: int = 6):
     """K=3 nested ODE models for y(t), observed with noise:
 
     m0: dy = -a y            (pure decay)
     m1: dy = -a y + b        (decay + constant production)
     m2: dy = a y (1 - y/k)   (logistic growth)
+
+    ``segments=K`` builds every model through the segmented protocol
+    (uniform carry — rates padded to 2 — so the K>1 fused kernel can
+    switch one early-reject engine over the model id). Observations are
+    then the ``n_obs`` times AFTER t=0 (the unsegmented variant includes
+    t=0), each perturbed with noise keyed by its global observation
+    index.
     """
-    ts = np.linspace(0.0, t1, n_obs)
     y0 = jnp.asarray([2.0])
 
-    def mk(rhs, names, name):
-        def sim(key, theta):
-            traj = rk4_at_times(rhs, y0, ts, 6, args=tuple(theta))
-            y = traj[:, 0] + noise_sd * jax.random.normal(key, (len(ts),))
-            return {"y": y}
-
-        return JaxModel(sim, names, name=name)
-
-    def rhs0(y, a):
+    def rhs0(y, a, _b):
         return -a * y
 
     def rhs1(y, a, b):
@@ -77,11 +76,78 @@ def ode_family(n_obs: int = 12, t1: float = 8.0, noise_sd: float = 0.3):
     def rhs2(y, a, k):
         return a * y * (1.0 - y / k)
 
-    models = [
-        mk(rhs0, ["a"], "decay"),
-        mk(rhs1, ["a", "b"], "decay_production"),
-        mk(rhs2, ["a", "k"], "logistic"),
-    ]
+    if segments is not None:
+        if n_obs % segments:
+            raise ValueError(
+                f"segments={segments} must divide n_obs={n_obs}"
+            )
+        from ..ops.segment import SegmentedSim
+
+        ts = np.linspace(0.0, t1, n_obs + 1)[1:]
+        obs_per_seg = n_obs // segments
+        dt = (t1 / n_obs) / n_substeps
+
+        def mk(rhs, names, name, rates_of):
+            def init(key, theta):
+                return {"y": y0, "key": key,
+                        "rates": rates_of(theta)}
+
+            def step(carry, seg):
+                a_, b_ = carry["rates"][0], carry["rates"][1]
+
+                def obs_step(y, j):
+                    def micro(y, _):
+                        k1 = rhs(y, a_, b_)
+                        k2 = rhs(y + 0.5 * dt * k1, a_, b_)
+                        k3 = rhs(y + 0.5 * dt * k2, a_, b_)
+                        k4 = rhs(y + dt * k3, a_, b_)
+                        return (y + (dt / 6.0)
+                                * (k1 + 2 * k2 + 2 * k3 + k4), None)
+
+                    y_new, _ = jax.lax.scan(micro, y, None,
+                                            length=n_substeps)
+                    kn = jax.random.fold_in(
+                        carry["key"], seg * obs_per_seg + j)
+                    obs = y_new[0] + noise_sd * jax.random.normal(kn)
+                    return y_new, obs
+
+                y_fin, ys = jax.lax.scan(
+                    obs_step, carry["y"],
+                    jnp.arange(obs_per_seg, dtype=jnp.int32))
+                return {**carry, "y": y_fin}, ys
+
+            seg_spec = SegmentedSim(n_segments=segments, init=init,
+                                    step=step,
+                                    layout=(("y", obs_per_seg),))
+            return JaxModel(None, names, name=name, segmented=seg_spec)
+
+        models = [
+            mk(rhs0, ["a"], "decay",
+               lambda th: jnp.stack([th[0], jnp.zeros(())])),
+            mk(rhs1, ["a", "b"], "decay_production",
+               lambda th: jnp.stack([th[0], th[1]])),
+            mk(rhs2, ["a", "k"], "logistic",
+               lambda th: jnp.stack([th[0], th[1]])),
+        ]
+    else:
+        ts = np.linspace(0.0, t1, n_obs)
+
+        def mk(rhs, names, name, nargs):
+            def sim(key, theta):
+                args = tuple(theta[:nargs]) + ((jnp.zeros(()),)
+                                               if nargs == 1 else ())
+                traj = rk4_at_times(rhs, y0, ts, n_substeps, args=args)
+                y = traj[:, 0] + noise_sd * jax.random.normal(
+                    key, (len(ts),))
+                return {"y": y}
+
+            return JaxModel(sim, names, name=name)
+
+        models = [
+            mk(rhs0, ["a"], "decay", 1),
+            mk(rhs1, ["a", "b"], "decay_production", 2),
+            mk(rhs2, ["a", "k"], "logistic", 2),
+        ]
     priors = [
         Distribution(a=RV("uniform", 0.05, 1.0)),
         Distribution(a=RV("uniform", 0.05, 1.0), b=RV("uniform", 0.0, 1.0)),
@@ -92,8 +158,9 @@ def ode_family(n_obs: int = 12, t1: float = 8.0, noise_sd: float = 0.3):
 
 def observed_ode_family(seed: int = 0, true_model: int = 1,
                         n_obs: int = 12, t1: float = 8.0,
-                        noise_sd: float = 0.3) -> dict:
-    models, _, _ = ode_family(n_obs, t1, noise_sd)
+                        noise_sd: float = 0.3,
+                        segments: int | None = None) -> dict:
+    models, _, _ = ode_family(n_obs, t1, noise_sd, segments=segments)
     true_theta = {0: [0.4], 1: [0.4, 0.5], 2: [0.5, 6.0]}[true_model]
     out = models[true_model].sim(
         jax.random.key(seed), jnp.asarray(true_theta)

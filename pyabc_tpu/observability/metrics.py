@@ -232,6 +232,18 @@ MESH_ROW_COLLECTIVES_TOTAL = "pyabc_tpu_mesh_row_collectives_total"
 #:  stochastic record-column gathers (bytes; 0 for non-adaptive configs)
 MESH_SCALE_BYTES_GAUGE = "pyabc_tpu_mesh_scale_reduction_bytes_per_gen"
 
+# Segmented early-reject execution (ISSUE 15): both instruments ride
+# the packed fetch (four int32 per generation — zero extra syncs,
+# SyncLedger-asserted under the strict budget).
+#:  vector lanes retired between segments because the distance's
+#:  monotone prefix bound already exceeded the generation threshold —
+#:  each retirement is a provably-rejected trajectory whose remaining
+#:  segments were never paid for
+SIM_LANES_RETIRED_TOTAL = "pyabc_tpu_sim_lanes_retired_early_total"
+#:  productive segment-step share of the last chunk's lane sweeps
+#:  (seg_steps / (B * sweeps)); the shortfall is drain/refill idle time
+SIM_SEGMENT_OCCUPANCY_GAUGE = "pyabc_tpu_sim_segment_occupancy"
+
 
 # -- multi-tenant serving instrument names (round 14) -------------------------
 #

@@ -1,0 +1,208 @@
+"""Segmented simulation: the early-reject execution protocol (ISSUE 15).
+
+On the expensive scenario simulators (tau-leap Gillespie, network SIR,
+ODE families) the fused kernel's dominant cost is the proposal loop,
+where every lane runs the FULL trajectory (hundreds of ``lax.scan``
+steps) before the accept/reject decision — yet for p-norm distances the
+partial distance accumulated over a trajectory PREFIX is monotone
+non-decreasing, so a lane whose prefix bound already exceeds the
+generation epsilon is provably rejected and every remaining step of its
+simulation is discardable work. In late generations acceptance sits in
+the few-percent range, which makes nearly all device time provably
+wasted.
+
+This module holds the protocol + pure math of the fix; the execution
+engine (the segment-inner proposal loop with mid-flight lane refill)
+lives in ``inference/util.py::DeviceContext._generation_while_seg``.
+
+The protocol (:class:`SegmentedSim`): a model factors its simulator into
+
+- ``init(key, theta) -> carry`` — allocate the trajectory state (the
+  carry typically stores the sim key; per-step keys derive from it via
+  ``fold_in`` so a segment is reproducible in isolation);
+- ``step(carry, seg_idx) -> (carry, (seg_size,) f32)`` — advance one
+  fixed-length segment and emit that segment's summary-statistic
+  block. ``seg_idx`` MUST enter only as data (dynamic indexing /
+  ``fold_in`` tags) — the engine vmaps lanes sitting at DIFFERENT
+  segment indices through one step program, and a ``lax.switch`` over
+  ``seg_idx`` would execute every branch per lane;
+- ``layout`` — the emit order: per segment, for each named statistic,
+  the next ``sizes[name] / n_segments`` entries of its time series.
+
+``full_sim_from_segments`` synthesizes the ordinary ``sim(key, theta)``
+dict simulator by scanning the segment chain — the classic (unsegmented)
+kernel, the host oracle and the segmented engine therefore execute the
+IDENTICAL per-step math on identical keys, which is what makes the
+early-reject ON vs OFF populations bit-comparable.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class SegmentedSim:
+    """Segmented-simulation protocol of a :class:`~pyabc_tpu.model.JaxModel`.
+
+    ``layout`` is a tuple of ``(stat_name, per_segment_length)`` pairs in
+    the order ``step`` emits them; summing the lengths gives
+    ``seg_size`` and each stat's full series has
+    ``per_segment_length * n_segments`` entries.
+    """
+
+    n_segments: int
+    init: Callable
+    step: Callable
+    layout: tuple
+
+    @property
+    def seg_size(self) -> int:
+        return int(sum(per for _name, per in self.layout))
+
+
+def carry_struct_for(seg: SegmentedSim, dim: int):
+    """(shape, dtype) pytree of the protocol's carry for a dim-parameter
+    model — the K>1 uniformity gate compares these across models."""
+    key = jax.eval_shape(lambda: jax.random.key(0))
+    theta = jax.ShapeDtypeStruct((dim,), jnp.float32)
+    return jax.eval_shape(seg.init, key, theta)
+
+
+def index_map_for(seg: SegmentedSim, spec) -> np.ndarray:
+    """``(n_segments, seg_size)`` int32 map from each segment's emitted
+    block to its positions in the spec's FLAT sum-stat vector.
+
+    The flat vector concatenates stats by sorted name (``SumStatSpec``),
+    so a multi-channel time series is NOT contiguous in trajectory
+    order — segment ``j`` of channel ``c`` lands at
+    ``offsets[c] + j*per_seg .. + (j+1)*per_seg``. The engine gathers a
+    lane's row with its CURRENT (data-dependent) segment index, which is
+    why this is a precomputed array and not control flow.
+    """
+    rows = []
+    for j in range(seg.n_segments):
+        cols = []
+        for name, per in seg.layout:
+            if name not in spec.offsets:
+                raise KeyError(
+                    f"segment layout names unknown stat {name!r} "
+                    f"(spec has {spec.names})"
+                )
+            if per * seg.n_segments != spec.sizes[name]:
+                raise ValueError(
+                    f"stat {name!r}: {seg.n_segments} segments x {per} "
+                    f"per segment != spec size {spec.sizes[name]}"
+                )
+            off = spec.offsets[name] + j * per
+            cols.append(np.arange(off, off + per))
+        rows.append(np.concatenate(cols))
+    out = np.stack(rows).astype(np.int32)
+    if out.shape != (seg.n_segments, seg.seg_size):
+        raise ValueError("segment layout does not tile the spec")
+    return out
+
+
+def full_sim_from_segments(seg: SegmentedSim):
+    """Synthesize the ordinary dict simulator ``sim(key, theta)`` from the
+    segment chain (one ``lax.scan`` over segments). Both execution modes
+    of a segmented model run THIS chain — the classic kernel through the
+    scan, the early-reject engine step by step — so a proposal that runs
+    to completion produces identical statistics either way."""
+
+    def sim(key, theta):
+        carry0 = seg.init(key, theta)
+
+        def body(c, j):
+            c, vals = seg.step(c, j)
+            return c, vals
+
+        _, out = jax.lax.scan(body, carry0,
+                              jnp.arange(seg.n_segments, dtype=jnp.int32))
+        res = {}
+        col = 0
+        for name, per in seg.layout:
+            # (n_segments, per) -> the stat's full series in time order
+            res[name] = out[:, col:col + per].reshape(-1)
+            col += per
+        return res
+
+    return sim
+
+
+def uniform_protocol_reason(models) -> str | None:
+    """Why a model family cannot run ONE segmented engine program (None
+    = uniform). The engine switches the segment step over the model id
+    per lane, so every model must declare the same segment count, the
+    same emitted block size and an identical carry structure."""
+    segs = [getattr(m, "segmented", None) for m in models]
+    if any(s is None for s in segs):
+        missing = [m.name for m, s in zip(models, segs) if s is None]
+        return (f"model(s) {missing} declare no segmented-simulation "
+                f"protocol (JaxModel(segmented=...))")
+    ref = segs[0]
+    if ref.n_segments < 2:
+        return "n_segments < 2 leaves nothing to retire early"
+    for m, s in zip(models[1:], segs[1:]):
+        if s.n_segments != ref.n_segments or s.seg_size != ref.seg_size:
+            return (f"model {m.name!r} segments "
+                    f"({s.n_segments}x{s.seg_size}) differ from "
+                    f"{models[0].name!r} ({ref.n_segments}x{ref.seg_size})")
+        if tuple(s.layout) != tuple(ref.layout):
+            return (f"model {m.name!r} emit layout differs from "
+                    f"{models[0].name!r}")
+    try:
+        structs = [
+            jax.tree.map(
+                lambda x: (tuple(x.shape), str(x.dtype)),
+                carry_struct_for(s, m.space.dim),
+            )
+            for m, s in zip(models, segs)
+        ]
+    except Exception as exc:
+        return f"segment carry structure could not be traced: {exc!r}"
+    if any(str(st) != str(structs[0]) for st in structs[1:]):
+        return ("segment carry structures differ across models (the "
+                "per-lane lax.switch needs identical carry avals)")
+    return None
+
+
+def select_lanes(mask, new, old):
+    """Per-lane pytree select: ``new`` where ``mask`` else ``old``,
+    broadcasting the (B,) mask over trailing leaf dims. Typed PRNG-key
+    leaves route through key_data so any jax version selects them."""
+
+    def leaf(n, o):
+        try:
+            is_key = jnp.issubdtype(n.dtype, jax.dtypes.prng_key)
+        except Exception:
+            is_key = False
+        if is_key:
+            nd, od = jax.random.key_data(n), jax.random.key_data(o)
+            m = mask.reshape(mask.shape + (1,) * (nd.ndim - mask.ndim))
+            return jax.random.wrap_key_data(jnp.where(m, nd, od))
+        m = mask.reshape(mask.shape + (1,) * (n.ndim - mask.ndim))
+        return jnp.where(m, n, o)
+
+    return jax.tree.map(leaf, new, old)
+
+
+def gather_lanes(tree, idx):
+    """Row-gather every leaf of a lane pytree (leading axis = slots /
+    lanes). Works on typed PRNG-key leaves too — key arrays support
+    integer-array indexing."""
+    return jax.tree.map(lambda x: x[idx], tree)
+
+
+def occupancy(seg_steps, lane_sweeps):
+    """Fraction of vector-lane segment slots that advanced a live
+    candidate (``seg_steps`` productive steps out of ``B * sweeps``
+    total lane slots). 1.0 = every lane busy every sweep; the shortfall
+    is drain/imbalance idle time, while early-reject SAVINGS show up as
+    fewer sweeps for the same resolved proposal count."""
+    return np.where(lane_sweeps > 0,
+                    seg_steps / np.maximum(lane_sweeps, 1), 1.0)

@@ -142,3 +142,22 @@ SERVE_FAIRNESS_MAX_RATIO = 3.0
 DEFAULT_STORAGE_POP = 16384
 DEFAULT_STORAGE_GENS = 6
 DEFAULT_STORAGE_GUARD_MIN_X = 10.0
+# scenario lane (round 18): segmented early-reject on the scenario zoo.
+# Gillespie birth-death is the headline: one deep MedianEpsilon run per
+# mode (ON/OFF) at a population whose pow2 lane batch keeps the CPU
+# proxy inside the lane budget (accelerators lift via
+# PYABC_TPU_BENCH_SCENARIO_POP); generations run DEEP so the run enters
+# the few-percent-acceptance regime the tentpole targets — the late
+# window (acceptance <= SCENARIO_LATE_ACC) is where the provable-reject
+# fraction, and therefore the speedup, lives. 10 segments of a 200-leap
+# trajectory bound the retire granularity at 10% of the sim cost.
+DEFAULT_SCENARIO_POP = 1024
+DEFAULT_SCENARIO_POP_TPU = 131072
+DEFAULT_SCENARIO_GENS = 12
+DEFAULT_SCENARIO_SEGS = 10
+# the late window: chunks FULLY at/below this acceptance — the
+# few-percent regime where most proposals are provably rejectable
+SCENARIO_LATE_ACC = 0.01
+#: regression guard: late-window accepted-pps ratio ON/OFF (the ISSUE 15
+#: acceptance line; armed only when the run reaches the late window)
+SCENARIO_SPEEDUP_MIN_X = 2.0

@@ -1,0 +1,238 @@
+"""Segmented early-reject execution (ISSUE 15).
+
+The soundness contract under test: with ``early_reject`` ON, the fused
+kernel runs proposals segment by segment and retires lanes whose
+monotone distance lower bound already exceeds the generation epsilon —
+and the ACCEPTED POPULATIONS are bit-identical to the classic
+full-trajectory run (same keys, same slot order, only provably-rejected
+work skipped). Plus: the bound's monotonicity on random data, capability
+gating with named reasons, and the packed-fetch accounting metrics.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import pyabc_tpu as pt
+from pyabc_tpu.models import gillespie as g
+from pyabc_tpu.ops.segment import (
+    full_sim_from_segments,
+    index_map_for,
+    uniform_protocol_reason,
+)
+
+SEGMENTS = 5
+N_LEAPS = 100
+N_OBS = 20
+
+
+def _bd_model():
+    return g.make_birth_death_model(n_leaps=N_LEAPS, n_obs=N_OBS,
+                                    segments=SEGMENTS)
+
+
+def _run(early_reject, *, pop=64, gens=4, seed=11, **kwargs):
+    obs = g.observed_birth_death(n_leaps=N_LEAPS, n_obs=N_OBS,
+                                 segments=SEGMENTS)
+    abc = pt.ABCSMC(_bd_model(), g.birth_death_prior(),
+                    pt.PNormDistance(p=2), population_size=pop,
+                    eps=pt.MedianEpsilon(), seed=seed,
+                    early_reject=early_reject, fused_generations=4,
+                    **kwargs)
+    abc.new("sqlite://", obs)
+    h = abc.run(max_nr_populations=gens)
+    return abc, h
+
+
+# ---------------------------------------------------------------- protocol
+
+def test_segment_chain_matches_full_sim():
+    """The synthesized full simulator IS the segment chain: stepping the
+    protocol by hand and scattering through the index map reproduces
+    spec.flatten(sim(...)) bit-exactly."""
+    model = _bd_model()
+    spec = model.sumstat_spec()
+    seg = model.segmented
+    imap = index_map_for(seg, spec)
+    assert imap.shape == (SEGMENTS, seg.seg_size)
+    # every flat position is emitted exactly once
+    assert sorted(imap.reshape(-1).tolist()) == list(range(spec.total_size))
+
+    key = jax.random.key(3)
+    theta = jnp.asarray([1.0, -0.5])
+    full = np.asarray(spec.flatten(model.sim(key, theta)))
+    carry = seg.init(key, theta)
+    buf = np.zeros(spec.total_size, np.float32)
+    for j in range(seg.n_segments):
+        carry, vals = seg.step(carry, jnp.asarray(j, jnp.int32))
+        buf[imap[j]] = np.asarray(vals)
+    assert np.array_equal(buf, full)
+
+
+def test_multi_channel_layout_roundtrip():
+    model = g.make_stochastic_lv_model(n_leaps=100, n_obs=20, segments=4)
+    spec = model.sumstat_spec()
+    imap = index_map_for(model.segmented, spec)
+    assert sorted(imap.reshape(-1).tolist()) == list(range(spec.total_size))
+    sim2 = full_sim_from_segments(model.segmented)
+    out1 = model.sim(jax.random.key(0), jnp.asarray([0.2, -1.9, 0.1]))
+    out2 = sim2(jax.random.key(0), jnp.asarray([0.2, -1.9, 0.1]))
+    for k in out1:
+        assert np.array_equal(np.asarray(out1[k]), np.asarray(out2[k]))
+
+
+# ------------------------------------------------------------------- bound
+
+def test_pnorm_bound_monotone_and_sound():
+    rng = np.random.default_rng(0)
+    S = 24
+    spec_like = None  # the bound closures never read the spec
+    for p in (1.0, 2.0, np.inf):
+        dist = pt.PNormDistance(p=p)
+        w = jnp.asarray(rng.uniform(0.1, 2.0, S), jnp.float32)
+        bound = dist.device_bound_fn(spec_like)
+        x = jnp.asarray(rng.normal(size=S), jnp.float32)
+        x0 = jnp.asarray(rng.normal(size=S), jnp.float32)
+        dfn = dist.device_fn(None)
+        full = float(dfn(x, x0, w))
+        acc = bound["init"]()
+        prev_exceeds = False
+        for lo in range(0, S, 6):
+            idx = jnp.arange(lo, lo + 6)
+            acc = bound["step"](acc, x[idx], idx, x0, w)
+            # sound: never declares rejection below the true distance
+            assert not bool(bound["exceeds"](acc, jnp.asarray(full), w))
+            # monotone: once above a small threshold, stays above
+            small = jnp.asarray(full * 0.1)
+            now = bool(bound["exceeds"](acc, small, w))
+            assert now or not prev_exceeds
+            prev_exceeds = now
+        # after the full prefix the bound detects any threshold < d
+        assert bool(bound["exceeds"](acc, jnp.asarray(full * 0.9), w))
+
+
+def test_aggregated_bound_sound():
+    rng = np.random.default_rng(1)
+    S = 16
+    d = pt.AggregatedDistance(
+        [pt.PNormDistance(p=2), pt.PNormDistance(p=np.inf)],
+        weights=[0.7, 1.3],
+    )
+    d.initialize(0, x_0={"y": np.zeros(S)})
+    bound = d.device_bound_fn(None)
+    assert bound is not None
+    params = d.device_params(None)
+    dfn = d.device_fn(None)
+    x = jnp.asarray(rng.normal(size=S), jnp.float32)
+    x0 = jnp.asarray(rng.normal(size=S), jnp.float32)
+    full = float(dfn(x, x0, params))
+    acc = bound["init"]()
+    for lo in range(0, S, 4):
+        idx = jnp.arange(lo, lo + 4)
+        acc = bound["step"](acc, x[idx], idx, x0, params)
+        assert not bool(bound["exceeds"](acc, jnp.asarray(full), params))
+    assert bool(bound["exceeds"](acc, jnp.asarray(full * 0.9), params))
+
+
+# ----------------------------------------------------------- end to end
+
+def test_early_reject_populations_bit_identical():
+    """The headline contract: ON vs OFF accepted populations (theta,
+    weights, distances, epsilon trail) are BIT-identical — early reject
+    skips only provably-rejected work."""
+    abc_on, h_on = _run("auto", seed=11)
+    abc_off, h_off = _run(False, seed=11)
+    assert h_on.max_t == h_off.max_t
+    for t in range(h_on.max_t + 1):
+        df1, w1 = h_on.get_distribution(m=0, t=t)
+        df2, w2 = h_off.get_distribution(m=0, t=t)
+        assert np.array_equal(np.asarray(df1), np.asarray(df2))
+        assert np.array_equal(w1, w2)
+        ext1 = h_on.get_population_extended(t)
+        ext2 = h_off.get_population_extended(t)
+        assert np.array_equal(np.asarray(ext1["distance"]),
+                              np.asarray(ext2["distance"]))
+    # work was actually skipped in the late generations
+    retired = [
+        (h_on.get_telemetry(t) or {}).get("retired_early", 0)
+        for t in range(h_on.max_t + 1)
+    ]
+    assert sum(retired) > 0
+    occ = (h_on.get_telemetry(h_on.max_t) or {}).get("segment_occupancy")
+    assert occ is not None and 0.0 < occ <= 1.0
+
+
+def test_early_reject_metrics_exported():
+    from pyabc_tpu.observability import global_metrics
+    from pyabc_tpu.observability.metrics import (
+        SIM_LANES_RETIRED_TOTAL,
+        SIM_SEGMENT_OCCUPANCY_GAUGE,
+    )
+
+    before = global_metrics().counter(SIM_LANES_RETIRED_TOTAL).value
+    _run("auto", seed=13, gens=3)
+    after = global_metrics().counter(SIM_LANES_RETIRED_TOTAL).value
+    assert after > before
+    occ = global_metrics().gauge(SIM_SEGMENT_OCCUPANCY_GAUGE).value
+    assert 0.0 < occ <= 1.0
+
+
+# ----------------------------------------------------------------- gating
+
+def test_unsegmented_model_gates_off_with_reason():
+    from pyabc_tpu.models import lotka_volterra as lv
+
+    abc = pt.ABCSMC(lv.make_lv_model(), lv.default_prior(),
+                    pt.PNormDistance(p=2), population_size=32)
+    abc.new("sqlite://", lv.observed_data(seed=123))
+    reason = abc._early_reject_incapable_reason(
+        adaptive=False, stochastic=False, sumstat_mode=False,
+        sharded_n=None)
+    assert reason is not None and "segmented" in reason
+
+
+def test_early_reject_required_raises_when_incapable():
+    from pyabc_tpu.models import lotka_volterra as lv
+
+    abc = pt.ABCSMC(lv.make_lv_model(), lv.default_prior(),
+                    pt.PNormDistance(p=2), population_size=32,
+                    early_reject=True, fused_generations=4)
+    abc.new("sqlite://", lv.observed_data(seed=123))
+    with pytest.raises(ValueError, match="early_reject=True unavailable"):
+        abc.run(max_nr_populations=2)
+
+
+def test_adaptive_distance_gates_off():
+    obs = g.observed_birth_death(n_leaps=N_LEAPS, n_obs=N_OBS,
+                                 segments=SEGMENTS)
+    abc = pt.ABCSMC(_bd_model(), g.birth_death_prior(),
+                    pt.AdaptivePNormDistance(p=2), population_size=32,
+                    early_reject="auto")
+    abc.new("sqlite://", obs)
+    reason = abc._early_reject_incapable_reason(
+        adaptive=True, stochastic=False, sumstat_mode=False,
+        sharded_n=None)
+    assert reason is not None and "adaptive" in reason
+    # sharded composition is named too
+    reason = abc._early_reject_incapable_reason(
+        adaptive=False, stochastic=False, sumstat_mode=False,
+        sharded_n=8)
+    assert reason is not None and "sharded" in reason
+
+
+def test_uniform_protocol_reason_names_mismatch():
+    a = g.make_birth_death_model(segments=5)
+    b = g.make_birth_death_model(segments=5)
+    assert uniform_protocol_reason([a, b]) is None
+    c = g.make_birth_death_model(n_leaps=200, n_obs=20, segments=4)
+    assert "differ" in uniform_protocol_reason([a, c])
+    from pyabc_tpu.models import lotka_volterra as lv
+
+    assert "no segmented" in uniform_protocol_reason(
+        [a, lv.make_lv_model()])
+
+
+def test_early_reject_arg_validated():
+    with pytest.raises(ValueError, match="early_reject"):
+        pt.ABCSMC(_bd_model(), g.birth_death_prior(),
+                  pt.PNormDistance(p=2), early_reject="yes")

@@ -422,10 +422,25 @@ def test_mesh001_fires_on_collectives_outside_kernel_layer():
     }
 
 
+def test_instrumented_set_pins_kernel_layer_files():
+    """The INSTRUMENTED set exists so a rename can't silently un-lint a
+    kernel-layer module; ISSUE 15 pins the segmented engine's math."""
+    from pathlib import Path
+
+    from pyabc_tpu.analysis.engine import INSTRUMENTED
+
+    assert "pyabc_tpu/ops/segment.py" in INSTRUMENTED
+    assert "pyabc_tpu/inference/util.py" in INSTRUMENTED
+    root = Path(__file__).resolve().parents[1]
+    for rel in INSTRUMENTED:
+        assert (root / rel).exists(), f"pinned module missing: {rel}"
+
+
 def test_mesh001_kernel_layer_and_tests_exempt():
     assert not Mesh001().applies_to("pyabc_tpu/inference/util.py")
     assert not Mesh001().applies_to("pyabc_tpu/ops/shard.py")
     assert not Mesh001().applies_to("pyabc_tpu/ops/pack.py")
+    assert not Mesh001().applies_to("pyabc_tpu/ops/segment.py")
     assert not Mesh001().applies_to("tests/test_sharded.py")
     assert Mesh001().applies_to("pyabc_tpu/inference/smc.py")
     assert Mesh001().applies_to("pyabc_tpu/inference/dispatch.py")
