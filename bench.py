@@ -2079,6 +2079,267 @@ def run_storage_lane(budget_s: float) -> dict:
     return out
 
 
+# -- traffic lane -------------------------------------------------------------
+
+
+def traffic_lane_skip_reason() -> str | None:
+    """The `traffic` lane (round 19) runs the fleet-scale churn rig: an
+    open-loop seeded Poisson arrival process over the tenant-spec zoo
+    against a live RunScheduler with retention/GC/quotas armed, at an
+    arrival rate deliberately above what the pool can drain. Guards:
+    p99 admission latency, Retry-After honesty, within-class fairness,
+    and BOUNDED DISK — total History bytes stay under the fleet budget
+    and disposed tenants leave no files behind (the satellite-1
+    eviction-GC bugfix, measured). PYABC_TPU_BENCH_TRAFFIC=0 disables
+    it."""
+    if os.environ.get("PYABC_TPU_BENCH_TRAFFIC") == "0":
+        return "disabled via PYABC_TPU_BENCH_TRAFFIC=0"
+    return None
+
+
+def _traffic_lane_child() -> dict:
+    """The traffic lane's measured body — runs in the lane subprocess
+    with the 8-device platform configured and the sync budget strict.
+
+    Open-loop means the schedule does not wait for the pool: arrivals
+    land on time whether or not earlier tenants finished, 429s retry
+    exactly Retry-After later, and the lane's job is to measure what
+    the serving stack does under that pressure — not to complete every
+    tenant. Guards are ARMED only when their sample counts make them
+    meaningful (the scenario-lane precedent); the disk guards are
+    always armed.
+    """
+    import tempfile
+    import time
+    from pathlib import Path
+
+    import jax
+
+    from pyabc_tpu.observability import SYSTEM_CLOCK
+    from pyabc_tpu.serving import RetentionPolicy, RunScheduler, TenantQuota
+    from pyabc_tpu.traffic import (
+        ArrivalSchedule,
+        TrafficClass,
+        TrafficGenerator,
+    )
+    from pyabc_tpu.utils.bench_defaults import (
+        DEFAULT_TRAFFIC_BUDGET_S,
+        DEFAULT_TRAFFIC_DISK_BUDGET_BYTES,
+        DEFAULT_TRAFFIC_PROFILE,
+        DEFAULT_TRAFFIC_RATE_HZ,
+        DEFAULT_TRAFFIC_SEED,
+        DEFAULT_TRAFFIC_TENANTS,
+        TRAFFIC_ADMIT_P99_MAX_S,
+        TRAFFIC_FAIRNESS_MAX_RATIO,
+        TRAFFIC_HONESTY_P90_MAX,
+    )
+
+    clock = SYSTEM_CLOCK
+    t0 = clock.now()
+    budget = float(os.environ.get("PYABC_TPU_BENCH_TRAFFIC_BUDGET_S",
+                                  DEFAULT_TRAFFIC_BUDGET_S))
+    n_tenants = int(os.environ.get("PYABC_TPU_BENCH_TRAFFIC_TENANTS",
+                                   DEFAULT_TRAFFIC_TENANTS))
+    rate_hz = float(os.environ.get("PYABC_TPU_BENCH_TRAFFIC_RATE_HZ",
+                                   DEFAULT_TRAFFIC_RATE_HZ))
+    profile = os.environ.get("PYABC_TPU_BENCH_TRAFFIC_PROFILE",
+                             DEFAULT_TRAFFIC_PROFILE)
+    seed = int(os.environ.get("PYABC_TPU_BENCH_TRAFFIC_SEED",
+                              str(DEFAULT_TRAFFIC_SEED)))
+    disk_budget = int(os.environ.get(
+        "PYABC_TPU_BENCH_TRAFFIC_DISK_BUDGET_BYTES",
+        DEFAULT_TRAFFIC_DISK_BUDGET_BYTES))
+    n_dev = len(jax.devices())
+    out = {"n_devices": n_dev, "n_tenants": n_tenants,
+           "rate_hz": rate_hz, "profile": profile, "seed": seed,
+           "disk_budget_bytes": disk_budget}
+    if n_dev < 8:
+        out["skipped"] = (
+            f"only {n_dev} device(s) and forcing virtual devices was "
+            f"unavailable on this platform")
+        return out
+
+    base_dir = tempfile.mkdtemp(prefix="abc-bench-traffic-")
+    sched = RunScheduler(
+        n_devices=8, packing=2, max_queued=16, lease_timeout_s=120.0,
+        max_requeues=1, base_dir=base_dir,
+        # small terminal-retention cap + 1s sweeps keep the
+        # dispose/GC machinery HOT for the bounded-disk guards
+        max_terminal_tenants=32, lifecycle_sweep_s=1.0,
+        retention=RetentionPolicy(keep_last_k=1,
+                                  total_bytes_budget=disk_budget),
+        quota=TenantQuota(max_generations=64),
+    )
+    schedule = ArrivalSchedule.poisson(
+        n_tenants, rate_hz=rate_hz, seed=seed, profile=profile)
+    gen = TrafficGenerator(sched, schedule)
+    try:
+        # -- phase 1, DRAINED SLO PROBES on the fresh, idle pool:
+        # storms sized so the pool actually drains them — the regime
+        # where the Retry-After hint's promise (and per-tenant
+        # treatment) is testable. Probes must run BEFORE the churn:
+        # churn's cancelled stragglers burn the box until their chunk
+        # boundaries (minutes, for big tenants), and a probe racing
+        # them measures the backlog again — its hints price
+        # straggler-occupied slots, its service times share their CPU.
+        # The honesty probe is one burst over slot+queue capacity
+        # (rejections guaranteed, every arrival eventually admitted);
+        # the fairness probe is paced Poisson at near-constant
+        # concurrency so service times compare like for like. Guards
+        # arm only if the probe drained (cold-compile-bound smoke runs
+        # record instead of asserting — the scenario-lane precedent).
+        # One shared probe shape: a single warmup run pays the compile
+        # and seeds the admission cost estimator, the honesty burst
+        # then doubles as the fairness probe's cache warmer; pop 400
+        # keeps a run's service time around a second — sub-second runs
+        # made the fairness ratio measure OS scheduling jitter,
+        # whole-minute runs don't drain inside the probe window.
+        from pyabc_tpu.traffic import make_spec
+
+        probe_cls = (TrafficClass("gauss-probe", "gaussian",
+                                  weight=1.0, pops=(400,), gens=(3,)),)
+
+        def left() -> float:
+            return budget - (clock.now() - t0)
+
+        warm = sched.submit(make_spec(probe_cls[0], seed=seed),
+                            tenant_id="probe-warmup")
+        warm_by = clock.now() + min(budget * 0.25, 120.0)
+        while (warm.state not in ("completed", "failed")
+               and clock.now() < warm_by):
+            time.sleep(0.25)
+
+        hon_gen = TrafficGenerator(sched, ArrivalSchedule.burst(
+            1, burst_size=40, interval_s=1.0, seed=seed + 1,
+            classes=probe_cls))
+        hon_gen.run(budget_s=max(min(budget * 0.2, 120.0), 20.0))
+        hon_rep = hon_gen.report()
+        hon_drained = hon_gen.done() and hon_rep["dropped"] == 0
+        hon_gen.abort_pending()
+
+        fair_gen = TrafficGenerator(sched, ArrivalSchedule.poisson(
+            12, rate_hz=0.5, seed=seed + 2, classes=probe_cls))
+        fair_gen.run(budget_s=max(min(budget * 0.15, 90.0), 20.0))
+        fair_rep = fair_gen.report()
+        fair_drained = (fair_gen.done() and fair_rep["dropped"] == 0
+                        and any(n >= 2 for n in
+                                fair_rep["completed_by_class"].values()))
+        fair_gen.abort_pending()
+
+        # -- phase 2, CHURN: the seeded open-loop storm at full
+        # pressure, with whatever budget the probes left. This phase
+        # owns the lifecycle guards (GC, bounded disk, no orphans); its
+        # SLO percentiles are RECORDED but not asserted — under
+        # sustained open-loop overload the first Retry-After hint
+        # legitimately underestimates (new arrivals keep refilling the
+        # queue it priced) and completion times reflect backlog depth,
+        # not the scheduler's treatment.
+        gen.run(budget_s=max(left() - 20.0, 30.0))
+        rep = gen.report()
+        gen.abort_pending()
+
+        life = sched.lifecycle.stats()
+
+        # -- bounded disk AFTER all three phases: what is actually on
+        # disk vs what the scheduler still tracks (a disposed tenant
+        # leaving files behind is exactly the satellite-1 eviction
+        # leak)
+        live_ids = {st["id"] for st in
+                    sched.snapshot()["tenants"]
+                    if not st.get("disposed")}
+        total_bytes = 0
+        orphans = []
+        for p in Path(base_dir).rglob("*"):
+            if not p.is_file():
+                continue
+            total_bytes += p.stat().st_size
+            rel = p.relative_to(base_dir)
+            # ownership = the tenant id prefix of the TOP-level entry
+            # (x.db, x.db-wal, x.ck, x.db.columnar/run1/t0.parquet,
+            # x.tar.gz all belong to tenant x)
+            owner = rel.parts[0].split(".")[0]
+            if owner and owner not in live_ids:
+                orphans.append(str(rel))
+
+        honesty_armed = hon_drained and hon_rep["honesty_ratio"]["n"] >= 5
+        admit_armed = (hon_drained
+                       and hon_rep["admission_latency_s"]["n"] >= 10)
+        guard = {
+            "pass_admission_p99": (
+                bool(hon_rep["admission_latency_s"]["p99"]
+                     <= TRAFFIC_ADMIT_P99_MAX_S)
+                if admit_armed else None),
+            "pass_retry_after_honesty": (
+                bool(hon_rep["honesty_ratio"]["p90"]
+                     <= TRAFFIC_HONESTY_P90_MAX)
+                if honesty_armed else None),
+            "pass_fairness": (
+                bool(fair_rep["fairness_max_ratio"]
+                     <= TRAFFIC_FAIRNESS_MAX_RATIO)
+                if fair_drained else None),
+            "pass_disk_bounded": bool(total_bytes <= disk_budget),
+            "pass_no_orphan_files": orphans == [],
+            "pass_gc_exercised": bool(
+                life["generations_gced_total"] > 0
+                or life["tenants_disposed_total"] > 0),
+            "sync_budget_strict_armed": bool(
+                os.environ.get("PYABC_TPU_SYNC_BUDGET_STRICT") == "1"),
+        }
+        out.update({
+            "metric": "traffic_fleet_churn",
+            "lane_s": round(clock.now() - t0, 2),
+            "report": rep,
+            "honesty_probe": {"drained": hon_drained, **hon_rep},
+            "fairness_probe": {"drained": fair_drained, **fair_rep},
+            "lifecycle": life,
+            "bytes_on_disk_total": int(total_bytes),
+            "orphan_files": orphans[:20],
+            "admission": sched.snapshot()["admission"],
+            "regression_guard": guard,
+            "value": 1.0 if all(
+                v for v in
+                (x for k, x in guard.items() if k.startswith("pass_"))
+                if v is not None) else 0.0,
+        })
+        return out
+    finally:
+        sched.shutdown()
+
+
+def run_traffic_lane(budget_s: float) -> dict:
+    """Run the traffic lane in a subprocess with 8 forced virtual CPU
+    devices and the sync budget STRICT — the same rig as the serve
+    lane and the CI ``traffic`` smoke job."""
+    budget_s = max(float(budget_s), 45.0)
+    env = dict(os.environ)
+    env["PYABC_TPU_BENCH_TRAFFIC_CHILD"] = "1"
+    env.setdefault("PYABC_TPU_BENCH_TRAFFIC_BUDGET_S",
+                   str(budget_s * 0.9))
+    env["PYABC_TPU_SYNC_BUDGET_STRICT"] = "1"
+    if probe_platform() == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(HERE, "bench.py")],
+            env=env, capture_output=True, text=True,
+            timeout=budget_s + 60.0,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"traffic lane child timed out after {budget_s}s"}
+    for line in reversed(proc.stdout.strip().splitlines() or [""]):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    return {"error": f"traffic lane child rc={proc.returncode}: "
+                     f"{(proc.stderr or '')[-400:]}"}
+
+
 def main():
     from pyabc_tpu.utils.bench_defaults import (
         DEFAULT_BUDGET_S,
@@ -2193,6 +2454,28 @@ def main():
             except Exception as e:
                 _state["serve"] = {"error": repr(e)[:300]}
         _state["value"] = float(_state["serve"].get("value") or 0.0)
+        _state["partial"] = False
+        _state["budget_used_s"] = round(CLOCK.now() - t_start, 1)
+        _state["phase"] = "done"
+        _emit()
+        return
+
+    # `abc-bench --lane traffic`: ONLY the fleet-scale churn lane
+    # (round 19) — open-loop spec-zoo arrivals + retention/GC guards
+    if (os.environ.get("PYABC_TPU_BENCH_LANE") or "").strip().lower() \
+            == "traffic":
+        _state["phase"] = "traffic"
+        _state["metric"] = "traffic_fleet_churn"
+        traffic_skip = traffic_lane_skip_reason()
+        if traffic_skip:
+            _state["traffic"] = {"skipped": traffic_skip}
+        else:
+            try:
+                _state["traffic"] = run_traffic_lane(
+                    budget - max(10.0, 0.05 * budget))
+            except Exception as e:
+                _state["traffic"] = {"error": repr(e)[:300]}
+        _state["value"] = float(_state["traffic"].get("value") or 0.0)
         _state["partial"] = False
         _state["budget_used_s"] = round(CLOCK.now() - t_start, 1)
         _state["phase"] = "done"
@@ -2761,5 +3044,10 @@ if __name__ == "__main__":
         # serve-lane subprocess: same contract as the mesh child
         _emitted = True
         print(json.dumps(_serve_lane_child()))
+        sys.exit(0)
+    if os.environ.get("PYABC_TPU_BENCH_TRAFFIC_CHILD"):
+        # traffic-lane subprocess: same contract as the serve child
+        _emitted = True
+        print(json.dumps(_traffic_lane_child()))
         sys.exit(0)
     main()

@@ -62,6 +62,11 @@ INSTRUMENTED = frozenset({
     "pyabc_tpu/ops/select.py",
     "pyabc_tpu/ops/segment.py",
     "pyabc_tpu/ops/health.py",
+    # round 19: the traffic/lifecycle layer measures latency and ages
+    # tenants — every timestamp must ride the injected clock (CLOCK001)
+    "pyabc_tpu/traffic/specs.py",
+    "pyabc_tpu/traffic/generator.py",
+    "pyabc_tpu/serving/lifecycle.py",
 })
 
 

@@ -84,7 +84,8 @@ def export_cmd(db, run_id, what, time_point, m, fmt, out):
               help="walltime budget in seconds")
 @click.option("--cpu", is_flag=True, help="force the CPU platform")
 @click.option("--lane",
-              type=click.Choice(["all", "mesh", "serve", "storage"]),
+              type=click.Choice(["all", "mesh", "serve", "storage",
+                                 "scenario", "traffic"]),
               default="all",
               help="run only one bench lane: 'mesh' runs the sharded "
                    "multi-device lane (the MULTICHIP dryrun promoted to "
@@ -271,13 +272,27 @@ def _run_worker_child(host, port, **kwargs):
 @click.argument("host")
 @click.argument("port", type=int)
 @click.option("--watch", is_flag=True, help="refresh every 2s")
-def manager_cmd(host, port, watch):
+@click.option("--tenants", "tenants_mode", is_flag=True,
+              help="talk to an abc-serve API instead of a broker: list "
+              "its tenants (paged — round 19)")
+@click.option("--state", default=None,
+              help="with --tenants: only tenants in this state "
+              "(queued/running/completed/...)")
+@click.option("--offset", type=int, default=0,
+              help="with --tenants: page start")
+@click.option("--limit", type=int, default=None,
+              help="with --tenants: page size (default: everything)")
+def manager_cmd(host, port, watch, tenants_mode, state, offset, limit):
     """Show an ElasticSampler broker's live status (reference parity: the
-    ``abc-redis-manager`` CLI): generation, counters, connected workers."""
+    ``abc-redis-manager`` CLI): generation, counters, connected workers.
+    With ``--tenants`` it instead pages an abc-serve scheduler's tenant
+    list (``?state=&offset=&limit=`` on ``/api/tenants``)."""
     import time as _time
 
     from .broker.protocol import request
 
+    if tenants_mode:
+        return _manager_tenants(host, port, watch, state, offset, limit)
     while True:
         kind, status = request((host, port), ("status",))
         assert kind == "status", (kind, status)
@@ -351,6 +366,59 @@ def manager_cmd(host, port, watch):
         _time.sleep(2.0)
 
 
+def _manager_tenants(host, port, watch, state, offset, limit):
+    """``abc-manager --tenants``: page an abc-serve tenant list."""
+    import http.client
+    import json as _json
+    import time as _time
+
+    query = f"offset={offset}"
+    if state:
+        query += f"&state={state}"
+    if limit is not None:
+        query += f"&limit={limit}"
+    while True:
+        conn = http.client.HTTPConnection(host, port, timeout=10.0)
+        try:
+            conn.request("GET", f"/api/tenants?{query}")
+            resp = conn.getresponse()
+            body = _json.loads(resp.read().decode())
+            if resp.status != 200:
+                raise click.ClickException(f"HTTP {resp.status}: {body}")
+        finally:
+            conn.close()
+        tenants = body.get("tenants", [])
+        total = body.get("tenants_total", len(tenants))
+        shown = (f"tenants {offset}..{offset + len(tenants)} of {total}"
+                 + (f" state={state}" if state else ""))
+        click.echo(shown)
+        for st in tenants:
+            line = (
+                f"  {st['id']}: {st['state']} model={st['spec']['model']} "
+                f"pop={st['spec']['population_size']} "
+                f"gen={st.get('generations_done', 0)}"
+                f"/{st['spec']['generations']} "
+                f"bytes={st.get('bytes_on_disk', 0)}"
+            )
+            quota = st.get("quota_remaining")
+            if quota:
+                parts = [f"{k}={v:.0f}" if isinstance(v, float) else
+                         f"{k}={v}" for k, v in sorted(quota.items())
+                         if v is not None]
+                if parts:
+                    line += f" quota_left[{' '.join(parts)}]"
+            click.echo(line)
+        life = body.get("lifecycle")
+        if life:
+            click.echo(
+                f"  lifecycle: gced={life.get('generations_gced_total', 0)} "
+                f"disposed={life.get('tenants_disposed_total', 0)} "
+                f"archived={life.get('archives_total', 0)}")
+        if not watch:
+            break
+        _time.sleep(2.0)
+
+
 @click.command("abc-serve")
 @click.option("--host", default="127.0.0.1", help="bind address")
 @click.option("--port", type=int, default=8766, help="port (0 = ephemeral)")
@@ -389,9 +457,33 @@ def manager_cmd(host, port, watch):
 @click.option("--writer-threads", type=int, default=2,
               help="shared async History writer threads (the pooled "
               "writer serving every tenant's db)")
+@click.option("--keep-last-k", type=int, default=None,
+              help="retention: GC all but the newest K generations of "
+              "each non-running tenant's History (K>=1 keeps resume "
+              "safe; unset = keep everything)")
+@click.option("--tenant-ttl-s", type=float, default=None,
+              help="retention: dispose a terminal tenant's History this "
+              "long after it finishes (unset = never)")
+@click.option("--archive-on-complete", is_flag=True,
+              help="retention: tar.gz a terminal tenant's db + columnar "
+              "files instead of deleting them on disposal")
+@click.option("--disk-budget-bytes", type=int, default=None,
+              help="fleet retention: keep total History bytes under "
+              "this by disposing oldest-finished terminal tenants")
+@click.option("--quota-chip-seconds", type=float, default=None,
+              help="per-tenant quota: reject specs whose estimated "
+              "chip-seconds exceed this (HTTP 400, non-retryable)")
+@click.option("--quota-bytes", type=int, default=None,
+              help="per-tenant quota: bytes-on-disk bound enforced by "
+              "the retention sweep")
+@click.option("--quota-generations", type=int, default=None,
+              help="per-tenant quota: reject specs asking for more "
+              "generations than this")
 def serve_cmd(host, port, slots, n_devices, packing, preempt_queue_wait_s,
               max_queued, lease_timeout_s, max_requeues,
-              base_dir, writer_threads):
+              base_dir, writer_threads, keep_last_k, tenant_ttl_s,
+              archive_on_complete, disk_budget_bytes,
+              quota_chip_seconds, quota_bytes, quota_generations):
     """Multi-tenant ABC-SMC serving: a RunScheduler leasing contiguous
     SUB-MESHES of the device pool to tenants (sharded tenants span
     1/2/4/8 devices, small tenants pack per device), fronted by the
@@ -402,17 +494,39 @@ def serve_cmd(host, port, slots, n_devices, packing, preempt_queue_wait_s,
     writes a final checkpoint before the process exits."""
     import signal as _signal
 
-    from .serving import RunScheduler, serve_api
+    from .serving import (
+        RetentionPolicy,
+        RunScheduler,
+        TenantQuota,
+        serve_api,
+    )
     from .serving.placement import platform_device_count
 
     if n_devices == 0:
         n_devices = platform_device_count()
+    retention = None
+    if (keep_last_k is not None or tenant_ttl_s is not None
+            or archive_on_complete or disk_budget_bytes is not None):
+        retention = RetentionPolicy(
+            keep_last_k=keep_last_k, ttl_s=tenant_ttl_s,
+            archive_on_complete=archive_on_complete,
+            total_bytes_budget=disk_budget_bytes,
+        )
+    quota = None
+    if (quota_chip_seconds is not None or quota_bytes is not None
+            or quota_generations is not None):
+        quota = TenantQuota(
+            max_chip_seconds=quota_chip_seconds,
+            max_bytes_on_disk=quota_bytes,
+            max_generations=quota_generations,
+        )
     sched = RunScheduler(
         n_slots=slots, n_devices=n_devices, packing=packing,
         preempt_queue_wait_s=preempt_queue_wait_s,
         max_queued=max_queued,
         lease_timeout_s=lease_timeout_s, max_requeues=max_requeues,
         base_dir=base_dir, writer_threads=writer_threads,
+        retention=retention, quota=quota,
     )
     httpd = serve_api(sched, host=host, port=port, block=False)
     click.echo(

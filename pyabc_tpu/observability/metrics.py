@@ -308,6 +308,32 @@ HISTORY_INGEST_ROWS_PER_SEC_GAUGE = "pyabc_tpu_history_ingest_rows_per_sec"
 #:  sqlite main db + WAL)
 HISTORY_BYTES_ON_DISK_GAUGE = "pyabc_tpu_history_bytes_on_disk"
 
+# -- traffic / lifecycle instrument names (round 19) --------------------------
+#
+# The fleet-scale traffic subsystem (open-loop generator) and tenant
+# lifecycle layer (retention/GC/quotas); one canonical place so the
+# scheduler, lifecycle manager, traffic generator, serve API and the
+# bench `traffic` lane agree:
+#:  bytes on disk attributable to one tenant's History (sqlite db + WAL
+#:  + columnar generation files + archive); set in the tenant's PRIVATE
+#:  registry, so /metrics renders it with a {tenant="<id>"} label
+TENANT_BYTES_ON_DISK_GAUGE = "pyabc_tpu_tenant_bytes_on_disk"
+#:  generations deleted by lifecycle retention sweeps (keep-last-k /
+#:  TTL / eviction GC), SQL rows and columnar Parquet files both
+TENANT_GENERATIONS_GCED_TOTAL = "pyabc_tpu_tenant_generations_gced_total"
+#:  terminal tenants whose History was packed into a tar.gz archive
+TENANT_ARCHIVES_TOTAL = "pyabc_tpu_tenant_archives_total"
+#:  submissions refused because the TENANT QUOTA (chip-seconds, bytes
+#:  on disk, generations) was exhausted — distinct from queue-full 429s
+TENANT_QUOTA_REJECTIONS_TOTAL = "pyabc_tpu_tenant_quota_rejected_total"
+#:  open-loop arrivals the traffic generator submitted (admitted or not)
+TRAFFIC_ARRIVALS_TOTAL = "pyabc_tpu_traffic_arrivals_total"
+#:  arrivals refused with typed backpressure (429 + Retry-After)
+TRAFFIC_REJECTIONS_TOTAL = "pyabc_tpu_traffic_rejections_total"
+#:  submit -> posterior-complete latency of finished tenants (the
+#:  histogram's summary() carries the p50/p99 the bench lane guards)
+TIME_TO_POSTERIOR_HISTOGRAM = "pyabc_tpu_time_to_posterior_seconds"
+
 
 def health_event_metric(kind: str) -> str:
     """Per-kind health-event counter name — the registry's stand-in for

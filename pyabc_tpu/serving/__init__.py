@@ -26,7 +26,15 @@ hits a shape-keyed compiled-kernel cache (PR 2's adoption, generalized)
   requeue from checkpoints, checkpoint-preemption, device-loss
   survival, graceful SIGTERM drain;
 - :mod:`.api` — the ``abc-serve`` HTTP surface (submit/status/stream/
-  preempt, ``/metrics`` with per-tenant labels).
+  preempt, ``/metrics`` with per-tenant labels);
+- :mod:`.lifecycle` — :class:`RetentionPolicy` / :class:`TenantQuota` /
+  :class:`LifecycleManager` (round 19): keep-last-k / TTL / archive
+  retention, History GC (SQL rows + columnar Parquet files), fleet
+  disk budgets and per-tenant quota accounting — bounded disk for a
+  long-lived process under sustained churn;
+- :mod:`.streaming` — Arrow-IPC (or NDJSON-fallback) framing of the
+  epsilon trail + per-generation posterior summaries, pushed live over
+  ``/api/tenant/<id>/stream?format=arrow``.
 
 The headline contract, chaos-tested on CPU in ``tests/test_serving.py``
 and guarded by the bench ``serve`` lane: a fault injected into tenant A
@@ -35,8 +43,10 @@ tenant B.
 """
 from .admission import AdmissionController, AdmissionRejectedError
 from .api import serve_api
+from .lifecycle import LifecycleManager, RetentionPolicy, TenantQuota
 from .placement import SubMeshAllocator, feasible_widths
 from .scheduler import RunScheduler
+from .streaming import generation_summaries, stream_posterior
 from .tenant import (
     CANCELLED,
     COMPLETED,
@@ -54,6 +64,8 @@ from .tenant import (
 __all__ = [
     "AdmissionController", "AdmissionRejectedError",
     "RunScheduler", "serve_api",
+    "LifecycleManager", "RetentionPolicy", "TenantQuota",
+    "generation_summaries", "stream_posterior",
     "SubMeshAllocator", "feasible_widths",
     "Tenant", "TenantSpec", "MODEL_BUILDERS",
     "QUEUED", "RUNNING", "REQUEUED", "COMPLETED", "FAILED",

@@ -8,13 +8,27 @@ pattern (no Flask in this environment) into the serving front door:
                                   measured ``Retry-After`` header
                                   (typed backpressure, never unbounded
                                   queueing)
-- ``GET  /api/tenants``           all tenants' status + scheduler state
+- ``GET  /api/tenants``           tenants' status + scheduler state;
+                                  ``?state=running&offset=0&limit=50``
+                                  filters/pages the tenant list (round
+                                  19 — listing stays O(page) at fleet
+                                  scale)
 - ``GET  /api/tenant/<id>``       one tenant's status (state, progress,
-                                  lease/requeue history, health trail)
+                                  lease/requeue history, health trail,
+                                  bytes_on_disk + quota-remaining)
 - ``GET  /api/tenant/<id>/stream`` chunked NDJSON event tail
                                   (lifecycle + per-chunk progress;
                                   ``?since=<seq>`` resumes, the stream
-                                  ends when the tenant is terminal)
+                                  ends when the tenant is terminal);
+                                  ``?format=arrow`` instead streams the
+                                  epsilon trail + per-generation
+                                  posterior summaries as an Arrow IPC
+                                  stream (one record batch per
+                                  generation, pushed as each lands;
+                                  falls back to NDJSON summary lines
+                                  when the server lacks pyarrow) and
+                                  ``?format=summaries`` requests the
+                                  NDJSON summary framing directly
 - ``POST /api/tenant/<id>/cancel`` cancel (graceful for running runs)
 - ``POST /api/tenant/<id>/preempt`` checkpoint-preempt a running
                                   tenant: it stops at its next chunk
@@ -42,6 +56,16 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from .admission import AdmissionRejectedError
 from .scheduler import RunScheduler
 from .tenant import TERMINAL_STATES, TenantSpec
+
+
+def _query_params(query: str) -> dict:
+    out: dict[str, str] = {}
+    for kv in (query or "").split("&"):
+        if not kv:
+            continue
+        k, _, v = kv.partition("=")
+        out[k] = v
+    return out
 
 
 def _make_handler(sched: RunScheduler):
@@ -96,8 +120,15 @@ def _make_handler(sched: RunScheduler):
 
         def do_GET(self):  # noqa: N802 - stdlib API
             try:
-                if self.path == "/api/tenants":
-                    return self._json(200, sched.snapshot())
+                if (self.path == "/api/tenants"
+                        or self.path.startswith("/api/tenants?")):
+                    q = _query_params(self.path.partition("?")[2])
+                    limit = q.get("limit")
+                    return self._json(200, sched.snapshot(
+                        state=q.get("state") or None,
+                        offset=int(q.get("offset") or 0),
+                        limit=None if limit in (None, "") else int(limit),
+                    ))
                 if self.path == "/api/observability":
                     from ..observability import observability_snapshot
 
@@ -109,11 +140,11 @@ def _make_handler(sched: RunScheduler):
                     if rest.endswith("/stream") or "/stream?" in rest:
                         tid, _, q = rest.partition("/stream")
                         return self._stream(tid, q.lstrip("?"))
-                    tenant = sched.get(rest)
-                    if tenant is None:
+                    status = sched.status(rest)
+                    if status is None:
                         return self._json(404, {"error": "unknown tenant",
                                                 "id": rest})
-                    return self._json(200, tenant.to_status())
+                    return self._json(200, status)
                 self._json(404, {"error": "not found"})
             except BrokenPipeError:  # client went away mid-stream
                 pass
@@ -158,40 +189,93 @@ def _make_handler(sched: RunScheduler):
             self._send(200, "".join(parts).encode(),
                        ctype="text/plain; version=0.0.4")
 
+        def _start_chunked(self, ctype: str) -> None:
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+        def _write_chunk(self, data: bytes) -> None:
+            if not data:
+                return  # a zero-length chunk would terminate the body
+            self.wfile.write(f"{len(data):X}\r\n".encode())
+            self.wfile.write(data + b"\r\n")
+            self.wfile.flush()
+
+        def _end_chunked(self) -> None:
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+
         def _stream(self, tid: str, query: str) -> None:
             tenant = sched.get(tid)
             if tenant is None:
                 return self._json(404, {"error": "unknown tenant",
                                         "id": tid})
-            since = 0
-            for kv in query.split("&"):
-                k, _, v = kv.partition("=")
-                if k == "since" and v.isdigit():
-                    since = int(v)
-            self.send_response(200)
-            self.send_header("Content-Type", "application/x-ndjson")
-            self.send_header("Transfer-Encoding", "chunked")
-            self.end_headers()
-
-            def write_chunk(data: bytes) -> None:
-                self.wfile.write(f"{len(data):X}\r\n".encode())
-                self.wfile.write(data + b"\r\n")
-                self.wfile.flush()
-
-            seq = since
+            params = _query_params(query)
+            if params.get("format") in ("arrow", "summaries"):
+                return self._stream_posterior(
+                    tenant, want_arrow=params["format"] == "arrow")
+            since = params.get("since", "")
+            seq = int(since) if since.isdigit() else 0
+            self._start_chunked("application/x-ndjson")
             while True:
                 events = tenant.events_since(seq, timeout_s=1.0)
                 for ev in events:
                     seq = max(seq, int(ev["seq"]))
-                    write_chunk(
+                    self._write_chunk(
                         (json.dumps(ev, default=str) + "\n").encode())
                 if not events and tenant.state in TERMINAL_STATES:
                     break
-            write_chunk(
+            self._write_chunk(
                 (json.dumps({"kind": "end", "state": tenant.state})
                  + "\n").encode())
-            self.wfile.write(b"0\r\n\r\n")
-            self.wfile.flush()
+            self._end_chunked()
+
+        def _stream_posterior(self, tenant, want_arrow: bool) -> None:
+            """Push the epsilon trail + per-generation posterior
+            summaries as each generation becomes visible in the
+            tenant's History: Arrow IPC framing (one record batch per
+            generation) when pyarrow is available server-side, NDJSON
+            summary lines otherwise — the client dispatches on the
+            Content-Type."""
+            from ..storage.columnar import has_pyarrow
+            from . import streaming
+
+            use_arrow = want_arrow and has_pyarrow()
+            writer = streaming.ArrowSummaryWriter() if use_arrow else None
+            self._start_chunked(
+                streaming.ARROW_CONTENT_TYPE if use_arrow
+                else streaming.NDJSON_CONTENT_TYPE)
+            seq = 0
+            next_t = 0
+            while True:
+                events = tenant.events_since(seq, timeout_s=1.0)
+                for ev in events:
+                    seq = max(seq, int(ev["seq"]))
+                terminal = tenant.state in TERMINAL_STATES
+                if tenant.abc_id is not None and not tenant.disposed:
+                    try:
+                        summaries = streaming.generation_summaries(
+                            tenant.db_path, abc_id=tenant.abc_id,
+                            t_min=next_t)
+                    except Exception:
+                        # transient read-under-write (sqlite lock) or a
+                        # GC race: retry on the next wakeup
+                        summaries = []
+                    for s in summaries:
+                        next_t = max(next_t, s["t"] + 1)
+                        self._write_chunk(
+                            writer.frame(s) if use_arrow
+                            else streaming.summary_json_line(s))
+                if terminal and not events:
+                    break
+            if use_arrow:
+                self._write_chunk(writer.finish())
+            else:
+                self._write_chunk(
+                    (json.dumps({"kind": "end", "state": tenant.state})
+                     + "\n").encode())
+            self._end_chunked()
 
     return Handler
 

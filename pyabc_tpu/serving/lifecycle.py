@@ -1,0 +1,361 @@
+"""Tenant lifecycle: retention policies, History GC, quotas, archival.
+
+The long-lived serving process owns what pyABC's Redis-brokered sampler
+fleet got for free from operator babysitting: BOUNDED DISK and
+per-tenant accounting. Every admitted tenant writes a private History
+db (plus, for columnar tenants, one Parquet file per generation); a
+1000-tenant churn day must not end with 1000 dbs on disk and no policy
+saying which bytes matter. This module is that policy, in three parts:
+
+- :class:`RetentionPolicy` — declarative per-process retention:
+  ``keep_last_k`` generations per tenant (the resume seam only ever
+  needs the LATEST generation + the checkpoint, so any k >= 1 keeps
+  requeue-resume bit-identical), ``ttl_s`` for terminal tenants'
+  Histories, ``archive_on_complete`` (pack the db + columnar sidecar
+  into one tar.gz instead of deleting), and a fleet-wide
+  ``total_bytes_budget`` under which the oldest terminal tenants are
+  disposed first.
+- :class:`TenantQuota` — per-tenant admission limits in the same units
+  admission already prices backpressure: chip-seconds (checked against
+  the spec's cold-start estimate, tracked against actual spend),
+  bytes-on-disk (enforced by the sweep: over-quota tenants have their
+  oldest generations GC'd down to the floor), and generations.
+- :class:`LifecycleManager` — the scheduler-owned sweeper: the pump
+  calls :meth:`sweep` every ``sweep_interval_s`` ON THE INJECTED CLOCK
+  (CLOCK001); terminal-tenant eviction routes through :meth:`dispose`
+  so evicted tenants' files actually leave the disk (the pre-round-19
+  eviction dropped records but leaked every db forever).
+
+GC SAFETY CONTRACT: a sweep never touches a RUNNING tenant's History
+(its writer owns the file) and never deletes the newest generation or
+the PRE_TIME observed row of any tenant — ``ABCSMC.load`` +
+checkpoint adoption need exactly those, so a requeued tenant resumes
+bit-identical across any number of sweeps.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+from dataclasses import dataclass
+
+from ..observability import SYSTEM_CLOCK
+from ..observability.metrics import (
+    TENANT_ARCHIVES_TOTAL,
+    TENANT_BYTES_ON_DISK_GAUGE,
+    TENANT_GENERATIONS_GCED_TOTAL,
+    TENANT_QUOTA_REJECTIONS_TOTAL,
+)
+from ..storage.archive import archive_paths, archive_tenant_db
+from .admission import AdmissionRejectedError, spec_chip_seconds_estimate
+from .tenant import RUNNING, TERMINAL_STATES
+
+
+def disk_usage(db_url: str) -> dict:
+    """Bytes on disk attributable to one tenant History url:
+    ``{"db": ..., "columnar": ..., "archive": ..., "total": ...}``
+    (db includes WAL/SHM droppings)."""
+    sql_path, col_dir, archive = archive_paths(db_url)
+    db_b = 0
+    for p in (sql_path, str(sql_path) + "-wal", str(sql_path) + "-shm"):
+        if os.path.exists(p):
+            db_b += os.path.getsize(p)
+    col_b = 0
+    if col_dir.is_dir():
+        for root, _dirs, files in os.walk(col_dir):
+            for f in files:
+                col_b += os.path.getsize(os.path.join(root, f))
+    ar_b = archive.stat().st_size if archive.is_file() else 0
+    return {"db": db_b, "columnar": col_b, "archive": ar_b,
+            "total": db_b + col_b + ar_b}
+
+
+@dataclass
+class RetentionPolicy:
+    """What to keep, per tenant and fleet-wide. All fields optional —
+    the default policy retains everything (pre-round-19 behavior) except
+    that EVICTED tenants' files are now always disposed."""
+
+    #: keep only the newest k generations of each non-running tenant's
+    #: History (k >= 1; the latest generation + checkpoint are all a
+    #: requeue-resume needs). None = never prune generations.
+    keep_last_k: int | None = None
+    #: dispose a terminal tenant's History this many seconds after it
+    #: finished (injected-clock seconds). None = no TTL.
+    ttl_s: float | None = None
+    #: on dispose, pack the db + columnar sidecar into one tar.gz
+    #: (restorable via :func:`pyabc_tpu.storage.restore_tenant_db`)
+    #: instead of deleting outright
+    archive_on_complete: bool = False
+    #: fleet-wide disk budget over every known tenant's files; when
+    #: exceeded the sweep disposes the OLDEST-FINISHED terminal tenants
+    #: until back under (live tenants are never disposed)
+    total_bytes_budget: int | None = None
+
+    def __post_init__(self):
+        if self.keep_last_k is not None and int(self.keep_last_k) < 1:
+            raise ValueError(
+                "keep_last_k must be >= 1: the newest generation is the "
+                "resume seam and is never GC'd")
+
+
+@dataclass
+class TenantQuota:
+    """Per-tenant resource limits, enforced at admission (chip-seconds
+    vs the spec's cold-start estimate, generations vs the requested
+    schedule) and by the sweep (bytes-on-disk GC). None = unlimited."""
+
+    max_chip_seconds: float | None = None
+    max_bytes_on_disk: int | None = None
+    max_generations: int | None = None
+
+    def check_spec(self, spec) -> None:
+        """Raise :class:`AdmissionRejectedError` (non-retryable: the
+        same spec will fail the same way) when the spec cannot fit."""
+        if (self.max_generations is not None
+                and int(spec.generations) > int(self.max_generations)):
+            raise AdmissionRejectedError(
+                f"quota: generations {int(spec.generations)} exceeds "
+                f"max_generations {int(self.max_generations)}",
+                retry_after_s=None)
+        if self.max_chip_seconds is not None:
+            est = spec_chip_seconds_estimate(spec)
+            if est > float(self.max_chip_seconds):
+                raise AdmissionRejectedError(
+                    f"quota: estimated {est:.1f} chip-seconds exceeds "
+                    f"max_chip_seconds {float(self.max_chip_seconds):.1f}",
+                    retry_after_s=None)
+
+    def remaining(self, *, chip_s: float, bytes_on_disk: int,
+                  generations_done: int) -> dict:
+        """Quota-remaining view for status payloads (None = unlimited)."""
+        return {
+            "chip_seconds": (
+                None if self.max_chip_seconds is None
+                else round(max(0.0, float(self.max_chip_seconds)
+                               - float(chip_s)), 3)),
+            "bytes_on_disk": (
+                None if self.max_bytes_on_disk is None
+                else max(0, int(self.max_bytes_on_disk)
+                         - int(bytes_on_disk))),
+            "generations": (
+                None if self.max_generations is None
+                else max(0, int(self.max_generations)
+                         - int(generations_done))),
+        }
+
+
+class LifecycleManager:
+    """Retention/GC/quota sweeper owned by the :class:`RunScheduler`.
+
+    The scheduler calls in from three seams: :meth:`admission_check`
+    inside ``submit`` (under the scheduler lock), :meth:`sweep` from the
+    pump every ``sweep_interval_s``, and :meth:`dispose` from
+    terminal-tenant eviction. All timestamps ride the INJECTED clock."""
+
+    def __init__(self, *, policy: RetentionPolicy | None = None,
+                 quota: TenantQuota | None = None, clock=None,
+                 metrics=None, sweep_interval_s: float = 5.0):
+        from ..observability import NULL_METRICS
+
+        self.policy = policy if policy is not None else RetentionPolicy()
+        self.quota = quota if quota is not None else TenantQuota()
+        self.clock = clock if clock is not None else SYSTEM_CLOCK
+        self.metrics = metrics if metrics is not None else NULL_METRICS
+        self.sweep_interval_s = float(sweep_interval_s)
+        self._last_sweep: float | None = None
+        #: lifetime accounting the bench lane and tests read directly
+        self.generations_gced_total = 0
+        self.tenants_disposed_total = 0
+        self.archives_total = 0
+
+    def stats(self) -> dict:
+        from dataclasses import asdict
+
+        return {
+            "policy": asdict(self.policy),
+            "quota": asdict(self.quota),
+            "sweep_interval_s": self.sweep_interval_s,
+            "generations_gced_total": int(self.generations_gced_total),
+            "tenants_disposed_total": int(self.tenants_disposed_total),
+            "archives_total": int(self.archives_total),
+        }
+
+    # -------------------------------------------------------- admission
+    def admission_check(self, spec) -> None:
+        """Quota gate next to the chip-second backpressure pricing."""
+        try:
+            self.quota.check_spec(spec)
+        except AdmissionRejectedError:
+            self.metrics.counter(
+                TENANT_QUOTA_REJECTIONS_TOTAL,
+                "submissions refused because the tenant quota "
+                "(chip-seconds / generations) was exhausted",
+            ).inc()
+            raise
+
+    # ------------------------------------------------------- accounting
+    def bytes_on_disk(self, tenant) -> int:
+        """Total bytes of this tenant's History artifacts, exported to
+        the tenant's PRIVATE registry (so /metrics labels it)."""
+        total = disk_usage(tenant.db_path)["total"]
+        ck = tenant.checkpoint_path
+        if ck and os.path.exists(ck):
+            total += os.path.getsize(ck)
+        tenant.bytes_on_disk = int(total)
+        tenant.metrics.gauge(
+            TENANT_BYTES_ON_DISK_GAUGE,
+            "bytes on disk for this tenant's History (db + WAL + "
+            "columnar generation files + archive + checkpoint)",
+        ).set(float(total))
+        return int(total)
+
+    def quota_remaining(self, tenant) -> dict:
+        return self.quota.remaining(
+            chip_s=float(getattr(tenant, "chip_s", 0.0)),
+            bytes_on_disk=int(getattr(tenant, "bytes_on_disk", 0)),
+            generations_done=int(tenant.generations_done),
+        )
+
+    # ------------------------------------------------------------ sweep
+    def due(self) -> bool:
+        now = self.clock.now()
+        if (self._last_sweep is not None
+                and now - self._last_sweep < self.sweep_interval_s):
+            return False
+        self._last_sweep = now
+        return True
+
+    def sweep(self, tenants: list) -> dict:
+        """One retention pass over a snapshot of tenant records.
+
+        Returns ``{"pruned": n_generations, "disposed": [ids...]}``.
+        RUNNING tenants are skipped entirely (their writer owns the
+        History); everything else may be generation-pruned
+        (keep-last-k / bytes quota, newest generation always kept) and
+        terminal tenants may be disposed (TTL / fleet byte budget)."""
+        now = self.clock.now()
+        pruned = 0
+        disposed: list[str] = []
+        for tenant in tenants:
+            if tenant.state == RUNNING or tenant.disposed:
+                continue
+            pruned += self._gc_tenant(tenant)
+        # TTL disposal, oldest first
+        for tenant in tenants:
+            if (self.policy.ttl_s is not None
+                    and tenant.state in TERMINAL_STATES
+                    and not tenant.disposed
+                    and tenant.finished_at is not None
+                    and now - tenant.finished_at >= self.policy.ttl_s):
+                self.dispose(tenant)
+                disposed.append(tenant.id)
+        # fleet byte budget: dispose oldest-finished terminal tenants
+        if self.policy.total_bytes_budget is not None:
+            total = sum(self.bytes_on_disk(t) for t in tenants
+                        if not t.disposed)
+            if total > self.policy.total_bytes_budget:
+                victims = sorted(
+                    (t for t in tenants
+                     if t.state in TERMINAL_STATES and not t.disposed
+                     and t.finished_at is not None),
+                    key=lambda t: t.finished_at)
+                for victim in victims:
+                    if total <= self.policy.total_bytes_budget:
+                        break
+                    total -= self.dispose(victim)
+                    disposed.append(victim.id)
+        return {"pruned": pruned, "disposed": disposed}
+
+    def _gc_tenant(self, tenant) -> int:
+        """Prune one non-running tenant's oldest generations down to
+        the retention floor (keep-last-k, then further only if the
+        byte quota demands it — but never below the newest
+        generation). Returns generations removed."""
+        keep = self.policy.keep_last_k
+        over_quota = (
+            self.quota.max_bytes_on_disk is not None
+            and self.bytes_on_disk(tenant) > self.quota.max_bytes_on_disk
+        )
+        if keep is None and not over_quota:
+            return 0
+        sql_path, _, _ = archive_paths(tenant.db_path)
+        if not sql_path.is_file():
+            return 0  # never started (or already disposed/archived)
+        try:
+            from ..storage import History
+
+            hist = History(tenant.db_path, _id=tenant.abc_id,
+                           wal=False)
+            try:
+                max_t = hist.max_t
+                if max_t < 0:
+                    return 0
+                removed = 0
+                if keep is not None:
+                    cut = max_t - int(keep) + 1
+                    if cut > 0:
+                        removed += hist.prune_before(cut)
+                while (over_quota and hist.n_populations > 1):
+                    # byte-quota pressure: shed the single oldest
+                    # generation at a time until under (or only the
+                    # newest — the resume seam — remains)
+                    oldest = int(hist.get_all_populations()
+                                 .query("t >= 0")["t"].min())
+                    removed += hist.prune_before(oldest + 1)
+                    hist.vacuum()
+                    over_quota = (self.bytes_on_disk(tenant)
+                                  > self.quota.max_bytes_on_disk)
+                if removed:
+                    hist.vacuum()
+            finally:
+                hist.close()
+        except Exception:
+            # a requeued tenant's stale attempt may still hold the db
+            # (transient sqlite lock): skip this sweep, the next one
+            # will catch up
+            return 0
+        if removed:
+            self.generations_gced_total += removed
+            self.metrics.counter(
+                TENANT_GENERATIONS_GCED_TOTAL,
+                "generations deleted by lifecycle retention sweeps "
+                "(SQL rows + columnar Parquet files)",
+            ).inc(removed)
+            tenant.record_event("generations_gced", n=removed)
+            self.bytes_on_disk(tenant)
+        return removed
+
+    # ---------------------------------------------------------- dispose
+    def dispose(self, tenant) -> int:
+        """Remove (or archive) one tenant's on-disk artifacts. The
+        terminal-eviction seam: called for every evicted tenant, for
+        TTL-expired tenants and under fleet byte-budget pressure.
+        Returns bytes freed (net of any archive written)."""
+        before = self.bytes_on_disk(tenant)
+        sql_path, col_dir, archive = archive_paths(tenant.db_path)
+        if (self.policy.archive_on_complete
+                and tenant.state in TERMINAL_STATES
+                and sql_path.is_file()):
+            archive_tenant_db(tenant.db_path, remove_original=True)
+            self.archives_total += 1
+            self.metrics.counter(
+                TENANT_ARCHIVES_TOTAL,
+                "terminal tenants whose History was packed into a "
+                "tar.gz archive",
+            ).inc()
+            tenant.record_event("archived", path=str(archive))
+        else:
+            for p in (sql_path, str(sql_path) + "-wal",
+                      str(sql_path) + "-shm"):
+                if os.path.exists(p):
+                    os.unlink(p)
+            if col_dir.is_dir():
+                shutil.rmtree(col_dir)
+        ck = tenant.checkpoint_path
+        if ck and os.path.exists(ck):
+            os.unlink(ck)
+        tenant.disposed = True
+        self.tenants_disposed_total += 1
+        after = self.bytes_on_disk(tenant)
+        tenant.record_event("disposed", bytes_freed=before - after)
+        return before - after

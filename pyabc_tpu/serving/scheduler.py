@@ -85,11 +85,13 @@ from ..observability.metrics import (
     TENANTS_LIVE_GAUGE,
     TENANTS_QUEUED_GAUGE,
 )
+from ..observability.metrics import TIME_TO_POSTERIOR_HISTOGRAM
 from ..resilience.lease import LeaseTable
 from ..storage import WriterPool
 from ..utils.xla_cache import KernelCache
 from . import placement
 from .admission import AdmissionController, AdmissionRejectedError
+from .lifecycle import LifecycleManager, RetentionPolicy, TenantQuota
 from .tenant import (
     CANCELLED,
     COMPLETED,
@@ -124,7 +126,10 @@ class RunScheduler:
                  preempt_queue_wait_s: float | None = None,
                  base_dir: str | None = None, clock=None, metrics=None,
                  writer_threads: int = 2, kernel_cache_entries: int = 8,
-                 tick_s: float = 0.05, max_terminal_tenants: int = 256):
+                 tick_s: float = 0.05, max_terminal_tenants: int = 256,
+                 retention: RetentionPolicy | None = None,
+                 quota: TenantQuota | None = None,
+                 lifecycle_sweep_s: float = 5.0):
         self.clock = clock if clock is not None else SYSTEM_CLOCK
         self.metrics = metrics if metrics is not None else global_metrics()
         #: the device pool the allocator manages. ``n_devices`` sizes it
@@ -165,6 +170,14 @@ class RunScheduler:
         self.admission = AdmissionController(
             max_queued=max_queued, n_chips=pool, clock=self.clock,
             metrics=self.metrics,
+        )
+        #: retention/GC/quota layer (round 19): the pump sweeps it,
+        #: submit consults its quota gate, and terminal-tenant eviction
+        #: routes file disposal through it — bounded disk for a
+        #: long-lived serving process
+        self.lifecycle = LifecycleManager(
+            policy=retention, quota=quota, clock=self.clock,
+            metrics=self.metrics, sweep_interval_s=lifecycle_sweep_s,
         )
         #: run-level leases: synthetic unique slot ids leased per tenant
         #: (device RANGES live in the allocator; packed width-1 tenants
@@ -210,6 +223,10 @@ class RunScheduler:
             live_now = len(self._lease_slot_of)
             self.admission.admit(
                 spec, queued_now=queued_now, live_now=live_now)
+            # quota gate rides NEXT to the chip-second backpressure: a
+            # spec the tenant quota cannot fit is refused non-retryably
+            # (retrying the same oversized spec later will not help)
+            self.lifecycle.admission_check(spec)
             tid = (str(tenant_id) if tenant_id is not None
                    else f"tenant-{next(self._ids)}")
             if tid in self._tenants:
@@ -331,9 +348,26 @@ class RunScheduler:
         self.writer_pool.close()
 
     # ----------------------------------------------------------- snapshot
-    def snapshot(self) -> dict:
+    def snapshot(self, *, state: str | None = None, offset: int = 0,
+                 limit: int | None = None) -> dict:
+        """Scheduler + tenant status view; the tenant list is optionally
+        state-filtered and PAGED (round 19 — listing stays O(page) with
+        hundreds of live tenants; the default stays the full list for
+        compatibility). Disk/quota accounting is refreshed only for the
+        returned page."""
         with self._lock:
-            tenants = [t.to_status() for t in self._tenants.values()]
+            records = list(self._tenants.values())
+            if state is not None:
+                records = [t for t in records if t.state == str(state)]
+            total = len(records)
+            off = max(int(offset), 0)
+            page = (records[off:off + max(int(limit), 0)]
+                    if limit is not None else records[off:])
+            for tenant in page:
+                self.lifecycle.bytes_on_disk(tenant)
+                tenant.quota_remaining = self.lifecycle.quota_remaining(
+                    tenant)
+            tenants = [t.to_status() for t in page]
             queue = list(self._queue)
             place = self.allocator.stats()
         return {
@@ -342,13 +376,29 @@ class RunScheduler:
             "queue": queue,
             "draining": self._draining,
             "tenants": tenants,
+            "tenants_total": total,
+            "offset": off,
+            "limit": limit,
+            "state_filter": state,
             "placement": place,
             "devices_lost_total": int(self.devices_lost_total),
             "leases": self.leases.stats(),
             "admission": self.admission.stats(),
+            "lifecycle": self.lifecycle.stats(),
             "kernel_cache": self.kernel_cache.stats(),
             "stale_reports_discarded": int(self.stale_reports_discarded),
         }
+
+    def status(self, tenant_id: str) -> dict | None:
+        """One tenant's status with freshly-computed disk/quota fields
+        (the per-tenant API route)."""
+        with self._lock:
+            tenant = self._tenants.get(str(tenant_id))
+            if tenant is None:
+                return None
+            self.lifecycle.bytes_on_disk(tenant)
+            tenant.quota_remaining = self.lifecycle.quota_remaining(tenant)
+            return tenant.to_status()
 
     # --------------------------------------------------- device health
     def mark_devices_lost(self, devices) -> list[str]:
@@ -442,6 +492,8 @@ class RunScheduler:
                 self._reap_leases_locked()
                 self._start_queued_locked()
                 self._maybe_auto_preempt_locked()
+                self._maybe_lifecycle_sweep_locked()
+                self._evict_overflow_locked()
                 self._set_occupancy_gauges_locked()
                 self._wake.wait(timeout=self.tick_s)
 
@@ -464,6 +516,7 @@ class RunScheduler:
             run_s = payload.get("run_s", 0.0)
             tenant.run_s += run_s
             # chip-seconds: wall time × the sub-mesh width it held
+            tenant.chip_s += run_s * width
             self.admission.note_run_seconds(run_s, chips=width)
             if outcome == COMPLETED:
                 tenant.result = payload.get("result")
@@ -611,6 +664,17 @@ class RunScheduler:
             )
             tenant.thread.start()
 
+    def _maybe_lifecycle_sweep_locked(self) -> None:
+        """Periodic retention/GC pass, under the scheduler lock so no
+        tenant can transition into RUNNING mid-sweep (the GC safety
+        contract: a sweep never touches a History whose writer is
+        live). RUNNING tenants are skipped inside the sweep."""
+        if not self.lifecycle.due():
+            return
+        self.lifecycle.sweep(list(self._tenants.values()))
+        for tenant in self._tenants.values():
+            tenant.quota_remaining = self.lifecycle.quota_remaining(tenant)
+
     def _maybe_auto_preempt_locked(self) -> None:
         """The preemption POLICY: when a queued tenant has been
         unplaceable past ``preempt_queue_wait_s`` (pool fully leased or
@@ -682,6 +746,16 @@ class RunScheduler:
         if state in counters:
             name, help_ = counters[state]
             self.metrics.counter(name, help_).inc()
+        if state == COMPLETED:
+            # time-to-posterior SLO accounting: submit -> completed on
+            # the injected clock (queue wait + every attempt + requeues)
+            self.metrics.histogram(
+                TIME_TO_POSTERIOR_HISTOGRAM,
+                "submit to posterior-complete latency of finished "
+                "tenants (seconds)",
+            ).observe(tenant.finished_at - tenant.submitted_at)
+        self.lifecycle.bytes_on_disk(tenant)
+        tenant.quota_remaining = self.lifecycle.quota_remaining(tenant)
         self._evict_terminal_locked(tenant.id)
         self._set_occupancy_gauges_locked()
         self._wake.notify_all()
@@ -690,9 +764,15 @@ class RunScheduler:
         """Bound terminal-tenant retention: keep the newest
         ``max_terminal_tenants`` finished records for status queries,
         evict the oldest beyond that (tenant record, event ring,
-        observability namespace) — a long-lived serving process must
-        not grow with every tenant it has ever finished."""
+        observability namespace — AND, round 19, the on-disk History
+        files through the lifecycle layer: the pre-round-19 eviction
+        dropped the record but leaked every evicted tenant's db and
+        ``.columnar/`` files forever) — a long-lived serving process
+        must not grow with every tenant it has ever finished."""
         self._terminal_order.append(tid)
+        self._evict_overflow_locked()
+
+    def _evict_overflow_locked(self) -> None:
         while len(self._terminal_order) > self.max_terminal_tenants:
             old_tid = self._terminal_order.popleft()
             old = self._tenants.get(old_tid)
@@ -700,9 +780,23 @@ class RunScheduler:
                 continue
             if old.state not in TERMINAL_STATES:  # resurrection guard
                 continue
-            # a stale attempt thread may still be unwinding; it holds
-            # its own reference to the Tenant object and reports into a
-            # bumped epoch, so dropping the registry entry is safe
+            if old.thread is not None and old.thread.is_alive():
+                # a stale attempt thread is still unwinding (a reaped or
+                # cancelled tenant's stop lands only at its next chunk
+                # boundary): disposing NOW races its final flush — a
+                # write checking out a fresh sqlite connection after the
+                # unlink recreates the db as an orphan file. Defer: put
+                # it back at the front and retry on a later pump tick;
+                # the ring overshoots its cap by the still-unwinding
+                # handful, briefly. The registry entry itself is safe to
+                # keep — the thread reports into a bumped epoch.
+                self._terminal_order.appendleft(old_tid)
+                break
+            if not old.disposed:
+                try:
+                    self.lifecycle.dispose(old)
+                except OSError:
+                    pass  # a locked/vanished file must not kill the pump
             del self._tenants[old_tid]
             unregister_tenant_source(old_tid)
 

@@ -1,0 +1,225 @@
+"""Live posterior streaming: Arrow-IPC framing of the epsilon trail and
+per-generation posterior summaries (round 19).
+
+The NDJSON event tail (``/api/tenant/<id>/stream``) tells a client THAT
+a chunk landed; watching CONVERGENCE still meant polling SQL afterwards.
+This module closes that gap: as each generation's row (and, for
+columnar tenants, its Parquet record batch — PR 13) becomes visible in
+the tenant's History, the API pushes one summary — ``(t, epsilon,
+per-model posterior means)`` — to the client, framed either as an
+Arrow IPC stream (one record batch per generation; the natural wire
+format for the columnar store) or as NDJSON lines when pyarrow is
+absent on either end.
+
+The summary numbers are BIT-IDENTICAL to a post-hoc ``History`` read on
+both stores: means are the float64 dot product of exactly the
+``get_distribution`` outputs (same row order, same normalized weights),
+epsilons come from the same ``populations`` rows, and float64 survives
+Arrow IPC framing exactly.
+
+Wire schema (flattened — one row per (generation, model, parameter),
+so K>1 model-selection tenants with per-model parameter spaces need no
+nesting)::
+
+    t: int64, epsilon: float64, m: int32, p_model: float64,
+    n: int64, param: utf8, mean: float64
+
+Reads-only module: it opens History dbs, never constructs runs
+(ISO001 stays with the scheduler).
+"""
+from __future__ import annotations
+
+import io
+import json
+
+import numpy as np
+
+from ..storage.columnar import has_pyarrow
+
+#: content type of the Arrow-framed stream (the NDJSON fallback keeps
+#: ``application/x-ndjson`` — clients dispatch on the response header)
+ARROW_CONTENT_TYPE = "application/vnd.apache.arrow.stream"
+NDJSON_CONTENT_TYPE = "application/x-ndjson"
+
+_SCHEMA_FIELDS = (
+    ("t", "int64"), ("epsilon", "float64"), ("m", "int32"),
+    ("p_model", "float64"), ("n", "int64"), ("param", "utf8"),
+    ("mean", "float64"),
+)
+
+
+# ------------------------------------------------------------- summaries
+def generation_summaries(db_url: str, abc_id: int | None = None,
+                         t_min: int = 0) -> list[dict]:
+    """Per-generation posterior summaries from a tenant History.
+
+    Returns ``[{"t", "epsilon", "models": {m: {"p", "n",
+    "means": {param: mean}}}}, ...]`` for every stored generation with
+    ``t >= t_min``, in ascending t. The means are float64 dots of the
+    ``get_distribution`` contract (post-hoc parity by construction).
+    """
+    from ..storage import History
+
+    hist = History(db_url, _id=abc_id, wal=False)
+    try:
+        if hist.id is None:
+            return []
+        pops = hist.get_all_populations()
+        out = []
+        for _, row in pops.iterrows():
+            t = int(row["t"])
+            if t < max(int(t_min), 0):
+                continue
+            probs = hist.get_model_probabilities(t)
+            models = {}
+            for m in probs.index:
+                p = float(probs.loc[m, "p"])
+                if p <= 0:
+                    continue
+                df, w = hist.get_distribution(m=int(m), t=t)
+                means = {
+                    str(col): float(np.dot(w, np.asarray(df[col],
+                                                         np.float64)))
+                    for col in df.columns
+                }
+                models[int(m)] = {"p": p, "n": int(len(w)),
+                                  "means": means}
+            out.append({"t": t, "epsilon": float(row["epsilon"]),
+                        "models": models})
+        return out
+    finally:
+        hist.close()
+
+
+def _flatten(summary: dict) -> list[tuple]:
+    rows = []
+    for m, info in sorted(summary["models"].items()):
+        for param, mean in sorted(info["means"].items()):
+            rows.append((summary["t"], summary["epsilon"], int(m),
+                         info["p"], info["n"], param, mean))
+    return rows
+
+
+# ---------------------------------------------------------- arrow frames
+class ArrowSummaryWriter:
+    """Incremental Arrow IPC framing: :meth:`frame` turns one summary
+    into the next stream chunk (the first call carries the schema
+    message), :meth:`finish` yields the end-of-stream marker."""
+
+    def __init__(self):
+        import pyarrow as pa
+
+        self._pa = pa
+        self._sink = io.BytesIO()
+        self._schema = pa.schema(
+            [pa.field(name, getattr(pa, typ)())
+             for name, typ in _SCHEMA_FIELDS])
+        self._writer = pa.ipc.new_stream(self._sink, self._schema)
+
+    def _take(self) -> bytes:
+        data = self._sink.getvalue()
+        self._sink.seek(0)
+        self._sink.truncate()
+        return data
+
+    def frame(self, summary: dict) -> bytes:
+        pa = self._pa
+        rows = _flatten(summary)
+        cols = list(zip(*rows)) if rows else [[] for _ in _SCHEMA_FIELDS]
+        batch = pa.record_batch(
+            [pa.array(list(col), field.type)
+             for col, field in zip(cols, self._schema)],
+            schema=self._schema)
+        self._writer.write_batch(batch)
+        return self._take()
+
+    def finish(self) -> bytes:
+        self._writer.close()
+        return self._take()
+
+
+def read_arrow_summaries(raw: bytes) -> list[dict]:
+    """Client side: reassemble ``generation_summaries``-shaped dicts
+    from a complete Arrow IPC stream (bit-exact round trip)."""
+    import pyarrow as pa
+
+    out: dict[int, dict] = {}
+    with pa.ipc.open_stream(io.BytesIO(raw)) as reader:
+        for batch in reader:
+            cols = {name: batch.column(i).to_pylist()
+                    for i, (name, _) in enumerate(_SCHEMA_FIELDS)}
+            for i in range(batch.num_rows):
+                t = int(cols["t"][i])
+                s = out.setdefault(
+                    t, {"t": t, "epsilon": float(cols["epsilon"][i]),
+                        "models": {}})
+                m = int(cols["m"][i])
+                info = s["models"].setdefault(
+                    m, {"p": float(cols["p_model"][i]),
+                        "n": int(cols["n"][i]), "means": {}})
+                info["means"][str(cols["param"][i])] = float(
+                    cols["mean"][i])
+    return [out[t] for t in sorted(out)]
+
+
+# --------------------------------------------------------- ndjson frames
+def summary_json_line(summary: dict) -> bytes:
+    """The pyarrow-absent fallback framing: one NDJSON line per
+    generation (float64 exactness via repr round-trip)."""
+    payload = {
+        "kind": "generation", "t": summary["t"],
+        "epsilon": summary["epsilon"],
+        "models": {str(m): info for m, info in summary["models"].items()},
+    }
+    return (json.dumps(payload) + "\n").encode()
+
+
+def parse_summary_lines(lines) -> list[dict]:
+    out = []
+    for line in lines:
+        obj = json.loads(line)
+        if obj.get("kind") != "generation":
+            continue
+        out.append({
+            "t": int(obj["t"]), "epsilon": float(obj["epsilon"]),
+            "models": {
+                int(m): {"p": float(info["p"]), "n": int(info["n"]),
+                         "means": {k: float(v)
+                                   for k, v in info["means"].items()}}
+                for m, info in obj["models"].items()
+            },
+        })
+    return out
+
+
+# ----------------------------------------------------------- http client
+def stream_posterior(host: str, port: int, tenant_id: str,
+                     timeout_s: float = 120.0) -> tuple[str, list[dict]]:
+    """Consume ``/api/tenant/<id>/stream?format=arrow`` to completion.
+
+    Returns ``(format, summaries)`` where format is ``"arrow"`` or
+    ``"ndjson"`` — the SERVER picks NDJSON when it lacks pyarrow (and a
+    pyarrow-less client should pass ``format=summaries`` itself to skip
+    the Arrow negotiation entirely).
+    """
+    import http.client
+
+    want_arrow = has_pyarrow()
+    fmt = "arrow" if want_arrow else "summaries"
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    try:
+        conn.request(
+            "GET", f"/api/tenant/{tenant_id}/stream?format={fmt}")
+        resp = conn.getresponse()
+        if resp.status != 200:
+            raise RuntimeError(
+                f"stream failed: HTTP {resp.status} "
+                f"{resp.read(300)!r}")
+        ctype = resp.getheader("Content-Type", "")
+        raw = resp.read()  # http.client de-chunks transparently
+        if ctype.startswith(ARROW_CONTENT_TYPE):
+            return "arrow", read_arrow_summaries(raw)
+        return "ndjson", parse_summary_lines(
+            line for line in raw.decode().splitlines() if line.strip())
+    finally:
+        conn.close()

@@ -86,11 +86,64 @@ def _build_lotka_volterra(spec: "TenantSpec") -> dict:
     }
 
 
+def _build_gillespie_bd(spec: "TenantSpec") -> dict:
+    """Stochastic birth-death (tau-leap Gillespie) — the scenario zoo's
+    cheap stochastic-kinetics workload, traffic-class ``gillespie``."""
+    import pyabc_tpu as pt
+    from ..models import gillespie as g
+
+    n_leaps = int(spec.params.get("n_leaps", 100))
+    n_obs = int(spec.params.get("n_obs", 10))
+    return {
+        "models": g.make_birth_death_model(n_leaps=n_leaps, n_obs=n_obs),
+        "parameter_priors": g.birth_death_prior(),
+        "distance_function": pt.PNormDistance(p=2),
+        "eps": pt.MedianEpsilon(),
+        "observed": g.observed_birth_death(
+            seed=int(spec.data_seed), n_leaps=n_leaps, n_obs=n_obs),
+    }
+
+
+def _build_sir(spec: "TenantSpec") -> dict:
+    """Deterministic SIR ODE with observation noise (noisy-ABC)."""
+    import pyabc_tpu as pt
+    from ..models import sir
+
+    n_obs = int(spec.params.get("n_obs", 15))
+    return {
+        "models": sir.make_sir_model(n_obs=n_obs),
+        "parameter_priors": sir.default_prior(),
+        "distance_function": pt.PNormDistance(p=2),
+        "eps": pt.MedianEpsilon(),
+        "observed": sir.observed_data(
+            seed=int(spec.data_seed), n_obs=n_obs),
+    }
+
+
+def _build_selection_pair(spec: "TenantSpec") -> dict:
+    """K=2 tractable model selection (analytic model posterior) — the
+    K>1 fused-kernel path exercised at traffic scale."""
+    import pyabc_tpu as pt
+    from ..models import model_selection as msel
+
+    models, priors, _ = msel.tractable_pair()
+    return {
+        "models": models,
+        "parameter_priors": priors,
+        "distance_function": pt.PNormDistance(p=2),
+        "eps": pt.MedianEpsilon(),
+        "observed": {"x": float(spec.params.get("x_obs", 0.7))},
+    }
+
+
 #: declarative model registry the submit API draws from; each builder
 #: maps a spec to ABCSMC component kwargs + the observed data
 MODEL_BUILDERS = {
     "gaussian": _build_gaussian,
     "lotka_volterra": _build_lotka_volterra,
+    "gillespie_bd": _build_gillespie_bd,
+    "sir": _build_sir,
+    "selection_pair": _build_selection_pair,
 }
 
 
@@ -255,6 +308,18 @@ class Tenant:
         self.finished_at: float | None = None
         #: wall seconds actually spent RUNNING (summed over attempts)
         self.run_s = 0.0
+        #: chip-seconds actually consumed (run seconds × lease width,
+        #: summed over attempts) — the quota-accounting unit
+        self.chip_s = 0.0
+        #: last lifecycle measurement of this tenant's on-disk bytes
+        #: (db + WAL + columnar files + archive + checkpoint)
+        self.bytes_on_disk = 0
+        #: quota-remaining view ({chip_seconds, bytes_on_disk,
+        #: generations}; None until the lifecycle layer first computes it)
+        self.quota_remaining: dict | None = None
+        #: lifecycle disposal happened: the History files were deleted
+        #: (or archived) — status survives, the data artifacts are gone
+        self.disposed = False
         self.generations_done = 0
         self.error: str | None = None
         #: the PR-6 health trail of a failed run, shipped with status
@@ -360,6 +425,10 @@ class Tenant:
             "started_at": self.started_at,
             "finished_at": self.finished_at,
             "run_s": round(self.run_s, 6),
+            "chip_s": round(self.chip_s, 6),
+            "bytes_on_disk": int(self.bytes_on_disk),
+            "quota_remaining": self.quota_remaining,
+            "disposed": bool(self.disposed),
             "db": self.db_path,
             "checkpoint": self.checkpoint_path,
             "kernel_cache_hit": self.kernel_cache_hit,

@@ -1,3 +1,4 @@
+from .archive import archive_tenant_db, restore_tenant_db
 from .bytes_storage import df_from_bytes, df_to_bytes, np_from_bytes, np_to_bytes
 from .columnar import ColumnarStore, GenerationBatch
 from .history import (
@@ -12,5 +13,6 @@ __all__ = [
     "History", "PRE_TIME", "create_sqlite_db_id",
     "WriterPool", "PooledWriter",
     "ColumnarStore", "GenerationBatch",
+    "archive_tenant_db", "restore_tenant_db",
     "np_to_bytes", "np_from_bytes", "df_to_bytes", "df_from_bytes",
 ]

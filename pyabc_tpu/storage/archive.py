@@ -1,0 +1,112 @@
+"""Whole-db archival for terminal tenants (serving lifecycle, ISSUE 16).
+
+A terminal tenant's History — the sqlite db plus its ``.columnar/``
+generation-file sidecar — is packed into ONE ``.tar.gz`` so the serving
+base_dir holds a single compact artifact per archived tenant instead of
+a db + WAL + N Parquet files. ``restore`` unpacks it back in place and
+the restored History answers ``get_distribution`` / ``get_all_populations``
+bit-identically (the tar round-trip never rewrites file contents).
+
+Layout inside the archive (names are fixed, not caller paths, so an
+archive restores into any directory)::
+
+    db                     the sqlite file (WAL checkpointed first)
+    columnar/run<id>/t<t>.parquet   the sidecar tree, if present
+
+Pure-stdlib (tarfile); no pyarrow dependency — archiving a columnar
+tenant just streams its Parquet files as opaque bytes.
+"""
+from __future__ import annotations
+
+import os
+import sqlite3
+import tarfile
+from pathlib import Path
+
+from .history import _db_path, _parse_store_url
+
+#: archive file suffix next to the tenant db ("<tid>.db" -> "<tid>.tar.gz")
+ARCHIVE_SUFFIX = ".tar.gz"
+
+
+def archive_paths(db_url: str) -> tuple[Path, Path, Path]:
+    """(sqlite path, columnar sidecar dir, archive path) for a db url."""
+    sql_path = Path(_db_path(_parse_store_url(db_url)[0]))
+    return sql_path, Path(str(sql_path) + ".columnar"), \
+        sql_path.with_suffix("").with_name(
+            sql_path.with_suffix("").name + ARCHIVE_SUFFIX)
+
+
+def _checkpoint_wal(sql_path: Path) -> None:
+    """Fold the -wal file into the main db so the archive is one file's
+    truth (a tar of db+wal would need sqlite to replay on restore)."""
+    conn = sqlite3.connect(sql_path)
+    try:
+        conn.execute("PRAGMA wal_checkpoint(TRUNCATE)")
+        conn.commit()
+    finally:
+        conn.close()
+
+
+def archive_tenant_db(db_url: str, *, remove_original: bool = True) -> Path:
+    """Pack a tenant History (db + columnar sidecar) into one tar.gz.
+
+    Returns the archive path. With ``remove_original`` (the default, the
+    compaction use) the db, WAL droppings, and sidecar tree are deleted
+    after the archive is written tmp + ``os.replace`` — a crash mid-pack
+    leaves the originals untouched and only a ``.tmp`` orphan.
+    """
+    sql_path, col_dir, out = archive_paths(db_url)
+    if not sql_path.is_file():
+        raise FileNotFoundError(f"no tenant db at {sql_path}")
+    _checkpoint_wal(sql_path)
+    tmp = out.with_suffix(out.suffix + ".tmp")
+    with tarfile.open(tmp, "w:gz") as tar:
+        tar.add(sql_path, arcname="db")
+        if col_dir.is_dir():
+            tar.add(col_dir, arcname="columnar")
+    os.replace(tmp, out)
+    if remove_original:
+        sql_path.unlink()
+        for side in ("-wal", "-shm"):
+            Path(str(sql_path) + side).unlink(missing_ok=True)
+        if col_dir.is_dir():
+            import shutil
+
+            shutil.rmtree(col_dir)
+    return out
+
+
+def restore_tenant_db(db_url: str, *, remove_archive: bool = False) -> Path:
+    """Unpack ``archive_tenant_db``'s artifact back to the live layout.
+
+    Returns the restored sqlite path; ``History(db_url)`` then reads the
+    run exactly as before archival.
+    """
+    sql_path, col_dir, archive = archive_paths(db_url)
+    if not archive.is_file():
+        raise FileNotFoundError(f"no tenant archive at {archive}")
+    with tarfile.open(archive, "r:gz") as tar:
+        for member in tar.getmembers():
+            # defensive extraction: fixed top-level names only
+            if not (member.name == "db" or member.name == "columnar"
+                    or member.name.startswith("columnar/")):
+                raise ValueError(
+                    f"unexpected member {member.name!r} in {archive}")
+        db_member = tar.extractfile("db")
+        assert db_member is not None
+        sql_path.parent.mkdir(parents=True, exist_ok=True)
+        with open(sql_path, "wb") as fh:
+            fh.write(db_member.read())
+        for member in tar.getmembers():
+            if member.isfile() and member.name.startswith("columnar/"):
+                rel = Path(member.name).relative_to("columnar")
+                dest = col_dir / rel
+                dest.parent.mkdir(parents=True, exist_ok=True)
+                src = tar.extractfile(member)
+                assert src is not None
+                with open(dest, "wb") as fh:
+                    fh.write(src.read())
+    if remove_archive:
+        archive.unlink()
+    return sql_path

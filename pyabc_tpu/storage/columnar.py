@@ -456,6 +456,17 @@ class ColumnarStore:
         Called AFTER the metadata-row delete committed: rows are the
         visibility truth, so a crash between the commit and the unlink
         leaves only invisible orphans (overwritten on re-append)."""
+        return self._prune(abc_id, lambda t: t >= int(t_from))
+
+    def prune_before(self, abc_id: int, t_before: int) -> int:
+        """Delete this run's generation files with t < ``t_before`` —
+        the retention-GC direction (keep-last-k / TTL sweeps drop the
+        OLDEST generations). Same row-truth ordering contract as
+        :meth:`prune`: call only after the metadata-row delete
+        committed."""
+        return self._prune(abc_id, lambda t: t < int(t_before))
+
+    def _prune(self, abc_id: int, drop) -> int:
         d = self.run_dir(abc_id)
         if not d.is_dir():
             return 0
@@ -465,7 +476,7 @@ class ColumnarStore:
                 t = int(p.stem[1:])
             except ValueError:
                 continue
-            if t >= int(t_from):
+            if drop(t):
                 p.unlink(missing_ok=True)
                 removed += 1
         return removed

@@ -832,10 +832,32 @@ class History:
         append duplicate population rows for the same ``t``. The
         checkpoint is the canonical state — rows past it are trimmed
         before the re-run."""
+        return self._prune_where(
+            "t>=?", (int(t),),
+            lambda: self._colstore.prune(self.id, int(t)))
+
+    @_locked
+    def prune_before(self, t: int) -> int:
+        """Delete this run's populations with 0 <= generation < ``t``
+        (and their models/particles/parameters/samples). Returns the
+        number of populations removed.
+
+        Retention-GC seam (serving lifecycle, keep-last-k / TTL): drops
+        the OLDEST generations while :meth:`prune_from` drops the newest.
+        The PRE_TIME observed-data row is never touched — ``load()`` +
+        requeue-resume need only that row, the checkpoint, and ``max_t``,
+        all of which survive any ``prune_before`` cut. Note
+        ``total_nr_simulations`` shrinks accordingly (the dropped
+        generations' sample counts are gone with their rows)."""
+        return self._prune_where(
+            "t>=0 AND t<?", (int(t),),
+            lambda: self._colstore.prune_before(self.id, int(t)))
+
+    def _prune_where(self, cond: str, params: tuple, colstore_prune) -> int:
         cur = self._conn.cursor()
         pop_ids = [r[0] for r in cur.execute(
-            "SELECT id FROM populations WHERE abc_smc_id=? AND t>=?",
-            (self.id, int(t)),
+            f"SELECT id FROM populations WHERE abc_smc_id=? AND {cond}",
+            (self.id, *params),
         ).fetchall()]
         if not pop_ids:
             return 0
@@ -863,8 +885,17 @@ class History:
         # are the visibility truth, so a crash between commit and unlink
         # leaves only invisible orphan files (overwritten on re-append)
         if self._colstore is not None:
-            self._colstore.prune(self.id, int(t))
+            colstore_prune()
         return len(pop_ids)
+
+    @_locked
+    def vacuum(self) -> None:
+        """Reclaim the pages freed by pruning (sqlite keeps them in the
+        freelist otherwise — a pruned db's file size would never shrink).
+        Sqlite-only; a no-op on other dialects."""
+        if self._dialect.name == "sqlite":
+            self._conn.commit()
+            self._conn.execute("VACUUM")
 
     def update_telemetry(self, t: int, telemetry: dict) -> None:
         """Merge keys into the telemetry json of generation t (adaptation

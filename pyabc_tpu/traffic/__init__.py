@@ -1,0 +1,32 @@
+"""pyabc_tpu.traffic — fleet-scale open-loop load generation (round 19).
+
+The serving subsystem is chaos-tested tenant by tenant; this package
+tests it the way production traffic will: SUSTAINED SEEDED ARRIVALS
+(Poisson and burst processes) drawn from a tenant-spec zoo derived from
+the scenario zoo, driven open-loop against a live
+:class:`~pyabc_tpu.serving.scheduler.RunScheduler` — arrivals keep
+coming whether or not the pool has caught up, exactly the regime where
+bounded admission, Retry-After honesty, retention GC and fairness
+either hold or visibly break.
+
+- :mod:`.specs` — the tenant-spec zoo: weighted traffic classes
+  (gaussian / Gillespie birth-death / SIR / K>1 selection; mixed
+  populations, shard widths and History stores) and the seeded
+  deterministic sampler over them;
+- :mod:`.generator` — :class:`ArrivalSchedule` (precomputed seeded
+  arrival process) and :class:`TrafficGenerator` (open-loop driver on
+  the INJECTED clock — CLOCK001 — measuring admission latency, 429
+  honesty against observed waits, p50/p99 time-to-posterior, and
+  per-class fairness under churn).
+
+Everything here is measurement and submission; no module in this
+package constructs a run or touches a device (ISO001 stays with the
+scheduler).
+"""
+from .generator import ArrivalSchedule, TrafficGenerator, percentile
+from .specs import SPEC_PROFILES, TrafficClass, make_spec, spec_zoo
+
+__all__ = [
+    "ArrivalSchedule", "TrafficGenerator", "percentile",
+    "SPEC_PROFILES", "TrafficClass", "make_spec", "spec_zoo",
+]

@@ -1,0 +1,328 @@
+"""Open-loop traffic generation against a live :class:`RunScheduler`.
+
+Closed-loop load tests (submit, wait, submit) measure the system at the
+rate the SYSTEM chooses; production tenants arrive at the rate THEY
+choose. :class:`ArrivalSchedule` precomputes a seeded arrival process
+(Poisson gaps or periodic bursts) over the spec zoo, and
+:class:`TrafficGenerator` replays it open-loop: an arrival whose due
+time has passed is submitted NOW whether or not the pool has caught up,
+and a 429 answer schedules a retry exactly ``Retry-After`` later — the
+generator honors the hint, which is precisely what lets it measure
+whether the hint was honest.
+
+Measured, all on the injected clock (CLOCK001 — this module is in the
+abc-lint INSTRUMENTED set):
+
+- admission latency — wall time spent inside ``submit()`` per arrival
+  (p99 guards the scheduler lock under churn);
+- 429 honesty — per rejected arrival, ``observed_wait / first_hint``
+  where observed_wait runs from the first rejection to eventual
+  admission; an honest hint keeps the ratio near 1, the bench lane
+  bounds its p90;
+- time-to-posterior — ``finished_at - submitted_at`` per completed
+  tenant (p50/p99 + the ``pyabc_tpu_time_to_posterior_seconds``
+  histogram the scheduler feeds);
+- fairness — within each traffic class, max/min accepted-particle
+  throughput over SERVICE time (started -> finished; a tenant's queue
+  wait reflects arrival order, not the scheduler's treatment) across
+  completed tenants (cross-class ratios compare apples to oranges;
+  within-class they expose starvation).
+
+The generator only submits and observes — it never constructs runs or
+touches devices (ISO001 stays with the scheduler).
+"""
+from __future__ import annotations
+
+import heapq
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..observability import global_metrics
+from ..observability.metrics import (
+    TRAFFIC_ARRIVALS_TOTAL,
+    TRAFFIC_REJECTIONS_TOTAL,
+)
+from ..serving.admission import AdmissionRejectedError
+from ..serving.tenant import TERMINAL_STATES
+from .specs import TrafficClass, draw_class, make_spec, spec_zoo
+
+
+def percentile(samples, q: float) -> float:
+    """Percentile over raw samples (the Histogram keeps only moments,
+    so lane percentiles are computed generator-side from samples)."""
+    if not samples:
+        return float("nan")
+    return float(np.percentile(np.asarray(samples, np.float64), q))
+
+
+@dataclass
+class Arrival:
+    """One scheduled submission and everything observed about it."""
+
+    idx: int
+    due_s: float
+    cls: TrafficClass
+    seed: int
+    tenant_id: str | None = None
+    spec: object | None = None  # the TenantSpec actually drawn
+    admit_latency_s: float | None = None
+    first_reject_at: float | None = None
+    first_hint_s: float | None = None
+    rejections: int = 0
+    admitted_at: float | None = None
+    dropped: str | None = None  # non-retryable rejection reason
+    ttp_s: float | None = None  # time-to-posterior once terminal
+    run_s: float | None = None  # service time (started -> finished)
+    final_state: str | None = None
+
+
+class ArrivalSchedule:
+    """A precomputed, seeded arrival process over the spec zoo.
+
+    Precomputing (rather than drawing as the clock runs) keeps the
+    process independent of scheduler timing: the same seed yields the
+    same arrivals whether the pool keeps up or drowns.
+    """
+
+    def __init__(self, arrivals: list[Arrival]):
+        self.arrivals = sorted(arrivals, key=lambda a: a.due_s)
+
+    def __len__(self):
+        return len(self.arrivals)
+
+    @property
+    def horizon_s(self) -> float:
+        return self.arrivals[-1].due_s if self.arrivals else 0.0
+
+    @classmethod
+    def poisson(cls, n: int, rate_hz: float, seed: int = 0,
+                profile: str = "smoke",
+                classes=None) -> "ArrivalSchedule":
+        """``n`` arrivals with exponential inter-arrival gaps at
+        ``rate_hz``, classes drawn by zoo weight (or from an explicit
+        ``classes`` tuple overriding the profile)."""
+        rng = np.random.default_rng(seed)
+        classes = classes if classes is not None else spec_zoo(profile)
+        t = 0.0
+        arrivals = []
+        for i in range(int(n)):
+            t += float(rng.exponential(1.0 / float(rate_hz)))
+            arrivals.append(Arrival(
+                idx=i, due_s=t, cls=draw_class(classes, rng),
+                seed=int(rng.integers(0, 2**31 - 1))))
+        return cls(arrivals)
+
+    @classmethod
+    def burst(cls, n_bursts: int, burst_size: int, interval_s: float,
+              seed: int = 0, profile: str = "smoke",
+              classes=None) -> "ArrivalSchedule":
+        """``n_bursts`` bursts of ``burst_size`` simultaneous arrivals
+        every ``interval_s`` — the worst case for admission latency and
+        Retry-After honesty."""
+        rng = np.random.default_rng(seed)
+        classes = classes if classes is not None else spec_zoo(profile)
+        arrivals = []
+        idx = 0
+        for b in range(int(n_bursts)):
+            due = b * float(interval_s)
+            for _ in range(int(burst_size)):
+                arrivals.append(Arrival(
+                    idx=idx, due_s=due, cls=draw_class(classes, rng),
+                    seed=int(rng.integers(0, 2**31 - 1))))
+                idx += 1
+        return cls(arrivals)
+
+
+class TrafficGenerator:
+    """Replay an :class:`ArrivalSchedule` against a scheduler, honoring
+    Retry-After on 429s, and collect the fleet-level measurements."""
+
+    def __init__(self, sched, schedule: ArrivalSchedule, *,
+                 metrics=None, max_retries: int = 50):
+        self.sched = sched
+        self.clock = sched.clock
+        self.schedule = schedule
+        self.metrics = metrics if metrics is not None else global_metrics()
+        self.max_retries = int(max_retries)
+        self._epoch = self.clock.now()
+        # (fire_at, tiebreak, arrival) heaps; retries share the heap so
+        # a retry due before a fresh arrival goes first
+        self._heap: list[tuple[float, int, Arrival]] = []
+        self._tie = 0
+        for a in schedule.arrivals:
+            self._push(self._epoch + a.due_s, a)
+        self._pending: dict[str, Arrival] = {}  # tenant_id -> live
+        self._done: list[Arrival] = []
+        self._arrivals_total = self.metrics.counter(
+            TRAFFIC_ARRIVALS_TOTAL,
+            "Traffic-generator submission attempts")
+        self._rejections_total = self.metrics.counter(
+            TRAFFIC_REJECTIONS_TOTAL,
+            "Traffic-generator admission rejections")
+
+    def _push(self, fire_at: float, arrival: Arrival) -> None:
+        heapq.heappush(self._heap, (fire_at, self._tie, arrival))
+        self._tie += 1
+
+    # ------------------------------------------------------------ driving
+    def step(self) -> int:
+        """Submit every arrival/retry due at ``clock.now()`` and poll
+        live tenants for terminal states; returns submissions made."""
+        now = self.clock.now()
+        n = 0
+        while self._heap and self._heap[0][0] <= now:
+            _, _, arrival = heapq.heappop(self._heap)
+            self._submit(arrival)
+            n += 1
+        self._poll()
+        return n
+
+    def done(self) -> bool:
+        return not self._heap and not self._pending
+
+    def run(self, budget_s: float, poll_s: float = 0.05) -> None:
+        """Drive :meth:`step` until every arrival is terminal or the
+        budget expires (real sleeps; the measurements ride the injected
+        clock, which for a live run is SYSTEM_CLOCK)."""
+        deadline = self.clock.now() + float(budget_s)
+        while not self.done() and self.clock.now() < deadline:
+            self.step()
+            time.sleep(poll_s)
+        self._poll()
+
+    def abort_pending(self) -> int:
+        """Quiesce: drop every unfired arrival/retry and cancel every
+        still-live tenant (phase boundaries in the bench lane — the
+        churn phase must release the pool before a drained probe can
+        measure it). Returns the number of cancellations requested;
+        RUNNING tenants stop at their next chunk boundary, so the pool
+        frees progressively, not instantly."""
+        self._heap.clear()
+        n = 0
+        for tid in list(self._pending):
+            try:
+                if self.sched.cancel(tid):
+                    n += 1
+            except AttributeError:
+                break  # a scheduler without cancel(): nothing to do
+        self._poll()
+        return n
+
+    # --------------------------------------------------------- internals
+    def _submit(self, arrival: Arrival) -> None:
+        if arrival.spec is None:
+            arrival.spec = make_spec(arrival.cls, seed=arrival.seed)
+        spec = arrival.spec
+        self._arrivals_total.inc()
+        t0 = self.clock.now()
+        try:
+            tenant = self.sched.submit(spec)
+        except AdmissionRejectedError as exc:
+            self._rejections_total.inc()
+            arrival.rejections += 1
+            now = self.clock.now()
+            if arrival.first_reject_at is None:
+                arrival.first_reject_at = now
+                arrival.first_hint_s = exc.retry_after_s
+            if (exc.retry_after_s is None
+                    or arrival.rejections > self.max_retries):
+                arrival.dropped = exc.reason
+                self._done.append(arrival)
+                return
+            self._push(now + float(exc.retry_after_s), arrival)
+            return
+        now = self.clock.now()
+        arrival.admit_latency_s = now - t0
+        arrival.admitted_at = now
+        arrival.tenant_id = tenant.id
+        self._pending[tenant.id] = arrival
+
+    def _poll(self) -> None:
+        for tid in list(self._pending):
+            tenant = self.sched.get(tid)
+            arrival = self._pending[tid]
+            if tenant is None:  # evicted before we sampled it
+                arrival.final_state = "evicted"
+            elif tenant.state in TERMINAL_STATES:
+                arrival.final_state = tenant.state
+                if tenant.finished_at is not None:
+                    arrival.ttp_s = (tenant.finished_at
+                                     - tenant.submitted_at)
+                    started = getattr(tenant, "started_at", None)
+                    # service time excludes the queue: fairness must
+                    # compare the scheduler's treatment, not arrival
+                    # order (under churn ttp is dominated by backlog)
+                    arrival.run_s = (
+                        tenant.finished_at - started
+                        if started is not None else arrival.ttp_s)
+            else:
+                continue
+            del self._pending[tid]
+            self._done.append(arrival)
+
+    # ------------------------------------------------------------ results
+    def report(self) -> dict:
+        """The fleet measurement: admission latency, 429 honesty,
+        time-to-posterior percentiles, per-class fairness."""
+        done = list(self._done)
+        # admission latency and honesty are known the moment an arrival
+        # is ADMITTED — live tenants count, only completion metrics wait
+        landed = done + list(self._pending.values())
+        admit_lat = [a.admit_latency_s for a in landed
+                     if a.admit_latency_s is not None]
+        ttp = [a.ttp_s for a in done if a.ttp_s is not None]
+        honesty = [
+            (a.admitted_at - a.first_reject_at) / a.first_hint_s
+            for a in landed
+            if (a.admitted_at is not None
+                and a.first_reject_at is not None
+                and a.first_hint_s)
+        ]
+        by_class: dict[str, list[float]] = {}
+        for a in done:
+            service = a.run_s if a.run_s else a.ttp_s
+            if (a.final_state == "completed" and service
+                    and service > 0 and a.spec is not None):
+                pps = (a.spec.population_size
+                       * a.spec.generations) / service
+                by_class.setdefault(a.cls.name, []).append(pps)
+        fairness = {}
+        for name, pps in by_class.items():
+            if len(pps) >= 2 and min(pps) > 0:
+                fairness[name] = max(pps) / min(pps)
+        states: dict[str, int] = {}
+        for a in done:
+            key = "dropped" if a.dropped else (a.final_state or "pending")
+            states[key] = states.get(key, 0) + 1
+        return {
+            "arrivals": len(self.schedule),
+            "submitted": len([a for a in landed
+                              if a.admitted_at is not None]),
+            "pending": len(self._pending),
+            "rejections": sum(a.rejections for a in landed),
+            "dropped": len([a for a in done if a.dropped]),
+            "states": states,
+            "admission_latency_s": {
+                "p50": percentile(admit_lat, 50),
+                "p99": percentile(admit_lat, 99),
+                "n": len(admit_lat),
+            },
+            "honesty_ratio": {
+                "p50": percentile(honesty, 50),
+                "p90": percentile(honesty, 90),
+                "max": max(honesty) if honesty else float("nan"),
+                "n": len(honesty),
+            },
+            "time_to_posterior_s": {
+                "p50": percentile(ttp, 50),
+                "p99": percentile(ttp, 99),
+                "n": len(ttp),
+            },
+            "fairness_max_ratio": (max(fairness.values())
+                                   if fairness else float("nan")),
+            "fairness_by_class": fairness,
+            "completed_by_class": {k: len(v)
+                                   for k, v in by_class.items()},
+        }

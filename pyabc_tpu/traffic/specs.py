@@ -1,0 +1,136 @@
+"""The tenant-spec zoo: weighted traffic classes over the scenario zoo.
+
+A fleet does not submit one workload shape; it submits a MIX — cheap
+gaussian smoke runs next to Gillespie birth-death tenants, SIR tenants
+with real dynamics, K>1 model-selection pairs, occasional big sharded
+populations — and the serving guarantees (fairness, bounded admission,
+retention GC) only mean something measured against that mix. This
+module pins the mix down as data: each :class:`TrafficClass` maps a
+name to a :class:`~pyabc_tpu.serving.tenant.TenantSpec` template plus a
+sampling weight, and :func:`spec_zoo` / :func:`make_spec` turn a seed
+into a reproducible spec draw.
+
+Two profiles, both deterministic in the seed:
+
+- ``smoke``  — CI-sized (~40 tenants in ~60 s on forced-8-device CPU):
+  tiny populations, few generations, gaussian-dominant but every model
+  family represented so the lane exercises all MODEL_BUILDERS paths;
+- ``full``   — the bench/fleet mix for the 1000-tenant churn run:
+  wider population spread, sharded big tenants, columnar-store tenants
+  alongside plain sqlite ones.
+
+Pure data + seeded draws; no clocks, no devices, no run construction.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..serving.tenant import MODEL_BUILDERS, TenantSpec
+
+
+@dataclass(frozen=True)
+class TrafficClass:
+    """One weighted workload shape in the zoo.
+
+    ``weight`` is the relative arrival probability within a profile;
+    ``pops``/``gens`` are the discrete choices a draw picks from
+    uniformly (mixed shapes WITHIN a class keep the compiled-kernel
+    cache honest — same model, several pad widths).
+    """
+
+    name: str
+    model: str
+    weight: float
+    pops: tuple[int, ...]
+    gens: tuple[int, ...]
+    fused_generations: int = 1
+    #: shard count (power of two >= 2) for a sub-mesh lease; None =
+    #: an unsharded width-1 tenant (packable per chip)
+    sharded: int | None = None
+    store: str = "rows"
+    params: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.model not in MODEL_BUILDERS:
+            raise ValueError(
+                f"unknown model {self.model!r}; "
+                f"known: {sorted(MODEL_BUILDERS)}")
+        if self.weight <= 0:
+            raise ValueError("weight must be positive")
+
+
+#: CI-sized mix: everything small, every model family touched. The
+#: gaussian classes dominate (they are the cheapest to simulate) so the
+#: ~60 s smoke job spends its budget on SCHEDULING pressure — many
+#: arrivals, churn, GC — not on simulation depth.
+_SMOKE = (
+    TrafficClass("gauss-small", "gaussian", weight=4.0,
+                 pops=(100, 200), gens=(3, 4)),
+    TrafficClass("gauss-fused", "gaussian", weight=2.0,
+                 pops=(200,), gens=(4,), fused_generations=2),
+    TrafficClass("bd-small", "gillespie_bd", weight=1.0,
+                 pops=(100,), gens=(3,)),
+    TrafficClass("sir-small", "sir", weight=1.0,
+                 pops=(100,), gens=(3,)),
+    TrafficClass("select-small", "selection_pair", weight=1.0,
+                 pops=(150,), gens=(3,)),
+)
+
+#: The fleet mix for the 1000-tenant churn run: population spread wide
+#: enough to hit several pad widths per model, a sharded big-gaussian
+#: class (width>1 leases contending with the width-1 packing), and
+#: columnar-store tenants so retention GC has Parquet files to delete.
+_FULL = _SMOKE + (
+    TrafficClass("gauss-big-sharded", "gaussian", weight=0.5,
+                 pops=(800, 1600), gens=(6, 8), fused_generations=2,
+                 sharded=4),
+    TrafficClass("gauss-columnar", "gaussian", weight=1.5,
+                 pops=(200, 400), gens=(4, 6), store="columnar"),
+    TrafficClass("bd-columnar", "gillespie_bd", weight=0.5,
+                 pops=(200,), gens=(4,), store="columnar"),
+)
+
+SPEC_PROFILES: dict[str, tuple[TrafficClass, ...]] = {
+    "smoke": _SMOKE,
+    "full": _FULL,
+}
+
+
+def spec_zoo(profile: str = "smoke") -> tuple[TrafficClass, ...]:
+    """The traffic classes of ``profile`` (``smoke`` or ``full``)."""
+    try:
+        return SPEC_PROFILES[profile]
+    except KeyError:
+        raise ValueError(
+            f"unknown traffic profile {profile!r}; "
+            f"known: {sorted(SPEC_PROFILES)}") from None
+
+
+def make_spec(cls: TrafficClass, seed: int,
+              data_seed: int | None = None) -> TenantSpec:
+    """Instantiate one spec draw from a class, deterministically in
+    ``seed`` (which also seeds the run itself)."""
+    rng = np.random.default_rng(seed)
+    pop = int(cls.pops[int(rng.integers(len(cls.pops)))])
+    gens = int(cls.gens[int(rng.integers(len(cls.gens)))])
+    return TenantSpec(
+        model=cls.model,
+        population_size=pop,
+        generations=gens,
+        seed=int(seed),
+        fused_generations=cls.fused_generations,
+        data_seed=int(data_seed if data_seed is not None else seed),
+        sharded=cls.sharded,
+        store=cls.store,
+        params=dict(cls.params),
+    )
+
+
+def draw_class(classes: tuple[TrafficClass, ...],
+               rng: np.random.Generator) -> TrafficClass:
+    """Weighted class draw (the generator's per-arrival choice)."""
+    weights = np.asarray([c.weight for c in classes], np.float64)
+    idx = int(rng.choice(len(classes), p=weights / weights.sum()))
+    return classes[idx]

@@ -161,3 +161,33 @@ SCENARIO_LATE_ACC = 0.01
 #: regression guard: late-window accepted-pps ratio ON/OFF (the ISSUE 15
 #: acceptance line; armed only when the run reaches the late window)
 SCENARIO_SPEEDUP_MIN_X = 2.0
+# traffic lane (round 19): fleet-scale churn — an open-loop seeded
+# Poisson arrival process from the spec zoo against a live RunScheduler
+# on forced-8-device CPU. 1000 tenants is the ISSUE acceptance scale
+# (env-overridable; CI runs the ~40-tenant smoke profile in its own
+# job). The arrival rate is deliberately above what a 1-core box can
+# drain so the lane spends its budget in the 429/retry/GC regime;
+# retention keep-last-1 + the fleet disk budget make "bounded disk
+# under churn" an assertable number instead of a hope.
+DEFAULT_TRAFFIC_TENANTS = 1000
+DEFAULT_TRAFFIC_SMOKE_TENANTS = 40
+DEFAULT_TRAFFIC_RATE_HZ = 8.0
+DEFAULT_TRAFFIC_BUDGET_S = 480.0
+DEFAULT_TRAFFIC_SMOKE_BUDGET_S = 60.0
+DEFAULT_TRAFFIC_PROFILE = "full"
+DEFAULT_TRAFFIC_SEED = 190
+#: fleet disk budget for the lane's RetentionPolicy: total History
+#: bytes (db + columnar + archives) the sweep must keep the fleet under
+DEFAULT_TRAFFIC_DISK_BUDGET_BYTES = 256 * 1024 * 1024
+#: p99 bound on wall time spent inside submit() (scheduler lock health
+#: under churn; generous — the 1-core box runs orchestrators, the pump
+#: and the generator on one core)
+TRAFFIC_ADMIT_P99_MAX_S = 2.0
+#: Retry-After honesty: p90 of observed_wait / first_hint. An honest
+#: hint lands near 1; the bound is loose because open-loop pressure
+#: keeps REFILLING the queue between a rejection and its retry, which
+#: legitimately stretches the observed wait beyond any single hint.
+TRAFFIC_HONESTY_P90_MAX = 10.0
+#: within-class fairness: max/min accepted-pps across completed tenants
+#: of the SAME traffic class (the serve-lane bound, fleet-sized)
+TRAFFIC_FAIRNESS_MAX_RATIO = 3.0

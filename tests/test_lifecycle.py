@@ -1,0 +1,341 @@
+"""Tenant lifecycle (round 19): retention GC, quotas, archival.
+
+The contracts under test, directly against the storage + lifecycle
+layers (no scheduler, no jax — the serving-integration legs live in
+tests/test_serving.py and tests/test_traffic.py):
+
+1. PRUNE-BEFORE — ``History.prune_before(t)`` drops the OLDEST
+   generations (SQL rows AND columnar Parquet files) and never touches
+   the PRE_TIME observed row or the newest generation — the resume
+   seam survives any retention setting.
+2. ARCHIVE ROUND-TRIP — ``archive_tenant_db`` packs db + columnar
+   sidecar into one tar.gz and removes the originals;
+   ``restore_tenant_db`` brings them back with every
+   ``get_distribution`` read bit-identical.
+3. QUOTAS — ``TenantQuota.check_spec`` rejects NON-RETRYABLY
+   (retry_after_s None -> HTTP 400, the client must not loop), and the
+   remaining-view arithmetic clamps at zero.
+4. SWEEP — keep-last-k GC, byte-quota shedding (never below the newest
+   generation), TTL disposal and the fleet byte budget, all on an
+   injected VirtualClock; RUNNING tenants are never touched.
+"""
+import os
+import tarfile
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from pyabc_tpu.core.parameters import ParameterSpace
+from pyabc_tpu.core.population import Population
+from pyabc_tpu.core.sumstat_spec import SumStatSpec
+from pyabc_tpu.observability import VirtualClock
+from pyabc_tpu.sampler.base import Sample, exp_normalize_log_weights
+from pyabc_tpu.serving import AdmissionRejectedError, TenantSpec
+from pyabc_tpu.serving.lifecycle import (
+    LifecycleManager,
+    RetentionPolicy,
+    TenantQuota,
+    disk_usage,
+)
+from pyabc_tpu.storage import (
+    History,
+    archive_tenant_db,
+    restore_tenant_db,
+)
+from pyabc_tpu.storage.archive import archive_paths
+from pyabc_tpu.storage.columnar import has_pyarrow
+
+N, D, S = 80, 2, 3
+MODEL_NAMES = ["m0"]
+PARAM_NAMES = [["a", "b"]]
+
+
+def _population(seed: int) -> Population:
+    r = np.random.default_rng(seed)
+    sample = Sample()
+    sample.set_accepted(
+        ms=np.zeros(N, np.int32),
+        thetas=r.normal(size=(N, D)),
+        weights=exp_normalize_log_weights(r.normal(size=N)),
+        distances=np.abs(r.normal(size=N)),
+        sumstats=r.normal(size=(N, S)),
+        proposal_ids=np.arange(N),
+    )
+    return Population(
+        ms=sample.ms, thetas=sample.thetas, weights=sample.weights,
+        distances=sample.distances, sumstats=sample.sumstats,
+        spaces=[ParameterSpace(n) for n in PARAM_NAMES],
+        sumstat_spec=SumStatSpec({"x": np.zeros(S)}),
+        model_names=MODEL_NAMES,
+    )
+
+
+def _make_history(db_url: str, gens: int = 4) -> None:
+    h = History(db_url)
+    h.store_initial_data(None, {}, {"x": np.zeros(S)}, {"a": 1.0},
+                         MODEL_NAMES, "{}", "{}", "{}")
+    for t in range(gens):
+        h.append_population(t, 1.0 - 0.1 * t, _population(300 + t),
+                            3 * N, MODEL_NAMES)
+    h.close()
+
+
+def _distributions(db_url: str) -> list:
+    h = History(db_url)
+    out = []
+    for t in range(h.n_populations):
+        eps = h.get_all_populations().query("t >= 0")["epsilon"]
+        df, w = h.get_distribution(0, h.max_t - h.n_populations + 1 + t)
+        out.append((np.asarray(eps), df.to_numpy(), np.asarray(w)))
+    h.close()
+    return out
+
+
+class FakeTenant:
+    """The attribute surface LifecycleManager touches, no scheduler."""
+
+    def __init__(self, tmp_path, tid: str, scheme: str = "sqlite",
+                 gens: int = 4, state: str = "completed",
+                 finished_at: float | None = 0.0):
+        from pyabc_tpu.observability import MetricsRegistry
+
+        self.id = tid
+        self.db_path = f"{scheme}:///{tmp_path}/{tid}.db"
+        self.checkpoint_path = str(tmp_path / f"{tid}.ck")
+        self.abc_id = 1
+        self.state = state
+        self.disposed = False
+        self.finished_at = finished_at
+        self.generations_done = gens
+        self.chip_s = 0.0
+        self.bytes_on_disk = 0
+        self.metrics = MetricsRegistry()
+        self.events: list = []
+        if gens:
+            _make_history(self.db_path, gens=gens)
+
+    def record_event(self, kind, **attrs):
+        self.events.append({"kind": kind, **attrs})
+
+
+# ======================================================== prune_before
+def test_prune_before_drops_oldest_keeps_resume_seam(
+        tmp_path, store_scheme):
+    db = f"{store_scheme}:///{tmp_path}/t.db"
+    _make_history(db, gens=4)
+    h = History(db)
+    assert h.n_populations == 4
+    removed = h.prune_before(2)
+    assert removed == 2
+    assert h.n_populations == 2 and h.max_t == 3
+    # the PRE_TIME observed row survives: load()'s seam
+    assert h.get_observed_sum_stat() is not None
+    ts = h.get_all_populations().query("t >= 0")["t"].to_list()
+    assert sorted(ts) == [2, 3]
+    # surviving generations read back whole
+    df, w = h.get_distribution(0, 3)
+    assert len(w) == N and len(df) == N
+    h.vacuum()
+    h.close()
+    if "columnar" in store_scheme:
+        col = Path(str(tmp_path / "t.db") + ".columnar")
+        names = sorted(p.name for p in col.rglob("*.parquet"))
+        assert names == ["t2.parquet", "t3.parquet"]
+
+
+def test_prune_before_never_drops_newest(tmp_path):
+    db = f"sqlite:///{tmp_path}/t.db"
+    _make_history(db, gens=3)
+    h = History(db)
+    # an over-eager cut still leaves nothing above max_t untouched:
+    # prune_before(max_t) keeps exactly the newest
+    assert h.prune_before(h.max_t) == 2
+    assert h.n_populations == 1 and h.max_t == 2
+    h.close()
+
+
+# ====================================================== archive round-trip
+def test_archive_roundtrip_restores_bit_identical(
+        tmp_path, store_scheme):
+    db = f"{store_scheme}:///{tmp_path}/t.db"
+    _make_history(db, gens=3)
+    before = _distributions(db)
+    sql_path, col_dir, archive = archive_paths(db)
+
+    out = archive_tenant_db(db)
+    assert out == archive and archive.is_file()
+    assert not sql_path.exists()
+    assert not col_dir.exists()
+    with tarfile.open(archive) as tf:
+        names = tf.getnames()
+    assert "db" in names
+    if "columnar" in store_scheme:
+        assert any(n.startswith("columnar/") for n in names)
+
+    restore_tenant_db(db, remove_archive=True)
+    assert sql_path.is_file() and not archive.exists()
+    after = _distributions(db)
+    assert len(before) == len(after)
+    for (ea, da, wa), (eb, db_, wb) in zip(before, after):
+        assert np.array_equal(ea, eb)
+        assert np.array_equal(da, db_)
+        assert np.array_equal(wa, wb)
+
+
+# =============================================================== quotas
+def test_quota_check_spec_rejects_non_retryable():
+    quota = TenantQuota(max_generations=4)
+    quota.check_spec(TenantSpec(model="gaussian", generations=4))
+    with pytest.raises(AdmissionRejectedError) as exc_info:
+        quota.check_spec(TenantSpec(model="gaussian", generations=5))
+    assert exc_info.value.retry_after_s is None  # -> HTTP 400, not 429
+
+    tight = TenantQuota(max_chip_seconds=0.5)
+    with pytest.raises(AdmissionRejectedError) as exc_info:
+        tight.check_spec(TenantSpec(model="gaussian", generations=8,
+                                    population_size=4000))
+    assert exc_info.value.retry_after_s is None
+    assert "chip-seconds" in exc_info.value.reason
+
+
+def test_quota_remaining_clamps_at_zero():
+    quota = TenantQuota(max_chip_seconds=10.0, max_bytes_on_disk=100,
+                        max_generations=4)
+    rem = quota.remaining(chip_s=12.0, bytes_on_disk=40,
+                          generations_done=1)
+    assert rem == {"chip_seconds": 0.0, "bytes_on_disk": 60,
+                   "generations": 3}
+    unlimited = TenantQuota().remaining(
+        chip_s=1e9, bytes_on_disk=10**12, generations_done=10**6)
+    assert all(v is None for v in unlimited.values())
+
+
+def test_retention_policy_validates_keep_last_k():
+    with pytest.raises(ValueError):
+        RetentionPolicy(keep_last_k=0)
+    RetentionPolicy(keep_last_k=1)  # the floor: the resume seam
+
+
+# ================================================================ sweep
+def test_sweep_keep_last_k_prunes_idle_not_running(tmp_path):
+    clock = VirtualClock()
+    life = LifecycleManager(policy=RetentionPolicy(keep_last_k=1),
+                            clock=clock)
+    idle = FakeTenant(tmp_path, "idle", state="completed")
+    busy = FakeTenant(tmp_path, "busy", state="running")
+    res = life.sweep([idle, busy])
+    assert res["pruned"] == 3 and res["disposed"] == []
+    h = History(idle.db_path)
+    assert h.n_populations == 1 and h.max_t == 3
+    h.close()
+    h = History(busy.db_path)
+    assert h.n_populations == 4  # RUNNING: writer owns the file
+    h.close()
+    assert life.generations_gced_total == 3
+    assert any(e["kind"] == "generations_gced" for e in idle.events)
+
+
+def test_sweep_byte_quota_sheds_to_newest_generation_floor(tmp_path):
+    clock = VirtualClock()
+    life = LifecycleManager(
+        quota=TenantQuota(max_bytes_on_disk=1),  # impossible: shed all
+        clock=clock)
+    t = FakeTenant(tmp_path, "fat", state="completed", gens=5)
+    life.sweep([t])
+    h = History(t.db_path)
+    # the newest generation is the floor — never GC'd below it
+    assert h.n_populations == 1 and h.max_t == 4
+    h.close()
+
+
+def test_sweep_ttl_disposes_terminal_after_deadline(tmp_path):
+    clock = VirtualClock()
+    life = LifecycleManager(policy=RetentionPolicy(ttl_s=100.0),
+                            clock=clock)
+    t = FakeTenant(tmp_path, "old", state="completed",
+                   finished_at=clock.now())
+    sql_path, _, _ = archive_paths(t.db_path)
+    clock.advance(99.0)
+    assert life.sweep([t])["disposed"] == []
+    assert sql_path.is_file()
+    clock.advance(2.0)
+    assert life.sweep([t])["disposed"] == ["old"]
+    assert t.disposed and not sql_path.exists()
+    # disposed tenants are terminal for the sweep: never re-disposed
+    assert life.sweep([t])["disposed"] == []
+
+
+def test_sweep_fleet_budget_disposes_oldest_finished(tmp_path):
+    clock = VirtualClock()
+    life = LifecycleManager(
+        policy=RetentionPolicy(total_bytes_budget=1), clock=clock)
+    older = FakeTenant(tmp_path, "older", state="completed",
+                       finished_at=1.0)
+    newer = FakeTenant(tmp_path, "newer", state="completed",
+                       finished_at=2.0)
+    live = FakeTenant(tmp_path, "live", state="running",
+                      finished_at=None)
+    res = life.sweep([newer, older, live])
+    # oldest-finished first; the RUNNING tenant is untouchable
+    assert res["disposed"][0] == "older"
+    assert "live" not in res["disposed"]
+    assert archive_paths(live.db_path)[0].is_file()
+
+
+def test_dispose_archives_terminal_when_policy_asks(tmp_path):
+    clock = VirtualClock()
+    life = LifecycleManager(
+        policy=RetentionPolicy(archive_on_complete=True), clock=clock)
+    t = FakeTenant(tmp_path, "keepsake", state="completed")
+    Path(t.checkpoint_path).write_bytes(b"ck")
+    freed = life.dispose(t)
+    sql_path, _, archive = archive_paths(t.db_path)
+    assert archive.is_file() and not sql_path.exists()
+    assert not os.path.exists(t.checkpoint_path)
+    assert t.disposed and life.archives_total == 1
+    assert isinstance(freed, int)
+    # restorable: the archive is a real backup, not a tombstone
+    restore_tenant_db(t.db_path)
+    assert _distributions(t.db_path)
+
+
+def test_gc_skips_never_started_tenant(tmp_path):
+    clock = VirtualClock()
+    life = LifecycleManager(policy=RetentionPolicy(keep_last_k=1),
+                            clock=clock)
+    ghost = FakeTenant(tmp_path, "ghost", state="queued", gens=0)
+    assert life.sweep([ghost])["pruned"] == 0
+    # CRITICAL: GC must not CREATE a db for a tenant that never ran
+    assert not archive_paths(ghost.db_path)[0].exists()
+
+
+def test_disk_usage_counts_db_and_columnar(tmp_path, store_scheme):
+    db = f"{store_scheme}:///{tmp_path}/t.db"
+    _make_history(db, gens=2)
+    usage = disk_usage(db)
+    assert usage["db"] > 0
+    if "columnar" in store_scheme:
+        assert usage["columnar"] > 0
+    assert usage["total"] == (usage["db"] + usage["columnar"]
+                              + usage["archive"])
+
+
+def test_archive_gating_without_pyarrow_row_store_roundtrips(tmp_path):
+    """The archive path never imports pyarrow for a row-store tenant —
+    proven under the PYABC_TPU_BLOCK_PYARROW CI leg by this test running
+    there (tar + sqlite only)."""
+    db = f"sqlite:///{tmp_path}/t.db"
+    _make_history(db, gens=2)
+    archive_tenant_db(db)
+    restore_tenant_db(db)
+    assert len(_distributions(db)) == 2
+
+
+@pytest.mark.skipif(not has_pyarrow(), reason="needs pyarrow")
+def test_lifecycle_manager_bytes_on_disk_gauges_tenant_registry(tmp_path):
+    clock = VirtualClock()
+    life = LifecycleManager(clock=clock)
+    t = FakeTenant(tmp_path, "gauged", scheme="sqlite+columnar")
+    total = life.bytes_on_disk(t)
+    assert total > 0 and t.bytes_on_disk == total

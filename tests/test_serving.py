@@ -982,6 +982,190 @@ def test_auto_preemption_frees_capacity_for_starved_queue(
     assert_history_parity(big.db_path, ref, int(h_ref.n_populations))
 
 
+# ============================================ lifecycle + streaming (r19)
+def test_terminal_tenant_eviction_gcs_disk(make_scheduler, store_scheme):
+    """Satellite bugfix (round 19): evicting a terminal tenant record
+    must also delete its History db (and columnar Parquet files, and
+    the checkpoint) — the pre-round-19 eviction dropped the in-memory
+    record and leaked the disk forever. Parameterized over both store
+    backends so the Parquet sidecar directory is covered too."""
+    import pathlib
+
+    from pyabc_tpu.serving.lifecycle import disk_usage
+
+    store = "columnar" if "columnar" in store_scheme else "rows"
+    sched = make_scheduler(n_slots=1, max_queued=8,
+                           max_terminal_tenants=1)
+    tenants = [
+        sched.submit(spec_for(seed=711 + i, gens=2, pop=60, store=store),
+                     tenant_id=f"tenant-gcdisk{i}")
+        for i in range(3)
+    ]
+    wait_terminal(tenants)
+    for t in tenants:
+        assert t.state == COMPLETED, (t.id, t.state, t.error)
+    # cap 1: the two oldest terminal records were evicted ...
+    assert sched.get("tenant-gcdisk0") is None
+    assert sched.get("tenant-gcdisk1") is None
+    assert sched.get("tenant-gcdisk2") is not None
+    # ... and their disk followed them out: db, -wal, Parquet, checkpoint
+    for t in tenants[:2]:
+        assert t.disposed
+        assert disk_usage(t.db_path)["total"] == 0
+        assert not os.path.exists(t.checkpoint_path)
+    assert disk_usage(tenants[2].db_path)["total"] > 0
+    base = pathlib.Path(sched.base_dir)
+    owners = {p.name.split(".")[0] for p in base.iterdir()}
+    assert "tenant-gcdisk0" not in owners
+    assert "tenant-gcdisk1" not in owners
+    assert sched.lifecycle.stats()["tenants_disposed_total"] >= 2
+
+
+def test_eviction_defers_while_stale_attempt_thread_alive(make_scheduler):
+    """Disposal must NOT race a still-unwinding attempt thread: a reaped
+    or cancelled tenant's thread stops only at its next chunk boundary,
+    and a History write checking out a fresh sqlite connection AFTER the
+    unlink recreates the db as an orphan file (observed in the round-19
+    traffic lane). Eviction therefore defers while ``tenant.thread`` is
+    alive and the pump retries once the thread exits."""
+    from pyabc_tpu.serving.lifecycle import disk_usage
+
+    sched = make_scheduler(n_slots=1, max_terminal_tenants=1)
+    a = sched.submit(spec_for(seed=741, gens=2, pop=60),
+                     tenant_id="tenant-defer0")
+    wait_terminal([a])
+    assert a.state == COMPLETED, (a.state, a.error)
+    # stand in for a stale attempt still unwinding (the real thread has
+    # exited; eviction only looks at liveness)
+    release = threading.Event()
+    th = threading.Thread(target=release.wait, daemon=True)
+    th.start()
+    a.thread = th
+    try:
+        b = sched.submit(spec_for(seed=742, gens=2, pop=60),
+                         tenant_id="tenant-defer1")
+        wait_terminal([b])
+        # b's finish overflowed the cap-1 ring, but a's "attempt" is
+        # alive: eviction defers — record kept, files untouched
+        time.sleep(0.5)
+        assert sched.get("tenant-defer0") is not None
+        assert not a.disposed
+        assert disk_usage(a.db_path)["total"] > 0
+    finally:
+        release.set()
+    th.join(timeout=10)
+    # thread gone -> the pump's retry disposes on a later tick
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 10:
+        if sched.get("tenant-defer0") is None:
+            break
+        time.sleep(0.05)
+    assert sched.get("tenant-defer0") is None
+    assert a.disposed
+    assert disk_usage(a.db_path)["total"] == 0
+
+
+def test_stream_posterior_live_parity_both_stores(make_scheduler,
+                                                  store_scheme):
+    """Tentpole (round 19): the live posterior stream — Arrow IPC when
+    pyarrow is present, NDJSON summary lines otherwise — reconstructs
+    the epsilon trail + per-generation posterior means BIT-identical to
+    a post-hoc History read, on both store backends. The client opens
+    the stream while the run is LIVE; the server pushes each generation
+    as it lands and ends the stream at the terminal state."""
+    from pyabc_tpu.serving.streaming import (
+        generation_summaries,
+        parse_summary_lines,
+        stream_posterior,
+    )
+    from pyabc_tpu.storage.columnar import has_pyarrow
+
+    store = "columnar" if "columnar" in store_scheme else "rows"
+    sched = make_scheduler(n_slots=1)
+    httpd = serve_api(sched, port=0, block=False)
+    port = httpd.server_port
+    try:
+        t = sched.submit(spec_for(seed=721, gens=4, store=store),
+                         tenant_id="tenant-stream")
+        # consume LIVE: blocks following the run, ends at terminal
+        fmt, streamed = stream_posterior("127.0.0.1", port,
+                                         "tenant-stream", timeout_s=240)
+        wait_terminal([t])
+        assert t.state == COMPLETED, (t.state, t.error)
+        posthoc = generation_summaries(t.db_path)
+        assert [s["t"] for s in posthoc] == list(range(4))
+        assert streamed == posthoc  # float64 survives the wire exactly
+        assert fmt == ("arrow" if has_pyarrow() else "ndjson")
+        # the explicit NDJSON fallback a pyarrow-less CLIENT requests
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/tenant/tenant-stream"
+                "/stream?format=summaries", timeout=60) as r:
+            assert r.headers["Content-Type"].startswith(
+                "application/x-ndjson")
+            lines = [ln for ln in r.read().decode().splitlines()
+                     if ln.strip()]
+        assert parse_summary_lines(lines) == posthoc
+    finally:
+        httpd.shutdown()
+
+
+def test_requeue_resume_survives_retention_gc(make_scheduler, tmp_path):
+    """Lifecycle safety (round 19): retention GC never deletes what a
+    resume needs. A keep-last-2 sweep runs every 0.1 s around a tenant
+    killed once mid-run (after 2 chunks = 4 persisted generations, so
+    the sweep has prunable history before the resume); the requeued
+    attempt adopts the checkpoint, completes, and every generation the
+    pruned History still holds is bit-identical to a solo reference."""
+    from pyabc_tpu.serving.lifecycle import RetentionPolicy
+
+    sched = make_scheduler(n_slots=1, max_requeues=1,
+                           retention=RetentionPolicy(keep_last_k=2),
+                           lifecycle_sweep_s=0.1)
+    install_fault_plan(FaultPlan([
+        FaultRule(site="orchestrator.chunk", kind="kill", after=2,
+                  max_fires=1, match="victim"),
+    ]))
+    victim = sched.submit(spec_for(seed=731, gens=8),
+                          tenant_id="tenant-gc-victim")
+    wait_terminal([victim])
+    uninstall_fault_plan()
+    assert victim.state == COMPLETED, (victim.state, victim.error)
+    assert victim.requeues == 1 and victim.attempt == 2
+    # the post-terminal sweep prunes the idle db down to keep_last_k
+    t0 = time.monotonic()
+    n = -1
+    while time.monotonic() - t0 < 30:
+        h = History(victim.db_path)
+        n = int(h.n_populations)
+        h.close()
+        if n <= 2:
+            break
+        time.sleep(0.1)
+    assert n == 2, n
+    assert sched.lifecycle.stats()["generations_gced_total"] > 0
+    # surviving generations bit-identical to the solo reference's tail
+    ref = f"sqlite:///{tmp_path}/ref_gcresume.db"
+    solo_reference(731, ref, gens=8)
+    h, href = History(victim.db_path), History(ref)
+    try:
+        pops = h.get_all_populations().query("t >= 0")
+        ref_pops = href.get_all_populations().query("t >= 0")
+        ref_eps = {int(r["t"]): float(r["epsilon"])
+                   for _, r in ref_pops.iterrows()}
+        assert sorted(int(r["t"]) for _, r in pops.iterrows()) == [6, 7]
+        for _, row in pops.iterrows():
+            t = int(row["t"])
+            assert float(row["epsilon"]) == ref_eps[t]
+            df_a, w_a = h.get_distribution(0, t)
+            df_b, w_b = href.get_distribution(0, t)
+            assert np.array_equal(np.sort(df_a["theta"].to_numpy()),
+                                  np.sort(df_b["theta"].to_numpy())), t
+            assert np.array_equal(np.sort(w_a), np.sort(w_b)), t
+    finally:
+        h.close()
+        href.close()
+
+
 # ======================================================== fairness sanity
 def test_slots_rotate_through_queue_no_starvation(make_scheduler):
     """More tenants than slots: every tenant eventually runs and
