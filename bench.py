@@ -1193,9 +1193,265 @@ def run_scenario_lane(budget_s: float, platform: str = "cpu") -> dict:
                 msel.observed_ode_family(seed=0, segments=4),
                 192 if cpu else 8192, 3)
 
+    # adaptive-distance early-reject leg (ISSUE 17): the moment-based
+    # refit over ALL resolved lanes is a different (unbiased) estimator
+    # than the classic survivor ring, so the contract is posterior
+    # parity + actually-retired work, not bit-identity
+    if CLOCK.now() - t_lane0 < budget_s * 0.97:
+        try:
+            from pyabc_tpu.distance.scale import standard_deviation
+
+            ad_pop, ad_gens = 128, 5
+            ad = {}
+            for early in ("auto", False):
+                abc_a = pt.ABCSMC(
+                    g.make_birth_death_model(segments=segments),
+                    g.birth_death_prior(),
+                    pt.AdaptivePNormDistance(
+                        p=2, scale_function=standard_deviation),
+                    population_size=ad_pop, eps=pt.MedianEpsilon(),
+                    seed=17, early_reject=early, fused_generations=2,
+                    tracer=TRACER,
+                )
+                abc_a.new("sqlite://", obs)
+                h_a = abc_a.run(max_nr_populations=ad_gens)
+                df, w = h_a.get_distribution(m=0, t=h_a.max_t)
+                ad[early] = {
+                    "mu": np.asarray([
+                        float(np.average(df[c], weights=w))
+                        for c in ("log_b", "log_d")
+                    ]),
+                    "retired": int(sum(
+                        (h_a.get_telemetry(t) or {}).get(
+                            "retired_early", 0)
+                        for t in range(h_a.max_t + 1))),
+                }
+            ad_err = float(np.max(np.abs(ad["auto"]["mu"]
+                                         - ad[False]["mu"])))
+            out["adaptive"] = {
+                "posterior_mean_err": round(ad_err, 3),
+                "parity_ok": bool(ad_err < 0.5),
+                "retired_early_total": ad["auto"]["retired"],
+                "pop_size": ad_pop, "generations": ad_gens,
+            }
+        except Exception as e:
+            out["adaptive"] = {"error": repr(e)[:300]}
+
     out["lane_s"] = round(CLOCK.now() - t_lane0, 2)
     out["value"] = out.get("pps_late_on", out["pps_on"])
     return out
+
+
+def _scenario_sharded_child() -> dict:
+    """The sharded scenario leg's measured body (ISSUE 17) — runs in a
+    forced-8-device subprocess with the sync budget strict. The same
+    Gillespie birth-death ON/OFF contrast as the parent lane, but on
+    the COMPOSED sharded+segmented kernel: each of the 8 mesh devices
+    runs its own shard-local retire/refill sweep.
+
+    Measurement shape: the parent lane's per-chunk-wall window does
+    NOT survive the engine's pipelining here — chunks are dispatched
+    ahead on the device-resident carry chain, so a late chunk's fetch
+    wait can read ~0 while its compute hid inside an earlier chunk's
+    wait (observed: 0.002 s "walls" on chunks doing tens of seconds of
+    simulation). The late window is instead isolated by SEED-MATCHED
+    PREFIX SUBTRACTION on fully drained runs: per mode, one cold run
+    compiles, then a warm run to ``gens - 3`` and a warm run to
+    ``gens`` (context-adopted, zero compile) — identical seeds make
+    the prefixes identical work, so ``wall(full) - wall(prefix)`` is
+    exactly the last-3-generation wall, pipelining included. Guards:
+
+    - ``parity_ok``: ON/OFF accepted populations bit-identical;
+    - ``speedup_ok``: late-window accepted-pps ON >= 1.5x OFF (the CPU
+      proxy of the >=2x real-TPU target — 8 virtual devices timeshare
+      one core, so the retire win competes with collective overhead a
+      real chip does not pay), armed only when the window's acceptance
+      actually reached the late regime;
+    - ``sync_ok``: the strict per-run sync budget holds — the per-shard
+      early-reject columns ride the packed fetch;
+    - per-shard accounting: retired_per_shard in telemetry, the retire
+      imbalance in the engine's mesh snapshot.
+    """
+    import jax
+    import numpy as np
+
+    import pyabc_tpu as pt
+    from pyabc_tpu.models import gillespie as g
+    from pyabc_tpu.observability import SYSTEM_CLOCK
+    from pyabc_tpu.parallel.distributed import local_mesh
+    from pyabc_tpu.utils.bench_defaults import (
+        DEFAULT_SCENARIO_SEGS,
+        DEFAULT_SCENARIO_SHARDED_BUDGET_S,
+        DEFAULT_SCENARIO_SHARDED_GENS,
+        DEFAULT_SCENARIO_SHARDED_POP,
+        SCENARIO_LATE_ACC,
+        SCENARIO_SHARDED_SPEEDUP_MIN_X,
+    )
+
+    clock = SYSTEM_CLOCK
+    t0 = clock.now()
+    budget = float(os.environ.get(
+        "PYABC_TPU_BENCH_SCENARIO_SHARDED_BUDGET_S",
+        DEFAULT_SCENARIO_SHARDED_BUDGET_S))
+    pop = int(os.environ.get("PYABC_TPU_BENCH_SCENARIO_SHARDED_POP",
+                             DEFAULT_SCENARIO_SHARDED_POP))
+    gens = int(os.environ.get("PYABC_TPU_BENCH_SCENARIO_SHARDED_GENS",
+                              DEFAULT_SCENARIO_SHARDED_GENS))
+    segments = int(os.environ.get("PYABC_TPU_BENCH_SCENARIO_SEGS",
+                                  DEFAULT_SCENARIO_SEGS))
+    late_acc = float(os.environ.get(
+        "PYABC_TPU_BENCH_SCENARIO_LATE_ACC", SCENARIO_LATE_ACC))
+    G = 2
+    late_gens = 3
+    prefix = gens - late_gens
+    devs = jax.devices()
+    out = {"metric": "accepted_particles_per_sec_gillespie_sharded_"
+                     "early_reject",
+           "n_devices": len(devs), "platform": devs[0].platform,
+           "pop_size": pop, "segments": segments, "generations": gens,
+           "late_gens": late_gens}
+    if len(devs) < 2:
+        out["skipped"] = (f"{len(devs)} device(s): the sharded leg "
+                          f"needs a multi-device platform")
+        return out
+
+    obs = g.observed_birth_death(segments=segments)
+
+    def build(early, seed=7):
+        abc = pt.ABCSMC(
+            g.make_birth_death_model(segments=segments),
+            g.birth_death_prior(), pt.PNormDistance(p=2),
+            population_size=pop, eps=pt.MedianEpsilon(), seed=seed,
+            early_reject=early, fused_generations=G,
+            mesh=local_mesh(),
+        )
+        abc.new("sqlite://", obs)
+        return abc
+
+    runs = {}
+    for early in ("auto", False):
+        # cold run: pays the compile, short — its wall is not compared
+        abc_c = build(early)
+        t_r = clock.now()
+        abc_c.run(max_nr_populations=G)
+        cold_s = clock.now() - t_r
+        legs = {}
+        for label, n_pops in (("prefix", prefix), ("full", gens)):
+            abc_w = build(early)
+            abc_w.adopt_device_context(abc_c)
+            t_r = clock.now()
+            h_w = abc_w.run(max_nr_populations=n_pops,
+                            max_walltime=budget * 0.3)
+            legs[label] = {"abc": abc_w, "h": h_w,
+                           "run_s": clock.now() - t_r}
+        runs[early] = {"cold_s": cold_s, **legs}
+
+    h_on = runs["auto"]["full"]["h"]
+    h_off = runs[False]["full"]["h"]
+    gens_done = min(h_on.max_t, h_off.max_t) + 1
+    if gens_done < gens or runs["auto"]["prefix"]["h"].max_t + 1 < prefix:
+        out["error"] = (f"walltime cut the runs short "
+                        f"({gens_done}/{gens} generations) — late-window "
+                        f"subtraction needs fully drained runs; raise "
+                        f"the leg budget or lower the pop")
+        return out
+    parity = True
+    for t in range(gens_done):
+        d1, w1 = h_on.get_distribution(m=0, t=t)
+        d2, w2 = h_off.get_distribution(m=0, t=t)
+        parity &= (np.array_equal(np.asarray(d1), np.asarray(d2))
+                   and np.array_equal(w1, w2))
+    out["parity_ok"] = bool(parity)
+
+    # acceptance actually reached the late regime inside the window?
+    acc_window = [
+        (h_off.get_telemetry(t) or {}).get("acceptance_rate")
+        for t in range(prefix, gens)
+    ]
+    out["late_window_acceptance"] = [
+        round(a, 4) if a is not None else None for a in acc_window
+    ]
+    late_reached = any(a is not None and a <= late_acc
+                       for a in acc_window)
+    for early, label in (("auto", "on"), (False, "off")):
+        r = runs[early]
+        late_wall = r["full"]["run_s"] - r["prefix"]["run_s"]
+        out[f"run_s_{label}"] = round(r["full"]["run_s"], 2)
+        out[f"prefix_s_{label}"] = round(r["prefix"]["run_s"], 2)
+        out[f"cold_s_{label}"] = round(r["cold_s"], 2)
+        out[f"late_wall_s_{label}"] = round(late_wall, 2)
+        out[f"pps_{label}"] = round(
+            pop * gens / max(r["full"]["run_s"], 1e-9), 1)
+        out[f"pps_late_{label}"] = round(
+            pop * late_gens / max(late_wall, 1e-9), 1)
+    out["speedup_run_x"] = round(
+        out["pps_on"] / max(out["pps_off"], 1e-9), 2)
+    out["speedup_late_x"] = round(
+        out["pps_late_on"] / max(out["pps_late_off"], 1e-9), 2)
+    out["late_regime_reached"] = bool(late_reached)
+    out["speedup_ok"] = (bool(
+        out["speedup_late_x"] >= SCENARIO_SHARDED_SPEEDUP_MIN_X)
+        if late_reached else None)
+
+    eng = runs["auto"]["full"]["abc"]._engine
+    rep = eng.sync_budget_report() if eng is not None else {}
+    out["sync_ok"] = bool(rep.get("ok", False))
+    out["syncs_per_run"] = int(rep.get("syncs", -1))
+    # per-shard early-reject accounting (packed fetch, zero extra syncs)
+    retired = 0
+    per_shard = None
+    for t in range(h_on.max_t + 1):
+        tel = h_on.get_telemetry(t) or {}
+        retired += tel.get("retired_early", 0)
+        if tel.get("retired_per_shard"):
+            per_shard = tel["retired_per_shard"]
+    out["lanes_retired_early_total"] = int(retired)
+    out["retired_per_shard_last"] = per_shard
+    mesh_block = (eng.snapshot().get("mesh") or {}) if eng else {}
+    out["retire_imbalance"] = mesh_block.get("retire_imbalance")
+    out["retired_per_device"] = mesh_block.get("retired_per_device")
+    out["lane_s"] = round(clock.now() - t0, 2)
+    out["value"] = out.get("pps_late_on", out.get("pps_on", 0.0))
+    return out
+
+
+def run_scenario_sharded_leg(budget_s: float,
+                             platform: str = "cpu") -> dict:
+    """Run the sharded scenario leg in a subprocess (forced 8 virtual
+    CPU devices without an accelerator, strict sync budget armed) —
+    the mesh-lane contract: a hung child never eats the bench budget.
+    The floor is real: the inline lane's deep runs overshoot their
+    max_walltime at chunk granularity (a late chunk can run minutes),
+    so "what's left of the lane budget" is routinely near zero — a
+    60s leg would always time out and record nothing."""
+    budget_s = max(float(budget_s), 300.0)
+    env = dict(os.environ)
+    env["PYABC_TPU_BENCH_SCENARIO_SHARDED_CHILD"] = "1"
+    env["PYABC_TPU_BENCH_SCENARIO_SHARDED_BUDGET_S"] = str(
+        budget_s * 0.9)
+    env["PYABC_TPU_SYNC_BUDGET_STRICT"] = "1"
+    if platform == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(HERE, "bench.py")],
+            env=env, capture_output=True, text=True, timeout=budget_s,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"sharded scenario child timed out after "
+                         f"{budget_s}s"}
+    for line in reversed(proc.stdout.strip().splitlines() or [""]):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    return {"error": f"sharded scenario child rc={proc.returncode}: "
+                     f"{(proc.stderr or '')[-400:]}"}
 
 
 # -- dispatch lane ------------------------------------------------------------
@@ -2403,11 +2659,22 @@ def main():
         if scenario_skip:
             _state["scenario"] = {"skipped": scenario_skip}
         else:
+            # the inline lane takes ~55% of the budget; the sharded
+            # subprocess leg (ISSUE 17: forced-8-device composed
+            # sharded+segmented kernel) gets the rest
+            inline_budget = (budget - max(10.0, 0.05 * budget)) * 0.55
             try:
                 _state["scenario"] = run_scenario_lane(
-                    budget - max(10.0, 0.05 * budget), platform)
+                    inline_budget, platform)
             except Exception as e:
                 _state["scenario"] = {"error": repr(e)[:300]}
+            sharded_budget = budget - (CLOCK.now() - t_start) \
+                - max(10.0, 0.05 * budget)
+            try:
+                _state["scenario"]["sharded"] = run_scenario_sharded_leg(
+                    sharded_budget, platform)
+            except Exception as e:
+                _state["scenario"]["sharded"] = {"error": repr(e)[:300]}
         _state["value"] = float(_state["scenario"].get("value") or 0.0)
         _state["util"] = _state["scenario"].get("util", {})
         _state["partial"] = False
@@ -3039,6 +3306,12 @@ if __name__ == "__main__":
         # ONE JSON line
         _emitted = True
         print(json.dumps(_mesh_lane_child()))
+        sys.exit(0)
+    if os.environ.get("PYABC_TPU_BENCH_SCENARIO_SHARDED_CHILD"):
+        # sharded scenario leg subprocess: same contract as the mesh
+        # child (forced 8 devices + strict sync budget in the env)
+        _emitted = True
+        print(json.dumps(_scenario_sharded_child()))
         sys.exit(0)
     if os.environ.get("PYABC_TPU_BENCH_SERVE_CHILD"):
         # serve-lane subprocess: same contract as the mesh child

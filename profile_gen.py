@@ -161,7 +161,7 @@ def build_scenario(model_name: str, segments: int | None):
 def main(pop: int = 1000, transition: str = "mvn", generations: int = 3,
          k_fraction: float = 0.25, refit_every: int | None = None,
          model_name: str = "lv", segments: int | None = None,
-         early_reject: str = "auto"):
+         early_reject: str = "auto", sharded: int | None = None):
     import jax
 
     import pyabc_tpu as pt
@@ -175,6 +175,7 @@ def main(pop: int = 1000, transition: str = "mvn", generations: int = 3,
         population_size=pop, eps=pt.MedianEpsilon(), seed=0,
         early_reject={"auto": "auto", "on": True,
                       "off": False}[early_reject],
+        **({"sharded": sharded} if sharded else {}),
         **({"transitions": trans} if trans is not None else {}),
         **({"refit_every": refit_every} if refit_every is not None else {}),
     )
@@ -238,6 +239,11 @@ if __name__ == "__main__":
     ap.add_argument("--early-reject", choices=("auto", "on", "off"),
                     default="auto",
                     help="segmented early-reject mode for the SMC run")
+    ap.add_argument("--sharded", type=int, default=None,
+                    help="shard count for the sharded fused kernel "
+                         "(virtual shards on one device, or a mesh "
+                         "width that divides it); composes with "
+                         "--segments --early-reject (ISSUE 17)")
     ap.add_argument("--transition", choices=("mvn", "local"), default="mvn")
     ap.add_argument("--generations", type=int, default=3)
     ap.add_argument("--k-fraction", type=float, default=0.25)
@@ -258,4 +264,5 @@ if __name__ == "__main__":
         main(pop=args.pop, transition=args.transition,
              generations=args.generations, k_fraction=args.k_fraction,
              refit_every=args.refit_every, model_name=args.model,
-             segments=args.segments, early_reject=args.early_reject)
+             segments=args.segments, early_reject=args.early_reject,
+             sharded=args.sharded)

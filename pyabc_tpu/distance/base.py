@@ -80,6 +80,16 @@ class Distance(ABC):
         Monotonicity requirement: folding more entries must never
         decrease the implied bound — p-norms with non-negative weights
         qualify; signed/normalized forms do not.
+
+        Stochastic kernels flip the direction: a closure dict carrying
+        ``"upper": True`` is a monotone UPPER bound on the total
+        LOG-DENSITY, and its ``exceeds(acc, thr, params)`` is True only
+        when acceptance at the per-lane log-density threshold ``thr`` is
+        provably impossible (``acc < thr`` minus slack). The engine
+        computes ``thr`` from the lane's pre-committed acceptance draw —
+        upper-bound closures are only sound under a
+        :class:`~pyabc_tpu.acceptor.StochasticAcceptor` and the
+        segmented engine refuses them elsewhere.
         """
         return None
 

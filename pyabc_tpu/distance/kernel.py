@@ -28,6 +28,23 @@ SCALE_LOG = "SCALE_LOG"
 
 _LOG_2PI = math.log(2.0 * math.pi)
 
+#: absolute slack (log-density units) on the stochastic retirement
+#: comparison: the engine's running prefix sum and the full-vector
+#: ``jnp.sum`` may round in different orders, so a bound within this
+#: band of the lane's log-density threshold never retires. A false keep
+#: wastes segments; a false retire would be unsound. The relative term
+#: covers large-magnitude log-densities (1e-4 is ~200x the f32
+#: summation error of a 10^4-entry sum).
+BOUND_SLACK = 1e-3
+
+
+def _upper_exceeds(acc, threshold, params):
+    """Retirement test of an UPPER log-density bound: True only when the
+    final log-density is provably BELOW ``threshold`` — i.e. acceptance
+    at that per-lane log-density threshold is impossible."""
+    slack = BOUND_SLACK + 1e-4 * jnp.abs(acc)
+    return acc < threshold - slack
+
 
 class StochasticKernel(Distance):
     """Base stochastic kernel (pyabc StochasticKernel).
@@ -178,6 +195,32 @@ class IndependentNormalKernel(StochasticKernel):
             return -0.5 * jnp.sum(_LOG_2PI + jnp.log(var) + diff * diff / var)
         return fn
 
+    def device_bound_fn(self, spec):
+        """Monotone UPPER-bound accumulator on the total log-density over
+        sum-stat prefixes (the stochastic-acceptor retirement contract).
+
+        Each element's log-density is maximized at zero deviation
+        (``-0.5 (log 2π + log var_i)``), so the accumulator starts at
+        ``pdf_max`` (the sum of per-element maxima) and every emitted
+        entry subtracts its actual deficit ``0.5 diff²/var`` — the bound
+        is non-increasing as entries fold in and always ≥ the final
+        log-density. ``exceeds`` fires only when even this optimistic
+        bound sits below the lane's acceptance threshold."""
+        if callable(self.var) or self.pdf_max is None:
+            return None
+        pdf_max = float(self.pdf_max)
+
+        def init():
+            return jnp.asarray(pdf_max, jnp.float32)
+
+        def step(acc, vals, idx, x0, params):
+            var = jnp.broadcast_to(params, x0.shape)[idx]
+            diff = vals - x0[idx]
+            return acc - 0.5 * jnp.sum(diff * diff / var)
+
+        return {"init": init, "step": step, "exceeds": _upper_exceeds,
+                "upper": True}
+
 
 class IndependentLaplaceKernel(StochasticKernel):
     """Independent Laplace noise per statistic (pyabc IndependentLaplaceKernel)."""
@@ -214,6 +257,25 @@ class IndependentLaplaceKernel(StochasticKernel):
             b = jnp.broadcast_to(params, diff.shape)
             return -jnp.sum(jnp.log(2.0 * b) + jnp.abs(diff) / b)
         return fn
+
+    def device_bound_fn(self, spec):
+        """Monotone UPPER-bound accumulator on the total log-density:
+        start at ``pdf_max`` (per-element maxima ``-log 2b_i``), subtract
+        each emitted entry's deficit ``|diff|/b`` — non-increasing, and
+        always ≥ the final log-density."""
+        if callable(self.scale) or self.pdf_max is None:
+            return None
+        pdf_max = float(self.pdf_max)
+
+        def init():
+            return jnp.asarray(pdf_max, jnp.float32)
+
+        def step(acc, vals, idx, x0, params):
+            b = jnp.broadcast_to(params, x0.shape)[idx]
+            return acc - jnp.sum(jnp.abs(vals - x0[idx]) / b)
+
+        return {"init": init, "step": step, "exceeds": _upper_exceeds,
+                "upper": True}
 
 
 def _binom_logpmf(k, n, p):
@@ -262,6 +324,28 @@ class BinomialKernel(StochasticKernel):
 
         return fn
 
+    def device_bound_fn(self, spec):
+        """Monotone UPPER bound: every per-element log-pmf is ≤ 0 (a pmf
+        never exceeds 1), so the prefix partial sum of ACTUAL per-entry
+        log-pmfs upper-bounds the total — init 0, fold the real terms.
+        SCALE_LOG only (the lin path's 1e-30 density clamp happens after
+        the sum and is not prefix-separable)."""
+        if self.ret_scale != SCALE_LOG:
+            return None
+
+        def init():
+            return jnp.zeros((), jnp.float32)
+
+        def step(acc, vals, idx, x0, p):
+            n = jnp.maximum(jnp.round(vals), 0.0)
+            k = jnp.round(x0[idx])
+            logp = _binom_logpmf(k, n, p)
+            logp = jnp.where((k >= 0) & (k <= n), logp, -jnp.inf)
+            return acc + jnp.sum(logp)
+
+        return {"init": init, "step": step, "exceeds": _upper_exceeds,
+                "upper": True}
+
 
 class PoissonKernel(StochasticKernel):
     """Poisson observation noise: x_0 ~ Poisson(sim) (pyabc PoissonKernel)."""
@@ -295,6 +379,26 @@ class PoissonKernel(StochasticKernel):
             return jnp.exp(total) if lin else total
 
         return fn
+
+    def device_bound_fn(self, spec):
+        """Monotone UPPER bound via the pmf ≤ 1 argument — see
+        :meth:`BinomialKernel.device_bound_fn`."""
+        if self.ret_scale != SCALE_LOG:
+            return None
+
+        def init():
+            return jnp.zeros((), jnp.float32)
+
+        def step(acc, vals, idx, x0, params):
+            lam = jnp.maximum(vals, 1e-12)
+            k = jnp.round(x0[idx])
+            logp = (k * jnp.log(lam) - lam
+                    - jax.scipy.special.gammaln(k + 1.0))
+            logp = jnp.where(k >= 0, logp, -jnp.inf)
+            return acc + jnp.sum(logp)
+
+        return {"init": init, "step": step, "exceeds": _upper_exceeds,
+                "upper": True}
 
 
 class NegativeBinomialKernel(StochasticKernel):

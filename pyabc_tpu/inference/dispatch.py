@@ -67,6 +67,7 @@ from ..observability.metrics import (
     MESH_IMBALANCE_GAUGE,
     MESH_ROW_COLLECTIVES_TOTAL,
     MESH_SCALE_BYTES_GAUGE,
+    SIM_RETIRE_IMBALANCE_GAUGE,
     SPECULATIVE_ROLLBACKS_TOTAL,
     SYNCS_PER_RUN_GAUGE,
 )
@@ -255,6 +256,11 @@ class DispatchEngine:
             "speculative_rollbacks": int(self.speculative_rollbacks),
             "sync_budget": self.sync_budget_report(),
         }
+        fallbacks = getattr(self.owner, "_capability_fallbacks", None)
+        if fallbacks:
+            # why this run is NOT on a requested fast path — the reason
+            # strings, not just a counter (/api/observability reads this)
+            snap["capability_fallbacks"] = [dict(f) for f in fallbacks]
         if self.mesh_shards:
             snap["mesh"] = {
                 "devices": int(self.mesh_shards),
@@ -299,6 +305,19 @@ class DispatchEngine:
             "imbalance": round(imbalance, 4),
             "busy_max_frac": round(busy_max, 4),
         }
+        # composed sharded+segmented chunks (ISSUE 17) ship per-shard
+        # retire columns on the same packed fetch: retire imbalance =
+        # how unevenly the early-reject bound fired across lane blocks
+        retire_imb = None
+        if "retired_shard" in fetched:
+            per_dev_ret = np.asarray(
+                fetched["retired_shard"])[:g_done].sum(axis=0).astype(float)
+            rmean = float(per_dev_ret.mean())
+            retire_imb = (float(per_dev_ret.max()) / rmean
+                          if rmean > 0 else 1.0)
+            self._mesh_stats["retired_per_device"] = [
+                int(r) for r in per_dev_ret]
+            self._mesh_stats["retire_imbalance"] = round(retire_imb, 4)
         from ..observability import global_metrics
 
         for reg in (self.owner.metrics, global_metrics()):
@@ -327,6 +346,12 @@ class DispatchEngine:
                 "busiest shard's share of mesh proposal rounds in the "
                 "last chunk",
             ).set(busy_max)
+            if retire_imb is not None:
+                reg.gauge(
+                    SIM_RETIRE_IMBALANCE_GAUGE,
+                    "per-shard early-reject imbalance of the last chunk "
+                    "(max/mean of lanes retired; 1.0 = evenly spread)",
+                ).set(retire_imb)
 
     def sync_budget_report(self) -> dict:
         """The per-run sync budget, asserted through the SyncLedger:

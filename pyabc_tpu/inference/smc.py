@@ -413,6 +413,14 @@ class ABCSMC:
         #: this class reads the tracer's injected clock (monotonic).
         self.tracer = tracer if tracer is not None else default_tracer()
         self.metrics = metrics if metrics is not None else NULL_METRICS
+        #: capability-gate fallbacks of this run: {"gate", "reason"}
+        #: dicts recorded whenever a requested fast path silently fell
+        #: back (sharded kernel, segmented early reject, ...). Surfaced
+        #: through History telemetry, the dispatch snapshot
+        #: (/api/observability) and the fallback counters — "why is
+        #: this run not on the fast path" is a query, not a log grep.
+        self._capability_fallbacks: list = []
+        self._fallbacks_reported = False
         self._clock = self.tracer.clock
         #: device-sync accounting (observability/sync.py): every blocking
         #: host<->device round trip of this run — chunk fetches, compute
@@ -1751,8 +1759,48 @@ class ABCSMC:
                     f"sharded fused sampling unavailable: {reason}"
                 )
             logger.info("sharded fused path off: %s", reason)
+            self._note_capability_fallback("sharded", reason)
             return None
         return n
+
+    def _note_capability_fallback(self, gate: str, reason: str) -> None:
+        """Record a capability-gate fallback: a fast path the config
+        implied (mesh present, segmented models built, ...) that the
+        run could not take. The reason string lands in
+        ``self._capability_fallbacks`` (History telemetry + dispatch
+        snapshot) and bumps the fallback counters on both registries —
+        per-gate via the name-suffix convention (the registry has no
+        label support)."""
+        entry = {"gate": str(gate), "reason": str(reason)}
+        if entry in self._capability_fallbacks:
+            return  # one fallback per (gate, reason) per run
+        self._capability_fallbacks.append(entry)
+        from ..observability import global_metrics
+        from ..observability.metrics import (
+            CAPABILITY_FALLBACKS_TOTAL,
+            capability_fallback_metric,
+        )
+
+        for reg in (self.metrics, global_metrics()):
+            reg.counter(
+                CAPABILITY_FALLBACKS_TOTAL,
+                "requested fast paths that fell back to a slower "
+                "serving path (per-gate split: _<gate> suffix)",
+            ).inc()
+            reg.counter(
+                capability_fallback_metric(gate),
+                f"capability fallbacks at the {gate} gate",
+            ).inc()
+
+    def _fallbacks_telemetry(self) -> dict:
+        """The run's fallback list for the FIRST persisted generation's
+        History telemetry (reported once — later generations carry no
+        duplicate)."""
+        if self._capability_fallbacks and not self._fallbacks_reported:
+            self._fallbacks_reported = True
+            return {"capability_fallbacks":
+                    [dict(f) for f in self._capability_fallbacks]}
+        return {}
 
     def _sharded_incapable_reason(self, n_shards: int) -> str | None:
         """Why the sharded multigen kernel cannot serve this config (None
@@ -1814,7 +1862,16 @@ class ABCSMC:
         config (None = capable). Mirrors ``_sharded_incapable_reason``:
         every reason names the path that still serves the config —
         incapable configs fall back LOUDLY to the classic
-        full-trajectory loop, they never silently change semantics."""
+        full-trajectory loop, they never silently change semantics.
+
+        ISSUE 17 killed the three big exclusions: the engine now runs
+        INSIDE the sharded kernel (shard-local retire/refill over each
+        shard's lane-key block), adaptive distances refit unbiased from
+        per-column moments over ALL resolved lanes, and stochastic
+        acceptors retire against per-lane pre-committed acceptance
+        thresholds when the kernel provides a log-density upper bound.
+        What remains gated is genuinely unservable, each reason naming
+        why."""
         from ..ops.segment import uniform_protocol_reason
 
         reason = uniform_protocol_reason(self.models)
@@ -1824,36 +1881,79 @@ class ABCSMC:
                     f"JaxModel(segmented=...) to enable early reject")
         if self.spec is None:
             return "no SumStatSpec yet (run not initialized)"
-        if self.distance_function.device_bound_fn(self.spec) is None:
+        bound = self.distance_function.device_bound_fn(self.spec)
+        if bound is None:
+            if stochastic:
+                return (f"{type(self.distance_function).__name__} has "
+                        f"no monotone log-density upper bound "
+                        f"(device_bound_fn); the classic kernel serves "
+                        f"it — elementwise-separable kernels "
+                        f"(IndependentNormal/IndependentLaplace, "
+                        f"log-scale Binomial/Poisson) bound soundly")
             return (f"{type(self.distance_function).__name__} has no "
                     f"monotone prefix bound (device_bound_fn); the "
                     f"classic kernel serves it — p-norm-family "
                     f"distances bound soundly")
-        if adaptive:
-            return ("adaptive distances refit their scale from the "
-                    "record ring of ALL simulations, but early reject "
-                    "leaves retired trajectories without complete "
-                    "statistics — the ring would be survivor-biased; "
-                    "the classic kernel serves adaptive configs")
-        if stochastic or type(self.acceptor) is not UniformAcceptor:
-            return ("only the UniformAcceptor's accept test "
-                    "(distance <= eps) is decidable from a distance "
-                    "lower bound; stochastic/custom acceptors keep the "
+        upper = bool(bound.get("upper", False))
+        if stochastic and not upper:
+            return (f"{type(self.distance_function).__name__}'s prefix "
+                    f"bound is a distance LOWER bound; stochastic "
+                    f"retirement needs a log-density UPPER bound "
+                    f"(acceptance provably impossible at the lane's "
+                    f"pre-committed draw) — the classic kernel serves "
+                    f"this config")
+        if not stochastic and upper:
+            return ("a log-density upper bound only decides the "
+                    "StochasticAcceptor's test; deterministic accepts "
+                    "keep the classic kernel")
+        if not stochastic and type(self.acceptor) is not UniformAcceptor:
+            return ("only the UniformAcceptor's deterministic accept "
+                    "test (distance <= eps) is decidable from a "
+                    "distance lower bound; custom acceptors keep the "
                     "classic kernel")
+        if stochastic and any(
+            sch[0] == "acceptance_rate"
+            for sch in self._temp_config()[0]
+        ):
+            return ("the AcceptanceRateScheme reweights the record "
+                    "ring of ALL evaluations, but under early reject "
+                    "the ring holds completed evaluations only — the "
+                    "temperature would be survivor-biased; the classic "
+                    "kernel serves this scheme")
+        if adaptive:
+            d = self.distance_function
+            if not d.sharded_scale_capable():
+                scale_name = getattr(
+                    getattr(d, "scale_function", None), "__name__",
+                    repr(getattr(d, "scale_function", None)))
+                from ..ops.scale_reduce import SHARDED_SCALE_NAMES
+
+                return (f"adaptive scale function {scale_name!r} has "
+                        f"no moment-decomposable reduction, and under "
+                        f"early reject the completed-only record ring "
+                        f"is survivor-biased — unbiased refits need "
+                        f"per-column moments over resolved lanes; the "
+                        f"classic kernel serves this config (switch to "
+                        f"{', '.join(sorted(SHARDED_SCALE_NAMES))} for "
+                        f"early reject)")
+            cfg = (d.device_sharded_reduce(self.spec)
+                   if self.spec is not None else None)
+            if cfg is None or cfg["cols"] is not None:
+                return ("adaptive refits under retirement accumulate "
+                        "per-column moments over RAW sum-stat columns; "
+                        "derived record-column transforms "
+                        "(AdaptiveAggregatedDistance sub-distances) "
+                        "read whole rows — the classic kernel serves "
+                        "this config")
         if sumstat_mode:
             return ("learned summary statistics mix trajectory entries "
                     "across the prefix — no sound per-segment bound; "
                     "the classic kernel serves this config")
-        if sharded_n:
-            return ("the sharded multigen kernel keeps its own "
-                    "lane-key reduction; segmented early reject "
-                    "composes with the unsharded kernel only — drop "
-                    "sharded= (or set early_reject=False) for now")
-        if self.mesh is not None:
-            return ("the GSPMD mesh path constrains lane arrays per "
-                    "round; the segmented engine's refill gathers are "
-                    "unsharded for now — run without a mesh for early "
-                    "reject")
+        if self.mesh is not None and not sharded_n:
+            return ("the replicated GSPMD mesh path constrains lane "
+                    "arrays per round; segmented early reject composes "
+                    "with the sharded kernel (sharded=<n>) or without "
+                    "a mesh")
         d = self.distance_function
         for w in getattr(d, "weights", {}).values():
             if np.any(np.asarray(w) < 0):
@@ -2431,7 +2531,7 @@ class ABCSMC:
                 sumstat_mode=sumstat_mode, sharded_n=sharded_n,
             )
             if seg_reason is None:
-                seg_cfg = ctx.segment_cfg()
+                seg_cfg = ctx.segment_cfg(stochastic=stochastic)
             elif self.early_reject is True:
                 raise ValueError(
                     f"early_reject=True unavailable: {seg_reason}"
@@ -2443,6 +2543,7 @@ class ABCSMC:
                 # only worth a log line when the user built segmented
                 # models — every plain config would spam otherwise
                 logger.info("segmented early reject off: %s", seg_reason)
+                self._note_capability_fallback("early_reject", seg_reason)
         health_cfg = self._health_cfg()
         # the multigen kernel's static configuration; the dispatch engine
         # owns the build (kernel.build span) and every invocation —
@@ -2964,6 +3065,40 @@ class ABCSMC:
                                  "segment_occupancy": round(occ_g, 4),
                                  "seg_steps": steps_g,
                                  "seg_resolved": resolved_g}
+                    if "retired_shard" in fetched:
+                        # composed sharded+segmented chunks (ISSUE 17):
+                        # the per-shard int32 columns ride the same
+                        # packed fetch — split the retired counter and
+                        # occupancy gauge per shard (suffix convention,
+                        # cardinality = shard count) and ship both
+                        # breakdowns in telemetry
+                        ret_sh = [int(x) for x in
+                                  np.asarray(fetched["retired_shard"][g])]
+                        steps_sh = np.asarray(
+                            fetched["seg_steps_shard"][g])
+                        slots_sh = np.asarray(
+                            fetched["seg_lane_slots_shard"][g])
+                        occ_sh = [
+                            round(float(st) / max(int(sl), 1), 4)
+                            for st, sl in zip(steps_sh, slots_sh)
+                        ]
+                        for reg in (self.metrics, global_metrics()):
+                            for i, (r_i, o_i) in enumerate(
+                                    zip(ret_sh, occ_sh)):
+                                reg.counter(
+                                    f"{SIM_LANES_RETIRED_TOTAL}"
+                                    f"_shard_{i}",
+                                    "lanes retired early on this shard",
+                                ).inc(r_i)
+                                reg.gauge(
+                                    f"{SIM_SEGMENT_OCCUPANCY_GAUGE}"
+                                    f"_shard_{i}",
+                                    "segment occupancy on this shard",
+                                ).set(o_i)
+                        refit_tel = {**refit_tel,
+                                     "retired_per_shard": ret_sh,
+                                     "segment_occupancy_per_shard":
+                                         occ_sh}
                 if g == g_last_ok or sumstat_refit:
                     last_sample, last_pop = _build()
                     last_eps, last_acc_rate = current_eps, acceptance_rate
@@ -3010,6 +3145,7 @@ class ABCSMC:
                         "distance_changed": bool(adaptive),
                         **refit_tel,
                         **(mem_telemetry if g == 0 else {}),
+                        **self._fallbacks_telemetry(),
                     },
                 )
                 logger.info(

@@ -617,13 +617,22 @@ class DeviceContext:
         return jax.lax.while_loop(cond, body, state0)
 
     # ------------------------------------------ segmented early reject
-    def segment_cfg(self) -> dict:
+    def segment_cfg(self, stochastic: bool = False) -> dict:
         """Build the segmented early-reject execution config (ISSUE 15):
         the uniform segment protocol of the model family, the flat-index
         emission map, and the distance's monotone prefix-bound closures.
         Raises with the blocking reason when the config cannot run the
         segmented engine — callers that want a soft fallback gate first
-        (``ABCSMC._early_reject_incapable_reason``)."""
+        (``ABCSMC._early_reject_incapable_reason``).
+
+        ``stochastic`` selects the stochastic-acceptor retirement mode
+        (ISSUE 17): the bound closures must then be an UPPER bound on
+        the kernel's log-density (``device_bound_fn`` dicts carrying
+        ``"upper": True``), and the engine retires against per-lane
+        pre-committed acceptance thresholds. The direction check is a
+        soundness gate in BOTH directions — a lower distance bound
+        retired against log-density thresholds (or vice versa) would
+        discard viable candidates."""
         from ..ops.segment import index_map_for, uniform_protocol_reason
 
         reason = uniform_protocol_reason(self.models)
@@ -636,6 +645,16 @@ class DeviceContext:
                 f"{type(self.distance).__name__} has no monotone "
                 "prefix bound (device_bound_fn)"
             )
+        if bool(bound.get("upper", False)) != bool(stochastic):
+            direction = "an upper log-density" if bound.get("upper") \
+                else "a lower distance"
+            need = ("a StochasticAcceptor" if bound.get("upper")
+                    else "a UniformAcceptor")
+            raise ValueError(
+                "segmented execution unavailable: "
+                f"{type(self.distance).__name__} provides {direction} "
+                f"bound, which is only sound under {need}"
+            )
         seg0 = self.models[0].segmented
         return {
             "n_segments": int(seg0.n_segments),
@@ -644,6 +663,7 @@ class DeviceContext:
             "bound": bound,
             "use_hist": bool(getattr(self.acceptor,
                                      "use_complete_history", False)),
+            "stochastic": bool(stochastic),
         }
 
     def _seg_propose(self, kind: str):
@@ -755,7 +775,9 @@ class DeviceContext:
 
     def _generation_while_seg(self, key, dyn, n_target, *, B, n_cap,
                               rec_cap, max_rounds, kind, seg_cfg,
-                              all_accept=False, record_proposal=False):
+                              all_accept=False, record_proposal=False,
+                              moment_cfg=None, dfeat_cfg=None,
+                              B_total=None, lane_base=None):
         """Segment-inner proposal loop with mid-flight lane refill — the
         early-reject twin of :meth:`_generation_while` (ISSUE 15).
 
@@ -786,6 +808,27 @@ class DeviceContext:
         Returns the classic 5-tuple plus a dict of early-reject
         accounting: lanes retired, productive segment steps, resolved
         proposals, and sweeps (occupancy = seg_steps / (B * sweeps)).
+
+        Sharded composition (ISSUE 17): ``B_total``/``lane_base`` make
+        this engine one SHARD's segment sweep — the round key splits
+        into ``B_total`` global lane keys and this shard slices its
+        contiguous ``B``-lane block at ``lane_base``, exactly the
+        classic sharded ``run_lanes`` slice, so the lane-key reduction
+        (global lane ``i`` keeps one key everywhere) is preserved and
+        retire/refill stays strictly shard-local. ``moment_cfg`` /
+        ``dfeat_cfg`` carry the PR 12 adaptive machinery: moments
+        accumulate over ALL resolved lanes with per-COLUMN eligibility
+        (retired lanes contribute their simulated prefix columns — the
+        documented completed-only correction that removes the
+        survivor bias of a ring-based refit), and accepted rows store
+        their distance-feature vectors at completion. With
+        ``moment_cfg`` the return inserts the moment block before the
+        accounting dict. ``seg_cfg["stochastic"]`` switches retirement
+        to per-lane log-density thresholds from each lane's
+        PRE-COMMITTED acceptance draw (the acceptor's own
+        ``uniform(kacc)``), making stochastic retirement exact: a lane
+        retires only when its kernel-value upper bound proves the
+        already-drawn accept test cannot pass.
         """
         from ..ops.segment import gather_lanes, select_lanes
 
@@ -802,9 +845,23 @@ class DeviceContext:
         step_fn = self._seg_step_fn()
         acc_dev = self.acceptor.device_fn(self.distance.device_fn(self.spec))
         eps = dyn["eps"]
+        stoch_thr = bool(seg_cfg.get("stochastic", False))
         thr = (jnp.minimum(eps, dyn["acc_params"])
                if seg_cfg["use_hist"] else eps)
         dist_params = dyn["dist_params"]
+        if moment_cfg is not None:
+            from ..ops.scale_reduce import (
+                accumulate_moments,
+                init_moments,
+            )
+
+            mom_C, mom_cols_fn, _mom_x0_kernel, mom_x0_cols = moment_cfg
+            if mom_cols_fn is not None:
+                raise ValueError(
+                    "segmented moment accumulation needs raw sum-stat "
+                    "columns (prefix-accumulable); derived column "
+                    "transforms read whole rows"
+                )
         seg_size = int(seg_cfg["seg_size"])
         # stats accumulate SEGMENT-MAJOR as (B, n_seg, seg_size) via a
         # dense one-hot FMA — a per-lane scatter here costs more than a
@@ -819,7 +876,17 @@ class DeviceContext:
         x0_by_seg = self.x0[seg_cfg["index_map"]]
 
         def propose_block(r):
-            keys = jax.random.split(jax.random.fold_in(key, r), B)
+            if B_total is None:
+                keys = jax.random.split(jax.random.fold_in(key, r), B)
+            else:
+                # sharded: the round key still splits into the GLOBAL
+                # lane keys; this shard slices its contiguous block —
+                # the same lane-key reduction the classic sharded
+                # run_lanes performs, so lane i is keyed identically on
+                # every execution mode and width
+                keys_all = jax.random.split(
+                    jax.random.fold_in(key, r), B_total)
+                keys = jax.lax.dynamic_slice_in_dim(keys_all, lane_base, B)
             return jax.vmap(lambda k: propose(k, dyn))(keys)
 
         res0 = {
@@ -830,6 +897,8 @@ class DeviceContext:
             "log_weight": jnp.full((n_cap,), -jnp.inf, jnp.float32),
             "slot": jnp.full((n_cap,), -1, jnp.int32),
         }
+        if dfeat_cfg is not None:
+            res0["dfeat"] = jnp.zeros((n_cap, dfeat_cfg[0]), jnp.float32)
         rec0 = {
             "sumstats": jnp.zeros((rec_cap, S), jnp.float32),
             "distance": jnp.zeros((rec_cap,), jnp.float32),
@@ -864,6 +933,8 @@ class DeviceContext:
                   z32,                            # r_head
                   jnp.ones((B,), bool),           # alive
                   blocks0, res0, rec0, lane0)
+        if moment_cfg is not None:
+            state0 = state0 + (init_moments(mom_C),)
 
         def cond(state):
             n_acc, any_live, sweeps = state[0], state[7], state[6]
@@ -872,7 +943,8 @@ class DeviceContext:
         def body(state):
             (n_acc, n_started, n_valid, retired, seg_steps, resolved,
              sweeps, _any_live, r_head, alive, blocks, res, rec,
-             lane) = state
+             lane) = state[:14]
+            mom = state[14] if moment_cfg is not None else None
             # ---- refill: resolved lanes take the next slots in lane
             # order (the same rank/cumsum compaction the reservoir
             # write uses), gathering their precomputed proposal rows
@@ -933,11 +1005,21 @@ class DeviceContext:
             # final segment — most sweeps in the heavy-retire regime —
             # skip the reservoir/ring writes entirely
             done = stepmask & (lane["seg_idx"] >= n_seg)
+            # the flat-order stats of every lane: the moment fold reads
+            # them each sweep anything resolves, so compute them once and
+            # let the completion branch share the gather
+            stats_all = (
+                lane["stats"].reshape((B, n_seg * seg_size))[:, dense_pos]
+                if moment_cfg is not None else None
+            )
 
             def _complete(args):
                 res_c, rec_c = args
-                stats_flat = lane["stats"].reshape(
-                    (B, n_seg * seg_size))[:, dense_pos]
+                stats_flat = (
+                    stats_all if stats_all is not None
+                    else lane["stats"].reshape(
+                        (B, n_seg * seg_size))[:, dense_pos]
+                )
                 d, accept, log_acc_w = jax.vmap(
                     lambda k, s: acc_dev(k, s, self.x0, eps,
                                          dist_params, dyn["acc_params"])
@@ -975,6 +1057,15 @@ class DeviceContext:
                     "slot": res_c["slot"].at[write_pos].set(
                         lane["slot"], mode="drop"),
                 }
+                if dfeat_cfg is not None:
+                    # accepted lanes always run to completion, so their
+                    # feature rows are exact — same contract as the
+                    # classic sharded accept-time write
+                    _dC, dfeat_row, dfeat_x0 = dfeat_cfg
+                    res_c["dfeat"] = args[0]["dfeat"].at[write_pos].set(
+                        jax.vmap(lambda s: dfeat_row(s, dfeat_x0))(
+                            stats_flat),
+                        mode="drop")
                 # record ring: completed evaluations in slot order (the
                 # documented deviation — retired lanes have no stats)
                 rec_pos = jnp.where(
@@ -1007,11 +1098,45 @@ class DeviceContext:
             # ---- retirement: provably rejected mid-trajectory (bound
             # sound + slack, so a surviving lane ALWAYS gets the exact
             # final test above; invalid draws are rejected at segment 1)
-            exceeds = jax.vmap(
-                lambda a: bound["exceeds"](a, thr, dist_params)
-            )(lane["bacc"])
+            if stoch_thr:
+                # stochastic acceptance: each lane's accept draw u is
+                # PRE-COMMITTED by its kacc key (the acceptor's device_fn
+                # draws uniform(kacc)), so the per-lane log-density
+                # threshold pdf_norm + T*log(u) is exact — the kernel's
+                # upper bound falling below it proves the already-drawn
+                # test "log(u) < (logv - pdf_norm)/T" cannot pass.
+                # u == 0 gives thr = -inf (the lane is certainly
+                # accepted and never retires); T = +inf (calibration)
+                # likewise never retires.
+                u_lane = jax.vmap(jax.random.uniform)(lane["kacc"])
+                thr_lane = dyn["acc_params"] + eps * jnp.log(u_lane)
+                exceeds = jax.vmap(
+                    lambda a, tl: bound["exceeds"](a, tl, dist_params)
+                )(lane["bacc"], thr_lane)
+            else:
+                exceeds = jax.vmap(
+                    lambda a: bound["exceeds"](a, thr, dist_params)
+                )(lane["bacc"])
             retire = stepmask & ~done & (exceeds | ~lane["valid"])
             resolved_now = done | retire
+            if moment_cfg is not None:
+                # ALL resolved lanes feed the scale moments: completed
+                # lanes every column (identical to the classic take),
+                # retired lanes the prefix columns they actually
+                # simulated — per-column counts keep each statistic's
+                # scale an average over every proposal that simulated
+                # it, which is what removes the survivor bias of a
+                # completed-only ring refit
+                seg_done = (jnp.arange(n_seg, dtype=jnp.int32)[None, :]
+                            < lane["seg_idx"][:, None])
+                col_mask = jnp.broadcast_to(
+                    seg_done[:, :, None], (B, n_seg, seg_size)
+                ).reshape((B, n_seg * seg_size))[:, dense_pos]
+                take_rows = (resolved_now & lane["valid"]
+                             & (lane["slot"] < rec_cap))
+                mom = accumulate_moments(
+                    mom, stats_all, take_rows[:, None] & col_mask,
+                    mom_x0_cols)
             lane["active"] = stepmask & ~resolved_now
             n_acc = n_acc + acc_inc
             n_valid = n_valid + jnp.sum(resolved_now & lane["valid"],
@@ -1019,18 +1144,24 @@ class DeviceContext:
             retired = retired + jnp.sum(retire, dtype=jnp.int32)
             resolved = resolved + jnp.sum(resolved_now, dtype=jnp.int32)
             any_live = jnp.any(lane["active"]) | (n_started < budget)
-            return (n_acc, n_started, n_valid, retired, seg_steps,
-                    resolved, sweeps + 1, any_live, r_head, alive,
-                    blocks, res, rec, lane)
+            nxt = (n_acc, n_started, n_valid, retired, seg_steps,
+                   resolved, sweeps + 1, any_live, r_head, alive,
+                   blocks, res, rec, lane)
+            if moment_cfg is not None:
+                nxt = nxt + (mom,)
+            return nxt
 
+        final = jax.lax.while_loop(cond, body, state0)
         (n_acc, n_started, n_valid, retired, seg_steps, resolved,
          sweeps, _live, _rh, _alive, _blocks, res, rec,
-         _lane) = jax.lax.while_loop(cond, body, state0)
+         _lane) = final[:14]
         rounds = (n_started + B - 1) // B
         segx = {"retired": retired, "seg_steps": seg_steps,
                 "seg_resolved": resolved,
                 # total lane-sweep slots: the occupancy denominator
                 "seg_lane_slots": sweeps * B}
+        if moment_cfg is not None:
+            return n_acc, rounds, n_valid, res, rec, final[14], segx
         return n_acc, rounds, n_valid, res, rec, segx
 
     def generation_kernel(self, B: int, mode: str, n_cap: int, rec_cap: int,
@@ -1287,7 +1418,8 @@ class DeviceContext:
         """
         seg_token = (None if segment_cfg is None else
                      (segment_cfg["n_segments"], segment_cfg["seg_size"],
-                      segment_cfg["use_hist"]))
+                      segment_cfg["use_hist"],
+                      segment_cfg.get("stochastic", False)))
         cache_key = ("multigen", B, n_cap, rec_cap, max_rounds, G, adaptive,
                      eps_quantile, eps_weighted, alpha, multiplier,
                      trans_cls.__name__, fit_statics, dims,
@@ -1299,18 +1431,26 @@ class DeviceContext:
             return self._kernels[cache_key]
         if stochastic and self.K != 1:
             raise ValueError("stochastic fused chunks support K=1 only")
-        if segment_cfg is not None and (
-                sharded is not None or adaptive or stochastic
-                or sumstat_transform):
-            # the caller gates these combinations with a named fallback
+        if segment_cfg is not None and sumstat_transform:
+            # the one genuinely incompatible combination left (ISSUE 17
+            # lifted sharded/adaptive/stochastic): a learned transform
+            # mixes entries across the prefix, so no per-prefix bound is
+            # sound. The caller gates it with a named fallback
             # (ABCSMC._early_reject_incapable_reason); reaching here
             # means the gate was bypassed. (In-kernel calibration DOES
             # compose: the eps=+inf prior round keeps the classic lane —
             # nothing can retire at an infinite threshold.)
             raise ValueError(
-                "segmented early reject composes with the plain "
-                "unsharded multigen kernel only (non-adaptive distance, "
-                "uniform acceptor)"
+                "segmented early reject cannot serve learned summary "
+                "statistics (no sound per-prefix bound in the "
+                "transformed feature space)"
+            )
+        if segment_cfg is not None and \
+                bool(segment_cfg.get("stochastic", False)) != stochastic:
+            raise ValueError(
+                "segment_cfg stochastic mode does not match the kernel's "
+                "acceptor configuration (build it via "
+                "segment_cfg(stochastic=...))"
             )
         if sharded is not None:
             # the explicitly sharded variant: per-device lanes/reservoirs
@@ -1341,6 +1481,7 @@ class DeviceContext:
                 temp_config=temp_config, temp_fixed=temp_fixed,
                 weight_sched=weight_sched,
                 fold_sched_mode=fold_sched_mode, adaptive_n=adaptive_n,
+                segment_cfg=segment_cfg,
             )
             self._kernels[cache_key] = fn
             return fn
@@ -1362,7 +1503,40 @@ class DeviceContext:
             self.distance.device_weight_update() if adaptive else None
         )
         scale_reduce = ss_fn = scale_impl = None
-        if adaptive and sumstat_transform:
+        seg_moment_cfg = seg_scale_finish = seg_mom_x0 = None
+        if adaptive and segment_cfg is not None:
+            # unbiased adaptive refits under retirement (ISSUE 17): the
+            # segmented engine's record ring keeps COMPLETED evaluations
+            # only, so a ring-based refit would be survivor-biased.
+            # Instead the engine folds the PR 12 moment blocks over ALL
+            # resolved lanes (retired lanes contribute their simulated
+            # prefix columns, per-column counts) and the refit finishes
+            # from moments — the same machinery the sharded kernel uses.
+            from ..ops.scale_reduce import scale_from_moments
+
+            adapt_cfg = self.distance.device_sharded_reduce(self.spec)
+            if (weight_post is None or adapt_cfg is None
+                    or adapt_cfg["cols"] is not None):
+                raise RuntimeError(
+                    "adaptive segmented run needs a moment-expressible "
+                    "scale over raw sum-stat columns "
+                    "(distance.device_sharded_reduce)"
+                )
+            seg_scale_finish = scale_from_moments(adapt_cfg["name"])
+            seg_mom_x0 = (self.x0 if adapt_cfg["x0_cols"] is None
+                          else adapt_cfg["x0_cols"])
+            seg_moment_cfg = (adapt_cfg["cols_dim"] or S,
+                              adapt_cfg["cols"], self.x0, seg_mom_x0)
+            # in-kernel calibration still reduces its complete prior
+            # sample through the classic ring reduce (eps=+inf retires
+            # nothing, so the sample has full rows)
+            scale_reduce = self.distance.device_record_reduce(self.spec)
+            if fused_calibration is not None and scale_reduce is None:
+                raise RuntimeError(
+                    "adaptive segmented calibration needs a device "
+                    "record reduce (distance.device_record_reduce)"
+                )
+        elif adaptive and sumstat_transform:
             # the record ring holds RAW sumstats; the scale reduction runs
             # in the TRANSFORMED feature space of the (chunk-constant)
             # learned statistics, so compose the sumstat device twin with
@@ -1481,6 +1655,7 @@ class DeviceContext:
                                 rec_cap=rec_cap, max_rounds=max_rounds,
                                 kind=kind, seg_cfg=segment_cfg,
                                 record_proposal=stochastic,
+                                moment_cfg=seg_moment_cfg,
                             )
 
                         if not first_gen_prior:
@@ -1533,13 +1708,24 @@ class DeviceContext:
                                                  jnp.float32)
                         rec["logq"] = jnp.zeros((rec_cap,), jnp.float32)
                     if segment_cfg is not None:
-                        return z32, z32, z32, res, rec, {
+                        segx_z = {
                             "retired": z32, "seg_steps": z32,
                             "seg_resolved": z32, "seg_lane_slots": z32,
                         }
+                        if seg_moment_cfg is not None:
+                            from ..ops.scale_reduce import init_moments
+
+                            return (z32, z32, z32, res, rec,
+                                    init_moments(seg_moment_cfg[0]), segx_z)
+                        return z32, z32, z32, res, rec, segx_z
                     return z32, z32, z32, res, rec
 
-                if segment_cfg is not None:
+                mom = None
+                if segment_cfg is not None and seg_moment_cfg is not None:
+                    (n_acc, rounds, n_valid, res, rec, mom,
+                     segx) = jax.lax.cond(stopped, skip_gen, run_gen,
+                                          None)
+                elif segment_cfg is not None:
                     (n_acc, rounds, n_valid, res, rec,
                      segx) = jax.lax.cond(stopped, skip_gen, run_gen,
                                           None)
@@ -1560,6 +1746,13 @@ class DeviceContext:
                     scale = scale_impl(rec_t, rec["valid"],
                                        ss_fn(self.x0, ssp))
                     dist_w_next = {"w": weight_post(scale), "ss": ssp}
+                elif adaptive and segment_cfg is not None:
+                    # refit from the engine's resolved-lane moments —
+                    # the record ring under retirement holds completed
+                    # evaluations only and would bias the scale toward
+                    # survivors
+                    scale = seg_scale_finish(mom, seg_mom_x0)
+                    dist_w_next = weight_post(scale)
                 elif adaptive:
                     scale = scale_reduce(rec["sumstats"], rec["valid"],
                                          self.x0)
@@ -1953,7 +2146,8 @@ class DeviceContext:
                           temp_fixed: bool = False,
                           weight_sched: bool = False,
                           fold_sched_mode: bool = False,
-                          adaptive_n: tuple | None = None):
+                          adaptive_n: tuple | None = None,
+                          segment_cfg: dict | None = None):
         """The sharded fused chunk: population axis split over the mesh
         with chunk-boundary-only ROW collectives.
 
@@ -2011,6 +2205,18 @@ class DeviceContext:
         generation (``ops/shard.py::merge_index_dyn``), and user weight
         schedules / CV fold tables resolve per generation on the
         replicated column exactly as in the unsharded kernel.
+
+        Segmented early reject (ISSUE 17): with ``segment_cfg`` each
+        shard runs the retire/refill engine over ITS lane-key block —
+        ``_generation_while_seg`` slices its proposal keys out of the
+        GLOBAL round split (``B_total``/``lane_base``), so the lane-key
+        reduction and the slot-ordered reservoir survive unchanged and
+        retire/refill never crosses devices. The adaptive moment blocks
+        now accumulate over ALL resolved lanes (retired lanes feed their
+        simulated prefix columns, per-column counts) and per-shard
+        retire counters ride the existing packed fetch — the collective
+        set, and therefore ``syncs_per_run``, is identical to the
+        non-segmented sharded schedule.
         """
         from jax.sharding import PartitionSpec as P
 
@@ -2105,7 +2311,34 @@ class DeviceContext:
                     out = out + (jnp.zeros((0,), jnp.float32),)
                 return out
 
+            def _seg(kind):
+                # this shard's segment sweep: the engine slices its
+                # B_loc lane keys out of the GLOBAL round split, so
+                # retire/refill stays strictly shard-local while lane i
+                # keeps the identical key at every width
+                out = self._generation_while_seg(
+                    gen_key, dyn, quota_loc, B=B_loc, n_cap=cap_loc,
+                    rec_cap=rec_cap, max_rounds=max_rounds, kind=kind,
+                    seg_cfg=segment_cfg,
+                    record_proposal=record_proposal,
+                    moment_cfg=moment_cfg, dfeat_cfg=dfeat_cfg,
+                    B_total=B, lane_base=shard_idx * B_loc,
+                )
+                if moment_cfg is None:
+                    # insert the mom placeholder before the accounting
+                    return out[:5] + (jnp.zeros((0,), jnp.float32),
+                                      out[5])
+                return out
+
             def run_gen(_):
+                if segment_cfg is not None:
+                    if not first_gen_prior:
+                        return _seg("transition")
+                    return jax.lax.cond(
+                        use_prior,
+                        lambda: _seg("prior"),
+                        lambda: _seg("transition"),
+                    )
                 if not first_gen_prior:
                     return _run_with(self._lane_transition)
                 return jax.lax.cond(
@@ -2145,10 +2378,19 @@ class DeviceContext:
                     from ..ops.scale_reduce import init_moments
 
                     mom = init_moments(moment_cfg[0])
+                if segment_cfg is not None:
+                    return z32, z32, z32, res, rec, mom, {
+                        "retired": z32, "seg_steps": z32,
+                        "seg_resolved": z32, "seg_lane_slots": z32,
+                    }
                 return z32, z32, z32, res, rec, mom
 
-            (n_acc_l, rounds_l, n_valid_l, res_l, rec_l,
-             mom_l) = jax.lax.cond(stopped, skip_gen, run_gen, None)
+            if segment_cfg is not None:
+                (n_acc_l, rounds_l, n_valid_l, res_l, rec_l, mom_l,
+                 segx_l) = jax.lax.cond(stopped, skip_gen, run_gen, None)
+            else:
+                (n_acc_l, rounds_l, n_valid_l, res_l, rec_l,
+                 mom_l) = jax.lax.cond(stopped, skip_gen, run_gen, None)
             # local accepted-theta finiteness: the one health input that
             # must be reduced across shards instead of recomputed from
             # the gathered scalar columns
@@ -2156,8 +2398,11 @@ class DeviceContext:
                 n_acc_l, quota_loc)
             theta_bad_l = ~jnp.all(jnp.isfinite(
                 jnp.where(mask_loc[:, None], res_l["theta"], 0.0)))
-            return (n_acc_l, rounds_l, n_valid_l, res_l, rec_l, mom_l,
-                    theta_bad_l)
+            ret = (n_acc_l, rounds_l, n_valid_l, res_l, rec_l, mom_l,
+                   theta_bad_l)
+            if segment_cfg is not None:
+                ret = ret + (segx_l,)
+            return ret
 
         # the two executions of the SAME shard program: without a mesh
         # the shards are a vmapped leading axis on one device and the
@@ -2281,9 +2526,11 @@ class DeviceContext:
                 }
                 use_prior = (t == 0) if first_gen_prior \
                     else jnp.asarray(False)
+                loc = A.run_local(gen_key, dyn, n_target, use_prior,
+                                  stopped)
                 (n_acc_l, rounds_l, n_valid_l, res_l, rec_l, mom_l,
-                 theta_bad_l) = A.run_local(gen_key, dyn, n_target,
-                                            use_prior, stopped)
+                 theta_bad_l) = loc[:7]
+                segx_l = loc[7] if segment_cfg is not None else None
                 # ---- per-generation scalar-column collectives only
                 d_col = A.rows(res_l["distance"])
                 lw_col = A.rows(res_l["log_weight"])
@@ -2510,6 +2757,24 @@ class DeviceContext:
                     "n_acc_shard": nacc_sh, "rounds_shard": rounds_sh,
                     **temp_extra,
                 }
+                if segx_l is not None:
+                    # early-reject accounting, globally AND per shard:
+                    # the per-shard int32 columns ride the packed fetch
+                    # exactly like n_acc_shard — the retire-imbalance
+                    # gauge costs zero extra syncs
+                    retired_sh = A.stack(segx_l["retired"])
+                    steps_sh = A.stack(segx_l["seg_steps"])
+                    slots_sh = A.stack(segx_l["seg_lane_slots"])
+                    out.update({
+                        "retired": jnp.sum(retired_sh),
+                        "seg_steps": jnp.sum(steps_sh),
+                        "seg_resolved": jnp.sum(
+                            A.stack(segx_l["seg_resolved"])),
+                        "seg_lane_slots": jnp.sum(slots_sh),
+                        "retired_shard": retired_sh,
+                        "seg_steps_shard": steps_sh,
+                        "seg_lane_slots_shard": slots_sh,
+                    })
                 if health_config is not None:
                     out["health"] = word
                     out["ess"] = ess

@@ -243,6 +243,32 @@ SIM_LANES_RETIRED_TOTAL = "pyabc_tpu_sim_lanes_retired_early_total"
 #:  productive segment-step share of the last chunk's lane sweeps
 #:  (seg_steps / (B * sweeps)); the shortfall is drain/refill idle time
 SIM_SEGMENT_OCCUPANCY_GAUGE = "pyabc_tpu_sim_segment_occupancy"
+#:  per-shard early-reject imbalance of the last processed chunk
+#:  (max over shards of lanes retired / mean; 1.0 = evenly spread
+#:  rejection) — sits next to pyabc_tpu_mesh_shard_imbalance so a
+#:  bound that fires on one shard's lane block is visible (ISSUE 17:
+#:  the composed sharded+segmented kernel)
+SIM_RETIRE_IMBALANCE_GAUGE = "pyabc_tpu_sim_retire_shard_imbalance"
+
+# -- capability-gate fallback accounting (ISSUE 17) ---------------------------
+#
+# When early_reject="auto" or an implicit mesh-width shard resolution
+# falls back to a slower serving path, the fallback used to be a log
+# line only. Operators watching a fleet need it as a counter:
+#:  total silent capability-gate fallbacks (all gates); the per-gate
+#:  breakdown rides name suffixes (capability_fallback_metric), the
+#:  full reason strings land in History telemetry and
+#:  /api/observability
+CAPABILITY_FALLBACKS_TOTAL = "pyabc_tpu_capability_fallbacks_total"
+
+
+def capability_fallback_metric(gate: str) -> str:
+    """Per-gate fallback counter name — the registry's stand-in for
+    ``pyabc_tpu_capability_fallbacks_total{reason=...}`` (the text
+    exposition has no label support; cardinality is bounded by the
+    fixed gate set: early_reject, sharded)."""
+    g = "".join(c if c.isalnum() or c == "_" else "_" for c in str(gate))
+    return f"{CAPABILITY_FALLBACKS_TOTAL}_{g}"
 
 
 # -- multi-tenant serving instrument names (round 14) -------------------------

@@ -66,18 +66,31 @@ def init_moments(C: int):
 
 def accumulate_moments(mom, cols, take, x0):
     """Fold one proposal round's record columns into the shard's moment
-    block. ``cols (B, C)``, ``take (B,)`` = this round's ring-eligible
-    rows (valid simulation AND inside the record window), ``x0 (C,)``
-    the observation in column space."""
+    block. ``cols (B, C)``, ``take`` = this round's ring-eligible rows
+    (valid simulation AND inside the record window), ``x0 (C,)`` the
+    observation in column space.
+
+    ``take`` is either ``(B,)`` (every column of a taken row counts —
+    the classic whole-row path) or ``(B, C)`` boolean (per-COLUMN
+    eligibility: the segmented early-reject engine folds retired lanes'
+    simulated PREFIX columns in, so each column's moments aggregate over
+    every proposal that actually simulated it). The whole-row path keeps
+    its scalar-count broadcast untouched — bool sums are exact in f32 at
+    any realistic round count, so existing sharded-adaptive bit-identity
+    is preserved."""
     import jax.numpy as jnp
 
-    t = take[:, None]
+    per_col = take.ndim == 2
+    t = take if per_col else take[:, None]
     csum = jnp.where(t, cols, 0.0).sum(axis=0)
     csq = jnp.where(t, cols * cols, 0.0).sum(axis=0)
     cad = jnp.where(t, jnp.abs(cols - x0[None, :]), 0.0).sum(axis=0)
-    cnt = jnp.broadcast_to(
-        take.sum().astype(jnp.float32), (cols.shape[1],)
-    )
+    if per_col:
+        cnt = take.sum(axis=0).astype(jnp.float32)
+    else:
+        cnt = jnp.broadcast_to(
+            take.sum().astype(jnp.float32), (cols.shape[1],)
+        )
     cmax = jnp.where(t, cols, -jnp.inf).max(axis=0)
     cmin = jnp.where(t, cols, jnp.inf).min(axis=0)
     return jnp.stack([

@@ -161,6 +161,21 @@ SCENARIO_LATE_ACC = 0.01
 #: regression guard: late-window accepted-pps ratio ON/OFF (the ISSUE 15
 #: acceptance line; armed only when the run reaches the late window)
 SCENARIO_SPEEDUP_MIN_X = 2.0
+# sharded scenario leg (ISSUE 17): the composed sharded+segmented
+# kernel measured on a forced-8-device mesh in a subprocess. The
+# CPU-proxy guard is looser than the unsharded one — 8 virtual devices
+# timeshare one core, so the retire win competes with collective
+# overhead the real chip does not pay (>=2x stays the real-TPU target).
+SCENARIO_SHARDED_SPEEDUP_MIN_X = 1.5
+# the r20 capture measured the whole leg at ~333 s on the 1-core box
+# (6 drained runs); the budget leaves headroom so the warm full runs
+# (max_walltime = 0.3 * budget each) never get cut mid-measurement
+DEFAULT_SCENARIO_SHARDED_BUDGET_S = 600.0
+# the sharded leg runs 6 drained runs (2 cold + 4 warm) on 8 virtual
+# devices timesharing one core — half the inline lane's pop and one
+# fewer generation keep the whole leg inside its budget there
+DEFAULT_SCENARIO_SHARDED_POP = 512
+DEFAULT_SCENARIO_SHARDED_GENS = 11
 # traffic lane (round 19): fleet-scale churn — an open-loop seeded
 # Poisson arrival process from the spec zoo against a live RunScheduler
 # on forced-8-device CPU. 1000 tenants is the ISSUE acceptance scale

@@ -202,22 +202,91 @@ def test_early_reject_required_raises_when_incapable():
         abc.run(max_nr_populations=2)
 
 
-def test_adaptive_distance_gates_off():
+def _gate_abc(dist, acceptor=None, eps=None):
     obs = g.observed_birth_death(n_leaps=N_LEAPS, n_obs=N_OBS,
                                  segments=SEGMENTS)
-    abc = pt.ABCSMC(_bd_model(), g.birth_death_prior(),
-                    pt.AdaptivePNormDistance(p=2), population_size=32,
-                    early_reject="auto")
+    abc = pt.ABCSMC(_bd_model(), g.birth_death_prior(), dist,
+                    population_size=32, early_reject="auto",
+                    **({"acceptor": acceptor} if acceptor else {}),
+                    **({"eps": eps} if eps is not None else {}))
     abc.new("sqlite://", obs)
+    # the gate runs after distance init in the real loop
+    abc.distance_function.initialize(0, None, abc.x_0)
+    return abc
+
+
+def test_adaptive_gate_lifted_for_moment_scales():
+    """ISSUE 17: adaptive distances with moment-expressible scale
+    functions run segmented (unbiased per-column moments over ALL
+    resolved lanes); the default MAD scale stays gated with a reason
+    naming the decomposable alternatives."""
+    from pyabc_tpu.distance.scale import standard_deviation
+
+    abc = _gate_abc(pt.AdaptivePNormDistance(
+        p=2, scale_function=standard_deviation))
+    assert abc._early_reject_incapable_reason(
+        adaptive=True, stochastic=False, sumstat_mode=False,
+        sharded_n=None) is None
+    abc = _gate_abc(pt.AdaptivePNormDistance(p=2))  # MAD default
     reason = abc._early_reject_incapable_reason(
         adaptive=True, stochastic=False, sumstat_mode=False,
         sharded_n=None)
-    assert reason is not None and "adaptive" in reason
-    # sharded composition is named too
+    assert reason is not None and "moment" in reason
+    assert "standard_deviation" in reason
+    # derived record-column transforms read whole rows: still gated
+    abc = _gate_abc(pt.AdaptiveAggregatedDistance(
+        [pt.PNormDistance(p=2), pt.PNormDistance(p=1)]))
+    reason = abc._early_reject_incapable_reason(
+        adaptive=True, stochastic=False, sumstat_mode=False,
+        sharded_n=None)
+    assert reason is not None and "whole rows" in reason
+
+
+def test_sharded_gate_lifted():
+    """ISSUE 17: the segmented engine runs INSIDE the sharded kernel —
+    a shard count no longer gates early reject; only the replicated
+    GSPMD mesh path (mesh without sharded) remains excluded."""
+    abc = _gate_abc(pt.PNormDistance(p=2))
+    assert abc._early_reject_incapable_reason(
+        adaptive=False, stochastic=False, sumstat_mode=False,
+        sharded_n=8) is None
+
+
+def test_stochastic_gate_lifted_for_bounded_kernels():
+    """ISSUE 17: a StochasticAcceptor retires against per-lane
+    pre-committed acceptance thresholds when the kernel provides a
+    log-density UPPER bound; distances without one (or with a distance
+    LOWER bound) stay gated with the direction named."""
+    from pyabc_tpu.epsilon.temperature import ExpDecayFixedIterScheme
+
+    abc = _gate_abc(pt.IndependentNormalKernel(var=4.0),
+                    acceptor=pt.StochasticAcceptor(),
+                    eps=pt.Temperature(
+                        schemes=[ExpDecayFixedIterScheme()]))
+    assert abc._early_reject_incapable_reason(
+        adaptive=False, stochastic=True, sumstat_mode=False,
+        sharded_n=None) is None
+    # the AcceptanceRateScheme reweights the ring of ALL evaluations —
+    # survivor-biased under retirement, so it keeps the classic kernel
+    abc = _gate_abc(pt.IndependentNormalKernel(var=4.0),
+                    acceptor=pt.StochasticAcceptor(),
+                    eps=pt.Temperature())
+    reason = abc._early_reject_incapable_reason(
+        adaptive=False, stochastic=True, sumstat_mode=False,
+        sharded_n=None)
+    assert reason is not None and "AcceptanceRateScheme" in reason
+    # a distance LOWER bound cannot decide the stochastic test
+    abc = _gate_abc(pt.PNormDistance(p=2))
+    reason = abc._early_reject_incapable_reason(
+        adaptive=False, stochastic=True, sumstat_mode=False,
+        sharded_n=None)
+    assert reason is not None and "UPPER" in reason
+    # and an upper bound decides ONLY the stochastic test
+    abc = _gate_abc(pt.IndependentNormalKernel(var=4.0))
     reason = abc._early_reject_incapable_reason(
         adaptive=False, stochastic=False, sumstat_mode=False,
-        sharded_n=8)
-    assert reason is not None and "sharded" in reason
+        sharded_n=None)
+    assert reason is not None and "upper bound" in reason
 
 
 def test_uniform_protocol_reason_names_mismatch():
@@ -236,3 +305,186 @@ def test_early_reject_arg_validated():
     with pytest.raises(ValueError, match="early_reject"):
         pt.ABCSMC(_bd_model(), g.birth_death_prior(),
                   pt.PNormDistance(p=2), early_reject="yes")
+
+
+def test_capability_fallback_telemetry():
+    """Satellite (ISSUE 17): a requested-but-incapable fast path is a
+    MEASURED event — the gate and reason land in the fallback counter
+    (global registry: /api/observability), the run's fallback list (the
+    dispatch snapshot) and the first generation's History telemetry."""
+    from pyabc_tpu.observability import global_metrics
+    from pyabc_tpu.observability.metrics import (
+        CAPABILITY_FALLBACKS_TOTAL,
+        capability_fallback_metric,
+    )
+
+    before = global_metrics().counter(CAPABILITY_FALLBACKS_TOTAL).value
+    # segmented models + the default MAD scale: early_reject="auto"
+    # falls back loudly at the early_reject gate
+    obs = g.observed_birth_death(n_leaps=N_LEAPS, n_obs=N_OBS,
+                                 segments=SEGMENTS)
+    abc = pt.ABCSMC(_bd_model(), g.birth_death_prior(),
+                    pt.AdaptivePNormDistance(p=2), population_size=32,
+                    eps=pt.MedianEpsilon(), seed=3, early_reject="auto",
+                    fused_generations=2)
+    abc.new("sqlite://", obs)
+    h = abc.run(max_nr_populations=2)
+    assert abc._capability_fallbacks, "fallback not recorded"
+    entry = abc._capability_fallbacks[0]
+    assert entry["gate"] == "early_reject"
+    assert "moment" in entry["reason"]
+    after = global_metrics().counter(CAPABILITY_FALLBACKS_TOTAL).value
+    assert after > before
+    assert global_metrics().counter(
+        capability_fallback_metric("early_reject")).value >= 1
+    tel = h.get_telemetry(0) or {}
+    assert tel.get("capability_fallbacks"), tel
+    assert tel["capability_fallbacks"][0]["gate"] == "early_reject"
+
+
+# ------------------------------------------- composed paths (ISSUE 17)
+#
+# The two speed tentpoles compose: the segmented retire/refill engine
+# runs INSIDE the sharded kernel (shard-local sweeps over each shard's
+# lane-key block), adaptive scales refit unbiased from per-column
+# moments over ALL resolved lanes, and stochastic acceptors retire
+# against per-lane pre-committed acceptance thresholds. The contracts:
+# ON==OFF bit-identity wherever the classic run is the reference
+# (uniform + stochastic accepts), posterior parity for adaptive (the
+# moment refit is a different — unbiased — estimator than the
+# survivor-only ring), and mesh==virtual bit-identity for sharding.
+
+@pytest.mark.slow
+def test_stochastic_early_reject_bit_identical():
+    """A StochasticAcceptor lane retires only when acceptance is
+    provably impossible at its pre-committed draw — accepted
+    populations, weights and the temperature trail are BIT-identical
+    to the classic full-trajectory run."""
+    from pyabc_tpu.epsilon.temperature import ExpDecayFixedIterScheme
+
+    def _run_stoch(early, seed=7):
+        obs = g.observed_birth_death(n_leaps=N_LEAPS, n_obs=N_OBS,
+                                     segments=SEGMENTS)
+        abc = pt.ABCSMC(
+            _bd_model(), g.birth_death_prior(),
+            pt.IndependentNormalKernel(var=4.0), population_size=64,
+            eps=pt.Temperature(schemes=[ExpDecayFixedIterScheme()],
+                               initial_temperature=50.0),
+            acceptor=pt.StochasticAcceptor(), seed=seed,
+            early_reject=early, fused_generations=4)
+        abc.new("sqlite://", obs)
+        return abc, abc.run(max_nr_populations=4)
+
+    _abc_on, h_on = _run_stoch("auto")
+    _abc_off, h_off = _run_stoch(False)
+    assert h_on.max_t == h_off.max_t
+    eps_on = h_on.get_all_populations().query(
+        "t >= 0")["epsilon"].to_numpy()
+    eps_off = h_off.get_all_populations().query(
+        "t >= 0")["epsilon"].to_numpy()
+    assert np.array_equal(eps_on, eps_off)
+    for t in range(h_on.max_t + 1):
+        df1, w1 = h_on.get_distribution(m=0, t=t)
+        df2, w2 = h_off.get_distribution(m=0, t=t)
+        assert np.array_equal(np.asarray(df1), np.asarray(df2))
+        assert np.array_equal(w1, w2)
+    retired = sum(
+        (h_on.get_telemetry(t) or {}).get("retired_early", 0)
+        for t in range(h_on.max_t + 1)
+    )
+    assert retired > 0
+
+
+def test_adaptive_early_reject_posterior_parity():
+    """Adaptive scales under retirement accumulate moments over ALL
+    resolved lanes — a different (unbiased) estimator than the classic
+    survivor ring, so the contract is posterior parity plus actually-
+    retired work, not bit-identity."""
+    from pyabc_tpu.distance.scale import standard_deviation
+
+    def _run_ad(early, seed=5):
+        obs = g.observed_birth_death(n_leaps=N_LEAPS, n_obs=N_OBS,
+                                     segments=SEGMENTS)
+        abc = pt.ABCSMC(
+            _bd_model(), g.birth_death_prior(),
+            pt.AdaptivePNormDistance(
+                p=2, scale_function=standard_deviation),
+            population_size=128, eps=pt.MedianEpsilon(), seed=seed,
+            early_reject=early, fused_generations=4)
+        abc.new("sqlite://", obs)
+        return abc, abc.run(max_nr_populations=5)
+
+    def _post(h):
+        df, w = h.get_distribution(0, h.max_t)
+        th = np.asarray(df)
+        return (th * np.asarray(w)[:, None]).sum(axis=0)
+
+    abc_on, h_on = _run_ad("auto")
+    _abc_off, h_off = _run_ad(False)
+    retired = sum(
+        (h_on.get_telemetry(t) or {}).get("retired_early", 0)
+        for t in range(h_on.max_t + 1)
+    )
+    assert retired > 0
+    np.testing.assert_allclose(_post(h_on), _post(h_off), atol=0.15)
+    # the adaptive weights refit each generation under retirement
+    w = abc_on.distance_function.weights
+    assert len(w) >= 3 and not np.allclose(w[1], w[2])
+
+
+@pytest.mark.mesh
+def test_sharded_segment_bit_identical_to_virtual():
+    """The composed tentpole contract: sharded×segmented runs are
+    bit-identical between the 8-device mesh and the virtual-shard
+    reference, AND to the segmented-off sharded run (early reject
+    skips only provably-rejected work, shard-locally)."""
+    from jax.sharding import Mesh
+
+    def _run_sh(early, mesh=None, sharded=None, seed=11):
+        obs = g.observed_birth_death(n_leaps=N_LEAPS, n_obs=N_OBS,
+                                     segments=SEGMENTS)
+        abc = pt.ABCSMC(
+            _bd_model(), g.birth_death_prior(), pt.PNormDistance(p=2),
+            population_size=64, eps=pt.MedianEpsilon(), seed=seed,
+            early_reject=early, fused_generations=4, mesh=mesh,
+            sharded=sharded)
+        abc.new("sqlite://", obs)
+        return abc, abc.run(max_nr_populations=4)
+
+    def _arrays(h):
+        pops = h.get_all_populations().query("t >= 0")
+        out = {"eps": pops["epsilon"].to_numpy()}
+        for t in pops["t"]:
+            df, w = h.get_distribution(0, int(t))
+            out[f"th_{t}"] = np.asarray(df)
+            out[f"w_{t}"] = np.asarray(w)
+        return out
+
+    devs = jax.devices("cpu")
+    if len(devs) < 8:
+        pytest.skip(f"need 8 virtual cpu devices, have {len(devs)}")
+    _abc_v, h_v = _run_sh("auto", sharded=8)
+    _abc_off, h_off = _run_sh(False, sharded=8)
+    mesh = Mesh(np.asarray(devs[:8]), axis_names=("particles",))
+    abc_m, h_m = _run_sh("auto", mesh=mesh)
+    a, b, c = _arrays(h_v), _arrays(h_off), _arrays(h_m)
+    assert set(a) == set(b) == set(c)
+    for k in a:
+        np.testing.assert_array_equal(
+            a[k], b[k], err_msg=f"sharded seg ON vs OFF diverged at {k}")
+        np.testing.assert_array_equal(
+            a[k], c[k], err_msg=f"mesh vs virtual seg diverged at {k}")
+    # per-shard early-reject accounting rode the packed fetch
+    tel = None
+    for t in range(h_m.max_t + 1):
+        cand = h_m.get_telemetry(t) or {}
+        if cand.get("retired_per_shard"):
+            tel = cand
+            break
+    assert tel is not None
+    assert len(tel["retired_per_shard"]) == 8
+    assert sum(tel["retired_per_shard"]) == tel["retired_early"]
+    assert len(tel["segment_occupancy_per_shard"]) == 8
+    mesh_block = abc_m._engine.snapshot()["mesh"]
+    assert mesh_block["retire_imbalance"] >= 1.0
+    assert len(mesh_block["retired_per_device"]) == 8
