@@ -1861,16 +1861,203 @@ def _mesh_lane_child() -> dict:
     return out
 
 
+def _mesh_multihost_worker(role: str) -> dict:
+    """One process of the mesh lane's ``multihost`` leg (round 18).
+
+    Role "0"/"1": join the 2-process gloo runtime over the localhost
+    coordinator (``PYABC_TPU_BENCH_MESH_MH_PORT``), 4 virtual CPU
+    devices per process, and run the sharded multigen kernel over the
+    8-device GLOBAL mesh. Role "ref": the 1-process virtual-shard run
+    of the identical config — the bit-identity reference. Returns the
+    measured block the parent compares (full-History sha256 digest,
+    epsilon trail, strict sync budget, warm-ish pps)."""
+    if role == "ref":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        mesh = None
+    else:
+        from pyabc_tpu.parallel import distributed as dist
+
+        port = os.environ["PYABC_TPU_BENCH_MESH_MH_PORT"]
+        dist.initialize(f"127.0.0.1:{port}", num_processes=2,
+                        process_id=int(role), platform="cpu",
+                        num_cpu_devices=4)
+        import jax
+
+        mesh = dist.global_mesh()
+    import hashlib
+
+    import numpy as np
+
+    import pyabc_tpu as pt
+    from pyabc_tpu.observability import SYSTEM_CLOCK
+
+    from pyabc_tpu.utils.bench_defaults import (
+        DEFAULT_MESH_MH_GENS,
+        DEFAULT_MESH_MH_POP,
+    )
+
+    pop = int(os.environ.get("PYABC_TPU_BENCH_MESH_MH_POP",
+                             DEFAULT_MESH_MH_POP))
+    gens = int(os.environ.get("PYABC_TPU_BENCH_MESH_MH_GENS",
+                              DEFAULT_MESH_MH_GENS))
+    noise_sd = 0.5
+
+    @pt.JaxModel.from_function(["theta"], name="gauss_mh_bench")
+    def model(key, theta):
+        return {"x": theta[0] + noise_sd * jax.random.normal(key)}
+
+    abc = pt.ABCSMC(
+        model, pt.Distribution(theta=pt.RV("norm", 0.0, 1.0)),
+        pt.PNormDistance(p=2), population_size=pop,
+        eps=pt.MedianEpsilon(), seed=21, mesh=mesh, sharded=8,
+        fused_generations=3,
+    )
+    abc.new("sqlite://", {"x": 1.0})
+    t0 = SYSTEM_CLOCK.now()
+    h = abc.run(max_nr_populations=gens)
+    wall = SYSTEM_CLOCK.now() - t0
+    rep = abc._engine.sync_budget_report() if abc._engine else {}
+    pops = h.get_all_populations().query("t >= 0")
+    dig = hashlib.sha256()
+    dig.update(pops["epsilon"].to_numpy().astype(np.float64).tobytes())
+    for t in pops["t"]:
+        df, w = h.get_distribution(0, int(t))
+        dig.update(df["theta"].to_numpy().astype(np.float64).tobytes())
+        dig.update(np.asarray(w, np.float64).tobytes())
+    return {
+        "role": role,
+        "digest": dig.hexdigest(),
+        "eps": [round(float(e), 10) for e in pops["epsilon"]],
+        "generations": int(h.n_populations),
+        "syncs_per_run": int(rep.get("syncs", -1)),
+        "chunks_per_run": int(rep.get("chunks", -1)),
+        "sync_budget_ok": bool(rep.get("ok", False)),
+        "wall_s": round(wall, 2),
+        "accepted_particles_per_sec": round(
+            pop * h.n_populations / max(wall, 1e-9), 1),
+    }
+
+
+def run_mesh_multihost_leg(budget_s: float) -> dict:
+    """The mesh lane's ``multihost`` leg: the sharded multigen kernel
+    over TWO real processes (gloo CPU collectives, localhost
+    coordinator, 4 virtual devices each — the multi-host-as-multi-
+    process rig the CI ``multihost`` job uses), regression-guarded
+    BIT-identical to the 1-process virtual-shard reference, with the
+    strict per-run sync budget holding across the process boundary
+    (``syncs_per_run <= chunks + O(1)``, DCN collectives ride the
+    existing chunk barriers). PYABC_TPU_BENCH_MESH_MULTIHOST=0
+    disables it; =1 forces it regardless of the budget floor."""
+    import socket
+
+    budget_s = float(budget_s)
+    # the rig needs ~100s wall (2 gloo interpreters compile + run, then
+    # the solo reference) — under that, record a skip instead of eating
+    # the whole bench budget on workers that will be killed mid-compile
+    if (budget_s < 150.0
+            and os.environ.get("PYABC_TPU_BENCH_MESH_MULTIHOST") != "1"):
+        return {"skipped": f"budget {budget_s:.0f}s < 150s floor for the "
+                           "2-process gloo rig "
+                           "(PYABC_TPU_BENCH_MESH_MULTIHOST=1 forces it)"}
+    budget_s = max(budget_s, 60.0)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYABC_TPU_SYNC_BUDGET_STRICT"] = "1"
+    env["PYABC_TPU_BENCH_MESH_MH_PORT"] = str(port)
+
+    def child(role):
+        e = dict(env)
+        e["PYABC_TPU_BENCH_MESH_MH_ROLE"] = role
+        return subprocess.Popen(
+            [sys.executable, os.path.join(HERE, "bench.py")],
+            env=e, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        )
+
+    def last_json(stdout, tag):
+        for line in reversed((stdout or "").strip().splitlines() or [""]):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+        return {"error": f"{tag} emitted no JSON"}
+
+    procs = [child("0"), child("1")]
+    blocks = []
+    try:
+        for role, p in zip(("0", "1"), procs):
+            out, err = p.communicate(timeout=budget_s)
+            if p.returncode != 0:
+                return {"error": f"multihost worker {role} rc="
+                                 f"{p.returncode}: {(err or '')[-400:]}"}
+            blocks.append(last_json(out, f"worker {role}"))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        return {"error": f"multihost workers timed out after {budget_s}s"}
+    try:
+        ref_proc = subprocess.run(
+            [sys.executable, os.path.join(HERE, "bench.py")],
+            env={**env, "PYABC_TPU_BENCH_MESH_MH_ROLE": "ref"},
+            capture_output=True, text=True, timeout=budget_s,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"multihost ref timed out after {budget_s}s"}
+    if ref_proc.returncode != 0:
+        return {"error": f"multihost ref rc={ref_proc.returncode}: "
+                         f"{(ref_proc.stderr or '')[-400:]}"}
+    ref = last_json(ref_proc.stdout, "ref")
+    w0, w1 = blocks
+    bit_identical = (
+        "digest" in w0
+        and w0.get("digest") == w1.get("digest") == ref.get("digest"))
+    return {
+        "n_processes": 2,
+        "devices_per_process": 4,
+        "collectives": "gloo",
+        "eps": w0.get("eps"),
+        "accepted_particles_per_sec_multihost": w0.get(
+            "accepted_particles_per_sec"),
+        "wall_s": w0.get("wall_s"),
+        "ref_wall_s": ref.get("wall_s"),
+        "util": {
+            "syncs_per_run": w0.get("syncs_per_run"),
+            "chunks_per_run": w0.get("chunks_per_run"),
+            "sync_budget_ok": bool(w0.get("sync_budget_ok")),
+        },
+        "regression_guard": {
+            # round-18 acceptance: the 2-process run is BIT-identical
+            # (full-History digest) to the 1-process virtual-shard run,
+            # and the strict sync budget holds on both processes
+            "pass_bit_identity": bool(bit_identical),
+            "pass_sync_budget": bool(
+                w0.get("sync_budget_ok") and w1.get("sync_budget_ok")),
+        },
+    }
+
+
 def run_mesh_lane(budget_s: float, platform: str = "cpu") -> dict:
     """Run the mesh lane in a subprocess. On accelerator platforms the
     child sees the real devices; on CPU it forces 8 virtual devices
     (``--xla_force_host_platform_device_count``) — the same rig the
     test suite and the CI ``mesh`` job use. A hung child never eats
-    the bench budget (timeout -> recorded error)."""
+    the bench budget (timeout -> recorded error). On CPU a slice of the
+    budget goes to the ``multihost`` leg (2-process gloo rig)."""
     budget_s = max(float(budget_s), 60.0)
+    mh_enabled = (platform == "cpu"
+                  and os.environ.get("PYABC_TPU_BENCH_MESH_MULTIHOST")
+                  != "0")
+    mh_share = 0.35 if mh_enabled else 0.0
+    child_budget = budget_s * (1.0 - mh_share)
     env = dict(os.environ)
     env["PYABC_TPU_BENCH_MESH_CHILD"] = "1"
-    env["PYABC_TPU_BENCH_MESH_BUDGET_S"] = str(budget_s * 0.9)
+    env["PYABC_TPU_BENCH_MESH_BUDGET_S"] = str(child_budget * 0.9)
     # the budget is an armed invariant in the lane, not a soft warning
     env["PYABC_TPU_SYNC_BUDGET_STRICT"] = "1"
     if platform == "cpu":
@@ -1883,17 +2070,28 @@ def run_mesh_lane(budget_s: float, platform: str = "cpu") -> dict:
     try:
         proc = subprocess.run(
             [sys.executable, os.path.join(HERE, "bench.py")],
-            env=env, capture_output=True, text=True, timeout=budget_s,
+            env=env, capture_output=True, text=True,
+            timeout=child_budget,
         )
     except subprocess.TimeoutExpired:
-        return {"error": f"mesh lane child timed out after {budget_s}s"}
+        return {"error": f"mesh lane child timed out after "
+                         f"{child_budget}s"}
+    out = None
     for line in reversed(proc.stdout.strip().splitlines() or [""]):
         try:
-            return json.loads(line)
+            out = json.loads(line)
+            break
         except json.JSONDecodeError:
             continue
-    return {"error": f"mesh lane child rc={proc.returncode}: "
-                     f"{(proc.stderr or '')[-400:]}"}
+    if out is None:
+        return {"error": f"mesh lane child rc={proc.returncode}: "
+                         f"{(proc.stderr or '')[-400:]}"}
+    if mh_enabled:
+        try:
+            out["multihost"] = run_mesh_multihost_leg(budget_s * mh_share)
+        except Exception as e:
+            out["multihost"] = {"error": repr(e)[:300]}
+    return out
 
 
 # -- serve lane ---------------------------------------------------------------
@@ -3306,6 +3504,14 @@ if __name__ == "__main__":
         # ONE JSON line
         _emitted = True
         print(json.dumps(_mesh_lane_child()))
+        sys.exit(0)
+    if os.environ.get("PYABC_TPU_BENCH_MESH_MH_ROLE"):
+        # multihost-leg subprocess: roles "0"/"1" join the 2-process gloo
+        # runtime over the localhost coordinator; role "ref" is the
+        # 1-process virtual-shard reference the digests are checked against
+        _emitted = True
+        print(json.dumps(
+            _mesh_multihost_worker(os.environ["PYABC_TPU_BENCH_MESH_MH_ROLE"])))
         sys.exit(0)
     if os.environ.get("PYABC_TPU_BENCH_SCENARIO_SHARDED_CHILD"):
         # sharded scenario leg subprocess: same contract as the mesh

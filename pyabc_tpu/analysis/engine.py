@@ -67,6 +67,9 @@ INSTRUMENTED = frozenset({
     "pyabc_tpu/traffic/specs.py",
     "pyabc_tpu/traffic/generator.py",
     "pyabc_tpu/serving/lifecycle.py",
+    # round 18: the ONE sanctioned multi-process runtime module
+    # (DIST001's allow-list target) must stay in the scan
+    "pyabc_tpu/parallel/distributed.py",
 })
 
 

@@ -11,6 +11,7 @@ from __future__ import annotations
 from .clock import Clock001
 from .collectives import Mesh001
 from .dispatch import Disp001
+from .distributed import Dist001
 from .exceptions import Exc001
 from .isolation import Iso001
 from .locks import Lock001
@@ -20,7 +21,7 @@ from .sync import Sync001
 from .telemetry import Telem001
 
 RULE_CLASSES = [Sync001, Clock001, Rng001, Exc001, Lock001, Telem001,
-                Disp001, Mesh001, Iso001, Place001]
+                Disp001, Mesh001, Iso001, Place001, Dist001]
 
 
 def all_rules():

@@ -1,0 +1,82 @@
+"""DIST001 — the multi-process runtime is touched in ONE module only.
+
+The multi-host contract (round 18) is that every process runs the SAME
+program and stays in lock-step through the kernels' collectives; the
+only host-side API that may observe or change the process topology is
+``pyabc_tpu/parallel/distributed.py`` (``initialize``, ``is_primary``,
+``primary_db``/``resume_db``, ``barrier``). A ``jax.process_index()``
+probe in the SMC loop, a stray ``jax.distributed.initialize`` in a
+test helper, or a ``multihost_utils`` barrier in the serving layer is
+a divergence hazard: it forks host-side control flow per process (the
+exact class of bug the replicated-deterministic adaptation contract
+exists to prevent) and bypasses the one place where topology config is
+validated (idempotence + partial-env guards). Mirrors MESH001 (mesh
+traffic lives in the kernel layer) and PLACE001 (device enumeration
+lives in placement) for the process dimension.
+
+Note: ``Device.process_index`` ATTRIBUTE reads (the mesh gate in
+``smc.py``/``util.py``) are fine — they inspect a mesh object, not the
+runtime; this rule fires on CALLS into the distributed runtime.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileContext, Finding, Rule
+
+#: the one sanctioned module
+ALLOWED_FILES = {"pyabc_tpu/parallel/distributed.py"}
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """`a.b.c` -> "a.b.c" for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+class Dist001(Rule):
+    name = "DIST001"
+    summary = ("multi-process runtime call outside "
+               "pyabc_tpu/parallel/distributed.py")
+    hint = ("route process-topology access through "
+            "pyabc_tpu/parallel/distributed.py (initialize/is_primary/"
+            "process_count/primary_db/resume_db/barrier) — a direct "
+            "jax.distributed / jax.process_index / multihost_utils call "
+            "elsewhere forks host-side control flow per process and "
+            "bypasses the module's config validation")
+
+    def applies_to(self, rel: str) -> bool:
+        if not rel.startswith("pyabc_tpu/"):
+            return False
+        if rel.startswith("pyabc_tpu/analysis/"):
+            return False
+        return rel not in ALLOWED_FILES
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            if dotted is None or not (
+                    "jax.distributed" in dotted
+                    or "multihost_utils" in dotted
+                    or dotted.endswith("jax.process_index")
+                    or dotted.endswith("jax.process_count")):
+                continue
+            name = dotted
+            findings.append(self.finding(
+                ctx, node,
+                f"`{name}(...)` touches the multi-process runtime "
+                f"outside pyabc_tpu/parallel/distributed.py — topology "
+                f"access routes through that module's validated helpers "
+                f"(is_primary/process_count/primary_db/resume_db/"
+                f"barrier)",
+            ))
+        return findings

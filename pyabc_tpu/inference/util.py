@@ -299,6 +299,17 @@ class DeviceContext:
 
         self.sync_ledger = NULL_SYNC_LEDGER
 
+    def mesh_is_multihost(self) -> bool:
+        """True when the mesh spans more than one process. Kernels that
+        hand results back to the host replicate their outputs in this
+        case (``out_shardings=NamedSharding(mesh, P())``) so EVERY
+        process can device_get the full tree for the replicated
+        persist/adaptation step — the all-gather over DCN at the chunk
+        barrier is the reference's Redis result-queue drain."""
+        return self.mesh is not None and len(
+            {d.process_index for d in self.mesh.devices.flat}
+        ) > 1
+
     # ------------------------------------------------------------------ build
     @staticmethod
     def _shard_lane_keys(keys, lane_sharding):
@@ -1230,9 +1241,7 @@ class DeviceContext:
                 )
             return out
 
-        if self.mesh is not None and len(
-            {d.process_index for d in self.mesh.devices.flat}
-        ) > 1:
+        if self.mesh_is_multihost():
             # multi-host: replicate outputs (an all-gather over DCN at the
             # generation barrier — the reference's result-queue drain) so
             # every host can device_get the full reservoir for the
@@ -1309,10 +1318,8 @@ class DeviceContext:
                              ss_gens=ss_key, m_dtype=m_dtype, g_keep=g_keep,
                              merge_index=midx)
 
-        multi_host = self.mesh is not None and len(
-            {d.process_index for d in self.mesh.devices.flat}
-        ) > 1
-        if multi_host or (self.mesh is not None and midx is not None):
+        if self.mesh_is_multihost() or (
+                self.mesh is not None and midx is not None):
             # multi-host: keep the packed tree replicated like the outs it
             # compacts, so every host can device_get it. Sharded
             # single-host: replicating here makes the row merge an
@@ -2111,9 +2118,7 @@ class DeviceContext:
                 ret["calib"] = calib_info
             return ret
 
-        if self.mesh is not None and len(
-            {d.process_index for d in self.mesh.devices.flat}
-        ) > 1:
+        if self.mesh_is_multihost():
             # multi-host: replicate the per-generation outputs (one
             # all-gather over DCN at the CHUNK barrier — G generations per
             # cross-host sync instead of one) so every host can device_get

@@ -87,6 +87,7 @@ __all__ = [
     "dispatch_sources_snapshot",
     "register_tenant_source", "unregister_tenant_source",
     "tenant_sources_snapshot",
+    "record_host_clock_offset", "host_clocks_snapshot",
 ]
 
 _lock = _threading.Lock()
@@ -282,6 +283,29 @@ def tenant_sources_snapshot() -> dict:
     return out
 
 
+#: measured clock offsets of OTHER hosts against this process's clock:
+#: host name -> the ClockOffsetEstimator summary dict recorded by
+#: ``parallel.distributed.measure_clock_offset``. Multi-host span merges
+#: read this table to map a secondary host's monotonic timestamps onto
+#: the coordinator's timeline (offset ± RTT/2).
+_host_clocks: dict = {}
+
+
+def record_host_clock_offset(host: str, summary: dict) -> None:
+    """Record a remote host's measured clock offset (a
+    :meth:`~pyabc_tpu.observability.ClockOffsetEstimator.summary` dict)
+    under ``host`` in the process-wide snapshot. Re-measurement
+    replaces the earlier record."""
+    with _lock:
+        _host_clocks[str(host)] = dict(summary)
+
+
+def host_clocks_snapshot() -> dict:
+    """{host: offset summary} for every measured remote host."""
+    with _lock:
+        return {h: dict(s) for h, s in _host_clocks.items()}
+
+
 def observability_snapshot() -> dict:
     """One JSON-ready dict of the process's tracer + metrics state —
     the in-process snapshot API (dashboard endpoint, bench block).
@@ -291,11 +315,13 @@ def observability_snapshot() -> dict:
     chunks, speculative rollbacks, sync budget); ``tenants`` carries
     each live serving-layer tenant's PRIVATE tracer/metrics namespace —
     concurrent runs aggregate side by side instead of interleaving
-    through the process globals."""
+    through the process globals; ``hosts`` carries the measured clock
+    offset (± RTT/2) of every remote host probed from this process."""
     return {
         "tracer": global_tracer().snapshot(),
         "metrics": global_metrics().snapshot(),
         "workers": _workers_snapshot(),
         "dispatch": dispatch_sources_snapshot(),
         "tenants": tenant_sources_snapshot(),
+        "hosts": host_clocks_snapshot(),
     }

@@ -315,6 +315,10 @@ SUBMESH_WIDEST_FREE_GAUGE = "pyabc_tpu_submesh_widest_free"
 TENANT_PREEMPTIONS_TOTAL = "pyabc_tpu_tenant_preemptions_total"
 #:  devices marked lost (hard mesh loss — capacity shrunk, leases reaped)
 DEVICES_LOST_TOTAL = "pyabc_tpu_devices_lost_total"
+#:  whole hosts marked lost (round 18 fleets — the host's entire
+#:  allocator segment quarantined, every lease on it reaped, admission
+#:  repriced on the surviving fleet)
+HOSTS_LOST_TOTAL = "pyabc_tpu_hosts_lost_total"
 #:  tenants requeued because their sub-mesh lost a device (infrastructure
 #:  fault: does NOT consume the tenant's own requeue budget)
 TENANT_DEVICE_LOSS_REQUEUES_TOTAL = \

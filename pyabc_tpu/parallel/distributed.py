@@ -37,6 +37,56 @@ import os
 import numpy as np
 
 
+class DistributedConfigError(RuntimeError):
+    """A multi-host configuration error caught BEFORE it reaches the JAX
+    runtime: partial env (coordinator without a process count, or the
+    reverse) and conflicting re-initialization both used to surface as
+    opaque late failures inside ``jax.distributed.initialize``."""
+
+
+#: the config of the one successful :func:`initialize` call (None until
+#: then). The JAX distributed runtime cannot be re-initialized, so a
+#: second call with the SAME config is a no-op and a second call with a
+#: DIFFERENT config is a typed error instead of a runtime crash.
+_INIT_CONFIG: dict | None = None
+
+
+def _resolve_init_config(coordinator_address, num_processes, process_id, *,
+                         platform, num_cpu_devices,
+                         cpu_collectives) -> dict:
+    """Merge explicit args with the PYABC_TPU_* env fallbacks and reject
+    partial configurations with a typed error."""
+    coordinator_address = coordinator_address or os.environ.get(
+        "PYABC_TPU_COORDINATOR"
+    )
+    if num_processes is None and "PYABC_TPU_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["PYABC_TPU_NUM_PROCESSES"])
+    if process_id is None and "PYABC_TPU_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["PYABC_TPU_PROCESS_ID"])
+    # explicit coordination needs the full triple: a coordinator without a
+    # process count (or the reverse) dies deep inside the JAX client with
+    # a timeout/assert long after the real mistake — fail here, named.
+    explicit = {
+        "coordinator_address": coordinator_address,
+        "num_processes": num_processes,
+        "process_id": process_id,
+    }
+    given = {k for k, v in explicit.items() if v is not None}
+    if given and given != set(explicit):
+        missing = sorted(set(explicit) - given)
+        raise DistributedConfigError(
+            "partial multi-host configuration: "
+            f"{sorted(given)} set but {missing} missing — pass all of "
+            "coordinator_address/num_processes/process_id (env: "
+            "PYABC_TPU_COORDINATOR / PYABC_TPU_NUM_PROCESSES / "
+            "PYABC_TPU_PROCESS_ID), or none of them for TPU-pod "
+            "auto-detection"
+        )
+    return dict(explicit, platform=platform,
+                num_cpu_devices=num_cpu_devices,
+                cpu_collectives=cpu_collectives)
+
+
 def initialize(coordinator_address: str | None = None,
                num_processes: int | None = None,
                process_id: int | None = None, *,
@@ -47,13 +97,37 @@ def initialize(coordinator_address: str | None = None,
 
     Env fallbacks: ``PYABC_TPU_COORDINATOR``, ``PYABC_TPU_NUM_PROCESSES``,
     ``PYABC_TPU_PROCESS_ID`` — or, on real multi-host TPU pods, pass nothing
-    and let JAX's cluster auto-detection fill everything in.
+    and let JAX's cluster auto-detection fill everything in. A partial
+    config (coordinator without a process count, or the reverse) raises
+    :class:`DistributedConfigError` here instead of timing out inside the
+    JAX client.
+
+    Idempotent: a second call with the SAME resolved config is a no-op;
+    a second call with a DIFFERENT config raises
+    :class:`DistributedConfigError` (the runtime cannot re-initialize).
+    Both guards run BEFORE any ``jax.config`` mutation, so a rejected
+    call leaves the process untouched.
 
     ``platform='cpu'`` + ``num_cpu_devices=N`` force an N-virtual-device CPU
     backend per process (the multi-host-as-multi-process-on-localhost test
     rig, mirroring the reference's localhost Redis tests); CPU cross-process
     collectives use ``cpu_collectives`` ('gloo').
     """
+    global _INIT_CONFIG
+    config = _resolve_init_config(
+        coordinator_address, num_processes, process_id,
+        platform=platform, num_cpu_devices=num_cpu_devices,
+        cpu_collectives=cpu_collectives,
+    )
+    if _INIT_CONFIG is not None:
+        if config == _INIT_CONFIG:
+            return  # already initialized with this exact config
+        raise DistributedConfigError(
+            "jax.distributed is already initialized with a different "
+            f"config: first {_INIT_CONFIG!r}, now {config!r} — the "
+            "runtime cannot be re-initialized; restart the process to "
+            "change the mesh topology"
+        )
     import jax
 
     if platform is not None:
@@ -76,17 +150,12 @@ def initialize(coordinator_address: str | None = None,
         jax.config.update(
             "jax_cpu_collectives_implementation", cpu_collectives
         )
-    coordinator_address = coordinator_address or os.environ.get(
-        "PYABC_TPU_COORDINATOR"
-    )
-    if num_processes is None and "PYABC_TPU_NUM_PROCESSES" in os.environ:
-        num_processes = int(os.environ["PYABC_TPU_NUM_PROCESSES"])
-    if process_id is None and "PYABC_TPU_PROCESS_ID" in os.environ:
-        process_id = int(os.environ["PYABC_TPU_PROCESS_ID"])
     jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes, process_id=process_id,
+        coordinator_address=config["coordinator_address"],
+        num_processes=config["num_processes"],
+        process_id=config["process_id"],
     )
+    _INIT_CONFIG = config
 
 
 def global_mesh(axis_name: str = "particles"):
@@ -121,11 +190,58 @@ def is_primary() -> bool:
     return jax.process_index() == 0
 
 
+def process_count() -> int:
+    """Number of processes in the distributed runtime (1 if
+    single-process)."""
+    import jax
+
+    return jax.process_count()
+
+
 def primary_db(db: str) -> str:
     """The real db url on the primary host, a throwaway in-memory store on
     the others (the History is written identically everywhere; one copy is
     enough and sqlite files must not be shared over NFS)."""
     return db if is_primary() else "sqlite://"
+
+
+def resume_db(db: str) -> str:
+    """The db url to ``load()`` from when RESUMING a preempted multi-host
+    run.
+
+    Checkpoint adoption validates ``abc_id`` + run fingerprint against the
+    History, so every process must rebuild IDENTICAL resume state — but
+    only the primary may keep writing the real file (sqlite files must not
+    be written concurrently). On the primary this is just ``db``; every
+    other process gets a private COPY of the primary's sqlite file
+    (``<path>.proc<i>``), read at load time and thrown away with the
+    process. Non-file urls (including in-memory) have nothing to copy and
+    fall back to the throwaway store."""
+    import jax
+
+    if jax.process_index() == 0:
+        return db
+    prefix = "sqlite:///"
+    if not db.startswith(prefix):
+        return "sqlite://"
+    path = db[len(prefix):]
+    if not os.path.exists(path):
+        return "sqlite://"
+    import sqlite3
+
+    copy = f"{path}.proc{jax.process_index()}"
+    # the backup API folds the -wal sidecar in; a bare file copy would
+    # silently drop every commit still living in the WAL
+    src = sqlite3.connect(path)
+    try:
+        dst = sqlite3.connect(copy)
+        try:
+            src.backup(dst)
+        finally:
+            dst.close()
+    finally:
+        src.close()
+    return prefix + copy
 
 
 def barrier(name: str = "pyabc_tpu_barrier") -> None:
@@ -134,3 +250,108 @@ def barrier(name: str = "pyabc_tpu_barrier") -> None:
     from jax.experimental import multihost_utils
 
     multihost_utils.sync_global_devices(name)
+
+
+# --------------------------------------------------------- host clocks
+#
+# Span timestamps are per-process monotonic readings with no shared
+# epoch, so merging a secondary host's trace onto the coordinator's
+# timeline needs a measured offset. The rig is the ClockOffsetEstimator's
+# NTP exchange over a bare TCP socket: each probe sends one byte at local
+# t1, the remote replies with its clock at t2, the reply lands at t4 —
+# offset = t2 - (t1+t4)/2, uncertainty = RTT/2 (clock.py). No JAX
+# involved: the exchange must work before (and independent of) the
+# distributed runtime.
+
+def serve_clock(port: int = 0, clock=None):
+    """Serve this process's monotonic clock over TCP for offset probes.
+
+    Returns ``(port, stop)``: the bound port and a zero-argument callable
+    that shuts the server down. Each connection answers any number of
+    1-byte probes, each with the 8-byte big-endian float ``clock.now()``.
+    """
+    import socket
+    import struct
+    import threading
+
+    from ..observability.clock import SYSTEM_CLOCK
+
+    clock = clock or SYSTEM_CLOCK
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", int(port)))
+    srv.listen(8)
+    stopping = threading.Event()
+
+    def _handle(conn):
+        with conn:
+            while not stopping.is_set():
+                try:
+                    if not conn.recv(1):
+                        return
+                    conn.sendall(struct.pack("!d", clock.now()))
+                except OSError:
+                    return
+
+    def _accept_loop():
+        while not stopping.is_set():
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=_handle, args=(conn,), daemon=True
+            ).start()
+
+    bound_port = srv.getsockname()[1]
+    thread = threading.Thread(target=_accept_loop, daemon=True)
+    thread.start()
+
+    def stop():
+        stopping.set()
+        try:
+            srv.close()
+        except OSError:
+            pass
+
+    return bound_port, stop
+
+
+def measure_clock_offset(address: str, *, host: str | None = None,
+                         n_samples: int = 16, clock=None):
+    """Measure a remote host's clock offset against the local clock.
+
+    ``address`` is ``"host:port"`` of a :func:`serve_clock` endpoint.
+    Runs ``n_samples`` NTP-style exchanges through a
+    :class:`~pyabc_tpu.observability.ClockOffsetEstimator` (min-RTT
+    sample wins) and returns the estimator. When ``host`` is given the
+    summary is also recorded into the process-wide observability
+    snapshot's per-host clock table
+    (:func:`~pyabc_tpu.observability.record_host_clock_offset`).
+    """
+    import socket
+    import struct
+
+    from .. import observability
+    from ..observability.clock import ClockOffsetEstimator, SYSTEM_CLOCK
+
+    clock = clock or SYSTEM_CLOCK
+    hostname, _, port = address.rpartition(":")
+    est = ClockOffsetEstimator()
+    with socket.create_connection((hostname, int(port)), timeout=30) as s:
+        for _ in range(int(n_samples)):
+            t1 = clock.now()
+            s.sendall(b"p")
+            buf = b""
+            while len(buf) < 8:
+                chunk = s.recv(8 - len(buf))
+                if not chunk:
+                    raise ConnectionError(
+                        f"clock server at {address} closed mid-probe")
+                buf += chunk
+            t4 = clock.now()
+            (t2_remote,) = struct.unpack("!d", buf)
+            est.add_sample(t1, t2_remote, t4)
+    if host is not None:
+        observability.record_host_clock_offset(host, est.summary())
+    return est

@@ -124,10 +124,12 @@ _KIND_CORRUPT = ("nan_poison", "cov_corrupt", "weight_zero")
 #: scheduler pump at the ``device.mesh`` site (maybe_device_fault) —
 #: ``device_lost`` marks the rule's ``devices`` range dead (leases
 #: touching them are reaped, capacity shrinks), ``device_degraded``
-#: cordons them (no new placements, existing leases drain naturally).
-#: Nothing raises: losing hardware is a scheduler event, not an
-#: exception on any tenant's thread.
-_KIND_DEVICE = ("device_lost", "device_degraded")
+#: cordons them (no new placements, existing leases drain naturally),
+#: ``host_lost`` (round 18 fleets) kills whole HOSTS — its ``devices``
+#: spec names host indices; the scheduler quarantines each host's
+#: entire allocator segment. Nothing raises: losing hardware is a
+#: scheduler event, not an exception on any tenant's thread.
+_KIND_DEVICE = ("device_lost", "device_degraded", "host_lost")
 KINDS = (tuple(_KIND_EXC) + tuple(_KIND_SLEEP) + _KIND_CORRUPT
          + _KIND_DEVICE)
 

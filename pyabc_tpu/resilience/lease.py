@@ -176,6 +176,36 @@ class LeaseTable:
             })
         return events
 
+    def reap_wids(self, wids, reason: str = "owner_reaped") -> list[dict]:
+        """Immediately reap EVERY lease belonging to ``wids``, deadline
+        notwithstanding — the host-loss path (round 18 fleets): when a
+        machine dies, all of its owners' leases go at once; waiting out
+        the per-lease timeout would stall requeue by exactly the
+        detection latency. Returns the same events as :meth:`reap`,
+        tagged ``reason``."""
+        dead = {str(w) for w in wids}
+        now = self.clock.now()
+        events = []
+        for lease_id in list(self._leases):
+            lease = self._leases[lease_id]
+            if lease["wid"] not in dead:
+                continue
+            ranges = _runs(sorted(lease["slots"]))
+            orphaned_at = min(lease["deadline"] - self.timeout_s, now)
+            for a, b in ranges:
+                self._requeue.append((a, b, orphaned_at))
+            for s in lease["slots"]:
+                self._slot_owner.pop(s, None)
+            del self._leases[lease_id]
+            self.leases_expired += 1
+            events.append({
+                "wid": lease["wid"],
+                "n_slots": sum(b - a for a, b in ranges),
+                "ranges": ranges,
+                "reason": str(reason),
+            })
+        return events
+
     def discard_requeued(self) -> int:
         """Drop every requeued range without redispatching it; returns
         the number of slots discarded.

@@ -84,13 +84,19 @@ class AdmissionController:
     """
 
     def __init__(self, *, max_queued: int = 16, n_chips: int = 1,
-                 clock=None, metrics=None):
+                 clock=None, metrics=None, n_hosts: int = 1):
         from ..observability import NULL_METRICS, SYSTEM_CLOCK
 
         self.max_queued = int(max_queued)
         self.clock = clock if clock is not None else SYSTEM_CLOCK
         self.metrics = metrics if metrics is not None else NULL_METRICS
         self._lock = threading.Lock()
+        #: ``n_chips`` is the FLEET total across all hosts (round 18):
+        #: chip-seconds price identically wherever the chip lives, so
+        #: host loss is just a big ``set_capacity`` step. ``n_hosts``
+        #: rides along for observability — the dashboard reads capacity
+        #: as hosts × chips-per-host.
+        self.n_hosts = max(int(n_hosts), 1)
         self._n_chips = max(int(n_chips), 1)  # abc-lint: guarded-by=_lock
         #: EW-averaged chip-seconds per completed run; None until the
         #: first completion (cold start: spec-seeded hints)
@@ -172,6 +178,7 @@ class AdmissionController:
                 "admitted_total": self.admitted_total,
                 "rejected_total": self.rejected_total,
                 "n_chips": self._n_chips,
+                "n_hosts": self.n_hosts,
                 "avg_chip_s": (
                     None if self._avg_chip_s is None
                     else round(self._avg_chip_s, 3)

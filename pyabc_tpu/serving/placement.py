@@ -14,6 +14,17 @@ module owns all of the topology:
   sub-mesh). Devices can be marked LOST (reaped from capacity —
   quarantined on free, never re-issued) or DEGRADED (cordoned: existing
   leases drain naturally, no new placements).
+
+  Round 18 (multi-host fleets): the device line becomes PER-HOST
+  SEGMENTS — ``n_hosts`` equal runs of ``devices_per_host`` devices.
+  Buddy alignment makes host confinement free: with a power-of-two
+  segment, any aligned block of width <= devices_per_host sits inside
+  exactly one host, so only widths ABOVE the segment can straddle
+  hosts — and those need an explicit ``multi_host=True`` lease (a
+  tenant that opted into DCN collectives). Host loss
+  (:meth:`mark_host_lost`) is device loss for the whole segment at
+  once: every lease touching it is reported for reaping, the segment
+  quarantines, capacity shrinks by ``devices_per_host``.
 - :func:`feasible_widths` — the placement policy for a ``sharded=n``
   request: the PR-9/15 kernel contract makes the reduction a pure
   function of ``n_shards``, so ANY power-of-two divisor width works
@@ -61,10 +72,21 @@ class SubMeshAllocator:
     ("zero leaked/overlapping device ranges").
     """
 
-    def __init__(self, n_devices: int, *, packing: int = 1):
+    def __init__(self, n_devices: int, *, packing: int = 1,
+                 n_hosts: int = 1):
         self.n_devices = int(n_devices)
         if self.n_devices < 1:
             raise ValueError("need at least one device")
+        self.n_hosts = max(int(n_hosts), 1)
+        if self.n_devices % self.n_hosts:
+            raise ValueError(
+                f"{self.n_devices} devices do not split evenly over "
+                f"{self.n_hosts} hosts")
+        self.devices_per_host = self.n_devices // self.n_hosts
+        if self.n_hosts > 1 and not _is_pow2(self.devices_per_host):
+            raise ValueError(
+                f"devices_per_host={self.devices_per_host} must be a "
+                f"power of two for host-confined buddy blocks")
         self.packing = max(int(packing), 1)
         #: width -> sorted list of free block offsets
         self._free: dict[int, list[int]] = {}
@@ -77,23 +99,41 @@ class SubMeshAllocator:
         self._owner_shared: dict[str, int] = {}
         self._lost: set[int] = set()
         self._degraded: set[int] = set()
+        self._lost_hosts: set[int] = set()
         # lifetime counters (observability)
         self.allocs_total = 0
         self.frees_total = 0
         self.coalesces_total = 0
         self.devices_lost_total = 0
+        self.hosts_lost_total = 0
+
+    def host_of(self, device: int) -> int:
+        """Which host segment device ``device`` lives in."""
+        return int(device) // self.devices_per_host
 
     # ------------------------------------------------------------ alloc
-    def alloc(self, width: int, owner: str) -> int | None:
+    def alloc(self, width: int, owner: str, *,
+              multi_host: bool = False) -> int | None:
         """Lease a contiguous ``width``-device sub-mesh to ``owner``;
         returns the base device index, or None when nothing fits now
         (the tenant stays queued). Width 1 packs into a shared block
-        when ``packing > 1``."""
+        when ``packing > 1``. On a multi-host pool, widths above
+        ``devices_per_host`` straddle host segments (whole hosts, DCN
+        collectives in the tenant's critical path) and need an explicit
+        ``multi_host=True``."""
         width = int(width)
         owner = str(owner)
         if not _is_pow2(width):
             raise ValueError(f"sub-mesh width must be a power of two, "
                              f"got {width}")
+        if self.n_hosts > 1 and width > self.devices_per_host \
+                and not multi_host:
+            raise ValueError(
+                f"width {width} spans "
+                f"{width // self.devices_per_host} host segments of "
+                f"{self.devices_per_host} devices; sub-mesh leases never "
+                f"straddle hosts implicitly — pass multi_host=True for "
+                f"an explicitly multi-host sharded tenant")
         if owner in self._exclusive or owner in self._owner_shared:
             raise ValueError(f"owner {owner!r} already holds a lease")
         if width == 1 and self.packing > 1:
@@ -238,6 +278,27 @@ class SubMeshAllocator:
                 affected.update(owners)
         return sorted(affected)
 
+    def mark_host_lost(self, host: int) -> list[str]:
+        """Whole-host loss: quarantine the host's entire device segment
+        in one step. Returns every owner whose lease touches the dead
+        host — each must be reaped and re-placed (a host-confined lease
+        dies with its host; an explicitly multi-host lease dies when ANY
+        of its hosts does). Counted separately from plain device loss
+        (``hosts_lost_total``) so the fleet dashboard distinguishes a
+        flaky chip from a dead machine."""
+        host = int(host)
+        if host < 0 or host >= self.n_hosts:
+            raise ValueError(f"host {host} out of range "
+                             f"(0..{self.n_hosts - 1})")
+        lo = host * self.devices_per_host
+        segment = range(lo, lo + self.devices_per_host)
+        already = all(d in self._lost for d in segment)
+        affected = self.mark_lost(segment)
+        if not already and host not in self._lost_hosts:
+            self._lost_hosts.add(host)
+            self.hosts_lost_total += 1
+        return affected
+
     def mark_degraded(self, devices) -> None:
         """Cordon: no NEW placements on these devices; existing leases
         drain naturally (the soft half of device loss)."""
@@ -256,6 +317,12 @@ class SubMeshAllocator:
             if d in self._lost:
                 self._lost.remove(d)
                 self._coalesce(d, 1)
+        self._lost_hosts = {
+            h for h in self._lost_hosts
+            if any(d in self._lost
+                   for d in range(h * self.devices_per_host,
+                                  (h + 1) * self.devices_per_host))
+        }
 
     def _free_block_containing(self, d: int) -> tuple[int, int] | None:
         for size, los in self._free.items():
@@ -291,8 +358,11 @@ class SubMeshAllocator:
         return {
             "n_devices": self.n_devices,
             "packing": self.packing,
+            "n_hosts": self.n_hosts,
+            "devices_per_host": self.devices_per_host,
             "healthy_devices": self.healthy_count(),
             "lost_devices": sorted(self._lost),
+            "lost_hosts": sorted(self._lost_hosts),
             "degraded_devices": sorted(self._degraded),
             "free_devices": free_devices,
             "widest_free": self.widest_free(),
@@ -312,6 +382,7 @@ class SubMeshAllocator:
             "frees_total": self.frees_total,
             "coalesces_total": self.coalesces_total,
             "devices_lost_total": self.devices_lost_total,
+            "hosts_lost_total": self.hosts_lost_total,
         }
 
     def check_invariants(self) -> list[str]:
@@ -339,6 +410,12 @@ class SubMeshAllocator:
             if lo % width:
                 problems.append(
                     f"misaligned lease {owner}=({lo},{width})")
+            if width <= self.devices_per_host and \
+                    self.host_of(lo) != self.host_of(lo + width - 1):
+                problems.append(
+                    f"host-confinable lease {owner}=({lo},{width}) "
+                    f"straddles hosts {self.host_of(lo)} and "
+                    f"{self.host_of(lo + width - 1)}")
             for d in range(lo, lo + width):
                 claim(d, f"lease:{owner}")
         for lo, owners in self._shared.items():

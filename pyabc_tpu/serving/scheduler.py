@@ -71,6 +71,7 @@ from ..observability import (
 )
 from ..observability.metrics import (
     DEVICES_LOST_TOTAL,
+    HOSTS_LOST_TOTAL,
     SUBMESH_DEVICES_FREE_GAUGE,
     SUBMESH_DEVICES_HEALTHY_GAUGE,
     SUBMESH_WIDEST_FREE_GAUGE,
@@ -120,7 +121,7 @@ class RunScheduler:
     DEFAULT_LEASE_TIMEOUT_S = 60.0
 
     def __init__(self, n_slots: int = 1, *, n_devices: int | None = None,
-                 packing: int = 1, max_queued: int = 16,
+                 packing: int = 1, n_hosts: int = 1, max_queued: int = 16,
                  lease_timeout_s: float = DEFAULT_LEASE_TIMEOUT_S,
                  max_requeues: int = 1,
                  preempt_queue_wait_s: float | None = None,
@@ -142,8 +143,14 @@ class RunScheduler:
         #: by the kernel's width-independence contract.
         pool = int(n_devices) if n_devices is not None else max(
             int(n_slots), 1)
+        #: ``n_hosts > 1`` models the fleet: the pool splits into equal
+        #: per-host device segments, leases confine to one host unless
+        #: the tenant explicitly opted into multi-host placement, and a
+        #: dead host reaps its whole segment in one step
         self.allocator = placement.SubMeshAllocator(
-            pool, packing=max(int(packing), 1))
+            pool, packing=max(int(packing), 1),
+            n_hosts=max(int(n_hosts), 1))
+        self.n_hosts = self.allocator.n_hosts
         self.packing = self.allocator.packing
         #: width-1-equivalent concurrency, kept for API/status compat
         self.n_slots = pool * self.packing
@@ -169,7 +176,7 @@ class RunScheduler:
 
         self.admission = AdmissionController(
             max_queued=max_queued, n_chips=pool, clock=self.clock,
-            metrics=self.metrics,
+            metrics=self.metrics, n_hosts=self.n_hosts,
         )
         #: retention/GC/quota layer (round 19): the pump sweeps it,
         #: submit consults its quota gate, and terminal-tenant eviction
@@ -202,6 +209,7 @@ class RunScheduler:
         self._shutdown = False
         self.stale_reports_discarded = 0
         self.devices_lost_total = 0
+        self.hosts_lost_total = 0
         self._pump = threading.Thread(
             target=self._pump_loop, daemon=True, name="abc-serve-pump")
         self._pump.start()
@@ -382,6 +390,7 @@ class RunScheduler:
             "state_filter": state,
             "placement": place,
             "devices_lost_total": int(self.devices_lost_total),
+            "hosts_lost_total": int(self.hosts_lost_total),
             "leases": self.leases.stats(),
             "admission": self.admission.stats(),
             "lifecycle": self.lifecycle.stats(),
@@ -418,11 +427,51 @@ class RunScheduler:
             self.allocator.mark_degraded(devices)
             self._set_occupancy_gauges_locked()
 
+    def mark_host_lost(self, host: int) -> list[str]:
+        """Whole-host loss (a machine died, not a chip): the host's
+        entire allocator segment quarantines in one step, every lease
+        touching it is reaped, the affected tenants requeue budget-free
+        from their chunk-boundary checkpoints, and admission reprices
+        on the surviving fleet capacity. Returns the affected tenant
+        ids."""
+        with self._lock:
+            return self._apply_host_loss_locked(host)
+
     def _apply_device_loss_locked(self, devices) -> list[str]:
         devices = sorted({int(d) for d in devices})
         before = self.allocator.healthy_count()
         affected = self.allocator.mark_lost(devices)
-        n_lost = before - self.allocator.healthy_count()
+        self._count_devices_lost_locked(before)
+        self._requeue_lost_leases_locked(affected, devices, "device_lost")
+        return affected
+
+    def _apply_host_loss_locked(self, host: int) -> list[str]:
+        host = int(host)
+        before = self.allocator.healthy_count()
+        hosts_before = self.allocator.hosts_lost_total
+        affected = self.allocator.mark_host_lost(host)
+        self._count_devices_lost_locked(before)
+        n_hosts = self.allocator.hosts_lost_total - hosts_before
+        if n_hosts:
+            self.hosts_lost_total += n_hosts
+            self.metrics.counter(
+                HOSTS_LOST_TOTAL,
+                "hosts marked lost (whole-segment quarantine: every "
+                "lease on the host reaped, fleet capacity repriced)",
+            ).inc(n_hosts)
+        dph = self.allocator.devices_per_host
+        segment = list(range(host * dph, (host + 1) * dph))
+        # the dead host's run-level leases are reaped in one step (not
+        # timed out): run leases requeue the TENANT, so the slot ranges
+        # the reap pushes are discarded like every other reap path
+        self.leases.reap_wids(affected, reason="host_lost")
+        self.leases.discard_requeued()
+        self._requeue_lost_leases_locked(affected, segment, "host_lost",
+                                         host=host, lease_already_gone=True)
+        return affected
+
+    def _count_devices_lost_locked(self, healthy_before: int) -> None:
+        n_lost = healthy_before - self.allocator.healthy_count()
         if n_lost:
             self.devices_lost_total += n_lost
             self.metrics.counter(
@@ -430,15 +479,26 @@ class RunScheduler:
                 "devices marked lost (mesh loss: capacity shrunk, "
                 "leases reaped)",
             ).inc(n_lost)
+
+    def _requeue_lost_leases_locked(self, affected, devices, cause,
+                                    host: int | None = None,
+                                    lease_already_gone: bool = False
+                                    ) -> None:
+        """The shared back half of device and host loss: reprice
+        admission on the surviving capacity, then reap + budget-free
+        requeue every affected RUNNING tenant (infrastructure faults
+        are never the tenant's fault)."""
         self.admission.set_capacity(self.allocator.healthy_count())
         t_loss = self.clock.now()
         for tid in affected:
             tenant = self._tenants.get(tid)
             if tenant is None or tenant.state != RUNNING:
                 continue
+            extra = {} if host is None else {"host": int(host)}
             tenant.record_event(
-                "device_lost", devices=devices,
-                width=tenant.submesh_width, lo=tenant.submesh_lo)
+                cause, devices=devices,
+                width=tenant.submesh_width, lo=tenant.submesh_lo,
+                **extra)
             tenant._device_loss_t0 = t_loss
             # stale-ify the attempt (a thread still computing on "lost"
             # hardware reports into a bumped epoch and is discarded)
@@ -446,17 +506,18 @@ class RunScheduler:
             tenant.epoch += 1
             if tenant.abc is not None:
                 tenant.abc.request_graceful_stop()
-            self._release_placement_locked(tenant)
+            self._release_placement_locked(
+                tenant, lease_already_gone=lease_already_gone)
             if self._draining:
                 self._finish_locked(
-                    tenant, FAILED, error="device lost during drain")
+                    tenant, FAILED, error=f"{cause} during drain")
                 continue
             tenant.device_loss_requeues += 1
             tenant.state = REQUEUED
             tenant.abc = None
             self._queue.append(tenant.id)
             tenant.record_event("requeued", attempt=tenant.attempt,
-                                cause="device_lost")
+                                cause=cause)
             self.metrics.counter(
                 TENANT_DEVICE_LOSS_REQUEUES_TOTAL,
                 "tenants requeued because their sub-mesh lost a device "
@@ -464,12 +525,12 @@ class RunScheduler:
             ).inc()
         self._set_occupancy_gauges_locked()
         self._wake.notify_all()
-        return affected
 
     def _poll_device_faults_locked(self) -> None:
         """The deterministic ``device.mesh`` chaos site: the pump polls
         the active FaultPlan every tick, so mesh loss is injectable on
-        CPU exactly like every other fault kind."""
+        CPU exactly like every other fault kind. ``host_lost`` reads
+        the fault's device spec as HOST indices."""
         from ..resilience.faults import maybe_device_fault
 
         ev = maybe_device_fault("device.mesh")
@@ -477,6 +538,9 @@ class RunScheduler:
             return
         if ev["kind"] == "device_lost":
             self._apply_device_loss_locked(ev["devices"])
+        elif ev["kind"] == "host_lost":
+            for h in sorted({int(h) for h in ev["devices"]}):
+                self._apply_host_loss_locked(h)
         else:  # device_degraded
             self.allocator.mark_degraded(ev["devices"])
 
@@ -624,10 +688,16 @@ class RunScheduler:
                 continue
             # sub-mesh placement: widest free power-of-two divisor of
             # the requested shard count (any width is bit-identical by
-            # the kernel contract), width 1 for unsharded tenants
+            # the kernel contract), width 1 for unsharded tenants.
+            # Widths above one host's segment are tried only for
+            # explicitly multi-host tenants — everyone else confines to
+            # a host and never pays (or risks) DCN.
             lo = width = None
+            multi_host = bool(getattr(tenant.spec, "multi_host", False))
             for w in placement.feasible_widths(tenant.spec.sharded):
-                got = self.allocator.alloc(w, tid)
+                if w > self.allocator.devices_per_host and not multi_host:
+                    continue
+                got = self.allocator.alloc(w, tid, multi_host=multi_host)
                 if got is not None:
                     lo, width = got, w
                     break

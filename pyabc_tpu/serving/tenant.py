@@ -173,6 +173,13 @@ class TenantSpec:
     #: tenant resumes bit-identical on whatever width is free next.
     #: None = unsharded (a width-1 slot, packable per chip).
     sharded: int | None = None
+    #: opt-in to sub-mesh leases WIDER than one host's device segment
+    #: (round 18 fleets): a multi-host lease puts DCN collectives in the
+    #: tenant's critical path and dies whenever ANY of its hosts does,
+    #: so straddling hosts is never implicit. False = the scheduler only
+    #: tries host-confined divisor widths (the kernel contract makes
+    #: them bit-identical anyway).
+    multi_host: bool = False
     #: per-particle sumstat retention. Default True: lease-expiry
     #: REQUEUE resumes via History `load()`, whose adaptive-state
     #: restore reads the last stored generation's sum stats — a tenant
@@ -227,6 +234,10 @@ class TenantSpec:
                 raise ValueError(
                     "sharded must be a power of two >= 2 (or None for "
                     "an unsharded width-1 tenant)")
+        if self.multi_host and not self.sharded:
+            raise ValueError(
+                "multi_host=True needs sharded=<n>: only a sharded "
+                "sub-mesh lease can span host segments")
         bad = self.RESERVED_OVERRIDES & set(self.abcsmc_overrides)
         if bad:
             raise ValueError(
@@ -261,6 +272,7 @@ class TenantSpec:
             "data_seed": int(self.data_seed),
             "sharded": (None if self.sharded is None
                         else int(self.sharded)),
+            "multi_host": bool(self.multi_host),
             "store_sum_stats": self.store_sum_stats,
             "store": self.store,
             "minimum_epsilon": self.minimum_epsilon,

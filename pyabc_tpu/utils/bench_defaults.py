@@ -115,6 +115,13 @@ DEFAULT_MESH_POP = 1024
 DEFAULT_MESH_G = 8
 DEFAULT_MESH_GENS = 9
 DEFAULT_MESH_BUDGET_S = 120.0
+# multihost leg (round 21): 2 gloo processes × 4 forced CPU devices
+# timeshare one core with a python interpreter each, so the leg runs a
+# deliberately small config — the guards are bit-identity + sync
+# budget, not throughput (gloo-over-loopback pps is recorded as a
+# proxy only)
+DEFAULT_MESH_MH_POP = 128
+DEFAULT_MESH_MH_GENS = 4
 # serve lane (round 15): mesh-aware serving on a forced-8-device pool —
 # a mixed fleet (one sharded=4 big tenant on a width-4 sub-mesh lease +
 # unsharded width-1 tenants) through one checkpoint-preemption and one

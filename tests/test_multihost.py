@@ -7,6 +7,7 @@ runtime over a localhost coordinator, shard the particle axis over a
 the correct posterior — proving the per-generation barrier works across
 processes (VERDICT r1 #6).
 """
+import hashlib
 import os
 import socket
 import subprocess
@@ -14,6 +15,11 @@ import sys
 
 import numpy as np
 import pytest
+
+# the CI `multihost` job runs exactly this module (2-process gloo rig on
+# localhost, 4 virtual CPU devices per process); the fast distributed-
+# module tests (initialize guards, clock offset) ride along in tier-1
+pytestmark = pytest.mark.multihost
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
@@ -169,3 +175,438 @@ def test_two_process_posterior(tmp_path):
     assert mus[0] == pytest.approx(0.8, abs=0.3)
     # only the primary wrote the real db
     assert db.exists()
+
+
+# ------------------------------------------------- round 18: sharded kernel
+#
+# The tentpole claim: the shard_map multigen kernel runs UNCHANGED over a
+# multi-process global mesh — shard-local segment sweeps per host, scalar
+# columns all-gathered over DCN — and is BIT-identical (every generation's
+# thetas, weights and the epsilon trail) to the 1-process virtual-shard
+# reference at the same shard count. The workers print a sha256 digest
+# over the full History; the test compares digests across proc0, proc1
+# and the solo reference.
+
+#: digest body shared by every worker below (and mirrored by
+#: ``_digest_history`` for in-process references) — epsilon trail plus
+#: every generation's (theta, weight) float64 bytes
+_DIGEST_SRC = """
+def _digest(h, sort_rows=False):
+    import hashlib
+    import numpy as np
+    pops = h.get_all_populations().query("t >= 0")
+    dig = hashlib.sha256()
+    dig.update(pops["epsilon"].to_numpy().astype(np.float64).tobytes())
+    for t in pops["t"]:
+        df, w = h.get_distribution(0, int(t))
+        th = df.to_numpy().astype(np.float64)
+        w = np.asarray(w, np.float64)
+        if sort_rows:
+            order = np.lexsort(th.T)
+            th, w = th[order], w[order]
+        dig.update(th.tobytes())
+        dig.update(w.tobytes())
+    eps = ",".join(f"{e:.10g}" for e in pops["epsilon"])
+    return dig.hexdigest(), eps
+"""
+
+exec(_DIGEST_SRC)  # defines _digest for in-process references
+
+
+def _spawn_workers(script_text, tmp_path, extra_args=(), n_procs=2,
+                   timeout=420):
+    """Run ``n_procs`` copies of a worker script (argv: pid, port,
+    *extra_args) against one fresh coordinator port; returns the RESULT
+    lines (one per process, order proc0..procN)."""
+    script = tmp_path / "mh_worker.py"
+    script.write_text(script_text)
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYABC_TPU_SYNC_BUDGET_STRICT"] = "1"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(pid), str(port),
+             *map(str, extra_args)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(n_procs)
+    ]
+    outs = [p.communicate(timeout=timeout)[0] for p in procs]
+    failures = [f"proc {pid} rc={p.returncode}:\n{out[-3000:]}"
+                for pid, (p, out) in enumerate(zip(procs, outs))
+                if p.returncode != 0]
+    assert not failures, "\n\n".join(failures)
+    results = []
+    for pid, out in enumerate(outs):
+        lines = [ln for ln in out.splitlines() if ln.startswith("RESULT")]
+        assert len(lines) == 1, f"proc {pid}:\n{out[-3000:]}"
+        results.append(lines[0])
+    return results
+
+
+def _field(line, key):
+    return line.split(f"{key}=")[1].split()[0]
+
+
+WORKER_SHARDED = """
+import sys
+pid = int(sys.argv[1])
+port = sys.argv[2]
+from pyabc_tpu.parallel import distributed as dist
+dist.initialize(f"127.0.0.1:{port}", num_processes=2, process_id=pid,
+                platform="cpu", num_cpu_devices=4)
+import jax
+import numpy as np
+import pyabc_tpu as pt
+""" + _DIGEST_SRC + """
+NOISE_SD = 0.5
+
+@pt.JaxModel.from_function(["theta"], name="gauss_mh")
+def model(key, theta):
+    return {"x": theta[0] + NOISE_SD * jax.random.normal(key)}
+
+mesh = dist.global_mesh()
+assert mesh.devices.size == 8
+prior = pt.Distribution(theta=pt.RV("norm", 0.0, 1.0))
+abc = pt.ABCSMC(model, prior, pt.PNormDistance(p=2), population_size=128,
+                eps=pt.MedianEpsilon(), seed=21, mesh=mesh, sharded=8,
+                fused_generations=3)
+assert abc._sharded_n() == 8
+abc.new(dist.primary_db("sqlite://"), {"x": 1.0})
+h = abc.run(max_nr_populations=4)
+rep = abc._engine.sync_budget_report()
+digest, eps = _digest(h)
+print(f"RESULT pid={pid} digest={digest} eps=[{eps}]"
+      f" syncs={rep['syncs']} chunks={rep['chunks']} ok={rep['ok']}",
+      flush=True)
+"""
+
+
+WORKER_SHARDED_REF = """
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import pyabc_tpu as pt
+""" + _DIGEST_SRC + """
+NOISE_SD = 0.5
+
+@pt.JaxModel.from_function(["theta"], name="gauss_mh")
+def model(key, theta):
+    return {"x": theta[0] + NOISE_SD * jax.random.normal(key)}
+
+prior = pt.Distribution(theta=pt.RV("norm", 0.0, 1.0))
+abc = pt.ABCSMC(model, prior, pt.PNormDistance(p=2), population_size=128,
+                eps=pt.MedianEpsilon(), seed=21, sharded=8,
+                fused_generations=3)
+abc.new("sqlite://", {"x": 1.0})
+h = abc.run(max_nr_populations=4)
+digest, eps = _digest(h)
+print(f"RESULT pid=ref digest={digest} eps=[{eps}]", flush=True)
+"""
+
+
+def _run_reference(script_text, tmp_path, name="mh_ref.py"):
+    script = tmp_path / name
+    script.write_text(script_text)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run([sys.executable, str(script)], env=env,
+                          capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-3000:]
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("RESULT")]
+    assert len(lines) == 1, proc.stdout[-3000:]
+    return lines[0]
+
+
+@pytest.mark.slow
+def test_two_process_sharded_bit_identical(tmp_path):
+    """Tentpole acceptance: the sharded multigen kernel on a 2-process
+    gloo mesh (2x4 devices, width 8) is BIT-identical — full History
+    digest and epsilon trail — to the 1-process virtual-shard run at the
+    same shard count, and the strict per-run sync budget holds with the
+    collectives spanning processes (syncs_per_run <= chunks + O(1))."""
+    results = _spawn_workers(WORKER_SHARDED, tmp_path)
+    digests = {_field(r, "digest") for r in results}
+    assert len(digests) == 1, results
+    ref = _run_reference(WORKER_SHARDED_REF, tmp_path)
+    assert _field(ref, "digest") in digests, (ref, results)
+    assert _field(ref, "eps") == _field(results[0], "eps")
+    for r in results:
+        assert _field(r, "ok") == "True", r
+        syncs, chunks = int(_field(r, "syncs")), int(_field(r, "chunks"))
+        assert syncs <= chunks + 8, r
+
+
+WORKER_SEGMENTED = """
+import sys
+pid = int(sys.argv[1])
+port = sys.argv[2]
+from pyabc_tpu.parallel import distributed as dist
+dist.initialize(f"127.0.0.1:{port}", num_processes=2, process_id=pid,
+                platform="cpu", num_cpu_devices=4)
+import jax
+import numpy as np
+import pyabc_tpu as pt
+from pyabc_tpu.models import gillespie as g
+""" + _DIGEST_SRC + """
+model = g.make_birth_death_model(n_leaps=100, n_obs=20, segments=5)
+obs = g.observed_birth_death(n_leaps=100, n_obs=20, segments=5)
+abc = pt.ABCSMC(model, g.birth_death_prior(), pt.PNormDistance(p=2),
+                population_size=64, eps=pt.MedianEpsilon(), seed=41,
+                early_reject="auto", mesh=dist.global_mesh(), sharded=8,
+                fused_generations=2)
+abc.new(dist.primary_db("sqlite://"), obs)
+h = abc.run(max_nr_populations=4)
+retired = sum((h.get_telemetry(t) or {}).get("retired_early", 0)
+              for t in range(h.n_populations))
+digest, eps = _digest(h, sort_rows=True)
+print(f"RESULT pid={pid} digest={digest} eps=[{eps}] retired={retired}",
+      flush=True)
+"""
+
+
+WORKER_SEGMENTED_REF = """
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import pyabc_tpu as pt
+from pyabc_tpu.models import gillespie as g
+""" + _DIGEST_SRC + """
+model = g.make_birth_death_model(n_leaps=100, n_obs=20, segments=5)
+obs = g.observed_birth_death(n_leaps=100, n_obs=20, segments=5)
+abc = pt.ABCSMC(model, g.birth_death_prior(), pt.PNormDistance(p=2),
+                population_size=64, eps=pt.MedianEpsilon(), seed=41,
+                early_reject="auto", sharded=8, fused_generations=2)
+abc.new("sqlite://", obs)
+h = abc.run(max_nr_populations=4)
+retired = sum((h.get_telemetry(t) or {}).get("retired_early", 0)
+              for t in range(h.n_populations))
+digest, eps = _digest(h, sort_rows=True)
+print(f"RESULT pid=ref digest={digest} eps=[{eps}] retired={retired}",
+      flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_segmented_early_reject_bit_identical(tmp_path):
+    """The COMPOSED kernel (ISSUE 17's segmented early-reject engine
+    inside the sharded kernel) crosses the process boundary too: the
+    2-process run retires lanes early and still lands digest-identical
+    on the 1-process virtual-shard reference."""
+    results = _spawn_workers(WORKER_SEGMENTED, tmp_path)
+    digests = {_field(r, "digest") for r in results}
+    assert len(digests) == 1, results
+    ref = _run_reference(WORKER_SEGMENTED_REF, tmp_path,
+                         name="mh_seg_ref.py")
+    assert _field(ref, "digest") in digests, (ref, results)
+    # early reject genuinely ON in both rigs, retiring the same lanes
+    assert int(_field(ref, "retired")) > 0
+    assert {_field(r, "retired") for r in results} \
+        == {_field(ref, "retired")}
+
+
+# ---------------------------------------- preempt/resume across topologies
+#
+# PR-5 checkpoints are written by the PRIMARY only and adoptable at any
+# process count x width: a run interrupted on a 1-process virtual-shard
+# topology resumes on the 2-process mesh (each non-primary loading a
+# private COPY of the primary's sqlite file via ``resume_db``) and lands
+# bit-identical on the uninterrupted solo run.
+
+WORKER_RESUME = """
+import sys
+pid = int(sys.argv[1])
+port = sys.argv[2]
+db_path = sys.argv[3]
+ck = sys.argv[4]
+abc_id = int(sys.argv[5])
+from pyabc_tpu.parallel import distributed as dist
+dist.initialize(f"127.0.0.1:{port}", num_processes=2, process_id=pid,
+                platform="cpu", num_cpu_devices=4)
+import jax
+import numpy as np
+import pyabc_tpu as pt
+""" + _DIGEST_SRC + """
+NOISE_SD = 0.5
+
+@pt.JaxModel.from_function(["theta"], name="gauss_mh_resume")
+def model(key, theta):
+    return {"x": theta[0] + NOISE_SD * jax.random.normal(key)}
+
+prior = pt.Distribution(theta=pt.RV("norm", 0.0, 1.0))
+abc = pt.ABCSMC(model, prior, pt.PNormDistance(p=2), population_size=64,
+                eps=pt.MedianEpsilon(), seed=21, mesh=dist.global_mesh(),
+                sharded=8, fused_generations=2, checkpoint_path=ck)
+abc.load(dist.resume_db(f"sqlite:///{db_path}"), abc_id)
+h = abc.run(max_nr_populations=4)
+digest, eps = _digest(h)
+print(f"RESULT pid={pid} digest={digest} eps=[{eps}]"
+      f" gens={h.n_populations}", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_preempt_virtual_resume_two_process_bit_identical(tmp_path):
+    """Interrupt a 1-process virtual-shard run at the first chunk
+    boundary (the production graceful-stop path), resume it on the
+    2-process global mesh — both processes adopt the primary-written
+    checkpoint and finish bit-identical to the uninterrupted solo run."""
+    import jax
+
+    import pyabc_tpu as pt
+    from pyabc_tpu.inference.smc import GracefulShutdown
+
+    NOISE_SD = 0.5
+
+    def make(checkpoint_path=None):
+        @pt.JaxModel.from_function(["theta"], name="gauss_mh_resume")
+        def model(key, theta):
+            return {"x": theta[0] + NOISE_SD * jax.random.normal(key)}
+
+        return pt.ABCSMC(
+            model, pt.Distribution(theta=pt.RV("norm", 0.0, 1.0)),
+            pt.PNormDistance(p=2), population_size=64,
+            eps=pt.MedianEpsilon(), seed=21, sharded=8,
+            fused_generations=2, checkpoint_path=checkpoint_path)
+
+    # the uninterrupted solo reference
+    ref = make()
+    ref.new("sqlite://", {"x": 1.0})
+    h_ref = ref.run(max_nr_populations=4)
+    ref_digest, ref_eps = _digest(h_ref)
+
+    # interrupt at the first chunk boundary
+    db_path = tmp_path / "mh_resume.db"
+    ck = tmp_path / "mh_resume.ck"
+    abc = make(checkpoint_path=str(ck))
+    abc.new(f"sqlite:///{db_path}", {"x": 1.0})
+    abc_id = int(abc.history.id)
+    abc.chunk_event_cb = lambda ev: abc.request_graceful_stop()
+    with pytest.raises(GracefulShutdown):
+        abc.run(max_nr_populations=4)
+    assert 0 < abc.history.n_populations < 4
+    assert ck.exists()
+
+    # resume on the 2-process mesh
+    results = _spawn_workers(WORKER_RESUME, tmp_path,
+                             extra_args=(db_path, ck, abc_id))
+    for r in results:
+        assert _field(r, "gens") == "4", r
+        assert _field(r, "digest") == ref_digest, (r, ref_digest)
+        assert _field(r, "eps") == f"[{ref_eps}]", r
+    # the non-primary resumed from a private COPY, never the real file
+    assert (tmp_path / "mh_resume.db.proc1").exists()
+
+
+# -------------------------------------------------- fast distributed tests
+#
+# No subprocesses, no jax.distributed: the initialize() config guards and
+# the cross-process clock-offset rig are plain-python testable and run in
+# tier-1.
+
+class TestInitializeGuards:
+    @pytest.fixture(autouse=True)
+    def _clean_env(self, monkeypatch):
+        for var in ("PYABC_TPU_COORDINATOR", "PYABC_TPU_NUM_PROCESSES",
+                    "PYABC_TPU_PROCESS_ID"):
+            monkeypatch.delenv(var, raising=False)
+
+    def test_partial_explicit_config_is_typed_error(self):
+        from pyabc_tpu.parallel import distributed as dist
+
+        with pytest.raises(dist.DistributedConfigError, match="missing"):
+            dist.initialize("127.0.0.1:12345")
+
+    def test_partial_env_config_is_typed_error(self, monkeypatch):
+        from pyabc_tpu.parallel import distributed as dist
+
+        monkeypatch.setenv("PYABC_TPU_COORDINATOR", "127.0.0.1:12345")
+        with pytest.raises(dist.DistributedConfigError,
+                           match="PYABC_TPU_NUM_PROCESSES"):
+            dist.initialize()
+
+    def test_env_fallback_fills_the_triple(self, monkeypatch):
+        from pyabc_tpu.parallel import distributed as dist
+
+        monkeypatch.setenv("PYABC_TPU_COORDINATOR", "127.0.0.1:12345")
+        monkeypatch.setenv("PYABC_TPU_NUM_PROCESSES", "2")
+        monkeypatch.setenv("PYABC_TPU_PROCESS_ID", "1")
+        cfg = dist._resolve_init_config(
+            None, None, None, platform=None, num_cpu_devices=None,
+            cpu_collectives="gloo")
+        assert cfg["coordinator_address"] == "127.0.0.1:12345"
+        assert cfg["num_processes"] == 2
+        assert cfg["process_id"] == 1
+
+    def test_second_identical_initialize_is_noop(self, monkeypatch):
+        from pyabc_tpu.parallel import distributed as dist
+
+        cfg = dist._resolve_init_config(
+            "127.0.0.1:1", 2, 0, platform="cpu", num_cpu_devices=4,
+            cpu_collectives="gloo")
+        monkeypatch.setattr(dist, "_INIT_CONFIG", cfg)
+        # same config: returns before touching jax.config or the runtime
+        # (a real re-init attempt against 127.0.0.1:1 would error out)
+        dist.initialize("127.0.0.1:1", 2, 0, platform="cpu",
+                        num_cpu_devices=4)
+
+    def test_conflicting_reinitialize_is_typed_error(self, monkeypatch):
+        from pyabc_tpu.parallel import distributed as dist
+
+        cfg = dist._resolve_init_config(
+            "127.0.0.1:1", 2, 0, platform="cpu", num_cpu_devices=4,
+            cpu_collectives="gloo")
+        monkeypatch.setattr(dist, "_INIT_CONFIG", cfg)
+        with pytest.raises(dist.DistributedConfigError,
+                           match="re-initialized"):
+            dist.initialize("127.0.0.1:1", 2, 1, platform="cpu",
+                            num_cpu_devices=4)
+
+
+class TestClockOffset:
+    def test_offset_measured_within_rtt_bound_and_recorded(self):
+        """NTP-style probe against a second 'host' serving its monotonic
+        clock over TCP: on one machine CLOCK_MONOTONIC shares its epoch,
+        so the measured offset must sit inside the +-RTT/2 uncertainty
+        window — the bound the span-merge contract leans on. The summary
+        lands per-host in the observability snapshot."""
+        from pyabc_tpu import observability
+        from pyabc_tpu.parallel import distributed as dist
+
+        port, stop = dist.serve_clock()
+        try:
+            est = dist.measure_clock_offset(
+                f"127.0.0.1:{port}", host="host-b")
+        finally:
+            stop()
+        s = est.summary()
+        assert s["n_samples"] == 16
+        assert s["rtt_s"] > 0.0
+        assert abs(s["offset_s"]) <= s["uncertainty_s"]
+        snap = observability.observability_snapshot()
+        assert snap["hosts"]["host-b"]["offset_s"] == s["offset_s"]
+        assert snap["hosts"]["host-b"]["uncertainty_s"] \
+            == s["uncertainty_s"]
+
+    def test_serve_clock_answers_repeated_probes(self):
+        from pyabc_tpu.parallel import distributed as dist
+
+        port, stop = dist.serve_clock()
+        try:
+            a = dist.measure_clock_offset(f"127.0.0.1:{port}",
+                                          n_samples=4)
+            b = dist.measure_clock_offset(f"127.0.0.1:{port}",
+                                          n_samples=4)
+        finally:
+            stop()
+        assert a.summary()["n_samples"] == 4
+        assert b.summary()["n_samples"] == 4

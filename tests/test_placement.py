@@ -199,3 +199,93 @@ def test_build_mesh_physical_vs_virtual():
         devs = list(mesh.devices.flat)
         assert [d.id for d in devs] == [jax.devices()[2].id,
                                         jax.devices()[3].id]
+
+
+# ------------------------------------------------- round 18: host segments
+#
+# The device line becomes per-host segments: buddy alignment makes host
+# confinement free for widths <= devices_per_host, widths above need an
+# explicit multi_host lease, and host loss quarantines a whole segment in
+# one step (counted separately from chip loss).
+
+def test_host_pool_validation():
+    with pytest.raises(ValueError, match="split evenly"):
+        SubMeshAllocator(8, n_hosts=3)
+    with pytest.raises(ValueError, match="power of two"):
+        SubMeshAllocator(12, n_hosts=2)  # 6 devices/host
+    a = SubMeshAllocator(8, n_hosts=2)
+    assert a.devices_per_host == 4
+    assert [a.host_of(d) for d in (0, 3, 4, 7)] == [0, 0, 1, 1]
+    assert a.stats()["n_hosts"] == 2
+
+
+def test_leases_never_straddle_hosts_implicitly():
+    a = SubMeshAllocator(8, n_hosts=2)
+    with pytest.raises(ValueError, match="straddle hosts"):
+        a.alloc(8, "wide")
+    # host-confinable widths pack into single segments, aligned
+    assert a.alloc(4, "t0") == 0
+    assert a.alloc(4, "t1") == 4
+    assert a.host_of(0) == 0 and a.host_of(4) == 1
+    assert a.check_invariants() == []
+
+
+def test_multi_host_flag_allows_whole_host_spans():
+    a = SubMeshAllocator(8, n_hosts=2)
+    assert a.alloc(8, "wide", multi_host=True) == 0
+    assert a.check_invariants() == []
+    a.free("wide")
+    assert a.widest_free() == 8
+
+
+def test_single_host_pool_unaffected_by_straddle_guard():
+    """n_hosts=1 (the round-15 default): no straddle guard, alloc keeps
+    its old contract (None when nothing fits, never a new raise)."""
+    a = SubMeshAllocator(8)
+    assert a.alloc(8, "wide") == 0
+    assert a.alloc(1, "later") is None
+
+
+def test_mark_host_lost_reaps_segment_and_counts_once():
+    a = SubMeshAllocator(8, n_hosts=2)
+    assert a.alloc(4, "t0") == 0
+    assert a.alloc(2, "t1") == 4
+    affected = a.mark_host_lost(1)
+    assert affected == ["t1"]
+    assert a.healthy_count() == 4
+    assert a.stats()["lost_hosts"] == [1]
+    assert a.hosts_lost_total == 1
+    # a second loss of the SAME (already dead) host is idempotent:
+    # nothing newly affected, the host counter does not double-count
+    assert a.mark_host_lost(1) == []
+    assert a.hosts_lost_total == 1
+    # the lease reaps scheduler-side; freeing quarantines the segment
+    a.free("t1")
+    assert a.widest_free() == 0  # t0 still holds host 0
+    a.free("t0")
+    assert a.widest_free() == 4
+    assert a.check_invariants() == []
+    with pytest.raises(ValueError, match="out of range"):
+        a.mark_host_lost(2)
+
+
+def test_host_restore_clears_lost_host_set():
+    a = SubMeshAllocator(8, n_hosts=2)
+    a.mark_host_lost(0)
+    assert a.stats()["lost_hosts"] == [0]
+    a.restore([0, 1])  # partial repair: segment still has lost chips
+    assert a.stats()["lost_hosts"] == [0]
+    a.restore([2, 3])
+    assert a.stats()["lost_hosts"] == []
+    assert a.healthy_count() == 8
+    assert a.check_invariants() == []
+
+
+def test_multi_host_lease_dies_with_any_host():
+    a = SubMeshAllocator(8, n_hosts=2)
+    assert a.alloc(8, "wide", multi_host=True) == 0
+    assert a.mark_host_lost(1) == ["wide"]
+    a.free("wide")
+    # host 0's half comes back; host 1's segment stays quarantined
+    assert a.widest_free() == 4
+    assert a.check_invariants() == []

@@ -747,13 +747,105 @@ def test_place001_mutation_stray_mesh_in_scheduler_fails():
         "longer guarded")
 
 
-def test_registry_has_ten_rules_with_iso001_and_place001():
+# -------------------------------------------------------------- DIST001
+
+DIST_FIRES = """
+import jax
+from jax.experimental import multihost_utils
+def helper():
+    jax.distributed.initialize()
+    if jax.process_index() == 0:
+        multihost_utils.sync_global_devices("x")
+    return jax.process_count()
+"""
+
+DIST_CLEAN = """
+from ..parallel import distributed as dist
+def helper(db):
+    dist.initialize()
+    # Device.process_index ATTRIBUTE reads inspect a mesh, not the
+    # runtime: the multi-host gate in smc.py/util.py stays legal
+    n_proc = len({d.process_index for d in mesh.devices.flat})
+    return dist.primary_db(db), n_proc
+"""
+
+DIST_SUPPRESSED = """
+import jax
+def probe():
+    # abc-lint: disable=DIST001 offline capability probe, no topology change
+    return jax.process_count()
+"""
+
+
+def test_dist001_fires_on_runtime_calls():
+    from pyabc_tpu.analysis.rules.distributed import Dist001
+
+    open_, _ = check(Dist001(), DIST_FIRES, "pyabc_tpu/inference/smc.py")
+    assert len(open_) == 4, [f.to_dict() for f in open_]
+    msgs = " ".join(f.message for f in open_)
+    assert "jax.distributed.initialize" in msgs
+    assert "jax.process_index" in msgs
+    assert "multihost_utils" in msgs
+    assert "jax.process_count" in msgs
+
+
+def test_dist001_scope_is_pyabc_minus_distributed():
+    from pyabc_tpu.analysis.rules.distributed import Dist001
+
+    r = Dist001()
+    # the one sanctioned module is exempt; the rest of the package is in
+    assert not r.applies_to("pyabc_tpu/parallel/distributed.py")
+    assert r.applies_to("pyabc_tpu/inference/smc.py")
+    assert r.applies_to("pyabc_tpu/inference/util.py")
+    assert r.applies_to("pyabc_tpu/serving/scheduler.py")
+    assert not r.applies_to("bench.py")
+    assert not r.applies_to("tests/test_multihost.py")
+    open_, _ = check(r, DIST_CLEAN, "pyabc_tpu/inference/smc.py")
+    assert open_ == [], [f.to_dict() for f in open_]
+
+
+def test_dist001_suppression_with_reason():
+    from pyabc_tpu.analysis.rules.distributed import Dist001
+
+    open_, sup = check(Dist001(), DIST_SUPPRESSED,
+                       "pyabc_tpu/serving/scheduler.py")
+    assert open_ == [] and len(sup) == 1 and sup[0].reason
+
+
+def test_dist001_mutation_process_probe_in_smc_fails():
+    """THE mutation guard: a ``jax.process_index()`` probe growing back
+    into the SMC loop — per-process host control flow, the divergence
+    class the replicated-deterministic contract forbids — must make
+    DIST001 fire; today's smc.py is clean (its multi-host gate reads
+    Device.process_index attributes only)."""
+    from pyabc_tpu.analysis.rules.distributed import Dist001
+
+    path = REPO / "pyabc_tpu" / "inference" / "smc.py"
+    src = path.read_text()
+    rel = "pyabc_tpu/inference/smc.py"
+    open_, _ = check(Dist001(), src, rel)
+    assert open_ == [], [f.to_dict() for f in open_]
+    mutated = src + (
+        "\n\ndef _only_on_primary(fn):\n"
+        "    import jax\n"
+        "    if jax.process_index() == 0:\n"
+        "        return fn()\n"
+    )
+    open_m, _ = check(Dist001(), mutated, rel)
+    assert len(open_m) >= 1, (
+        "a jax.process_index() probe re-added to inference/smc.py left "
+        "DIST001 silent — the process-topology confinement contract is "
+        "no longer guarded")
+
+
+def test_registry_has_eleven_rules_with_place001_and_dist001():
     from pyabc_tpu.analysis.rules import rule_ids
 
     ids = rule_ids()
-    assert len(ids) == 10
+    assert len(ids) == 11
     assert "ISO001" in ids
     assert "PLACE001" in ids
+    assert "DIST001" in ids
 
 
 # ------------------------------------------------------- the tier-1 gate
