@@ -2569,8 +2569,23 @@ def _traffic_lane_child() -> dict:
 
     import jax
 
-    from pyabc_tpu.observability import SYSTEM_CLOCK
-    from pyabc_tpu.serving import RetentionPolicy, RunScheduler, TenantQuota
+    from pyabc_tpu.observability import (
+        SLO,
+        SYSTEM_CLOCK,
+        MetricsRegistry,
+        SloEngine,
+        VirtualClock,
+        coverage_report,
+        read_flight,
+        render_timeline,
+    )
+    from pyabc_tpu.serving import (
+        AdmissionRejectedError,
+        RetentionPolicy,
+        RunScheduler,
+        TenantQuota,
+        TenantSpec,
+    )
     from pyabc_tpu.traffic import (
         ArrivalSchedule,
         TrafficClass,
@@ -2583,6 +2598,8 @@ def _traffic_lane_child() -> dict:
         DEFAULT_TRAFFIC_RATE_HZ,
         DEFAULT_TRAFFIC_SEED,
         DEFAULT_TRAFFIC_TENANTS,
+        SLO_ALERT_LATENCY_MAX_S,
+        SLO_RECORDER_ATTRIBUTED_FRAC_MIN,
         TRAFFIC_ADMIT_P99_MAX_S,
         TRAFFIC_FAIRNESS_MAX_RATIO,
         TRAFFIC_HONESTY_P90_MAX,
@@ -2680,6 +2697,86 @@ def _traffic_lane_child() -> dict:
                                 fair_rep["completed_by_class"].values()))
         fair_gen.abort_pending()
 
+        # -- phase 1b, the `slo` leg (round 22), part 1: burn-rate
+        # fire/clear on the INJECTED clock. The engine's 5m/1h fast
+        # windows make a wall-clock assertion impossible inside a
+        # minute-scale lane share, so the leg drives the same SloEngine
+        # the scheduler runs (counter-ratio SLI, stock thresholds) on a
+        # VirtualClock: 100% failures until the page fires, then a
+        # drain until the fast pair clears — both latencies RECORDED in
+        # injected seconds, the fire/clear itself guarded.
+        sclk = VirtualClock()
+        sclk.advance(1.0)
+        sreg = MetricsRegistry(clock=sclk)
+        eng = SloEngine(
+            sreg,
+            slos=[SLO(name="availability", objective=0.99,
+                      good_counter="good_total",
+                      bad_counter="bad_total")],
+            clock=sclk, sample_interval_s=10.0, register=False)
+        sgood = sreg.counter("good_total", "drain successes")
+        sbad = sreg.counter("bad_total", "overload failures")
+        eng.sample(force=True)
+        # warm up ONE HOUR of healthy traffic first: on a cold engine
+        # every window falls back to the oldest sample and a single bad
+        # batch pages instantly — the latency worth recording is the
+        # warmed one, where the 1h window must genuinely roll to 14.4%
+        # bad before the page fires
+        for _ in range(360):
+            sclk.advance(10.0)
+            sgood.inc(5)
+            eng.sample()
+        t_over = sclk.now()
+        alert_latency_s = None
+        for _ in range(120):
+            sclk.advance(10.0)
+            sbad.inc(5)
+            eng.sample()
+            # the FAST page specifically — the slow 6h/3d ticket pair
+            # trips earlier under a cold ring (~burn 6 on the total
+            # outage) and would otherwise mask the number we're after
+            if eng.evaluate("availability")["alerting_fast"]:
+                alert_latency_s = sclk.now() - t_over
+                break
+        t_drain = sclk.now()
+        clear_latency_s = None
+        for _ in range(800):
+            sclk.advance(10.0)
+            sgood.inc(5)
+            eng.sample()
+            if not eng.evaluate("availability")["alerting_fast"]:
+                clear_latency_s = sclk.now() - t_drain
+                break
+
+        # -- phase 1c, part 2: recorder steady-state overhead. Two
+        # warm probe runs (the warmup already paid this shape's
+        # compile) through the scheduler — whose per-tenant flight
+        # recorder is ALWAYS armed on the run's own tracer/metrics —
+        # plus an on-demand snapshot each, then the resilience lane's
+        # attributed-wall-clock math over the tenant's private trace:
+        # a recorder that stalled the run would read as dark time.
+        recorder_fracs = []
+        for i in range(2):
+            rec_t = sched.submit(make_spec(probe_cls[0], seed=seed + 50 + i),
+                                 tenant_id=f"slorec{i}")
+            rec_by = clock.now() + min(max(left(), 1.0), 90.0)
+            while (rec_t.state not in ("completed", "failed")
+                   and clock.now() < rec_by):
+                time.sleep(0.1)
+            if rec_t.state != "completed":
+                break
+            fl = rec_t.flight.snapshot(reason="bench")
+            sdicts = [sp.to_dict() for sp in rec_t.tracer.spans()]
+            cov = coverage_report(
+                sdicts, exclude_names=ELASTIC_BLANKET_SPANS)
+            recorder_fracs.append({
+                "tenant": rec_t.id, "window_s": cov["window_s"],
+                "steady_attributed_frac": cov["attributed_frac"],
+                "dark_s": cov["dark_s"],
+                "flight_entries": len(fl["entries"]),
+                "flight_spans": len(fl["spans"]),
+            })
+
         # -- phase 2, CHURN: the seeded open-loop storm at full
         # pressure, with whatever budget the probes left. This phase
         # owns the lifecycle guards (GC, bounded disk, no orphans); its
@@ -2688,9 +2785,95 @@ def _traffic_lane_child() -> dict:
         # legitimately underestimates (new arrivals keep refilling the
         # queue it priced) and completion times reflect backlog depth,
         # not the scheduler's treatment.
+        # the `slo` leg part 3 rides the churn: a watcher waits for a
+        # mid-flight tenant (>= 1 generation done), takes out a device
+        # under it, and verifies the fault path left a parseable flight
+        # file whose timeline covers the KILL -> REQUEUE window. The
+        # file is read right after the requeue dump lands — before the
+        # lifecycle sweep can dispose the tenant and reclaim it.
+        chaos_flight = {"armed": False}
+        churn_live = {"on": True}
+
+        def _chaos_kill():
+            # the churn fleet's smoke tenants finish in well under a
+            # second — too fast to catch mid-flight from a poll — so
+            # the watcher submits its OWN long-running victim into the
+            # storm (retrying through the same 429 backpressure real
+            # arrivals see) and takes out a device under it
+            victim = None
+            by = clock.now() + 90.0
+            while churn_live["on"] and clock.now() < by:
+                try:
+                    victim = sched.submit(
+                        TenantSpec(model="gaussian",
+                                   population_size=4000,
+                                   generations=8, seed=seed + 77,
+                                   fused_generations=2),
+                        tenant_id="slochaos")
+                    break
+                except AdmissionRejectedError:
+                    time.sleep(2.0)
+            if victim is None:
+                return
+            dev = None
+            by = clock.now() + 120.0
+            while clock.now() < by:
+                lo = victim.submesh_lo
+                if lo is not None:
+                    dev = lo
+                if dev is not None and victim.generations_done >= 1:
+                    break
+                if victim.state in ("completed", "failed"):
+                    break
+                time.sleep(0.05)
+            if dev is None or victim.state in ("completed", "failed"):
+                return
+            affected = sched.mark_devices_lost([dev])
+            by = clock.now() + 30.0
+            while (not os.path.exists(victim.flight_path)
+                   and clock.now() < by):
+                time.sleep(0.1)
+            try:
+                payload = read_flight(victim.flight_path)
+                kill_ts = next(e["ts"] for e in payload["events"]
+                               if e["kind"] == "device_lost")
+                req_ts = next(e["ts"] for e in payload["events"]
+                              if e["kind"] == "requeued")
+                text = render_timeline(payload)
+                chaos_flight.update({
+                    "armed": True,
+                    "ok": bool(
+                        victim.id in affected
+                        and payload["reason"] == "device_lost"
+                        and kill_ts <= req_ts
+                        and any(e["kind"] == "device_lost"
+                                for e in payload["entries"])
+                        and "device_lost" in text),
+                    "victim": victim.id,
+                    "device": int(dev),
+                    "n_affected": len(affected),
+                    "kill_to_requeue_s": round(req_ts - kill_ts, 6),
+                    "flight_reason": payload["reason"],
+                })
+            except Exception as e:  # the guard reports, never crashes
+                chaos_flight.update(
+                    {"armed": True, "ok": False,
+                     "error": repr(e)[:200]})
+
+        import threading
+        killer = threading.Thread(target=_chaos_kill, daemon=True)
+        killer.start()
         gen.run(budget_s=max(left() - 20.0, 30.0))
         rep = gen.report()
         gen.abort_pending()
+        churn_live["on"] = False
+        killer.join(timeout=60)
+
+        # the scheduler's LIVE SLO state after the storm — the fleet
+        # signal the /api/observability block now exports (recorded,
+        # not asserted: open-loop overload legitimately burns budget,
+        # and the fast windows need minutes of wall clock to roll)
+        slo_live = sched.slo.snapshot()
 
         life = sched.lifecycle.stats()
 
@@ -2718,7 +2901,20 @@ def _traffic_lane_child() -> dict:
         honesty_armed = hon_drained and hon_rep["honesty_ratio"]["n"] >= 5
         admit_armed = (hon_drained
                        and hon_rep["admission_latency_s"]["n"] >= 10)
+        recorder_armed = len(recorder_fracs) == 2
         guard = {
+            "pass_slo_fire_and_clear": bool(
+                alert_latency_s is not None
+                and alert_latency_s <= SLO_ALERT_LATENCY_MAX_S
+                and clear_latency_s is not None),
+            "pass_flight_on_chaos": (
+                bool(chaos_flight.get("ok"))
+                if chaos_flight["armed"] else None),
+            "pass_recorder_overhead": (
+                bool(min(r["steady_attributed_frac"]
+                         for r in recorder_fracs)
+                     >= SLO_RECORDER_ATTRIBUTED_FRAC_MIN)
+                if recorder_armed else None),
             "pass_admission_p99": (
                 bool(hon_rep["admission_latency_s"]["p99"]
                      <= TRAFFIC_ADMIT_P99_MAX_S)
@@ -2743,6 +2939,20 @@ def _traffic_lane_child() -> dict:
             "metric": "traffic_fleet_churn",
             "lane_s": round(clock.now() - t0, 2),
             "report": rep,
+            "slo_leg": {
+                "alert_latency_s": alert_latency_s,
+                "clear_latency_s": clear_latency_s,
+                "alert_latency_max_s": SLO_ALERT_LATENCY_MAX_S,
+                "basis": (
+                    "injected-clock seconds on the scheduler's own "
+                    "SloEngine: 100% failure overload until the fast "
+                    "5m/1h pair pages, goods-only drain until it "
+                    "clears"),
+                "chaos_flight": chaos_flight,
+                "recorder_runs": recorder_fracs,
+                "recorder_frac_min": SLO_RECORDER_ATTRIBUTED_FRAC_MIN,
+                "live": slo_live,
+            },
             "honesty_probe": {"drained": hon_drained, **hon_rep},
             "fairness_probe": {"drained": fair_drained, **fair_rep},
             "lifecycle": life,

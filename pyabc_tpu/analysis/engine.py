@@ -70,6 +70,11 @@ INSTRUMENTED = frozenset({
     # round 18: the ONE sanctioned multi-process runtime module
     # (DIST001's allow-list target) must stay in the scan
     "pyabc_tpu/parallel/distributed.py",
+    # round 22: the flight recorder and SLO engine timestamp every
+    # entry/sample on the injected clock (CLOCK001) and recorder.py is
+    # REC001's allow-list target — both must stay in the scan
+    "pyabc_tpu/observability/recorder.py",
+    "pyabc_tpu/observability/slo.py",
 })
 
 

@@ -16,12 +16,13 @@ from .exceptions import Exc001
 from .isolation import Iso001
 from .locks import Lock001
 from .placement_rule import Place001
+from .recorder_rule import Rec001
 from .rng import Rng001
 from .sync import Sync001
 from .telemetry import Telem001
 
 RULE_CLASSES = [Sync001, Clock001, Rng001, Exc001, Lock001, Telem001,
-                Disp001, Mesh001, Iso001, Place001, Dist001]
+                Disp001, Mesh001, Iso001, Place001, Dist001, Rec001]
 
 
 def all_rules():

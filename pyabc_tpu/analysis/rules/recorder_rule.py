@@ -1,0 +1,95 @@
+"""REC001 — telemetry persistence lives in recorder.py / export.py only.
+
+The observability package is IN-MEMORY by design: bounded rings,
+instruments, span lists. Exactly two modules are allowed to turn that
+state into bytes — ``observability/recorder.py`` (the crash-safe
+flight-recorder file: tmp + fsync + rename, CRC-framed) and
+``observability/export.py`` (Prometheus exposition text). A stray
+``open(...)`` in a metrics helper, or a hand-rolled ``write_flight``
+call from the serving layer, bypasses the recorder's atomicity and
+bounded-ring semantics: a torn half-file on crash is exactly the
+postmortem artifact the flight recorder exists to make impossible.
+Mirrors DIST001 (one sanctioned module for the process runtime) for
+the telemetry-persistence dimension.
+
+Two firing modes:
+
+- filesystem-write machinery (``open``, ``os.replace``, ``os.rename``,
+  ``os.fsync``) inside ``pyabc_tpu/observability/`` but outside the
+  two sanctioned files;
+- a direct ``write_flight(...)`` call ANYWHERE in ``pyabc_tpu/``
+  outside ``recorder.py`` — persistence goes through
+  ``FlightRecorder.dump()``, which owns the payload schema.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileContext, Finding, Rule
+
+#: the two sanctioned telemetry-persistence modules
+ALLOWED_FILES = {
+    "pyabc_tpu/observability/recorder.py",
+    "pyabc_tpu/observability/export.py",
+}
+
+#: filesystem-write machinery banned inside observability/
+_FS_WRITE = {"open", "io.open", "os.replace", "os.rename", "os.fsync"}
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """`a.b.c` -> "a.b.c" for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+class Rec001(Rule):
+    name = "REC001"
+    summary = ("telemetry file write outside observability/recorder.py "
+               "and observability/export.py")
+    hint = ("persist telemetry through FlightRecorder.dump() (crash-"
+            "safe tmp+fsync+rename, CRC-framed) or export it through "
+            "prometheus_text() — a hand-rolled open()/write_flight() "
+            "elsewhere can leave a torn half-file on crash, exactly "
+            "the artifact the flight recorder exists to prevent")
+
+    def applies_to(self, rel: str) -> bool:
+        if not rel.startswith("pyabc_tpu/"):
+            return False
+        if rel.startswith("pyabc_tpu/analysis/"):
+            return False
+        return rel not in ALLOWED_FILES
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        in_obs = ctx.rel.startswith("pyabc_tpu/observability/")
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            if dotted is None:
+                continue
+            if dotted == "write_flight" or dotted.endswith(".write_flight"):
+                findings.append(self.finding(
+                    ctx, node,
+                    f"`{dotted}(...)` persists a flight payload outside "
+                    f"pyabc_tpu/observability/recorder.py — telemetry "
+                    f"persistence goes through FlightRecorder.dump(), "
+                    f"which owns the payload schema and the crash-safe "
+                    f"write path",
+                ))
+            elif in_obs and dotted in _FS_WRITE:
+                findings.append(self.finding(
+                    ctx, node,
+                    f"`{dotted}(...)` writes files inside the in-memory "
+                    f"observability package — only recorder.py (flight "
+                    f"files) and export.py (exposition text) may turn "
+                    f"telemetry state into bytes",
+                ))
+        return findings

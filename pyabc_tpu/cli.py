@@ -269,9 +269,14 @@ def _run_worker_child(host, port, **kwargs):
 
 
 @click.command("abc-manager")
-@click.argument("host")
-@click.argument("port", type=int)
+@click.argument("host", required=False)
+@click.argument("port", type=int, required=False)
 @click.option("--watch", is_flag=True, help="refresh every 2s")
+@click.option("--postmortem", "postmortem", default=None,
+              type=click.Path(exists=True),
+              help="render a crash-safe flight-recorder file (a "
+              "tenant's .flight dump) as an offset-corrected timeline "
+              "and exit; no server needed")
 @click.option("--tenants", "tenants_mode", is_flag=True,
               help="talk to an abc-serve API instead of a broker: list "
               "its tenants (paged — round 19)")
@@ -282,15 +287,25 @@ def _run_worker_child(host, port, **kwargs):
               help="with --tenants: page start")
 @click.option("--limit", type=int, default=None,
               help="with --tenants: page size (default: everything)")
-def manager_cmd(host, port, watch, tenants_mode, state, offset, limit):
+def manager_cmd(host, port, watch, tenants_mode, state, offset, limit,
+                postmortem):
     """Show an ElasticSampler broker's live status (reference parity: the
     ``abc-redis-manager`` CLI): generation, counters, connected workers.
     With ``--tenants`` it instead pages an abc-serve scheduler's tenant
-    list (``?state=&offset=&limit=`` on ``/api/tenants``)."""
+    list (``?state=&offset=&limit=`` on ``/api/tenants``); with
+    ``--postmortem FILE`` it renders a flight-recorder dump offline."""
     import time as _time
 
     from .broker.protocol import request
 
+    if postmortem is not None:
+        from .observability import read_flight, render_timeline
+
+        click.echo(render_timeline(read_flight(postmortem)))
+        return
+    if host is None or port is None:
+        raise click.UsageError(
+            "HOST and PORT are required unless --postmortem is given")
     if tenants_mode:
         return _manager_tenants(host, port, watch, state, offset, limit)
     while True:

@@ -60,7 +60,7 @@ import logging
 
 import numpy as np
 
-from ..observability import register_dispatch_source
+from ..observability import fire_span_ship_hooks, register_dispatch_source
 from ..observability.metrics import (
     MESH_BUSY_MAX_GAUGE,
     MESH_DEVICES_GAUGE,
@@ -778,6 +778,11 @@ class DispatchEngine:
                 self.owner.chunk_event_cb(ev)
             except Exception:
                 logger.exception("chunk_event_cb failed")
+        # span-federation cadence (ISSUE 19): installed SpanShippers
+        # piggyback on the processed chunk — pure host-side TCP, no
+        # device touch, so the SyncLedger stays identical with
+        # federation on or off (strict-budget-asserted)
+        fire_span_ship_hooks()
         return (stop, last_pop, last_sample, last_eps, last_acc_rate,
                 t_at, g_lim, health_fail)
 

@@ -41,7 +41,12 @@ from ..distance import (
 )
 from ..epsilon import Epsilon, MedianEpsilon, NoEpsilon
 from ..model import JaxModel, Model, assert_models
-from ..observability import NULL_METRICS, SyncLedger, default_tracer
+from ..observability import (
+    NULL_METRICS,
+    SyncLedger,
+    default_tracer,
+    fire_span_ship_hooks,
+)
 from ..populationstrategy import (
     ConstantPopulationSize,
     ListPopulationSize,
@@ -2482,6 +2487,9 @@ class ABCSMC:
                     })
                 except Exception:
                     logger.exception("chunk_event_cb failed")
+            # span-federation cadence: generation 0 runs outside the
+            # chunk pipeline but its spans belong to the pod timeline
+            fire_span_ship_hooks()
             if self._check_stop(0, current_eps, minimum_epsilon,
                                 max_nr_populations, acceptance_rate,
                                 min_acceptance_rate, sims_total,

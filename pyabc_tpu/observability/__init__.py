@@ -51,12 +51,22 @@ from .export import (
 )
 from .metrics import (
     Counter,
+    FEDERATED_SPAN_BATCHES_TOTAL,
+    FEDERATED_SPANS_TOTAL,
     Gauge,
     Histogram,
     MetricsRegistry,
     NullMetrics,
     NULL_METRICS,
 )
+from .recorder import (
+    FlightCorruptError,
+    FlightRecorder,
+    read_flight,
+    render_timeline,
+    write_flight,
+)
+from .slo import SLO, SloEngine, default_slos
 from .sync import (
     DEFAULT_SYNC_FLOOR_S,
     NullSyncLedger,
@@ -88,6 +98,14 @@ __all__ = [
     "register_tenant_source", "unregister_tenant_source",
     "tenant_sources_snapshot",
     "record_host_clock_offset", "host_clocks_snapshot",
+    "FlightRecorder", "FlightCorruptError", "read_flight", "write_flight",
+    "render_timeline",
+    "SLO", "SloEngine", "default_slos",
+    "register_slo_source", "unregister_slo_source", "slo_sources_snapshot",
+    "ingest_remote_spans", "federated_spans_snapshot",
+    "clear_federated_spans",
+    "install_span_ship_hook", "uninstall_span_ship_hook",
+    "fire_span_ship_hooks",
 ]
 
 _lock = _threading.Lock()
@@ -306,6 +324,164 @@ def host_clocks_snapshot() -> dict:
         return {h: dict(s) for h, s in _host_clocks.items()}
 
 
+#: spans shipped from OTHER processes of a multi-host run, already
+#: offset-corrected onto this process's timebase and accounted on
+#: ``host:<p>`` pseudo-threads (the round-8 ``worker:<id>`` pattern,
+#: extended to whole pod members). Bounded: oldest dropped beyond the
+#: cap, so a long fleet run cannot grow the primary without bound.
+_federated_spans: list = []
+_FEDERATED_MAX_SPANS = 4096
+_federated_batches = 0
+_federated_dropped = 0
+
+
+def ingest_remote_spans(host: str, process_id: int, spans,
+                        *, tracer=None) -> int:
+    """Merge span summaries shipped by a remote process.
+
+    Each span dict's ``start``/``end`` are monotonic timestamps on the
+    REMOTE host's clock; they are mapped onto this process's timebase
+    with the measured offset from :func:`host_clocks_snapshot`
+    (``local = remote - offset_s`` — the estimator's offset convention
+    is remote-minus-local), then accounted under a ``host:<p>``
+    pseudo-thread in the bounded federated buffer (and mirrored into
+    ``tracer`` — default the process-global tracer — via
+    ``record_span``, so the coverage accountant and the flight recorder
+    see the whole pod). Spans from a host with NO measured offset merge
+    uncorrected and are flagged ``offset_corrected=False``. Returns the
+    number of spans merged."""
+    global _federated_batches, _federated_dropped
+    with _lock:
+        summ = _host_clocks.get(str(host))
+    offset = float(summ.get("offset_s") or 0.0) if summ else 0.0
+    corrected = summ is not None and summ.get("offset_s") is not None
+    if tracer is None:
+        tracer = global_tracer()
+    thread = f"host:{int(process_id)}"
+    merged: list = []
+    for sp in spans:
+        d = sp.to_dict() if hasattr(sp, "to_dict") else dict(sp)
+        start = float(d.get("start") or 0.0) - offset
+        end_raw = d.get("end")
+        end = (float(end_raw) - offset) if end_raw is not None else start
+        attrs = dict(d.get("attrs") or {})
+        attrs["origin_host"] = str(host)
+        attrs["origin_thread"] = d.get("thread", "")
+        if not corrected:
+            attrs["offset_corrected"] = False
+        merged.append({
+            "name": d.get("name", ""), "thread": thread,
+            "start": start, "end": end, "attrs": attrs,
+        })
+        if getattr(tracer, "enabled", False):
+            tracer.record_span(d.get("name", ""), start, end,
+                               thread=thread, **attrs)
+    with _lock:
+        _federated_spans.extend(merged)
+        _federated_batches += 1
+        if len(_federated_spans) > _FEDERATED_MAX_SPANS:
+            drop = len(_federated_spans) - _FEDERATED_MAX_SPANS
+            del _federated_spans[:drop]
+            _federated_dropped += drop
+    reg = global_metrics()
+    reg.counter(FEDERATED_SPAN_BATCHES_TOTAL).inc()
+    reg.counter(FEDERATED_SPANS_TOTAL).inc(len(merged))
+    return len(merged)
+
+
+def federated_spans_snapshot() -> list:
+    """Offset-corrected remote spans merged so far (dicts, oldest
+    first; bounded — see :func:`ingest_remote_spans`)."""
+    with _lock:
+        return [dict(d) for d in _federated_spans]
+
+
+def clear_federated_spans() -> None:
+    """Drop the federated buffer (test/bench hygiene between runs)."""
+    global _federated_batches, _federated_dropped
+    with _lock:
+        _federated_spans.clear()
+        _federated_batches = 0
+        _federated_dropped = 0
+
+
+#: span-ship hooks the dispatch engine fires once per processed chunk
+#: (the per-generation coordination cadence): zero-argument callables —
+#: a SpanShipper's ``ship``. Plain host-side I/O only: a hook must
+#: never touch a device or the SyncLedger, and a raising hook is
+#: dropped (best-effort observability must not fail the run).
+_span_ship_hooks: list = []
+
+
+def install_span_ship_hook(fn) -> None:
+    """Register ``fn`` to fire on the per-chunk federation cadence."""
+    with _lock:
+        if fn not in _span_ship_hooks:
+            _span_ship_hooks.append(fn)
+
+
+def uninstall_span_ship_hook(fn) -> None:
+    with _lock:
+        _span_ship_hooks[:] = [f for f in _span_ship_hooks if f is not fn]
+
+
+def fire_span_ship_hooks() -> None:
+    """Fire every installed ship hook; raising hooks uninstall
+    themselves (counted nowhere — the shipper side already marks itself
+    dead and logs through its own channel)."""
+    with _lock:
+        hooks = list(_span_ship_hooks)
+    for fn in hooks:
+        try:
+            fn()
+        except Exception:
+            uninstall_span_ship_hook(fn)
+
+
+#: weakly-referenced SLO engines: each entry is a weakref to an object
+#: with ``snapshot() -> dict`` (the RunScheduler's SloEngine registers
+#: itself on construction). Same lifecycle rules as the dispatch
+#: sources: dead refs prune on read.
+_slo_sources: list = []
+
+
+def register_slo_source(source) -> None:
+    """Register an SLO engine (an object with ``snapshot()``) with the
+    process-wide snapshot, via weakref — ``/api/observability`` then
+    carries the live burn-rate state next to the tenant namespaces."""
+    import weakref
+
+    with _lock:
+        _slo_sources.append(weakref.ref(source))
+
+
+def unregister_slo_source(source) -> None:
+    with _lock:
+        _slo_sources[:] = [
+            r for r in _slo_sources
+            if r() is not None and r() is not source
+        ]
+
+
+def slo_sources_snapshot() -> list:
+    """Snapshots of every live SLO engine in this process."""
+    out: list = []
+    with _lock:
+        refs = list(_slo_sources)
+    for r in refs:
+        src = r()
+        if src is None:
+            continue
+        try:
+            out.append(src.snapshot())
+        except Exception as exc:  # snapshotting must never kill the
+            # dashboard — but the broken source is named, not swallowed
+            out.append({"__error__": repr(exc)[:200]})
+    with _lock:
+        _slo_sources[:] = [r for r in _slo_sources if r() is not None]
+    return out
+
+
 def observability_snapshot() -> dict:
     """One JSON-ready dict of the process's tracer + metrics state —
     the in-process snapshot API (dashboard endpoint, bench block).
@@ -317,6 +493,10 @@ def observability_snapshot() -> dict:
     concurrent runs aggregate side by side instead of interleaving
     through the process globals; ``hosts`` carries the measured clock
     offset (± RTT/2) of every remote host probed from this process."""
+    with _lock:
+        fed = {"n_spans": len(_federated_spans),
+               "n_batches": _federated_batches,
+               "n_dropped": _federated_dropped}
     return {
         "tracer": global_tracer().snapshot(),
         "metrics": global_metrics().snapshot(),
@@ -324,4 +504,6 @@ def observability_snapshot() -> dict:
         "dispatch": dispatch_sources_snapshot(),
         "tenants": tenant_sources_snapshot(),
         "hosts": host_clocks_snapshot(),
+        "federation": fed,
+        "slo": slo_sources_snapshot(),
     }

@@ -141,10 +141,10 @@ def prometheus_text(registry, labels: dict | None = None) -> str:
             if inst.help:
                 lines.append(f"# HELP {name} {inst.help}")
             lines.append(f"# TYPE {name} histogram")
+            snap = inst.snapshot()
             cum = 0
-            with inst._lock:
-                buckets = list(inst._buckets)
-                count, total = inst.count, inst.sum
+            buckets = snap["buckets"]
+            count, total = snap["count"], snap["sum"]
             for edge, n in zip(inst.bucket_bounds(), buckets[:-1]):
                 cum += n
                 le = 'le="%g"' % edge

@@ -132,14 +132,57 @@ class Histogram:
             edge *= 2.0
         return out
 
-    def summary(self) -> dict:
+    def snapshot(self) -> dict:
+        """One consistent point-in-time read: bucket counts, count, sum,
+        min, max captured under the lock TOGETHER. Every reader that
+        needs more than a single field (the Prometheus exposition, the
+        SLO engine, ``summary``/``quantile``) goes through this — a
+        field-by-field read can interleave with a concurrent ``observe``
+        and yield a ``count`` inconsistent with the cumulative bucket
+        series."""
         with self._lock:
             return {
-                "count": self.count, "sum": round(self.sum, 9),
-                "min": self.min if self.count else None,
-                "max": self.max if self.count else None,
-                "mean": (self.sum / self.count) if self.count else None,
+                "buckets": list(self._buckets),
+                "count": self.count,
+                "sum": self.sum,
+                "min": self.min,
+                "max": self.max,
             }
+
+    def quantile(self, q: float, snap: dict | None = None) -> float:
+        """Upper-bound quantile estimate from the log2 buckets: the
+        upper edge of the smallest bucket whose cumulative count reaches
+        ``q * count`` (the overflow bucket reports the observed max, the
+        below-base bucket the base edge). NaN when empty. ``q`` is a
+        fraction in [0, 1]. Conservative by construction — the true
+        quantile is never above the estimate within a bucket."""
+        s = snap if snap is not None else self.snapshot()
+        count = s["count"]
+        if count <= 0:
+            return float("nan")
+        target = max(1, -(-count * min(max(float(q), 0.0), 1.0) // 1))
+        cum = 0
+        edge = self._base
+        buckets = s["buckets"]
+        for n in buckets[:-1]:
+            cum += n
+            if cum >= target:
+                return min(edge, s["max"])
+            edge *= 2.0
+        return s["max"]
+
+    def summary(self) -> dict:
+        s = self.snapshot()
+        count = s["count"]
+        return {
+            "count": count, "sum": round(s["sum"], 9),
+            "min": s["min"] if count else None,
+            "max": s["max"] if count else None,
+            "mean": (s["sum"] / count) if count else None,
+            "p50": self.quantile(0.50, s) if count else None,
+            "p90": self.quantile(0.90, s) if count else None,
+            "p99": self.quantile(0.99, s) if count else None,
+        }
 
 
 # -- elastic-worker instrument names (the pyabc_tpu_worker_* family) ---------
@@ -364,6 +407,36 @@ TRAFFIC_REJECTIONS_TOTAL = "pyabc_tpu_traffic_rejections_total"
 #:  histogram's summary() carries the p50/p99 the bench lane guards)
 TIME_TO_POSTERIOR_HISTOGRAM = "pyabc_tpu_time_to_posterior_seconds"
 
+# -- SLO / flight-recorder instrument names (round 22) ------------------------
+#
+# The burn-rate engine (observability/slo.py) and the crash-safe flight
+# recorder (observability/recorder.py); one canonical place so the
+# scheduler, traffic generator, serve API and the bench `slo` leg agree:
+#:  wall seconds spent INSIDE RunScheduler.submit() per admitted
+#:  arrival, observed scheduler-side (the traffic generator's view adds
+#:  client retry waits; this is the fleet's own admission-latency SLI)
+ADMISSION_LATENCY_HISTOGRAM = "pyabc_tpu_admission_latency_seconds"
+#:  observed_wait / first_hint per 429-rejected-then-admitted arrival —
+#:  the Retry-After honesty ratio, observed by the traffic generator
+#:  (1.0 = the hint priced the queue exactly)
+RETRY_HONESTY_HISTOGRAM = "pyabc_tpu_retry_after_honesty_ratio"
+#:  flight files persisted by fault-path dumps (all tenants, all causes)
+FLIGHT_DUMPS_TOTAL = "pyabc_tpu_flight_dumps_total"
+#:  remote span batches merged by the primary's federation sink
+FEDERATED_SPAN_BATCHES_TOTAL = "pyabc_tpu_federated_span_batches_total"
+#:  remote spans merged onto host:<p> pseudo-threads (offset-corrected)
+FEDERATED_SPANS_TOTAL = "pyabc_tpu_federated_spans_total"
+
+
+def slo_metric(slo_name: str, which: str) -> str:
+    """A per-SLO gauge name: ``pyabc_tpu_slo_<slo>_<which>`` with the
+    SLO name sanitized to Prometheus charset — the registry's stand-in
+    for ``pyabc_tpu_slo_*{slo=...}`` labels. ``which`` is one of
+    ``burn_fast`` / ``burn_slow`` / ``alerting`` / ``bad_fraction``;
+    cardinality is bounded by the declared SLO set."""
+    s = "".join(c if c.isalnum() or c == "_" else "_" for c in str(slo_name))
+    return f"pyabc_tpu_slo_{s}_{which}"
+
 
 def health_event_metric(kind: str) -> str:
     """Per-kind health-event counter name — the registry's stand-in for
@@ -462,7 +535,14 @@ class _NullInstrument:
 
     def summary(self) -> dict:
         return {"count": 0, "sum": 0.0, "min": None, "max": None,
-                "mean": None}
+                "mean": None, "p50": None, "p90": None, "p99": None}
+
+    def snapshot(self) -> dict:
+        return {"buckets": [], "count": 0, "sum": 0.0,
+                "min": float("inf"), "max": float("-inf")}
+
+    def quantile(self, q: float, snap: dict | None = None) -> float:
+        return float("nan")
 
 
 _NULL_INSTRUMENT = _NullInstrument()

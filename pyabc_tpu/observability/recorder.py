@@ -1,0 +1,315 @@
+"""Crash-safe flight recorder: the per-tenant black box.
+
+When a tenant dies hard — ``DegenerateRunError``, lease reap, device or
+host loss, a fatal writer-pool error, a SIGTERM drain — its in-memory
+trace used to die with it. The :class:`FlightRecorder` keeps a bounded
+ring of recent context (recorder notes, the span tail, metric deltas
+against an armed baseline, tenant lifecycle events, the measured
+cross-host clock table and the federated span tail) on the injected
+clock, and persists it ATOMICALLY on every fault path: serialized as
+JSON, CRC-framed exactly like the PR-5 checkpoint header (magic |
+version | crc32 | length, little-endian), written tmp + flush + fsync +
+rename so a crash mid-dump leaves either the previous flight file or a
+complete new one — never a torn read for the postmortem.
+
+JSON (not pickle) on purpose: a flight file must be parseable by
+``abc-manager --postmortem`` and by humans under incident pressure,
+with no import of the writing process's class graph.
+
+Same design rules as the rest of the subsystem: stdlib-only, host-side,
+injected clock only (CLOCK001), and dump-never-raises — a recorder
+failure on a fault path must not mask the fault being recorded.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import struct
+import threading
+import zlib
+
+from .clock import Clock, SYSTEM_CLOCK
+
+logger = logging.getLogger("pyabc_tpu.observability.recorder")
+
+#: flight-file magic — distinct from the checkpoint's ``PTCK`` so a
+#: mixed-up path fails loudly with a typed error, not a bad unpickle
+FLIGHT_MAGIC = b"PTFR"
+FLIGHT_VERSION = 1
+
+# same frame as resilience/checkpoint.py: magic | schema version |
+# payload crc32 | payload length, little-endian, 20 bytes
+_HEADER = struct.Struct("<4sIIQ")
+
+
+class FlightCorruptError(RuntimeError):
+    """A flight file failed validation (bad magic/version/length/CRC)."""
+
+    def __init__(self, path: str, reason: str):
+        super().__init__(f"corrupt flight file {path!r}: {reason}")
+        self.path = str(path)
+        self.reason = reason
+
+
+def write_flight(path: str, payload: dict) -> int:
+    """Atomically persist ``payload`` as a CRC-framed flight file.
+
+    tmp + flush + fsync + rename: the destination is never observable
+    half-written. Returns the total bytes written."""
+    blob = json.dumps(payload, default=str).encode("utf-8")
+    header = _HEADER.pack(FLIGHT_MAGIC, FLIGHT_VERSION,
+                          zlib.crc32(blob) & 0xFFFFFFFF, len(blob))
+    tmp = f"{path}.tmp"
+    with open(tmp, "wb") as fh:
+        fh.write(header)
+        fh.write(blob)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    return _HEADER.size + len(blob)
+
+
+def read_flight(path: str) -> dict:
+    """Load + validate a flight file; raises :class:`FlightCorruptError`
+    with the FIRST failing check (magic -> version -> length -> CRC ->
+    decode), mirroring the checkpoint loader's order."""
+    with open(path, "rb") as fh:
+        data = fh.read()
+    if len(data) < _HEADER.size:
+        raise FlightCorruptError(
+            path, f"truncated header ({len(data)} < {_HEADER.size} bytes)")
+    magic, version, crc, length = _HEADER.unpack_from(data)
+    if magic != FLIGHT_MAGIC:
+        raise FlightCorruptError(path, f"bad magic {magic!r}")
+    if version != FLIGHT_VERSION:
+        raise FlightCorruptError(
+            path, f"unsupported flight version {version}")
+    blob = data[_HEADER.size:]
+    if len(blob) != length:
+        raise FlightCorruptError(
+            path, f"payload length {len(blob)} != header {length}")
+    if zlib.crc32(blob) & 0xFFFFFFFF != crc:
+        raise FlightCorruptError(path, "payload CRC mismatch")
+    try:
+        payload = json.loads(blob)
+    except json.JSONDecodeError as err:
+        raise FlightCorruptError(path, f"JSON decode failed: {err}") from err
+    if not isinstance(payload, dict):
+        raise FlightCorruptError(
+            path, f"payload is {type(payload).__name__}, not an object")
+    return payload
+
+
+class FlightRecorder:
+    """Bounded black box for one tenant/run.
+
+    ``note(kind, **attrs)`` appends a timestamped entry to the ring
+    (oldest dropped beyond ``max_entries``); ``arm`` attaches the live
+    sources a snapshot gathers from — the tenant's tracer (span tail),
+    metrics registry (baseline captured at arm time so the snapshot
+    carries DELTAS, not lifetime totals), and a lifecycle-events
+    callable. ``dump`` persists the snapshot via :func:`write_flight`
+    and NEVER raises: it is called from fault paths where a secondary
+    failure must not mask the primary one.
+    """
+
+    def __init__(self, run_id: str, *, clock: Clock | None = None,
+                 path: str | None = None, max_entries: int = 512,
+                 max_spans: int = 256):
+        self.run_id = str(run_id)
+        self.path = path
+        self._clock = clock if clock is not None else SYSTEM_CLOCK
+        self._lock = threading.Lock()
+        self._max_entries = int(max_entries)
+        self._max_spans = int(max_spans)
+        self._entries: list[dict] = []  # abc-lint: guarded-by=_lock
+        self._n_dropped = 0  # abc-lint: guarded-by=_lock
+        self._tracer = None
+        self._metrics = None
+        self._events_fn = None
+        self._baseline: dict = {}
+        self.n_dumps = 0
+
+    # ------------------------------------------------------------------ arm
+    def arm(self, *, tracer=None, metrics=None, events_fn=None) -> None:
+        """Attach live sources; captures the metrics baseline so later
+        snapshots report deltas over the recorder's lifetime."""
+        with self._lock:
+            if tracer is not None:
+                self._tracer = tracer
+            if metrics is not None:
+                self._metrics = metrics
+                self._baseline = _numeric_view(metrics.snapshot())
+            if events_fn is not None:
+                self._events_fn = events_fn
+
+    # ----------------------------------------------------------------- ring
+    def note(self, kind: str, **attrs) -> None:
+        """Append one timestamped entry (lease/chunk events, health
+        words, capability fallbacks, fault-path breadcrumbs)."""
+        entry = {"ts": self._clock.now(), "wall": self._clock.wall(),
+                 "kind": str(kind)}
+        entry.update(attrs)
+        with self._lock:
+            self._entries.append(entry)
+            if len(self._entries) > self._max_entries:
+                drop = len(self._entries) - self._max_entries
+                del self._entries[:drop]
+                self._n_dropped += drop
+
+    # ------------------------------------------------------------- snapshot
+    def snapshot(self, *, reason: str = "on_demand") -> dict:
+        """The full black-box payload, JSON-ready."""
+        # lazy import: the package __init__ imports this module
+        from . import federated_spans_snapshot, host_clocks_snapshot
+        with self._lock:
+            entries = list(self._entries)
+            n_dropped = self._n_dropped
+            tracer, metrics, events_fn = (
+                self._tracer, self._metrics, self._events_fn)
+            baseline = dict(self._baseline)
+        spans: list[dict] = []
+        if tracer is not None:
+            # host:<p> pseudo-thread spans ride the federated block —
+            # skipping them here keeps the timeline free of duplicates
+            # when the tracer also mirrors the federation merge
+            tail = [sp for sp in tracer.spans()
+                    if not str(sp.thread).startswith("host:")]
+            spans = [sp.to_dict() for sp in tail[-self._max_spans:]]
+        current: dict = {}
+        deltas: dict = {}
+        if metrics is not None:
+            current = _numeric_view(metrics.snapshot())
+            deltas = {k: round(v - baseline.get(k, 0.0), 9)
+                      for k, v in current.items()
+                      if v != baseline.get(k, 0.0)}
+        events: list = []
+        if events_fn is not None:
+            try:
+                events = list(events_fn())
+            except Exception as err:
+                events = [{"kind": "flight.events_source_error",
+                           "error": repr(err)[:200]}]
+        return {
+            "flight_version": FLIGHT_VERSION,
+            "run_id": self.run_id,
+            "reason": reason,
+            "ts": self._clock.now(),
+            "wall": self._clock.wall(),
+            "entries": entries,
+            "entries_dropped": n_dropped,
+            "spans": spans,
+            "metrics": {"baseline": baseline, "current": current,
+                        "deltas": deltas},
+            "events": events,
+            "hosts": host_clocks_snapshot(),
+            "federated_spans": federated_spans_snapshot()[-self._max_spans:],
+        }
+
+    # ----------------------------------------------------------------- dump
+    def dump(self, path: str | None = None, *,
+             reason: str = "fault") -> str | None:
+        """Persist the snapshot; returns the path, or None on failure.
+
+        Never raises — a broken disk during a host-loss dump must not
+        turn the fault path into a crash loop. Failures are logged and
+        visible as ``flight.dump_error`` notes on the next snapshot."""
+        target = path or self.path
+        if target is None:
+            return None
+        try:
+            write_flight(target, self.snapshot(reason=reason))
+            self.n_dumps += 1
+            return target
+        except Exception as err:
+            logger.warning("flight dump to %s failed: %r", target, err)
+            self.note("flight.dump_error", path=str(target),
+                      error=repr(err)[:200])
+            return None
+
+
+def _numeric_view(snapshot: dict) -> dict:
+    """Flatten a MetricsRegistry.snapshot() to {name: float} — histogram
+    summaries contribute their count/sum so deltas stay meaningful."""
+    out: dict[str, float] = {}
+    for name, val in snapshot.items():
+        if isinstance(val, dict):
+            out[f"{name}_count"] = float(val.get("count") or 0)
+            out[f"{name}_sum"] = float(val.get("sum") or 0.0)
+        else:
+            try:
+                out[name] = float(val)
+            except (TypeError, ValueError):
+                continue
+    return out
+
+
+# -------------------------------------------------------------- postmortem
+def render_timeline(payload: dict) -> str:
+    """Render a flight payload into the offset-corrected postmortem
+    timeline ``abc-manager --postmortem`` prints.
+
+    Spans (local and ``host:<p>`` federated — the latter were mapped
+    onto the primary's timebase at ingest via the measured clock
+    offsets), recorder entries and tenant lifecycle events merge into
+    one chronological listing with times relative to the earliest
+    timestamp; the host-clock table prints the offset ± uncertainty
+    each remote span was corrected with."""
+    rows: list[tuple[float, str]] = []
+
+    def _fmt_attrs(attrs: dict) -> str:
+        if not attrs:
+            return ""
+        body = " ".join(f"{k}={v}" for k, v in list(attrs.items())[:8])
+        return body if len(body) <= 100 else body[:97] + "..."
+
+    for sp in list(payload.get("spans") or []) + \
+            list(payload.get("federated_spans") or []):
+        start = float(sp.get("start") or 0.0)
+        end = sp.get("end")
+        dur = (float(end) - start) if end is not None else 0.0
+        rows.append((start, "span  %-14s %-28s %8.3fs  %s" % (
+            f"[{sp.get('thread', '')}]", sp.get("name", ""), dur,
+            _fmt_attrs(sp.get("attrs") or {}))))
+    for ent in payload.get("entries") or []:
+        ts = float(ent.get("ts") or 0.0)
+        attrs = {k: v for k, v in ent.items()
+                 if k not in ("ts", "wall", "kind")}
+        rows.append((ts, "note  %-14s %-28s           %s" % (
+            "[recorder]", ent.get("kind", ""), _fmt_attrs(attrs))))
+    for ev in payload.get("events") or []:
+        if not isinstance(ev, dict):
+            continue
+        ts = float(ev.get("ts") or 0.0)
+        attrs = {k: v for k, v in ev.items()
+                 if k not in ("ts", "wall", "kind", "seq")}
+        rows.append((ts, "event %-14s %-28s           %s" % (
+            "[tenant]", ev.get("kind", ""), _fmt_attrs(attrs))))
+    rows.sort(key=lambda r: r[0])
+    t0 = rows[0][0] if rows else 0.0
+
+    lines = [
+        "flight recorder · run %s · reason=%s · dumped %s" % (
+            payload.get("run_id", "?"), payload.get("reason", "?"),
+            payload.get("wall", "?")),
+    ]
+    hosts = payload.get("hosts") or {}
+    for host, summ in sorted(hosts.items()):
+        if isinstance(summ, dict):
+            lines.append(
+                "host clock %-18s offset=%+.6fs ±%.6fs" % (
+                    str(host),
+                    float(summ.get("offset_s") or 0.0),
+                    float(summ.get("uncertainty_s") or 0.0)))
+    deltas = (payload.get("metrics") or {}).get("deltas") or {}
+    if deltas:
+        lines.append("metric deltas since arm: " + ", ".join(
+            f"{k}={v:g}" for k, v in sorted(deltas.items())[:12]))
+    dropped = payload.get("entries_dropped") or 0
+    if dropped:
+        lines.append(f"({dropped} oldest recorder entries dropped)")
+    lines.append("")
+    for ts, body in rows:
+        lines.append("%+10.3fs  %s" % (ts - t0, body.rstrip()))
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,309 @@
+"""Declarative SLOs with multi-window burn-rate alerting.
+
+The traffic lane's one-shot bench guards (admission p99, Retry-After
+honesty, time-to-posterior) become LIVE fleet signals: an
+:class:`SLO` declares a per-traffic-class objective over instruments
+that already exist in a :class:`~pyabc_tpu.observability.metrics.
+MetricsRegistry`, and the :class:`SloEngine` samples the cumulative
+good/total counts on the injected clock, computes windowed bad-event
+fractions, and alerts on the classic multi-window burn-rate rule: the
+error budget must be burning FAST on both a short and a long window
+(fast pair 5m/1h at 14.4x budget, slow pair 6h/3d at 6x) before the
+alert fires — transient spikes on the short window alone don't page,
+and a sustained slow leak still does.
+
+Three SLI shapes, all read from cumulative instruments (no per-request
+bookkeeping):
+
+- **histogram threshold** — good = observations at or under
+  ``threshold`` (the cumulative log2-bucket count at the last edge not
+  above it, via ``Histogram.snapshot()`` — conservative: a straddling
+  bucket counts bad);
+- **good/total counters** — e.g. availability = completed / admitted;
+- **good/bad counters** — total = good + bad, e.g. admission
+  availability = admitted / (admitted + rejected).
+
+Everything is host-side, stdlib-only, and injected-clock-disciplined
+like the rest of the subsystem; state is exported as ``pyabc_tpu_slo_*``
+gauges plus the ``slo`` block of ``/api/observability``.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from .clock import Clock, SYSTEM_CLOCK
+from .metrics import (
+    ADMISSION_LATENCY_HISTOGRAM,
+    Histogram,
+    RETRY_HONESTY_HISTOGRAM,
+    TENANT_ADMISSIONS_TOTAL,
+    TENANT_COMPLETED_TOTAL,
+    TENANT_REJECTIONS_TOTAL,
+    TIME_TO_POSTERIOR_HISTOGRAM,
+    slo_metric,
+)
+
+#: multi-window burn-rate pairs (seconds) + thresholds — the standard
+#: fast-page / slow-ticket split: 14.4x burn on 5m AND 1h consumes 2%
+#: of a 30-day budget in an hour; 6x on 6h AND 3d is the slow leak
+FAST_WINDOWS_S = (300.0, 3600.0)
+FAST_BURN_THRESHOLD = 14.4
+SLOW_WINDOWS_S = (21600.0, 259200.0)
+SLOW_BURN_THRESHOLD = 6.0
+
+
+@dataclass(frozen=True)
+class SLO:
+    """One declarative objective over existing instruments.
+
+    Exactly one SLI shape must be configured: ``histogram`` +
+    ``threshold``, ``good_counter`` + ``total_counter``, or
+    ``good_counter`` + ``bad_counter``. ``objective`` is the target
+    good fraction (0.99 = 1% error budget)."""
+
+    name: str
+    objective: float
+    traffic_class: str = "*"
+    histogram: str | None = None
+    threshold: float | None = None
+    good_counter: str | None = None
+    total_counter: str | None = None
+    bad_counter: str | None = None
+    description: str = ""
+
+    def __post_init__(self):
+        if not 0.0 < self.objective < 1.0:
+            raise ValueError(
+                f"SLO {self.name!r}: objective must be in (0, 1), "
+                f"got {self.objective}")
+        hist = self.histogram is not None
+        ratio = self.good_counter is not None and (
+            (self.total_counter is not None)
+            != (self.bad_counter is not None))
+        if hist == ratio or (hist and self.threshold is None):
+            raise ValueError(
+                f"SLO {self.name!r}: configure exactly one SLI shape — "
+                "histogram+threshold, good_counter+total_counter, or "
+                "good_counter+bad_counter")
+
+    @property
+    def budget(self) -> float:
+        """The error budget: the tolerated bad fraction."""
+        return 1.0 - self.objective
+
+
+def default_slos() -> list[SLO]:
+    """The fleet's standing objectives over the serving instruments
+    (schedulers may pass their own list to tighten/replace them)."""
+    return [
+        SLO(name="admission_latency", objective=0.99,
+            histogram=ADMISSION_LATENCY_HISTOGRAM, threshold=2.0,
+            description="scheduler-side submit() wall under 2s"),
+        SLO(name="admission_availability", objective=0.99,
+            good_counter=TENANT_ADMISSIONS_TOTAL,
+            bad_counter=TENANT_REJECTIONS_TOTAL,
+            description="arrivals admitted vs 429-rejected"),
+        SLO(name="availability", objective=0.90,
+            good_counter=TENANT_COMPLETED_TOTAL,
+            total_counter=TENANT_ADMISSIONS_TOTAL,
+            description="admitted tenants that reach a posterior"),
+        SLO(name="time_to_posterior", objective=0.90,
+            histogram=TIME_TO_POSTERIOR_HISTOGRAM, threshold=600.0,
+            description="submit -> posterior under 10 minutes"),
+        SLO(name="retry_honesty", objective=0.90,
+            histogram=RETRY_HONESTY_HISTOGRAM, threshold=10.0,
+            description="Retry-After hints within 10x of observed wait"),
+    ]
+
+
+@dataclass
+class _Sample:
+    ts: float
+    good: float
+    total: float
+
+
+@dataclass
+class _SloState:
+    slo: SLO
+    samples: list = field(default_factory=list)
+
+
+class SloEngine:
+    """Samples cumulative SLIs into bounded rings and evaluates the
+    multi-window burn-rate rule on the injected clock.
+
+    ``sample()`` is called opportunistically from the scheduler's pump
+    tick (throttled to ``sample_interval_s``); ``snapshot()`` serves
+    the live state to ``/api/observability`` and the bench; gauges
+    (``pyabc_tpu_slo_<name>_{burn_fast,burn_slow,alerting,
+    bad_fraction}``) are refreshed on every accepted sample so a plain
+    Prometheus scrape sees the burn state without calling the API."""
+
+    def __init__(self, metrics, *, slos: list[SLO] | None = None,
+                 clock: Clock | None = None,
+                 sample_interval_s: float = 10.0,
+                 max_samples: int = 4096, register: bool = True):
+        self._metrics = metrics
+        self._clock = clock if clock is not None else getattr(
+            metrics, "clock", SYSTEM_CLOCK)
+        self._interval = float(sample_interval_s)
+        self._max_samples = int(max_samples)
+        self._lock = threading.Lock()
+        self._states = {s.name: _SloState(slo=s)  # abc-lint: guarded-by=_lock
+                        for s in (slos if slos is not None
+                                  else default_slos())}
+        self._last_ts: float | None = None  # abc-lint: guarded-by=_lock
+        if register:
+            from . import register_slo_source
+            register_slo_source(self)
+
+    @property
+    def slos(self) -> list[SLO]:
+        with self._lock:
+            return [st.slo for st in self._states.values()]
+
+    # ------------------------------------------------------------- sampling
+    def _measure(self, slo: SLO) -> tuple[float, float]:
+        """Cumulative (good, total) for one SLO, right now."""
+        if slo.histogram is not None:
+            hist = self._metrics.histogram(slo.histogram)
+            if not isinstance(hist, Histogram):
+                return 0.0, 0.0
+            snap = hist.snapshot()
+            good = 0
+            for edge, n in zip(hist.bucket_bounds(), snap["buckets"][:-1]):
+                if edge > slo.threshold:
+                    break
+                good += n
+            return float(good), float(snap["count"])
+        good = float(self._metrics.counter(slo.good_counter).value)
+        if slo.total_counter is not None:
+            total = float(self._metrics.counter(slo.total_counter).value)
+        else:
+            total = good + float(
+                self._metrics.counter(slo.bad_counter).value)
+        return good, total
+
+    def sample(self, force: bool = False) -> bool:
+        """Take one sample of every SLI if ``sample_interval_s`` has
+        elapsed (or ``force``); returns whether a sample was taken."""
+        now = self._clock.now()
+        with self._lock:
+            if (not force and self._last_ts is not None
+                    and now - self._last_ts < self._interval):
+                return False
+            self._last_ts = now
+            states = list(self._states.values())
+        for st in states:
+            good, total = self._measure(st.slo)
+            with self._lock:
+                st.samples.append(_Sample(ts=now, good=good, total=total))
+                if len(st.samples) > self._max_samples:
+                    del st.samples[:len(st.samples) - self._max_samples]
+            self._export_gauges(st, now)
+        return True
+
+    # ----------------------------------------------------------- evaluation
+    @staticmethod
+    def _window_delta(samples: list, now: float,
+                      window_s: float) -> tuple[float, float]:
+        """(bad, total) events inside the trailing window: latest sample
+        minus the newest sample at or before ``now - window_s`` (the
+        oldest available when the ring doesn't reach back that far —
+        the standard cold-start approximation)."""
+        if not samples:
+            return 0.0, 0.0
+        latest = samples[-1]
+        cutoff = now - window_s
+        base = samples[0]
+        for s in samples:
+            if s.ts <= cutoff:
+                base = s
+            else:
+                break
+        d_total = latest.total - base.total
+        d_good = latest.good - base.good
+        if d_total <= 0.0:
+            return 0.0, 0.0
+        return max(0.0, d_total - d_good), d_total
+
+    def _burn(self, st: _SloState, now: float, window_s: float) -> float:
+        with self._lock:
+            samples = list(st.samples)
+        bad, total = self._window_delta(samples, now, window_s)
+        if total <= 0.0:
+            return 0.0
+        return (bad / total) / st.slo.budget
+
+    def _evaluate(self, st: _SloState, now: float) -> dict:
+        slo = st.slo
+        burns = {w: self._burn(st, now, w)
+                 for w in (*FAST_WINDOWS_S, *SLOW_WINDOWS_S)}
+        burn_fast = min(burns[w] for w in FAST_WINDOWS_S)
+        burn_slow = min(burns[w] for w in SLOW_WINDOWS_S)
+        alerting_fast = burn_fast > FAST_BURN_THRESHOLD
+        alerting_slow = burn_slow > SLOW_BURN_THRESHOLD
+        with self._lock:
+            latest = st.samples[-1] if st.samples else None
+        good = latest.good if latest else 0.0
+        total = latest.total if latest else 0.0
+        bad_fraction = (1.0 - good / total) if total > 0 else 0.0
+        return {
+            "name": slo.name,
+            "traffic_class": slo.traffic_class,
+            "objective": slo.objective,
+            "description": slo.description,
+            "good": good,
+            "total": total,
+            "bad_fraction": round(bad_fraction, 9),
+            "burn": {f"{int(w)}s": round(burns[w], 6) for w in burns},
+            "burn_fast": round(burn_fast, 6),
+            "burn_slow": round(burn_slow, 6),
+            "alerting_fast": alerting_fast,
+            "alerting_slow": alerting_slow,
+            "alerting": alerting_fast or alerting_slow,
+        }
+
+    def _export_gauges(self, st: _SloState, now: float) -> None:
+        ev = self._evaluate(st, now)
+        name = st.slo.name
+        reg = self._metrics
+        reg.gauge(slo_metric(name, "burn_fast")).set(ev["burn_fast"])
+        reg.gauge(slo_metric(name, "burn_slow")).set(ev["burn_slow"])
+        reg.gauge(slo_metric(name, "alerting")).set(
+            1.0 if ev["alerting"] else 0.0)
+        reg.gauge(slo_metric(name, "bad_fraction")).set(ev["bad_fraction"])
+
+    def evaluate(self, name: str) -> dict:
+        """Burn-rate evaluation of one SLO, at the current clock."""
+        with self._lock:
+            st = self._states[name]
+        return self._evaluate(st, self._clock.now())
+
+    def alerting(self, name: str | None = None) -> bool:
+        """Is ``name`` (or, with None, ANY declared SLO) alerting?"""
+        now = self._clock.now()
+        with self._lock:
+            states = ([self._states[name]] if name is not None
+                      else list(self._states.values()))
+        return any(self._evaluate(st, now)["alerting"] for st in states)
+
+    def snapshot(self) -> dict:
+        """JSON-ready live state (the ``/api/observability`` block)."""
+        now = self._clock.now()
+        with self._lock:
+            states = list(self._states.values())
+            last_ts = self._last_ts
+        return {
+            "windows": {
+                "fast_s": list(FAST_WINDOWS_S),
+                "fast_threshold": FAST_BURN_THRESHOLD,
+                "slow_s": list(SLOW_WINDOWS_S),
+                "slow_threshold": SLOW_BURN_THRESHOLD,
+            },
+            "sample_interval_s": self._interval,
+            "last_sample_ts": last_ts,
+            "slos": [self._evaluate(st, now) for st in states],
+        }

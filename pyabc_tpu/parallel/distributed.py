@@ -355,3 +355,199 @@ def measure_clock_offset(address: str, *, host: str | None = None,
     if host is not None:
         observability.record_host_clock_offset(host, est.summary())
     return est
+
+
+# ------------------------------------------------------ span federation
+#
+# A PR-18 pod run leaves every non-primary process's spans stranded in
+# that process: the gap accountant and the flight recorder only saw the
+# primary's share of the wall clock. Federation ships bounded span
+# summaries to the primary over the same kind of bare-TCP side channel
+# as the clock rig — pure host-side I/O piggybacked on the
+# per-generation cadence (the dispatch engine fires the ship hook next
+# to its chunk-event callback), so it adds ZERO blocking host<->device
+# round trips: nothing here may touch a device or the SyncLedger, and
+# the strict sync budget asserts federation on/off identical.
+#
+# Batch wire format: 4-byte big-endian length + JSON object
+# {"host": str, "process_id": int, "spans": [span dicts]}. The primary
+# merges via observability.ingest_remote_spans, which offset-corrects
+# each span with the measured host-clock table onto host:<p>
+# pseudo-threads.
+
+def serve_span_sink(port: int = 0, *, tracer=None, on_batch=None):
+    """Primary-side federation sink; returns ``(port, stop)``.
+
+    Each received batch merges into the process-wide federated span
+    buffer (offset-corrected — see
+    :func:`~pyabc_tpu.observability.ingest_remote_spans`); ``tracer``
+    overrides the mirror target, ``on_batch(batch_dict)`` is an
+    optional test/bench tap. Malformed batches drop the connection,
+    never the server."""
+    import json
+    import socket
+    import struct
+    import threading
+
+    from .. import observability
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", int(port)))
+    srv.listen(8)
+    stopping = threading.Event()
+
+    def _recv_exact(conn, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _handle(conn):
+        with conn:
+            while not stopping.is_set():
+                try:
+                    head = _recv_exact(conn, 4)
+                    if head is None:
+                        return
+                    (length,) = struct.unpack("!I", head)
+                    body = _recv_exact(conn, length)
+                    if body is None:
+                        return
+                    batch = json.loads(body)
+                    observability.ingest_remote_spans(
+                        str(batch.get("host", "?")),
+                        int(batch.get("process_id", -1)),
+                        batch.get("spans") or (),
+                        tracer=tracer,
+                    )
+                    if on_batch is not None:
+                        on_batch(batch)
+                except (OSError, ValueError, KeyError):
+                    return
+
+    def _accept_loop():
+        while not stopping.is_set():
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=_handle, args=(conn,), daemon=True
+            ).start()
+
+    bound_port = srv.getsockname()[1]
+    threading.Thread(target=_accept_loop, daemon=True).start()
+
+    def stop():
+        stopping.set()
+        try:
+            srv.close()
+        except OSError:
+            pass
+
+    return bound_port, stop
+
+
+class SpanShipper:
+    """Ships a tracer's new finished spans to a federation sink.
+
+    Owned by a NON-primary process; ``ship()`` collects spans finished
+    since the last call (cursor by span id, so the tracer's bounded
+    buffer dropping old spans can't replay), truncates to
+    ``max_spans_per_batch`` newest, and sends one batch over the
+    persistent TCP connection. Plain host-side I/O on the generation
+    cadence: a ship failure DISABLES the shipper (federation is
+    best-effort observability) and never propagates into the run.
+
+    ``install()`` registers ``ship`` with the observability-layer ship
+    hook the dispatch engine fires per processed chunk; ``close()``
+    unregisters and drops the socket.
+    """
+
+    def __init__(self, address: str, *, host: str, process_id: int,
+                 tracer, max_spans_per_batch: int = 256):
+        self.address = str(address)
+        self.host = str(host)
+        self.process_id = int(process_id)
+        self._tracer = tracer
+        self._max_batch = int(max_spans_per_batch)
+        self._cursor = 0
+        self._sock = None
+        self._dead = False
+        self.n_shipped = 0
+
+    @classmethod
+    def from_env(cls, tracer, *, process_id: int | None = None,
+                 host: str | None = None):
+        """A shipper targeting ``PYABC_TPU_SPAN_SINK`` (``host:port``),
+        or None when the env var is unset — the opt-in production
+        spelling; tests/bench construct explicitly."""
+        address = os.environ.get("PYABC_TPU_SPAN_SINK")
+        if not address:
+            return None
+        if process_id is None:
+            import jax
+
+            process_id = jax.process_index()
+        return cls(address, host=host or f"proc{process_id}",
+                   process_id=process_id, tracer=tracer)
+
+    def _connect(self):
+        import socket
+
+        if self._sock is None:
+            hostname, _, port = self.address.rpartition(":")
+            self._sock = socket.create_connection(
+                (hostname, int(port)), timeout=30)
+        return self._sock
+
+    def ship(self) -> int:
+        """Send spans finished since the last ship; returns the count
+        (0 after a failure has disabled the shipper)."""
+        import json
+        import struct
+
+        if self._dead:
+            return 0
+        fresh = [sp for sp in self._tracer.spans()
+                 if sp.span_id > self._cursor
+                 and not str(sp.thread).startswith("host:")]
+        if not fresh:
+            return 0
+        self._cursor = max(sp.span_id for sp in fresh)
+        fresh = fresh[-self._max_batch:]
+        body = json.dumps({
+            "host": self.host,
+            "process_id": self.process_id,
+            "spans": [sp.to_dict() for sp in fresh],
+        }).encode("utf-8")
+        try:
+            sock = self._connect()
+            sock.sendall(struct.pack("!I", len(body)) + body)
+        except OSError:
+            self._dead = True
+            self._sock = None
+            return 0
+        self.n_shipped += len(fresh)
+        return len(fresh)
+
+    def install(self) -> "SpanShipper":
+        from .. import observability
+
+        observability.install_span_ship_hook(self.ship)
+        return self
+
+    def close(self) -> None:
+        from .. import observability
+
+        observability.uninstall_span_ship_hook(self.ship)
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None

@@ -29,6 +29,11 @@ pattern (no Flask in this environment) into the serving front door:
                                   when the server lacks pyarrow) and
                                   ``?format=summaries`` requests the
                                   NDJSON summary framing directly
+- ``GET  /api/tenant/<id>/flight`` the tenant's live flight-recorder
+                                  snapshot (round 22): bounded rings of
+                                  recent spans, metric deltas, events
+                                  and federated host spans — the same
+                                  payload a fault-path dump persists
 - ``POST /api/tenant/<id>/cancel`` cancel (graceful for running runs)
 - ``POST /api/tenant/<id>/preempt`` checkpoint-preempt a running
                                   tenant: it stops at its next chunk
@@ -140,6 +145,19 @@ def _make_handler(sched: RunScheduler):
                     if rest.endswith("/stream") or "/stream?" in rest:
                         tid, _, q = rest.partition("/stream")
                         return self._stream(tid, q.lstrip("?"))
+                    if rest.endswith("/flight"):
+                        tid = rest[:-len("/flight")]
+                        tenant = sched.get(tid)
+                        if tenant is None:
+                            return self._json(
+                                404, {"error": "unknown tenant",
+                                      "id": tid})
+                        # on-demand flight snapshot: the same payload a
+                        # fault-path dump persists, straight off the
+                        # live rings (no file round-trip)
+                        return self._json(
+                            200,
+                            tenant.flight.snapshot(reason="api"))
                     status = sched.status(rest)
                     if status is None:
                         return self._json(404, {"error": "unknown tenant",

@@ -354,6 +354,12 @@ class LifecycleManager:
         ck = tenant.checkpoint_path
         if ck and os.path.exists(ck):
             os.unlink(ck)
+        # flight-recorder dumps belong to the tenant too: a disposed
+        # tenant leaving its .flight behind is the same orphan-file
+        # leak the eviction guard exists to catch
+        fl = getattr(tenant, "flight_path", None)
+        if fl and os.path.exists(fl):
+            os.unlink(fl)
         tenant.disposed = True
         self.tenants_disposed_total += 1
         after = self.bytes_on_disk(tenant)

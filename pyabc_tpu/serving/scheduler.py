@@ -65,12 +65,15 @@ from collections import deque
 
 from ..observability import (
     SYSTEM_CLOCK,
+    SloEngine,
     global_metrics,
     register_tenant_source,
     unregister_tenant_source,
 )
 from ..observability.metrics import (
+    ADMISSION_LATENCY_HISTOGRAM,
     DEVICES_LOST_TOTAL,
+    FLIGHT_DUMPS_TOTAL,
     HOSTS_LOST_TOTAL,
     SUBMESH_DEVICES_FREE_GAUGE,
     SUBMESH_DEVICES_HEALTHY_GAUGE,
@@ -130,7 +133,8 @@ class RunScheduler:
                  tick_s: float = 0.05, max_terminal_tenants: int = 256,
                  retention: RetentionPolicy | None = None,
                  quota: TenantQuota | None = None,
-                 lifecycle_sweep_s: float = 5.0):
+                 lifecycle_sweep_s: float = 5.0,
+                 slos=None, slo_sample_interval_s: float = 10.0):
         self.clock = clock if clock is not None else SYSTEM_CLOCK
         self.metrics = metrics if metrics is not None else global_metrics()
         #: the device pool the allocator manages. ``n_devices`` sizes it
@@ -193,6 +197,13 @@ class RunScheduler:
         self.leases = LeaseTable(self.clock, timeout_s=lease_timeout_s)
         self.kernel_cache = KernelCache(max_entries=kernel_cache_entries)
         self.writer_pool = WriterPool(n_threads=writer_threads)
+        #: SLO burn-rate engine (round 22): samples the scheduler's own
+        #: instruments on the pump cadence, so live burn state is one
+        #: ``snapshot()["slo"]`` away and the gauges export for free
+        self.slo = SloEngine(
+            self.metrics, slos=slos, clock=self.clock,
+            sample_interval_s=slo_sample_interval_s,
+        )
 
         self._lock = threading.RLock()
         self._wake = threading.Condition(self._lock)
@@ -221,6 +232,7 @@ class RunScheduler:
 
         Returns the supervised :class:`Tenant` immediately; the run
         starts when a device slot frees up."""
+        t_admit = self.clock.now()
         with self._lock:
             if self._shutdown or self._draining:
                 raise AdmissionRejectedError(
@@ -249,11 +261,17 @@ class RunScheduler:
                 tid, spec, clock=self.clock,
                 db_path=f"{scheme}:///{self.base_dir}/{tid}.db",
                 checkpoint_path=os.path.join(self.base_dir, f"{tid}.ck"),
+                flight_path=os.path.join(self.base_dir, f"{tid}.flight"),
             )
             self._tenants[tid] = tenant
             self._queue.append(tid)
             register_tenant_source(tid, tenant)
             tenant.record_event("admitted", queued_ahead=queued_now)
+            self.metrics.histogram(
+                ADMISSION_LATENCY_HISTOGRAM,
+                "admission decision latency for admitted tenants "
+                "(submit entry -> queued), seconds",
+            ).observe(max(self.clock.now() - t_admit, 0.0))
             self._set_occupancy_gauges_locked()
             self._wake.notify_all()
         return tenant
@@ -396,6 +414,7 @@ class RunScheduler:
             "lifecycle": self.lifecycle.stats(),
             "kernel_cache": self.kernel_cache.stats(),
             "stale_reports_discarded": int(self.stale_reports_discarded),
+            "slo": self.slo.snapshot(),
         }
 
     def status(self, tenant_id: str) -> dict | None:
@@ -499,6 +518,8 @@ class RunScheduler:
                 cause, devices=devices,
                 width=tenant.submesh_width, lo=tenant.submesh_lo,
                 **extra)
+            tenant.flight.note(cause, devices=devices,
+                               width=tenant.submesh_width, **extra)
             tenant._device_loss_t0 = t_loss
             # stale-ify the attempt (a thread still computing on "lost"
             # hardware reports into a bumped epoch and is discarded)
@@ -523,6 +544,10 @@ class RunScheduler:
                 "tenants requeued because their sub-mesh lost a device "
                 "(requeue budget untouched)",
             ).inc()
+            # flight file covers detection -> reap -> requeue: the dump
+            # lands after the "requeued" event so the postmortem
+            # timeline shows the full loss window
+            self._dump_flight_locked(tenant, reason=cause)
         self._set_occupancy_gauges_locked()
         self._wake.notify_all()
 
@@ -559,6 +584,10 @@ class RunScheduler:
                 self._maybe_lifecycle_sweep_locked()
                 self._evict_overflow_locked()
                 self._set_occupancy_gauges_locked()
+                # SLO burn-rate sampling rides the pump tick (self-
+                # throttled to its own sample interval); evaluation
+                # reads counters/histograms only, never tenant state
+                self.slo.sample()
                 self._wake.wait(timeout=self.tick_s)
 
     def _drain_reports_locked(self) -> None:
@@ -643,6 +672,8 @@ class RunScheduler:
             if tenant is None or tenant.state != RUNNING:
                 continue
             tenant.record_event("lease_reaped", reason=ev["reason"])
+            tenant.flight.note("lease_reaped", reason=ev["reason"],
+                               epoch=tenant.epoch)
             # stale-ify the attempt: a hung thread waking later reports
             # into a bumped epoch and is discarded; ask it to stop at
             # its next chunk so it cannot keep burning the device
@@ -672,6 +703,10 @@ class RunScheduler:
                     "run leases reaped with the tenant requeued from "
                     "its checkpoint",
                 ).inc()
+                # dump AFTER the requeue decision so the flight file's
+                # timeline covers detection -> reap -> requeue (the
+                # FAILED branches dump through _finish_locked)
+                self._dump_flight_locked(tenant, reason="lease_reaped")
 
     def _start_queued_locked(self) -> None:
         i = 0
@@ -797,6 +832,17 @@ class RunScheduler:
         except ValueError:
             pass
 
+    def _dump_flight_locked(self, tenant: Tenant, reason: str) -> None:
+        """Persist the tenant's flight-recorder ring on a fault path.
+        ``dump`` never raises (a broken postmortem must not break the
+        scheduler); the counter counts files actually written."""
+        path = tenant.flight.dump(reason=reason)
+        if path is not None:
+            self.metrics.counter(
+                FLIGHT_DUMPS_TOTAL,
+                "flight-recorder files persisted on fault paths",
+            ).inc()
+
     def _finish_locked(self, tenant: Tenant, state: str,
                        error: str | None = None) -> None:
         tenant.state = state
@@ -824,6 +870,12 @@ class RunScheduler:
                 "submit to posterior-complete latency of finished "
                 "tenants (seconds)",
             ).observe(tenant.finished_at - tenant.submitted_at)
+        if state in (FAILED, DRAINED):
+            # fault paths persist the black box: the last spans, metric
+            # deltas and events land on disk for the --postmortem view
+            tenant.flight.note("finish", state=state, error=error)
+            self._dump_flight_locked(
+                tenant, reason=f"finish:{state.lower()}")
         self.lifecycle.bytes_on_disk(tenant)
         tenant.quota_remaining = self.lifecycle.quota_remaining(tenant)
         self._evict_terminal_locked(tenant.id)

@@ -27,7 +27,7 @@ from __future__ import annotations
 import threading
 from dataclasses import dataclass, field
 
-from ..observability import MetricsRegistry, Tracer
+from ..observability import FlightRecorder, MetricsRegistry, Tracer
 
 # ------------------------------------------------------------ tenant states
 #: admitted, waiting for a device slot
@@ -305,6 +305,7 @@ class Tenant:
 
     def __init__(self, tenant_id: str, spec: TenantSpec, *, clock,
                  db_path: str, checkpoint_path: str,
+                 flight_path: str | None = None,
                  max_events: int = 512):
         self.id = str(tenant_id)
         self.spec = spec
@@ -378,6 +379,17 @@ class Tenant:
         #: record here)
         self.tracer = Tracer(clock=clock)
         self.metrics = MetricsRegistry(clock=clock)
+        #: the crash-safe black box (ISSUE 19): armed against THIS
+        #: tenant's private namespace, so a fault-path dump carries the
+        #: span tail, metric deltas since admission, and the lifecycle
+        #: event ring — persisted to ``flight_path`` by the scheduler's
+        #: fault hooks and served live via /api/tenant/<id>/flight
+        self.flight_path = (str(flight_path) if flight_path is not None
+                            else None)
+        self.flight = FlightRecorder(
+            self.id, clock=clock, path=self.flight_path)
+        self.flight.arm(tracer=self.tracer, metrics=self.metrics,
+                        events_fn=self.events_snapshot)
 
     # ----------------------------------------------------------- events
     def record_event(self, kind: str, **attrs) -> None:
@@ -392,6 +404,11 @@ class Tenant:
             if len(self._events) > self._max_events:
                 del self._events[: len(self._events) - self._max_events]
             self._event_waiters.notify_all()
+
+    def events_snapshot(self) -> list[dict]:
+        """The whole current event ring (the flight recorder's source)."""
+        with self._lock:
+            return [dict(e) for e in self._events]
 
     def events_since(self, seq: int, timeout_s: float = 0.0) -> list[dict]:
         """Events with ``seq > seq`` (optionally waiting up to
@@ -443,6 +460,8 @@ class Tenant:
             "disposed": bool(self.disposed),
             "db": self.db_path,
             "checkpoint": self.checkpoint_path,
+            "flight": self.flight_path,
+            "flight_dumps": int(self.flight.n_dumps),
             "kernel_cache_hit": self.kernel_cache_hit,
             "error": self.error,
             "health_trail": list(self.health_trail),

@@ -41,8 +41,10 @@ import numpy as np
 
 from ..observability import global_metrics
 from ..observability.metrics import (
+    RETRY_HONESTY_HISTOGRAM,
     TRAFFIC_ARRIVALS_TOTAL,
     TRAFFIC_REJECTIONS_TOTAL,
+    Histogram,
 )
 from ..serving.admission import AdmissionRejectedError
 from ..serving.tenant import TERMINAL_STATES
@@ -50,11 +52,17 @@ from .specs import TrafficClass, draw_class, make_spec, spec_zoo
 
 
 def percentile(samples, q: float) -> float:
-    """Percentile over raw samples (the Histogram keeps only moments,
-    so lane percentiles are computed generator-side from samples)."""
+    """Percentile over raw samples through the SHARED log2-bucket
+    estimator (:meth:`Histogram.quantile`, round 22) — lane numbers and
+    SLO burn numbers now come from one estimator instead of the old
+    numpy interpolation, so a lane p99 and the SLO engine's bucketed
+    p99 can only disagree by bucket resolution, never by method."""
     if not samples:
         return float("nan")
-    return float(np.percentile(np.asarray(samples, np.float64), q))
+    h = Histogram("_lane_percentile_scratch")
+    for s in samples:
+        h.observe(float(s))
+    return h.quantile(q / 100.0)
 
 
 @dataclass
@@ -237,6 +245,16 @@ class TrafficGenerator:
         arrival.admit_latency_s = now - t0
         arrival.admitted_at = now
         arrival.tenant_id = tenant.id
+        if arrival.first_reject_at is not None and arrival.first_hint_s:
+            # Retry-After honesty lands in the shared registry the
+            # moment it is knowable (admission after a 429), so the
+            # retry_honesty SLO burns on live traffic, not on report()
+            self.metrics.histogram(
+                RETRY_HONESTY_HISTOGRAM,
+                "observed wait after a 429 divided by the first "
+                "Retry-After hint (1.0 = perfectly honest)",
+            ).observe((now - arrival.first_reject_at)
+                      / arrival.first_hint_s)
         self._pending[tenant.id] = arrival
 
     def _poll(self) -> None:
@@ -261,6 +279,28 @@ class TrafficGenerator:
                 continue
             del self._pending[tid]
             self._done.append(arrival)
+
+    def assert_slos(self, slo_engine=None, *, allow=()) -> list:
+        """Assert the fleet's declared SLOs are not burning (round 22).
+
+        Forces a sample on ``slo_engine`` (default: the scheduler's own
+        :class:`~pyabc_tpu.observability.SloEngine`) and raises
+        ``AssertionError`` naming every alerting SLO not in ``allow``
+        — the traffic lane's post-drain gate. Returns the (filtered)
+        list of alerting SLO names, empty on success."""
+        engine = (slo_engine if slo_engine is not None
+                  else getattr(self.sched, "slo", None))
+        if engine is None:
+            return []
+        engine.sample(force=True)
+        allowed = {str(a) for a in allow}
+        firing = sorted(
+            s["name"] for s in engine.snapshot()["slos"]
+            if s["alerting"] and s["name"] not in allowed)
+        if firing:
+            raise AssertionError(
+                f"SLOs burning after traffic drain: {firing}")
+        return firing
 
     # ------------------------------------------------------------ results
     def report(self) -> dict:

@@ -213,3 +213,16 @@ TRAFFIC_HONESTY_P90_MAX = 10.0
 #: within-class fairness: max/min accepted-pps across completed tenants
 #: of the SAME traffic class (the serve-lane bound, fleet-sized)
 TRAFFIC_FAIRNESS_MAX_RATIO = 3.0
+#: round 22, the traffic lane's `slo` leg -------------------------------
+#: floor on warm-run attributed wall-clock fraction with the per-tenant
+#: flight recorder ARMED (tracer + metrics + snapshot-on-demand): the
+#: recorder's ring writes ride existing instrumentation, so steady-state
+#: coverage must match the resilience lane's bar — a recorder that
+#: stalls the run would show up here as dark time
+SLO_RECORDER_ATTRIBUTED_FRAC_MIN = 0.9
+#: ceiling on fast-burn alert latency in INJECTED-clock seconds for a
+#: total (100% failure) outage striking a warmed-up (1h of good
+#: traffic) service: the page needs BOTH fast windows past 14.4x, and
+#: the 1h window is the slower gate — it must accumulate a 14.4% bad
+#: fraction, ~0.144 * 3600 ~= 518 s of outage, plus sampling slack
+SLO_ALERT_LATENCY_MAX_S = 600.0

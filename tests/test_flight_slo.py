@@ -1,0 +1,601 @@
+"""Round 22: flight recorder, SLO burn-rate engine, span federation.
+
+Unit coverage for the observability tentpole — everything here runs on
+VirtualClock / localhost sockets, no jax devices:
+
+- crash-safe flight files: CRC-framed write/read round trip, a typed
+  :class:`FlightCorruptError` per corruption mode, bounded rings,
+  metric deltas, dump-never-raises;
+- SLO engine: SLI-shape validation, multi-window burn-rate fire/clear
+  on the injected clock, ``pyabc_tpu_slo_*`` gauge export, histogram-
+  threshold SLI conservatism;
+- Histogram satellites: lock-consistent ``snapshot()``, the shared
+  log2-bucket ``quantile()``, tenant-labelled exposition and the
+  ``+Inf`` cumulative invariant under concurrent observes;
+- federation: sink/shipper round trip over TCP, offset correction via
+  the PR-18 host-clock estimates, cursor dedup, best-effort death, and
+  SyncLedger identity with federation on vs off.
+"""
+import re
+import threading
+import time
+
+import pytest
+
+from pyabc_tpu.observability import (
+    MetricsRegistry,
+    Tracer,
+    VirtualClock,
+    clear_federated_spans,
+    federated_spans_snapshot,
+    fire_span_ship_hooks,
+    ingest_remote_spans,
+    install_span_ship_hook,
+    read_flight,
+    record_host_clock_offset,
+    render_timeline,
+    uninstall_span_ship_hook,
+    write_flight,
+)
+from pyabc_tpu.observability.metrics import Histogram, slo_metric
+from pyabc_tpu.observability.recorder import (
+    FlightCorruptError,
+    FlightRecorder,
+)
+from pyabc_tpu.observability.slo import (
+    FAST_BURN_THRESHOLD,
+    SLO,
+    SloEngine,
+    default_slos,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_federation():
+    clear_federated_spans()
+    yield
+    clear_federated_spans()
+
+
+# ====================================================== flight files
+def test_flight_write_read_round_trip(tmp_path):
+    path = str(tmp_path / "t.flight")
+    payload = {"run_id": "t1", "entries": [{"kind": "x", "ts": 1.0}],
+               "nested": {"a": [1, 2, 3]}}
+    n = write_flight(path, payload)
+    assert n > 0
+    assert read_flight(path) == payload
+
+
+def test_flight_corruption_raises_typed_errors(tmp_path):
+    path = str(tmp_path / "t.flight")
+    write_flight(path, {"run_id": "t1"})
+    good = (tmp_path / "t.flight").read_bytes()
+
+    def corrupt(data, name):
+        p = tmp_path / name
+        p.write_bytes(data)
+        with pytest.raises(FlightCorruptError) as ei:
+            read_flight(str(p))
+        return str(ei.value)
+
+    # each validation step produces its own reason, in order
+    assert "truncated header" in corrupt(good[:8], "short.flight")
+    assert "magic" in corrupt(b"XXXX" + good[4:], "magic.flight")
+    bad_ver = good[:4] + (99).to_bytes(4, "little") + good[8:]
+    assert "version" in corrupt(bad_ver, "ver.flight")
+    assert corrupt(good[:-4], "trunc.flight")  # short payload
+    flipped = good[:-1] + bytes([good[-1] ^ 0xFF])
+    assert "crc" in corrupt(flipped, "crc.flight").lower()
+
+
+def test_recorder_ring_bounds_and_drop_count():
+    clk = VirtualClock()
+    rec = FlightRecorder("t1", clock=clk, max_entries=4)
+    for i in range(10):
+        clk.advance(1.0)
+        rec.note("tick", i=i)
+    snap = rec.snapshot()
+    assert len(snap["entries"]) == 4
+    assert snap["entries_dropped"] == 6
+    assert [e["i"] for e in snap["entries"]] == [6, 7, 8, 9]
+
+
+def test_recorder_metric_deltas_since_arm():
+    clk = VirtualClock()
+    reg = MetricsRegistry(clock=clk)
+    reg.counter("c_total", "x").inc(5)
+    rec = FlightRecorder("t1", clock=clk)
+    rec.arm(metrics=reg)
+    reg.counter("c_total").inc(3)
+    snap = rec.snapshot()
+    assert snap["metrics"]["deltas"]["c_total"] == 3.0
+
+
+def test_recorder_dump_never_raises(tmp_path):
+    clk = VirtualClock()
+    rec = FlightRecorder("t1", clock=clk,
+                         path=str(tmp_path / "no" / "such" / "dir" / "f"))
+    rec.note("x")
+    assert rec.dump() is None  # unwritable path: logged, not raised
+    assert rec.n_dumps == 0
+    ok = rec.dump(path=str(tmp_path / "ok.flight"))
+    assert ok is not None and rec.n_dumps == 1
+    assert read_flight(ok)["run_id"] == "t1"
+
+
+def test_recorder_snapshot_spans_and_timeline():
+    clk = VirtualClock()
+    tracer = Tracer(clock=clk)
+    rec = FlightRecorder("t1", clock=clk)
+    rec.arm(tracer=tracer)
+    with tracer.span("work", gen=3):
+        clk.advance(0.5)
+    rec.note("fault", reason="test")
+    snap = rec.snapshot(reason="unit")
+    assert snap["reason"] == "unit"
+    assert [s["name"] for s in snap["spans"]] == ["work"]
+    text = render_timeline(snap)
+    assert "work" in text and "fault" in text and "t1" in text
+
+
+def test_timeline_merges_federated_spans_without_duplicates():
+    clk = VirtualClock()
+    clk.advance(100.0)
+    tracer = Tracer(clock=clk)
+    record_host_clock_offset("hostB", {"offset_s": 0.5,
+                                       "uncertainty_s": 0.001})
+    ingest_remote_spans("hostB", 1, [
+        {"name": "remote_work", "start": 100.5, "end": 101.5,
+         "thread": "MainThread", "attrs": {}}], tracer=tracer)
+    rec = FlightRecorder("t1", clock=clk)
+    rec.arm(tracer=tracer)
+    snap = rec.snapshot()
+    # the federated span rides ONLY the federated block — the tracer
+    # mirror (thread host:1) is filtered from the local tail
+    assert snap["spans"] == []
+    assert len(snap["federated_spans"]) == 1
+    fed = snap["federated_spans"][0]
+    assert fed["thread"] == "host:1"
+    assert fed["start"] == pytest.approx(100.0)  # offset-corrected
+    text = render_timeline(snap)
+    assert text.count("remote_work") == 1
+    assert "hostB" in text  # host-clock table row
+
+
+# ========================================================== SLO engine
+def test_slo_validation_rejects_bad_shapes():
+    with pytest.raises(ValueError):
+        SLO(name="x", objective=1.5, good_counter="g", total_counter="t")
+    with pytest.raises(ValueError):
+        SLO(name="x", objective=0.9)  # no SLI shape
+    with pytest.raises(ValueError):
+        SLO(name="x", objective=0.9, histogram="h")  # no threshold
+    with pytest.raises(ValueError):
+        SLO(name="x", objective=0.9, good_counter="g",
+            total_counter="t", bad_counter="b")  # two ratio shapes
+    slo = SLO(name="x", objective=0.99, good_counter="g", bad_counter="b")
+    assert slo.budget == pytest.approx(0.01)
+
+
+def test_default_slos_cover_the_fleet_objectives():
+    names = {s.name for s in default_slos()}
+    assert names == {"admission_latency", "admission_availability",
+                     "availability", "time_to_posterior", "retry_honesty"}
+
+
+def _ratio_engine(clk, objective=0.99):
+    reg = MetricsRegistry(clock=clk)
+    slo = SLO(name="avail", objective=objective,
+              good_counter="good_total", bad_counter="bad_total")
+    eng = SloEngine(reg, slos=[slo], clock=clk,
+                    sample_interval_s=10.0, register=False)
+    return reg, eng
+
+
+def test_burn_rate_alert_fires_under_overload_and_clears_on_drain():
+    clk = VirtualClock()
+    clk.advance(1.0)
+    reg, eng = _ratio_engine(clk)
+    good = reg.counter("good_total", "g")
+    bad = reg.counter("bad_total", "b")
+    eng.sample(force=True)  # baseline
+    assert not eng.alerting("avail")
+
+    # overload: 100% failures for 10 minutes — burns the 1% budget at
+    # 100x on BOTH fast windows, far past the 14.4x page threshold
+    for _ in range(60):
+        clk.advance(10.0)
+        bad.inc(5)
+        eng.sample()
+    ev = eng.evaluate("avail")
+    assert ev["burn_fast"] > FAST_BURN_THRESHOLD
+    assert ev["alerting_fast"] and ev["alerting"]
+    assert eng.alerting("avail") and eng.alerting()
+
+    # drain: goods only until both fast windows (5m, 1h) roll past the
+    # bad stretch — the PAGE clears (the slow-ticket pair may keep
+    # burning: that budget was genuinely spent)
+    for _ in range(400):
+        clk.advance(10.0)
+        good.inc(5)
+        eng.sample()
+    ev = eng.evaluate("avail")
+    assert not ev["alerting_fast"], ev
+    # ... and once the slow 6h/3d windows roll past the outage too,
+    # the SLO is fully quiet again
+    for _ in range(320):
+        clk.advance(900.0)
+        good.inc(5)
+        eng.sample(force=True)
+    assert not eng.alerting("avail")
+
+
+def test_transient_spike_on_short_window_alone_does_not_page():
+    clk = VirtualClock()
+    clk.advance(1.0)
+    reg, eng = _ratio_engine(clk)
+    good = reg.counter("good_total", "g")
+    bad = reg.counter("bad_total", "b")
+    # a long healthy stretch fills the 1h window with goods
+    for _ in range(360):
+        clk.advance(10.0)
+        good.inc(100)
+        eng.sample()
+    # then one bad one-minute blip: the 5m window burns hot, the 1h
+    # window does not — the multi-window rule holds the page
+    for _ in range(6):
+        clk.advance(10.0)
+        bad.inc(300)
+        eng.sample()
+    ev = eng.evaluate("avail")
+    assert ev["burn"]["300s"] > FAST_BURN_THRESHOLD
+    assert ev["burn_fast"] <= FAST_BURN_THRESHOLD
+    assert not ev["alerting"]
+
+
+def test_slo_gauges_exported_on_sample():
+    clk = VirtualClock()
+    clk.advance(1.0)
+    reg, eng = _ratio_engine(clk)
+    reg.counter("bad_total", "b").inc(10)
+    eng.sample(force=True)
+    clk.advance(10.0)
+    reg.counter("bad_total").inc(10)
+    eng.sample(force=True)
+    snap = reg.snapshot()
+    assert slo_metric("avail", "burn_fast") in snap
+    assert snap[slo_metric("avail", "alerting")] == 1.0
+    assert snap[slo_metric("avail", "bad_fraction")] == 1.0
+
+
+def test_histogram_threshold_sli_is_conservative():
+    clk = VirtualClock()
+    reg = MetricsRegistry(clock=clk)
+    h = reg.histogram("lat_seconds", "x")
+    for _ in range(8):
+        h.observe(0.001)  # well under threshold
+    h.observe(50.0)       # well over
+    slo = SLO(name="lat", objective=0.5, histogram="lat_seconds",
+              threshold=1.0)
+    eng = SloEngine(reg, slos=[slo], clock=clk, register=False)
+    good, total = eng._measure(slo)
+    assert total == 9.0
+    # conservative: good counts only buckets whose UPPER edge is at or
+    # under the threshold, so 8 <= good < 9 and the straddler is bad
+    assert 8.0 <= good < 9.0
+
+
+def test_slo_sample_throttles_on_interval():
+    clk = VirtualClock()
+    clk.advance(1.0)
+    _, eng = _ratio_engine(clk)
+    assert eng.sample() is True
+    assert eng.sample() is False       # same instant: throttled
+    clk.advance(5.0)
+    assert eng.sample() is False       # < interval
+    assert eng.sample(force=True) is True
+    clk.advance(10.0)
+    assert eng.sample() is True
+
+
+# ================================================ Histogram satellites
+def test_histogram_snapshot_is_self_consistent():
+    h = Histogram("h", "x")
+    for v in (0.001, 0.02, 0.3, 4.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert sum(snap["buckets"]) == snap["count"] == 4
+    assert snap["min"] == 0.001 and snap["max"] == 4.0
+    assert snap["sum"] == pytest.approx(4.321)
+
+
+def test_histogram_quantile_semantics():
+    h = Histogram("h", "x")
+    assert h.quantile(0.5) != h.quantile(0.5)  # NaN when empty
+    for _ in range(90):
+        h.observe(0.001)
+    for _ in range(10):
+        h.observe(5.0)
+    # p50 lands in the fast bucket (upper edge capped at observed max);
+    # p99 lands in the slow bucket
+    assert h.quantile(0.5) < 0.01
+    assert 4.0 < h.quantile(0.99) <= 8.2
+    assert h.quantile(1.0) <= h.max
+    # overflow values resolve to the observed max, not an edge
+    h2 = Histogram("h2", "x")
+    h2.observe(1e12)
+    assert h2.quantile(0.9) == 1e12
+
+
+def test_histogram_summary_has_shared_percentiles():
+    h = Histogram("h", "x")
+    s = h.summary()
+    assert s["p50"] is None and s["p99"] is None
+    for _ in range(100):
+        h.observe(0.01)
+    s = h.summary()
+    assert s["p50"] == pytest.approx(h.quantile(0.5))
+    assert s["p90"] == pytest.approx(h.quantile(0.9))
+    assert s["p99"] == pytest.approx(h.quantile(0.99))
+
+
+def _parse_prom_hist(text, name, label=None):
+    """{le_value: cumulative_count} + count/sum for one exposition."""
+    buckets, count = {}, None
+    for line in text.splitlines():
+        if line.startswith(f"{name}_bucket"):
+            if label is not None and label not in line:
+                continue
+            le = re.search(r'le="([^"]+)"', line).group(1)
+            buckets[le] = float(line.rsplit(" ", 1)[1])
+        elif line.startswith(f"{name}_count"):
+            count = float(line.rsplit(" ", 1)[1])
+    return buckets, count
+
+
+def test_prometheus_text_tenant_labelled_histogram():
+    from pyabc_tpu.observability.export import prometheus_text
+
+    reg = MetricsRegistry()
+    h = reg.histogram("work_seconds", "x")
+    for v in (0.001, 0.01, 99.0):
+        h.observe(v)
+    text = prometheus_text(reg, labels={"tenant": "t-9"})
+    assert 'tenant="t-9"' in text
+    buckets, count = _parse_prom_hist(text, "work_seconds",
+                                      label='tenant="t-9"')
+    assert count == 3.0 and buckets["+Inf"] == 3.0
+    # cumulative: monotone nondecreasing in le order, +Inf == count
+    ordered = [buckets[k] for k in buckets if k != "+Inf"]
+    assert ordered == sorted(ordered)
+    # every bucket line carries BOTH labels
+    for line in text.splitlines():
+        if line.startswith("work_seconds_bucket"):
+            assert 'le="' in line and 'tenant="t-9"' in line
+
+
+def test_prometheus_histogram_inf_invariant_under_concurrent_observes():
+    """The satellite-1 fix: exposition reads one locked snapshot, so
+    within a single scrape +Inf ALWAYS equals _count even while other
+    threads observe concurrently (the old unlocked read could catch
+    the buckets and the count mid-update)."""
+    from pyabc_tpu.observability.export import prometheus_text
+
+    reg = MetricsRegistry()
+    h = reg.histogram("busy_seconds", "x")
+    stop = threading.Event()
+
+    def hammer():
+        i = 0
+        while not stop.is_set():
+            h.observe(0.001 * (1 + i % 7))
+            i += 1
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(200):
+            buckets, count = _parse_prom_hist(
+                prometheus_text(reg), "busy_seconds")
+            assert buckets["+Inf"] == count
+            assert sum(b for k, b in buckets.items()
+                       if k == "+Inf") == count
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+
+
+# ========================================================== federation
+def test_ingest_remote_spans_offset_correction():
+    tracer = Tracer(clock=VirtualClock())
+    record_host_clock_offset("fed-h1", {"offset_s": 2.0,
+                                        "uncertainty_s": 0.01})
+    n = ingest_remote_spans("fed-h1", 3, [
+        {"name": "gen", "start": 12.0, "end": 13.0,
+         "thread": "MainThread", "attrs": {"g": 1}}], tracer=tracer)
+    assert n == 1
+    [sp] = federated_spans_snapshot()
+    assert sp["thread"] == "host:3"
+    assert sp["start"] == pytest.approx(10.0)  # local = remote - offset
+    assert sp["end"] == pytest.approx(11.0)
+    assert sp["attrs"]["origin_host"] == "fed-h1"
+    assert sp["attrs"]["origin_thread"] == "MainThread"
+    # mirrored onto the local tracer under the host pseudo-thread
+    assert [s.thread for s in tracer.spans()] == ["host:3"]
+
+
+def test_ingest_without_clock_estimate_is_flagged_uncorrected():
+    n = ingest_remote_spans("never-measured-host", 7, [
+        {"name": "gen", "start": 5.0, "end": 6.0, "attrs": {}}])
+    assert n == 1
+    [sp] = federated_spans_snapshot()
+    assert sp["start"] == 5.0  # passed through untouched
+    assert sp["attrs"]["offset_corrected"] is False
+
+
+def test_span_sink_and_shipper_round_trip():
+    from pyabc_tpu.parallel.distributed import SpanShipper, serve_span_sink
+
+    clk = VirtualClock()
+    local = Tracer(clock=clk)   # primary-side merge target
+    remote = Tracer(clock=clk)  # the "other host"'s tracer
+    batches = []
+    port, stop = serve_span_sink(tracer=local,
+                                 on_batch=lambda b: batches.append(b))
+    try:
+        with remote.span("remote_gen", gen=1):
+            clk.advance(1.0)
+        with remote.span("remote_gen", gen=2):
+            clk.advance(1.0)
+        shipper = SpanShipper(f"127.0.0.1:{port}", host="hB",
+                              process_id=1, tracer=remote)
+        assert shipper.ship() == 2
+        assert shipper.ship() == 0  # cursor: nothing new, no resend
+        with remote.span("remote_gen", gen=3):
+            clk.advance(1.0)
+        assert shipper.ship() == 1
+        shipper.close()
+        # ship() returns at socket-write time; ingestion happens on the
+        # sink's reader thread — wait for it to drain before asserting
+        deadline = time.monotonic() + 10.0
+        while len(batches) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+    finally:
+        stop()
+    assert len(batches) == 2
+    fed = federated_spans_snapshot()
+    assert len(fed) == 3 and all(s["thread"] == "host:1" for s in fed)
+    assert sorted(s["attrs"]["gen"] for s in fed) == [1, 2, 3]
+    # merged into the primary's tracer for the flight recorder
+    assert len([s for s in local.spans() if s.thread == "host:1"]) == 3
+
+
+def test_shipper_skips_already_federated_spans():
+    """A primary that is ALSO a shipper (mid-tier fan-in) must not
+    re-ship spans it ingested from other hosts — host:* threads are
+    excluded from the cursor scan."""
+    from pyabc_tpu.parallel.distributed import SpanShipper, serve_span_sink
+
+    clk = VirtualClock()
+    mid = Tracer(clock=clk)
+    ingest_remote_spans("leaf", 5, [
+        {"name": "leaf_gen", "start": 1.0, "end": 2.0, "attrs": {}}],
+        tracer=mid)
+    sink_tr = Tracer(clock=clk)
+    port, stop = serve_span_sink(tracer=sink_tr)
+    try:
+        shipper = SpanShipper(f"127.0.0.1:{port}", host="mid",
+                              process_id=1, tracer=mid)
+        assert shipper.ship() == 0  # the host:5 mirror is not re-shipped
+        shipper.close()
+    finally:
+        stop()
+
+
+def test_shipper_is_best_effort_after_sink_death():
+    from pyabc_tpu.parallel.distributed import SpanShipper, serve_span_sink
+
+    clk = VirtualClock()
+    remote = Tracer(clock=clk)
+    port, stop = serve_span_sink()
+    stop()  # sink is gone before the first ship
+    shipper = SpanShipper(f"127.0.0.1:{port}", host="hB", process_id=1,
+                          tracer=remote)
+    with remote.span("gen"):
+        clk.advance(1.0)
+    assert shipper.ship() == 0  # no raise: telemetry never kills a run
+    assert shipper.ship() == 0
+    shipper.close()
+
+
+def test_ship_hooks_fire_and_self_heal():
+    calls = []
+
+    def good_hook():
+        calls.append("good")
+
+    def bad_hook():
+        calls.append("bad")
+        raise OSError("sink died")
+
+    install_span_ship_hook(good_hook)
+    install_span_ship_hook(bad_hook)
+    try:
+        fire_span_ship_hooks()
+        fire_span_ship_hooks()
+        # the raising hook uninstalled itself after the first firing
+        assert calls == ["good", "bad", "good"]
+    finally:
+        uninstall_span_ship_hook(good_hook)
+        uninstall_span_ship_hook(bad_hook)
+
+
+def test_federation_adds_zero_blocking_syncs():
+    """THE federation contract: a fused run with a SpanShipper firing
+    on every chunk books a SyncLedger IDENTICAL to the same run with
+    federation off — shipping is pure host-side TCP."""
+    import jax
+
+    import pyabc_tpu as pt
+    from pyabc_tpu.parallel.distributed import SpanShipper, serve_span_sink
+
+    def run_once(with_federation):
+        @pt.JaxModel.from_function(["theta"], name="gauss")
+        def model(key, theta):
+            return {"x": theta[0] + 0.5 * jax.random.normal(key)}
+
+        prior = pt.Distribution(theta=pt.RV("norm", 0.0, 1.0))
+        abc = pt.ABCSMC(model, prior, pt.PNormDistance(p=2),
+                        population_size=64, eps=pt.MedianEpsilon(),
+                        seed=11, fused_generations=2)
+        abc.new("sqlite://", {"x": 1.0}, store_sum_stats=False)
+        shipper = stop = None
+        if with_federation:
+            port, stop = serve_span_sink()
+            side = Tracer()  # spans to ship on every chunk hook firing
+            with side.span("pre_run"):
+                pass
+            shipper = SpanShipper(f"127.0.0.1:{port}", host="self",
+                                  process_id=0, tracer=side)
+            shipper.install()
+        try:
+            abc.run(max_nr_populations=4)
+        finally:
+            if shipper is not None:
+                shipper.close()
+            if stop is not None:
+                stop()
+        return dict(abc.sync_ledger.by_kind()), abc.sync_ledger.count
+
+    kinds_off, count_off = run_once(False)
+    kinds_on, count_on = run_once(True)
+    assert kinds_on == kinds_off
+    assert count_on == count_off
+
+
+# ================================================================ CLI
+def test_manager_postmortem_renders_flight_file(tmp_path):
+    from click.testing import CliRunner
+
+    from pyabc_tpu.cli import manager_cmd
+
+    clk = VirtualClock()
+    rec = FlightRecorder("t-pm", clock=clk)
+    rec.note("fault", reason="unit")
+    path = rec.dump(path=str(tmp_path / "t.flight"))
+    res = CliRunner().invoke(manager_cmd, ["--postmortem", path])
+    assert res.exit_code == 0, res.output
+    assert "t-pm" in res.output and "fault" in res.output
+
+
+def test_manager_requires_host_port_without_postmortem():
+    from click.testing import CliRunner
+
+    from pyabc_tpu.cli import manager_cmd
+
+    res = CliRunner().invoke(manager_cmd, [])
+    assert res.exit_code != 0
+    assert "HOST and PORT" in res.output

@@ -610,3 +610,151 @@ class TestClockOffset:
             stop()
         assert a.summary()["n_samples"] == 4
         assert b.summary()["n_samples"] == 4
+
+
+# ================================================= round 22: federation
+WORKER_FLIGHT = """
+import os
+import sys
+import time
+
+pid = int(sys.argv[1])
+port = sys.argv[2]
+sink_port = int(sys.argv[3])
+clock_port = int(sys.argv[4])
+workdir = sys.argv[5]
+
+from pyabc_tpu.parallel import distributed as dist
+
+dist.initialize(f"127.0.0.1:{port}", num_processes=2, process_id=pid,
+                platform="cpu", num_cpu_devices=4)
+from pyabc_tpu import observability as obs
+from pyabc_tpu.observability import Tracer, read_flight, render_timeline
+from pyabc_tpu.parallel.distributed import (
+    SpanShipper, measure_clock_offset, serve_clock, serve_span_sink)
+
+done_file = os.path.join(workdir, "primary_done")
+
+if pid == 1:
+    # the non-primary host: serve our clock for the primary's offset
+    # probe, then emit heartbeat spans and ship them on a steady
+    # cadence for the primary's whole chaos run
+    _, cstop = serve_clock(clock_port)
+    tracer = Tracer()
+    shipper = SpanShipper(f"127.0.0.1:{sink_port}", host="h1",
+                          process_id=1, tracer=tracer)
+    dist.barrier("flight_rig_up")  # the primary's span sink is open
+    n = 0
+    while not os.path.exists(done_file) and n < 6000:
+        with tracer.span("host1_heartbeat", seq=n):
+            time.sleep(0.05)
+        shipper.ship()
+        n += 1
+    shipper.ship()
+    shipper.close()
+    cstop()
+    assert shipper.n_shipped >= n, (shipper.n_shipped, n)
+    print(f"RESULT pid=1 spans={n} shipped={shipper.n_shipped}")
+else:
+    from pyabc_tpu.serving import COMPLETED, RunScheduler, TenantSpec
+
+    _, sstop = serve_span_sink(sink_port)
+    dist.barrier("flight_rig_up")
+    measure_clock_offset(f"127.0.0.1:{clock_port}", host="h1")
+    assert "h1" in obs.host_clocks_snapshot()
+
+    # a LONG lease: this 1-core box runs two interpreters, the sink and
+    # pytest — a compile-bearing chunk can silently exceed the default
+    # lease window, and a lease reap here would overwrite the host_lost
+    # flight dump this test exists to assert
+    sched = RunScheduler(n_devices=2, n_hosts=2, lease_timeout_s=600.0,
+                         base_dir=os.path.join(workdir, "serve"))
+    spec = TenantSpec(model="gaussian", population_size=2000,
+                      generations=8, seed=91, fused_generations=2)
+    t = sched.submit(spec, tenant_id="t-victim")
+    # grab the placement WHILE the run holds it: a terminal tenant has
+    # released its sub-mesh (submesh_lo is None again), so the loss
+    # must be injected mid-flight
+    t0 = time.monotonic()
+    lo = None
+    while time.monotonic() - t0 < 240:
+        if lo is None and t.submesh_lo is not None:
+            lo = t.submesh_lo
+        if lo is not None and t.generations_done >= 1:
+            break
+        time.sleep(0.02)
+    assert lo is not None and t.generations_done >= 1, (t.state, t.error)
+    victim_host = lo // sched.allocator.devices_per_host
+    affected = sched.mark_host_lost(victim_host)
+    assert t.id in affected, (affected, victim_host)
+    t0 = time.monotonic()
+    while t.state != COMPLETED and time.monotonic() - t0 < 240:
+        time.sleep(0.1)
+    assert t.state == COMPLETED, (t.state, t.error)
+    assert t.device_loss_requeues == 1 and t.requeues == 0, (
+        t.device_loss_requeues, t.requeues)
+    time.sleep(1.0)  # let the heartbeat tail land in the sink
+
+    # THE fault-path artifact: host loss left a parseable flight file
+    payload = read_flight(t.flight_path)
+    assert payload["run_id"] == "t-victim"
+    assert payload["reason"] == "host_lost", payload["reason"]
+    ev_kinds = [e["kind"] for e in payload["events"]]
+    assert "host_lost" in ev_kinds and "requeued" in ev_kinds
+    assert any(e["kind"] == "host_lost" for e in payload["entries"])
+    assert payload["hosts"]["h1"]["offset_s"] is not None
+    fed = payload["federated_spans"]
+    assert fed, "no federated spans in the fault dump"
+    assert all(s["thread"] == "host:1" for s in fed)
+    assert all("offset_corrected" not in s["attrs"] for s in fed)
+    loc = payload["spans"]
+    assert loc, "no local spans in the fault dump"
+    assert not any(str(s["thread"]).startswith("host:") for s in loc)
+
+    # merged, offset-corrected coverage: host-1 spans bracket the
+    # detection -> reap -> requeue window on the PRIMARY's clock. The
+    # federated block is a bounded TAIL, so by completion the
+    # pre-detection spans have rolled out of a fresh snapshot — the
+    # bracketing uses the DUMP (written at the requeue instant, so its
+    # tail reaches back past the detection) for the front edge and a
+    # post-completion snapshot for the back edge.
+    detect_ts = next(e["ts"] for e in payload["events"]
+                     if e["kind"] == "host_lost")
+    requeue_ts = next(e["ts"] for e in payload["events"]
+                      if e["kind"] == "requeued")
+    assert detect_ts <= requeue_ts
+    assert min(s["start"] for s in fed) <= detect_ts, (
+        min(s["start"] for s in fed), detect_ts)
+    snap = t.flight.snapshot(reason="postmortem")
+    fed2 = snap["federated_spans"]
+    assert fed2 and max(s["end"] for s in fed2) >= requeue_ts, (
+        len(fed2), requeue_ts)
+    text = render_timeline(payload)
+    assert "host:1" in text and "host_lost" in text and "h1" in text
+
+    with open(done_file, "w") as f:
+        f.write("done")
+    sched.shutdown()
+    sstop()
+    print(f"RESULT pid=0 state={t.state} "
+          f"requeues={t.device_loss_requeues} fed={len(fed)} flight=ok")
+"""
+
+
+@pytest.mark.slow
+def test_host_lost_flight_file_federates_both_hosts(tmp_path):
+    """Round 22 acceptance: an injected ``host_lost`` on the 2-process
+    gloo rig leaves a parseable flight file on the PRIMARY whose
+    merged, offset-corrected timeline holds spans from BOTH hosts
+    covering detection -> reap -> requeue. Process 1 streams heartbeat
+    spans through the federation sink the whole time; process 0 runs
+    the scheduler chaos and asserts the artifact end-to-end."""
+    sink_port, clock_port = _free_port(), _free_port()
+    results = _spawn_workers(
+        WORKER_FLIGHT, tmp_path,
+        extra_args=(sink_port, clock_port, str(tmp_path)),
+        timeout=540)
+    assert _field(results[0], "state") == "completed"
+    assert _field(results[0], "flight") == "ok"
+    assert int(_field(results[0], "fed")) >= 1
+    assert int(_field(results[1], "shipped")) >= 1

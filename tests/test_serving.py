@@ -181,6 +181,22 @@ def test_chaos_isolation_killed_tenant_never_harms_survivors(
     kinds = [e["kind"] for e in chaos.events_since(0)]
     assert "lease_reaped" in kinds and "requeued" in kinds
 
+    # round 22: the failure left a parseable flight file whose timeline
+    # covers the whole fault window — detection (lease_reaped) through
+    # requeue to the terminal failure
+    from pyabc_tpu.observability import read_flight, render_timeline
+
+    payload = read_flight(chaos.flight_path)
+    assert payload["run_id"] == chaos.id
+    assert payload["reason"].startswith("finish:")
+    ev_kinds = [e["kind"] for e in payload["events"]]
+    assert "lease_reaped" in ev_kinds and "requeued" in ev_kinds
+    assert FAILED in ev_kinds
+    note_kinds = [e["kind"] for e in payload["entries"]]
+    assert "lease_reaped" in note_kinds and "finish" in note_kinds
+    text = render_timeline(payload)
+    assert "lease_reaped" in text and "requeued" in text
+
     # posterior parity vs seed-matched solo runs — bit-identical
     ref1 = f"sqlite:///{tmp_path}/ref1.db"
     ref2 = f"sqlite:///{tmp_path}/ref2.db"
@@ -724,11 +740,21 @@ def test_api_submit_status_stream_metrics(make_scheduler):
         assert snap["n_slots"] == 1
         assert any(t["id"] == tid for t in snap["tenants"])
 
-        # observability endpoint aggregates the tenant namespace
+        # observability endpoint aggregates the tenant namespace —
+        # and (round 22) carries the registered SLO engines' block
         with urllib.request.urlopen(f"{base}/api/observability",
                                     timeout=30) as r:
             obs = json.loads(r.read())
         assert tid in obs["tenants"]
+        assert "slo" in obs and "federation" in obs
+
+        # on-demand flight snapshot (round 22): the live rings, no
+        # fault needed
+        with urllib.request.urlopen(f"{base}/api/tenant/{tid}/flight",
+                                    timeout=30) as r:
+            flight = json.loads(r.read())
+        assert flight["run_id"] == tid and flight["reason"] == "api"
+        assert any(e["kind"] == "admitted" for e in flight["events"])
 
         # /metrics: global families + tenant-labelled series
         with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:

@@ -838,14 +838,119 @@ def test_dist001_mutation_process_probe_in_smc_fails():
         "no longer guarded")
 
 
-def test_registry_has_eleven_rules_with_place001_and_dist001():
+# --------------------------------------------------------------- REC001
+
+REC_FIRES_OBS = """
+import json, os
+def leak_metrics(registry, path):
+    with open(path, "w") as f:
+        json.dump(registry.snapshot(), f)
+    os.replace(path + ".tmp", path)
+"""
+
+REC_FIRES_WRITE_FLIGHT = """
+from ..observability.recorder import write_flight
+def hand_rolled_dump(payload, path):
+    write_flight(path, payload)
+"""
+
+REC_CLEAN = """
+def on_fault(tenant):
+    # persistence goes through the recorder's own crash-safe path
+    tenant.flight.note("fault", reason="lease_reaped")
+    return tenant.flight.dump(reason="lease_reaped")
+"""
+
+REC_SUPPRESSED = """
+def debug_spill(payload, path):
+    # abc-lint: disable=REC001 throwaway debug spill, not a flight file
+    with open(path, "w") as f:
+        f.write(repr(payload))
+"""
+
+
+def test_rec001_fires_on_fs_writes_inside_observability():
+    from pyabc_tpu.analysis.rules.recorder_rule import Rec001
+
+    open_, _ = check(Rec001(), REC_FIRES_OBS,
+                     "pyabc_tpu/observability/metrics.py")
+    assert len(open_) == 2, [f.to_dict() for f in open_]
+    msgs = " ".join(f.message for f in open_)
+    assert "open" in msgs and "os.replace" in msgs
+
+
+def test_rec001_fires_on_write_flight_outside_recorder():
+    from pyabc_tpu.analysis.rules.recorder_rule import Rec001
+
+    open_, _ = check(Rec001(), REC_FIRES_WRITE_FLIGHT,
+                     "pyabc_tpu/serving/scheduler.py")
+    assert len(open_) == 1, [f.to_dict() for f in open_]
+    assert "FlightRecorder.dump()" in open_[0].message
+
+
+def test_rec001_scope_is_two_sanctioned_modules():
+    from pyabc_tpu.analysis.rules.recorder_rule import Rec001
+
+    r = Rec001()
+    # the two sanctioned persistence modules are exempt; the rest of
+    # the observability package (and the wider tree) is in
+    assert not r.applies_to("pyabc_tpu/observability/recorder.py")
+    assert not r.applies_to("pyabc_tpu/observability/export.py")
+    assert r.applies_to("pyabc_tpu/observability/metrics.py")
+    assert r.applies_to("pyabc_tpu/observability/slo.py")
+    assert r.applies_to("pyabc_tpu/serving/scheduler.py")
+    assert not r.applies_to("bench.py")
+    assert not r.applies_to("tests/test_observability.py")
+    # open()/os.replace OUTSIDE observability/ stays legal (checkpoints,
+    # History dbs): only the write_flight bypass fires tree-wide
+    open_, _ = check(r, REC_FIRES_OBS, "pyabc_tpu/serving/lifecycle.py")
+    assert open_ == [], [f.to_dict() for f in open_]
+    open_, _ = check(r, REC_CLEAN, "pyabc_tpu/serving/scheduler.py")
+    assert open_ == [], [f.to_dict() for f in open_]
+
+
+def test_rec001_suppression_with_reason():
+    from pyabc_tpu.analysis.rules.recorder_rule import Rec001
+
+    open_, sup = check(Rec001(), REC_SUPPRESSED,
+                       "pyabc_tpu/observability/metrics.py")
+    assert open_ == [] and len(sup) == 1 and sup[0].reason
+
+
+def test_rec001_mutation_file_write_in_slo_fails():
+    """THE mutation guard: a file write growing into the SLO engine —
+    telemetry persisted outside the recorder's crash-safe path — must
+    make REC001 fire; today's slo.py is clean (it only reads
+    instruments and exports gauges)."""
+    from pyabc_tpu.analysis.rules.recorder_rule import Rec001
+
+    path = REPO / "pyabc_tpu" / "observability" / "slo.py"
+    src = path.read_text()
+    rel = "pyabc_tpu/observability/slo.py"
+    open_, _ = check(Rec001(), src, rel)
+    assert open_ == [], [f.to_dict() for f in open_]
+    mutated = src + (
+        "\n\ndef _spill_alert_log(snapshot, path):\n"
+        "    import json\n"
+        "    with open(path, 'a') as f:\n"
+        "        f.write(json.dumps(snapshot))\n"
+    )
+    open_m, _ = check(Rec001(), mutated, rel)
+    assert len(open_m) >= 1, (
+        "a file write re-added to observability/slo.py left REC001 "
+        "silent — the telemetry-persistence confinement contract is "
+        "no longer guarded")
+
+
+def test_registry_has_twelve_rules_with_dist001_and_rec001():
     from pyabc_tpu.analysis.rules import rule_ids
 
     ids = rule_ids()
-    assert len(ids) == 11
+    assert len(ids) == 12
     assert "ISO001" in ids
     assert "PLACE001" in ids
     assert "DIST001" in ids
+    assert "REC001" in ids
 
 
 # ------------------------------------------------------- the tier-1 gate

@@ -108,7 +108,17 @@ def test_burst_schedule_shape():
 
 def test_percentile_of_empty_is_nan():
     assert np.isnan(percentile([], 99))
-    assert percentile([1.0, 2.0, 3.0], 50) == 2.0
+    # round 22: percentile() rides the shared Histogram.quantile log2-
+    # bucket estimator (bucket upper edge capped at the observed max) —
+    # a CONSERVATIVE estimate, never below the true percentile and
+    # never above the largest sample
+    p50 = percentile([1.0, 2.0, 3.0], 50)
+    assert 2.0 <= p50 <= 3.0
+    # a clear bucket separation resolves exactly: 99 fast samples, one
+    # slow outlier — p50 must not be dragged to the outlier
+    p50 = percentile([0.5] * 99 + [40.0], 50)
+    assert 0.5 <= p50 < 1.1
+    assert percentile([0.5] * 99 + [40.0], 100) == 40.0
 
 
 # ==================================================== generator (model)
