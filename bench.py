@@ -1193,6 +1193,65 @@ def run_scenario_lane(budget_s: float, platform: str = "cpu") -> dict:
                 msel.observed_ode_family(seed=0, segments=4),
                 192 if cpu else 8192, 3)
 
+    # learned-sumstat leg (ISSUE 20): the high-dim network SIR
+    # (n_patches=8 -> S=128 raw stats/particle) with identity vs
+    # LINEAR learned summaries under the device-fit plan — the packed
+    # fetch ships transformed C'=2 rows instead of raw S-dim rows, so
+    # the contract is fetch-bytes/particle reduction (target >= 2x at
+    # n_patches >= 8) plus the accepted-pps delta at matched budget
+    if CLOCK.now() - t_lane0 < budget_s * 0.96:
+        try:
+            # pop must exceed need = S + 2 = 130 or the gen-0 seed fit
+            # never fires and the plan silently degrades to host mode;
+            # alpha=1.0 keeps the float32 normal equations conditioned
+            # at S=128 (1e-6 jitter is below f32 noise on a ~n-scaled
+            # Gram and NaNs the solve)
+            ss_pop, ss_gens, n_patches, n_obs = 256, 5, 8, 16
+            obs_sir = sir_mod.observed_network_sir(
+                n_patches=n_patches, n_obs=n_obs)
+            legs = {}
+            for leg in ("identity", "linear"):
+                dist = pt.PNormDistance(p=2) if leg == "identity" else \
+                    pt.PNormDistance(p=2, sumstat=pt.PredictorSumstat(
+                        pt.LinearPredictor(alpha=1.0)))
+                abc_s = pt.ABCSMC(
+                    sir_mod.make_network_sir_model(
+                        n_patches=n_patches, n_obs=n_obs),
+                    sir_mod.network_sir_prior(), dist,
+                    population_size=ss_pop, eps=pt.MedianEpsilon(),
+                    seed=11, fused_generations=2, tracer=TRACER,
+                )
+                abc_s.new("sqlite://", obs_sir)
+                t0 = CLOCK.now()
+                h_s = abc_s.run(max_nr_populations=ss_gens)
+                wall = CLOCK.now() - t0
+                summ = abc_s.sync_ledger.summary()
+                fetch_b = summ["bytes_by_kind"].get("chunk_fetch", 0)
+                n_acc = (h_s.max_t + 1) * ss_pop
+                legs[leg] = {
+                    "pps": round(n_acc / max(wall, 1e-9), 1),
+                    "fetch_bytes_per_particle": round(
+                        fetch_b / max(n_acc, 1), 1),
+                    "syncs": int(summ["syncs"]),
+                    "generations": int(h_s.max_t + 1),
+                }
+            red = (legs["identity"]["fetch_bytes_per_particle"]
+                   / max(legs["linear"]["fetch_bytes_per_particle"],
+                         1e-9))
+            out["sumstat"] = {
+                "identity": legs["identity"],
+                "linear": legs["linear"],
+                "fetch_bytes_reduction_x": round(red, 2),
+                "reduction_ok": bool(red >= 2.0),
+                "pps_delta_x": round(
+                    legs["linear"]["pps"]
+                    / max(legs["identity"]["pps"], 1e-9), 2),
+                "pop_size": ss_pop, "n_patches": n_patches,
+                "dim_raw": n_patches * n_obs, "dim_reduced": 2,
+            }
+        except Exception as e:
+            out["sumstat"] = {"error": repr(e)[:300]}
+
     # adaptive-distance early-reject leg (ISSUE 17): the moment-based
     # refit over ALL resolved lanes is a different (unbiased) estimator
     # than the classic survivor ring, so the contract is posterior

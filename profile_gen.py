@@ -161,18 +161,43 @@ def build_scenario(model_name: str, segments: int | None):
 def main(pop: int = 1000, transition: str = "mvn", generations: int = 3,
          k_fraction: float = 0.25, refit_every: int | None = None,
          model_name: str = "lv", segments: int | None = None,
-         early_reject: str = "auto", sharded: int | None = None):
+         early_reject: str = "auto", sharded: int | None = None,
+         sumstat: str = "identity"):
     import jax
 
     import pyabc_tpu as pt
 
     model, prior, obs, distance = build_scenario(model_name, segments)
 
+    fit_times: list = []
+    if sumstat != "identity":
+        # learned summaries (ISSUE 20): override the scenario distance
+        # with plain PNorm + PredictorSumstat — the config the device-fit
+        # plan (and the segmented transformed bound) serves; host fits
+        # are timed through the wrapper, device boundary refits are
+        # counted from the run's metrics
+        pred = (pt.LinearPredictor() if sumstat == "linear"
+                else pt.MLPPredictor(hidden=(32,), n_steps=200))
+        _orig_fit = pred.fit
+
+        def _timed_fit(x, y, w=None):
+            t0 = time.perf_counter()
+            _orig_fit(x, y, w)
+            fit_times.append(time.perf_counter() - t0)
+
+        pred.fit = _timed_fit
+        distance = pt.PNormDistance(p=2, sumstat=pt.PredictorSumstat(pred))
+
+    from pyabc_tpu.observability.metrics import MetricsRegistry
+
+    metrics = MetricsRegistry()
+
     trans = (pt.LocalTransition(k_fraction=k_fraction)
              if transition == "local" else None)
     abc = pt.ABCSMC(
         model, prior, distance,
         population_size=pop, eps=pt.MedianEpsilon(), seed=0,
+        metrics=metrics,
         early_reject={"auto": "auto", "on": True,
                       "off": False}[early_reject],
         **({"sharded": sharded} if sharded else {}),
@@ -191,6 +216,22 @@ def main(pop: int = 1000, transition: str = "mvn", generations: int = 3,
         print(f"refit events: {refits}/{len(abc.refit_events)} "
               f"generations refit; last drift "
               f"{abc.refit_events[-1][2]:.4f}")
+    if sumstat != "identity":
+        from pyabc_tpu.observability.metrics import SUMSTAT_REFITS_TOTAL
+
+        ss = abc.distance_function.sumstat
+        plan = abc._sumstat_device_plan
+        S = abc.spec.total_size
+        C2 = ss.out_dim(S)
+        dev_refits = abc.metrics.counter(SUMSTAT_REFITS_TOTAL).value
+        print(
+            f"SUMSTAT kind={sumstat} "
+            f"mode={'device' if plan is not None else 'host'} "
+            f"C={S} C'={C2} reduction={S / max(C2, 1):.1f}x "
+            f"host_fits={len(fit_times)} "
+            f"host_fit_s={sum(fit_times):.4f} "
+            f"device_refits={int(dev_refits)}"
+        )
 
     # now profile one more generation by hand, split into stages
     t = h.max_t + 1
@@ -244,6 +285,12 @@ if __name__ == "__main__":
                          "(virtual shards on one device, or a mesh "
                          "width that divides it); composes with "
                          "--segments --early-reject (ISSUE 17)")
+    ap.add_argument("--sumstat", choices=("identity", "linear", "mlp"),
+                    default="identity",
+                    help="learned summary statistics (ISSUE 20): wrap "
+                         "the distance in a PredictorSumstat; composes "
+                         "with --sharded/--segments/--early-reject and "
+                         "prints fit wall time + C->C' reduction")
     ap.add_argument("--transition", choices=("mvn", "local"), default="mvn")
     ap.add_argument("--generations", type=int, default=3)
     ap.add_argument("--k-fraction", type=float, default=0.25)
@@ -265,4 +312,4 @@ if __name__ == "__main__":
              generations=args.generations, k_fraction=args.k_fraction,
              refit_every=args.refit_every, model_name=args.model,
              segments=args.segments, early_reject=args.early_reject,
-             sharded=args.sharded)
+             sharded=args.sharded, sumstat=args.sumstat)

@@ -238,11 +238,19 @@ class PNormDistance(Distance):
         accumulates the running max. Weights come from the SAME
         per-generation ``device_params`` the accept test uses, so a
         weight schedule reweights the bound and the final test together.
-        Learned sumstat transforms mix entries across the prefix and
-        have no sound per-prefix bound (None).
+
+        Learned sumstat transforms mix entries across the prefix, so
+        the plain partial p-sum is unavailable. For a FITTED LINEAR
+        transform at p = 2 the prefix still determines a sound lower
+        bound through null-space projectors of the remaining segments'
+        coefficient rows (``ops/fit.py::linear_bound_prepare`` holds
+        the math); that bound dict carries a ``"prepare"`` hook the
+        segmented engine calls once per generation with the live
+        distance params and the static emission map. Other learned
+        configurations return None (no sound per-prefix bound).
         """
         if self.sumstat is not None:
-            return None
+            return self._transformed_bound_fn()
         p = self.p
         rtol = self.BOUND_RTOL
 
@@ -268,8 +276,67 @@ class PNormDistance(Distance):
 
         return {"init": init, "step": step, "exceeds": exceeds}
 
+    def _transformed_bound_fn(self):
+        """The projector-based prefix bound for a fitted linear learned
+        transform at p = 2 (see :meth:`device_bound_fn`), or None when
+        the transform/config has no sound per-prefix bound. Restricted
+        to plain ``PNormDistance``: the adaptive variant refits weights
+        per generation from a scale reduction that itself needs the
+        transformed rows — a circularity the host path resolves."""
+        if type(self) is not PNormDistance or self.p != 2.0:
+            return None
+        from ..predictor.predictor import LinearPredictor
+        from ..sumstat.base import PredictorSumstat
+
+        ss = self.sumstat
+        if not isinstance(ss, PredictorSumstat):
+            return None
+        if not isinstance(ss.predictor, LinearPredictor):
+            # covers MLP/GP/ModelSelection: nonlinear (or host-only)
+            # transforms have no per-prefix linear structure to project
+            return None
+        if not ss.predictor.fitted or ss._out_dim is None:
+            return None
+        from ..ops.fit import linear_bound_fns, linear_bound_prepare
+
+        fns = linear_bound_fns(self.BOUND_RTOL, int(ss._out_dim))
+        return {**fns, "prepare": linear_bound_prepare}
+
+    def _sumstat_config(self):
+        """Identity of the learned-transform stack for kernel-cache
+        keying: predictor type + scalar hyperparameters + the fitted
+        feature dim. Without this, a distance with and without a learned
+        transform (or with differently configured predictors) would hash
+        to the SAME compiled-kernel cache key."""
+        ss = self.sumstat
+        if ss is None:
+            return None
+        cfg = {"name": type(ss).__name__}
+        pred = getattr(ss, "predictor", None)
+        if pred is not None:
+            pcfg = {"name": type(pred).__name__}
+            for attr in ("alpha", "lr", "n_steps", "hidden", "n_iter"):
+                val = getattr(pred, attr, None)
+                if isinstance(val, (int, float)):
+                    pcfg[attr] = val
+                elif isinstance(val, (tuple, list)):
+                    pcfg[attr] = tuple(val)
+            pcfg["fitted"] = bool(pred.fitted)
+            cfg["predictor"] = pcfg
+        out_dim = getattr(ss, "_out_dim", None)
+        if out_dim is not None:
+            cfg["out_dim"] = int(out_dim)
+        fit_every = getattr(ss, "fit_every", None)
+        if fit_every is not None:
+            cfg["fit_every"] = int(fit_every)
+        return cfg
+
     def get_config(self):
-        return {"name": type(self).__name__, "p": self.p}
+        cfg = {"name": type(self).__name__, "p": self.p}
+        ss_cfg = self._sumstat_config()
+        if ss_cfg is not None:
+            cfg["sumstat"] = ss_cfg
+        return cfg
 
     def __repr__(self):
         return f"{type(self).__name__}(p={self.p})"
@@ -523,11 +590,15 @@ class AdaptivePNormDistance(PNormDistance):
                 json.dump(log, fh, indent=1)
 
     def get_config(self):
-        return {
+        cfg = {
             "name": type(self).__name__,
             "p": self.p,
             "scale_function": self.scale_function.__name__,
         }
+        ss_cfg = self._sumstat_config()
+        if ss_cfg is not None:
+            cfg["sumstat"] = ss_cfg
+        return cfg
 
     def __repr__(self):
         return (

@@ -270,6 +270,23 @@ class DispatchEngine:
                     self.mesh_scale_bytes),
                 **(self._mesh_stats or {}),
             }
+        ss = getattr(self.owner.distance_function, "sumstat", None)
+        if ss is not None:
+            # learned-summaries state of THIS run (/api/observability
+            # reads it per dispatch engine, next to the global/tenant
+            # registry gauges): which mode serves the transform and the
+            # C -> C' reduction the packed fetch ships
+            plan = getattr(self.owner, "_sumstat_device_plan", None)
+            dim_raw = int(getattr(self.owner.spec, "total_size", 0) or 0)
+            block = {
+                "mode": "device" if plan is not None else "host",
+                "transform": type(ss).__name__,
+                "dim_raw": dim_raw,
+            }
+            if plan is not None:
+                block["kind"] = str(plan["kind"])
+                block["dim_reduced"] = int(plan["out_dim"])
+            snap["sumstat"] = block
         return snap
 
     def _note_mesh_stats(self, fetched, g_done: int) -> None:

@@ -426,6 +426,11 @@ class ABCSMC:
         #: this run not on the fast path" is a query, not a log grep.
         self._capability_fallbacks: list = []
         self._fallbacks_reported = False
+        #: resolved device-native learned-sumstat fit plan of the run
+        #: (ISSUE 20) — None outside sumstat mode AND in the legacy
+        #: host-refit dispatch mode; set by _loop_fused per run
+        self._sumstat_device_plan: dict | None = None
+        self._sumstat_reported = False
         self._clock = self.tracer.clock
         #: device-sync accounting (observability/sync.py): every blocking
         #: host<->device round trip of this run — chunk fetches, compute
@@ -1265,6 +1270,15 @@ class ABCSMC:
         if self._device_ctx is not None:
             # an adopted/pre-built context records into THIS run's ledger
             self._device_ctx.sync_ledger = self.sync_ledger
+        # learned-sumstat predictors that train on-device (MLP) fetch
+        # their fitted params back host-side; that round trip belongs to
+        # THIS run's sync accounting, not a lint-baseline exemption
+        _ss = getattr(self.distance_function, "sumstat", None)
+        _pred = getattr(_ss, "predictor", None)
+        if _pred is not None:
+            _pred.sync_ledger = self.sync_ledger
+            for _sub in getattr(_pred, "predictors", ()):
+                _sub.sync_ledger = self.sync_ledger
         # fresh health supervision per run: the trail and the rollback
         # budget are run state (resilience/health.py)
         from ..resilience.health import RunSupervisor
@@ -1824,6 +1838,31 @@ class ABCSMC:
                     [dict(f) for f in self._capability_fallbacks]}
         return {}
 
+    def _sumstat_telemetry(self) -> dict:
+        """Per-run learned-sumstat block for the FIRST persisted
+        generation's History telemetry (reported once, like the
+        capability fallbacks): serving mode (device-fit plan vs legacy
+        host refit), transform kind, and the raw-S -> learned-C' wire
+        dimensions of the packed fetch."""
+        ss = getattr(self.distance_function, "sumstat", None)
+        if ss is None or self._sumstat_reported:
+            return {}
+        self._sumstat_reported = True
+        plan = self._sumstat_device_plan
+        block: dict = {
+            "mode": "device" if plan is not None else "host",
+            "transform": type(ss).__name__,
+            "dim_raw": (int(self.spec.total_size)
+                        if self.spec is not None else None),
+        }
+        if plan is not None:
+            block["kind"] = plan["kind"]
+            block["dim_reduced"] = int(plan["out_dim"])
+            block["need"] = int(plan["need"])
+        elif getattr(ss, "_out_dim", None):
+            block["dim_reduced"] = int(ss._out_dim)
+        return {"sumstat": block}
+
     def _sharded_incapable_reason(self, n_shards: int) -> str | None:
         """Why the sharded multigen kernel cannot serve this config (None
         = capable). Round 16 (ISSUE 12) shrank this gate to the
@@ -1841,11 +1880,31 @@ class ABCSMC:
                     "fused feature set)")
         d = self.distance_function
         if getattr(d, "sumstat", None) is not None:
-            return ("learned summary statistics refit HOST-side in the "
-                    "transformed feature space at chunk boundaries, so "
-                    "the shard-local record ring cannot carry their "
-                    "scale state; the replicated GSPMD path serves this "
-                    "config (drop the sumstat transform to shard)")
+            # ISSUE 20: learned statistics SHARD when the boundary fit
+            # has a LINEAR in-kernel twin (the ridge solve runs on the
+            # gathered reservoir rows the cadence refit already pays
+            # for); what stays gated keeps an actionable reason
+            from ..sumstat.device import device_fit_plan
+
+            if getattr(d, "adaptive", False):
+                return ("adaptive scale refits compose with learned "
+                        "summary statistics on the UNSHARDED device-fit "
+                        "path only (the scale must refit AFTER the "
+                        "transform, in the new feature space — a "
+                        "replicated post-collective stage the sharded "
+                        "kernel does not run); the replicated GSPMD "
+                        "path serves this config")
+            plan, plan_reason = device_fit_plan(
+                d,
+                total_size=(self.spec.total_size
+                            if self.spec is not None else 0),
+                d_max=1, sharded_n=n_shards,
+            )
+            if plan is None:
+                return (f"learned summary statistics without a device-"
+                        f"fit plan refit HOST-side at chunk boundaries "
+                        f"({plan_reason}); the replicated GSPMD path "
+                        f"serves this config")
         if ((isinstance(d, AdaptivePNormDistance) and d.adaptive)
                 or (type(d) is AdaptiveAggregatedDistance and d.adaptive)) \
                 and not d.sharded_scale_capable():
@@ -2002,9 +2061,37 @@ class ABCSMC:
                         "read whole rows — the classic kernel serves "
                         "this config")
         if sumstat_mode:
-            return ("learned summary statistics mix trajectory entries "
-                    "across the prefix — no sound per-segment bound; "
-                    "the classic kernel serves this config")
+            # ISSUE 20: a fitted LINEAR transform admits an EXACT
+            # per-prefix bound (null-space projectors of the remaining
+            # segments' coefficient rows — ops/fit.py), so the engine
+            # serves it under a device-fit plan; anything host-refit
+            # or adaptive keeps the classic kernel
+            from ..sumstat.device import device_fit_plan
+
+            if adaptive:
+                return ("adaptive scale refits interleave with the "
+                        "learned-transform refit at the boundary (scale "
+                        "follows transform, in the NEW feature space); "
+                        "the segmented bound needs a fixed per-"
+                        "generation transform — the fused unsharded "
+                        "kernel serves this composition")
+            plan, plan_reason = device_fit_plan(
+                self.distance_function,
+                total_size=(self.spec.total_size
+                            if self.spec is not None else 0),
+                d_max=1, sharded_n=None,
+            )
+            if plan is None:
+                return (f"learned summary statistics without a device-"
+                        f"fit plan mix trajectory entries across the "
+                        f"prefix with host-refit parameters — no sound "
+                        f"per-segment bound ({plan_reason}); the "
+                        f"classic kernel serves this config")
+            if plan["kind"] != "linear":
+                return ("the transformed-space prefix bound is exact "
+                        "for LINEAR learned transforms only (projector "
+                        "null spaces of the remaining coefficient "
+                        "rows); MLP transforms keep the classic kernel")
         if self.mesh is not None and not sharded_n:
             return ("the replicated GSPMD mesh path constrains lane "
                     "arrays per round; segmented early reject composes "
@@ -2515,6 +2602,26 @@ class ABCSMC:
         # speculatively in this mode
         sumstat_mode = getattr(self.distance_function, "sumstat", None) \
             is not None
+        if sumstat_mode and self._resume_carry is not None \
+                and t == self.resumed_from_checkpoint_t:
+            # fresh-process resume: the fitted transform lives in the
+            # checkpoint carry's dist_w slot, not in this process's
+            # (unfitted) predictor — restore it BEFORE the device-fit
+            # plan fixes the C' dimension, so the rebuilt validation
+            # carry and the resumed carry share one pytree structure
+            # (f32 round-trip: bit-identical to the carried operands)
+            from ..sumstat.device import (
+                mirror_fitted_params,
+                seed_params_ready,
+            )
+
+            dw = self._resume_carry[3]
+            if not seed_params_ready(self.distance_function) \
+                    and isinstance(dw, dict) and len(dw.get("ss", ())):
+                mirror_fitted_params(
+                    self.distance_function,
+                    jax.tree.map(np.asarray, dw["ss"]), t - 1,
+                )
         # static shapes are sized for the LARGEST generation of a varying
         # (ListPopulationSize) schedule; smaller generations mask down.
         # In-kernel adaptive n sizes them to the strategy's hard cap.
@@ -2530,6 +2637,65 @@ class ABCSMC:
         n_cap = self._fused_n_cap()  # == _pow2(n_max, 64), single source
         # sharded fused sampling (ISSUE 9): population axis over the mesh
         sharded_n = self._sharded_n()
+        # ISSUE 20: device-native learned-statistic fits — when the
+        # predictor has a traceable in-kernel twin (ops/fit.py) the
+        # boundary refit moves INTO the kernel: fitted params ride the
+        # chunk carry, the packed fetch ships transformed C'-dim rows,
+        # and the engine's legacy host-refit dispatch mode (depth-1
+        # pipeline, f32 fetch, no speculation/checkpoints) is bypassed
+        sumstat_plan = None
+        sumstat_fit_token = None
+        if sumstat_mode:
+            from ..sumstat.device import (
+                device_fit_plan,
+                plan_cache_token,
+                seed_params_ready,
+            )
+
+            ss = self.distance_function.sumstat
+            plan, plan_reason = device_fit_plan(
+                self.distance_function,
+                total_size=self.spec.total_size,
+                d_max=int(getattr(ss, "_out_dim", None) or 0),
+                sharded_n=sharded_n,
+            )
+            if plan is not None and not seed_params_ready(
+                    self.distance_function):
+                plan, plan_reason = None, (
+                    "the generation-0 host fit did not seed the "
+                    "predictor (min_samples not reached), so the "
+                    "carried parameter structure and C' dimension are "
+                    "unfixed; the host-refit path serves this run"
+                )
+            if plan is not None and adaptive and sharded_n:
+                # unreachable through _sharded_incapable_reason (it
+                # refuses adaptive+sumstat), kept as a structural guard
+                plan, plan_reason = None, (
+                    "adaptive scale + learned transform compose on the "
+                    "unsharded device-fit path only"
+                )
+            if plan is None:
+                logger.info("device-native sumstat fit off: %s",
+                            plan_reason)
+                self._note_capability_fallback(
+                    "sumstat_device", plan_reason)
+                if sharded_n:
+                    # the STATIC sharded gate admitted this config (a
+                    # linear plan resolves), but the runtime seeding
+                    # failed (e.g. a no-checkpoint resume: the fitted
+                    # transform is unrecoverable from transformed
+                    # History rows) — the sharded kernel cannot serve
+                    # host-refit sumstat mode, so sharding drops too
+                    self._note_capability_fallback(
+                        "sharded",
+                        "learned-sumstat device-fit plan failed at "
+                        "runtime (" + str(plan_reason) + "); the "
+                        "host-refit path serves the run unsharded")
+                    sharded_n = None
+            else:
+                sumstat_fit_token = plan_cache_token(plan)
+            sumstat_plan = plan
+        self._sumstat_device_plan = sumstat_plan
         # record-ring capacity for the adaptive/stochastic mechanisms; in
         # sharded mode the ring is PER SHARD, so the per-shard cap scales
         # down to keep the total recorded evaluations comparable to the
@@ -2589,6 +2755,14 @@ class ABCSMC:
                 adaptive=adaptive, stochastic=stochastic,
                 sumstat_mode=sumstat_mode, sharded_n=sharded_n,
             )
+            if seg_reason is None and sumstat_mode \
+                    and sumstat_plan is None:
+                # the static gate admitted a linear device-fit plan but
+                # the runtime seeding failed (no-checkpoint resume):
+                # host-refit sumstat mode has no sound per-prefix bound
+                seg_reason = ("learned-sumstat device-fit plan failed "
+                              "at runtime; the transformed prefix "
+                              "bound needs the fitted linear transform")
             if seg_reason is None:
                 seg_cfg = ctx.segment_cfg(stochastic=stochastic)
             elif self.early_reject is True:
@@ -2625,6 +2799,7 @@ class ABCSMC:
             temp_fixed=temp_fixed,
             complete_history=complete_history,
             sumstat_transform=sumstat_mode,
+            sumstat_fit=sumstat_fit_token,
             adaptive_n=(
                 (float(self.population_strategy.mean_cv),
                  int(self.population_strategy.min_population_size),
@@ -2865,7 +3040,7 @@ class ABCSMC:
                   "max_walltime": max_walltime,
                   "start_walltime": start_walltime},
             n_of=self.population_strategy,
-            sumstat_refit=sumstat_mode,
+            sumstat_refit=sumstat_mode and sumstat_plan is None,
             adaptive=adaptive,
             stochastic=stochastic,
             temp_fixed=temp_fixed,
@@ -3205,6 +3380,7 @@ class ABCSMC:
                         **refit_tel,
                         **(mem_telemetry if g == 0 else {}),
                         **self._fallbacks_telemetry(),
+                        **self._sumstat_telemetry(),
                     },
                 )
                 logger.info(
@@ -3253,6 +3429,48 @@ class ABCSMC:
                         w_g = dwn[g]
                     self.distance_function.weights[t + 1] = \
                         self._device_w_to_host(w_g)
+                plan = self._sumstat_device_plan
+                if plan is not None and g == g_limit - 1 \
+                        and min(int(fetched["n_acc"][g]), n) \
+                        >= int(plan["need"]):
+                    # the kernel's boundary learned-sumstat fit fired
+                    # for this generation (the host evaluates the SAME
+                    # predicate the in-kernel lax.cond did): mirror the
+                    # fitted transform into the host predictor — resume-
+                    # rebuilt carries, later host predicts and repr-
+                    # level diagnostics must reflect the device fit
+                    import jax as _jax
+
+                    from ..observability import global_metrics
+                    from ..observability.metrics import (
+                        SUMSTAT_DIM_GAUGE,
+                        SUMSTAT_DIM_REDUCED_GAUGE,
+                        SUMSTAT_REFITS_TOTAL,
+                    )
+                    from ..sumstat.device import mirror_fitted_params
+
+                    ssp_g = _jax.tree.map(
+                        lambda v: np.asarray(v[g]),
+                        fetched["dist_w_next"]["ss"],
+                    )
+                    mirror_fitted_params(
+                        self.distance_function, ssp_g, t + 1)
+                    for reg in (self.metrics, global_metrics()):
+                        reg.counter(
+                            SUMSTAT_REFITS_TOTAL,
+                            "in-kernel learned-sumstat boundary refits "
+                            "(device-fit plan runs)",
+                        ).inc()
+                        reg.gauge(
+                            SUMSTAT_DIM_GAUGE,
+                            "raw summary-statistic dimension S of the "
+                            "learned-sumstat run",
+                        ).set(float(self.spec.total_size))
+                        reg.gauge(
+                            SUMSTAT_DIM_REDUCED_GAUGE,
+                            "learned feature dimension C' the packed "
+                            "fetch ships per particle",
+                        ).set(float(plan["out_dim"]))
                 if adaptive_n:
                     # mirror the in-kernel bootstrap-CV decision into the
                     # host strategy (resume / post-loop host generations)
@@ -3714,13 +3932,25 @@ class ABCSMC:
 
         wd = self.history.get_weighted_distances(t_last)
         ws, stats_mat = self.history.get_weighted_sum_stats(t_last)
-        new_d = np.asarray([
-            self.distance_function(
-                self.spec.unflatten(stats_mat[i]), self.x_0, t_last + 1
-            )
-            for i in range(stats_mat.shape[0])
-        ])
-        wd = pd.DataFrame({"distance": new_d, "w": ws / ws.sum()})
+        if stats_mat.shape[1] != self.spec.total_size:
+            # device-native learned-sumstat generations persist
+            # TRANSFORMED C'-dim rows (ISSUE 20); the raw-space
+            # recompute is impossible without the fitted transform AND
+            # unnecessary — the stored distances were computed in the
+            # transformed space the accept test ran in, so they
+            # re-seed the threshold as-is
+            wd = pd.DataFrame({
+                "distance": wd["distance"].to_numpy(),
+                "w": ws / ws.sum(),
+            })
+        else:
+            new_d = np.asarray([
+                self.distance_function(
+                    self.spec.unflatten(stats_mat[i]), self.x_0, t_last + 1
+                )
+                for i in range(stats_mat.shape[0])
+            ])
+            wd = pd.DataFrame({"distance": new_d, "w": ws / ws.sum()})
         from ..epsilon import QuantileEpsilon
 
         if isinstance(self.eps, QuantileEpsilon):

@@ -885,6 +885,12 @@ class DeviceContext:
             dense_pos[imap_np[j]] = j * seg_size + np.arange(seg_size)
         dense_pos = jnp.asarray(dense_pos)
         x0_by_seg = self.x0[seg_cfg["index_map"]]
+        # transformed-space bounds (ISSUE 20) precompute per-generation
+        # operands — suffix-Gram null-space projectors of the fitted
+        # linear transform — from the live distance params and the STATIC
+        # emission map; classic bounds read the distance params directly
+        bparams = (bound["prepare"](dist_params, imap_np)
+                   if "prepare" in bound else dist_params)
 
         def propose_block(r):
             if B_total is None:
@@ -1005,7 +1011,7 @@ class DeviceContext:
                 stepmask[:, None, None], stats2, lane["stats"])
             bacc2 = jax.vmap(
                 lambda a, v, i: bound["step"](a, v, i, self.x0,
-                                              dist_params)
+                                              bparams)
             )(lane["bacc"], vals, idx_row)
             lane["bacc"] = select_lanes(stepmask, bacc2, lane["bacc"])
             lane["seg_idx"] = lane["seg_idx"] + stepmask.astype(jnp.int32)
@@ -1122,11 +1128,11 @@ class DeviceContext:
                 u_lane = jax.vmap(jax.random.uniform)(lane["kacc"])
                 thr_lane = dyn["acc_params"] + eps * jnp.log(u_lane)
                 exceeds = jax.vmap(
-                    lambda a, tl: bound["exceeds"](a, tl, dist_params)
+                    lambda a, tl: bound["exceeds"](a, tl, bparams)
                 )(lane["bacc"], thr_lane)
             else:
                 exceeds = jax.vmap(
-                    lambda a: bound["exceeds"](a, thr, dist_params)
+                    lambda a: bound["exceeds"](a, thr, bparams)
                 )(lane["bacc"])
             retire = stepmask & ~done & (exceeds | ~lane["valid"])
             resolved_now = done | retire
@@ -1345,6 +1351,7 @@ class DeviceContext:
                         temp_fixed: bool = False,
                         complete_history: bool = False,
                         sumstat_transform: bool = False,
+                        sumstat_fit: tuple | None = None,
                         adaptive_n: tuple | None = None,
                         weight_sched: bool = False,
                         fold_sched_mode: bool = False,
@@ -1431,26 +1438,28 @@ class DeviceContext:
                      eps_quantile, eps_weighted, alpha, multiplier,
                      trans_cls.__name__, fit_statics, dims,
                      stochastic, temp_config, temp_fixed, complete_history,
-                     sumstat_transform, adaptive_n, weight_sched,
+                     sumstat_transform, sumstat_fit, adaptive_n, weight_sched,
                      fold_sched_mode, first_gen_prior, fused_calibration,
                      refit_cadence, health_config, sharded, seg_token)
         if cache_key in self._kernels:
             return self._kernels[cache_key]
         if stochastic and self.K != 1:
             raise ValueError("stochastic fused chunks support K=1 only")
-        if segment_cfg is not None and sumstat_transform:
-            # the one genuinely incompatible combination left (ISSUE 17
-            # lifted sharded/adaptive/stochastic): a learned transform
-            # mixes entries across the prefix, so no per-prefix bound is
-            # sound. The caller gates it with a named fallback
+        if segment_cfg is not None and sumstat_transform and (
+                sumstat_fit is None or adaptive):
+            # a learned transform mixes entries across the prefix, so the
+            # classic partial p-sum is unsound — ISSUE 20 supplies a
+            # projector bound for DEVICE-FIT linear transforms
+            # (non-adaptive: the adaptive scale refit needs transformed
+            # rows the retirement-biased ring cannot supply). Everything
+            # else stays gated by the caller
             # (ABCSMC._early_reject_incapable_reason); reaching here
-            # means the gate was bypassed. (In-kernel calibration DOES
-            # compose: the eps=+inf prior round keeps the classic lane —
-            # nothing can retire at an infinite threshold.)
+            # means the gate was bypassed.
             raise ValueError(
-                "segmented early reject cannot serve learned summary "
-                "statistics (no sound per-prefix bound in the "
-                "transformed feature space)"
+                "segmented early reject serves learned summary "
+                "statistics only under a device-fit plan with a "
+                "non-adaptive linear transform (projector prefix "
+                "bound); this config has no sound per-prefix bound"
             )
         if segment_cfg is not None and \
                 bool(segment_cfg.get("stochastic", False)) != stochastic:
@@ -1465,11 +1474,21 @@ class DeviceContext:
             # ISSUE 12 extended it to the adaptive mechanisms — adaptive
             # distances, stochastic acceptors, weight/pop schedules and
             # in-kernel adaptive n all ride the scalar-column collectives)
-            if sumstat_transform or fused_calibration is not None:
+            if fused_calibration is not None:
                 raise ValueError(
-                    "sharded multigen cannot serve learned summary "
-                    "statistics or in-kernel calibration — the caller "
-                    "must gate these onto the GSPMD or host paths"
+                    "sharded multigen cannot serve in-kernel "
+                    "calibration — the caller must gate it onto the "
+                    "GSPMD or host paths"
+                )
+            if sumstat_transform and (
+                    sumstat_fit is None or adaptive
+                    or dict(sumstat_fit).get("kind") != "linear"):
+                raise ValueError(
+                    "sharded multigen serves learned summary statistics "
+                    "only under a LINEAR non-adaptive device-fit plan "
+                    "(the boundary ridge fit rides the row gather); the "
+                    "caller must gate other configs onto the host-refit "
+                    "path"
                 )
             if refit_cadence is None:
                 raise ValueError(
@@ -1489,6 +1508,8 @@ class DeviceContext:
                 weight_sched=weight_sched,
                 fold_sched_mode=fold_sched_mode, adaptive_n=adaptive_n,
                 segment_cfg=segment_cfg,
+                sumstat_transform=sumstat_transform,
+                sumstat_fit=sumstat_fit,
             )
             self._kernels[cache_key] = fn
             return fn
@@ -1511,6 +1532,12 @@ class DeviceContext:
         )
         scale_reduce = ss_fn = scale_impl = None
         seg_moment_cfg = seg_scale_finish = seg_mom_x0 = None
+        if sumstat_transform:
+            # the learned transform's device twin: applied to the fetched
+            # rows under a device-fit plan, and composed with the scale
+            # twin on the adaptive path below
+            ss_fn = self.distance.sumstat.device_fn(self.spec)
+        fit_plan = dict(sumstat_fit) if sumstat_fit is not None else None
         if adaptive and segment_cfg is not None:
             # unbiased adaptive refits under retirement (ISSUE 17): the
             # segmented engine's record ring keeps COMPLETED evaluations
@@ -1549,7 +1576,6 @@ class DeviceContext:
             # learned statistics, so compose the sumstat device twin with
             # the raw scale twin
             scale_impl = self.distance.device_scale_impl()
-            ss_fn = self.distance.sumstat.device_fn(self.spec)
             if weight_post is None or scale_impl is None:
                 raise RuntimeError(
                     "adaptive multigen run needs device scale + weight twins"
@@ -1747,8 +1773,54 @@ class DeviceContext:
                 )
                 w_norm = normalize_log_weights(res["log_weight"], k_mask)
 
+                fit_now = None
+                if fit_plan is not None:
+                    # ISSUE 20 device-fit plan: refit the learned transform
+                    # at the chunk's LAST ACTIVE generation from the
+                    # accepted reservoir the step already holds — the
+                    # boundary cadence the host refit used to pay a fetch
+                    # for. ``need`` mirrors the host min-samples rule; a
+                    # generation that missed it (or a mid-chunk
+                    # generation) carries the old params forward.
+                    from ..ops.fit import (keep_if_finite, mlp_fit_steps,
+                                           ridge_fit)
+
+                    fit_now = (
+                        (g == g_limit - 1) & gen_ok
+                        & (jnp.minimum(n_acc, n_target)
+                           >= jnp.int32(fit_plan["need"]))
+                    )
+                    ssp_old = dist_w["ss"]
+                    y_fit = res["theta"][:, : fit_plan["out_dim"]]
+                    w_fit = jnp.where(k_mask, jnp.exp(w_norm), 0.0)
+                    if fit_plan["kind"] == "linear":
+                        def _fit_ss(_):
+                            new = ridge_fit(
+                                res["sumstats"], y_fit, w_fit, k_mask,
+                                fit_plan["alpha"],
+                            )
+                            return keep_if_finite(new, ssp_old)[0]
+                    else:
+                        def _fit_ss(_):
+                            new = mlp_fit_steps(
+                                ssp_old, res["sumstats"], y_fit, w_fit,
+                                k_mask, lr=fit_plan["lr"],
+                                n_steps=fit_plan["n_steps"],
+                            )
+                            return keep_if_finite(new, ssp_old)[0]
+
+                    ssp_next = jax.lax.cond(
+                        fit_now, _fit_ss, lambda _: ssp_old, None
+                    )
+                else:
+                    ssp_next = None
+
                 if adaptive and sumstat_transform:
-                    ssp = dist_w["ss"]
+                    # host AdaptivePNormDistance.update order: transform
+                    # refit FIRST, then the scale weights in the NEW
+                    # transformed feature space
+                    ssp = (ssp_next if ssp_next is not None
+                           else dist_w["ss"])
                     rec_t = jax.vmap(lambda r: ss_fn(r, ssp))(rec["sumstats"])
                     scale = scale_impl(rec_t, rec["valid"],
                                        ss_fn(self.x0, ssp))
@@ -1764,6 +1836,8 @@ class DeviceContext:
                     scale = scale_reduce(rec["sumstats"], rec["valid"],
                                          self.x0)
                     dist_w_next = weight_post(scale)
+                elif ssp_next is not None:
+                    dist_w_next = {"w": dist_w["w"], "ss": ssp_next}
                 else:
                     dist_w_next = dist_w
                 if adaptive:
@@ -1773,6 +1847,18 @@ class DeviceContext:
                     d_new = jax.vmap(
                         lambda s: dist_fn(s, self.x0, dist_w_next)
                     )(res["sumstats"])
+                elif ssp_next is not None:
+                    # at a boundary refit the epsilon quantile must be
+                    # taken in the NEW feature space — the space the next
+                    # chunk's accept test runs in (history keeps the
+                    # acceptance-time values, like the adaptive path)
+                    d_new = jax.lax.cond(
+                        fit_now,
+                        lambda: jax.vmap(
+                            lambda s: dist_fn(s, self.x0, dist_w_next)
+                        )(res["sumstats"]),
+                        lambda: res["distance"],
+                    )
                 else:
                     d_new = res["distance"]
 
@@ -1962,6 +2048,21 @@ class DeviceContext:
                     )
                 else:
                     word = ess = health_state_next = None
+                if fit_plan is not None:
+                    # the packed fetch ships TRANSFORMED C'-dim rows: the
+                    # generation's ACCEPTANCE-time params (the carry
+                    # input, not the boundary refit) transform the
+                    # accepted rows so host-side population build /
+                    # persist see exactly the feature space the accept
+                    # test ran in — and the high-dim raw-S wire payload
+                    # shrinks to O(n_params) per particle
+                    ssp_used = dist_w["ss"]
+                    res = {
+                        **res,
+                        "sumstats": jax.vmap(
+                            lambda s: ss_fn(s, ssp_used)
+                        )(res["sumstats"]),
+                    }
                 out = {
                     **res,
                     "eps_used": eps_g, "eps_next": eps_next,
@@ -2152,7 +2253,9 @@ class DeviceContext:
                           weight_sched: bool = False,
                           fold_sched_mode: bool = False,
                           adaptive_n: tuple | None = None,
-                          segment_cfg: dict | None = None):
+                          segment_cfg: dict | None = None,
+                          sumstat_transform: bool = False,
+                          sumstat_fit: tuple | None = None):
         """The sharded fused chunk: population axis split over the mesh
         with chunk-boundary-only ROW collectives.
 
@@ -2241,6 +2344,13 @@ class DeviceContext:
         refit_every_s, _drift_thr = refit_cadence
         use_mesh = self.mesh is not None
         dist_fn = self.distance.device_fn(self.spec)
+        # ISSUE 20: learned-sumstat device-fit plan (LINEAR, non-adaptive
+        # — the multigen_kernel gate enforced it). The boundary ridge fit
+        # consumes the SAME gathered rows the cadence refit pays for, so
+        # the per-chunk collective set is unchanged.
+        fit_plan = dict(sumstat_fit) if sumstat_fit is not None else None
+        ss_fn = (self.distance.sumstat.device_fn(self.spec)
+                 if sumstat_transform else None)
         weight_post = (
             self.distance.device_weight_update() if adaptive else None
         )
@@ -2590,9 +2700,68 @@ class DeviceContext:
                     # they must not leak into the chunk outputs
                     res_l = {k: v for k, v in res_l.items()
                              if k != "dfeat"}
+                elif fit_plan is not None:
+                    # boundary refit of the learned transform: gather the
+                    # raw sum-stat + theta rows INSIDE the cond (the same
+                    # pattern the cadence refit uses — fit_now fires at
+                    # the chunk's last active generation only, so this
+                    # rides the boundary the run already pays) and
+                    # recompute the accepted distances in the NEW feature
+                    # space for the epsilon quantile, from the gathered
+                    # replicated rows so every width computes the
+                    # identical column
+                    from ..ops.fit import keep_if_finite, ridge_fit
+
+                    fit_now = (
+                        (g == g_limit - 1) & gen_ok
+                        & (jnp.minimum(n_acc, n_target)
+                           >= jnp.int32(fit_plan["need"]))
+                    )
+                    ssp_old = dist_w["ss"]
+                    res_raw = res_l
+
+                    def _fit_ss(_):
+                        ss_glob = A.rows(res_raw["sumstats"])
+                        th_glob = A.rows(
+                            res_raw["theta"])[:, : fit_plan["out_dim"]]
+                        w_fit = jnp.where(k_mask, jnp.exp(w_norm), 0.0)
+                        ssp_n = ridge_fit(ss_glob, th_glob, w_fit,
+                                          k_mask, fit_plan["alpha"])
+                        ssp_n, fit_ok = keep_if_finite(ssp_n, ssp_old)
+                        dw = {"w": dist_w["w"], "ss": ssp_n}
+                        d_n = jax.vmap(
+                            lambda s: dist_fn(s, self.x0, dw)
+                        )(ss_glob)
+                        # a rejected fit keeps the acceptance-time
+                        # distance column verbatim — recomputing under
+                        # the OLD params over gathered rows could differ
+                        # in the last bit from the shard-local
+                        # acceptance pass
+                        return ssp_n, jnp.where(fit_ok, d_n, d_col)
+
+                    ssp_next, d_new = jax.lax.cond(
+                        fit_now, _fit_ss,
+                        lambda _: (ssp_old, d_col), None,
+                    )
+                    dist_w_next = {"w": dist_w["w"], "ss": ssp_next}
                 else:
                     dist_w_next = dist_w
                     d_new = d_col
+                if fit_plan is not None:
+                    # the fetch ships TRANSFORMED C'-dim rows under the
+                    # generation's ACCEPTANCE-time params — shard-local
+                    # math, no new collectives (the row merge happens in
+                    # fetch_pack_kernel exactly as before, just over C'
+                    # columns instead of S)
+                    ssp_used = dist_w["ss"]
+                    res_l = {
+                        **res_l,
+                        "sumstats": A.map_local(
+                            lambda rows: jax.vmap(
+                                lambda s: ss_fn(s, ssp_used)
+                            )(rows)
+                        )(res_l["sumstats"]),
+                    }
                 if eps_quantile:
                     pts = jnp.where(k_mask, d_new, jnp.inf)
                     wts = (
@@ -2745,10 +2914,6 @@ class DeviceContext:
                 else:
                     word = ess = health_state_next = None
                 out = {
-                    "dbg_dcol": d_col, "dbg_lw": lw_col,
-                    "dbg_nacc": nacc_sh, "dbg_rounds": rounds_sh,
-                    "dbg_th": A.rows(res_l["theta"]),
-                    "dbg_ss": A.rows(res_l["sumstats"]),
                     **res_l,
                     "eps_used": eps_g, "eps_next": eps_next,
                     "dist_w_next": dist_w_next, "n_acc": n_acc,

@@ -79,6 +79,7 @@ def observed_data(seed: int = 0, n_obs: int = 15, t1: float = 60.0,
 def make_network_sir_model(n_patches: int = 8, n_obs: int = 16,
                            t1: float = 60.0, n_substeps: int = 4,
                            coupling: float = 0.08, segments: int = 4,
+                           noise_sd: float = 0.0,
                            name: str = "network_sir") -> JaxModel:
     """Ring-coupled metapopulation SIR; theta = (beta, gamma) global.
 
@@ -88,6 +89,13 @@ def make_network_sir_model(n_patches: int = 8, n_obs: int = 16,
     EVERY patch at ``n_obs`` equally spaced times after t=0, flattened
     time-major: ``{"infected": (n_obs * n_patches,)}`` — a trajectory
     prefix is a flat prefix, so segment bounds are exact.
+
+    ``noise_sd > 0`` adds iid measurement noise to the emitted counts
+    INSIDE the simulator (per segment, from the carried key). The
+    default stays deterministic; the noisy variant is the honest
+    learned-summary scenario — a regression trained on noise-free
+    stats mis-extrapolates to a noisy observation, so posterior-quality
+    comparisons must train on data drawn like the observed data.
     """
     if n_obs % segments:
         raise ValueError(
@@ -129,8 +137,13 @@ def make_network_sir_model(n_patches: int = 8, n_obs: int = 16,
 
         y_fin, infected = jax.lax.scan(
             obs_step, carry["y"], None, length=obs_per_seg)
-        return ({**carry, "y": y_fin},
-                infected.reshape(-1))  # time-major (obs_per_seg*n_patches,)
+        infected = infected.reshape(-1)  # time-major
+        key = carry["key"]
+        if noise_sd > 0:
+            key, sub = jax.random.split(key)
+            infected = infected + noise_sd * jax.random.normal(
+                sub, infected.shape)
+        return ({**carry, "y": y_fin, "key": key}, infected)
 
     from ..ops.segment import SegmentedSim
 

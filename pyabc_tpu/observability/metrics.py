@@ -293,6 +293,20 @@ SIM_SEGMENT_OCCUPANCY_GAUGE = "pyabc_tpu_sim_segment_occupancy"
 #:  the composed sharded+segmented kernel)
 SIM_RETIRE_IMBALANCE_GAUGE = "pyabc_tpu_sim_retire_shard_imbalance"
 
+# -- device-native learned summary statistics (ISSUE 20) ----------------------
+#
+# Fearnhead-Prangle transforms fit IN-KERNEL at chunk boundaries under
+# a device-fit plan; the instruments make the fit cadence and the
+# raw-S -> learned-C' fetch compression observable per run:
+#:  in-kernel boundary refits of the learned-sumstat predictor (the
+#:  host mirror bumps this when the kernel's fit predicate fired)
+SUMSTAT_REFITS_TOTAL = "pyabc_tpu_sumstat_refits_total"
+#:  raw summary-statistic dimension S of the learned-sumstat run
+SUMSTAT_DIM_GAUGE = "pyabc_tpu_sumstat_dim"
+#:  learned feature dimension C' the packed fetch ships per particle
+#:  (the S -> C' ratio IS the fetch-bytes reduction of the transform)
+SUMSTAT_DIM_REDUCED_GAUGE = "pyabc_tpu_sumstat_dim_reduced"
+
 # -- capability-gate fallback accounting (ISSUE 17) ---------------------------
 #
 # When early_reject="auto" or an implicit mesh-width shard resolution

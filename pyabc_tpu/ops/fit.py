@@ -1,0 +1,262 @@
+"""On-device predictor fits for learned summary statistics (ISSUE 20).
+
+The Fearnhead-Prangle transform s(x) = E[theta | x] is learned by
+regressing accepted thetas on raw summary statistics. The host parity
+layer (``predictor/predictor.py``) fits per generation with numpy /
+optax; this module holds the TRACEABLE twins that run INSIDE the
+multigen kernel at the chunk boundary, so the fitted parameters ride
+the chunk carry as plain device operands and the per-run sync count
+stays at ``chunks + O(1)``:
+
+1. :func:`ridge_fit` — the weighted ridge normal-equations solve of
+   ``LinearPredictor.fit`` on masked reservoir rows. It returns the
+   SAME ``{"W", "b", "mu", "sd"}`` pytree ``device_params()`` produces,
+   so a host-seeded carry and a kernel-refit carry have identical
+   structure (checkpoint resume and the dispatch engine never see the
+   difference).
+2. :func:`mlp_fit_steps` — a fixed number of full-batch Adam steps on
+   the ``MLPPredictor`` tanh network, warm-started from the carried
+   layer stack (the host fit restarts from a seeded init each
+   generation; warm-starting is the documented deviation that makes
+   the in-kernel fit a bounded-cost scan instead of a cold optimize).
+3. :func:`linear_bound_prepare` — the transformed-space prefix bound
+   of the segmented early-reject engine: for a fitted LINEAR transform
+   the partial feature vector accumulated over a trajectory prefix
+   determines a sound lower bound on the final transformed distance
+   via null-space projectors of the remaining segments' coefficient
+   rows (see the function docstring for the math and its soundness
+   argument).
+
+Everything here is pure jax-traceable math with no host callbacks: the
+fits execute under ``lax.cond`` at the boundary generation of a chunk,
+in both the unsharded scan and the sharded kernel's replicated
+post-collective section.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: floor below which a standardization scale is treated as constant
+#: (mirrors ``predictor._standardize_fit``: sd <= 1e-12 -> 1.0)
+SD_FLOOR = 1e-12
+
+#: relative eigenvalue threshold under which a direction of the
+#: remaining-segments Gram is treated as unreachable (numerically null).
+#: Directions within float noise of zero eigenvalue are counted into the
+#: bound; genuinely tiny-but-positive eigenvalues are excluded (kept
+#: reachable), which only makes the bound SMALLER — the conservative,
+#: sound direction for early rejection.
+NULL_EIG_RTOL = 1e-6
+
+
+def masked_standardize(x, mask):
+    """Per-column (mu, sd) of the masked rows of ``x`` — the traceable
+    twin of ``predictor._standardize_fit`` (biased /n std, sd floor).
+
+    ``x``: (n, S); ``mask``: (n,) bool. Returns ((S,), (S,)) float32.
+    """
+    m = mask.astype(jnp.float32)
+    n = jnp.maximum(jnp.sum(m), 1.0)
+    mu = jnp.sum(x * m[:, None], axis=0) / n
+    var = jnp.sum(((x - mu) ** 2) * m[:, None], axis=0) / n
+    sd = jnp.sqrt(var)
+    sd = jnp.where(sd > SD_FLOOR, sd, 1.0)
+    return mu, sd
+
+
+def ridge_fit(x, y, w, mask, alpha: float):
+    """Weighted ridge normal-equations fit on masked rows — the traceable
+    twin of ``LinearPredictor.fit``.
+
+    ``x``: (n, S) raw sum-stat rows; ``y``: (n, d) (padded) theta rows;
+    ``w``: (n,) non-negative particle weights; ``mask``: (n,) bool row
+    eligibility (the reservoir's accepted prefix). Rows outside the mask
+    contribute exactly nothing. Returns the ``LinearPredictor``
+    ``device_params()`` pytree ``{"W": (S, d), "b": (d,), "mu": (S,),
+    "sd": (S,)}`` in float32.
+
+    Math (identical to the host fit, which runs in float64): weights
+    renormalized to sum n, inputs standardized by masked (mu, sd),
+    ``W = (Xs' diag(w) Xs + alpha I)^-1 Xs' diag(w) (y - ym)``,
+    ``b = ym`` the weighted target mean. ``alpha > 0`` keeps the system
+    positive definite for any mask population.
+    """
+    x = x.astype(jnp.float32)
+    y = y.astype(jnp.float32)
+    S = x.shape[1]
+    m = mask.astype(jnp.float32)
+    n = jnp.maximum(jnp.sum(m), 1.0)
+    mu, sd = masked_standardize(x, mask)
+    xs = ((x - mu) / sd) * m[:, None]
+    w = jnp.maximum(w, 0.0) * m
+    w = w * n / jnp.maximum(jnp.sum(w), 1e-30)
+    A = xs.T @ (xs * w[:, None]) + alpha * jnp.eye(S, dtype=jnp.float32)
+    ym = (w @ y) / n
+    B = xs.T @ (w[:, None] * ((y - ym) * m[:, None]))
+    W = jnp.linalg.solve(A, B)
+    return {"W": W, "b": ym, "mu": mu, "sd": sd}
+
+
+def _mlp_forward(layers, x):
+    """Traceable twin of ``MLPPredictor._forward`` (tanh hidden, linear
+    head) over a batch ``x``: (n, S)."""
+    h = x
+    for layer in layers[:-1]:
+        h = jnp.tanh(h @ layer["w"] + layer["b"])
+    last = layers[-1]
+    return h @ last["w"] + last["b"]
+
+
+def mlp_fit_steps(params, x, y, w, mask, *, lr: float, n_steps: int):
+    """A bounded number of full-batch Adam steps on the MLP predictor,
+    warm-started from the carried ``{"layers", "mu", "sd", "ymu",
+    "ysd"}`` pytree; returns the same structure.
+
+    Input/target standardization is refit from the masked rows each
+    boundary (like the host fit); the layer stack continues from its
+    carried values instead of a fresh seeded init — the warm-start
+    deviation documented in the module header. Adam is implemented
+    inline (optax is an optional dependency of the HOST fit only; the
+    kernel must stay importable without it).
+    """
+    x = x.astype(jnp.float32)
+    y = y.astype(jnp.float32)
+    m = mask.astype(jnp.float32)
+    mu, sd = masked_standardize(x, mask)
+    ymu, ysd = masked_standardize(y, mask)
+    xs = ((x - mu) / sd) * m[:, None]
+    ys = ((y - ymu) / ysd) * m[:, None]
+    n = jnp.maximum(jnp.sum(m), 1.0)
+    wts = jnp.maximum(w, 0.0) * m
+    wts = wts / jnp.maximum(jnp.sum(wts) / n, 1e-30)
+    layers = params["layers"]
+
+    def loss_fn(p):
+        pred = _mlp_forward(p, xs)
+        return jnp.sum(wts[:, None] * (pred - ys) ** 2) / (n * ys.shape[1])
+
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    zeros = jax.tree.map(jnp.zeros_like, layers)
+
+    def step(carry, i):
+        p, mo, ve = carry
+        g = jax.grad(loss_fn)(p)
+        mo = jax.tree.map(lambda a, b: b1 * a + (1 - b1) * b, mo, g)
+        ve = jax.tree.map(lambda a, b: b2 * a + (1 - b2) * b * b, ve, g)
+        t = (i + 1).astype(jnp.float32)
+        corr1 = 1.0 - b1 ** t
+        corr2 = 1.0 - b2 ** t
+        p = jax.tree.map(
+            lambda pp, a, b: pp - lr * (a / corr1)
+            / (jnp.sqrt(b / corr2) + eps),
+            p, mo, ve,
+        )
+        return (p, mo, ve), ()
+
+    (layers, _, _), _ = jax.lax.scan(
+        step, (layers, zeros, zeros),
+        jnp.arange(n_steps, dtype=jnp.int32),
+    )
+    return {"layers": layers, "mu": mu, "sd": sd, "ymu": ymu, "ysd": ysd}
+
+
+def keep_if_finite(new, old):
+    """``(params, ok)`` where ``params`` is ``new`` if every leaf of
+    ``new`` is all-finite, else ``old`` — the kernel's blown-fit guard.
+
+    A float32 normal-equations solve can go non-finite when the Gram
+    matrix is ill-conditioned relative to ``alpha`` (measured: S=128
+    correlated stats at alpha=1e-6). Accepting such params would poison
+    every subsequent distance and kill the run via the health engine;
+    keeping the previous boundary's transform merely skips one refit.
+    ``ok`` is the scalar bool so callers can gate values derived from
+    the new params (e.g. recomputed reservoir distances) on the same
+    decision.
+    """
+    ok = jnp.bool_(True)
+    for leaf in jax.tree.leaves(new):
+        ok = ok & jnp.all(jnp.isfinite(leaf))
+    guarded = jax.tree.map(
+        lambda nw, od: jnp.where(ok, nw, od), new, old)
+    return guarded, ok
+
+
+def linear_bound_prepare(dist_params, imap_np: np.ndarray):
+    """Per-generation precompute of the TRANSFORMED-space prefix bound
+    (segmented early reject under a fitted linear learned transform,
+    p = 2).
+
+    With a linear transform the weighted transformed difference is
+    ``u = (x - x0)^T A`` where ``A[c, :] = w * W[c, :] / sd[c]`` (the
+    standardization shift cancels between x and x0). A trajectory
+    prefix P determines ``v = sum_{c in P} (x_c - x0_c) A[c, :]``; the
+    unknown remainder ``r`` lies in the row span of the REMAINING
+    segments' coefficient rows, so
+
+        min_r ||v + r||^2  =  v^T P_j v,
+
+    with ``P_j`` the orthogonal projector onto the null space of the
+    suffix Gram ``G_j = A_rest^T A_rest`` after ``j`` segments — an
+    EXACT lower bound on the final squared distance, computable from
+    the prefix alone. Early segments usually leave ``G_j`` full-rank
+    (``P_j = 0``: no retirement, trivially sound); as segments fold in
+    the null space grows until ``P_{n_seg} = I`` recovers the full
+    distance. Eigendirections within :data:`NULL_EIG_RTOL` of zero are
+    treated as null (float noise of a true zero); small-but-real
+    eigenvalues stay reachable, which only SHRINKS the bound — the
+    conservative side. The engine's ``BOUND_RTOL`` slack band guards
+    the comparison itself, and a surviving lane always gets the exact
+    final accept test.
+
+    ``imap_np`` is the STATIC (n_segments, seg_size) emission map; the
+    suffix masks are resolved at trace time, the Grams/eigh run on
+    device once per generation (C' x C' with C' = number of learned
+    features — a few, so the eigh cost is noise).
+
+    Returns ``{"At": (S, C'), "proj": (n_segments + 1, C', C')}``.
+    """
+    ssp = dist_params["ss"]
+    w = dist_params["w"]
+    W = ssp["W"]
+    At = (W / ssp["sd"][:, None]) * jnp.asarray(w, jnp.float32)[None, :]
+    n_seg = imap_np.shape[0]
+    projs = []
+    for j in range(n_seg + 1):
+        cols = imap_np[j:].reshape(-1)
+        rows = At[jnp.asarray(cols, jnp.int32)] if cols.size else None
+        if rows is None:
+            G = jnp.zeros((At.shape[1], At.shape[1]), jnp.float32)
+        else:
+            G = rows.T @ rows
+        lam, Q = jnp.linalg.eigh(G)
+        lam_max = jnp.maximum(lam[-1], 1e-30)
+        null = (lam <= NULL_EIG_RTOL * lam_max).astype(jnp.float32)
+        projs.append((Q * null[None, :]) @ Q.T)
+    return {"At": At, "proj": jnp.stack(projs)}
+
+
+def linear_bound_fns(rtol: float, out_dim: int):
+    """The ``{"init", "step", "exceeds"}`` closures of the transformed
+    prefix bound (see :func:`linear_bound_prepare` for the math and the
+    prepared-parameter layout). The accumulator is a flat
+    ``(out_dim + 1,)`` float32 row — the partial feature vector plus
+    the folded-segment count (exact in f32 for any real segment count),
+    so the engine's existing broadcast/select lane machinery applies
+    unchanged."""
+
+    def init():
+        return jnp.zeros((out_dim + 1,), jnp.float32)
+
+    def step(acc, vals, idx, x0, bp):
+        contrib = (vals - x0[idx]) @ bp["At"][idx]
+        return jnp.concatenate([acc[:-1] + contrib, acc[-1:] + 1.0])
+
+    def exceeds(acc, threshold, bp):
+        j = jnp.clip(acc[-1].astype(jnp.int32), 0, bp["proj"].shape[0] - 1)
+        v = acc[:-1]
+        q = v @ (bp["proj"][j] @ v)
+        return q > (threshold * (1.0 + rtol)) ** 2
+
+    return {"init": init, "step": step, "exceeds": exceeds}

@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability.sync import NULL_SYNC_LEDGER
+
 
 def _standardize_fit(x: np.ndarray):
     mu = x.mean(axis=0)
@@ -30,6 +32,12 @@ def _standardize_fit(x: np.ndarray):
 
 class Predictor(ABC):
     """y ~ f(x) regression with a traceable predict path."""
+
+    #: the owning run's SyncLedger (rebound by ``ABCSMC.run``): a fit
+    #: that trains on-device and fetches the result back blocks the host
+    #: and must account the round trip; outside a run the null ledger
+    #: swallows the record
+    sync_ledger = NULL_SYNC_LEDGER
 
     @abstractmethod
     def fit(self, x: np.ndarray, y: np.ndarray,
@@ -232,6 +240,10 @@ class MLPPredictor(Predictor):
             return params
 
         self._params = jax.device_get(train(params, opt_state))
+        self.sync_ledger.record(
+            "sumstat_train_fetch",
+            sum(int(np.asarray(v).nbytes)
+                for v in jax.tree.leaves(self._params)))
 
     def predict(self, x):
         x = np.asarray(x, np.float64)

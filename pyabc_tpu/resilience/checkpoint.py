@@ -52,8 +52,11 @@ from ..storage.bytes_storage import np_from_bytes, np_to_bytes
 
 #: bumped when the on-disk layout changes; loaders reject other versions
 #: with CheckpointCorruptError (v2: CRC/length header added, fused carry
-#: gained the health-guard stall state)
-CHECKPOINT_VERSION = 2
+#: gained the health-guard stall state; v3: learned-sumstat runs under a
+#: device-fit plan checkpoint at all — their dist_w slot carries the
+#: fitted predictor pytree ``{"w", "ss"}``, a structure no v2 loader
+#: ever rebuilt)
+CHECKPOINT_VERSION = 3
 
 #: file magic: identifies a framed pyabc_tpu checkpoint before any parse
 CHECKPOINT_MAGIC = b"PTCK"

@@ -1,4 +1,12 @@
 """Summary-statistic transforms (reference ``pyabc/sumstat/``)."""
 from .base import IdentitySumstat, PredictorSumstat, Sumstat
+from .device import device_fit_plan, mirror_fitted_params, plan_cache_token
 
-__all__ = ["Sumstat", "IdentitySumstat", "PredictorSumstat"]
+__all__ = [
+    "Sumstat",
+    "IdentitySumstat",
+    "PredictorSumstat",
+    "device_fit_plan",
+    "mirror_fitted_params",
+    "plan_cache_token",
+]

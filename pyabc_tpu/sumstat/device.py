@@ -1,0 +1,166 @@
+"""Device-native learned summary statistics (ISSUE 20 tentpole).
+
+``PredictorSumstat`` historically forced the fused loop into the
+crippled ``sumstat_refit`` dispatch mode: depth-1 pipeline, float32
+fetch, no speculation, no checkpoints, and a HOST-side predictor refit
+at every chunk boundary — and it was the recurring refusal reason in
+every capability gate (sharded kernel, segmented early reject,
+in-kernel calibration, look-ahead).
+
+This module decides when the fit itself can move INTO the kernel
+(:mod:`pyabc_tpu.ops.fit`): the resolved *device-fit plan* is a small
+static config the multigen kernel specializes on. Under a plan the
+fitted parameters ride the chunk carry as constant device operands
+(``dist_w["ss"]``), the kernel refits them at the boundary generation
+from the accepted reservoir (riding the collectives the cadence refit
+already pays — zero new host syncs), and the packed fetch ships
+TRANSFORMED C'-dim rows instead of raw S-dim statistics.
+
+What stays host-side keeps an actionable reason string
+(:func:`device_fit_plan` returns ``(None, reason)``), surfaced through
+the capability-fallback telemetry like every other gate in the repo.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..predictor.predictor import (
+    GPPredictor,
+    LassoPredictor,
+    LinearPredictor,
+    MLPPredictor,
+    ModelSelectionPredictor,
+)
+from .base import PredictorSumstat
+
+
+def device_fit_plan(distance, *, total_size: int, d_max: int,
+                    sharded_n: int | None = None) -> tuple[dict | None,
+                                                           str | None]:
+    """Resolve the in-kernel fit plan for a learned-sumstat distance, or
+    the actionable reason it stays on the host path.
+
+    ``(plan, None)`` means the multigen kernel can own the boundary
+    refit: ``plan`` is a static config (hashable via
+    :func:`plan_cache_token`) naming the fit kind and its
+    hyperparameters. ``(None, reason)`` means the legacy host-refit
+    dispatch mode serves the config; the reason lands in the
+    capability-fallback telemetry.
+
+    The resolution is STATIC — predictor type and hyperparameters only.
+    Whether the predictor is actually fitted (the generation-0 host fit
+    seeds the carried parameters and fixes the C' feature dimension) is
+    a runtime question the fused loop checks separately.
+    """
+    sumstat = getattr(distance, "sumstat", None)
+    if sumstat is None:
+        return None, "distance has no learned sumstat transform"
+    if not isinstance(sumstat, PredictorSumstat):
+        return None, (
+            f"{type(sumstat).__name__} is a fixed transform, not a "
+            f"fitted predictor — nothing to refit in-kernel"
+        )
+    if sumstat.fit_every != 1:
+        return None, (
+            f"fit_every={sumstat.fit_every} host cadence control: the "
+            f"in-kernel fit runs at every chunk boundary; drop "
+            f"fit_every (or set 1) for device-native fits"
+        )
+    pred = sumstat.predictor
+    need = (int(sumstat.min_samples) if sumstat.min_samples is not None
+            else total_size + 2)
+    if isinstance(pred, ModelSelectionPredictor):
+        return None, (
+            "ModelSelectionPredictor's cross-validated winner selection "
+            "is host control flow (per-candidate fits + a validation "
+            "split); the host-refit path serves it — pick the winning "
+            "predictor directly for device-native fits"
+        )
+    if isinstance(pred, GPPredictor):
+        return None, (
+            "GPPredictor subsamples training points with host RNG and "
+            "solves a dense kernel system per fit; the host-refit path "
+            "serves it — LinearPredictor/MLPPredictor fit on-device"
+        )
+    if isinstance(pred, LassoPredictor):
+        return None, (
+            "LassoPredictor's ISTA proximal loop fits host-side (L1 "
+            "thresholding has no bounded-cost in-kernel form here); "
+            "the host-refit path serves it — LinearPredictor fits "
+            "on-device"
+        )
+    if isinstance(pred, MLPPredictor):
+        if sharded_n:
+            return None, (
+                "MLPPredictor's warm-started Adam steps refit on the "
+                "gathered reservoir; the sharded kernel serves LINEAR "
+                "device fits only — drop sharding or switch to "
+                "LinearPredictor"
+            )
+        return {
+            "kind": "mlp",
+            "out_dim": int(d_max),
+            "need": need,
+            "lr": float(pred.lr),
+            "n_steps": min(int(pred.n_steps), 100),
+        }, None
+    if isinstance(pred, LinearPredictor):
+        return {
+            "kind": "linear",
+            "out_dim": int(d_max),
+            "need": need,
+            "alpha": float(pred.alpha),
+        }, None
+    return None, (
+        f"{type(pred).__name__} has no traceable in-kernel fit twin; "
+        f"the host-refit path serves it"
+    )
+
+
+def plan_cache_token(plan: dict | None) -> tuple | None:
+    """Hashable kernel-cache token of a device-fit plan (sorted items)."""
+    if plan is None:
+        return None
+    return tuple(sorted(plan.items()))
+
+
+def seed_params_ready(distance) -> bool:
+    """True when the generation-0 host fit has seeded the predictor, so
+    the carry's ``dist_w["ss"]`` pytree has its final (fitted)
+    structure and the C' feature dimension is fixed."""
+    sumstat = getattr(distance, "sumstat", None)
+    return (isinstance(sumstat, PredictorSumstat)
+            and sumstat.predictor.fitted)
+
+
+def mirror_fitted_params(distance, ssp_host, t: int) -> None:
+    """Write the kernel's boundary-fit parameters back into the HOST
+    predictor, so host state (checkpoint carry rebuilds, repr-level
+    diagnostics, a later host ``predict``) reflects the device fit.
+
+    ``ssp_host`` is the fetched ``dist_w_next["ss"]`` pytree for the
+    boundary generation (numpy, float32). The float32 values are stored
+    as-is: ``device_params()`` casts to float32 on the way back, so a
+    resume-rebuilt carry round-trips BIT-IDENTICAL to the carried
+    device operands (the preempt-matrix contract).
+    """
+    sumstat = distance.sumstat
+    pred = sumstat.predictor
+    if isinstance(pred, MLPPredictor):
+        pred._params = [
+            {"w": np.asarray(layer["w"], np.float32),
+             "b": np.asarray(layer["b"], np.float32)}
+            for layer in ssp_host["layers"]
+        ]
+        pred._mu = np.asarray(ssp_host["mu"], np.float32)
+        pred._sd = np.asarray(ssp_host["sd"], np.float32)
+        pred._ymu = np.asarray(ssp_host["ymu"], np.float32)
+        pred._ysd = np.asarray(ssp_host["ysd"], np.float32)
+        sumstat._out_dim = int(np.asarray(ssp_host["ymu"]).shape[-1])
+    else:
+        pred._W = np.asarray(ssp_host["W"], np.float32)
+        pred._b = np.asarray(ssp_host["b"], np.float32)
+        pred._mu = np.asarray(ssp_host["mu"], np.float32)
+        pred._sd = np.asarray(ssp_host["sd"], np.float32)
+        sumstat._out_dim = int(np.asarray(ssp_host["b"]).shape[-1])
+    sumstat._last_fit_t = int(t)

@@ -32,6 +32,29 @@ if os.environ.get("PYABC_TPU_BLOCK_PYARROW") == "1":
         del sys.modules[_m]
     sys.meta_path.insert(0, _PyarrowBlocker())
 
+# CI sumstat degradation leg (ISSUE 20): PYABC_TPU_BLOCK_SKLEARN=1
+# makes sklearn AND optax unimportable, proving the learned-summary
+# stack depends on neither for the LINEAR device path — the predictors
+# are hand-rolled numpy/JAX, the in-kernel ridge fit is pure JAX, and
+# optax is an optional dependency of the HOST MLP fit only.
+if os.environ.get("PYABC_TPU_BLOCK_SKLEARN") == "1":
+    import importlib.abc
+    import sys
+
+    class _LearnDepsBlocker(importlib.abc.MetaPathFinder):
+        _roots = ("sklearn", "optax")
+
+        def find_spec(self, name, path=None, target=None):
+            if name.split(".")[0] in self._roots:
+                raise ImportError(
+                    f"{name} import blocked (PYABC_TPU_BLOCK_SKLEARN=1)")
+            return None
+
+    for _m in [m for m in sys.modules
+               if m.split(".")[0] in ("sklearn", "optax")]:
+        del sys.modules[_m]
+    sys.meta_path.insert(0, _LearnDepsBlocker())
+
 import jax
 import numpy as np
 import pytest

@@ -295,3 +295,76 @@ def test_preempt_adaptive_carry_bit_identical(flavor, tmp_path):
         assert np.array_equal(a, b), (
             f"{flavor} preempt/resume diverged from the uninterrupted "
             f"run")
+
+
+# ------------------------------------- learned-sumstat cell (ISSUE 20)
+#
+# The fitted Fearnhead-Prangle transform rides the chunk carry
+# (dist_w["ss"]) and the checkpoint (format v3); a preempted run must
+# resume the predictor params mid-run on a different width and land
+# bit-identically — mirror_fitted_params stores the fetched float32
+# values verbatim, so the resume-rebuilt carry equals the carried
+# device operands bitwise.
+
+def _make_learned(db, *, width, seed=61, checkpoint_path=None):
+    @pt.JaxModel.from_function(["theta"], name="fp_preempt")
+    def model(key, theta):
+        k1, k2 = jax.random.split(key)
+        sig = theta[0] + NOISE_SD * jax.random.normal(k1, (2,))
+        noise = 5.0 * jax.random.normal(k2, (4,))
+        return {"sig": sig, "noise": noise}
+
+    return pt.ABCSMC(
+        model, pt.Distribution(theta=pt.RV("norm", 0.0, 1.0)),
+        pt.PNormDistance(p=2, sumstat=pt.PredictorSumstat(
+            pt.LinearPredictor())),
+        population_size=POP, eps=pt.MedianEpsilon(), seed=seed,
+        mesh=_mesh(width), sharded=N_SHARDS, fused_generations=G,
+        checkpoint_path=checkpoint_path,
+    )
+
+
+def test_preempt_learned_sumstat_w2_resume_w4_bit_identical(tmp_path):
+    """Learned-transform cell: interrupt the width-2 sharded run with
+    in-kernel boundary fits at the first post-seed chunk boundary,
+    resume at width 4 — full-History bit-identity vs the uninterrupted
+    virtual-shard run, with the device-fit plan active on BOTH legs."""
+    obs = {"sig": np.asarray([1.0, 1.0]), "noise": np.zeros(4)}
+    ref_db = f"sqlite:///{tmp_path}/ref_ss.db"
+    ref = _make_learned(ref_db, width=None)
+    ref.new(ref_db, obs)
+    h_ref = ref.run(max_nr_populations=GENS)
+    assert h_ref.n_populations == GENS
+    assert ref._sumstat_device_plan is not None
+    reference = _history_arrays(h_ref)
+
+    db = f"sqlite:///{tmp_path}/run_ss.db"
+    ck = str(tmp_path / "run_ss.ck")
+    abc = _make_learned(db, width=2, checkpoint_path=ck)
+    abc.new(db, obs)
+    abc_id = int(abc.history.id)
+    events = {"n": 0}
+
+    def on_chunk(ev):
+        # event 1 is the generation-0 HOST seed-fit collect; stop at
+        # the first REAL chunk boundary so fitted params are mid-carry
+        events["n"] += 1
+        if events["n"] >= 2:
+            abc.request_graceful_stop()
+
+    abc.chunk_event_cb = on_chunk
+    with pytest.raises(GracefulShutdown):
+        abc.run(max_nr_populations=GENS)
+    assert 0 < abc.history.n_populations < GENS
+
+    abc2 = _make_learned(db, width=4, checkpoint_path=ck)
+    abc2.load(db, abc_id)
+    h = abc2.run(max_nr_populations=GENS)
+    assert h.n_populations == GENS
+    assert abc2._sumstat_device_plan is not None
+    got = _history_arrays(h)
+    assert len(got) == len(reference)
+    for a, b in zip(reference, got):
+        assert np.array_equal(a, b), (
+            "learned-sumstat preempt/resume diverged from the "
+            "uninterrupted run")
